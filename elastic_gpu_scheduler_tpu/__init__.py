@@ -1,0 +1,19 @@
+"""tpu-elastic-scheduler: a TPU-native Kubernetes scheduling framework.
+
+A from-scratch rebuild of the capabilities of elastic-ai/elastic-gpu-scheduler
+(reference: /root/reference, a Go kube-scheduler extender for fractional/multi-card
+GPU scheduling) retargeted to Cloud TPU:
+
+- Extended resources ``elasticgpu.io/tpu-chip`` (100 units = 1 chip, fractional
+  TensorCore sharing) and ``elasticgpu.io/tpu-hbm`` (GiB), replacing
+  ``gpu-core``/``gpu-memory`` (reference: pkg/utils/types.go:6).
+- Placement over an explicit ICI mesh topology: allocations carry mesh
+  *coordinates*, not flat card indices (reference hands out anonymous indices,
+  pkg/scheduler/gpu.go:100).
+- Gang scheduling (all-or-nothing bind for SPMD replica groups) and
+  contiguous-sub-slice search — net-new vs. the reference.
+- A JAX/XLA workload plane (models/, ops/, parallel/) so scheduled placements
+  translate directly into ``jax.sharding.Mesh`` axes for pjit/shard_map jobs.
+"""
+
+__version__ = "0.1.0"

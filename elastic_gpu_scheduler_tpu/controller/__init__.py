@@ -1,0 +1,1 @@
+"""controller subpackage of elastic_gpu_scheduler_tpu."""

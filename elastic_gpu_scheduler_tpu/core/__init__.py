@@ -1,0 +1,1 @@
+"""core subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,319 @@
+"""Placement search and allocation state.
+
+TPU-native rebuild of the reference's allocation engine:
+
+- ``GPUs.Trade`` exhaustive DFS over anonymous card indices
+  (reference: pkg/scheduler/gpu.go:65-129)  →  ``ChipSet.trade``: a DFS over
+  containers whose whole-chip candidates are *contiguous ICI sub-boxes*
+  (compact-first canonical enumeration, topology.box_shapes/placements) with a
+  non-contiguous fallback, and whose complete assignments are scored by a
+  pluggable ``Rater``.
+- ``GPUs.Transact/Cancel`` (gpu.go:153-191)  →  ``ChipSet.transact/cancel``.
+- ``NodeAllocator`` (pkg/scheduler/node.go)  →  same name; caches the assume
+  result per request hash for reuse by score/bind, with two reference bugs
+  fixed: the hash is pod-unique (node.go:63-64 collides across same-shaped
+  pods) and ``score`` never dereferences a missing option (node.go:78-84
+  nil-deref).
+
+``ChipSet`` is deliberately node-agnostic: a host view (4-8 chips of a slice)
+and a slice view (all chips, for gang placement) are the same type, so the
+gang scheduler reuses this search unchanged at slice scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Optional
+
+from .chip import Chip
+from .request import TPURequest
+from .topology import Coord, Topology
+
+# Search budget: max complete assignments rated per trade() call.  The
+# reference's DFS is unbounded (gpu.go:65-129) and explodes combinatorially;
+# we keep best-so-far semantics under a budget so worst-case latency is capped.
+DEFAULT_SEARCH_BUDGET = 4096
+
+
+@dataclass(frozen=True)
+class ContainerAlloc:
+    """One container's placement: which chips, and how much of each."""
+
+    container: str
+    coords: tuple[Coord, ...]
+    whole: bool  # True → whole chips (all core+hbm of each coord)
+    core: int = 0  # per-chip core units when fractional
+    hbm: int = 0  # per-chip HBM GiB when fractional
+    contiguous: bool = True  # whole-chip: did we get an ICI-contiguous box?
+
+    @property
+    def needs_tpu(self) -> bool:
+        return bool(self.coords)
+
+
+@dataclass
+class Option:
+    """A complete placement decision for one pod on one node/slice.
+
+    Mirrors the reference's GPUOption (pkg/scheduler/allocate.go:60-93) with
+    coordinates instead of flat indices.
+    """
+
+    request_hash: str
+    allocs: tuple[ContainerAlloc, ...]
+    score: float = 0.0
+
+    def coords_by_container(self) -> dict[str, tuple[Coord, ...]]:
+        return {a.container: a.coords for a in self.allocs}
+
+
+class Rater:
+    """Placement policy: rate a complete assignment (reference: rater.go:8-10).
+
+    ``rate`` is called with the ChipSet *after* the option has been applied,
+    matching the reference's rate-post-assignment convention (rater.go:30-50).
+    Scores are floats in [0, 100]; the extender layer normalizes to 0-10.
+    """
+
+    name = "rater"
+
+    def rate(self, chips: "ChipSet", option: Option) -> float:
+        raise NotImplementedError
+
+
+class ChipSet:
+    """A set of TPU chips addressed by coordinates in a (possibly larger) mesh.
+
+    ``topo`` describes the full mesh the coordinates live in; ``chips`` may
+    cover only part of it (a host's chips within a slice).
+    """
+
+    def __init__(self, topo: Topology, chips: Iterable[Chip]):
+        self.topo = topo
+        self.chips: dict[Coord, Chip] = {}
+        for ch in chips:
+            if not topo.contains(ch.coord):
+                raise ValueError(f"chip coord {ch.coord} outside topology {topo.dims}")
+            if ch.coord in self.chips:
+                raise ValueError(f"duplicate chip coord {ch.coord}")
+            self.chips[ch.coord] = ch
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def num_chips(self) -> int:
+        return len(self.chips)
+
+    def free_chips(self) -> list[Chip]:
+        """Untouched chips in canonical (row-major) coordinate order."""
+        return sorted(
+            (c for c in self.chips.values() if c.is_free),
+            key=lambda c: self.topo.index(c.coord),
+        )
+
+    def total_core(self) -> int:
+        return sum(c.core_total for c in self.chips.values())
+
+    def avail_core(self) -> int:
+        return sum(c.core_avail for c in self.chips.values())
+
+    def total_hbm(self) -> int:
+        return sum(c.hbm_total for c in self.chips.values())
+
+    def avail_hbm(self) -> int:
+        return sum(c.hbm_avail for c in self.chips.values())
+
+    def clone(self) -> "ChipSet":
+        return ChipSet(self.topo, (c.clone() for c in self.chips.values()))
+
+    def status(self) -> dict:
+        return {
+            "topology": self.topo.spec(),
+            "chips": {
+                ".".join(map(str, co)): {
+                    "core_avail": ch.core_avail,
+                    "core_total": ch.core_total,
+                    "hbm_avail": ch.hbm_avail,
+                    "hbm_total": ch.hbm_total,
+                }
+                for co, ch in sorted(
+                    self.chips.items(), key=lambda kv: self.topo.index(kv[0])
+                )
+            },
+        }
+
+    # -- candidate generation ------------------------------------------------
+
+    def _whole_chip_candidates(
+        self, count: int, max_candidates: int
+    ) -> Iterator[tuple[tuple[Coord, ...], bool]]:
+        """Candidate coord-sets for a `count`-whole-chip container.
+
+        Yields (coords, contiguous).  Contiguous axis-aligned sub-boxes first
+        (most compact shapes first), then one non-contiguous fallback taking
+        free chips in canonical order — so a fragmented mesh still schedules,
+        just with a locality penalty applied by the rater.
+        """
+        free = {c.coord for c in self.free_chips()}
+        if len(free) < count:
+            return
+        emitted = 0
+        seen: set[frozenset] = set()
+        for shape in self.topo.box_shapes(count):
+            for box in self.topo.placements(shape):
+                if emitted >= max_candidates:
+                    break
+                if all(c in free for c in box):
+                    key = frozenset(box)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    emitted += 1
+                    yield box, True
+            if emitted >= max_candidates:
+                break
+        if emitted == 0:
+            fallback = tuple(sorted(free, key=self.topo.index)[:count])
+            yield fallback, False
+
+    def _fractional_candidates(self, core: int, hbm: int) -> Iterator[Coord]:
+        for ch in sorted(self.chips.values(), key=lambda c: self.topo.index(c.coord)):
+            if ch.can_fit(core, hbm):
+                yield ch.coord
+
+    # -- the search ----------------------------------------------------------
+
+    def trade(
+        self,
+        request: TPURequest,
+        rater: Rater,
+        search_budget: int = DEFAULT_SEARCH_BUDGET,
+        max_candidates_per_container: int = 64,
+    ) -> Optional[Option]:
+        """Find the best-scoring placement for all containers, or None.
+
+        DFS over containers; each complete assignment is applied, rated, and
+        rolled back.  Best score wins; ties keep the FIRST found (deterministic
+        — the reference keeps the last due to a strict `>` guard, gpu.go:85;
+        deviation documented in SURVEY §5).
+        """
+        units = list(zip(request.container_names, request.units))
+        chosen: list[ContainerAlloc] = []
+        best: list[Optional[Option]] = [None]
+        budget = [search_budget]
+
+        def dfs(i: int) -> None:
+            if budget[0] <= 0:
+                return
+            if i == len(units):
+                budget[0] -= 1
+                opt = Option(request.hash(), tuple(chosen))
+                score = rater.rate(self, opt)
+                opt.score = score
+                if best[0] is None or score > best[0].score:
+                    best[0] = opt
+                return
+            name, unit = units[i]
+            if not unit.needs_tpu:
+                chosen.append(
+                    ContainerAlloc(container=name, coords=(), whole=False)
+                )
+                dfs(i + 1)
+                chosen.pop()
+                return
+            if unit.wants_whole_chips:
+                for coords, contiguous in self._whole_chip_candidates(
+                    unit.chip_count, max_candidates_per_container
+                ):
+                    alloc = ContainerAlloc(
+                        container=name, coords=coords, whole=True,
+                        contiguous=contiguous,
+                    )
+                    self._apply(alloc)
+                    chosen.append(alloc)
+                    dfs(i + 1)
+                    chosen.pop()
+                    self._revert(alloc)
+                    if budget[0] <= 0:
+                        return
+            else:
+                core = max(unit.core, 0)
+                hbm = unit.hbm
+                n = 0
+                for coord in self._fractional_candidates(core, hbm):
+                    alloc = ContainerAlloc(
+                        container=name, coords=(coord,), whole=False,
+                        core=core, hbm=hbm,
+                    )
+                    self._apply(alloc)
+                    chosen.append(alloc)
+                    dfs(i + 1)
+                    chosen.pop()
+                    self._revert(alloc)
+                    n += 1
+                    if n >= max_candidates_per_container or budget[0] <= 0:
+                        return
+
+        dfs(0)
+        return best[0]
+
+    # -- state transitions ---------------------------------------------------
+
+    def _apply(self, alloc: ContainerAlloc) -> None:
+        if alloc.whole:
+            for c in alloc.coords:
+                self.chips[c].take_whole()
+        else:
+            for c in alloc.coords:
+                self.chips[c].take(alloc.core, alloc.hbm)
+
+    def _revert(self, alloc: ContainerAlloc) -> None:
+        if alloc.whole:
+            for c in alloc.coords:
+                self.chips[c].give_whole()
+        else:
+            for c in alloc.coords:
+                self.chips[c].give(alloc.core, alloc.hbm)
+
+    def can_transact(self, option: Option) -> bool:
+        """Check the whole option fits the current state without mutating it."""
+        core_need: dict[Coord, int] = {}
+        hbm_need: dict[Coord, int] = {}
+        whole_need: set[Coord] = set()
+        for a in option.allocs:
+            if not a.needs_tpu:
+                continue
+            for c in a.coords:
+                if c not in self.chips:
+                    return False
+                if a.whole:
+                    if c in whole_need:
+                        return False
+                    whole_need.add(c)
+                else:
+                    core_need[c] = core_need.get(c, 0) + a.core
+                    hbm_need[c] = hbm_need.get(c, 0) + a.hbm
+        for c in whole_need:
+            if not self.chips[c].is_free or c in core_need:
+                return False
+        for c, need in core_need.items():
+            ch = self.chips[c]
+            if ch.core_avail < need or ch.hbm_avail < hbm_need.get(c, 0):
+                return False
+        return True
+
+    def transact(self, option: Option) -> None:
+        """Commit an option (reference: gpu.go:153-175).  All-or-nothing:
+        the option is validated in full before any chip is touched, so a
+        mid-apply failure can never leak partial allocations."""
+        if not self.can_transact(option):
+            raise ValueError(f"option {option.request_hash} no longer fits")
+        for a in option.allocs:
+            if a.needs_tpu:
+                self._apply(a)
+
+    def cancel(self, option: Option) -> None:
+        """Roll back a committed option (reference: gpu.go:177-191)."""
+        for a in option.allocs:
+            if a.needs_tpu:
+                self._revert(a)

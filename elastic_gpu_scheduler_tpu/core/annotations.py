@@ -1,0 +1,100 @@
+"""Allocation ↔ pod-annotation codec: the durable ledger.
+
+The reference persists every allocation as pod annotations and rebuilds all
+in-memory state from them on restart (reference: pkg/scheduler/pod.go:57-78
+writes; pkg/scheduler/allocate.go:75-93 reads back).  Same design here, with
+mesh *coordinates* on the wire instead of flat card indices:
+
+    elasticgpu.io/assumed: "true"              (annotation AND label)
+    elasticgpu.io/node: <node name>
+    elasticgpu.io/container-<name>: "0.0.0,0.1.0"   (chip coords, row-major)
+    elasticgpu.io/allocated-topology: "2x1x1"       (bounding box, informational)
+
+Amounts (whole vs fractional, core units, HBM) are NOT in the annotations —
+they are recovered from the pod's own resource requests, exactly as the
+reference does, so the pod spec + annotations together are the full record.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import consts
+from .allocator import ContainerAlloc, Option
+from .request import TPURequest, request_from_pod
+from .topology import Topology, bounding_box, format_coord, format_topology, is_contiguous, parse_coord
+
+
+def annotations_for_option(option: Option, node_name: str) -> dict[str, str]:
+    ann = {
+        consts.ANNOTATION_ASSUMED: "true",
+        consts.ANNOTATION_NODE: node_name,
+    }
+    all_coords = []
+    for a in option.allocs:
+        if not a.needs_tpu:
+            continue
+        ann[consts.ANNOTATION_CONTAINER_PREFIX + a.container] = ",".join(
+            format_coord(c) for c in a.coords
+        )
+        all_coords.extend(a.coords)
+    if all_coords:
+        ann[consts.ANNOTATION_TOPOLOGY] = format_topology(bounding_box(all_coords))
+    return ann
+
+
+def labels_for_option() -> dict[str, str]:
+    return {consts.ANNOTATION_ASSUMED: "true"}
+
+
+def is_assumed(pod) -> bool:
+    """Reference: pkg/scheduler/pod.go:80-82."""
+    ann = pod.metadata.annotations or {}
+    return ann.get(consts.ANNOTATION_ASSUMED) == "true"
+
+
+def assigned_node(pod) -> Optional[str]:
+    ann = pod.metadata.annotations or {}
+    return ann.get(consts.ANNOTATION_NODE) or (pod.spec.node_name or None)
+
+
+def option_from_pod(pod, topo: Topology) -> Optional[Option]:
+    """Reconstruct the committed Option from a bound pod's annotations —
+    the restart-recovery path (reference: allocate.go:75-93).
+
+    Returns None if the pod has no TPU allocation annotations.
+    """
+    ann = pod.metadata.annotations or {}
+    request = request_from_pod(pod)
+    allocs: list[ContainerAlloc] = []
+    found = False
+    for name, unit in zip(request.container_names, request.units):
+        key = consts.ANNOTATION_CONTAINER_PREFIX + name
+        raw = ann.get(key)
+        if raw is None or not unit.needs_tpu:
+            allocs.append(ContainerAlloc(container=name, coords=(), whole=False))
+            continue
+        found = True
+        coords = tuple(parse_coord(p) for p in raw.split(",") if p)
+        if unit.wants_whole_chips:
+            allocs.append(
+                ContainerAlloc(
+                    container=name,
+                    coords=coords,
+                    whole=True,
+                    contiguous=is_contiguous(coords, topo),
+                )
+            )
+        else:
+            allocs.append(
+                ContainerAlloc(
+                    container=name,
+                    coords=coords,
+                    whole=False,
+                    core=max(unit.core, 0),
+                    hbm=unit.hbm,
+                )
+            )
+    if not found:
+        return None
+    return Option(request_hash=request.hash(), allocs=tuple(allocs))

@@ -1,0 +1,72 @@
+"""Per-chip capacity state.
+
+TPU analogue of the reference's GPU device model (reference:
+pkg/scheduler/gpu.go:9-56): a chip exposes 100 core units (fractional
+TensorCore duty share — the ``elasticgpu.io/tpu-chip`` resource) and an HBM
+budget in GiB (``elasticgpu.io/tpu-hbm``).  Whole-chip allocation zeroes both
+availabilities; fractional allocation subtracts.  Unlike the reference, every
+chip carries its ICI mesh coordinate so placements are topology-addressable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .topology import Coord
+
+CORE_PER_CHIP = 100  # 100 units = one whole chip (reference: pkg/utils/types.go:6)
+
+
+@dataclass
+class Chip:
+    coord: Coord
+    core_total: int = CORE_PER_CHIP
+    hbm_total: int = 0  # GiB
+    core_avail: int = field(default=-1)
+    hbm_avail: int = field(default=-1)
+
+    def __post_init__(self):
+        if self.core_avail < 0:
+            self.core_avail = self.core_total
+        if self.hbm_avail < 0:
+            self.hbm_avail = self.hbm_total
+
+    @property
+    def is_free(self) -> bool:
+        return self.core_avail == self.core_total and self.hbm_avail == self.hbm_total
+
+    @property
+    def is_untouched(self) -> bool:
+        """No fractional tenant — whole-chip allocation requires this."""
+        return self.is_free
+
+    def can_fit(self, core: int, hbm: int) -> bool:
+        return self.core_avail >= core and self.hbm_avail >= hbm
+
+    def take(self, core: int, hbm: int) -> None:
+        if not self.can_fit(core, hbm):
+            raise ValueError(
+                f"chip {self.coord}: cannot take core={core} hbm={hbm} "
+                f"(avail core={self.core_avail} hbm={self.hbm_avail})"
+            )
+        self.core_avail -= core
+        self.hbm_avail -= hbm
+
+    def give(self, core: int, hbm: int) -> None:
+        self.core_avail = min(self.core_total, self.core_avail + core)
+        self.hbm_avail = min(self.hbm_total, self.hbm_avail + hbm)
+
+    def take_whole(self) -> None:
+        if not self.is_free:
+            raise ValueError(f"chip {self.coord}: not free for whole-chip take")
+        self.core_avail = 0
+        self.hbm_avail = 0
+
+    def give_whole(self) -> None:
+        self.core_avail = self.core_total
+        self.hbm_avail = self.hbm_total
+
+    def clone(self) -> "Chip":
+        return Chip(
+            self.coord, self.core_total, self.hbm_total, self.core_avail, self.hbm_avail
+        )

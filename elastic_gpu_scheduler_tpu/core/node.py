@@ -1,0 +1,172 @@
+"""Per-node allocation view with assume/score/allocate memoization.
+
+TPU rebuild of the reference's NodeAllocator (reference: pkg/scheduler/node.go):
+
+- built from a Node object, not a client — keeps the core unit-testable
+  without an API server (the pattern the reference's lone test gestures at,
+  pkg/scheduler/scheduler_test.go:11-24).
+- ``assume`` caches its Option under the request hash so filter→score→bind
+  reuse one placement (node.go:64-72); ``allocate`` consumes the cached option
+  (node.go:87-104).
+- Fixed vs reference: ``score`` on a cache miss re-assumes and then reads the
+  *fresh* option (node.go:78-84 dereferences nil); the hash is pod-unique
+  (see core/request.py); capacity is re-readable via ``refresh_from_node``
+  instead of being frozen at first sight (scheduler.go:62-64 caches forever).
+
+Chip inventory derivation: the node's allocatable ``elasticgpu.io/tpu-chip``
+(core units, 100/chip) gives the chip count; HBM is split evenly across chips
+(the reference does the same even split for gpu memory, node.go:33-40, with the
+same uniformity caveat).  Coordinates come from the node's topology labels
+(host box + offset within the slice); absent labels fall back to a 1-D mesh —
+so plain "N chips" nodes work with zero topology configuration.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils import consts
+from .allocator import ChipSet, Option, Rater
+from .chip import CORE_PER_CHIP, Chip
+from .request import TPURequest
+from .topology import Coord, Topology, default_wrap, parse_coord, parse_topology
+
+
+def chips_from_node(node) -> tuple[Topology, list[Chip]]:
+    """Derive (slice topology, this host's chips) from a k8s Node object."""
+    alloc = node.status.allocatable or {}
+    core_units = int(str(alloc.get(consts.RESOURCE_TPU_CORE, "0")))
+    hbm_total = int(str(alloc.get(consts.RESOURCE_TPU_HBM, "0")))
+    chip_count = core_units // CORE_PER_CHIP
+    if chip_count <= 0:
+        return Topology((0,)), []
+    hbm_per_chip = hbm_total // chip_count
+
+    labels = node.metadata.labels or {}
+    family = labels.get(consts.LABEL_TPU_ACCELERATOR, "v5e")
+    slice_spec = labels.get(consts.LABEL_TPU_TOPOLOGY)
+    host_spec = labels.get(consts.LABEL_TPU_HOST_TOPOLOGY)
+    offset_spec = labels.get(consts.LABEL_TPU_HOST_OFFSET)
+
+    if slice_spec:
+        slice_dims = parse_topology(slice_spec)
+        topo = Topology(slice_dims, default_wrap(family, slice_dims))
+        host_dims = parse_topology(host_spec) if host_spec else None
+        offset = parse_coord(offset_spec) if offset_spec else (0,) * len(slice_dims)
+        if host_dims is None:
+            # host owns a row-major prefix of the slice starting at offset
+            coords = []
+            start = topo.index(offset)
+            for i in range(start, start + chip_count):
+                coords.append(topo.coord_of(i))
+        else:
+            host_topo = Topology(host_dims)
+            coords = [
+                tuple(o + l for o, l in zip(offset, local))
+                for local in host_topo.coords()
+            ][:chip_count]
+    else:
+        topo = Topology((chip_count,))
+        coords = [(i,) for i in range(chip_count)]
+
+    chips = [Chip(coord=c, hbm_total=hbm_per_chip) for c in coords]
+    return topo, chips
+
+
+class NodeAllocator:
+    """One node's chips + the per-request option cache."""
+
+    def __init__(self, node):
+        self.node_name = node.metadata.name
+        topo, chips = chips_from_node(node)
+        self.chips = ChipSet(topo, chips)
+        self.allocated: dict[str, Option] = {}  # request hash → assumed option
+        self.lock = threading.Lock()
+
+    # -- verbs (reference: node.go:61-160) -----------------------------------
+
+    def assume(self, request: TPURequest, rater: Rater) -> Optional[Option]:
+        with self.lock:
+            h = request.hash()
+            cached = self.allocated.get(h)
+            if cached is not None:
+                return cached
+            opt = self.chips.trade(request, rater)
+            if opt is not None:
+                self.allocated[h] = opt
+            return opt
+
+    def score(self, request: TPURequest, rater: Rater) -> Optional[float]:
+        opt = self.assume(request, rater)
+        return None if opt is None else opt.score
+
+    def allocate(self, request: TPURequest, rater: Rater) -> Option:
+        """Pop the cached option (re-assuming if evicted or stale) and commit.
+
+        A cached option can go stale: assume() doesn't reserve chips, so an
+        earlier pod's commit may have taken them.  In that case we re-trade
+        against current state instead of failing (the reference crashes or
+        mis-fails here; SURVEY §5 request-hash/cache quirks).
+        """
+        with self.lock:
+            h = request.hash()
+            opt = self.allocated.pop(h, None)
+            if opt is not None and not self.chips.can_transact(opt):
+                opt = None  # stale — placement taken since assume
+            if opt is None:
+                opt = self.chips.trade(request, rater)
+            if opt is None:
+                raise RuntimeError(
+                    f"node {self.node_name}: cannot find option for {request.pod_key}"
+                )
+            self.chips.transact(opt)
+            return opt
+
+    def forget(self, option: Option) -> None:
+        """Free a committed allocation (reference: node.go:129-140)."""
+        with self.lock:
+            self.chips.cancel(option)
+
+    def add(self, option: Option) -> None:
+        """Learn an externally-committed allocation (restart rebuild or a bind
+        by another replica; reference: node.go:148-160)."""
+        with self.lock:
+            self.chips.transact(option)
+
+    def drop_assumed(self, request_hash: str) -> None:
+        """Evict a cached (not committed) option — e.g. gang rollback."""
+        with self.lock:
+            self.allocated.pop(request_hash, None)
+
+    def refresh_from_node(self, node) -> None:
+        """Re-derive capacity if the node's allocatable changed (the reference
+        never does this; SURVEY §5 'node allocator cached forever')."""
+        with self.lock:
+            topo, chips = chips_from_node(node)
+            same_shape = topo.dims == self.chips.topo.dims and set(
+                c.coord for c in chips
+            ) == set(self.chips.chips)
+            if not same_shape:
+                self.chips = ChipSet(topo, chips)
+                self.allocated.clear()
+                return
+            # Same chip layout: apply per-chip total changes (e.g. HBM resize)
+            # while preserving live usage.
+            for fresh in chips:
+                live = self.chips.chips[fresh.coord]
+                if fresh.hbm_total != live.hbm_total:
+                    used = live.hbm_total - live.hbm_avail
+                    live.hbm_total = fresh.hbm_total
+                    live.hbm_avail = max(0, fresh.hbm_total - used)
+                if fresh.core_total != live.core_total:
+                    used = live.core_total - live.core_avail
+                    live.core_total = fresh.core_total
+                    live.core_avail = max(0, fresh.core_total - used)
+
+    def status(self) -> dict:
+        with self.lock:
+            s = self.chips.status()
+            s["node"] = self.node_name
+            s["pending_options"] = len(self.allocated)
+            return s

@@ -1,0 +1,130 @@
+"""Pod → TPU resource request model.
+
+TPU analogue of the reference's request parsing (reference:
+pkg/scheduler/allocate.go:15-58):
+
+- per container: ``TPUUnit(core, hbm, chip_count)``
+- ``core == 0 and hbm == 0``  → NOT_NEEDED (container takes no TPU)
+- ``core >= 100``             → whole chips, ``chip_count = core // 100``
+                                 (must be an exact multiple; the reference
+                                 silently floors, allocate.go:46-49 — we reject)
+- ``0 < core < 100``          → fractional share of one chip (+ hbm)
+- ``core == 0 and hbm > 0``   → hbm-only fractional share (gpushare-by-memory)
+
+The request hash keys the assume→score→bind memoization cache.  Unlike the
+reference — whose hash is shape-only and collides across identically-shaped
+pending pods (allocate.go:30-33; quirk documented in SURVEY §5) — ours mixes in
+the pod UID so each pending pod gets its own cached placement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+from ..utils import consts
+
+NOT_NEEDED = -1  # container requests no TPU (reference: allocate.go:15-18)
+
+
+@dataclass(frozen=True)
+class TPUUnit:
+    """One container's demand."""
+
+    core: int = NOT_NEEDED  # core units on ONE chip, or NOT_NEEDED
+    hbm: int = 0  # GiB on that chip
+    chip_count: int = 0  # >0 → that many WHOLE chips (core/hbm then unused)
+
+    @property
+    def needs_tpu(self) -> bool:
+        return self.chip_count > 0 or self.core > 0 or self.hbm > 0
+
+    @property
+    def wants_whole_chips(self) -> bool:
+        return self.chip_count > 0
+
+
+@dataclass(frozen=True)
+class TPURequest:
+    """Parsed per-pod request: one TPUUnit per container, in spec order."""
+
+    pod_uid: str
+    pod_key: str  # namespace/name
+    units: tuple[TPUUnit, ...]
+    container_names: tuple[str, ...]
+    gang_name: str = ""
+    gang_size: int = 0
+
+    @property
+    def needs_tpu(self) -> bool:
+        return any(u.needs_tpu for u in self.units)
+
+    @property
+    def total_chips_equiv(self) -> float:
+        """Demand in whole-chip equivalents (for packing-efficiency math)."""
+        t = 0.0
+        for u in self.units:
+            if u.wants_whole_chips:
+                t += u.chip_count
+            elif u.needs_tpu:
+                t += max(u.core, 0) / consts.CORE_PER_CHIP
+        return t
+
+    def hash(self) -> str:
+        h = hashlib.sha256()
+        h.update(self.pod_uid.encode())
+        for name, u in zip(self.container_names, self.units):
+            h.update(f"|{name}:{u.core}:{u.hbm}:{u.chip_count}".encode())
+        return h.hexdigest()[:16]
+
+
+def _get_quantity(resources: Mapping[str, object], names: Sequence[str]) -> int:
+    total = 0
+    for n in names:
+        v = resources.get(n)
+        if v is None:
+            continue
+        total += int(str(v))
+    return total
+
+
+def unit_from_resources(resources: Mapping[str, object]) -> TPUUnit:
+    """Parse one container's resource map (limits merged over requests)."""
+    core = _get_quantity(resources, consts.RESOURCE_TPU_CORE_ALIASES)
+    hbm = _get_quantity(resources, consts.RESOURCE_TPU_HBM_ALIASES)
+    if core == 0 and hbm == 0:
+        return TPUUnit(core=NOT_NEEDED, hbm=0, chip_count=0)
+    if core >= consts.CORE_PER_CHIP:
+        if core % consts.CORE_PER_CHIP != 0:
+            raise ValueError(
+                f"{consts.RESOURCE_TPU_CORE}={core}: multi-chip requests must be "
+                f"an exact multiple of {consts.CORE_PER_CHIP}"
+            )
+        return TPUUnit(core=0, hbm=hbm, chip_count=core // consts.CORE_PER_CHIP)
+    return TPUUnit(core=core, hbm=hbm, chip_count=0)
+
+
+def request_from_pod(pod) -> TPURequest:
+    """Build a TPURequest from a k8s Pod object (see k8s/objects.py)."""
+    units = []
+    names = []
+    for c in pod.spec.containers:
+        res = dict(c.resources.requests or {})
+        res.update(c.resources.limits or {})
+        units.append(unit_from_resources(res))
+        names.append(c.name)
+    ann = pod.metadata.annotations or {}
+    gang = ann.get(consts.ANNOTATION_GANG_NAME, "")
+    try:
+        gang_size = int(ann.get(consts.ANNOTATION_GANG_SIZE, "0"))
+    except ValueError:
+        gang_size = 0
+    return TPURequest(
+        pod_uid=pod.metadata.uid,
+        pod_key=f"{pod.metadata.namespace}/{pod.metadata.name}",
+        units=tuple(units),
+        container_names=tuple(names),
+        gang_name=gang,
+        gang_size=gang_size,
+    )

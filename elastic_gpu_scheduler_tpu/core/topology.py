@@ -1,0 +1,243 @@
+"""ICI mesh topology model.
+
+The reference models a node's GPUs as a flat list with anonymous integer indices
+(reference: pkg/scheduler/node.go:32-40, pkg/scheduler/gpu.go:193-202) and is
+therefore blind to interconnect locality. On TPU, chips in a slice form an ICI
+mesh/torus (2D for v5e, 3D for v4/v5p) and collective performance depends on
+allocations being *contiguous sub-slices* of that mesh. This module is the
+coordinate space everything else speaks:
+
+- ``Topology``: an N-D mesh with per-axis wraparound (torus) flags.
+- ``Coord``: a chip's position, serialized as "x.y.z" in pod annotations.
+- sub-box enumeration: all axis-aligned placements of a requested shape,
+  including torus wraparound — the candidate set for contiguous placement.
+- shape factorization: ways to realize "N chips" as a box inside the mesh.
+
+GKE exposes slice topology via node labels (``cloud.google.com/gke-tpu-topology``
+style, e.g. "4x4x8"); we mirror that with ``elasticgpu.io/tpu-topology`` plus a
+per-host offset label so each Kubernetes node (one TPU host) knows which
+coordinates of the slice it owns. See k8s/objects.py for the label names.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from dataclasses import dataclass
+from typing import Iterator, Sequence
+
+import numpy as np
+
+Coord = tuple[int, ...]
+
+
+def parse_topology(spec: str) -> tuple[int, ...]:
+    """Parse "4x4x8" → (4, 4, 8). Accepts 1-4 axes."""
+    try:
+        dims = tuple(int(p) for p in spec.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"bad topology spec {spec!r}") from e
+    if not (1 <= len(dims) <= 4) or any(d <= 0 for d in dims):
+        raise ValueError(f"bad topology spec {spec!r}")
+    return dims
+
+
+def format_topology(dims: Sequence[int]) -> str:
+    return "x".join(str(d) for d in dims)
+
+
+def format_coord(c: Coord) -> str:
+    """Wire format for one chip coordinate: "x.y.z"."""
+    return ".".join(str(v) for v in c)
+
+
+def parse_coord(s: str) -> Coord:
+    return tuple(int(p) for p in s.split("."))
+
+
+# Accelerator families.  cores_per_chip is informational (v5p/v4 chips have two
+# TensorCores fused as one "megacore" device under XLA; v5e has one).  A torus
+# axis on v4/v5p exists when the full-slice axis length is a multiple of 4
+# (wrap-around ICI links); v5e slices are plain 2D meshes.
+ACCELERATOR_FAMILIES = {
+    "v4": {"ndim": 3, "cores_per_chip": 2, "chips_per_host": 4, "torus_multiple": 4},
+    "v5e": {"ndim": 2, "cores_per_chip": 1, "chips_per_host": 4, "torus_multiple": 0},
+    "v5p": {"ndim": 3, "cores_per_chip": 2, "chips_per_host": 4, "torus_multiple": 4},
+    "v6e": {"ndim": 2, "cores_per_chip": 1, "chips_per_host": 4, "torus_multiple": 0},
+}
+
+
+def default_wrap(family: str, dims: Sequence[int]) -> tuple[bool, ...]:
+    info = ACCELERATOR_FAMILIES.get(family, {"torus_multiple": 0})
+    m = info.get("torus_multiple", 0)
+    return tuple(bool(m) and d % m == 0 and d >= m for d in dims)
+
+
+@dataclass(frozen=True)
+class Topology:
+    """An N-D ICI mesh with optional per-axis wraparound."""
+
+    dims: tuple[int, ...]
+    wrap: tuple[bool, ...] = ()
+
+    def __post_init__(self):
+        if not self.wrap:
+            object.__setattr__(self, "wrap", (False,) * len(self.dims))
+        if len(self.wrap) != len(self.dims):
+            raise ValueError("wrap length must match dims")
+
+    @classmethod
+    def from_spec(cls, spec: str, family: str = "v5e") -> "Topology":
+        dims = parse_topology(spec)
+        return cls(dims, default_wrap(family, dims))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    @property
+    def num_chips(self) -> int:
+        return int(np.prod(self.dims))
+
+    def spec(self) -> str:
+        return format_topology(self.dims)
+
+    def coords(self) -> Iterator[Coord]:
+        """All coordinates in row-major order (the canonical chip order)."""
+        return itertools.product(*(range(d) for d in self.dims))
+
+    def index(self, c: Coord) -> int:
+        """Row-major linear index of a coordinate."""
+        idx = 0
+        for v, d in zip(c, self.dims):
+            idx = idx * d + v
+        return idx
+
+    def coord_of(self, idx: int) -> Coord:
+        if not (0 <= idx < self.num_chips):
+            raise ValueError(f"index {idx} out of range for topology {self.dims}")
+        c = []
+        for d in reversed(self.dims):
+            c.append(idx % d)
+            idx //= d
+        return tuple(reversed(c))
+
+    def contains(self, c: Coord) -> bool:
+        return len(c) == self.ndim and all(0 <= v < d for v, d in zip(c, self.dims))
+
+    def neighbors(self, c: Coord) -> Iterator[Coord]:
+        """ICI neighbors (mesh edges, plus torus edges on wrapped axes)."""
+        for ax in range(self.ndim):
+            for step in (-1, 1):
+                v = c[ax] + step
+                if self.wrap[ax]:
+                    v %= self.dims[ax]
+                elif not (0 <= v < self.dims[ax]):
+                    continue
+                n = c[:ax] + (v,) + c[ax + 1 :]
+                if n != c:
+                    yield n
+
+    # -- sub-box placement ---------------------------------------------------
+
+    def placements(self, shape: Sequence[int]) -> Iterator[tuple[Coord, ...]]:
+        """All placements of an axis-aligned `shape` box: yields coord tuples.
+
+        On wrapped (torus) axes the box may wrap around; on mesh axes it must
+        fit inside.  `shape` must have self.ndim axes.
+        """
+        if len(shape) != self.ndim:
+            raise ValueError(f"shape {shape} has wrong rank for {self.dims}")
+        if any(s > d for s, d in zip(shape, self.dims)):
+            return
+        origin_ranges = []
+        for s, d, w in zip(shape, self.dims, self.wrap):
+            if w and s < d:
+                origin_ranges.append(range(d))
+            else:
+                origin_ranges.append(range(d - s + 1))
+        for origin in itertools.product(*origin_ranges):
+            box = []
+            for offs in itertools.product(*(range(s) for s in shape)):
+                c = tuple(
+                    (o + f) % d if w else o + f
+                    for o, f, d, w in zip(origin, offs, self.dims, self.wrap)
+                )
+                box.append(c)
+            yield tuple(box)
+
+    def box_shapes(self, count: int, max_shapes: int = 64) -> list[tuple[int, ...]]:
+        """Axis-aligned box shapes with `count` chips that fit in this mesh.
+
+        Sorted most-compact-first (minimal surface area → minimal ICI hop
+        diameter).  This is the canonical sub-slice enumeration replacing the
+        reference's "take the first N free cards" (pkg/scheduler/gpu.go:95-108).
+        """
+        return _box_shapes_cached(self.dims, count, max_shapes)
+
+
+@functools.lru_cache(maxsize=4096)
+def _box_shapes_cached(
+    dims: tuple[int, ...], count: int, max_shapes: int
+) -> list[tuple[int, ...]]:
+    ndim = len(dims)
+    shapes: set[tuple[int, ...]] = set()
+
+    def rec(prefix: tuple[int, ...], remaining: int, ax: int):
+        if ax == ndim - 1:
+            if remaining <= dims[ax]:
+                shapes.add(prefix + (remaining,))
+            return
+        for f in _divisors(remaining):
+            if f <= dims[ax]:
+                rec(prefix + (f,), remaining // f, ax + 1)
+
+    rec((), count, 0)
+
+    def compactness(shape: tuple[int, ...]) -> tuple:
+        # surface area of the box (lower = more compact), then max dim
+        vol = int(np.prod(shape))
+        surf = sum(
+            2 * vol // s for s in shape
+        )  # proportional surface; exact enough for ordering
+        return (surf, max(shape))
+
+    out = sorted(shapes, key=compactness)
+    return out[:max_shapes]
+
+
+def _divisors(n: int) -> list[int]:
+    out = []
+    i = 1
+    while i * i <= n:
+        if n % i == 0:
+            out.append(i)
+            if i != n // i:
+                out.append(n // i)
+        i += 1
+    return sorted(out)
+
+
+def bounding_box(coords: Sequence[Coord]) -> tuple[int, ...]:
+    """Bounding-box shape of a coordinate set (ignoring wraparound)."""
+    if not coords:
+        return ()
+    lo = [min(c[i] for c in coords) for i in range(len(coords[0]))]
+    hi = [max(c[i] for c in coords) for i in range(len(coords[0]))]
+    return tuple(h - l + 1 for l, h in zip(lo, hi))
+
+
+def is_contiguous(coords: Sequence[Coord], topo: Topology) -> bool:
+    """True if the coordinate set is connected in the ICI graph (BFS)."""
+    if not coords:
+        return True
+    cs = set(coords)
+    seen = {next(iter(cs))}
+    frontier = [next(iter(cs))]
+    while frontier:
+        cur = frontier.pop()
+        for n in topo.neighbors(cur):
+            if n in cs and n not in seen:
+                seen.add(n)
+                frontier.append(n)
+    return len(seen) == len(cs)

@@ -1,0 +1,1 @@
+"""deviceplugin subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,1 @@
+"""k8s subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,308 @@
+"""Minimal typed Kubernetes object model (Pod / Node / Binding).
+
+The reference links client-go and the full k8s API machinery; this build keeps
+a deliberately small typed core speaking the real API JSON (camelCase wire
+names), because (a) only pods, nodes, and bindings matter to the scheduler,
+and (b) the scheduling core must be constructible from plain objects with no
+API server — the unit-test pattern the reference gestures at
+(pkg/scheduler/scheduler_test.go:26-43) hardened into a design rule.
+
+``from_dict``/``to_dict`` round-trip the subset we model and preserve unknown
+fields verbatim in ``extra`` so a real API server's objects survive a
+read-modify-write cycle.
+"""
+
+from __future__ import annotations
+
+import copy
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: str = "0"
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "uid": self.uid,
+            "resourceVersion": self.resource_version,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=str(d.get("resourceVersion", "0")),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+        )
+
+
+@dataclass
+class ResourceRequirements:
+    requests: dict[str, Any] = field(default_factory=dict)
+    limits: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"requests": dict(self.requests), "limits": dict(self.limits)}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ResourceRequirements":
+        d = d or {}
+        return cls(
+            requests=dict(d.get("requests") or {}), limits=dict(d.get("limits") or {})
+        )
+
+
+@dataclass
+class Container:
+    name: str
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "image": self.image,
+            "resources": self.resources.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Container":
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            resources=ResourceRequirements.from_dict(d.get("resources")),
+        )
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "containers": [c.to_dict() for c in self.containers],
+            "nodeName": self.node_name,
+            "schedulerName": self.scheduler_name,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodSpec":
+        d = d or {}
+        return cls(
+            containers=[Container.from_dict(c) for c in d.get("containers") or []],
+            node_name=d.get("nodeName", ""),
+            scheduler_name=d.get("schedulerName", ""),
+        )
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+
+    def to_dict(self) -> dict:
+        return {"phase": self.phase}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PodStatus":
+        return cls(phase=(d or {}).get("phase", "Pending"))
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def is_completed(self) -> bool:
+        """Reference: pkg/scheduler/pod.go:16-25."""
+        return self.status.phase in ("Succeeded", "Failed")
+
+    def to_dict(self) -> dict:
+        d = dict(self.extra)
+        d.update(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": self.metadata.to_dict(),
+                "spec": self.spec.to_dict(),
+                "status": self.status.to_dict(),
+            }
+        )
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Pod":
+        extra = {
+            k: v for k, v in d.items() if k not in ("metadata", "spec", "status")
+        }
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec")),
+            status=PodStatus.from_dict(d.get("status")),
+            extra=extra,
+        )
+
+    def clone(self) -> "Pod":
+        return Pod.from_dict(copy.deepcopy(self.to_dict()))
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, Any] = field(default_factory=dict)
+    allocatable: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "capacity": dict(self.capacity),
+            "allocatable": dict(self.allocatable),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "NodeStatus":
+        d = d or {}
+        return cls(
+            capacity=dict(d.get("capacity") or {}),
+            allocatable=dict(d.get("allocatable") or {}),
+        )
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = dict(self.extra)
+        d.update(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": self.metadata.to_dict(),
+                "status": self.status.to_dict(),
+            }
+        )
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        extra = {k: v for k, v in d.items() if k not in ("metadata", "status")}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            status=NodeStatus.from_dict(d.get("status")),
+            extra=extra,
+        )
+
+    def clone(self) -> "Node":
+        return Node.from_dict(copy.deepcopy(self.to_dict()))
+
+
+@dataclass
+class Binding:
+    """pods/binding subresource payload (reference: scheduler.go:214-222)."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {
+                "name": self.pod_name,
+                "namespace": self.pod_namespace,
+                "uid": self.pod_uid,
+            },
+            "target": {"apiVersion": "v1", "kind": "Node", "name": self.node},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Binding":
+        md = d.get("metadata") or {}
+        return cls(
+            pod_name=md.get("name", ""),
+            pod_namespace=md.get("namespace", "default"),
+            pod_uid=md.get("uid", ""),
+            node=(d.get("target") or {}).get("name", ""),
+        )
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    containers: Optional[list[Container]] = None,
+    annotations: Optional[dict[str, str]] = None,
+    labels: Optional[dict[str, str]] = None,
+    uid: str = "",
+) -> Pod:
+    """Test/bench convenience constructor."""
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=uid or new_uid(),
+            annotations=dict(annotations or {}),
+            labels=dict(labels or {}),
+        ),
+        spec=PodSpec(containers=containers or []),
+    )
+
+
+def make_tpu_node(
+    name: str,
+    chips: int,
+    hbm_gib: int,
+    accelerator: str = "v5e",
+    slice_topology: str = "",
+    host_topology: str = "",
+    host_offset: str = "",
+    slice_name: str = "",
+) -> Node:
+    """Build a TPU node the way GKE would label it (see utils/consts.py)."""
+    from ..utils import consts
+
+    labels = {consts.LABEL_TPU_ACCELERATOR: accelerator}
+    if slice_topology:
+        labels[consts.LABEL_TPU_TOPOLOGY] = slice_topology
+    if host_topology:
+        labels[consts.LABEL_TPU_HOST_TOPOLOGY] = host_topology
+    if host_offset:
+        labels[consts.LABEL_TPU_HOST_OFFSET] = host_offset
+    if slice_name:
+        labels[consts.LABEL_TPU_SLICE] = slice_name
+    res = {
+        consts.RESOURCE_TPU_CORE: chips * consts.CORE_PER_CHIP,
+        consts.RESOURCE_TPU_HBM: hbm_gib,
+    }
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", uid=new_uid(), labels=labels),
+        status=NodeStatus(capacity=dict(res), allocatable=dict(res)),
+    )

@@ -1,0 +1,1 @@
+"""metrics subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,1 @@
+"""models subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,1 @@
+"""ops subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,1 @@
+"""parallel subpackage of elastic_gpu_scheduler_tpu."""

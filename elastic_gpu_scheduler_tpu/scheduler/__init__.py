@@ -1,0 +1,1 @@
+"""scheduler subpackage of elastic_gpu_scheduler_tpu."""

@@ -1,0 +1,1 @@
+"""server subpackage of elastic_gpu_scheduler_tpu."""

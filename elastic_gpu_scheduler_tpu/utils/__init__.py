@@ -1,0 +1,1 @@
+"""utils subpackage of elastic_gpu_scheduler_tpu."""

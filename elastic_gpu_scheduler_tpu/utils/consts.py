@@ -1,0 +1,49 @@
+"""Protocol constants: resource names, annotation/label keys, policies.
+
+TPU retarget of the reference's protocol strings (reference:
+pkg/utils/types.go:3-17).  The ``elasticgpu.io`` prefix is kept so existing
+tooling conventions carry over; the resources become TPU-shaped.
+"""
+
+# Extended resource names (pod spec `resources.limits` / node `allocatable`).
+RESOURCE_TPU_CORE = "elasticgpu.io/tpu-chip"  # 100 units = 1 physical chip
+RESOURCE_TPU_HBM = "elasticgpu.io/tpu-hbm"  # GiB
+# Unimplemented-in-reference analogues kept for request recognition parity
+# (reference recognizes qgpu/pgpu names it never schedules, pkg/scheduler/pod.go:27-34).
+RESOURCE_TPU_CORE_ALIASES = (RESOURCE_TPU_CORE, "elasticgpu.io/tpu-core")
+RESOURCE_TPU_HBM_ALIASES = (RESOURCE_TPU_HBM, "elasticgpu.io/tpu-memory")
+
+CORE_PER_CHIP = 100
+
+# Annotation / label keys — the durable allocation ledger lives on the pod
+# (reference: pkg/utils/types.go:8-10, pkg/scheduler/pod.go:57-78).
+ANNOTATION_ASSUMED = "elasticgpu.io/assumed"  # "true" once scheduled (label too)
+ANNOTATION_CONTAINER_PREFIX = "elasticgpu.io/container-"  # + name → "x.y.z,x.y.z"
+ANNOTATION_NODE = "elasticgpu.io/node"  # node the allocation belongs to
+ANNOTATION_TOPOLOGY = "elasticgpu.io/allocated-topology"  # box shape, e.g. "2x2"
+
+# Gang scheduling (net-new vs reference).
+ANNOTATION_GANG_NAME = "elasticgpu.io/gang-name"
+ANNOTATION_GANG_SIZE = "elasticgpu.io/gang-size"  # min members for all-or-nothing
+
+# Node labels describing TPU topology (mirrors GKE's
+# cloud.google.com/gke-tpu-topology convention).
+LABEL_TPU_ACCELERATOR = "elasticgpu.io/tpu-accelerator"  # v4|v5e|v5p|v6e
+LABEL_TPU_TOPOLOGY = "elasticgpu.io/tpu-topology"  # slice topology "4x4x8"
+LABEL_TPU_SLICE = "elasticgpu.io/tpu-slice"  # slice id this host belongs to
+LABEL_TPU_HOST_TOPOLOGY = "elasticgpu.io/tpu-host-topology"  # host-local box "2x2x1"
+LABEL_TPU_HOST_OFFSET = "elasticgpu.io/tpu-host-offset"  # host origin in slice "0.0.4"
+
+# Placement policies.
+PRIORITY_BINPACK = "binpack"
+PRIORITY_SPREAD = "spread"
+PRIORITY_RANDOM = "random"
+PRIORITY_ICI = "ici-locality"
+
+# The apiserver optimistic-concurrency conflict is matched *structurally*
+# (HTTP 409 / reason Conflict), not by error-string compare as the reference
+# does (reference: pkg/utils/types.go:15, pkg/scheduler/scheduler.go:201).
+CONFLICT_REASON = "Conflict"
+
+SCORE_MIN = 0
+SCORE_MAX = 10  # extender priority range; raters normalize into it

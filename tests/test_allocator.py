@@ -1,0 +1,384 @@
+"""Unit + property tests for the allocation core.
+
+Properties (SURVEY §4.1): the allocator never over-commits, whole-chip
+requests land only on fully-free chips, and allocations round-trip through
+the annotation codec.
+"""
+
+import random
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.core.allocator import ChipSet
+from elastic_gpu_scheduler_tpu.core.annotations import (
+    annotations_for_option,
+    option_from_pod,
+)
+from elastic_gpu_scheduler_tpu.core.chip import Chip
+from elastic_gpu_scheduler_tpu.core.node import NodeAllocator, chips_from_node
+from elastic_gpu_scheduler_tpu.core.rater import Binpack, ICILocality, Spread, get_rater
+from elastic_gpu_scheduler_tpu.core.request import (
+    NOT_NEEDED,
+    TPURequest,
+    TPUUnit,
+    request_from_pod,
+    unit_from_resources,
+)
+from elastic_gpu_scheduler_tpu.core.topology import Topology, is_contiguous
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def chipset(dims=(2, 2), hbm=16, wrap=()):
+    topo = Topology(dims, wrap or (False,) * len(dims))
+    return ChipSet(topo, (Chip(coord=c, hbm_total=hbm) for c in topo.coords()))
+
+
+def req(units, uid="pod-1", key="default/p1"):
+    return TPURequest(
+        pod_uid=uid,
+        pod_key=key,
+        units=tuple(units),
+        container_names=tuple(f"c{i}" for i in range(len(units))),
+    )
+
+
+# -- request parsing ---------------------------------------------------------
+
+
+def test_unit_parsing():
+    assert unit_from_resources({}) == TPUUnit(core=NOT_NEEDED, hbm=0, chip_count=0)
+    assert unit_from_resources({consts.RESOURCE_TPU_CORE: 50}) == TPUUnit(
+        core=50, hbm=0, chip_count=0
+    )
+    assert unit_from_resources(
+        {consts.RESOURCE_TPU_CORE: 200, consts.RESOURCE_TPU_HBM: 8}
+    ) == TPUUnit(core=0, hbm=8, chip_count=2)
+    assert unit_from_resources({consts.RESOURCE_TPU_HBM: 4}) == TPUUnit(
+        core=0, hbm=4, chip_count=0
+    )
+    with pytest.raises(ValueError):
+        unit_from_resources({consts.RESOURCE_TPU_CORE: 150})
+
+
+def test_request_hash_is_pod_unique():
+    # the reference's shape-only hash collides across pods (allocate.go:30-33)
+    a = req([TPUUnit(core=50)], uid="uid-a")
+    b = req([TPUUnit(core=50)], uid="uid-b")
+    assert a.hash() != b.hash()
+    assert a.hash() == req([TPUUnit(core=50)], uid="uid-a").hash()
+
+
+# -- placement search --------------------------------------------------------
+
+
+def test_fractional_fits_and_commits():
+    cs = chipset((2, 2))
+    r = req([TPUUnit(core=50, hbm=8)])
+    opt = cs.trade(r, Binpack())
+    assert opt is not None
+    cs.transact(opt)
+    assert cs.avail_core() == 4 * 100 - 50
+    assert cs.avail_hbm() == 4 * 16 - 8
+    cs.cancel(opt)
+    assert cs.avail_core() == 400 and cs.avail_hbm() == 64
+
+
+def test_whole_chip_needs_free_chips():
+    cs = chipset((2, 2))
+    # dirty one chip fractionally
+    frac = cs.trade(req([TPUUnit(core=10)], uid="f"), Binpack())
+    cs.transact(frac)
+    dirty = frac.allocs[0].coords[0]
+    opt = cs.trade(req([TPUUnit(chip_count=4)], uid="w"), Binpack())
+    assert opt is None  # only 3 fully-free chips remain
+    opt3 = cs.trade(req([TPUUnit(chip_count=3)], uid="w3"), Binpack())
+    assert opt3 is not None
+    assert dirty not in opt3.allocs[0].coords
+
+
+def test_whole_chip_prefers_contiguous_box():
+    cs = chipset((4, 4))
+    opt = cs.trade(req([TPUUnit(chip_count=4)]), ICILocality())
+    assert opt is not None
+    a = opt.allocs[0]
+    assert a.whole and a.contiguous
+    assert is_contiguous(a.coords, cs.topo)
+    # compact-first: 4 chips should land as a 2x2, not a 1x4 line
+    from elastic_gpu_scheduler_tpu.core.topology import bounding_box
+
+    assert bounding_box(a.coords) == (2, 2)
+
+
+def test_noncontiguous_fallback():
+    cs = chipset((1, 4))
+    # occupy chips 1 and 2, leaving 0 and 3 (no contiguous pair)
+    for coord in [(0, 1), (0, 2)]:
+        cs.chips[coord].take_whole()
+    opt = cs.trade(req([TPUUnit(chip_count=2)]), ICILocality())
+    assert opt is not None
+    a = opt.allocs[0]
+    assert set(a.coords) == {(0, 0), (0, 3)}
+    assert not a.contiguous
+
+
+def test_multi_container_dfs():
+    cs = chipset((2, 2))
+    r = req([TPUUnit(chip_count=2), TPUUnit(core=30, hbm=2), TPUUnit(core=NOT_NEEDED)])
+    opt = cs.trade(r, Binpack())
+    assert opt is not None
+    whole, frac, none = opt.allocs
+    assert len(whole.coords) == 2 and whole.whole
+    assert len(frac.coords) == 1 and not frac.whole
+    assert frac.coords[0] not in whole.coords
+    assert none.coords == ()
+
+
+def test_never_overcommits_property():
+    rng = random.Random(42)
+    for trial in range(30):
+        cs = chipset((2, 4), hbm=8)
+        committed = []
+        for i in range(20):
+            kind = rng.random()
+            if kind < 0.3:
+                u = TPUUnit(chip_count=rng.randint(1, 3))
+            else:
+                u = TPUUnit(core=rng.choice([10, 25, 50, 100 - 1]), hbm=rng.randint(0, 4))
+            r = req([u], uid=f"t{trial}-p{i}")
+            opt = cs.trade(r, Binpack())
+            if opt is None:
+                continue
+            cs.transact(opt)
+            committed.append(opt)
+            # invariant: no chip below zero
+            for ch in cs.chips.values():
+                assert 0 <= ch.core_avail <= ch.core_total
+                assert 0 <= ch.hbm_avail <= ch.hbm_total
+        for opt in committed:
+            cs.cancel(opt)
+        assert cs.avail_core() == cs.total_core()
+        assert cs.avail_hbm() == cs.total_hbm()
+
+
+# -- raters ------------------------------------------------------------------
+
+
+def test_binpack_consolidates_fractional():
+    cs = chipset((1, 4))
+    first = cs.trade(req([TPUUnit(core=30)], uid="a"), Binpack())
+    cs.transact(first)
+    used = first.allocs[0].coords[0]
+    second = cs.trade(req([TPUUnit(core=30)], uid="b"), Binpack())
+    assert second.allocs[0].coords[0] == used  # packs onto the same chip
+
+
+def test_spread_balances_fractional():
+    cs = chipset((1, 4))
+    first = cs.trade(req([TPUUnit(core=30)], uid="a"), Spread())
+    cs.transact(first)
+    used = first.allocs[0].coords[0]
+    second = cs.trade(req([TPUUnit(core=30)], uid="b"), Spread())
+    assert second.allocs[0].coords[0] != used  # goes to a fresh chip
+
+
+def test_get_rater():
+    for name in ("binpack", "spread", "random", "ici-locality"):
+        assert get_rater(name).name == name
+    with pytest.raises(ValueError):
+        get_rater("nope")
+
+
+# -- NodeAllocator -----------------------------------------------------------
+
+
+def tpu_pod(name, core=0, hbm=0, uid=""):
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        uid=uid or f"uid-{name}",
+    )
+
+
+def test_chips_from_node_labels():
+    node = make_tpu_node(
+        "host-0", chips=4, hbm_gib=64, accelerator="v5p",
+        slice_topology="4x4x8", host_topology="2x2x1", host_offset="0.2.3",
+    )
+    topo, chips = chips_from_node(node)
+    assert topo.dims == (4, 4, 8)
+    assert topo.wrap == (True, True, True)
+    assert [c.coord for c in chips] == [(0, 2, 3), (0, 3, 3), (1, 2, 3), (1, 3, 3)]
+    assert all(c.hbm_total == 16 for c in chips)
+
+
+def test_chips_from_node_unlabeled():
+    node = make_tpu_node("plain", chips=8, hbm_gib=64)
+    topo, chips = chips_from_node(node)
+    assert topo.dims == (8,)
+    assert len(chips) == 8
+
+
+def test_node_allocator_assume_score_allocate_forget():
+    node = make_tpu_node("n1", chips=4, hbm_gib=64)
+    na = NodeAllocator(node)
+    rater = Binpack()
+    pod = tpu_pod("p1", core=200)
+    r = request_from_pod(pod)
+    opt = na.assume(r, rater)
+    assert opt is not None
+    assert na.score(r, rater) == opt.score  # cached, no recompute crash
+    committed = na.allocate(r, rater)
+    assert committed is opt
+    assert na.chips.avail_core() == 200
+    # allocate consumed the cache
+    assert r.hash() not in na.allocated
+    na.forget(committed)
+    assert na.chips.avail_core() == 400
+
+
+def test_node_allocator_score_miss_no_crash():
+    # the reference nil-derefs on score-after-cache-miss (node.go:78-84)
+    node = make_tpu_node("n1", chips=4, hbm_gib=64)
+    na = NodeAllocator(node)
+    r = request_from_pod(tpu_pod("p1", core=50))
+    assert na.score(r, Binpack()) is not None
+
+
+def test_allocate_without_assume_still_works():
+    node = make_tpu_node("n1", chips=4, hbm_gib=64)
+    na = NodeAllocator(node)
+    r = request_from_pod(tpu_pod("p1", core=50, hbm=4))
+    opt = na.allocate(r, Binpack())
+    assert opt is not None and na.chips.avail_core() == 350
+
+
+# -- regression: review findings ---------------------------------------------
+
+
+def test_transact_is_atomic_no_partial_leak():
+    # a stale option whose second chip is taken must not leak the first
+    cs = chipset((1, 4))
+    stale = cs.trade(req([TPUUnit(chip_count=2)], uid="stale"), Binpack())
+    cs.chips[stale.allocs[0].coords[1]].take_whole()  # someone else took chip 2
+    with pytest.raises(ValueError):
+        cs.transact(stale)
+    first = stale.allocs[0].coords[0]
+    assert cs.chips[first].is_free  # no partial application
+
+
+def test_allocate_retrades_stale_cached_option():
+    # two pods assume the same chips; the second must re-trade, not crash
+    node = make_tpu_node("n", chips=4, hbm_gib=64)
+    na = NodeAllocator(node)
+    r1 = request_from_pod(tpu_pod("p1", core=300, uid="u1"))
+    r2 = request_from_pod(tpu_pod("p2", core=100, uid="u2"))
+    rater = Binpack()
+    assert na.assume(r1, rater) is not None
+    assert na.assume(r2, rater) is not None  # overlaps r1's chips
+    na.allocate(r1, rater)
+    opt2 = na.allocate(r2, rater)  # stale cache → re-trade succeeds
+    assert opt2.allocs[0].coords[0] not in {
+        c for a in na.allocated.values() for c in a.allocs[0].coords
+    }
+    assert na.chips.avail_core() == 0
+
+
+def test_allocate_stale_and_full_raises_cleanly():
+    node = make_tpu_node("n", chips=2, hbm_gib=32)
+    na = NodeAllocator(node)
+    rater = Binpack()
+    r1 = request_from_pod(tpu_pod("p1", core=200, uid="u1"))
+    r2 = request_from_pod(tpu_pod("p2", core=200, uid="u2"))
+    na.assume(r1, rater)
+    na.assume(r2, rater)
+    na.allocate(r1, rater)
+    with pytest.raises(RuntimeError, match="cannot find option"):
+        na.allocate(r2, rater)
+
+
+def test_refresh_applies_hbm_resize():
+    node = make_tpu_node("n", chips=4, hbm_gib=64)
+    na = NodeAllocator(node)
+    r = request_from_pod(tpu_pod("p", core=50, hbm=4))
+    na.allocate(r, Binpack())
+    bigger = make_tpu_node("n", chips=4, hbm_gib=128)
+    na.refresh_from_node(bigger)
+    # totals grew to 32/chip, live usage (4 GiB on one chip) preserved
+    assert na.chips.total_hbm() == 128
+    assert na.chips.avail_hbm() == 124
+    assert na.chips.avail_core() == 350
+
+
+def test_mislabeled_host_offset_raises():
+    # host offset near the end of the slice would run past the mesh
+    node = make_tpu_node(
+        "bad", chips=4, hbm_gib=64, slice_topology="4x4", host_offset="3.2"
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        NodeAllocator(node)
+
+
+# -- annotation codec --------------------------------------------------------
+
+
+def test_annotation_roundtrip():
+    node = make_tpu_node(
+        "host-0", chips=8, hbm_gib=128, accelerator="v5e",
+        slice_topology="4x4", host_topology="2x4", host_offset="0.0",
+    )
+    na = NodeAllocator(node)
+    pod = make_pod(
+        "p1",
+        containers=[
+            Container(
+                name="trainer",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: 400}
+                ),
+            ),
+            Container(
+                name="sidecar",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: 30, consts.RESOURCE_TPU_HBM: 2}
+                ),
+            ),
+        ],
+    )
+    r = request_from_pod(pod)
+    opt = na.allocate(r, ICILocality())
+    ann = annotations_for_option(opt, "host-0")
+    assert ann[consts.ANNOTATION_ASSUMED] == "true"
+    assert ann[consts.ANNOTATION_NODE] == "host-0"
+    pod.metadata.annotations.update(ann)
+
+    recovered = option_from_pod(pod, na.chips.topo)
+    assert recovered is not None
+    assert recovered.coords_by_container() == opt.coords_by_container()
+    for orig, rec in zip(opt.allocs, recovered.allocs):
+        assert orig.whole == rec.whole
+        assert orig.core == rec.core and orig.hbm == rec.hbm
+
+    # recovered option re-commits identically on a fresh allocator
+    na2 = NodeAllocator(node.clone())
+    na2.add(recovered)
+    assert na2.chips.avail_core() == na.chips.avail_core()
+    assert na2.chips.avail_hbm() == na.chips.avail_hbm()
+
+
+def test_option_from_pod_without_annotations():
+    pod = tpu_pod("p", core=50)
+    topo = Topology((4,))
+    assert option_from_pod(pod, topo) is None

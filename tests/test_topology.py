@@ -1,0 +1,88 @@
+"""Unit tests for the ICI mesh topology model."""
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.core.topology import (
+    Topology,
+    bounding_box,
+    default_wrap,
+    format_coord,
+    is_contiguous,
+    parse_coord,
+    parse_topology,
+)
+
+
+def test_parse_format_roundtrip():
+    assert parse_topology("4x4x8") == (4, 4, 8)
+    assert parse_topology("16") == (16,)
+    assert parse_coord(format_coord((1, 2, 3))) == (1, 2, 3)
+    with pytest.raises(ValueError):
+        parse_topology("4xx")
+    with pytest.raises(ValueError):
+        parse_topology("0x4")
+
+
+def test_index_coord_roundtrip():
+    t = Topology((3, 4, 5))
+    for i in range(t.num_chips):
+        assert t.index(t.coord_of(i)) == i
+
+
+def test_default_wrap():
+    # v5p axes wrap when length is a multiple of 4
+    assert default_wrap("v5p", (4, 4, 8)) == (True, True, True)
+    assert default_wrap("v5p", (2, 2, 4)) == (False, False, True)
+    # v5e is a plain mesh
+    assert default_wrap("v5e", (4, 4)) == (False, False)
+
+
+def test_neighbors_mesh_vs_torus():
+    mesh = Topology((4, 4))
+    corner = (0, 0)
+    assert set(mesh.neighbors(corner)) == {(1, 0), (0, 1)}
+    torus = Topology((4, 4), (True, True))
+    assert set(torus.neighbors(corner)) == {(1, 0), (0, 1), (3, 0), (0, 3)}
+
+
+def test_placements_mesh():
+    t = Topology((4, 4))
+    boxes = list(t.placements((2, 2)))
+    assert len(boxes) == 9  # 3x3 origins
+    for box in boxes:
+        assert len(box) == 4
+        assert is_contiguous(box, t)
+
+
+def test_placements_torus_wraps():
+    t = Topology((4, 4), (True, True))
+    boxes = list(t.placements((2, 2)))
+    assert len(boxes) == 16  # all origins valid on a torus
+    wrapped = [b for b in boxes if (3, 3) in b and (0, 0) in b]
+    assert wrapped, "expected a wraparound placement containing both corners"
+    for box in boxes:
+        assert is_contiguous(box, t)
+
+
+def test_box_shapes_compact_first():
+    t = Topology((4, 4, 8))
+    shapes = t.box_shapes(8)
+    assert shapes[0] == (2, 2, 2)  # cube before slabs/lines
+    assert all(
+        a * b * c == 8 and a <= 4 and b <= 4 and c <= 8 for a, b, c in shapes
+    )
+    # 16 chips in a 4x4x8: 4x4x1 or 2x2x4 style boxes exist
+    assert (2, 2, 4) in t.box_shapes(16)
+
+
+def test_box_shapes_impossible():
+    t = Topology((2, 2))
+    assert t.box_shapes(5) == []  # 5 doesn't fit as a box in 2x2
+    assert t.box_shapes(4) == [(2, 2)]
+
+
+def test_bounding_box_and_contiguity():
+    t = Topology((4, 4))
+    assert bounding_box([(0, 0), (1, 1)]) == (2, 2)
+    assert is_contiguous([(0, 0), (0, 1), (1, 1)], t)
+    assert not is_contiguous([(0, 0), (2, 2)], t)
