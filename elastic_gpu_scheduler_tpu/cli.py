@@ -1,0 +1,165 @@
+"""Entry point / CLI.
+
+Reference: cmd/main.go — flags ``-priority binpack|spread``, ``-mode`` comma
+list, ``-kubeconf``; env ``PORT`` (default 39999) and ``THREADNESS`` (default
+1) (main.go:26-30, 68-72, 103-110).  Additions: ``--priority ici-locality``
+and ``--fake-nodes`` to run self-contained against an in-memory cluster (for
+demos/benchmarks without an API server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from .controller.controller import Controller
+from .core.rater import get_rater
+from .k8s.client import FakeClientset, RestClientset
+from .k8s.fake import FakeCluster
+from .k8s.objects import make_tpu_node
+from .scheduler.registry import build_resource_schedulers
+from .scheduler.gang import GangCoordinator
+from .scheduler.scheduler import SchedulerConfig
+from .server.handlers import Bind, Predicate, Prioritize
+from .server.routes import ExtenderServer
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def build_stack(
+    clientset,
+    cluster=None,
+    priority: str = "binpack",
+    modes: tuple[str, ...] = ("tpushare",),
+    workers: int = 1,
+    gang_timeout: float = 30.0,
+):
+    """Wire registry + handlers + controller (reference: main.go:56-96)."""
+    rater = get_rater(priority)
+    config = SchedulerConfig(clientset=clientset, rater=rater)
+    registry = build_resource_schedulers(list(modes), config)
+    gang = GangCoordinator(clientset, timeout=gang_timeout)
+    predicate = Predicate(registry, gang=gang)
+    prioritize = Prioritize(registry)
+    bind = Bind(registry, clientset, gang=gang)
+    controller = None
+    if cluster is not None:
+        controller = Controller(cluster, registry, workers=workers)
+
+    def status():
+        seen = []
+        out = []
+        for sched in registry.values():
+            if id(sched) in seen:
+                continue
+            seen.append(id(sched))
+            out.append(sched.status())
+        return {"schedulers": out, "gangs": gang.status()}
+
+    return registry, predicate, prioritize, bind, controller, status, gang
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-elastic-scheduler")
+    p.add_argument(
+        "--priority",
+        default="binpack",
+        help="placement policy: binpack|spread|random|ici-locality",
+    )
+    p.add_argument(
+        "--mode", default="tpushare", help="comma-separated scheduler modes"
+    )
+    p.add_argument("--port", type=int, default=_env_int("PORT", 39999))
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument(
+        "--kube-api", default="", help="API server URL (out-of-cluster REST mode)"
+    )
+    p.add_argument("--kube-token", default="")
+    p.add_argument(
+        "--fake-nodes",
+        type=int,
+        default=0,
+        help="run self-contained with N fake 4-chip v5e TPU nodes",
+    )
+    p.add_argument(
+        "--threadness", type=int, default=_env_int("THREADNESS", 1),
+        help="controller worker threads",
+    )
+    p.add_argument("--gang-timeout", type=float, default=30.0)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    cluster = None
+    if args.fake_nodes > 0:
+        cluster = FakeCluster()
+        for i in range(args.fake_nodes):
+            cluster.add_node(
+                make_tpu_node(
+                    f"tpu-node-{i}", chips=4, hbm_gib=64, accelerator="v5e"
+                )
+            )
+        clientset = FakeClientset(cluster)
+    elif args.kube_api or os.environ.get("KUBERNETES_SERVICE_HOST"):
+        clientset = RestClientset(base_url=args.kube_api, token=args.kube_token)
+    else:
+        print(
+            "error: no cluster — use --fake-nodes N, --kube-api URL, or run "
+            "in-cluster",
+            file=sys.stderr,
+        )
+        return 2
+
+    _, predicate, prioritize, bind, controller, status, _ = build_stack(
+        clientset,
+        cluster=cluster,
+        priority=args.priority,
+        modes=tuple(m for m in args.mode.split(",") if m),
+        workers=args.threadness,
+        gang_timeout=args.gang_timeout,
+    )
+    if controller is not None:
+        controller.start()
+
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host=args.host, port=args.port
+    )
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        # second signal → hard exit (reference: signals/signal.go:16-30)
+        if stop.is_set():
+            os._exit(1)
+        stop.set()
+        server.stop()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    port = server.start()
+    print(f"tpu-elastic-scheduler serving on {args.host}:{port}")
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        if controller is not None:
+            controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

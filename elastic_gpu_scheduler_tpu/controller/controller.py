@@ -1,0 +1,219 @@
+"""Reconciliation controller: watch events → workqueue → sync.
+
+Reference: pkg/controller/controller.go.  Same shape, Python-threaded:
+
+- a pod watch (FakeCluster queue or API-server watch) filtered to TPU pods
+  (FilteringResourceEventHandler analogue, controller.go:69-91);
+- a deduplicating, rate-limited workqueue (controller.go:64) drained by N
+  worker threads (THREADNESS analogue);
+- ``sync_pod``: completed/deleted pod → ``forget_pod`` (frees chips);
+  running pod with a node → ``add_pod`` (learns allocations made by other
+  replicas or before a restart) (controller.go:154-185, 301-331);
+- a periodic full resync as the safety net for missed events
+  (controller.go:24-25: 30s informer resync).
+
+Fixed vs reference (SURVEY §5): workers loop until stopped instead of
+exiting after each item and relying on a 1s restart (controller.go:197-203).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..k8s.fake import FakeCluster, is_not_found
+from ..k8s.objects import Pod
+from ..scheduler.registry import get_resource_scheduler, is_tpu_pod
+from ..scheduler.scheduler import ResourceScheduler
+from ..core.annotations import assigned_node, is_assumed
+
+log = logging.getLogger("tpu-scheduler")
+
+
+class WorkQueue:
+    """Deduplicating rate-limited queue keyed by pod key."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0):
+        self._q: queue.Queue = queue.Queue()
+        self._pending: set[str] = set()
+        self._failures: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        self._q.put(key)
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0) + 1
+            self._failures[key] = n
+        delay = min(self.max_delay, self.base_delay * (2 ** min(n, 10)))
+        t = threading.Timer(delay, self.add, args=(key,))
+        t.daemon = True
+        t.start()
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def get(self, timeout: float = 0.2) -> Optional[str]:
+        try:
+            key = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        with self._lock:
+            self._pending.discard(key)
+        return key
+
+
+class Controller:
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        registry: dict[str, ResourceScheduler],
+        resync_period: float = 30.0,
+        workers: int = 1,
+    ):
+        self.cluster = cluster
+        self.registry = registry
+        self.resync_period = resync_period
+        self.workers = max(1, workers)
+        self.wq = WorkQueue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._watch_q: Optional[queue.Queue] = None
+        # pods seen by the watch, so sync can distinguish deleted pods
+        self._last_seen: dict[str, Pod] = {}
+        self._seen_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch_q = self.cluster.watch_pods()
+        t = threading.Thread(target=self._watch_loop, name="ctl-watch", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._resync_loop, name="ctl-resync", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"ctl-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        # initial resync so pre-existing pods are learned
+        self._enqueue_all()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_q is not None:
+            self.cluster.stop_watch(self._watch_q)
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Test helper: wait until the queue drains."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.wq._lock:
+                empty = not self.wq._pending
+            if empty and self.wq._q.empty():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- event plumbing ------------------------------------------------------
+
+    def _admit(self, pod: Pod) -> bool:
+        """Only TPU pods enter the queue (reference: controller.go:69-91)."""
+        return is_tpu_pod(pod)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                event, pod = self._watch_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if not self._admit(pod):
+                continue
+            with self._seen_lock:
+                # keep the DELETED pod's last state too — sync_pod consumes it
+                # to release the allocation once get_pod returns NotFound
+                self._last_seen[pod.key] = pod
+            # Update events only matter on completion transition or
+            # newly-assumed pods (reference: controller.go:242-266); enqueue
+            # unconditionally — sync_pod is idempotent and cheap.
+            self.wq.add(pod.key)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            self._enqueue_all()
+
+    def _enqueue_all(self) -> None:
+        try:
+            for pod in self.cluster.list_pods():
+                if self._admit(pod):
+                    with self._seen_lock:
+                        self._last_seen[pod.key] = pod
+                    self.wq.add(pod.key)
+        except Exception as e:
+            log.warning("resync list failed: %s", e)
+
+    # -- sync ----------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            key = self.wq.get()
+            if key is None:
+                continue
+            try:
+                self.sync_pod(key)
+                self.wq.forget(key)
+            except Exception as e:
+                log.warning("sync %s failed: %s; requeueing", key, e)
+                self.wq.add_rate_limited(key)
+
+    def sync_pod(self, key: str) -> None:
+        """Reference: syncPod (controller.go:154-185)."""
+        ns, _, name = key.partition("/")
+        try:
+            pod = self.cluster.get_pod(ns, name)
+        except Exception as e:
+            if is_not_found(e):
+                with self._seen_lock:
+                    pod = self._last_seen.pop(key, None)
+                if pod is not None:
+                    self._release(pod)
+                return
+            raise
+        if pod.is_completed():
+            self._release(pod)
+        elif pod.spec.node_name and is_assumed(pod):
+            self._assign(pod)
+
+    def _release(self, pod: Pod) -> None:
+        """Reference: releasePod bridge (controller.go:301-307)."""
+        sched = get_resource_scheduler(self.registry, pod)
+        if sched is None:
+            return
+        if sched.released_pod(pod):
+            return
+        sched.forget_pod(pod)
+
+    def _assign(self, pod: Pod) -> None:
+        """Reference: assignPod bridge (controller.go:325-331)."""
+        sched = get_resource_scheduler(self.registry, pod)
+        if sched is None:
+            return
+        if sched.known_pod(pod):
+            return
+        sched.add_pod(pod)

@@ -1,0 +1,179 @@
+"""Clientset abstraction: fake (in-memory) and REST (real API server).
+
+The reference builds a client-go clientset from in-cluster config or a
+kubeconfig path (reference: pkg/utils/utils.go:44-68).  Here the scheduler
+core is written against the small ``Clientset`` protocol below; tests and
+benchmarks inject ``FakeClientset`` and a real deployment uses
+``RestClientset`` (stdlib urllib against the API server, bearer-token auth —
+no external kubernetes package in this environment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+from .fake import ApiError, FakeCluster
+from .objects import Binding, Node, Pod
+
+
+class Clientset:
+    """The API surface the scheduler needs (reference usage:
+    scheduler.go:66,70,200,214; controller.go:55)."""
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        raise NotImplementedError
+
+    def list_pods(
+        self,
+        label_selector: Optional[dict[str, str]] = None,
+        field_selector: Optional[Callable[[Pod], bool]] = None,
+    ) -> list[Pod]:
+        raise NotImplementedError
+
+    def update_pod(self, pod: Pod) -> Pod:
+        raise NotImplementedError
+
+    def bind(self, binding: Binding) -> None:
+        raise NotImplementedError
+
+    def get_node(self, name: str) -> Node:
+        raise NotImplementedError
+
+    def list_nodes(self) -> list[Node]:
+        raise NotImplementedError
+
+
+class FakeClientset(Clientset):
+    def __init__(self, cluster: FakeCluster):
+        self.cluster = cluster
+
+    def get_pod(self, namespace, name):
+        return self.cluster.get_pod(namespace, name)
+
+    def list_pods(self, label_selector=None, field_selector=None):
+        return self.cluster.list_pods(label_selector, field_selector)
+
+    def update_pod(self, pod):
+        return self.cluster.update_pod(pod)
+
+    def bind(self, binding):
+        return self.cluster.bind(binding)
+
+    def get_node(self, name):
+        return self.cluster.get_node(name)
+
+    def list_nodes(self):
+        return self.cluster.list_nodes()
+
+
+class RestClientset(Clientset):
+    """Minimal REST client for a real API server.
+
+    In-cluster config discovery mirrors client-go: the service-account token
+    and CA at /var/run/secrets/kubernetes.io/serviceaccount, API host from
+    KUBERNETES_SERVICE_HOST/PORT (reference: utils.go:46-56 uses
+    rest.InClusterConfig).  Out-of-cluster, pass ``base_url`` + ``token``.
+    """
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(
+        self,
+        base_url: str = "",
+        token: str = "",
+        ca_file: str = "",
+        insecure: bool = False,
+    ):
+        if not base_url:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster and no base_url given for RestClientset"
+                )
+            base_url = f"https://{host}:{port}"
+            token_path = os.path.join(self.SA_DIR, "token")
+            if not token and os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+            ca = os.path.join(self.SA_DIR, "ca.crt")
+            if not ca_file and os.path.exists(ca):
+                ca_file = ca
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        if insecure:
+            self.ctx = ssl._create_unverified_context()
+        elif ca_file:
+            self.ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self.ctx = ssl.create_default_context()
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            ctx = self.ctx if url.startswith("https") else None
+            with urllib.request.urlopen(req, context=ctx, timeout=30) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            try:
+                status = json.loads(e.read())
+                reason = status.get("reason", "Unknown")
+                msg = status.get("message", str(e))
+            except Exception:
+                reason, msg = "Unknown", str(e)
+            raise ApiError(reason, msg, e.code) from None
+
+    def get_pod(self, namespace, name):
+        return Pod.from_dict(
+            self._req("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+        )
+
+    def list_pods(self, label_selector=None, field_selector=None):
+        path = "/api/v1/pods"
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += "?labelSelector=" + urllib.parse.quote(sel)
+        items = self._req("GET", path).get("items", [])
+        pods = [Pod.from_dict(i) for i in items]
+        if field_selector:
+            pods = [p for p in pods if field_selector(p)]
+        return pods
+
+    def update_pod(self, pod):
+        return Pod.from_dict(
+            self._req(
+                "PUT",
+                f"/api/v1/namespaces/{pod.metadata.namespace}/pods/"
+                f"{pod.metadata.name}",
+                pod.to_dict(),
+            )
+        )
+
+    def bind(self, binding):
+        self._req(
+            "POST",
+            f"/api/v1/namespaces/{binding.pod_namespace}/pods/"
+            f"{binding.pod_name}/binding",
+            binding.to_dict(),
+        )
+
+    def get_node(self, name):
+        return Node.from_dict(self._req("GET", f"/api/v1/nodes/{name}"))
+
+    def list_nodes(self):
+        items = self._req("GET", "/api/v1/nodes").get("items", [])
+        return [Node.from_dict(i) for i in items]

@@ -1,0 +1,103 @@
+"""kube-scheduler extender v1 wire types.
+
+JSON shapes of ``k8s.io/kube-scheduler/extender/v1`` — the protocol the stock
+kube-scheduler speaks to an extender webhook (reference: pkg/routes/routes.go
+(de)serializes these at 46-49, 94-99, 126-129; schema documented in the
+reference README.md:47-89).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .objects import Pod
+
+
+@dataclass
+class ExtenderArgs:
+    """filter / priorities request body."""
+
+    pod: Pod
+    node_names: Optional[list[str]] = None  # requires nodeCacheCapable=true
+
+    def to_dict(self) -> dict:
+        return {"Pod": self.pod.to_dict(), "NodeNames": self.node_names}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderArgs":
+        pod_d = d.get("Pod") or d.get("pod") or {}
+        names = d.get("NodeNames", d.get("nodeNames"))
+        return cls(pod=Pod.from_dict(pod_d), node_names=names)
+
+
+@dataclass
+class ExtenderFilterResult:
+    node_names: Optional[list[str]] = None
+    failed_nodes: dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "NodeNames": self.node_names,
+            "FailedNodes": dict(self.failed_nodes),
+            "Error": self.error,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderFilterResult":
+        return cls(
+            node_names=d.get("NodeNames"),
+            failed_nodes=dict(d.get("FailedNodes") or {}),
+            error=d.get("Error", ""),
+        )
+
+
+@dataclass
+class HostPriority:
+    host: str
+    score: int
+
+    def to_dict(self) -> dict:
+        return {"Host": self.host, "Score": self.score}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HostPriority":
+        return cls(host=d.get("Host", ""), score=int(d.get("Score", 0)))
+
+
+@dataclass
+class ExtenderBindingArgs:
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    def to_dict(self) -> dict:
+        return {
+            "PodName": self.pod_name,
+            "PodNamespace": self.pod_namespace,
+            "PodUID": self.pod_uid,
+            "Node": self.node,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderBindingArgs":
+        return cls(
+            pod_name=d.get("PodName", ""),
+            pod_namespace=d.get("PodNamespace", "default"),
+            pod_uid=d.get("PodUID", ""),
+            node=d.get("Node", ""),
+        )
+
+
+@dataclass
+class ExtenderBindingResult:
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {"Error": self.error}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderBindingResult":
+        return cls(error=d.get("Error", ""))

@@ -1,0 +1,186 @@
+"""In-memory fake Kubernetes cluster (pods, nodes, bindings, watch).
+
+Stands in for the API server in tests and benchmarks — the "kind cluster with
+fake TPU nodes" pattern from BASELINE config 1 without needing kind: TPU nodes
+are fake in the reference's benchmarks too, since capacity is just
+``status.allocatable`` numbers (reference: pkg/scheduler/node.go:24-26).
+
+Semantics modeled after the real API server where the scheduler depends on
+them:
+
+- ``resourceVersion`` bumps on every write; ``update_pod`` with a stale
+  version fails with a Conflict — the optimistic-lock path the reference
+  retries on (reference: pkg/scheduler/scheduler.go:199-213).
+- ``bind`` sets ``spec.nodeName`` via the pods/binding subresource.
+- watches deliver ADDED/MODIFIED/DELETED events to subscriber queues.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+from .objects import Binding, Node, Pod
+
+
+class ApiError(Exception):
+    def __init__(self, reason: str, message: str, code: int = 500):
+        super().__init__(f"{reason}: {message}")
+        self.reason = reason
+        self.code = code
+        self.message = message
+
+
+def conflict(msg: str) -> ApiError:
+    return ApiError("Conflict", msg, 409)
+
+
+def not_found(msg: str) -> ApiError:
+    return ApiError("NotFound", msg, 404)
+
+
+def is_conflict(e: Exception) -> bool:
+    return isinstance(e, ApiError) and e.reason == "Conflict"
+
+
+def is_not_found(e: Exception) -> bool:
+    return isinstance(e, ApiError) and e.reason == "NotFound"
+
+
+class FakeCluster:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods: dict[str, Pod] = {}  # ns/name → Pod
+        self._nodes: dict[str, Node] = {}
+        self._rv = 0
+        self._watchers: list[queue.Queue] = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, event: str, pod: Pod) -> None:
+        for q in list(self._watchers):
+            q.put((event, pod.clone()))
+
+    # -- nodes ---------------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            node.metadata.resource_version = self._next_rv()
+            self._nodes[node.metadata.name] = node.clone()
+
+    def get_node(self, name: str) -> Node:
+        with self._lock:
+            n = self._nodes.get(name)
+            if n is None:
+                raise not_found(f"node {name}")
+            return n.clone()
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return [n.clone() for n in self._nodes.values()]
+
+    # -- pods ----------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            key = pod.key
+            if key in self._pods:
+                raise ApiError("AlreadyExists", f"pod {key}", 409)
+            p = pod.clone()
+            p.metadata.resource_version = self._next_rv()
+            self._pods[key] = p
+            self._notify("ADDED", p)
+            return p.clone()
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            p = self._pods.get(f"{namespace}/{name}")
+            if p is None:
+                raise not_found(f"pod {namespace}/{name}")
+            return p.clone()
+
+    def list_pods(
+        self,
+        label_selector: Optional[dict[str, str]] = None,
+        field_selector: Optional[Callable[[Pod], bool]] = None,
+    ) -> list[Pod]:
+        with self._lock:
+            out = []
+            for p in self._pods.values():
+                if label_selector and any(
+                    (p.metadata.labels or {}).get(k) != v
+                    for k, v in label_selector.items()
+                ):
+                    continue
+                if field_selector and not field_selector(p):
+                    continue
+                out.append(p.clone())
+            return out
+
+    def update_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            key = pod.key
+            cur = self._pods.get(key)
+            if cur is None:
+                raise not_found(f"pod {key}")
+            if pod.metadata.resource_version != cur.metadata.resource_version:
+                raise conflict(
+                    f"pod {key}: resourceVersion {pod.metadata.resource_version} "
+                    f"!= {cur.metadata.resource_version}"
+                )
+            p = pod.clone()
+            p.metadata.resource_version = self._next_rv()
+            self._pods[key] = p
+            self._notify("MODIFIED", p)
+            return p.clone()
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            p = self._pods.pop(key, None)
+            if p is None:
+                raise not_found(f"pod {key}")
+            self._notify("DELETED", p)
+
+    def bind(self, binding: Binding) -> None:
+        with self._lock:
+            key = f"{binding.pod_namespace}/{binding.pod_name}"
+            cur = self._pods.get(key)
+            if cur is None:
+                raise not_found(f"pod {key}")
+            if binding.pod_uid and cur.metadata.uid != binding.pod_uid:
+                raise conflict(f"pod {key}: uid mismatch")
+            if cur.spec.node_name and cur.spec.node_name != binding.node:
+                raise conflict(f"pod {key}: already bound to {cur.spec.node_name}")
+            cur.spec.node_name = binding.node
+            cur.metadata.resource_version = self._next_rv()
+            self._notify("MODIFIED", cur)
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            cur = self._pods.get(key)
+            if cur is None:
+                raise not_found(f"pod {key}")
+            cur.status.phase = phase
+            cur.metadata.resource_version = self._next_rv()
+            self._notify("MODIFIED", cur)
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch_pods(self) -> queue.Queue:
+        """Subscribe to pod events; returns the subscriber queue."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers.append(q)
+        return q
+
+    def stop_watch(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)

@@ -1,1 +1,187 @@
-"""metrics subpackage of elastic_gpu_scheduler_tpu."""
+"""Prometheus-style metrics (text exposition, stdlib only).
+
+The reference has NO metrics (SURVEY §5: "No Prometheus metrics"); per-verb
+latency histograms are required here to *prove* the <100ms p99 bind target
+(BASELINE.md).  Exposed at /metrics in the standard text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + value
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            for labels, v in sorted(self._values.items()):
+                yield f"{self.name}{_fmt_labels(self.label_names, labels)} {v}"
+
+
+class Gauge(Counter):
+    def set(self, *labels: str, value: float) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            for labels, v in sorted(self._values.items()):
+                yield f"{self.name}{_fmt_labels(self.label_names, labels)} {v}"
+
+
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+        self._samples: dict[tuple[str, ...], list[float]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, *labels: str, value: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(labels, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+            samples = self._samples.setdefault(labels, [])
+            samples.append(value)
+            if len(samples) > 10000:
+                del samples[: len(samples) // 2]
+
+    def time(self, *labels: str):
+        return _Timer(self, labels)
+
+    def quantile(self, q: float, *labels: str) -> float:
+        """Exact quantile from retained samples (for bench/tests)."""
+        with self._lock:
+            samples = sorted(self._samples.get(labels, []))
+        if not samples:
+            return 0.0
+        idx = min(len(samples) - 1, max(0, int(q * len(samples) + 0.5) - 1))
+        return samples[idx]
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            for labels in sorted(self._counts):
+                counts = self._counts[labels]
+                for b, c in zip(self.buckets, counts):
+                    le = self.label_names + ("le",)
+                    lv = labels + (repr(float(b)),)
+                    yield f"{self.name}_bucket{_fmt_labels(le, lv)} {c}"
+                le = self.label_names + ("le",)
+                lv = labels + ("+Inf",)
+                yield f"{self.name}_bucket{_fmt_labels(le, lv)} {self._totals[labels]}"
+                yield (
+                    f"{self.name}_sum{_fmt_labels(self.label_names, labels)} "
+                    f"{self._sums[labels]}"
+                )
+                yield (
+                    f"{self.name}_count{_fmt_labels(self.label_names, labels)} "
+                    f"{self._totals[labels]}"
+                )
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: tuple[str, ...]):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(*self.labels, value=time.perf_counter() - self.start)
+        return False
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def register(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+VERB_LATENCY = REGISTRY.register(
+    Histogram(
+        "tpu_scheduler_verb_duration_seconds",
+        "Latency of extender verbs (filter/priorities/bind)",
+        ("verb",),
+    )
+)
+VERB_TOTAL = REGISTRY.register(
+    Counter(
+        "tpu_scheduler_verb_total",
+        "Extender verb invocations by result",
+        ("verb", "result"),
+    )
+)
+CHIPS_ALLOCATED = REGISTRY.register(
+    Gauge(
+        "tpu_scheduler_chips_core_allocated",
+        "Allocated core units per node",
+        ("node",),
+    )
+)
+GANG_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_scheduler_gang_events_total",
+        "Gang lifecycle events",
+        ("event",),
+    )
+)

@@ -1,0 +1,294 @@
+"""Gang scheduling: all-or-nothing placement + bind for SPMD replica groups.
+
+Net-new vs the reference (SURVEY §2 #19: the reference has no gang support;
+this is the TPU build's counterpart of data/model-parallel job placement —
+a 256-replica JAX job must land all replicas or none, BASELINE config 5).
+
+Two cooperating mechanisms (SURVEY §7 hard part (b)):
+
+1. **Plan at filter time.**  When the first gang member hits the filter verb,
+   the coordinator *plans the whole gang*: it clones the current chip state of
+   every candidate node (in ICI mesh order — slice, then host offset) and
+   greedily places all N member shapes onto the clones.  If the gang cannot
+   fully fit, every member is rejected — nothing is ever partially admitted.
+   If it fits, the plan yields N node slots, and each arriving member's
+   filter returns exactly its claimed slot.  Mesh-ordered planning makes the
+   gang occupy contiguous hosts, so the slice's ICI links stay inside the
+   job.  (Per-pod scattering — what the reference's per-pod verbs would do —
+   lets N identical pods all chase the same "best" node and livelock; the
+   plan is what makes 256-replica placement deterministic and fast.)
+
+2. **Barrier at bind time.**  Each member's bind verb blocks until all N
+   members' bind calls have arrived; only then does every member commit
+   (allocate + annotation write + Binding POST).  A gang that doesn't fill
+   within ``timeout`` seconds fails every waiter, releases the plan, and
+   leaves nothing bound.  If a commit fails mid-gang, members not yet bound
+   abort; already-bound members keep valid allocations (commit is
+   crash-consistent best-effort — the same consistency the reference's
+   single-pod bind path has, scheduler.go:199-227).
+
+Pods opt in via annotations ``elasticgpu.io/gang-name`` and
+``elasticgpu.io/gang-size``.  Gangs are assumed homogeneous (all members
+request the same shape) — the SPMD case; heterogeneous members still bind,
+but the plan is computed from the first member's shape.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.request import TPURequest, request_from_pod
+from ..k8s.objects import Pod
+from ..metrics import GANG_EVENTS
+from ..utils import consts
+from .scheduler import ResourceScheduler, TPUUnitScheduler
+
+log = logging.getLogger("tpu-scheduler")
+
+
+@dataclass
+class _Plan:
+    """Node slots for each gang member, in placement order."""
+
+    slots: list[str]  # one node name per member, mesh-ordered
+    claims: dict[str, str] = field(default_factory=dict)  # pod key → node
+    created: float = 0.0
+
+    def claim(self, pod_key: str) -> Optional[str]:
+        if pod_key in self.claims:
+            return self.claims[pod_key]
+        if len(self.claims) >= len(self.slots):
+            return None
+        node = self.slots[len(self.claims)]
+        self.claims[pod_key] = node
+        return node
+
+
+@dataclass
+class _Gang:
+    name: str
+    size: int
+    created: float
+    cond: threading.Condition
+    members: dict[str, str] = field(default_factory=dict)  # pod key → node
+    ready: bool = False
+    failed: str = ""
+    done: int = 0
+
+
+class GangCoordinator:
+    def __init__(self, clientset, timeout: float = 30.0):
+        self.clientset = clientset
+        self.timeout = timeout
+        self._gangs: dict[str, _Gang] = {}
+        self._plans: dict[str, _Plan] = {}
+        self._lock = threading.Lock()
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def gang_key(pod: Pod, req: TPURequest) -> str:
+        return f"{pod.metadata.namespace}/{req.gang_name}"
+
+    @staticmethod
+    def is_gang_pod(req: TPURequest) -> bool:
+        return bool(req.gang_name) and req.gang_size > 1
+
+    def _node_mesh_order(self, sched: TPUUnitScheduler, names: list[str]):
+        """Sort candidate nodes in (slice, host-offset row-major) order so
+        greedy planning fills the ICI mesh contiguously."""
+
+        def key(name: str):
+            try:
+                node = self.clientset.get_node(name)
+            except Exception:
+                return ("~", 1 << 30, name)
+            labels = node.metadata.labels or {}
+            slice_id = labels.get(consts.LABEL_TPU_SLICE, "")
+            offset = labels.get(consts.LABEL_TPU_HOST_OFFSET, "")
+            try:
+                from ..core.topology import parse_coord, parse_topology, Topology
+
+                topo_spec = labels.get(consts.LABEL_TPU_TOPOLOGY, "")
+                idx = (
+                    Topology(parse_topology(topo_spec)).index(parse_coord(offset))
+                    if topo_spec and offset
+                    else 0
+                )
+            except Exception:
+                idx = 0
+            return (slice_id, idx, name)
+
+        return sorted(names, key=key)
+
+    # -- filter-time planning ------------------------------------------------
+
+    def filter(
+        self, sched: TPUUnitScheduler, pod: Pod, node_names: list[str]
+    ) -> tuple[list[str], dict[str, str]]:
+        """Plan-once, steer-each-member filter for gang pods."""
+        req = request_from_pod(pod)
+        gkey = self.gang_key(pod, req)
+        with self._lock:
+            plan = self._plans.get(gkey)
+            if plan is not None and time.monotonic() - plan.created > self.timeout:
+                self._plans.pop(gkey, None)
+                plan = None
+            if plan is None:
+                plan = self._plan(sched, req, node_names)
+                if plan is None:
+                    GANG_EVENTS.inc("plan_infeasible")
+                    return [], {
+                        n: f"gang {gkey}: {req.gang_size} members cannot fit"
+                        for n in node_names
+                    }
+                plan.created = time.monotonic()
+                self._plans[gkey] = plan
+                GANG_EVENTS.inc("planned")
+            node = plan.claim(pod.key)
+            if node is None:
+                return [], {
+                    n: f"gang {gkey}: all {req.gang_size} slots claimed"
+                    for n in node_names
+                }
+            if node not in node_names:
+                return [], {
+                    n: f"gang {gkey}: planned node {node} not in candidates"
+                    for n in node_names
+                }
+            return [node], {}
+
+    def _plan(
+        self, sched: TPUUnitScheduler, req: TPURequest, node_names: list[str]
+    ) -> Optional[_Plan]:
+        """Greedily place all members onto cloned chip state, mesh-ordered."""
+        ordered = self._node_mesh_order(sched, node_names)
+        clones = {}
+        slots: list[str] = []
+        for member in range(req.gang_size):
+            member_req = TPURequest(
+                pod_uid=f"plan-{member}",
+                pod_key=f"plan/{member}",
+                units=req.units,
+                container_names=req.container_names,
+            )
+            placed = False
+            for name in ordered:
+                cs = clones.get(name)
+                if cs is None:
+                    with sched.lock:
+                        na = sched._get_allocator(name)
+                    if na is None:
+                        continue
+                    with na.lock:
+                        cs = na.chips.clone()
+                    clones[name] = cs
+                opt = cs.trade(member_req, sched.rater)
+                if opt is None:
+                    continue
+                cs.transact(opt)
+                slots.append(name)
+                placed = True
+                break
+            if not placed:
+                return None
+        return _Plan(slots=slots)
+
+    # -- bind-time barrier ---------------------------------------------------
+
+    def bind(self, sched: ResourceScheduler, node: str, pod: Pod) -> None:
+        req = request_from_pod(pod)
+        if not self.is_gang_pod(req):
+            sched.bind(node, pod)
+            return
+        gkey = self.gang_key(pod, req)
+        with self._lock:
+            g = self._gangs.get(gkey)
+            if g is None:
+                g = _Gang(
+                    name=gkey,
+                    size=req.gang_size,
+                    created=time.monotonic(),
+                    cond=threading.Condition(),
+                )
+                self._gangs[gkey] = g
+                GANG_EVENTS.inc("created")
+
+        with g.cond:
+            if g.failed:
+                self._maybe_gc(gkey, g)
+                raise RuntimeError(f"gang {gkey}: {g.failed}")
+            g.members[pod.key] = node
+            if len(g.members) >= g.size:
+                g.ready = True
+                GANG_EVENTS.inc("barrier_tripped")
+                g.cond.notify_all()
+            else:
+                deadline = g.created + self.timeout
+                while not g.ready and not g.failed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        g.failed = (
+                            f"timed out with {len(g.members)}/{g.size} members"
+                        )
+                        GANG_EVENTS.inc("timeout")
+                        g.cond.notify_all()
+                        break
+                    g.cond.wait(timeout=remaining)
+            if g.failed:
+                g.members.pop(pod.key, None)
+                self._maybe_gc(gkey, g)
+                raise RuntimeError(f"gang {gkey}: {g.failed}")
+
+        # barrier tripped: commit this member
+        try:
+            sched.bind(node, pod)
+        except Exception as e:
+            with g.cond:
+                if not g.failed:
+                    g.failed = f"member {pod.key} bind failed: {e}"
+                    GANG_EVENTS.inc("commit_failed")
+                    g.cond.notify_all()
+            raise
+        with g.cond:
+            g.done += 1
+            if g.done >= g.size:
+                GANG_EVENTS.inc("bound")
+            self._maybe_gc(gkey, g)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _maybe_gc(self, key: str, g: _Gang) -> None:
+        """Drop finished/failed-and-drained gangs + their plans
+        (caller holds g.cond)."""
+        finished = g.done >= g.size or (g.failed and not g.members)
+        if finished:
+            with self._lock:
+                if self._gangs.get(key) is g:
+                    del self._gangs[key]
+                if g.done >= g.size or g.failed:
+                    self._plans.pop(key, None)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "gangs": {
+                    k: {
+                        "size": g.size,
+                        "arrived": len(g.members),
+                        "done": g.done,
+                        "ready": g.ready,
+                        "failed": g.failed,
+                        "age_s": round(time.monotonic() - g.created, 3),
+                    }
+                    for k, g in self._gangs.items()
+                },
+                "plans": {
+                    k: {"slots": len(p.slots), "claimed": len(p.claims)}
+                    for k, p in self._plans.items()
+                },
+            }

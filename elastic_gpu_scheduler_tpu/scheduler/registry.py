@@ -1,0 +1,61 @@
+"""Scheduler registry + per-pod dispatch.
+
+Reference: BuildResourceSchedulers / GetResourceScheduler
+(pkg/scheduler/scheduler.go:292-334).  One engine instance is registered under
+*both* the core and HBM resource names (scheduler.go:308-309); dispatch scans
+the pod's container requests for a registered resource (scheduler.go:323-334).
+The reference's pgpu/qgpu modes are commented-out TODOs; here the mode set is
+just ``tpushare`` (fractional + whole-chip in one engine).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..k8s.objects import Pod
+from ..utils import consts
+from .scheduler import ResourceScheduler, SchedulerConfig, TPUUnitScheduler
+
+KNOWN_MODES = ("tpushare",)
+
+
+def build_resource_schedulers(
+    modes: list[str], config: SchedulerConfig
+) -> dict[str, ResourceScheduler]:
+    registry: dict[str, ResourceScheduler] = {}
+    for mode in modes:
+        if mode == "tpushare":
+            engine = TPUUnitScheduler(config, name="tpushare")
+            for res in (
+                *consts.RESOURCE_TPU_CORE_ALIASES,
+                *consts.RESOURCE_TPU_HBM_ALIASES,
+            ):
+                registry[res] = engine
+        else:
+            raise ValueError(f"unknown scheduler mode {mode!r}; known: {KNOWN_MODES}")
+    return registry
+
+
+def get_resource_scheduler(
+    registry: dict[str, ResourceScheduler], pod: Pod
+) -> Optional[ResourceScheduler]:
+    for c in pod.spec.containers:
+        for res_map in (c.resources.requests, c.resources.limits):
+            for name in res_map or {}:
+                if name in registry:
+                    return registry[name]
+    return None
+
+
+def is_tpu_pod(pod: Pod) -> bool:
+    """Does the pod request any recognized TPU resource?
+    (reference: IsGPUPod, pkg/scheduler/pod.go:27-34)."""
+    names = set(consts.RESOURCE_TPU_CORE_ALIASES) | set(
+        consts.RESOURCE_TPU_HBM_ALIASES
+    )
+    for c in pod.spec.containers:
+        for res_map in (c.resources.requests, c.resources.limits):
+            for name, v in (res_map or {}).items():
+                if name in names and int(str(v)) > 0:
+                    return True
+    return False

@@ -1,0 +1,326 @@
+"""The stateful scheduling engine (assume / score / bind / reconcile hooks).
+
+TPU rebuild of the reference's GPUUnitScheduler/BaseScheduler
+(reference: pkg/scheduler/scheduler.go:41-290):
+
+- one engine instance serves both TPU resource names (core + HBM), registered
+  under each (scheduler.go:308-309);
+- ``assume`` fans candidate nodes out to a worker pool (scheduler.go:135-156;
+  pool size configurable here, fixed 4 there);
+- ``bind`` writes the annotation ledger with optimistic-conflict retry then
+  POSTs the Binding subresource (scheduler.go:186-227).  Two deviations from
+  the reference, both documented in SURVEY §5 as quirks-not-to-replicate:
+  conflicts are detected structurally (HTTP 409) rather than by error-string
+  match, and non-conflict update errors are *raised* (the reference swallows
+  them and silently skips binding, scheduler.go:210-211);
+- on construction the engine rebuilds all node state from ``assumed=true``
+  pod annotations — the API server is the only durable store
+  (scheduler.go:86-106);
+- ``pod_maps``/``released_pods`` give at-most-once accounting across the
+  controller's add/forget callbacks (scheduler.go:47-49, 261-281).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.allocator import Option
+from ..core.annotations import (
+    annotations_for_option,
+    assigned_node,
+    is_assumed,
+    option_from_pod,
+)
+from ..core.node import NodeAllocator
+from ..core.rater import Rater
+from ..core.request import TPURequest, request_from_pod
+from ..k8s.client import Clientset
+from ..k8s.fake import is_conflict, is_not_found
+from ..k8s.objects import Binding, Pod
+from ..utils import consts
+
+log = logging.getLogger("tpu-scheduler")
+
+
+@dataclass
+class SchedulerConfig:
+    """Reference: ElasticSchedulerConfig (scheduler.go:23-28)."""
+
+    clientset: Clientset
+    rater: Rater
+    assume_workers: int = 4  # reference hardcodes 4 (scheduler.go:135)
+
+
+class ResourceScheduler:
+    """Verb interface the handlers dispatch to (reference: scheduler.go:30-39)."""
+
+    name = "resource-scheduler"
+
+    def assume(self, node_names: list[str], pod: Pod) -> tuple[list[str], dict[str, str]]:
+        raise NotImplementedError
+
+    def score(self, node_names: list[str], pod: Pod) -> list[int]:
+        raise NotImplementedError
+
+    def bind(self, node_name: str, pod: Pod) -> Pod:
+        raise NotImplementedError
+
+    def add_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def forget_pod(self, pod: Pod) -> None:
+        raise NotImplementedError
+
+    def known_pod(self, pod: Pod) -> bool:
+        raise NotImplementedError
+
+    def released_pod(self, pod: Pod) -> bool:
+        raise NotImplementedError
+
+    def status(self) -> dict:
+        raise NotImplementedError
+
+
+class TPUUnitScheduler(ResourceScheduler):
+    def __init__(self, config: SchedulerConfig, name: str = "tpushare"):
+        self.name = name
+        self.clientset = config.clientset
+        self.rater = config.rater
+        self.assume_workers = max(1, config.assume_workers)
+        self.lock = threading.RLock()
+        self.allocators: dict[str, NodeAllocator] = {}
+        # pod key → (node, committed Option); the at-most-once ledger
+        self.pod_maps: dict[str, tuple[str, Option]] = {}
+        self.released_pods: dict[str, str] = {}  # pod key → uid
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.assume_workers, thread_name_prefix="assume"
+        )
+        self._rebuild_state()
+
+    # -- startup rebuild (reference: scheduler.go:86-106) --------------------
+
+    def _rebuild_state(self) -> None:
+        try:
+            assumed = self.clientset.list_pods(
+                label_selector={consts.ANNOTATION_ASSUMED: "true"}
+            )
+        except Exception as e:  # pragma: no cover - startup best effort
+            log.warning("state rebuild: list assumed pods failed: %s", e)
+            return
+        for pod in assumed:
+            if pod.is_completed():
+                continue
+            node = assigned_node(pod)
+            if not node:
+                continue
+            try:
+                self.add_pod(pod)
+            except Exception as e:
+                log.warning("state rebuild: add pod %s failed: %s", pod.key, e)
+
+    def _get_allocator(self, node_name: str) -> Optional[NodeAllocator]:
+        """Cache-or-fetch a node's allocator, replaying its assumed pods
+        (reference: getNodeInfo, scheduler.go:62-84)."""
+        with self.lock:
+            na = self.allocators.get(node_name)
+            if na is not None:
+                return na
+            try:
+                node = self.clientset.get_node(node_name)
+            except Exception as e:
+                log.debug("get node %s: %s", node_name, e)
+                return None
+            na = NodeAllocator(node)
+            if na.chips.num_chips == 0:
+                return None
+            self.allocators[node_name] = na
+            # replay pods already assumed onto this node
+            try:
+                pods = self.clientset.list_pods(
+                    label_selector={consts.ANNOTATION_ASSUMED: "true"},
+                    field_selector=lambda p: assigned_node(p) == node_name
+                    and not p.is_completed(),
+                )
+            except Exception:
+                pods = []
+            for pod in pods:
+                if pod.key in self.pod_maps:
+                    continue
+                opt = option_from_pod(pod, na.chips.topo)
+                if opt is None:
+                    continue
+                try:
+                    na.add(opt)
+                    self.pod_maps[pod.key] = (node_name, opt)
+                except ValueError as e:
+                    log.warning("replay %s on %s: %s", pod.key, node_name, e)
+            return na
+
+    # -- verbs ---------------------------------------------------------------
+
+    def assume(
+        self, node_names: list[str], pod: Pod
+    ) -> tuple[list[str], dict[str, str]]:
+        """Filter: which candidate nodes can host the pod
+        (reference: scheduler.go:112-168)."""
+        request = request_from_pod(pod)
+        with self.lock:
+            allocators = [
+                (n, self._get_allocator(n)) for n in node_names
+            ]
+
+        ok: list[str] = []
+        failed: dict[str, str] = {}
+
+        def try_node(item):
+            name, na = item
+            if na is None:
+                return name, "no TPU capacity visible"
+            opt = na.assume(request, self.rater)
+            if opt is None:
+                return name, "insufficient TPU resources"
+            return name, None
+
+        results = list(self._pool.map(try_node, allocators))
+        for name, err in results:
+            if err is None:
+                ok.append(name)
+            else:
+                failed[name] = err
+        return ok, failed
+
+    def score(self, node_names: list[str], pod: Pod) -> list[int]:
+        """Priorities verb (reference: scheduler.go:170-184)."""
+        from ..core.rater import to_extender_score
+
+        request = request_from_pod(pod)
+        scores = []
+        for n in node_names:
+            with self.lock:
+                na = self._get_allocator(n)
+            if na is None:
+                scores.append(consts.SCORE_MIN)
+                continue
+            s = na.score(request, self.rater)
+            scores.append(consts.SCORE_MIN if s is None else to_extender_score(s))
+        return scores
+
+    def bind(self, node_name: str, pod: Pod) -> Pod:
+        """Commit + persist + bind (reference: scheduler.go:186-227).
+
+        Raises on failure; the committed allocation is rolled back if the
+        annotation write or binding POST cannot be completed.
+        """
+        request = request_from_pod(pod)
+        with self.lock:
+            na = self._get_allocator(node_name)
+            if na is None:
+                raise RuntimeError(f"bind: node {node_name} has no TPU allocator")
+            opt = na.allocate(request, self.rater)
+            self.pod_maps[pod.key] = (node_name, opt)
+            self.released_pods.pop(pod.key, None)
+
+        try:
+            updated = self._write_annotations(pod, opt, node_name)
+            self.clientset.bind(
+                Binding(
+                    pod_name=pod.metadata.name,
+                    pod_namespace=pod.metadata.namespace,
+                    pod_uid=pod.metadata.uid,
+                    node=node_name,
+                )
+            )
+            return updated
+        except Exception:
+            with self.lock:
+                self.pod_maps.pop(pod.key, None)
+                na.forget(opt)
+            raise
+
+    def _write_annotations(self, pod: Pod, opt: Option, node_name: str) -> Pod:
+        """Annotation-ledger write with one optimistic-conflict retry
+        (reference: scheduler.go:199-213)."""
+        attempts = 2
+        cur = pod
+        for i in range(attempts):
+            cur.metadata.annotations.update(annotations_for_option(opt, node_name))
+            cur.metadata.labels[consts.ANNOTATION_ASSUMED] = "true"
+            try:
+                return self.clientset.update_pod(cur)
+            except Exception as e:
+                if is_conflict(e) and i < attempts - 1:
+                    fresh = self.clientset.get_pod(
+                        pod.metadata.namespace, pod.metadata.name
+                    )
+                    if fresh.metadata.uid != pod.metadata.uid:
+                        raise RuntimeError(
+                            f"bind: pod {pod.key} was recreated (uid changed)"
+                        ) from None
+                    cur = fresh
+                    continue
+                raise
+        raise RuntimeError("unreachable")
+
+    # -- reconciliation hooks (reference: scheduler.go:229-281) --------------
+
+    def add_pod(self, pod: Pod) -> None:
+        """Learn an allocation committed elsewhere (controller/startup)."""
+        node_name = assigned_node(pod)
+        if not node_name:
+            return
+        with self.lock:
+            if pod.key in self.pod_maps:
+                return
+            na = self._get_allocator(node_name)
+            if na is None:
+                return
+            # _get_allocator may already have replayed this pod
+            if pod.key in self.pod_maps:
+                return
+            opt = option_from_pod(pod, na.chips.topo)
+            if opt is None:
+                return
+            try:
+                na.add(opt)
+            except ValueError as e:
+                log.warning("add_pod %s: %s", pod.key, e)
+                return
+            self.pod_maps[pod.key] = (node_name, opt)
+            self.released_pods.pop(pod.key, None)
+
+    def forget_pod(self, pod: Pod) -> None:
+        """Free a completed/deleted pod's chips, at most once
+        (reference: scheduler.go:247-267)."""
+        with self.lock:
+            entry = self.pod_maps.pop(pod.key, None)
+            if entry is None:
+                return
+            if self.released_pods.get(pod.key) == pod.metadata.uid:
+                return
+            node_name, opt = entry
+            na = self.allocators.get(node_name)
+            if na is not None:
+                na.forget(opt)
+            self.released_pods[pod.key] = pod.metadata.uid
+
+    def known_pod(self, pod: Pod) -> bool:
+        with self.lock:
+            return pod.key in self.pod_maps
+
+    def released_pod(self, pod: Pod) -> bool:
+        with self.lock:
+            return self.released_pods.get(pod.key) == pod.metadata.uid
+
+    def status(self) -> dict:
+        """Per-node chip availability dump (reference: scheduler.go:283-290)."""
+        with self.lock:
+            return {
+                "scheduler": self.name,
+                "rater": self.rater.name,
+                "nodes": {n: na.status() for n, na in self.allocators.items()},
+                "pods": sorted(self.pod_maps),
+            }

@@ -1,0 +1,101 @@
+"""Extender verb handlers.
+
+Thin adapters between the HTTP layer and the scheduling engines — the
+reference's pkg/server (predicate.go:16-40, priority.go:17-45, bind.go:21-55):
+pick the right engine for the pod's requested resource, call
+assume/score/bind.  Bind re-fetches the pod and double-checks UID and
+completion before committing (reference: bind.go:36-45, pod.go:110-131).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..k8s.extender import (
+    ExtenderArgs,
+    ExtenderBindingArgs,
+    ExtenderBindingResult,
+    ExtenderFilterResult,
+    HostPriority,
+)
+from ..k8s.fake import is_not_found
+from ..k8s.objects import Pod
+from ..scheduler.registry import get_resource_scheduler
+from ..scheduler.scheduler import ResourceScheduler
+
+log = logging.getLogger("tpu-scheduler")
+
+
+class Predicate:
+    def __init__(self, registry: dict[str, ResourceScheduler], gang=None):
+        self.registry = registry
+        self.gang = gang  # optional GangCoordinator
+
+    def handle(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        pod = args.pod
+        if args.node_names is None:
+            return ExtenderFilterResult(
+                error="extender requires nodeCacheCapable=true (NodeNames missing)"
+            )
+        sched = get_resource_scheduler(self.registry, pod)
+        if sched is None:
+            # no TPU demand → every node passes
+            return ExtenderFilterResult(node_names=list(args.node_names))
+        from ..core.request import request_from_pod
+
+        if self.gang is not None and self.gang.is_gang_pod(request_from_pod(pod)):
+            ok, failed = self.gang.filter(sched, pod, list(args.node_names))
+        else:
+            ok, failed = sched.assume(list(args.node_names), pod)
+        return ExtenderFilterResult(node_names=ok, failed_nodes=failed)
+
+
+class Prioritize:
+    def __init__(self, registry: dict[str, ResourceScheduler]):
+        self.registry = registry
+
+    def handle(self, args: ExtenderArgs) -> list[HostPriority]:
+        pod = args.pod
+        names = list(args.node_names or [])
+        sched = get_resource_scheduler(self.registry, pod)
+        if sched is None:
+            return [HostPriority(host=n, score=0) for n in names]
+        scores = sched.score(names, pod)
+        return [HostPriority(host=n, score=s) for n, s in zip(names, scores)]
+
+
+class Bind:
+    def __init__(self, registry: dict[str, ResourceScheduler], clientset, gang=None):
+        self.registry = registry
+        self.clientset = clientset
+        self.gang = gang
+
+    def handle(self, args: ExtenderBindingArgs) -> ExtenderBindingResult:
+        try:
+            pod = self.clientset.get_pod(args.pod_namespace, args.pod_name)
+        except Exception as e:
+            if is_not_found(e):
+                return ExtenderBindingResult(
+                    error=f"pod {args.pod_namespace}/{args.pod_name} not found"
+                )
+            return ExtenderBindingResult(error=f"get pod: {e}")
+        # delete/recreate race: the UID the kube-scheduler bound is stale
+        if args.pod_uid and pod.metadata.uid != args.pod_uid:
+            return ExtenderBindingResult(
+                error=f"pod {pod.key}: uid mismatch (recreated?)"
+            )
+        if pod.is_completed():
+            return ExtenderBindingResult(error=f"pod {pod.key} already completed")
+        sched = get_resource_scheduler(self.registry, pod)
+        if sched is None:
+            return ExtenderBindingResult(error=f"pod {pod.key} requests no TPU")
+        try:
+            if self.gang is not None:
+                self.gang.bind(sched, args.node, pod)
+            else:
+                sched.bind(args.node, pod)
+        except Exception as e:
+            log.warning("bind %s -> %s failed: %s", pod.key, args.node, e)
+            return ExtenderBindingResult(error=str(e))
+        return ExtenderBindingResult()

@@ -1,0 +1,167 @@
+"""HTTP routing for the extender webhook.
+
+Reference: pkg/routes/routes.go.  Paths kept wire-compatible:
+
+    POST /scheduler/filter      → Predicate
+    POST /scheduler/priorities  → Prioritize
+    POST /scheduler/bind        → Bind
+    GET  /scheduler/status      → per-node chip state dump (routes.go:197-218)
+    GET  /version               → version JSON (routes.go:165-171)
+    GET  /healthz               → liveness
+    GET  /metrics               → Prometheus text (net-new; reference has none)
+    GET  /debug/stacks          → all-thread stack dump (pprof analogue;
+                                  reference mounts net/http/pprof, pprof.go)
+
+Deviation (SURVEY §5 quirk not replicated): the reference's prioritize route
+panics on malformed input (routes.go:98,103,109); here every route returns a
+structured error with a 4xx/5xx status instead.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from .. import __version__
+from ..k8s.extender import ExtenderArgs, ExtenderBindingArgs
+from ..metrics import REGISTRY, VERB_LATENCY, VERB_TOTAL
+from .handlers import Bind, Predicate, Prioritize
+
+log = logging.getLogger("tpu-scheduler")
+
+
+class ExtenderServer:
+    def __init__(
+        self,
+        predicate: Predicate,
+        prioritize: Prioritize,
+        bind: Bind,
+        status_fn: Callable[[], dict],
+        host: str = "0.0.0.0",
+        port: int = 39999,
+    ):
+        self.predicate = predicate
+        self.prioritize = prioritize
+        self.bind = bind
+        self.status_fn = status_fn
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request plumbing ----------------------------------------------------
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, obj) -> None:
+                self._send(code, json.dumps(obj).encode())
+
+            def _read_json(self) -> Optional[dict]:
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return None
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/version":
+                    self._send_json(200, {"version": __version__})
+                elif path == "/healthz":
+                    self._send(200, b"ok", "text/plain")
+                elif path == "/metrics":
+                    self._send(200, REGISTRY.expose().encode(), "text/plain")
+                elif path == "/scheduler/status":
+                    try:
+                        self._send_json(200, server_self.status_fn())
+                    except Exception as e:
+                        self._send_json(500, {"error": str(e)})
+                elif path == "/debug/stacks":
+                    frames = sys._current_frames()
+                    out = []
+                    for tid, frame in frames.items():
+                        out.append(f"--- thread {tid} ---")
+                        out.extend(traceback.format_stack(frame))
+                    self._send(200, "".join(out).encode(), "text/plain")
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                body = self._read_json()
+                if body is None:
+                    VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "bad_request")
+                    self._send_json(400, {"Error": "malformed JSON body"})
+                    return
+                if path == "/scheduler/filter":
+                    self._verb("filter", lambda: server_self.predicate.handle(
+                        ExtenderArgs.from_dict(body)).to_dict())
+                elif path == "/scheduler/priorities":
+                    self._verb("priorities", lambda: [
+                        hp.to_dict()
+                        for hp in server_self.prioritize.handle(
+                            ExtenderArgs.from_dict(body))
+                    ])
+                elif path == "/scheduler/bind":
+                    self._verb("bind", lambda: server_self.bind.handle(
+                        ExtenderBindingArgs.from_dict(body)).to_dict())
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+
+            def _verb(self, verb: str, fn: Callable[[], object]) -> None:
+                try:
+                    with VERB_LATENCY.time(verb):
+                        result = fn()
+                    VERB_TOTAL.inc(verb, "ok")
+                    self._send_json(200, result)
+                except Exception as e:  # structured 500, never a crash
+                    log.exception("%s verb failed", verb)
+                    VERB_TOTAL.inc(verb, "error")
+                    self._send_json(500, {"Error": f"{verb}: {e}"})
+
+        return Handler
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Start serving in a background thread; returns the bound port."""
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="extender-http", daemon=True
+        )
+        self._thread.start()
+        log.info("extender serving on %s:%d", self.host, self.port)
+        return self.port
+
+    def serve_forever(self) -> None:
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,291 @@
+"""Integration tests: full filter → priorities → bind HTTP surface against a
+fake cluster, plus the reconciliation controller (SURVEY §4.2 strategy)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+@pytest.fixture()
+def stack():
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority="binpack", gang_timeout=2.0
+    )
+    controller.start()
+    server = ExtenderServer(predicate, prioritize, bind, status, host="127.0.0.1", port=0)
+    port = server.start()
+    yield cluster, clientset, port, controller
+    server.stop()
+    controller.stop()
+
+
+def post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        body = r.read()
+        try:
+            return r.status, json.loads(body)
+        except json.JSONDecodeError:
+            return r.status, body.decode()
+
+
+def schedule_pod(cluster, port, pod, nodes=None):
+    """Drive the verbs exactly as kube-scheduler would."""
+    cluster.create_pod(pod)
+    nodes = nodes or [n.metadata.name for n in cluster.list_nodes()]
+    code, filt = post(
+        port, "/scheduler/filter", {"Pod": pod.to_dict(), "NodeNames": nodes}
+    )
+    assert code == 200, filt
+    if not filt["NodeNames"]:
+        return None, filt
+    code, prio = post(
+        port,
+        "/scheduler/priorities",
+        {"Pod": pod.to_dict(), "NodeNames": filt["NodeNames"]},
+    )
+    assert code == 200
+    best = max(prio, key=lambda hp: hp["Score"])["Host"]
+    code, res = post(
+        port,
+        "/scheduler/bind",
+        {
+            "PodName": pod.metadata.name,
+            "PodNamespace": pod.metadata.namespace,
+            "PodUID": pod.metadata.uid,
+            "Node": best,
+        },
+    )
+    assert code == 200
+    return best, res
+
+
+def test_end_to_end_bind(stack):
+    cluster, clientset, port, _ = stack
+    pod = tpu_pod("trainer", core=200, hbm=32)
+    node, res = schedule_pod(cluster, port, pod)
+    assert res["Error"] == ""
+    bound = cluster.get_pod("default", "trainer")
+    assert bound.spec.node_name == node
+    ann = bound.metadata.annotations
+    assert ann[consts.ANNOTATION_ASSUMED] == "true"
+    assert ann[consts.ANNOTATION_NODE] == node
+    coords = ann[consts.ANNOTATION_CONTAINER_PREFIX + "main"].split(",")
+    assert len(coords) == 2
+    assert bound.metadata.labels[consts.ANNOTATION_ASSUMED] == "true"
+    # status reflects the allocation
+    code, st = get(port, "/scheduler/status")
+    assert code == 200
+    node_state = st["schedulers"][0]["nodes"][node]
+    used = sum(
+        1 for c in node_state["chips"].values() if c["core_avail"] == 0
+    )
+    assert used == 2
+
+
+def test_filter_rejects_full_nodes(stack):
+    cluster, clientset, port, _ = stack
+    # fill node-0 completely via four 100-core pods pinned by filtering to it
+    for i in range(4):
+        pod = tpu_pod(f"fill-{i}", core=100)
+        node, _ = schedule_pod(cluster, port, pod, nodes=["node-0"])
+        assert node == "node-0"
+    pod = tpu_pod("overflow", core=100)
+    cluster.create_pod(pod)
+    code, filt = post(
+        port, "/scheduler/filter", {"Pod": pod.to_dict(), "NodeNames": ["node-0"]}
+    )
+    assert code == 200
+    assert filt["NodeNames"] == []
+    assert "node-0" in filt["FailedNodes"]
+
+
+def test_fractional_sharing_eight_pods_one_chip(stack):
+    # BASELINE config 3: 8 pods × 12.5% sharing one chip
+    cluster, clientset, port, _ = stack
+    nodes_used = set()
+    for i in range(8):
+        pod = tpu_pod(f"share-{i}", core=12, hbm=1)
+        node, res = schedule_pod(cluster, port, pod, nodes=["node-1"])
+        assert res["Error"] == ""
+        nodes_used.add(node)
+    assert nodes_used == {"node-1"}
+    code, st = get(port, "/scheduler/status")
+    chips = st["schedulers"][0]["nodes"]["node-1"]["chips"]
+    touched = [c for c in chips.values() if c["core_avail"] < 100]
+    assert len(touched) == 1  # binpack put all 8 on one chip
+    assert touched[0]["core_avail"] == 100 - 8 * 12
+
+
+def test_filter_requires_node_cache_capable(stack):
+    _, _, port, _ = stack
+    pod = tpu_pod("p", core=100)
+    code, filt = post(port, "/scheduler/filter", {"Pod": pod.to_dict()})
+    assert code == 200
+    assert "nodeCacheCapable" in filt["Error"]
+
+
+def test_malformed_json_is_structured_error(stack):
+    _, _, port, _ = stack
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/scheduler/priorities",
+        data=b"{not json",
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            code, body = r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        code, body = e.code, json.loads(e.read())
+    assert code == 400
+    assert "Error" in body  # the reference panics here; we return 400
+
+
+def test_bind_uid_mismatch(stack):
+    cluster, _, port, _ = stack
+    pod = tpu_pod("ghost", core=100)
+    cluster.create_pod(pod)
+    code, res = post(
+        port,
+        "/scheduler/bind",
+        {
+            "PodName": "ghost",
+            "PodNamespace": "default",
+            "PodUID": "wrong-uid",
+            "Node": "node-0",
+        },
+    )
+    assert code == 200
+    assert "uid mismatch" in res["Error"]
+
+
+def test_non_tpu_pod_passes_filter(stack):
+    cluster, _, port, _ = stack
+    pod = make_pod("web", containers=[Container(name="nginx")])
+    cluster.create_pod(pod)
+    code, filt = post(
+        port,
+        "/scheduler/filter",
+        {"Pod": pod.to_dict(), "NodeNames": ["node-0", "node-1"]},
+    )
+    assert code == 200
+    assert filt["NodeNames"] == ["node-0", "node-1"]
+
+
+def test_controller_releases_completed_pod(stack):
+    cluster, clientset, port, controller = stack
+    pod = tpu_pod("job", core=400)
+    node, _ = schedule_pod(cluster, port, pod)
+    code, st = get(port, "/scheduler/status")
+    free = [
+        c
+        for c in st["schedulers"][0]["nodes"][node]["chips"].values()
+        if c["core_avail"] == 100
+    ]
+    assert len(free) == 0
+    cluster.set_pod_phase("default", "job", "Succeeded")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        code, st = get(port, "/scheduler/status")
+        free = [
+            c
+            for c in st["schedulers"][0]["nodes"][node]["chips"].values()
+            if c["core_avail"] == 100
+        ]
+        if len(free) == 4:
+            break
+        time.sleep(0.05)
+    assert len(free) == 4  # chips freed by reconciliation
+
+
+def test_controller_releases_deleted_pod(stack):
+    cluster, clientset, port, controller = stack
+    pod = tpu_pod("doomed", core=200)
+    node, _ = schedule_pod(cluster, port, pod)
+    cluster.delete_pod("default", "doomed")
+    deadline = time.time() + 5
+    ok = False
+    while time.time() < deadline:
+        code, st = get(port, "/scheduler/status")
+        chips = st["schedulers"][0]["nodes"][node]["chips"]
+        if all(c["core_avail"] == 100 for c in chips.values()):
+            ok = True
+            break
+        time.sleep(0.05)
+    assert ok
+
+
+def test_restart_rebuild_from_annotations(stack):
+    cluster, clientset, port, _ = stack
+    pod = tpu_pod("survivor", core=300)
+    node, _ = schedule_pod(cluster, port, pod)
+    cluster.set_pod_phase("default", "survivor", "Running")
+    # simulate a scheduler restart: brand-new stack over the same cluster
+    registry2, *_ = build_stack(FakeClientset(cluster), cluster=cluster)
+    sched2 = registry2[consts.RESOURCE_TPU_CORE]
+    st = sched2.status()
+    assert f"default/survivor" in st["pods"]
+    chips = st["nodes"][node]["chips"]
+    assert sum(1 for c in chips.values() if c["core_avail"] == 0) == 3
+
+
+def test_version_health_metrics(stack):
+    _, _, port, _ = stack
+    assert get(port, "/version")[1]["version"]
+    assert get(port, "/healthz")[1] == "ok"
+    code, text = get(port, "/metrics")
+    assert code == 200
+    assert "tpu_scheduler_verb_duration_seconds" in text
