@@ -1,0 +1,331 @@
+"""Benchmark: the five BASELINE configs through the full extender HTTP stack.
+
+North-star metrics (BASELINE.md): ≥95% chip-packing efficiency and <100ms
+p99 schedule/bind latency gang-scheduling a 256-replica JAX SPMD job onto a
+v5p-256 slice.  The reference publishes no numbers (SURVEY §6), so
+``vs_baseline`` is measured against the 100ms p99 target: vs_baseline =
+100ms / measured_p99 (>1.0 = beating the target).
+
+Methodology (mirrors how kube-scheduler drives an extender):
+
+- scheduling cycles are SEQUENTIAL (filter + priorities per pod over one
+  persistent HTTP connection — kube-scheduler runs one scheduling cycle at a
+  time); binds are CONCURRENT (kube-scheduler binds asynchronously).
+- per-pod latency = its filter+priorities round-trips + its bind commit.
+  For gang members the bind verb intentionally *waits* at the all-or-nothing
+  barrier until every member has arrived — that wait is admission-protocol
+  time, not scheduler processing time, so the commit latency (allocate +
+  annotation write + Binding POST, measured server-side from barrier trip) is
+  what counts against the 100ms target.  Barrier wall time is reported
+  separately as cfgN_gang_wall_ms.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+class Client:
+    """Persistent-connection JSON client (one per thread)."""
+
+    def __init__(self, port):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        self.conn.connect()
+        self.conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def post(self, path, body):
+        payload = json.dumps(body)
+        self.conn.request(
+            "POST", path, body=payload, headers={"Content-Type": "application/json"}
+        )
+        resp = self.conn.getresponse()
+        return json.loads(resp.read())
+
+    def close(self):
+        self.conn.close()
+
+
+def schedule_cycle(client, pod, nodes):
+    """One kube-scheduler scheduling cycle: filter + priorities → node."""
+    filt = client.post(
+        "/scheduler/filter", {"Pod": pod.to_dict(), "NodeNames": nodes}
+    )
+    if filt.get("Error") or not filt.get("NodeNames"):
+        raise RuntimeError(
+            f"filter: {filt.get('Error') or filt.get('FailedNodes')}"
+        )
+    prio = client.post(
+        "/scheduler/priorities",
+        {"Pod": pod.to_dict(), "NodeNames": filt["NodeNames"]},
+    )
+    return max(prio, key=lambda hp: hp["Score"])["Host"]
+
+
+def bind_pod(client, pod, node):
+    res = client.post(
+        "/scheduler/bind",
+        {
+            "PodName": pod.metadata.name,
+            "PodNamespace": pod.metadata.namespace,
+            "PodUID": pod.metadata.uid,
+            "Node": node,
+        },
+    )
+    if res.get("Error"):
+        raise RuntimeError(f"bind: {res['Error']}")
+
+
+def run_sequential(port, cluster, pods, nodes):
+    """Non-gang path: full per-pod RTT (filter+priorities+bind), sequential."""
+    client = Client(port)
+    lats = []
+    for p in pods:
+        cluster.create_pod(p)
+        t0 = time.perf_counter()
+        node = schedule_cycle(client, p, nodes)
+        bind_pod(client, p, node)
+        lats.append(time.perf_counter() - t0)
+    client.close()
+    return lats
+
+
+def run_gang(port, cluster, pods, nodes, gang):
+    """Gang path: sequential scheduling cycles, then concurrent binds.
+
+    Returns (per_pod_lats, sched_lats, commit_lats, wall_s); per-pod latency
+    pairs each pod's own scheduling RTT with its own post-barrier commit time
+    (read from the coordinator's per-pod telemetry)."""
+    client = Client(port)
+    targets = []
+    sched_lats = []
+    for p in pods:
+        cluster.create_pod(p)
+        t0 = time.perf_counter()
+        targets.append(schedule_cycle(client, p, nodes))
+        sched_lats.append(time.perf_counter() - t0)
+    client.close()
+
+    errors = [None] * len(pods)
+
+    def do_bind(i):
+        c = Client(port)
+        try:
+            bind_pod(c, pods[i], targets[i])
+        except Exception as e:
+            errors[i] = str(e)
+        finally:
+            c.close()
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=do_bind, args=(i,)) for i in range(len(pods))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    errs = [e for e in errors if e]
+    if errs:
+        raise RuntimeError(f"{len(errs)} gang binds failed: {errs[:3]}")
+    commit_lats = [gang.commit_secs[p.key] for p in pods]
+    per_pod = [s + c for s, c in zip(sched_lats, commit_lats)]
+    return per_pod, sched_lats, commit_lats, wall
+
+
+def packing_efficiency(registry):
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    st = sched.status()
+    total = used = 0
+    for ns in st["nodes"].values():
+        for c in ns["chips"].values():
+            total += c["core_total"]
+            used += c["core_total"] - c["core_avail"]
+    return used / total if total else 0.0
+
+
+def fresh_stack(nodes_fn, priority):
+    cluster = FakeCluster()
+    nodes_fn(cluster)
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority=priority, gang_timeout=60.0
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0
+    )
+    port = server.start()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+    return cluster, registry, server, port, node_names, gang
+
+
+def v5e_pool(cluster, n=4, chips=4, hbm=64):
+    for i in range(n):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=chips, hbm_gib=hbm, accelerator="v5e")
+        )
+
+
+def v5e_4x4_slice(cluster):
+    """4 hosts × 4 chips tiling a 4x4 v5e mesh."""
+    i = 0
+    for x in range(0, 4, 2):
+        for y in range(0, 4, 2):
+            cluster.add_node(
+                make_tpu_node(
+                    f"v5e-host-{i}", chips=4, hbm_gib=64, accelerator="v5e",
+                    slice_topology="4x4", host_topology="2x2",
+                    host_offset=f"{x}.{y}", slice_name="v5e-16",
+                )
+            )
+            i += 1
+
+
+def v5p_256_slice(cluster):
+    """32 hosts × 4 chips tiling a 4x4x8 v5p mesh (128 chips = 256 cores)."""
+    i = 0
+    for x in range(0, 4, 2):
+        for y in range(0, 4, 2):
+            for z in range(8):
+                cluster.add_node(
+                    make_tpu_node(
+                        f"v5p-host-{i}", chips=4, hbm_gib=380, accelerator="v5p",
+                        slice_topology="4x4x8", host_topology="2x2x1",
+                        host_offset=f"{x}.{y}.{z}", slice_name="v5p-256",
+                    )
+                )
+                i += 1
+
+
+def p99(xs):
+    xs = sorted(xs)
+    return xs[max(0, int(0.99 * len(xs)) - 1)] if xs else 0.0
+
+
+def main():
+    results = {}
+    per_pod = []  # per-pod schedule(+commit) latencies across all configs
+
+    # config 1: single-pod hbm-only binpack (README example analogue)
+    cluster, registry, server, port, nodes, _ = fresh_stack(v5e_pool, "binpack")
+    lats = run_sequential(port, cluster, [tpu_pod("cfg1-pod", hbm=8)], nodes)
+    results["cfg1_single_pod_ms"] = round(lats[0] * 1000, 3)
+    per_pod += lats
+    server.stop()
+
+    # config 2: 2-chip × 4-replica deployment, spread across 4 nodes
+    cluster, registry, server, port, nodes, _ = fresh_stack(v5e_pool, "spread")
+    pods = [tpu_pod(f"cfg2-{i}", core=200) for i in range(4)]
+    lats = run_sequential(port, cluster, pods, nodes)
+    spread_nodes = {
+        cluster.get_pod("default", f"cfg2-{i}").spec.node_name for i in range(4)
+    }
+    results["cfg2_spread_nodes"] = len(spread_nodes)  # 4 = perfectly spread
+    per_pod += lats
+    server.stop()
+
+    # config 3: fractional sharing — 8 pods × 12% core on one chip
+    cluster, registry, server, port, nodes, _ = fresh_stack(v5e_pool, "binpack")
+    pods = [tpu_pod(f"cfg3-{i}", core=12, hbm=1) for i in range(8)]
+    lats = run_sequential(port, cluster, pods, ["node-0"])
+    st = registry[consts.RESOURCE_TPU_CORE].status()
+    touched = [
+        c
+        for c in st["nodes"]["node-0"]["chips"].values()
+        if c["core_avail"] < c["core_total"]
+    ]
+    results["cfg3_chips_touched"] = len(touched)  # 1 = all shared one chip
+    per_pod += lats
+    server.stop()
+
+    # config 4: 16-chip job as a 4×(2x2-host) gang on a contiguous 4x4 v5e slice
+    cluster, registry, server, port, nodes, gang = fresh_stack(
+        v5e_4x4_slice, "ici-locality"
+    )
+    pods = [
+        tpu_pod(f"cfg4-{i}", core=400, gang="slice16", gang_size=4)
+        for i in range(4)
+    ]
+    pod_lats, sched_lats, commit_lats, wall = run_gang(
+        port, cluster, pods, nodes, gang
+    )
+    results["cfg4_packing"] = round(packing_efficiency(registry), 4)
+    results["cfg4_gang_wall_ms"] = round(wall * 1000, 3)
+    per_pod += pod_lats
+    server.stop()
+
+    # config 5 (north star): 256-replica gang on v5p-256
+    cluster, registry, server, port, nodes, gang = fresh_stack(
+        v5p_256_slice, "ici-locality"
+    )
+    pods = [
+        tpu_pod(f"replica-{i}", core=50, hbm=2, gang="spmd256", gang_size=256)
+        for i in range(256)
+    ]
+    pod_lats, sched_lats, commit_lats, wall = run_gang(
+        port, cluster, pods, nodes, gang
+    )
+    packing = packing_efficiency(registry)
+    results["cfg5_packing"] = round(packing, 4)
+    results["cfg5_gang_wall_ms"] = round(wall * 1000, 3)
+    results["cfg5_sched_p99_ms"] = round(p99(sched_lats) * 1000, 3)
+    results["cfg5_commit_p99_ms"] = round(p99(commit_lats) * 1000, 3)
+    per_pod += pod_lats
+    server.stop()
+
+    headline = p99(per_pod) * 1000
+    out = {
+        "metric": "schedule_bind_p99_ms",
+        "value": round(headline, 3),
+        "unit": "ms",
+        "vs_baseline": round(100.0 / headline, 3) if headline > 0 else 0.0,
+        "pods_scheduled": len(per_pod),
+        "packing_cfg5": results["cfg5_packing"],
+        "packing_target": 0.95,
+        **results,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

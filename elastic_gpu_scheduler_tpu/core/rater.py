@@ -54,46 +54,73 @@ def _locality_bonus(chips: ChipSet, option: Option) -> float:
     return sum(scores) / len(scores)
 
 
+def _node_used_before(chips: ChipSet, option: Option) -> float:
+    """Node-level core utilization BEFORE this option was applied, in [0,1].
+
+    The cross-node signal: the extender scores each node independently, so a
+    policy can only steer placement across nodes if the score encodes how
+    loaded this node already was (the reference's per-card formula has no
+    such term — its binpack cannot consolidate across nodes either)."""
+    consumed = 0
+    for a in option.allocs:
+        if not a.needs_tpu:
+            continue
+        for c in a.coords:
+            ch = chips.chips[c]
+            consumed += ch.core_total if a.whole else a.core
+    total = max(1, chips.total_core())
+    used_after = total - chips.avail_core()
+    used_before = used_after - consumed
+    return max(0.0, min(1.0, used_before / total))
+
+
+def _chip_used_before(chips: ChipSet, option: Option) -> float:
+    """Mean pre-assignment utilization of the chips this option touches
+    (fractional allocs only) — the within-node consolidation signal."""
+    vals = []
+    for a in option.allocs:
+        if a.whole or not a.needs_tpu:
+            continue
+        for ch, before in _consumed_view(chips, a):
+            vals.append(1.0 - before / max(1, ch.core_total))
+    return sum(vals) / len(vals) if vals else 0.0
+
+
 class Binpack(Rater):
-    """Consolidate: leave as many fully-free chips as possible, and place
-    fractional work on the fullest chip that fits (reference intent,
-    rater.go:15-51, with a bounded formula)."""
+    """Consolidate: prefer already-loaded nodes, already-shared chips, and
+    placements that preserve fully-free chips (reference intent,
+    rater.go:15-51, with a bounded formula and a working cross-node term)."""
 
     name = consts.PRIORITY_BINPACK
 
     def rate(self, chips: ChipSet, option: Option) -> float:
         total = max(1, chips.num_chips)
         untouched = sum(1 for c in chips.chips.values() if c.is_free)
-        preserve = untouched / total  # higher = better packing
-        fullness = []
-        for a in option.allocs:
-            if a.whole or not a.needs_tpu:
-                continue
-            for ch, before in _consumed_view(chips, a):
-                fullness.append(1.0 - before / max(1, ch.core_total))
-        frac = sum(fullness) / len(fullness) if fullness else 1.0
-        return 60.0 * preserve + 30.0 * frac + 10.0 * _locality_bonus(chips, option)
+        preserve = untouched / total  # after assignment: free chips kept whole
+        return (
+            35.0 * _node_used_before(chips, option)
+            + 30.0 * _chip_used_before(chips, option)
+            + 25.0 * preserve
+            + 10.0 * _locality_bonus(chips, option)
+        )
 
 
 class Spread(Rater):
-    """Balance: place work on the freest chips / spread across the mesh."""
+    """Balance: prefer the emptiest node and the freest chips (the
+    reference's Spread is a TODO stub, rater.go:56-59; this is a real one)."""
 
     name = consts.PRIORITY_SPREAD
 
     def rate(self, chips: ChipSet, option: Option) -> float:
-        freeness = []
-        for a in option.allocs:
-            if not a.needs_tpu:
-                continue
-            for ch, before in _consumed_view(chips, a):
-                freeness.append(before / max(1, ch.core_total))
-        frac = sum(freeness) / len(freeness) if freeness else 1.0
-        # prefer low post-assignment variance of per-chip load
-        avails = [c.core_avail / max(1, c.core_total) for c in chips.chips.values()]
-        mean = sum(avails) / max(1, len(avails))
-        var = sum((a - mean) ** 2 for a in avails) / max(1, len(avails))
-        balance = 1.0 - min(1.0, 4.0 * var)
-        return 55.0 * frac + 35.0 * balance + 10.0 * _locality_bonus(chips, option)
+        # NOTE: no post-assignment variance term — per-node variance rewards
+        # both empty and completely-full nodes (var=0), defeating the spread.
+        node_free = 1.0 - _node_used_before(chips, option)
+        chip_free = 1.0 - _chip_used_before(chips, option)
+        return (
+            50.0 * node_free
+            + 35.0 * chip_free
+            + 15.0 * _locality_bonus(chips, option)
+        )
 
 
 class ICILocality(Rater):

@@ -84,6 +84,11 @@ class Histogram:
     def time(self, *labels: str):
         return _Timer(self, labels)
 
+    def samples(self, *labels: str) -> list[float]:
+        """Retained raw samples (bench/test use)."""
+        with self._lock:
+            return list(self._samples.get(labels, []))
+
     def quantile(self, q: float, *labels: str) -> float:
         """Exact quantile from retained samples (for bench/tests)."""
         with self._lock:
@@ -183,5 +188,12 @@ GANG_EVENTS = REGISTRY.register(
         "tpu_scheduler_gang_events_total",
         "Gang lifecycle events",
         ("event",),
+    )
+)
+GANG_COMMIT = REGISTRY.register(
+    Histogram(
+        "tpu_scheduler_gang_commit_seconds",
+        "Per-member commit latency after the gang barrier trips "
+        "(allocate + annotation write + binding; excludes barrier wait)",
     )
 )

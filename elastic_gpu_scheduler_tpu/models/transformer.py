@@ -1,0 +1,163 @@
+"""Flagship model: a pure-functional decoder-only transformer LM.
+
+TPU-first design choices:
+
+- pure pytree params + functional apply (no framework classes): everything
+  under ``jit`` traces once; static shapes throughout.
+- layers are *stacked* on a leading L axis and applied with ``lax.scan`` —
+  one compiled layer body regardless of depth (fast compiles, XLA-friendly).
+- attention is the pluggable hot op: single-device flash attention
+  (ops/attention.py, Pallas on TPU) or ring attention over the ``seq`` mesh
+  axis for long context (parallel/ring.py).
+- optional ``jax.checkpoint`` rematerialization per layer trades FLOPs for
+  HBM (SURVEY §0 performance notes; standard long-context recipe).
+- matmuls in bfloat16 with fp32 accumulation (MXU-native).
+
+The reference has no model code (SURVEY §2 #19); this is the JAX SPMD
+workload the north star schedules ("a JAX/XLA workload requesting
+tpu-chip: N is placed, bound, and launched").
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops.attention import flash_attention
+from ..parallel.ring import ring_attention_sharded
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1376
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"  # compute dtype; params stay float32
+    remat: bool = False
+    use_ring_attention: bool = False  # sequence parallelism (needs mesh)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# -- init --------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    D, H, F, L, V = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5)
+
+    return {
+        "embed": dense(next(k), (V, D), 1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": dense(next(k), (L, D, H), D),
+            "wk": dense(next(k), (L, D, H), D),
+            "wv": dense(next(k), (L, D, H), D),
+            "wo": dense(next(k), (L, H, D), H),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "w_in": dense(next(k), (L, D, F), D),
+            "w_gate": dense(next(k), (L, D, F), D),
+            "w_out": dense(next(k), (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "unembed": dense(next(k), (D, V), D),
+    }
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# -- building blocks ---------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (B, S, H, Dh); positions: (S,)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    """(B,S,H,Dh) → (B,S,H,Dh), dispatching to ring or flash attention."""
+    qT = q.transpose(0, 2, 1, 3)  # (B,H,S,Dh)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    if cfg.use_ring_attention and mesh is not None:
+        oT = ring_attention_sharded(qT, kT, vT, mesh, causal=True)
+    else:
+        oT = flash_attention(qT, kT, vT, True, None)
+    return oT.transpose(0, 2, 1, 3)
+
+
+def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    """One transformer block. x: (B, S, D)."""
+    B, S, D = x.shape
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    dtype = jnp.dtype(cfg.dtype)
+    p = layer_params
+
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ p["wq"].astype(dtype)).reshape(B, S, Hn, Dh)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hn, Dh)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hn, Dh)
+    positions = jnp.arange(S)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    o = _attention(q, k, v, cfg, mesh).reshape(B, S, Hn * Dh)
+    x = x + (o @ p["wo"].astype(dtype))
+
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+    up = h @ p["w_in"].astype(dtype)
+    x = x + ((gate * up) @ p["w_out"].astype(dtype))
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens: (B, S) int32 → logits (B, S, V)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
+
+    layer_fn = functools.partial(_layer, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, layer_params):
+        return layer_fn(x, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = x @ params["unembed"].astype(dtype)
+    return logits.astype(jnp.float32)

@@ -1,0 +1,200 @@
+"""Flash attention: Pallas TPU kernel with a pure-JAX fallback.
+
+The hot op of the flagship model (models/transformer.py).  TPU-first design
+(/opt/skills/guides/pallas_guide.md): the kernel streams K/V through VMEM,
+keeps a running (max, sum, acc) in fp32, and hits the MXU with
+``preferred_element_type=jnp.float32`` matmuls.  Differentiation uses
+``jax.custom_vjp`` with an LSE-based recompute backward in plain JAX (XLA
+fuses it well; a Pallas backward kernel is a later optimization).
+
+No reference-parity obligation: the reference has no kernels (SURVEY §2 #19).
+On non-TPU backends (tests run on CPU) the fallback implements identical
+math, so the kernel is exercised in interpret mode and numerics are testable
+everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# -- reference implementation (also the CPU fallback) ------------------------
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (out, lse).  Shapes: q,k,v = (B, H, S, D); out same as q;
+    lse = (B, H, S) logsumexp of scaled scores (the flash residual)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    p = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype), lse
+
+
+# -- Pallas TPU kernel -------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
+    """One (batch, head, q-block) program; streams K/V blocks from VMEM."""
+    import jax.experimental.pallas as pl
+
+    block_q = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    q_block_idx = pl.program_id(2)
+    q_offset = q_block_idx * block_q
+
+    num_k_blocks = seq_k // block_k
+
+    def body(j, carry):
+        acc, m_i, l_i = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_ids = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_i - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_i * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m_i, l_i = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (
+        f"seq lengths ({sq},{sk}) must be multiples of blocks ({block_q},{block_k})"
+    )
+    grid = (b, h, sq // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# -- public op with custom VJP ----------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+    """Flash attention.  q,k,v: (batch, heads, seq, head_dim) → out like q."""
+    return _forward(q, k, v, causal, sm_scale)
+
+
+def _forward(q, k, v, causal, sm_scale):
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    if _use_pallas():
+        return _flash_forward_pallas(
+            q, k, v, causal, scale, block_q=128, block_k=128, interpret=False
+        )
+    return mha_reference(q, k, v, causal, scale)[0]
+
+
+def _fwd(q, k, v, causal, sm_scale):
+    out = _forward(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out)
+
+
+def _bwd(causal, sm_scale, res, do):
+    """Recompute backward (standard flash-attention gradient algebra);
+    the LSE is recomputed here rather than saved by the kernel."""
+    q, k, v, out = res
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    p = jnp.exp(logits - lse[..., None])  # (B,H,Sq,Sk)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

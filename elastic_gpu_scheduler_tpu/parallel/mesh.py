@@ -1,0 +1,150 @@
+"""Device-mesh construction: the scheduler → JAX workload bridge.
+
+The scheduler hands a pod its chip allocation as ICI mesh *coordinates* in
+annotations (core/annotations.py).  This module turns that allocation into a
+``jax.sharding.Mesh`` whose axis layout matches the physical ICI links, so
+XLA collectives (psum / all_gather / reduce_scatter / ppermute) ride ICI
+rather than hopping hosts — the placement property the scheduler worked to
+provide (north star, BASELINE.json).
+
+Axis convention for the flagship model (parallel/sharding.py):
+
+    data    — pure data parallelism (gradient psum)
+    fsdp    — fully-sharded data parallel (param all-gather / grad
+              reduce-scatter)
+    tensor  — tensor/model parallelism (Megatron-style sharded matmuls)
+    seq     — sequence/context parallelism (ring attention, parallel/ring.py)
+
+No analogous code exists in the reference (it schedules containers, not
+meshes — SURVEY §2 #19/#20); this is the TPU-native capability that slot
+maps to.
+"""
+
+from __future__ import annotations
+
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.topology import Coord, parse_coord
+
+AXES = ("data", "fsdp", "tensor", "seq")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape: axis name → size.  Product must equal #devices."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    @property
+    def sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "seq": self.seq,
+        }
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+    @classmethod
+    def for_devices(
+        cls, n: int, tensor: int = 1, seq: int = 1, fsdp: Optional[int] = None
+    ) -> "MeshSpec":
+        """Default factoring: given tensor/seq, put the rest in fsdp (or
+        split data×fsdp when ``fsdp`` is given)."""
+        rest, r = divmod(n, tensor * seq)
+        if r:
+            raise ValueError(f"{n} devices not divisible by tensor*seq={tensor*seq}")
+        if fsdp is None:
+            return cls(data=1, fsdp=rest, tensor=tensor, seq=seq)
+        data, r = divmod(rest, fsdp)
+        if r:
+            raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
+        return cls(data=data, fsdp=fsdp, tensor=tensor, seq=seq)
+
+
+def make_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh over the given (or all) devices, ICI-ordered when the
+    devices expose coords (real TPU), enumeration-ordered otherwise (CPU)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) != spec.num_devices:
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices, have {len(devs)}"
+        )
+    devs = _ici_order(devs)
+    arr = np.array(devs, dtype=object).reshape(
+        spec.data, spec.fsdp, spec.tensor, spec.seq
+    )
+    return Mesh(arr, AXES)
+
+
+def _ici_order(devs: list[jax.Device]) -> list[jax.Device]:
+    """Sort devices by physical mesh coordinates when available so adjacent
+    mesh positions are ICI neighbors."""
+
+    def key(d):
+        c = getattr(d, "coords", None)
+        if c is None:
+            return (0, d.id)
+        return (0, *tuple(c), getattr(d, "core_on_chip", 0))
+
+    try:
+        return sorted(devs, key=key)
+    except TypeError:  # heterogeneous keys; keep enumeration order
+        return devs
+
+
+def coords_from_annotations(
+    annotations: dict[str, str], container: str
+) -> list[Coord]:
+    """Parse the scheduler's chip-coordinate annotation for a container."""
+    from ..utils import consts
+
+    raw = annotations.get(consts.ANNOTATION_CONTAINER_PREFIX + container, "")
+    return [parse_coord(p) for p in raw.split(",") if p]
+
+
+def mesh_from_allocation(
+    annotations: dict[str, str],
+    container: str,
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the job's Mesh from its pod's allocation annotations.
+
+    On real TPU hardware, devices whose ``.coords`` match the allocated chip
+    coordinates are selected and laid out in allocation order (the scheduler
+    allocated a contiguous sub-box, so allocation order == ICI order).  When
+    device coords are unavailable (CPU simulation / tests), the first
+    ``spec.num_devices`` devices stand in.
+    """
+    alloc = coords_from_annotations(annotations, container)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    by_coord = {}
+    for d in devs:
+        c = getattr(d, "coords", None)
+        if c is not None:
+            by_coord[tuple(c)] = d
+    chosen: list[jax.Device] = []
+    if alloc and by_coord:
+        for c in alloc:
+            d = by_coord.get(tuple(c))
+            if d is None:
+                break
+            chosen.append(d)
+    if len(chosen) != spec.num_devices:
+        chosen = devs[: spec.num_devices]
+    return make_mesh(spec, chosen)

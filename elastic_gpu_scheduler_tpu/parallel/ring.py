@@ -1,0 +1,128 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context support, TPU-native: Q/K/V are sharded along the sequence axis
+across the ``seq`` devices; K/V shards rotate around the ICI ring via
+``lax.ppermute`` while each device accumulates its queries' attention with
+the numerically-stable running (max, sum, acc) merge — so a sequence N× the
+per-chip memory fits, and every hop is one ICI neighbor transfer (the
+scheduler's contiguous placement makes the ring physical).
+
+No reference analogue (the reference schedules pods; SURVEY §2 #19 maps this
+capability slot to topology-aware placement + this workload-side
+implementation).
+
+Usage: inside ``shard_map`` (``ring_attention``), or let
+``ring_attention_sharded`` wrap it for a mesh with axes (data, fsdp, tensor,
+seq).  Degenerates to one local flash block when the seq axis has size 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import NEG_INF
+
+
+def _block_attend(q, k, v, q_offset, k_offset, causal, scale):
+    """Scaled blockwise attention stats: returns (scores_exp·v, max, sumexp).
+
+    q: (B,H,Sq,D) local queries; k/v: (B,H,Sk,D) a rotating shard.
+    Offsets are the shards' global sequence starts, for causal masking.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        q_ids = q_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_ids = k_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_ids[None, None] >= k_ids[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B,H,Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return pv, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Call inside shard_map with q,k,v sequence-sharded on ``axis_name``.
+
+    Shapes (local): (B, H, S_local, D) → (B, H, S_local, D).
+    """
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    qf = q.astype(jnp.float32)
+    q_offset = my_idx * s_local
+
+    # derive carries from qf so they inherit its varying-axes type (plain
+    # zeros would be "replicated" and fail the fori_loop carry-type check)
+    acc0 = jnp.zeros_like(qf)
+    m0 = jnp.full_like(qf[..., 0], NEG_INF)
+    l0 = jnp.zeros_like(qf[..., 0])
+
+    def step(j, carry):
+        acc, m_i, l_i, k_cur, v_cur = carry
+        src = (my_idx - j) % n  # which shard k_cur/v_cur originated from
+        pv, m_blk, l_blk = _block_attend(
+            qf, k_cur, v_cur, q_offset, src * s_local, causal, scale
+        )
+        m_new = jnp.maximum(m_i, m_blk)
+        alpha = jnp.exp(m_i - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha[..., None] + pv * beta[..., None]
+        l_new = l_i * alpha + l_blk * beta
+
+        # rotate k/v one hop around the ring; the last iteration's rotation
+        # would be discarded, so skip it (saves one full K/V ICI hop per call)
+        def rotate(kv):
+            perm = [(p_, (p_ + 1) % n) for p_ in range(n)]
+            return (
+                lax.ppermute(kv[0], axis_name, perm),
+                lax.ppermute(kv[1], axis_name, perm),
+            )
+
+        k_nxt, v_nxt = lax.cond(j < n - 1, rotate, lambda kv: kv, (k_cur, v_cur))
+        return acc, m_new, l_new, k_nxt, v_nxt
+
+    acc, m_i, l_i, _, _ = lax.fori_loop(
+        0, n, step, (acc0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32))
+    )
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper: (B,H,S,D) with batch on data+fsdp, heads on tensor,
+    sequence on seq."""
+    spec = P(("data", "fsdp"), "tensor", "seq", None)
+    fn = functools.partial(
+        ring_attention, axis_name="seq", causal=causal, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

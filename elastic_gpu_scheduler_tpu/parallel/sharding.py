@@ -1,0 +1,75 @@
+"""Sharding rules for the flagship transformer (GSPMD-style).
+
+The recipe from the public scaling playbook: pick a mesh, annotate array
+shardings with PartitionSpecs, let XLA insert the collectives.
+
+Parameter layout (models/transformer.py pytree):
+
+    embed        (V, D)        → (tensor, fsdp)     vocab-sharded embed
+    layers/*     stacked (L, ...) leaves; per-leaf rules below
+    attn wq/wk/wv (L, D, H)    → (-, fsdp, tensor)  column-parallel
+    attn wo      (L, H, D)     → (-, tensor, fsdp)  row-parallel
+    mlp w_in/w_gate (L, D, F)  → (-, fsdp, tensor)  column-parallel
+    mlp w_out    (L, F, D)     → (-, tensor, fsdp)  row-parallel
+    norms        (L, D)        → replicated
+    unembed      (D, V)        → (fsdp, tensor)
+
+Activations: (batch, seq, d_model) → (("data","fsdp"), "seq", None) — batch
+sharded over data×fsdp, sequence over the seq axis (ring attention handles
+cross-shard attention).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_specs(params: Any) -> Any:
+    """PartitionSpec pytree matching models.transformer.init_params output."""
+
+    def spec_for(path: tuple[str, ...], leaf) -> P:
+        name = "/".join(path)
+        nd = leaf.ndim
+        if "unembed" in name:  # must precede the "embed" substring check
+            return P("fsdp", "tensor")
+        if "embed" in name:
+            return P("tensor", "fsdp")
+        if any(k in name for k in ("wq", "wk", "wv", "w_in", "w_gate")):
+            # stacked over layers: leading L axis unsharded
+            return P(None, "fsdp", "tensor") if nd == 3 else P("fsdp", "tensor")
+        if any(k in name for k in ("wo", "w_out")):
+            return P(None, "tensor", "fsdp") if nd == 3 else P("tensor", "fsdp")
+        return P()  # norms, scalars: replicated
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        keys = tuple(
+            getattr(k, "key", getattr(k, "idx", str(k))) for k in path
+        )
+        specs.append(spec_for(tuple(str(k) for k in keys), leaf))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def batch_spec() -> P:
+    """Tokens/labels (batch, seq): batch over data+fsdp, seq over seq axis."""
+    return P(("data", "fsdp"), "seq")
+
+
+def activation_spec() -> P:
+    """(batch, seq, d_model) activations."""
+    return P(("data", "fsdp"), "seq", None)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    specs = param_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -43,7 +43,7 @@ from typing import Optional
 
 from ..core.request import TPURequest, request_from_pod
 from ..k8s.objects import Pod
-from ..metrics import GANG_EVENTS
+from ..metrics import GANG_COMMIT, GANG_EVENTS
 from ..utils import consts
 from .scheduler import ResourceScheduler, TPUUnitScheduler
 
@@ -87,6 +87,8 @@ class GangCoordinator:
         self._gangs: dict[str, _Gang] = {}
         self._plans: dict[str, _Plan] = {}
         self._lock = threading.Lock()
+        # pod key → last commit duration (post-barrier); benchmark telemetry
+        self.commit_secs: dict[str, float] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -246,7 +248,12 @@ class GangCoordinator:
 
         # barrier tripped: commit this member
         try:
+            t0 = time.perf_counter()
             sched.bind(node, pod)
+            commit_s = time.perf_counter() - t0
+            GANG_COMMIT.observe(value=commit_s)
+            with self._lock:
+                self.commit_secs[pod.key] = commit_s
         except Exception as e:
             with g.cond:
                 if not g.failed:

@@ -35,6 +35,12 @@ from .handlers import Bind, Predicate, Prioritize
 log = logging.getLogger("tpu-scheduler")
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    # Gang binds hold N concurrent connections at the barrier; the stdlib
+    # default backlog of 5 resets connections under a 256-member gang.
+    request_queue_size = 1024
+
+
 class ExtenderServer:
     def __init__(
         self,
@@ -59,6 +65,9 @@ class ExtenderServer:
     def _make_handler(server_self):
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + delayed-ACK costs ~40ms per small JSON response body;
+            # this is a handler attribute (socketserver.StreamRequestHandler)
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):
                 log.debug("http: " + fmt, *args)
@@ -129,7 +138,9 @@ class ExtenderServer:
                 try:
                     with VERB_LATENCY.time(verb):
                         result = fn()
-                    VERB_TOTAL.inc(verb, "ok")
+                    # handler-level failures are returned in-body (Error field)
+                    failed = isinstance(result, dict) and result.get("Error")
+                    VERB_TOTAL.inc(verb, "error" if failed else "ok")
                     self._send_json(200, result)
                 except Exception as e:  # structured 500, never a crash
                     log.exception("%s verb failed", verb)
@@ -142,7 +153,7 @@ class ExtenderServer:
 
     def start(self) -> int:
         """Start serving in a background thread; returns the bound port."""
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _HTTPServer(
             (self.host, self.port), self._make_handler()
         )
         self.port = self._httpd.server_address[1]
@@ -154,7 +165,7 @@ class ExtenderServer:
         return self.port
 
     def serve_forever(self) -> None:
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _HTTPServer(
             (self.host, self.port), self._make_handler()
         )
         self._httpd.serve_forever()

@@ -3,17 +3,25 @@
 JAX-dependent tests run on a virtual 8-device CPU mesh so multi-chip sharding
 is exercised without TPU hardware (the driver separately dry-runs the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the ambient environment pins JAX_PLATFORMS=axon (the TPU tunnel) and a
+sitecustomize pre-imports jax's config module, so the env var must be
+overridden via jax.config.update BEFORE any backend initialization — plain
+os.environ assignment is too late.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
