@@ -1,0 +1,12 @@
+# Two-stage build (reference: Dockerfile:1-18 uses golang → debian-slim; here
+# the runtime is Python + grpc; protobuf messages are pre-generated in-tree).
+FROM python:3.12-slim AS base
+
+RUN pip install --no-cache-dir grpcio protobuf
+
+WORKDIR /app
+COPY elastic_gpu_scheduler_tpu/ elastic_gpu_scheduler_tpu/
+COPY bench.py ./
+
+EXPOSE 39999
+ENTRYPOINT ["python", "-m", "elastic_gpu_scheduler_tpu.cli"]

@@ -1,0 +1,20 @@
+# Reference: Makefile:1-11 (docker build tagged from git describe).
+TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
+IMAGE ?= tpu-elastic-scheduler:$(TAG)
+
+.PHONY: test bench proto image run-fake
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+proto:
+	cd elastic_gpu_scheduler_tpu/deviceplugin && protoc --python_out=. deviceplugin.proto
+
+image:
+	docker build -t $(IMAGE) .
+
+run-fake:
+	python -m elastic_gpu_scheduler_tpu.cli --fake-nodes 4 --priority ici-locality

@@ -1,0 +1,259 @@
+"""TPU kubelet device plugin.
+
+The one near-hardware component (SURVEY §7: "the libtpu device plugin
+replacing the nvidia/NVML agent").  Serves the kubelet DevicePlugin v1beta1
+gRPC API over a unix socket and registers with the kubelet's Registration
+service, advertising ``elasticgpu.io/tpu-chip`` in core units (100 devices
+per chip — fractional-sharing granularity, matching the scheduler's resource
+model, utils/consts.py).
+
+Chip discovery, in order:
+1. real TPU device files (/dev/accel*, the PCI TPU driver's nodes);
+2. a forced count via ``TPU_CHIP_COUNT`` env / constructor arg (simulation);
+Topology coordinates come from the same node labels the scheduler reads
+(LABEL_TPU_HOST_TOPOLOGY/OFFSET via env TPU_HOST_TOPOLOGY/TPU_HOST_OFFSET),
+falling back to a 1-D mesh.
+
+Allocate maps the kubelet-chosen device IDs back to chip coordinates and
+exposes them as ``TPU_VISIBLE_CHIPS`` env plus /dev/accel* device specs — the
+on-node half of the coordinate contract whose other half is the scheduler's
+``elasticgpu.io/container-<name>`` annotation (reference delegates this to
+the sibling Elastic GPU Agent, README.md:30-34; here it's in-repo).
+
+gRPC note: messages are protoc-generated (deviceplugin_pb2.py); service
+stubs are hand-wired with grpc generic handlers since grpcio-tools is not in
+this environment.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from . import deviceplugin_pb2 as pb
+from ..core.topology import Topology, parse_coord, parse_topology
+from ..utils import consts
+
+log = logging.getLogger("tpu-device-plugin")
+
+API_VERSION = "v1beta1"
+KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
+PLUGIN_SOCKET_NAME = "elasticgpu-tpu.sock"
+HEALTHY = "Healthy"
+
+_SVC = "v1beta1.DevicePlugin"
+_REG_SVC = "v1beta1.Registration"
+
+
+def discover_chips(
+    chip_count: int = 0,
+    host_topology: str = "",
+    host_offset: str = "",
+) -> list[tuple[str, str]]:
+    """Returns [(coord_str, device_path)]."""
+    paths = sorted(glob.glob("/dev/accel*"))
+    if chip_count <= 0:
+        chip_count = (
+            len(paths)
+            if paths
+            else int(os.environ.get("TPU_CHIP_COUNT", "0") or 0)
+        )
+    if chip_count <= 0:
+        return []
+    host_topology = host_topology or os.environ.get("TPU_HOST_TOPOLOGY", "")
+    host_offset = host_offset or os.environ.get("TPU_HOST_OFFSET", "")
+    if host_topology:
+        dims = parse_topology(host_topology)
+        topo = Topology(dims)
+        offset = (
+            parse_coord(host_offset) if host_offset else (0,) * len(dims)
+        )
+        coords = [
+            ".".join(str(o + v) for o, v in zip(offset, local))
+            for local in topo.coords()
+        ][:chip_count]
+    else:
+        coords = [str(i) for i in range(chip_count)]
+    out = []
+    for i, c in enumerate(coords):
+        path = paths[i] if i < len(paths) else f"/dev/accel{i}"
+        out.append((c, path))
+    return out
+
+
+class TPUDevicePlugin:
+    """DevicePlugin service implementation."""
+
+    def __init__(
+        self,
+        chips: Optional[list[tuple[str, str]]] = None,
+        core_units_per_chip: int = consts.CORE_PER_CHIP,
+        resource_name: str = consts.RESOURCE_TPU_CORE,
+    ):
+        self.chips = chips if chips is not None else discover_chips()
+        self.core_units = core_units_per_chip
+        self.resource_name = resource_name
+        self._stop = threading.Event()
+        self._server: Optional[grpc.Server] = None
+
+    # -- device model --------------------------------------------------------
+
+    def device_list(self) -> list[pb.Device]:
+        """One device per core unit: ID "<coord>/<unit>" (100 per chip)."""
+        devs = []
+        for coord, _path in self.chips:
+            for u in range(self.core_units):
+                devs.append(pb.Device(ID=f"{coord}/{u}", health=HEALTHY))
+        return devs
+
+    @staticmethod
+    def chip_of_device(device_id: str) -> str:
+        return device_id.split("/", 1)[0]
+
+    # -- rpc implementations -------------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False, get_preferred_allocation_available=False
+        )
+
+    def ListAndWatch(self, request, context):
+        yield pb.ListAndWatchResponse(devices=self.device_list())
+        # then keep the stream open, re-announcing on a slow heartbeat
+        while not self._stop.is_set():
+            if self._stop.wait(10.0):
+                break
+            yield pb.ListAndWatchResponse(devices=self.device_list())
+
+    def Allocate(self, request, context):
+        by_path = dict(self.chips)
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            chip_coords = sorted(
+                {self.chip_of_device(d) for d in creq.devices_i_ds}
+            )
+            cresp = pb.ContainerAllocateResponse()
+            cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(chip_coords)
+            cresp.envs["TPU_CHIP_CORE_UNITS"] = str(
+                len(creq.devices_i_ds)
+            )  # fractional share size in core units
+            for coord in chip_coords:
+                path = by_path.get(coord)
+                if path:
+                    cresp.devices.append(
+                        pb.DeviceSpec(
+                            container_path=path, host_path=path, permissions="rw"
+                        )
+                    )
+            resp.container_responses.append(cresp)
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # -- server wiring -------------------------------------------------------
+
+    def _generic_handler(self):
+        rpcs = {
+            "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+                self.GetDevicePluginOptions,
+                request_deserializer=pb.Empty.FromString,
+                response_serializer=pb.DevicePluginOptions.SerializeToString,
+            ),
+            "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                self.ListAndWatch,
+                request_deserializer=pb.Empty.FromString,
+                response_serializer=pb.ListAndWatchResponse.SerializeToString,
+            ),
+            "Allocate": grpc.unary_unary_rpc_method_handler(
+                self.Allocate,
+                request_deserializer=pb.AllocateRequest.FromString,
+                response_serializer=pb.AllocateResponse.SerializeToString,
+            ),
+            "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+                self.PreStartContainer,
+                request_deserializer=pb.PreStartContainerRequest.FromString,
+                response_serializer=pb.PreStartContainerResponse.SerializeToString,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(_SVC, rpcs)
+
+    def serve(self, socket_path: str) -> grpc.Server:
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        server.add_generic_rpc_handlers((self._generic_handler(),))
+        server.add_insecure_port(f"unix://{socket_path}")
+        server.start()
+        self._server = server
+        log.info(
+            "device plugin serving %d chips (%d devices) on %s",
+            len(self.chips),
+            len(self.chips) * self.core_units,
+            socket_path,
+        )
+        return server
+
+    def stop(self):
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=1)
+
+    def register(
+        self,
+        kubelet_socket: str = KUBELET_SOCKET,
+        endpoint: str = PLUGIN_SOCKET_NAME,
+    ) -> None:
+        """Register with the kubelet's Registration service."""
+        with grpc.insecure_channel(f"unix://{kubelet_socket}") as ch:
+            register = ch.unary_unary(
+                f"/{_REG_SVC}/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString,
+            )
+            register(
+                pb.RegisterRequest(
+                    version=API_VERSION,
+                    endpoint=endpoint,
+                    resource_name=self.resource_name,
+                    options=pb.DevicePluginOptions(),
+                ),
+                timeout=10,
+            )
+        log.info("registered %s with kubelet", self.resource_name)
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin wrapper
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-device-plugin")
+    p.add_argument("--plugin-dir", default="/var/lib/kubelet/device-plugins")
+    p.add_argument("--chip-count", type=int, default=0)
+    p.add_argument("--no-register", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    chips = discover_chips(chip_count=args.chip_count)
+    plugin = TPUDevicePlugin(chips=chips)
+    sock = os.path.join(args.plugin_dir, PLUGIN_SOCKET_NAME)
+    plugin.serve(sock)
+    if not args.no_register:
+        plugin.register(
+            kubelet_socket=os.path.join(args.plugin_dir, "kubelet.sock")
+        )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        plugin.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

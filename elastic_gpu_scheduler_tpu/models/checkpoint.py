@@ -1,0 +1,61 @@
+"""Checkpoint / resume for training jobs (orbax-backed).
+
+The scheduler's durable state is the pod-annotation ledger (core/
+annotations.py — crash-safe restart, mirroring the reference); the workload's
+durable state is this: params + optimizer state + step, saved via orbax so a
+rescheduled/preempted pod resumes where it left off.  Sharded arrays are
+saved/restored with their shardings (orbax handles jax.sharding natively).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger("tpu-launcher")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
+        )
+
+    def save(self, params: Any, opt_state: Any, step: int) -> None:
+        self.manager.save(
+            step,
+            args=self._ocp.args.Composite(
+                params=self._ocp.args.StandardSave(params),
+                opt_state=self._ocp.args.StandardSave(opt_state),
+            ),
+        )
+        self.manager.wait_until_finished()
+        log.info("checkpoint saved at step %d", step)
+
+    def restore(
+        self, params_template: Any, opt_state_template: Any
+    ) -> Optional[tuple[Any, Any, int]]:
+        """Restore the latest checkpoint, or None if none exists.
+
+        Templates provide structure/shardings for sharded restore."""
+        step = self.manager.latest_step()
+        if step is None:
+            return None
+        restored = self.manager.restore(
+            step,
+            args=self._ocp.args.Composite(
+                params=self._ocp.args.StandardRestore(params_template),
+                opt_state=self._ocp.args.StandardRestore(opt_state_template),
+            ),
+        )
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self.manager.close()

@@ -1,0 +1,142 @@
+"""Device plugin tests: a fake kubelet drives the real gRPC surface over a
+unix socket — registration, ListAndWatch, Allocate, PreStartContainer."""
+
+import os
+import queue
+import tempfile
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from elastic_gpu_scheduler_tpu.deviceplugin import deviceplugin_pb2 as pb
+from elastic_gpu_scheduler_tpu.deviceplugin.plugin import (
+    API_VERSION,
+    PLUGIN_SOCKET_NAME,
+    TPUDevicePlugin,
+    discover_chips,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+class FakeKubelet:
+    """Registration service end of the contract."""
+
+    def __init__(self, socket_path):
+        self.requests = queue.Queue()
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration",
+            {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    self._register,
+                    request_deserializer=pb.RegisterRequest.FromString,
+                    response_serializer=pb.Empty.SerializeToString,
+                )
+            },
+        )
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"unix://{socket_path}")
+        self.server.start()
+
+    def _register(self, request, context):
+        self.requests.put(request)
+        return pb.Empty()
+
+    def stop(self):
+        self.server.stop(grace=1)
+
+
+@pytest.fixture()
+def plugin_env():
+    with tempfile.TemporaryDirectory() as d:
+        kubelet_sock = os.path.join(d, "kubelet.sock")
+        plugin_sock = os.path.join(d, PLUGIN_SOCKET_NAME)
+        kubelet = FakeKubelet(kubelet_sock)
+        chips = discover_chips(
+            chip_count=4, host_topology="2x2", host_offset="0.2"
+        )
+        plugin = TPUDevicePlugin(chips=chips)
+        plugin.serve(plugin_sock)
+        yield kubelet, plugin, kubelet_sock, plugin_sock
+        plugin.stop()
+        kubelet.stop()
+
+
+def _dp_channel(plugin_sock):
+    return grpc.insecure_channel(f"unix://{plugin_sock}")
+
+
+def test_discover_chips_topology():
+    chips = discover_chips(chip_count=4, host_topology="2x2", host_offset="1.2")
+    assert [c for c, _ in chips] == ["1.2", "1.3", "2.2", "2.3"]
+    flat = discover_chips(chip_count=2)
+    assert [c for c, _ in flat] == ["0", "1"]
+    assert discover_chips(chip_count=0) == []  # nothing visible → empty
+
+
+def test_register_with_kubelet(plugin_env):
+    kubelet, plugin, kubelet_sock, plugin_sock = plugin_env
+    plugin.register(kubelet_socket=kubelet_sock)
+    req = kubelet.requests.get(timeout=5)
+    assert req.version == API_VERSION
+    assert req.resource_name == consts.RESOURCE_TPU_CORE
+    assert req.endpoint == PLUGIN_SOCKET_NAME
+
+
+def test_list_and_watch_advertises_core_units(plugin_env):
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        stream = ch.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty(), timeout=5)
+        first = next(iter(stream))
+    assert len(first.devices) == 4 * consts.CORE_PER_CHIP
+    ids = {d.ID for d in first.devices}
+    assert "0.2/0" in ids and "1.3/99" in ids
+    assert all(d.health == "Healthy" for d in first.devices)
+
+
+def test_allocate_maps_devices_to_chip_coords(plugin_env):
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        allocate = ch.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        # 50 units on chip 0.2 + 100 units on chip 0.3 (fractional + whole)
+        ids = [f"0.2/{u}" for u in range(50)] + [f"0.3/{u}" for u in range(100)]
+        resp = allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devices_i_ds=ids)
+                ]
+            ),
+            timeout=5,
+        )
+    cresp = resp.container_responses[0]
+    assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0.2,0.3"
+    assert cresp.envs["TPU_CHIP_CORE_UNITS"] == "150"
+    assert len(cresp.devices) == 2
+    assert all(d.permissions == "rw" for d in cresp.devices)
+
+
+def test_options_and_prestart(plugin_env):
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        opts = ch.unary_unary(
+            "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )(pb.Empty(), timeout=5)
+        assert opts.pre_start_required is False
+        pre = ch.unary_unary(
+            "/v1beta1.DevicePlugin/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )(pb.PreStartContainerRequest(devices_i_ds=["0.2/0"]), timeout=5)
+        assert pre is not None
