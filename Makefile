@@ -18,3 +18,6 @@ image:
 
 run-fake:
 	python -m elastic_gpu_scheduler_tpu.cli --fake-nodes 4 --priority ici-locality
+
+native:
+	python -c "from elastic_gpu_scheduler_tpu.core.native import build; print(build(force=True))"

@@ -144,6 +144,17 @@ class ChipSet:
 
     # -- candidate generation ------------------------------------------------
 
+    # meshes at/above this size route box enumeration to the C++ extension
+    NATIVE_THRESHOLD = 16
+
+    def _free_mask(self) -> bytes:
+        """Row-major 0/1 mask over the FULL mesh (unowned coords = 0)."""
+        mask = bytearray(self.topo.num_chips)
+        for c in self.chips.values():
+            if c.is_free:
+                mask[self.topo.index(c.coord)] = 1
+        return bytes(mask)
+
     def _whole_chip_candidates(
         self, count: int, max_candidates: int
     ) -> Iterator[tuple[tuple[Coord, ...], bool]]:
@@ -153,11 +164,33 @@ class ChipSet:
         (most compact shapes first), then one non-contiguous fallback taking
         free chips in canonical order — so a fragmented mesh still schedules,
         just with a locality penalty applied by the rater.
+
+        Large meshes use the native C++ enumerator (core/native.py); results
+        are identical to the Python path (tests/test_native.py).
         """
         free = {c.coord for c in self.free_chips()}
         if len(free) < count:
             return
         emitted = 0
+        if self.topo.num_chips >= self.NATIVE_THRESHOLD:
+            from .native import get_placement
+
+            native = get_placement()
+            if native is not None:
+                boxes = native.enumerate_free_boxes(
+                    self.topo.dims,
+                    self.topo.wrap,
+                    self._free_mask(),
+                    count,
+                    max_candidates,
+                )
+                for idx_box in boxes:
+                    emitted += 1
+                    yield tuple(self.topo.coord_of(i) for i in idx_box), True
+                if emitted == 0:
+                    fallback = tuple(sorted(free, key=self.topo.index)[:count])
+                    yield fallback, False
+                return
         seen: set[frozenset] = set()
         for shape in self.topo.box_shapes(count):
             for box in self.topo.placements(shape):

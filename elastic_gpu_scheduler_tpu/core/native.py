@@ -1,0 +1,84 @@
+"""Lazy loader/builder for the native placement extension.
+
+Builds native/placement.cc into a CPython extension on first use (g++ is in
+the image; pybind11/grpcio-tools are not, so the module uses the raw CPython
+API and is compiled with a single g++ invocation).  Every caller must treat
+``get_placement() is None`` as "use the Python fallback" — results of the two
+paths are bit-identical (tests/test_native.py asserts it).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+
+log = logging.getLogger("tpu-scheduler")
+
+_lock = threading.Lock()
+_loaded = False
+_module = None
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native_build")
+
+
+def _source_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    return os.path.join(repo, "native", "placement.cc")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the extension; returns the .so path or None on failure."""
+    src = _source_path()
+    if not os.path.exists(src):
+        return None
+    out_dir = _build_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(out_dir, f"_placement{suffix}")
+    if (
+        not force
+        and os.path.exists(so)
+        and os.path.getmtime(so) >= os.path.getmtime(src)
+    ):
+        return so
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", so,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return so
+    except Exception as e:  # missing toolchain, etc. → Python fallback
+        log.debug("native placement build failed: %s", e)
+        return None
+
+
+def get_placement():
+    """The _placement module, or None if unavailable."""
+    global _loaded, _module
+    if _loaded:
+        return _module
+    with _lock:
+        if _loaded:
+            return _module
+        try:
+            so = build()
+            if so is not None:
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location("_placement", so)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _module = mod
+                log.info("native placement search loaded (%s)", so)
+        except Exception as e:  # pragma: no cover
+            log.debug("native placement unavailable: %s", e)
+            _module = None
+        _loaded = True
+        return _module

@@ -195,12 +195,14 @@ def _box_shapes_cached(
     rec((), count, 0)
 
     def compactness(shape: tuple[int, ...]) -> tuple:
-        # surface area of the box (lower = more compact), then max dim
+        # surface area of the box (lower = more compact), then max dim, then
+        # the dims themselves — the FULL key, so equal-compactness ties are
+        # deterministic and identical to the native enumerator's ordering
         vol = int(np.prod(shape))
         surf = sum(
             2 * vol // s for s in shape
         )  # proportional surface; exact enough for ordering
-        return (surf, max(shape))
+        return (surf, max(shape), shape)
 
     out = sorted(shapes, key=compactness)
     return out[:max_shapes]

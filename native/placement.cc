@@ -1,0 +1,218 @@
+// Native contiguous-placement search for large ICI meshes.
+//
+// The combinatorial hot path of the scheduler (SURVEY §7 hard part (a):
+// "contiguous sub-slice search on a 3D torus is genuinely combinatorial —
+// the reference's naive DFS won't scale to 256 chips").  This module
+// implements the same canonical enumeration as core/topology.py
+// (box_shapes × placements filtered by a free mask), in C++ for slices with
+// hundreds-to-thousands of chips.  Python keeps an identical fallback; the
+// extension is loaded lazily (core/native.py) and results are
+// bit-identical so either path can serve any request.
+//
+// CPython C API only (no pybind11 in this environment).
+//
+// Exposed function:
+//   enumerate_free_boxes(dims: tuple[int], wrap: tuple[bool], free: bytes,
+//                        count: int, max_out: int) -> list[tuple[int, ...]]
+// `free` is one byte per row-major chip index (0/1).  Returns up to max_out
+// boxes as tuples of row-major indices, most-compact shapes first — the
+// exact contract of Topology.box_shapes + placements.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Shape {
+  std::vector<long> dims;
+  long surface;  // compactness key (proportional surface area)
+  long maxdim;
+};
+
+void shapes_rec(const std::vector<long>& mesh, long remaining, size_t axis,
+                std::vector<long>& prefix, std::vector<Shape>* out) {
+  if (axis == mesh.size() - 1) {
+    if (remaining <= mesh[axis]) {
+      Shape s;
+      s.dims = prefix;
+      s.dims.push_back(remaining);
+      long vol = 1;
+      for (long d : s.dims) vol *= d;
+      s.surface = 0;
+      s.maxdim = 0;
+      for (long d : s.dims) {
+        s.surface += 2 * vol / d;
+        s.maxdim = std::max(s.maxdim, d);
+      }
+      out->push_back(std::move(s));
+    }
+    return;
+  }
+  for (long f = 1; f <= remaining && f <= mesh[axis]; ++f) {
+    if (remaining % f) continue;
+    prefix.push_back(f);
+    shapes_rec(mesh, remaining / f, axis + 1, prefix, out);
+    prefix.pop_back();
+  }
+}
+
+// Enumerate all boxes of `shape` placed at every valid origin; append
+// row-major index vectors for fully-free boxes to `out`.
+void place_shape(const std::vector<long>& mesh, const std::vector<bool>& wrap,
+                 const std::vector<long>& strides, const uint8_t* free_mask,
+                 const std::vector<long>& shape, size_t max_out,
+                 std::vector<std::vector<long>>* out) {
+  size_t nd = mesh.size();
+  std::vector<long> origin_limit(nd);
+  for (size_t i = 0; i < nd; ++i) {
+    origin_limit[i] =
+        (wrap[i] && shape[i] < mesh[i]) ? mesh[i] : mesh[i] - shape[i] + 1;
+    if (origin_limit[i] <= 0) return;
+  }
+  // iterate origins (odometer)
+  std::vector<long> origin(nd, 0);
+  // precompute per-shape offsets once per origin via odometer over shape
+  std::vector<long> off(nd, 0);
+  std::vector<long> box;
+  long vol = 1;
+  for (long d : shape) vol *= d;
+  box.reserve(vol);
+  while (true) {
+    // collect box at this origin
+    box.clear();
+    bool ok = true;
+    std::fill(off.begin(), off.end(), 0);
+    while (true) {
+      long idx = 0;
+      for (size_t i = 0; i < nd; ++i) {
+        long v = origin[i] + off[i];
+        if (wrap[i]) v %= mesh[i];
+        idx += v * strides[i];
+      }
+      if (!free_mask[idx]) {
+        ok = false;
+        break;
+      }
+      box.push_back(idx);
+      // bump shape odometer
+      size_t a = nd;
+      while (a > 0) {
+        --a;
+        if (++off[a] < shape[a]) break;
+        off[a] = 0;
+        if (a == 0) goto box_done;
+      }
+      if (nd == 0) break;
+    }
+  box_done:
+    if (ok && (long)box.size() == vol) {
+      std::sort(box.begin(), box.end());
+      out->push_back(box);
+      if (out->size() >= max_out) return;
+    }
+    // bump origin odometer
+    size_t a = nd;
+    bool done = true;
+    while (a > 0) {
+      --a;
+      if (++origin[a] < origin_limit[a]) {
+        done = false;
+        break;
+      }
+      origin[a] = 0;
+    }
+    if (done) return;
+  }
+}
+
+PyObject* enumerate_free_boxes(PyObject*, PyObject* args) {
+  PyObject* dims_obj;
+  PyObject* wrap_obj;
+  Py_buffer free_buf;
+  long count, max_out;
+  if (!PyArg_ParseTuple(args, "O!O!y*ll", &PyTuple_Type, &dims_obj,
+                        &PyTuple_Type, &wrap_obj, &free_buf, &count,
+                        &max_out)) {
+    return nullptr;
+  }
+  size_t nd = PyTuple_GET_SIZE(dims_obj);
+  std::vector<long> mesh(nd);
+  std::vector<bool> wrap(nd, false);
+  long total = 1;
+  for (size_t i = 0; i < nd; ++i) {
+    mesh[i] = PyLong_AsLong(PyTuple_GET_ITEM(dims_obj, i));
+    total *= mesh[i];
+  }
+  if ((size_t)PyTuple_GET_SIZE(wrap_obj) == nd) {
+    for (size_t i = 0; i < nd; ++i) {
+      wrap[i] = PyObject_IsTrue(PyTuple_GET_ITEM(wrap_obj, i));
+    }
+  }
+  if (free_buf.len < total || count <= 0 || max_out <= 0) {
+    PyBuffer_Release(&free_buf);
+    if (count <= 0 || max_out <= 0) return PyList_New(0);
+    PyErr_SetString(PyExc_ValueError, "free mask shorter than mesh volume");
+    return nullptr;
+  }
+  std::vector<long> strides(nd, 1);
+  for (size_t i = nd; i-- > 1;) strides[i - 1] = strides[i] * mesh[i];
+
+  std::vector<Shape> shapes;
+  std::vector<long> prefix;
+  shapes_rec(mesh, count, 0, prefix, &shapes);
+  std::sort(shapes.begin(), shapes.end(), [](const Shape& a, const Shape& b) {
+    if (a.surface != b.surface) return a.surface < b.surface;
+    if (a.maxdim != b.maxdim) return a.maxdim < b.maxdim;
+    return a.dims < b.dims;
+  });
+
+  std::vector<std::vector<long>> found;
+  const uint8_t* mask = static_cast<const uint8_t*>(free_buf.buf);
+  std::vector<std::vector<long>> seen;  // dedupe identical index sets
+  for (const Shape& s : shapes) {
+    std::vector<std::vector<long>> batch;
+    place_shape(mesh, wrap, strides, mask, s.dims,
+                (size_t)max_out - found.size() + 64, &batch);
+    for (auto& b : batch) {
+      bool dup = false;
+      for (const auto& f : found) {
+        if (f == b) {
+          dup = true;
+          break;
+        }
+      }
+      if (!dup) found.push_back(std::move(b));
+      if (found.size() >= (size_t)max_out) break;
+    }
+    if (found.size() >= (size_t)max_out) break;
+  }
+  PyBuffer_Release(&free_buf);
+
+  PyObject* result = PyList_New(found.size());
+  if (!result) return nullptr;
+  for (size_t i = 0; i < found.size(); ++i) {
+    PyObject* tup = PyTuple_New(found[i].size());
+    for (size_t j = 0; j < found[i].size(); ++j) {
+      PyTuple_SET_ITEM(tup, j, PyLong_FromLong(found[i][j]));
+    }
+    PyList_SET_ITEM(result, i, tup);
+  }
+  return result;
+}
+
+PyMethodDef methods[] = {
+    {"enumerate_free_boxes", enumerate_free_boxes, METH_VARARGS,
+     "enumerate contiguous free sub-boxes, compact-first"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {PyModuleDef_HEAD_INIT, "_placement",
+                      "native contiguous placement search", -1, methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__placement(void) { return PyModule_Create(&module); }
