@@ -1,0 +1,88 @@
+"""Mixture-of-Experts FFN with expert parallelism (Switch-style top-1).
+
+TPU-first formulation: routing is expressed as dense one-hot dispatch/combine
+einsums (the GSPMD MoE pattern) so XLA lowers it to MXU matmuls plus an
+all-to-all over the ``expert`` mesh axis — no gathers/scatters with dynamic
+shapes.  Capacity-factor token dropping keeps every shape static.
+
+Expert weights carry a leading E axis sharded over the ``expert`` mesh axis
+(parallel/sharding.py); with E experts over ``expert``-axis devices, each
+device holds E/expert-size experts and XLA inserts the dispatch all-to-all.
+
+No reference analogue (the reference schedules pods; SURVEY §2 #19) — this
+is workload-plane capability, the EP slot of dp/fsdp/ep/pp/tp/sp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_ffn(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_in: jax.Array,
+    w_gate: jax.Array,
+    w_out: jax.Array,
+    capacity_factor: float = 1.25,
+    dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    """Switch-style MoE feed-forward.
+
+    x:      (B, S, D) tokens
+    gate_w: (D, E)    router
+    w_in/w_gate: (E, D, F); w_out: (E, F, D)  — expert-stacked SwiGLU FFN
+    Returns (output (B,S,D), aux_loss scalar) — aux is the load-balancing
+    loss (mean_prob · mean_assignment · E), the standard Switch auxiliary.
+    """
+    B, S, D = x.shape
+    E = gate_w.shape[-1]
+    tokens = B * S
+    capacity = max(1, int(capacity_factor * tokens / E))
+
+    xf = x.reshape(tokens, D)
+    logits = (xf @ gate_w.astype(x.dtype)).astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
+    expert_prob = jnp.max(probs, axis=-1)  # (T,)
+
+    # position of each token within its expert's queue (static shapes)
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (T, E)
+    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where assigned
+    pos_in_expert = jnp.sum(position, axis=-1) - 1  # (T,), -1 if unassigned
+    kept = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+
+    # dispatch/combine tensors (T, E, C)
+    dispatch = (
+        jax.nn.one_hot(expert_idx, E, dtype=x.dtype)[:, :, None]
+        * jax.nn.one_hot(jnp.clip(pos_in_expert, 0, capacity - 1), capacity,
+                         dtype=x.dtype)[:, None, :]
+        * kept[:, None, None].astype(x.dtype)
+    )
+    combine = dispatch * expert_prob[:, None, None].astype(x.dtype)
+
+    # route to experts: (E, C, D)
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch, xf, preferred_element_type=jnp.float32
+    ).astype(dtype)
+    # expert SwiGLU, batched over the (sharded) E axis
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dtype))
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(dtype))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", gate * up, w_out.astype(dtype)
+    )
+    # combine back: (T, D)
+    out = jnp.einsum(
+        "tec,ecd->td", combine, expert_out.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+    # Switch load-balancing auxiliary loss
+    density = jnp.mean(onehot.astype(jnp.float32), axis=0)  # fraction routed
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    return out.reshape(B, S, D), aux

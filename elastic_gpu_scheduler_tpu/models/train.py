@@ -17,7 +17,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import sharding as shardlib
-from .transformer import TransformerConfig, forward, init_params
+from .transformer import TransformerConfig, forward_with_aux, init_params
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -39,8 +39,11 @@ def loss_fn(
     targets = tokens[:, 1:]
     if mesh is not None:
         inputs = shardlib.constrain(inputs, mesh, shardlib.batch_spec())
-    logits = forward(params, inputs, cfg, mesh=mesh)
-    return cross_entropy_loss(logits, targets)
+    logits, aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
+    loss = cross_entropy_loss(logits, targets)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.aux_loss_weight * aux
+    return loss
 
 
 def make_train_step(
@@ -69,7 +72,8 @@ def init_sharded_state(
     given."""
     params = init_params(key, cfg)
     if mesh is not None:
-        params = shardlib.shard_params(params, mesh)
+        pipelined = cfg.n_microbatches > 0 and mesh.shape.get("pipe", 1) > 1
+        params = shardlib.shard_params(params, mesh, pipeline=pipelined)
     opt_state = optimizer.init(params)
     return params, opt_state
 

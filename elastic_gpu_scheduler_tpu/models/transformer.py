@@ -44,6 +44,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # compute dtype; params stay float32
     remat: bool = False
     use_ring_attention: bool = False  # sequence parallelism (needs mesh)
+    n_experts: int = 0  # >0 → MoE FFN (models/moe.py), expert-parallel
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    n_microbatches: int = 0  # >0 + mesh pipe>1 → pipeline parallelism
 
     @property
     def head_dim(self) -> int:
@@ -60,19 +64,31 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5)
 
-    return {
-        "embed": dense(next(k), (V, D), 1.0),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), jnp.float32),
-            "wq": dense(next(k), (L, D, H), D),
-            "wk": dense(next(k), (L, D, H), D),
-            "wv": dense(next(k), (L, D, H), D),
-            "wo": dense(next(k), (L, H, D), H),
-            "mlp_norm": jnp.ones((L, D), jnp.float32),
+    layers = {
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "wq": dense(next(k), (L, D, H), D),
+        "wk": dense(next(k), (L, D, H), D),
+        "wv": dense(next(k), (L, D, H), D),
+        "wo": dense(next(k), (L, H, D), H),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update({
+            "moe_gate": dense(next(k), (L, D, E), D),
+            "w_in": dense(next(k), (L, E, D, F), D),
+            "w_gate": dense(next(k), (L, E, D, F), D),
+            "w_out": dense(next(k), (L, E, F, D), F),
+        })
+    else:
+        layers.update({
             "w_in": dense(next(k), (L, D, F), D),
             "w_gate": dense(next(k), (L, D, F), D),
             "w_out": dense(next(k), (L, F, D), F),
-        },
+        })
+    return {
+        "embed": dense(next(k), (V, D), 1.0),
+        "layers": layers,
         "final_norm": jnp.ones((D,), jnp.float32),
         "unembed": dense(next(k), (D, V), D),
     }
@@ -117,7 +133,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
 
 
 def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
-    """One transformer block. x: (B, S, D)."""
+    """One transformer block. x: (B, S, D).  Returns (x, aux_loss)."""
     B, S, D = x.shape
     Hn, Dh = cfg.n_heads, cfg.head_dim
     dtype = jnp.dtype(cfg.dtype)
@@ -134,10 +150,64 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
     x = x + (o @ p["wo"].astype(dtype))
 
     h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-    up = h @ p["w_in"].astype(dtype)
-    x = x + ((gate * up) @ p["w_out"].astype(dtype))
-    return x
+    if cfg.n_experts > 0:
+        from .moe import moe_ffn
+
+        ffn, aux = moe_ffn(
+            h, p["moe_gate"], p["w_in"], p["w_gate"], p["w_out"],
+            capacity_factor=cfg.capacity_factor, dtype=dtype,
+        )
+        x = x + ffn
+    else:
+        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+        up = h @ p["w_in"].astype(dtype)
+        x = x + ((gate * up) @ p["w_out"].astype(dtype))
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
+
+
+def forward_with_aux(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """tokens: (B, S) int32 → (logits (B, S, V), aux_loss scalar)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
+
+    pipelined = (
+        cfg.n_microbatches > 0
+        and mesh is not None
+        and mesh.shape.get("pipe", 1) > 1
+    )
+    # inside the pipeline's manual shard_map, attention must be plain flash
+    # (ring attention's own shard_map does not nest under pp; see
+    # parallel/pipeline.py composition note)
+    layer_fn = functools.partial(
+        _layer, cfg=cfg, mesh=None if pipelined else mesh
+    )
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    if pipelined:
+        from ..parallel.pipeline import microbatch, pipeline_apply, unmicrobatch
+
+        xm = microbatch(x, cfg.n_microbatches)
+        ym, aux_total = pipeline_apply(
+            lambda h, lp: layer_fn(h, lp), params["layers"], xm, mesh
+        )
+        x = unmicrobatch(ym)
+    else:
+        def scan_body(x, layer_params):
+            x, aux = layer_fn(x, layer_params)
+            return x, aux
+
+        x, aux = lax.scan(scan_body, x, params["layers"])
+        aux_total = jnp.sum(aux)
+    x = rms_norm(x, params["final_norm"])
+    logits = x @ params["unembed"].astype(dtype)
+    return logits.astype(jnp.float32), aux_total
 
 
 def forward(
@@ -147,17 +217,4 @@ def forward(
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """tokens: (B, S) int32 → logits (B, S, V)."""
-    dtype = jnp.dtype(cfg.dtype)
-    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
-
-    layer_fn = functools.partial(_layer, cfg=cfg, mesh=mesh)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
-
-    def scan_body(x, layer_params):
-        return layer_fn(x, layer_params), None
-
-    x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"])
-    logits = x @ params["unembed"].astype(dtype)
-    return logits.astype(jnp.float32)
+    return forward_with_aux(params, tokens, cfg, mesh)[0]

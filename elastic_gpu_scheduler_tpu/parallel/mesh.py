@@ -12,6 +12,8 @@ Axis convention for the flagship model (parallel/sharding.py):
     data    — pure data parallelism (gradient psum)
     fsdp    — fully-sharded data parallel (param all-gather / grad
               reduce-scatter)
+    expert  — expert parallelism for MoE layers (models/moe.py)
+    pipe    — pipeline parallelism over layer groups (parallel/pipeline.py)
     tensor  — tensor/model parallelism (Megatron-style sharded matmuls)
     seq     — sequence/context parallelism (ring attention, parallel/ring.py)
 
@@ -32,7 +34,7 @@ from jax.sharding import Mesh
 
 from ..core.topology import Coord, parse_coord
 
-AXES = ("data", "fsdp", "tensor", "seq")
+AXES = ("data", "fsdp", "expert", "pipe", "tensor", "seq")
 
 
 @dataclass(frozen=True)
@@ -41,6 +43,8 @@ class MeshSpec:
 
     data: int = 1
     fsdp: int = 1
+    expert: int = 1
+    pipe: int = 1
     tensor: int = 1
     seq: int = 1
 
@@ -49,13 +53,18 @@ class MeshSpec:
         return {
             "data": self.data,
             "fsdp": self.fsdp,
+            "expert": self.expert,
+            "pipe": self.pipe,
             "tensor": self.tensor,
             "seq": self.seq,
         }
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq
+        return (
+            self.data * self.fsdp * self.expert * self.pipe * self.tensor
+            * self.seq
+        )
 
     @classmethod
     def for_devices(
@@ -86,7 +95,7 @@ def make_mesh(
         )
     devs = _ici_order(devs)
     arr = np.array(devs, dtype=object).reshape(
-        spec.data, spec.fsdp, spec.tensor, spec.seq
+        spec.data, spec.fsdp, spec.expert, spec.pipe, spec.tensor, spec.seq
     )
     return Mesh(arr, AXES)
 

@@ -1,0 +1,117 @@
+"""Pipeline parallelism (GPipe-style) over the ``pipe`` mesh axis.
+
+Layers are stacked on a leading L axis (models/transformer.py); sharding L
+over ``pipe`` gives each stage L/PP layers.  Microbatches march through the
+stages with one ``lax.ppermute`` hop per step — the classic GPipe schedule
+with M + PP - 1 steps and bubble fraction (PP-1)/(M+PP-1).
+
+Composition: the shard_map here is *manual only over pipe*; all other mesh
+axes (data/fsdp/expert/tensor) stay automatic, so XLA keeps sharding the
+per-stage matmuls and MoE dispatch as usual.  Sequence parallelism (ring
+attention, its own shard_map) does not nest inside the pipeline in this
+version — pp composes with dp/fsdp/ep/tp; sp composes with everything except
+pp.
+
+No reference analogue (SURVEY §2 #19): this is the PP slot of the workload
+plane's dp/fsdp/ep/pp/tp/sq axes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    layer_fn: Callable,  # (x_mb, layer_params) -> (x_mb, aux_scalar)
+    stacked_params,  # pytree, leaves (L, ...) with L % pp == 0
+    x: jax.Array,  # (M, mb, S, D) microbatched activations
+    mesh: Mesh,
+) -> tuple[jax.Array, jax.Array]:
+    """Run all layers over all microbatches; returns (y (M,mb,S,D), aux)."""
+    pp = mesh.shape["pipe"]
+    if pp == 1:
+        def scan_body(h, lp):
+            h2, aux = layer_fn(h, lp)
+            return h2, aux
+
+        M = x.shape[0]
+        flat = x.reshape((-1,) + x.shape[2:])
+        out, aux = lax.scan(scan_body, flat, stacked_params)
+        return out.reshape(x.shape), jnp.sum(aux)
+
+    M = x.shape[0]
+    T = M + pp - 1
+
+    def stage_fn(params_local, x_mb):
+        stage = lax.axis_index("pipe")
+        vary = lambda a: lax.pcast(a, "pipe", to="varying")
+
+        def run_layers(h):
+            def body(h, lp):
+                h2, aux = layer_fn(h, lp)
+                return h2, aux
+
+            h, aux = lax.scan(body, h, params_local)
+            return h, jnp.sum(aux)
+
+        state0 = vary(jnp.zeros(x_mb.shape[1:], x_mb.dtype))
+        outputs0 = vary(jnp.zeros_like(x_mb))
+        aux0 = vary(jnp.zeros((), jnp.float32))
+
+        def step(t, carry):
+            state, outputs, aux_total = carry
+            # stage 0 ingests microbatch t
+            inject = x_mb[jnp.where(t < M, t, 0)]
+            state = jnp.where(stage == 0, vary(inject), state)
+            state, aux = run_layers(state)
+            # this stage held microbatch (t - stage); is it a real one?
+            mb_idx = t - stage
+            valid = (mb_idx >= 0) & (mb_idx < M)
+            aux_total = aux_total + jnp.where(valid, aux, 0.0)
+            # last stage emits microbatch t - (pp - 1)
+            out_idx = t - (pp - 1)
+            write = (stage == pp - 1) & (out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, M - 1)
+            updated = lax.dynamic_update_index_in_dim(outputs, state, slot, 0)
+            outputs = jnp.where(write, updated, outputs)
+            # advance the pipeline one hop
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            state = lax.ppermute(state, "pipe", perm)
+            return state, outputs, aux_total
+
+        _, outputs, aux_total = lax.fori_loop(
+            0, T, step, (state0, outputs0, aux0)
+        )
+        # results live on the last stage; zero elsewhere → psum broadcasts
+        is_last = (stage == pp - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * is_last, "pipe")
+        aux_total = lax.psum(
+            aux_total * (stage >= 0), "pipe"
+        )  # every stage contributed its own layers' aux
+        return outputs, aux_total
+
+    y, aux = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P("pipe"), P()),
+        out_specs=(P(), P()),
+        axis_names={"pipe"},
+    )(stacked_params, x)
+    return y, aux
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) → (M, B/M, ...)."""
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by {n_micro} microbatches")
+    return x.reshape((n_micro, B // n_micro) + x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    return x.reshape((-1,) + x.shape[2:])

@@ -27,22 +27,38 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_specs(params: Any) -> Any:
-    """PartitionSpec pytree matching models.transformer.init_params output."""
+def param_specs(params: Any, pipeline: bool = False) -> Any:
+    """PartitionSpec pytree matching models.transformer.init_params output.
+
+    With ``pipeline=True`` the stacked layer axis (leading L) is sharded over
+    the ``pipe`` mesh axis so each pipeline stage owns its layer group
+    (parallel/pipeline.py)."""
+
+    lead = "pipe" if pipeline else None
 
     def spec_for(path: tuple[str, ...], leaf) -> P:
         name = "/".join(path)
         nd = leaf.ndim
+        in_layers = "layers" in name
         if "unembed" in name:  # must precede the "embed" substring check
             return P("fsdp", "tensor")
         if "embed" in name:
             return P("tensor", "fsdp")
+        if "moe_gate" in name:
+            return P(lead) if in_layers else P()  # router: replicated
         if any(k in name for k in ("wq", "wk", "wv", "w_in", "w_gate")):
-            # stacked over layers: leading L axis unsharded
-            return P(None, "fsdp", "tensor") if nd == 3 else P("fsdp", "tensor")
+            # nd==4 → MoE expert-stacked (L, E, D, F): experts over "expert"
+            if nd == 4:
+                return P(lead, "expert", "fsdp", "tensor")
+            # stacked over layers: leading L axis pipe-sharded when pipelining
+            return P(lead, "fsdp", "tensor") if nd == 3 else P("fsdp", "tensor")
         if any(k in name for k in ("wo", "w_out")):
-            return P(None, "tensor", "fsdp") if nd == 3 else P("tensor", "fsdp")
-        return P()  # norms, scalars: replicated
+            if nd == 4:
+                return P(lead, "expert", "tensor", "fsdp")
+            return P(lead, "tensor", "fsdp") if nd == 3 else P("tensor", "fsdp")
+        if in_layers and nd >= 1:
+            return P(lead)  # per-layer norms
+        return P()  # scalars / final norm: replicated
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     specs = []
@@ -64,8 +80,8 @@ def activation_spec() -> P:
     return P(("data", "fsdp"), "seq", None)
 
 
-def shard_params(params: Any, mesh: Mesh) -> Any:
-    specs = param_specs(params)
+def shard_params(params: Any, mesh: Mesh, pipeline: bool = False) -> Any:
+    specs = param_specs(params, pipeline=pipeline)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
