@@ -121,7 +121,7 @@ class TPUDevicePlugin:
 
     def GetDevicePluginOptions(self, request, context):
         return pb.DevicePluginOptions(
-            pre_start_required=False, get_preferred_allocation_available=False
+            pre_start_required=False, get_preferred_allocation_available=True
         )
 
     def ListAndWatch(self, request, context):
@@ -131,6 +131,38 @@ class TPUDevicePlugin:
             if self._stop.wait(10.0):
                 break
             yield pb.ListAndWatchResponse(devices=self.device_list())
+
+    def GetPreferredAllocation(self, request, context):
+        """Prefer filling already-chosen chips (must-include first), then the
+        fewest additional chips — core-unit binpacking within the node, so
+        fractional tenants consolidate and whole chips stay free."""
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            need = creq.allocation_size
+            chosen = list(creq.must_include_device_i_ds)[:need]
+            remaining = [
+                d for d in creq.available_device_i_ds if d not in set(chosen)
+            ]
+            by_chip: dict[str, list[str]] = {}
+            for d in remaining:
+                by_chip.setdefault(self.chip_of_device(d), []).append(d)
+            # chips already partially chosen first, then fewest-available
+            chosen_chips = {self.chip_of_device(d) for d in chosen}
+            order = sorted(
+                by_chip.items(),
+                key=lambda kv: (kv[0] not in chosen_chips, len(kv[1]), kv[0]),
+            )
+            for _chip, devs in order:
+                for d in sorted(devs):
+                    if len(chosen) >= need:
+                        break
+                    chosen.append(d)
+                if len(chosen) >= need:
+                    break
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(device_i_ds=chosen)
+            )
+        return resp
 
     def Allocate(self, request, context):
         by_path = dict(self.chips)
@@ -171,6 +203,13 @@ class TPUDevicePlugin:
                 self.ListAndWatch,
                 request_deserializer=pb.Empty.FromString,
                 response_serializer=pb.ListAndWatchResponse.SerializeToString,
+            ),
+            "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+                self.GetPreferredAllocation,
+                request_deserializer=pb.PreferredAllocationRequest.FromString,
+                response_serializer=(
+                    pb.PreferredAllocationResponse.SerializeToString
+                ),
             ),
             "Allocate": grpc.unary_unary_rpc_method_handler(
                 self.Allocate,

@@ -48,6 +48,12 @@ class Clientset:
     def list_nodes(self) -> list[Node]:
         raise NotImplementedError
 
+    def create_event(self, event: dict) -> None:
+        """Record a k8s Event.  The reference creates an event broadcaster but
+        never records anything (controller.go:57-60, SURVEY §5 quirk); here
+        scheduling outcomes are actually recorded."""
+        raise NotImplementedError
+
 
 class FakeClientset(Clientset):
     def __init__(self, cluster: FakeCluster):
@@ -70,6 +76,9 @@ class FakeClientset(Clientset):
 
     def list_nodes(self):
         return self.cluster.list_nodes()
+
+    def create_event(self, event):
+        return self.cluster.create_event(event)
 
 
 class RestClientset(Clientset):
@@ -177,3 +186,7 @@ class RestClientset(Clientset):
     def list_nodes(self):
         items = self._req("GET", "/api/v1/nodes").get("items", [])
         return [Node.from_dict(i) for i in items]
+
+    def create_event(self, event):
+        ns = (event.get("involvedObject") or {}).get("namespace", "default")
+        self._req("POST", f"/api/v1/namespaces/{ns}/events", event)

@@ -55,6 +55,7 @@ class FakeCluster:
         self._nodes: dict[str, Node] = {}
         self._rv = 0
         self._watchers: list[queue.Queue] = []
+        self.events: list[dict] = []  # recorded k8s Events (append-only)
 
     # -- internals -----------------------------------------------------------
 
@@ -170,6 +171,10 @@ class FakeCluster:
             cur.status.phase = phase
             cur.metadata.resource_version = self._next_rv()
             self._notify("MODIFIED", cur)
+
+    def create_event(self, event: dict) -> None:
+        with self._lock:
+            self.events.append(dict(event))
 
     # -- watch ---------------------------------------------------------------
 

@@ -234,12 +234,44 @@ class TPUUnitScheduler(ResourceScheduler):
                     node=node_name,
                 )
             )
+            self._record_event(
+                pod, "Normal", "Scheduled",
+                f"bound to {node_name} "
+                f"(chips {[a.coords for a in opt.allocs if a.needs_tpu]})",
+            )
             return updated
-        except Exception:
+        except Exception as e:
             with self.lock:
                 self.pod_maps.pop(pod.key, None)
                 na.forget(opt)
+            self._record_event(
+                pod, "Warning", "FailedScheduling", f"bind to {node_name}: {e}"
+            )
             raise
+
+    def _record_event(self, pod: Pod, etype: str, reason: str, message: str):
+        """Record a k8s Event for a scheduling outcome.  The reference wires
+        an event broadcaster but never records (controller.go:57-60); here
+        outcomes are observable via `kubectl describe pod`."""
+        try:
+            self.clientset.create_event(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "type": etype,
+                    "reason": reason,
+                    "message": message,
+                    "involvedObject": {
+                        "kind": "Pod",
+                        "namespace": pod.metadata.namespace,
+                        "name": pod.metadata.name,
+                        "uid": pod.metadata.uid,
+                    },
+                    "source": {"component": "tpu-elastic-scheduler"},
+                }
+            )
+        except Exception:  # events are best-effort
+            pass
 
     def _write_annotations(self, pod: Pod, opt: Option, node_name: str) -> Pod:
         """Annotation-ledger write with one optimistic-conflict retry

@@ -140,3 +140,32 @@ def test_options_and_prestart(plugin_env):
             response_deserializer=pb.PreStartContainerResponse.FromString,
         )(pb.PreStartContainerRequest(devices_i_ds=["0.2/0"]), timeout=5)
         assert pre is not None
+
+
+def test_preferred_allocation_binpacks_chips(plugin_env):
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        pref = ch.unary_unary(
+            "/v1beta1.DevicePlugin/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        # 30 units available on chip 0.2, 100 on chip 0.3, need 25 with 5
+        # already pinned on 0.2 → all 25 should stay on chip 0.2
+        avail = [f"0.2/{u}" for u in range(30)] + [f"0.3/{u}" for u in range(100)]
+        resp = pref(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_device_i_ds=avail,
+                        must_include_device_i_ds=["0.2/0"],
+                        allocation_size=25,
+                    )
+                ]
+            ),
+            timeout=5,
+        )
+    ids = list(resp.container_responses[0].device_i_ds)
+    assert len(ids) == 25
+    assert all(i.startswith("0.2/") for i in ids)
+    assert "0.2/0" in ids

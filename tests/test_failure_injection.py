@@ -1,0 +1,196 @@
+"""Failure-injection and concurrency tests (SURVEY §5: the reference has no
+fault injection; its recovery paths — optimistic-lock retry, at-most-once
+release, UID checks — are exactly what these tests exercise here)."""
+
+import threading
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.core.rater import Binpack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import ApiError, FakeCluster, conflict
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.scheduler.scheduler import (
+    SchedulerConfig,
+    TPUUnitScheduler,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, uid=""):
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        uid=uid or f"uid-{name}",
+    )
+
+
+class FlakyClientset(FakeClientset):
+    """Injects failures into specific verbs."""
+
+    def __init__(self, cluster):
+        super().__init__(cluster)
+        self.update_conflicts_remaining = 0
+        self.update_errors_remaining = 0
+        self.bind_errors_remaining = 0
+
+    def update_pod(self, pod):
+        if self.update_conflicts_remaining > 0:
+            self.update_conflicts_remaining -= 1
+            raise conflict(f"pod {pod.key}: injected conflict")
+        if self.update_errors_remaining > 0:
+            self.update_errors_remaining -= 1
+            raise ApiError("ServerTimeout", "injected", 500)
+        return super().update_pod(pod)
+
+    def bind(self, binding):
+        if self.bind_errors_remaining > 0:
+            self.bind_errors_remaining -= 1
+            raise ApiError("ServerTimeout", "injected", 500)
+        return super().bind(binding)
+
+
+def stack(n_nodes=2):
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        cluster.add_node(make_tpu_node(f"n{i}", chips=4, hbm_gib=64))
+    cs = FlakyClientset(cluster)
+    sched = TPUUnitScheduler(SchedulerConfig(clientset=cs, rater=Binpack()))
+    return cluster, cs, sched
+
+
+def test_bind_survives_one_conflict():
+    """The reference retries exactly once on optimistic-lock conflict
+    (scheduler.go:199-213); verify the retry path actually re-reads."""
+    cluster, cs, sched = stack()
+    pod = tpu_pod("p1", core=200)
+    cluster.create_pod(pod)
+    cs.update_conflicts_remaining = 1
+    sched.bind("n0", pod)
+    bound = cluster.get_pod("default", "p1")
+    assert bound.metadata.annotations[consts.ANNOTATION_ASSUMED] == "true"
+    assert bound.spec.node_name == "n0"
+
+
+def test_bind_conflict_with_recreated_pod_fails_cleanly():
+    cluster, cs, sched = stack()
+    pod = tpu_pod("p1", core=100)
+    cluster.create_pod(pod)
+    # recreate under a new uid behind the scheduler's back
+    cluster.delete_pod("default", "p1")
+    cluster.create_pod(tpu_pod("p1", core=100, uid="uid-other"))
+    cs.update_conflicts_remaining = 1
+    with pytest.raises(RuntimeError, match="recreated"):
+        sched.bind("n0", pod)
+    # allocation must have been rolled back
+    assert sched.allocators["n0"].chips.avail_core() == 400
+    assert not sched.known_pod(pod)
+
+
+def test_update_error_rolls_back_allocation():
+    """Non-conflict update errors must RAISE and roll back (the reference
+    swallows them and silently skips binding, scheduler.go:210-211 —
+    documented deviation)."""
+    cluster, cs, sched = stack()
+    pod = tpu_pod("p1", core=300)
+    cluster.create_pod(pod)
+    cs.update_errors_remaining = 1
+    with pytest.raises(ApiError):
+        sched.bind("n0", pod)
+    assert sched.allocators["n0"].chips.avail_core() == 400
+    # retry after the fault clears succeeds
+    sched.bind("n0", cluster.get_pod("default", "p1"))
+    assert sched.allocators["n0"].chips.avail_core() == 100
+
+
+def test_binding_post_error_rolls_back():
+    cluster, cs, sched = stack()
+    pod = tpu_pod("p1", core=100)
+    cluster.create_pod(pod)
+    cs.bind_errors_remaining = 1
+    with pytest.raises(ApiError):
+        sched.bind("n0", pod)
+    assert sched.allocators["n0"].chips.avail_core() == 400
+
+
+def test_forget_is_at_most_once():
+    cluster, cs, sched = stack()
+    pod = tpu_pod("p1", core=200)
+    cluster.create_pod(pod)
+    sched.bind("n0", pod)
+    assert sched.allocators["n0"].chips.avail_core() == 200
+    sched.forget_pod(pod)
+    sched.forget_pod(pod)  # double release must not double-credit
+    assert sched.allocators["n0"].chips.avail_core() == 400
+    assert sched.released_pod(pod)
+    # a re-observed add after release is re-admitted (new lifecycle)
+    bound = cluster.get_pod("default", "p1")
+    sched.add_pod(bound)
+    assert sched.known_pod(bound)
+
+
+def test_concurrent_bind_stress_never_overcommits():
+    """16 threads race filter+bind for 40 pods over 2 nodes (8 chips);
+    whatever succeeds must exactly account for the capacity."""
+    cluster, cs, sched = stack(n_nodes=2)
+    pods = [tpu_pod(f"p{i}", core=100) for i in range(40)]
+    for p in pods:
+        cluster.create_pod(p)
+    results = [None] * len(pods)
+
+    def run(i):
+        pod = pods[i]
+        ok, _ = sched.assume(["n0", "n1"], pod)
+        if not ok:
+            results[i] = "filtered"
+            return
+        try:
+            sched.bind(ok[0], pod)
+            results[i] = "bound"
+        except Exception:
+            results[i] = "bind_failed"
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(40)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    bound = results.count("bound")
+    used = sum(
+        400 - sched.allocators[n].chips.avail_core() for n in ("n0", "n1")
+    )
+    assert used == bound * 100
+    assert bound == 8  # exactly the cluster capacity
+    for n in ("n0", "n1"):
+        for ch in sched.allocators[n].chips.chips.values():
+            assert 0 <= ch.core_avail <= ch.core_total
+
+
+def test_bind_records_events():
+    cluster, cs, sched = stack()
+    pod = tpu_pod("ev1", core=100)
+    cluster.create_pod(pod)
+    sched.bind("n0", pod)
+    ok_events = [e for e in cluster.events if e["reason"] == "Scheduled"]
+    assert ok_events and ok_events[0]["involvedObject"]["name"] == "ev1"
+    # failure path records a warning event
+    pod2 = tpu_pod("ev2", core=100)
+    cluster.create_pod(pod2)
+    cs.bind_errors_remaining = 1
+    with pytest.raises(ApiError):
+        sched.bind("n0", pod2)
+    warn = [e for e in cluster.events if e["reason"] == "FailedScheduling"]
+    assert warn and warn[0]["type"] == "Warning"
