@@ -1,0 +1,144 @@
+"""Autoregressive generation with a KV cache.
+
+Decode path of the flagship LM: prefill the cache from the prompt with the
+batched forward, then one-token-at-a-time decode steps.  TPU-first: static
+cache shape (max_len), ``lax.dynamic_update_slice`` writes, position-masked
+attention — no dynamic shapes anywhere, so the step function jits once.
+
+No reference analogue (SURVEY §2 #19); workload-plane completeness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import NEG_INF
+from .transformer import TransformerConfig, rms_norm, rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, max_len, H, Dh)
+    v: jax.Array  # (L, B, max_len, H, Dh)
+    length: jax.Array  # () int32 — valid prefix length
+
+    @classmethod
+    def empty(cls, cfg: TransformerConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        dtype = jnp.dtype(cfg.dtype)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+def _cached_attention(q, cache_k, cache_v, length):
+    """q: (B, 1, H, Dh) at position `length`; cache: (B, max_len, H, Dh)."""
+    qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
+    kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,S,Dh)
+    vT = cache_v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale  # (B,H,1,S)
+    positions = jnp.arange(s.shape[-1])
+    s = jnp.where(positions[None, None, None, :] <= length, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,1,H,Dh)
+
+
+def decode_step(
+    params: dict, token: jax.Array, cache: KVCache, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """token: (B,) int32 at position cache.length → (logits (B,V), cache')."""
+    dtype = jnp.dtype(cfg.dtype)
+    B = token.shape[0]
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    x = params["embed"].astype(dtype)[token][:, None, :]  # (B,1,D)
+    pos = cache.length
+
+    def layer_step(x, scanned):
+        p, ck, cv = scanned  # per-layer params + cache slices
+        h = rms_norm(x, p["attn_norm"])
+        q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        posv = jnp.full((1,), pos)
+        q = rope(q, posv, cfg.rope_theta)
+        k = rope(k, posv, cfg.rope_theta)
+        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        o = _cached_attention(q, ck, cv, pos).reshape(B, 1, Hn * Dh)
+        x = x + (o @ p["wo"].astype(dtype))
+        h = rms_norm(x, p["mlp_norm"])
+        if cfg.n_experts > 0:
+            from .moe import moe_ffn
+
+            ffn, _ = moe_ffn(
+                h, p["moe_gate"], p["w_in"], p["w_gate"], p["w_out"],
+                capacity_factor=cfg.capacity_factor, dtype=dtype,
+            )
+            x = x + ffn
+        else:
+            gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+            up = h @ p["w_in"].astype(dtype)
+            x = x + ((gate * up) @ p["w_out"].astype(dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        layer_step, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["unembed"].astype(dtype))[:, 0, :]
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, pos + 1)
+
+
+def prefill(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """Feed the prompt one token at a time (simple, correct prefill).
+
+    tokens: (B, S) → (last-position logits (B, V), cache at length S)."""
+
+    def body(carry, tok):
+        cache = carry
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return cache, logits
+
+    cache, logits_seq = lax.scan(body, cache, tokens.T)
+    return logits_seq[-1], cache
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,  # (B, S) int32
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    max_len: int = 0,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation; returns (B, S+new)."""
+    B, S = prompt.shape
+    max_len = max_len or S + max_new_tokens
+    cache = KVCache.empty(cfg, B, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    if key is None:
+        key = jax.random.key(0)
+
+    step_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
+
+    out = [prompt]
+    for _ in range(max_new_tokens):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            token = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            token = jnp.argmax(logits, axis=-1)
+        out.append(token[:, None])
+        logits, cache = step_fn(params, token, cache)
+    return jnp.concatenate(out, axis=1)
