@@ -100,9 +100,9 @@ class GangCoordinator:
     def is_gang_pod(req: TPURequest) -> bool:
         return bool(req.gang_name) and req.gang_size > 1
 
-    def _node_mesh_order(self, sched: TPUUnitScheduler, names: list[str]):
-        """Sort candidate nodes in (slice, host-offset row-major) order so
-        greedy planning fills the ICI mesh contiguously."""
+    def _node_mesh_order(self, names: list[str]) -> list[tuple[str, str]]:
+        """Candidate nodes as (slice_id, name) in (slice, host-offset
+        row-major) order so greedy planning fills the ICI mesh contiguously."""
 
         def key(name: str):
             try:
@@ -125,7 +125,8 @@ class GangCoordinator:
                 idx = 0
             return (slice_id, idx, name)
 
-        return sorted(names, key=key)
+        keyed = sorted(((key(n), n) for n in names))
+        return [(k[0], n) for k, n in keyed]
 
     # -- filter-time planning ------------------------------------------------
 
@@ -167,8 +168,30 @@ class GangCoordinator:
     def _plan(
         self, sched: TPUUnitScheduler, req: TPURequest, node_names: list[str]
     ) -> Optional[_Plan]:
-        """Greedily place all members onto cloned chip state, mesh-ordered."""
-        ordered = self._node_mesh_order(sched, node_names)
+        """Place all members onto cloned chip state.
+
+        Slice-affine: each ICI slice is tried ALONE first (in mesh order), so
+        a gang that fits inside one slice never straddles the DCN boundary;
+        spanning slices is the last resort (collectives across slices fall
+        off ICI onto DCN — the exact cost the placement model exists to
+        avoid, SURVEY §5 'Distributed communication backend')."""
+        ordered = self._node_mesh_order(node_names)
+        slice_groups: dict[str, list[str]] = {}
+        for slice_id, name in ordered:
+            slice_groups.setdefault(slice_id, []).append(name)
+        candidates: list[list[str]] = [g for g in slice_groups.values()]
+        if len(candidates) > 1:
+            candidates.append([n for _, n in ordered])  # spanning fallback
+        for group in candidates:
+            slots = self._plan_on(sched, req, group)
+            if slots is not None:
+                return _Plan(slots=slots)
+        return None
+
+    def _plan_on(
+        self, sched: TPUUnitScheduler, req: TPURequest, ordered: list[str]
+    ) -> Optional[list[str]]:
+        """Greedy member placement over one candidate node group (cloned)."""
         clones = {}
         slots: list[str] = []
         for member in range(req.gang_size):
@@ -198,7 +221,7 @@ class GangCoordinator:
                 break
             if not placed:
                 return None
-        return _Plan(slots=slots)
+        return slots
 
     # -- bind-time barrier ---------------------------------------------------
 

@@ -256,3 +256,62 @@ def test_gang_plan_is_mesh_ordered(small_stack):
     xs = {o.split(".")[0] for o in offsets}
     ys = {o.split(".")[1] for o in offsets}
     assert len(xs) == 1 and len(ys) == 1, offsets
+
+
+def test_gang_prefers_single_slice_over_straddling():
+    """A gang that fits in one slice must not straddle the DCN boundary,
+    even when mesh order would greedily start in a half-full slice."""
+    cluster = FakeCluster()
+    for sname in ["slice-b", "slice-a"]:  # slice-a sorts first
+        i = 0
+        for x in range(0, 4, 2):
+            for y in range(0, 4, 2):
+                cluster.add_node(
+                    make_tpu_node(
+                        f"{sname}-h{i}", chips=4, hbm_gib=64, accelerator="v5e",
+                        slice_topology="4x4", host_topology="2x2",
+                        host_offset=f"{x}.{y}", slice_name=sname,
+                    )
+                )
+                i += 1
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="ici-locality"
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    # occupy half of slice-a: only slice-b can hold the whole 4-host gang
+    for h in ["slice-a-h0", "slice-a-h1"]:
+        na = sched._get_allocator(h)
+        for ch in na.chips.chips.values():
+            ch.take_whole()
+    nodes = [n.metadata.name for n in cluster.list_nodes()]
+    placed = []
+    for i in range(4):
+        p = gang_pod(f"m{i}", "affine", 4, core=400)
+        cluster.create_pod(p)
+        r = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+        placed.append(r.node_names[0] if r.node_names else None)
+    assert all(n and n.startswith("slice-b-") for n in placed), placed
+
+
+def test_gang_spans_slices_only_as_last_resort():
+    """When no single slice fits the gang, spanning is still allowed."""
+    cluster = FakeCluster()
+    for sname in ["sl-a", "sl-b"]:
+        cluster.add_node(
+            make_tpu_node(
+                f"{sname}-h0", chips=4, hbm_gib=64, accelerator="v5e",
+                slice_topology="2x2", host_topology="2x2", host_offset="0.0",
+                slice_name=sname,
+            )
+        )
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="ici-locality"
+    )
+    nodes = [n.metadata.name for n in cluster.list_nodes()]
+    placed = []
+    for i in range(2):
+        p = gang_pod(f"s{i}", "spanner", 2, core=400)
+        cluster.create_pod(p)
+        r = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+        placed.append(r.node_names[0] if r.node_names else None)
+    assert sorted(placed) == ["sl-a-h0", "sl-b-h0"]
