@@ -43,12 +43,15 @@ class JobSpec:
     model: TransformerConfig = field(default_factory=TransformerConfig)
     mesh: MeshSpec = field(default_factory=MeshSpec)
     steps: int = 10
-    batch_size: int = 8
+    batch_size: int = 8  # global batch (split across processes when multi-host)
     seq_len: int = 128
     lr: float = 3e-4
     seed: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
+    dataset_path: str = ""  # memmap token file; empty → synthetic motifs
+    warmup_steps: int = 0
+    grad_clip: float = 1.0
 
 
 def coords_for_container(
@@ -74,6 +77,11 @@ def run_job(
     devices=None,
 ) -> list[float]:
     """Train for spec.steps; returns per-step losses."""
+    from .parallel.distributed import maybe_initialize_distributed, process_info
+
+    maybe_initialize_distributed()
+    proc_idx, proc_count = process_info()
+
     ann = dict(pod_annotations or {})
     coords = coords_for_container(ann, container)
     if coords:
@@ -87,11 +95,32 @@ def run_job(
     mesh = mesh_from_allocation(ann, container, spec.mesh, devices=devices)
     log.info("mesh: %s over %d devices", spec.mesh.sizes, spec.mesh.num_devices)
 
-    opt = make_optimizer(lr=spec.lr)
+    opt = make_optimizer(
+        lr=spec.lr,
+        warmup_steps=spec.warmup_steps,
+        total_steps=spec.steps if spec.warmup_steps else 0,
+        grad_clip=spec.grad_clip,
+    )
     params, opt_state = init_sharded_state(
         jax.random.key(spec.seed), spec.model, opt, mesh
     )
     step_fn = make_jitted_train_step(spec.model, opt, mesh)
+
+    from .models.data import MemmapTokenDataset, SyntheticTokenDataset, batches
+
+    source = (
+        MemmapTokenDataset(spec.dataset_path)
+        if spec.dataset_path
+        else SyntheticTokenDataset(spec.model.vocab_size, seed=spec.seed)
+    )
+    batch_iter = batches(
+        source,
+        batch_size=spec.batch_size,
+        seq_len=spec.seq_len,
+        seed=spec.seed + 1,
+        process_index=proc_idx,
+        process_count=proc_count,
+    )
 
     start_step = 0
     ckpt = None
@@ -105,15 +134,8 @@ def run_job(
             log.info("resumed from step %d", start_step)
 
     losses = []
-    key = jax.random.key(spec.seed + 1)
     for step in range(start_step, spec.steps):
-        key, sub = jax.random.split(key)
-        tokens = jax.random.randint(
-            sub,
-            (spec.batch_size, spec.seq_len + 1),
-            0,
-            spec.model.vocab_size,
-        )
+        tokens = jax.numpy.asarray(next(batch_iter))
         params, opt_state, loss = step_fn(params, opt_state, tokens)
         losses.append(float(loss))
         if ckpt and spec.checkpoint_every and (step + 1) % spec.checkpoint_every == 0:

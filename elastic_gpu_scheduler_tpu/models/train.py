@@ -27,8 +27,29 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(logz - gold)
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.01,
+    warmup_steps: int = 0,
+    total_steps: int = 0,
+    grad_clip: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW with optional linear-warmup + cosine decay and global-norm clip
+    (the standard LM pretraining recipe)."""
+    if warmup_steps > 0 and total_steps > warmup_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+            end_value=lr * 0.1,
+        )
+    else:
+        schedule = lr
+    tx = optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    if grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
 
 
 def loss_fn(

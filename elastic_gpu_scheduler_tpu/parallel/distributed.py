@@ -1,0 +1,78 @@
+"""Multi-host initialization: one call per process before building meshes.
+
+Scales the workload plane to multi-host slices the way the scheduler scales
+placement: each pod of a gang runs one JAX process; ``jax.distributed``
+forms the global device view over ICI/DCN, after which the same
+``jax.sharding.Mesh`` code paths span hosts — XLA routes collectives over
+ICI within a slice and DCN across slices (SURVEY §2 #20: the TPU-native
+replacement for the reference ecosystem's NCCL/MPI backend is exactly
+XLA's collective runtime; nothing here implements transports).
+
+Environment contract (set by the gang's pod template / launcher):
+
+    TPU_COORDINATOR_ADDRESS  host:port of process 0 (or GKE's
+                             MEGASCALE/JAX defaults)
+    TPU_NUM_PROCESSES        gang size
+    TPU_PROCESS_ID           this member's index (e.g. from the pod name
+                             ordinal or the jobset completion index)
+
+On TPU VMs with libtpu, ``jax.distributed.initialize()`` can also infer
+everything from the TPU metadata — so all variables are optional there.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("tpu-launcher")
+
+
+def maybe_initialize_distributed(
+    coordinator: str = "",
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> bool:
+    """Initialize jax.distributed when a multi-process env is configured.
+
+    Returns True if distributed mode is active.  Safe no-op single-process.
+    """
+    coordinator = coordinator or os.environ.get("TPU_COORDINATOR_ADDRESS", "")
+    if num_processes <= 0:
+        num_processes = int(os.environ.get("TPU_NUM_PROCESSES", "0") or 0)
+    if process_id < 0:
+        process_id = int(os.environ.get("TPU_PROCESS_ID", "-1") or -1)
+
+    if num_processes <= 1 and not coordinator:
+        return False
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes > 0:
+        kwargs["num_processes"] = num_processes
+    if process_id >= 0:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+        log.info(
+            "jax.distributed: process %d/%d, %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.device_count(),
+        )
+        return True
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return True
+        raise
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when not distributed."""
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1

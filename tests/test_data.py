@@ -1,0 +1,82 @@
+"""Data pipeline tests: memmap round-trip, process-sharded batching, and
+actual learnability of the synthetic motif language."""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from elastic_gpu_scheduler_tpu.models.data import (
+    MemmapTokenDataset,
+    SyntheticTokenDataset,
+    batches,
+    write_token_file,
+)
+from elastic_gpu_scheduler_tpu.models.train import (
+    init_sharded_state,
+    make_jitted_train_step,
+    make_optimizer,
+)
+from elastic_gpu_scheduler_tpu.models.transformer import TransformerConfig
+
+
+def test_memmap_roundtrip_and_window():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "toks.bin")
+        toks = np.arange(1000) % 500
+        write_token_file(path, toks)
+        ds = MemmapTokenDataset(path)
+        assert len(ds) == 1000
+        w = ds.window(10, 16)
+        np.testing.assert_array_equal(w, toks[10:26])
+        assert w.dtype == np.int32
+        # wraps instead of running off the end
+        w2 = ds.window(999, 16)
+        assert len(w2) == 16
+
+
+def test_batches_process_sharding_is_partition():
+    """Two processes' local batches concatenate to the single-process batch."""
+    ds = SyntheticTokenDataset(vocab_size=64, seed=1)
+    full = next(batches(ds, batch_size=8, seq_len=12, seed=5))
+    p0 = next(batches(ds, 8, 12, seed=5, process_index=0, process_count=2))
+    p1 = next(batches(ds, 8, 12, seed=5, process_index=1, process_count=2))
+    np.testing.assert_array_equal(np.concatenate([p0, p1]), full)
+    assert full.shape == (8, 13)
+    with pytest.raises(ValueError):
+        next(batches(ds, 9, 12, process_count=2))
+
+
+def test_synthetic_language_is_learnable():
+    """Training on motifs beats training on uniform noise by a clear margin."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    opt = make_optimizer(lr=3e-3, grad_clip=1.0)
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg, opt)
+    step = make_jitted_train_step(cfg, opt)
+    ds = SyntheticTokenDataset(vocab_size=64, seed=2, noise=0.05)
+    it = batches(ds, batch_size=16, seq_len=32, seed=3)
+    loss = None
+    for i in range(60):
+        tokens = jax.numpy.asarray(next(it))
+        params, opt_state, loss = step(params, opt_state, tokens)
+    # uniform-noise entropy is ln(64) ≈ 4.16; motifs must be far below
+    assert float(loss) < 2.5, float(loss)
+
+
+def test_optimizer_schedule_and_clip():
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype="float32",
+    )
+    opt = make_optimizer(lr=1e-2, warmup_steps=5, total_steps=20, grad_clip=0.5)
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg, opt)
+    step = make_jitted_train_step(cfg, opt)
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, 32)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
