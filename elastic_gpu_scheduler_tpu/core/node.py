@@ -77,17 +77,39 @@ def chips_from_node(node) -> tuple[Topology, list[Chip]]:
 class NodeAllocator:
     """One node's chips + the per-request option cache."""
 
+    # assume() cache entries for pods that never reach bind would otherwise
+    # live forever (the reference's `allocated` map has the same leak,
+    # node.go:64-72); entries older than this are evicted opportunistically.
+    OPTION_TTL_S = 300.0
+
     def __init__(self, node):
         self.node_name = node.metadata.name
         topo, chips = chips_from_node(node)
         self.chips = ChipSet(topo, chips)
         self.allocated: dict[str, Option] = {}  # request hash → assumed option
+        self._allocated_at: dict[str, float] = {}  # request hash → monotonic
         self.lock = threading.Lock()
+
+    def _evict_stale_locked(self) -> None:
+        import time
+
+        now = time.monotonic()
+        stale = [
+            h
+            for h, t in self._allocated_at.items()
+            if now - t > self.OPTION_TTL_S
+        ]
+        for h in stale:
+            self.allocated.pop(h, None)
+            self._allocated_at.pop(h, None)
 
     # -- verbs (reference: node.go:61-160) -----------------------------------
 
     def assume(self, request: TPURequest, rater: Rater) -> Optional[Option]:
+        import time
+
         with self.lock:
+            self._evict_stale_locked()
             h = request.hash()
             cached = self.allocated.get(h)
             if cached is not None:
@@ -95,6 +117,7 @@ class NodeAllocator:
             opt = self.chips.trade(request, rater)
             if opt is not None:
                 self.allocated[h] = opt
+                self._allocated_at[h] = time.monotonic()
             return opt
 
     def score(self, request: TPURequest, rater: Rater) -> Optional[float]:
@@ -112,6 +135,7 @@ class NodeAllocator:
         with self.lock:
             h = request.hash()
             opt = self.allocated.pop(h, None)
+            self._allocated_at.pop(h, None)
             if opt is not None and not self.chips.can_transact(opt):
                 opt = None  # stale — placement taken since assume
             if opt is None:
@@ -138,6 +162,7 @@ class NodeAllocator:
         """Evict a cached (not committed) option — e.g. gang rollback."""
         with self.lock:
             self.allocated.pop(request_hash, None)
+            self._allocated_at.pop(request_hash, None)
 
     def refresh_from_node(self, node) -> None:
         """Re-derive capacity if the node's allocatable changed (the reference
@@ -150,6 +175,7 @@ class NodeAllocator:
             if not same_shape:
                 self.chips = ChipSet(topo, chips)
                 self.allocated.clear()
+                self._allocated_at.clear()
                 return
             # Same chip layout: apply per-chip total changes (e.g. HBM resize)
             # while preserving live usage.

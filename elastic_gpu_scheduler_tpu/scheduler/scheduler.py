@@ -95,7 +95,10 @@ class TPUUnitScheduler(ResourceScheduler):
         self.allocators: dict[str, NodeAllocator] = {}
         # pod key → (node, committed Option); the at-most-once ledger
         self.pod_maps: dict[str, tuple[str, Option]] = {}
-        self.released_pods: dict[str, str] = {}  # pod key → uid
+        # pod key → uid; bounded (FIFO) so long-lived schedulers don't grow
+        # without limit (the reference's releasedPodMap grows forever)
+        self.released_pods: dict[str, str] = {}
+        self.released_pods_max = 10000
         self._pool = ThreadPoolExecutor(
             max_workers=self.assume_workers, thread_name_prefix="assume"
         )
@@ -338,6 +341,8 @@ class TPUUnitScheduler(ResourceScheduler):
             if na is not None:
                 na.forget(opt)
             self.released_pods[pod.key] = pod.metadata.uid
+            while len(self.released_pods) > self.released_pods_max:
+                self.released_pods.pop(next(iter(self.released_pods)))
 
     def known_pod(self, pod: Pod) -> bool:
         with self.lock:
