@@ -1,11 +1,17 @@
 # Two-stage build (reference: Dockerfile:1-18 uses golang → debian-slim; here
 # the runtime is Python + grpc; protobuf messages are pre-generated in-tree).
+# g++ is included so core/native.py can build the C++ placement extension at
+# startup; numpy is a hard dependency of the topology core.
 FROM python:3.12-slim AS base
 
-RUN pip install --no-cache-dir grpcio protobuf
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir grpcio protobuf numpy
 
 WORKDIR /app
 COPY elastic_gpu_scheduler_tpu/ elastic_gpu_scheduler_tpu/
+COPY native/ native/
 COPY bench.py ./
 
 EXPOSE 39999

@@ -44,6 +44,11 @@ def build_stack(
     gang_timeout: float = 30.0,
 ):
     """Wire registry + handlers + controller (reference: main.go:56-96)."""
+    # warm the native placement extension at startup so the first large-mesh
+    # filter request never pays the g++ build under the allocator lock
+    from .core.native import get_placement
+
+    get_placement()
     rater = get_rater(priority)
     config = SchedulerConfig(clientset=clientset, rater=rater)
     registry = build_resource_schedulers(list(modes), config)

@@ -262,7 +262,9 @@ class TPUDevicePlugin:
                     version=API_VERSION,
                     endpoint=endpoint,
                     resource_name=self.resource_name,
-                    options=pb.DevicePluginOptions(),
+                    # kubelet stores the options from THIS message and only
+                    # calls GetPreferredAllocation when advertised here
+                    options=self.GetDevicePluginOptions(pb.Empty(), None),
                 ),
                 timeout=10,
             )

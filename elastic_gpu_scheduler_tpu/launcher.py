@@ -133,6 +133,10 @@ def run_job(
             params, opt_state, start_step = restored
             log.info("resumed from step %d", start_step)
 
+    # a resumed run must continue the batch stream, not replay it
+    for _ in range(start_step):
+        next(batch_iter)
+
     losses = []
     for step in range(start_step, spec.steps):
         tokens = jax.numpy.asarray(next(batch_iter))

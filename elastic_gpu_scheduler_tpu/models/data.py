@@ -30,7 +30,13 @@ class MemmapTokenDataset:
         return len(self.tokens)
 
     def window(self, start: int, length: int) -> np.ndarray:
-        start = int(start) % max(1, len(self.tokens) - length)
+        """A contiguous `length`-token window; `start` is taken modulo the
+        valid range so any 64-bit start is usable."""
+        if len(self.tokens) <= length:
+            raise ValueError(
+                f"{self.path}: {len(self.tokens)} tokens < window {length}"
+            )
+        start = int(start) % (len(self.tokens) - length)
         return np.asarray(self.tokens[start : start + length], dtype=np.int32)
 
 

@@ -133,12 +133,13 @@ def generate(
     step_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
 
     out = [prompt]
-    for _ in range(max_new_tokens):
+    for i in range(max_new_tokens):
         if temperature > 0:
             key, sub = jax.random.split(key)
             token = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             token = jnp.argmax(logits, axis=-1)
         out.append(token[:, None])
-        logits, cache = step_fn(params, token, cache)
+        if i < max_new_tokens - 1:  # the last token needs no further logits
+            logits, cache = step_fn(params, token, cache)
     return jnp.concatenate(out, axis=1)

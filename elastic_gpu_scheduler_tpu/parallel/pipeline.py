@@ -90,9 +90,9 @@ def pipeline_apply(
         # results live on the last stage; zero elsewhere → psum broadcasts
         is_last = (stage == pp - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * is_last, "pipe")
-        aux_total = lax.psum(
-            aux_total * (stage >= 0), "pipe"
-        )  # every stage contributed its own layers' aux
+        # every stage contributed its own layers' aux, once per microbatch;
+        # divide by M so the aux scale matches the unpipelined full-batch scan
+        aux_total = lax.psum(aux_total, "pipe") / M
         return outputs, aux_total
 
     y, aux = jax.shard_map(

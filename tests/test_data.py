@@ -32,9 +32,14 @@ def test_memmap_roundtrip_and_window():
         w = ds.window(10, 16)
         np.testing.assert_array_equal(w, toks[10:26])
         assert w.dtype == np.int32
-        # wraps instead of running off the end
+        # start is reduced modulo the valid range; never runs off the end
         w2 = ds.window(999, 16)
         assert len(w2) == 16
+        # a file shorter than the window is an error, not a short batch
+        short = os.path.join(d, "short.bin")
+        write_token_file(short, np.arange(10))
+        with pytest.raises(ValueError, match="< window"):
+            MemmapTokenDataset(short).window(0, 16)
 
 
 def test_batches_process_sharding_is_partition():
