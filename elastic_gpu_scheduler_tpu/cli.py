@@ -119,7 +119,11 @@ def main(argv=None) -> int:
             )
         clientset = FakeClientset(cluster)
     elif args.kube_api or os.environ.get("KUBERNETES_SERVICE_HOST"):
+        from .k8s.client import RestClusterView
+
         clientset = RestClientset(base_url=args.kube_api, token=args.kube_token)
+        # the controller consumes the same watch/list/get surface either way
+        cluster = RestClusterView(clientset)
     else:
         print(
             "error: no cluster — use --fake-nodes N, --kube-api URL, or run "

@@ -190,3 +190,74 @@ class RestClientset(Clientset):
     def create_event(self, event):
         ns = (event.get("involvedObject") or {}).get("namespace", "default")
         self._req("POST", f"/api/v1/namespaces/{ns}/events", event)
+
+
+class RestClusterView:
+    """Controller-facing view of a real API server: the same
+    watch_pods/stop_watch/list_pods/get_pod surface FakeCluster provides,
+    backed by RestClientset with a streaming watch
+    (GET /api/v1/pods?watch=true), so controller/controller.py runs unchanged
+    against either (the reference's SharedInformerFactory analogue,
+    controller.go:55-102)."""
+
+    def __init__(self, rest: "RestClientset", reconnect_delay: float = 1.0):
+        self.rest = rest
+        self.reconnect_delay = reconnect_delay
+        self._stops: dict[int, "threading.Event"] = {}
+
+    # -- reads delegate ------------------------------------------------------
+
+    def list_pods(self, label_selector=None, field_selector=None):
+        return self.rest.list_pods(label_selector, field_selector)
+
+    def get_pod(self, namespace, name):
+        return self.rest.get_pod(namespace, name)
+
+    # -- streaming watch -----------------------------------------------------
+
+    def watch_pods(self):
+        import queue as _queue
+        import threading as _threading
+
+        q: _queue.Queue = _queue.Queue()
+        stop = _threading.Event()
+        self._stops[id(q)] = stop
+        t = _threading.Thread(
+            target=self._watch_loop, args=(q, stop), daemon=True,
+            name="rest-watch",
+        )
+        t.start()
+        return q
+
+    def stop_watch(self, q):
+        stop = self._stops.pop(id(q), None)
+        if stop is not None:
+            stop.set()
+
+    def _watch_loop(self, q, stop):
+        import time as _time
+
+        while not stop.is_set():
+            try:
+                url = self.rest.base_url + "/api/v1/pods?watch=true"
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self.rest.token:
+                    req.add_header("Authorization", f"Bearer {self.rest.token}")
+                ctx = self.rest.ctx if url.startswith("https") else None
+                with urllib.request.urlopen(req, context=ctx, timeout=330) as resp:
+                    for raw in resp:
+                        if stop.is_set():
+                            return
+                        raw = raw.strip()
+                        if not raw:
+                            continue
+                        evt = json.loads(raw)
+                        etype = evt.get("type", "")
+                        obj = evt.get("object") or {}
+                        if etype in ("ADDED", "MODIFIED", "DELETED"):
+                            q.put((etype, Pod.from_dict(obj)))
+            except Exception:
+                if stop.is_set():
+                    return
+                _time.sleep(self.reconnect_delay)

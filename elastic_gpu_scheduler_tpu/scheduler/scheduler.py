@@ -41,6 +41,7 @@ from ..core.request import TPURequest, request_from_pod
 from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
+from ..metrics import CHIPS_ALLOCATED
 from ..utils import consts
 
 log = logging.getLogger("tpu-scheduler")
@@ -237,6 +238,7 @@ class TPUUnitScheduler(ResourceScheduler):
                     node=node_name,
                 )
             )
+            self._update_node_gauge(node_name)
             self._record_event(
                 pod, "Normal", "Scheduled",
                 f"bound to {node_name} "
@@ -251,6 +253,14 @@ class TPUUnitScheduler(ResourceScheduler):
                 pod, "Warning", "FailedScheduling", f"bind to {node_name}: {e}"
             )
             raise
+
+    def _update_node_gauge(self, node_name: str) -> None:
+        na = self.allocators.get(node_name)
+        if na is not None:
+            CHIPS_ALLOCATED.set(
+                node_name,
+                value=na.chips.total_core() - na.chips.avail_core(),
+            )
 
     def _record_event(self, pod: Pod, etype: str, reason: str, message: str):
         """Record a k8s Event for a scheduling outcome.  The reference wires
@@ -340,6 +350,7 @@ class TPUUnitScheduler(ResourceScheduler):
             na = self.allocators.get(node_name)
             if na is not None:
                 na.forget(opt)
+            self._update_node_gauge(node_name)
             self.released_pods[pod.key] = pod.metadata.uid
             while len(self.released_pods) > self.released_pods_max:
                 self.released_pods.pop(next(iter(self.released_pods)))

@@ -1,0 +1,138 @@
+"""RestClientset + RestClusterView against a miniature in-process API server
+speaking the real wire protocol (JSON REST + chunked watch stream)."""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.k8s.client import RestClientset, RestClusterView
+from elastic_gpu_scheduler_tpu.k8s.fake import ApiError
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod_dict(name, core=100):
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: core}
+                ),
+            )
+        ],
+    ).to_dict()
+
+
+class MiniApiServer:
+    """Three routes: list pods, get pod, watch stream (two events then hold)."""
+
+    def __init__(self):
+        self.pods = {"default/p1": tpu_pod_dict("p1")}
+        self.watch_started = threading.Event()
+        self.release_second_event = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/api/v1/pods?watch=true"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def chunk(obj):
+                        data = (json.dumps(obj) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                        )
+                        self.wfile.flush()
+
+                    outer.watch_started.set()
+                    chunk({"type": "ADDED", "object": tpu_pod_dict("w1")})
+                    outer.release_second_event.wait(timeout=10)
+                    chunk({"type": "MODIFIED", "object": tpu_pod_dict("w1")})
+                    # then hold the stream open briefly
+                    time.sleep(0.5)
+                elif self.path == "/api/v1/pods":
+                    body = json.dumps(
+                        {"items": list(outer.pods.values())}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.startswith("/api/v1/namespaces/default/pods/"):
+                    name = self.path.rsplit("/", 1)[-1]
+                    pod = outer.pods.get(f"default/{name}")
+                    if pod is None:
+                        err = json.dumps(
+                            {"reason": "NotFound", "message": name}
+                        ).encode()
+                        self.send_response(404)
+                        self.send_header("Content-Length", str(len(err)))
+                        self.end_headers()
+                        self.wfile.write(err)
+                        return
+                    body = json.dumps(pod).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def api():
+    server = MiniApiServer()
+    yield server
+    server.stop()
+
+
+def test_rest_get_and_list(api):
+    rest = RestClientset(base_url=f"http://127.0.0.1:{api.port}")
+    pods = rest.list_pods()
+    assert [p.metadata.name for p in pods] == ["p1"]
+    p = rest.get_pod("default", "p1")
+    assert p.metadata.name == "p1"
+    with pytest.raises(ApiError) as exc:
+        rest.get_pod("default", "missing")
+    assert exc.value.reason == "NotFound"
+
+
+def test_rest_watch_stream_delivers_events(api):
+    rest = RestClientset(base_url=f"http://127.0.0.1:{api.port}")
+    view = RestClusterView(rest)
+    q = view.watch_pods()
+    etype, pod = q.get(timeout=5)
+    assert etype == "ADDED" and pod.metadata.name == "w1"
+    api.release_second_event.set()
+    etype, pod = q.get(timeout=5)
+    assert etype == "MODIFIED" and pod.metadata.name == "w1"
+    view.stop_watch(q)
