@@ -311,11 +311,30 @@ def model_bench_on_tpu():
         n_params = param_count(params)
         tok = 8 * 1024
         tflops = 2 * n_params * tok / (fwd_ms / 1000) / 1e12
+        # decode throughput: KV-cache steps chain through the cache
+        from elastic_gpu_scheduler_tpu.models.generate import KVCache, decode_step
+        import functools as _ft
+
+        dstep = jax.jit(_ft.partial(decode_step, cfg=cfg))
+        B = 8
+        cache = KVCache.empty(cfg, B, 128)
+        tok = jnp.zeros((B,), jnp.int32)
+        logits, cache = dstep(params, tok, cache)
+        _ = float(logits[0, 0])  # compile + sync
+        t0 = _time.perf_counter()
+        d_iters = 32
+        for _i in range(d_iters):
+            logits, cache = dstep(params, jnp.argmax(logits, -1), cache)
+        _ = float(logits[0, 0])
+        decode_ms = (_time.perf_counter() - t0) * 1000 / d_iters
+
         return {
             "tpu_model_fwd_ms": round(fwd_ms, 3),
             "tpu_model_train_step_ms": round(step_ms, 3),
             "tpu_model_fwd_tflops": round(tflops, 2),
             "tpu_model_params_m": round(n_params / 1e6, 2),
+            "tpu_decode_ms_per_token": round(decode_ms, 3),
+            "tpu_decode_tokens_per_s": round(B * 1000 / decode_ms, 1),
         }
     except Exception as e:  # pragma: no cover
         return {"tpu_model_bench_error": str(e)[:200]}
