@@ -1,0 +1,7 @@
+"""``python -m elastic_gpu_scheduler_tpu`` → the scheduler CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,1 +1,14 @@
-"""core subpackage of elastic_gpu_scheduler_tpu."""
+"""Core scheduling domain: topology, chips, allocation, raters, annotations."""
+
+from .allocator import ChipSet, ContainerAlloc, Option, Rater
+from .chip import CORE_PER_CHIP, Chip
+from .node import NodeAllocator, chips_from_node
+from .rater import RATERS, get_rater
+from .request import TPURequest, TPUUnit, request_from_pod
+from .topology import Coord, Topology
+
+__all__ = [
+    "ChipSet", "ContainerAlloc", "Option", "Rater", "CORE_PER_CHIP", "Chip",
+    "NodeAllocator", "chips_from_node", "RATERS", "get_rater", "TPURequest",
+    "TPUUnit", "request_from_pod", "Coord", "Topology",
+]

@@ -1,1 +1,9 @@
-"""models subpackage of elastic_gpu_scheduler_tpu."""
+"""Workload-plane models: transformer LM, MoE, training, generation, data."""
+
+from .transformer import TransformerConfig, forward, forward_with_aux, init_params
+from .train import make_jitted_train_step, make_optimizer, init_sharded_state
+
+__all__ = [
+    "TransformerConfig", "forward", "forward_with_aux", "init_params",
+    "make_jitted_train_step", "make_optimizer", "init_sharded_state",
+]

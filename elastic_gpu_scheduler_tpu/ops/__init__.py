@@ -1,1 +1,5 @@
-"""ops subpackage of elastic_gpu_scheduler_tpu."""
+"""TPU kernels (Pallas) with portable fallbacks."""
+
+from .attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

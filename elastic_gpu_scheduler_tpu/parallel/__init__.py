@@ -1,1 +1,9 @@
-"""parallel subpackage of elastic_gpu_scheduler_tpu."""
+"""Parallelism: 6-axis mesh, sharding rules, ring attention, pipeline."""
+
+from .mesh import AXES, MeshSpec, make_mesh, mesh_from_allocation
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "AXES", "MeshSpec", "make_mesh", "mesh_from_allocation",
+    "ring_attention", "ring_attention_sharded",
+]
