@@ -160,11 +160,21 @@ class Controller:
 
     def _enqueue_all(self) -> None:
         try:
+            listed: set[str] = set()
             for pod in self.cluster.list_pods():
                 if self._admit(pod):
+                    listed.add(pod.key)
                     with self._seen_lock:
                         self._last_seen[pod.key] = pod
                     self.wq.add(pod.key)
+            # pods we have seen but the list no longer returns were deleted
+            # during a watch gap (REST reconnect); enqueue them so sync_pod
+            # observes NotFound and releases their chips — without this, a
+            # DELETED event lost across a reconnect leaks the allocation
+            with self._seen_lock:
+                vanished = [k for k in self._last_seen if k not in listed]
+            for k in vanished:
+                self.wq.add(k)
         except Exception as e:
             log.warning("resync list failed: %s", e)
 

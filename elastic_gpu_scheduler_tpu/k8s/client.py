@@ -123,7 +123,11 @@ class RestClientset(Clientset):
         else:
             self.ctx = ssl.create_default_context()
 
-    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def prepare(
+        self, path: str, method: str = "GET", body: Optional[dict] = None
+    ) -> tuple[urllib.request.Request, Optional[ssl.SSLContext]]:
+        """Build an authenticated request + TLS context for an API path
+        (shared by unary calls and the streaming watch)."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -132,8 +136,12 @@ class RestClientset(Clientset):
             req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        ctx = self.ctx if url.startswith("https") else None
+        return req, ctx
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        req, ctx = self.prepare(path, method, body)
         try:
-            ctx = self.ctx if url.startswith("https") else None
             with urllib.request.urlopen(req, context=ctx, timeout=30) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
@@ -239,12 +247,7 @@ class RestClusterView:
 
         while not stop.is_set():
             try:
-                url = self.rest.base_url + "/api/v1/pods?watch=true"
-                req = urllib.request.Request(url)
-                req.add_header("Accept", "application/json")
-                if self.rest.token:
-                    req.add_header("Authorization", f"Bearer {self.rest.token}")
-                ctx = self.rest.ctx if url.startswith("https") else None
+                req, ctx = self.rest.prepare("/api/v1/pods?watch=true")
                 with urllib.request.urlopen(req, context=ctx, timeout=330) as resp:
                     for raw in resp:
                         if stop.is_set():

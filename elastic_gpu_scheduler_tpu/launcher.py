@@ -113,14 +113,8 @@ def run_job(
         if spec.dataset_path
         else SyntheticTokenDataset(spec.model.vocab_size, seed=spec.seed)
     )
-    batch_iter = batches(
-        source,
-        batch_size=spec.batch_size,
-        seq_len=spec.seq_len,
-        seed=spec.seed + 1,
-        process_index=proc_idx,
-        process_count=proc_count,
-    )
+    # batch_iter is created after the checkpoint restore below so a resumed
+    # run fast-forwards the stream to start_step for free (per-index RNG)
 
     start_step = 0
     ckpt = None
@@ -133,9 +127,16 @@ def run_job(
             params, opt_state, start_step = restored
             log.info("resumed from step %d", start_step)
 
-    # a resumed run must continue the batch stream, not replay it
-    for _ in range(start_step):
-        next(batch_iter)
+    # a resumed run continues the batch stream, not replays it
+    batch_iter = batches(
+        source,
+        batch_size=spec.batch_size,
+        seq_len=spec.seq_len,
+        seed=spec.seed + 1,
+        process_index=proc_idx,
+        process_count=proc_count,
+        start_batch=start_step,
+    )
 
     losses = []
     for step in range(start_step, spec.steps):

@@ -32,11 +32,12 @@ class MemmapTokenDataset:
     def window(self, start: int, length: int) -> np.ndarray:
         """A contiguous `length`-token window; `start` is taken modulo the
         valid range so any 64-bit start is usable."""
-        if len(self.tokens) <= length:
+        if len(self.tokens) < length:
             raise ValueError(
                 f"{self.path}: {len(self.tokens)} tokens < window {length}"
             )
-        start = int(start) % (len(self.tokens) - length)
+        valid = len(self.tokens) - length
+        start = 0 if valid == 0 else int(start) % valid
         return np.asarray(self.tokens[start : start + length], dtype=np.int32)
 
 
@@ -78,19 +79,23 @@ def batches(
     process_index: int = 0,
     process_count: int = 1,
     max_batches: Optional[int] = None,
+    start_batch: int = 0,
 ) -> Iterator[np.ndarray]:
     """Yields (local_batch, seq_len+1) int32 arrays (inputs+shift target).
 
     ``batch_size`` is the GLOBAL batch; each process yields its slice.
+    Each batch index gets its own RNG derived from (seed, index), so
+    ``start_batch`` fast-forwards a resumed run in O(1) — no arrays are
+    built for skipped batches — while the stream stays identical.
     """
     if batch_size % process_count:
         raise ValueError(
             f"global batch {batch_size} not divisible by {process_count} processes"
         )
     local = batch_size // process_count
-    rng = np.random.default_rng(seed)
-    i = 0
-    while max_batches is None or i < max_batches:
+    i = start_batch
+    while max_batches is None or i < start_batch + max_batches:
+        rng = np.random.default_rng([seed, i])
         rows = []
         for b in range(batch_size):
             if isinstance(source, MemmapTokenDataset):

@@ -289,3 +289,33 @@ def test_version_health_metrics(stack):
     code, text = get(port, "/metrics")
     assert code == 200
     assert "tpu_scheduler_verb_duration_seconds" in text
+
+
+def test_resync_recovers_missed_delete(stack):
+    """A DELETED event lost in a watch gap (REST reconnect) must still be
+    reconciled: the periodic resync enqueues vanished pods so their chips
+    are released."""
+    cluster, clientset, port, controller = stack
+    pod = tpu_pod("ghosted", core=200)
+    node, _ = schedule_pod(cluster, port, pod)
+    # wait until the controller has observed the pod at least once
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with controller._seen_lock:
+            if "default/ghosted" in controller._last_seen:
+                break
+        time.sleep(0.02)
+    # simulate a missed DELETED event: remove the pod without notifying
+    with cluster._lock:
+        del cluster._pods["default/ghosted"]
+    controller._enqueue_all()  # what the periodic resync does
+    deadline = time.time() + 5
+    ok = False
+    while time.time() < deadline:
+        code, st = get(port, "/scheduler/status")
+        chips = st["schedulers"][0]["nodes"][node]["chips"]
+        if all(c["core_avail"] == 100 for c in chips.values()):
+            ok = True
+            break
+        time.sleep(0.05)
+    assert ok, "chips were not released after the missed delete"
