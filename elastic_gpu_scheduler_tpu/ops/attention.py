@@ -70,6 +70,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
     q_offset = q_block_idx * block_q
 
     num_k_blocks = seq_k // block_k
+    if causal:
+        # blocks entirely above the diagonal are fully masked — skip them
+        # (the last visited block still applies the element-wise mask)
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, pl.cdiv(q_offset + block_q, block_k)
+        )
 
     def body(j, carry):
         acc, m_i, l_i = carry
