@@ -315,3 +315,54 @@ def test_gang_spans_slices_only_as_last_resort():
         r = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
         placed.append(r.node_names[0] if r.node_names else None)
     assert sorted(placed) == ["sl-a-h0", "sl-b-h0"]
+
+
+def test_gang_1024_replicas_on_v5p_2048_scale():
+    """Scale test: v5p-2048 (1024 chips, 256 hosts, 8x16x8 mesh), a
+    1024-member whole-chip gang.  Planning must stay sub-second (cursor
+    planner + native enumerator) and pack 100%."""
+    cluster = FakeCluster()
+    hosts = []
+    i = 0
+    for x in range(0, 8, 2):
+        for y in range(0, 16, 2):
+            for z in range(8):
+                name = f"v5p2048-h{i}"
+                cluster.add_node(
+                    make_tpu_node(
+                        name, chips=4, hbm_gib=380, accelerator="v5p",
+                        slice_topology="8x16x8", host_topology="2x2x1",
+                        host_offset=f"{x}.{y}.{z}", slice_name="v5p-2048",
+                    )
+                )
+                hosts.append(name)
+                i += 1
+    assert len(hosts) == 256
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="ici-locality",
+        gang_timeout=120.0,
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    req_pod = gang_pod("probe-0", "mega", 1024, core=100)
+    cluster.create_pod(req_pod)
+    t0 = time.time()
+    filt = predicate.handle(ExtenderArgs(pod=req_pod, node_names=hosts))
+    plan_s = time.time() - t0
+    assert filt.node_names, filt.failed_nodes
+    assert plan_s < 2.0, f"planning took {plan_s:.2f}s"
+    # claim the remaining 1023 slots (each filter is a dict lookup now)
+    t0 = time.time()
+    for i in range(1, 1024):
+        p = gang_pod(f"probe-{i}", "mega", 1024, core=100)
+        cluster.create_pod(p)
+        r = predicate.handle(ExtenderArgs(pod=p, node_names=hosts))
+        assert r.node_names, r.failed_nodes
+    claim_s = time.time() - t0
+    st = gang.status()
+    assert st["plans"]["default/mega"]["claimed"] == 1024
+    # every host appears exactly 4 times (4 chips per host, 1 chip/member)
+    from collections import Counter
+
+    slots = Counter(gang._plans["default/mega"].slots)
+    assert all(v == 4 for v in slots.values()) and len(slots) == 256
+    print(f"\nplan {plan_s*1000:.0f}ms, 1023 claims {claim_s*1000:.0f}ms")
