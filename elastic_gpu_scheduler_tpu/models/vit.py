@@ -1,0 +1,144 @@
+"""Second model family: Vision Transformer (image classification).
+
+Shares the TPU-first machinery of the flagship LM — flash attention
+(non-causal), RMSNorm/SwiGLU blocks, stacked-layer ``lax.scan``, and the
+same parameter naming so parallel/sharding.py's rules shard it unchanged
+(wq/wk/wv column-parallel, wo row-parallel, etc.).  Patchify is a single
+reshape+matmul (MXU-native; no conv needed for square non-overlapping
+patches).
+
+No reference analogue (SURVEY §2 #19) — workload-plane breadth: one
+framework, multiple model families over the same mesh axes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from ..ops.attention import flash_attention
+from .transformer import rms_norm
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    n_classes: int = 10
+    d_model: int = 192
+    n_layers: int = 6
+    n_heads: int = 6
+    d_ff: int = 512
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def init_vit_params(key: jax.Array, cfg: ViTConfig) -> dict:
+    D, H, F, L = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff, cfg.n_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    k = iter(jax.random.split(key, 16))
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5
+
+    return {
+        "patch_embed": dense(next(k), (patch_dim, D), patch_dim),
+        "pos_embed": dense(next(k), (cfg.n_patches + 1, D), D) * 0.02,
+        "cls_token": jnp.zeros((D,), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": dense(next(k), (L, D, H), D),
+            "wk": dense(next(k), (L, D, H), D),
+            "wv": dense(next(k), (L, D, H), D),
+            "wo": dense(next(k), (L, H, D), H),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "w_in": dense(next(k), (L, D, F), D),
+            "w_gate": dense(next(k), (L, D, F), D),
+            "w_out": dense(next(k), (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "head": dense(next(k), (D, cfg.n_classes), D),
+    }
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, C) → (B, N, patch*patch*C) non-overlapping patches."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, gh, gw, p, p, C)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def _vit_layer(x, p, cfg: ViTConfig):
+    """Pre-norm bidirectional block. x: (B, N+1, D)."""
+    B, S, D = x.shape
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    dtype = jnp.dtype(cfg.dtype)
+
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ p["wq"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    o = flash_attention(q, k, v, False, None)  # bidirectional
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, Hn * Dh)
+    x = x + (o @ p["wo"].astype(dtype))
+
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+    up = h @ p["w_in"].astype(dtype)
+    x = x + ((gate * up) @ p["w_out"].astype(dtype))
+    return x
+
+
+def forward_vit(params: dict, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """images: (B, H, W, C) float → logits (B, n_classes)."""
+    dtype = jnp.dtype(cfg.dtype)
+    patches = patchify(images.astype(dtype), cfg.patch_size)
+    x = patches @ params["patch_embed"].astype(dtype)  # (B, N, D)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(
+        params["cls_token"].astype(dtype), (B, 1, cfg.d_model)
+    )
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"].astype(dtype)
+
+    layer_fn = lambda h, p: (_vit_layer(h, p, cfg), None)
+    if cfg.remat:
+        inner = jax.checkpoint(lambda h, p: _vit_layer(h, p, cfg))
+        layer_fn = lambda h, p: (inner(h, p), None)
+    x, _ = lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = x[:, 0, :] @ params["head"].astype(dtype)  # CLS token
+    return logits.astype(jnp.float32)
+
+
+def vit_loss(params, images, labels, cfg: ViTConfig) -> jax.Array:
+    logits = forward_vit(params, images, cfg)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def make_vit_train_step(cfg: ViTConfig, optimizer, mesh: Mesh = None):
+    import optax
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(vit_loss)(params, images, labels, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

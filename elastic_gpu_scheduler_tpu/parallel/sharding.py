@@ -40,10 +40,16 @@ def param_specs(params: Any, pipeline: bool = False) -> Any:
         name = "/".join(path)
         nd = leaf.ndim
         in_layers = "layers" in name
+        if "pos_embed" in name or "cls_token" in name:
+            return P()  # small positional/cls params: replicated
+        if "patch_embed" in name:
+            return P("fsdp", "tensor")  # (patch_dim, D) dense projection
         if "unembed" in name:  # must precede the "embed" substring check
             return P("fsdp", "tensor")
         if "embed" in name:
             return P("tensor", "fsdp")
+        if name.endswith("head"):
+            return P("fsdp", None)  # (D, n_classes): classes too small to shard
         if "moe_gate" in name:
             return P(lead) if in_layers else P()  # router: replicated
         if any(k in name for k in ("wq", "wk", "wv", "w_in", "w_gate")):
