@@ -411,6 +411,37 @@ def main():
     per_pod += pod_lats
     server.stop()
 
+    # scale: whole-gang planning time for 1024 members on a v5p-2048 mesh
+    cluster = FakeCluster()
+    i = 0
+    for x in range(0, 8, 2):
+        for y in range(0, 16, 2):
+            for z in range(8):
+                cluster.add_node(
+                    make_tpu_node(
+                        f"xl-h{i}", chips=4, hbm_gib=380, accelerator="v5p",
+                        slice_topology="8x16x8", host_topology="2x2x1",
+                        host_offset=f"{x}.{y}.{z}", slice_name="v5p-2048",
+                    )
+                )
+                i += 1
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority="ici-locality"
+    )
+    xl_pod = tpu_pod("xl-probe", core=100, gang="xl", gang_size=1024)
+    cluster.create_pod(xl_pod)
+    from elastic_gpu_scheduler_tpu.k8s.extender import ExtenderArgs
+
+    t0 = time.perf_counter()
+    filt = predicate.handle(
+        ExtenderArgs(pod=xl_pod, node_names=[f"xl-h{j}" for j in range(256)])
+    )
+    assert filt.node_names, filt.failed_nodes
+    results["v5p2048_gang1024_plan_ms"] = round(
+        (time.perf_counter() - t0) * 1000, 3
+    )
+
     results.update(model_bench_on_tpu())
 
     headline = p99(per_pod) * 1000

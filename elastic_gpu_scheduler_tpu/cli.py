@@ -108,6 +108,12 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
+    try:
+        get_rater(args.priority)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
     cluster = None
     if args.fake_nodes > 0:
         cluster = FakeCluster()

@@ -168,3 +168,31 @@ def test_sharded_matches_unsharded():
     params_s = shardlib.shard_params(params, mesh)
     out = jax.jit(lambda p, t: forward(p, t, cfg, mesh=None))(params_s, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_long_context_causality():
+    """Long context beyond toy size: 8192 tokens over the 8-device seq axis
+    (1024/shard).  The O(S^2) full reference is too big to compare, so assert
+    the defining properties instead: finite outputs, and perturbing the LAST
+    sequence shard leaves the FIRST shard's outputs bit-identical (causality
+    across ring hops)."""
+    mesh = make_mesh(MeshSpec(seq=8, fsdp=1))
+    key = jax.random.key(11)
+    B, H, S, D = 1, 2, 8192, 32
+    q, k, v = (
+        jax.random.normal(kk, (B, H, S, D), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    f = jax.jit(lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=True))
+    out_a = f(q, k, v)
+    assert bool(jnp.all(jnp.isfinite(out_a)))
+    # perturb the final shard's keys/values/queries
+    k2 = k.at[:, :, -1024:, :].add(1.0)
+    v2 = v.at[:, :, -1024:, :].add(1.0)
+    out_b = f(q, k2, v2)
+    np.testing.assert_array_equal(
+        np.asarray(out_a[:, :, :7168]), np.asarray(out_b[:, :, :7168])
+    )
+    assert not np.allclose(
+        np.asarray(out_a[:, :, -1024:]), np.asarray(out_b[:, :, -1024:])
+    )
