@@ -1,0 +1,193 @@
+"""Continuous-batching inference engine (slot-based KV cache pool).
+
+Serving-side subsystem of the workload plane: requests join and leave a
+fixed-shape batch *between* decode steps, so the TPU always steps one static
+(B_max, …) computation while work arrives and finishes asynchronously —
+the standard continuous-batching design, kept XLA-friendly:
+
+- one KV cache of shape (L, B_max, max_len, H, Dh); a slot per request;
+- per-slot ``length`` and ``active`` vectors; finished/empty slots keep
+  computing (masked, harmless) so shapes never change;
+- prefill is decode-steps over the prompt (models/generate.py math) into
+  the slot's cache region; admission happens between steps;
+- greedy or temperature sampling per slot.
+
+No reference analogue (SURVEY §2 #19); this is the inference-serving
+capability slot of a complete framework.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import KVCache
+from .transformer import TransformerConfig, rms_norm, rope
+from ..ops.attention import NEG_INF
+
+
+@dataclass
+class Request:
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    done: threading.Event = field(default_factory=threading.Event)
+    output: list[int] = field(default_factory=list)
+
+
+def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
+    """One decode step for every slot at its own position.
+
+    tokens: (B,) int32; cache_k/v: (L, B, M, H, Dh); lengths: (B,) int32
+    (position each slot writes at).  Returns (logits (B,V), new_k, new_v).
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    B = tokens.shape[0]
+    M = cache_k.shape[2]
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    x = params["embed"].astype(dtype)[tokens][:, None, :]  # (B,1,D)
+
+    def layer_step(x, scanned):
+        p, ck, cv = scanned  # ck/cv: (B, M, H, Dh)
+        h = rms_norm(x, p["attn_norm"])
+        q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        # rope at each slot's own position (vmap over batch)
+        rope_b = jax.vmap(
+            lambda xb, pos: rope(xb[None], pos[None], cfg.rope_theta)[0]
+        )
+        q = rope_b(q, lengths)
+        k = rope_b(k, lengths)
+        # write k/v at per-slot positions
+        onehot = jax.nn.one_hot(lengths, M, dtype=ck.dtype)  # (B, M)
+        ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k
+        cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v
+        # attend over each slot's valid prefix
+        qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
+        kT = ck.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,M,Dh)
+        vT = cv.transpose(0, 2, 1, 3).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh**-0.5)
+        pos_ids = jnp.arange(M)[None, None, None, :]
+        s = jnp.where(pos_ids <= lengths[:, None, None, None], s, NEG_INF)
+        pr = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, vT)
+        o = o.transpose(0, 2, 1, 3).astype(dtype).reshape(B, 1, Hn * Dh)
+        x = x + (o @ p["wo"].astype(dtype))
+        h = rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+        up = h @ p["w_in"].astype(dtype)
+        x = x + ((gate * up) @ p["w_out"].astype(dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["unembed"].astype(dtype))[:, 0, :]
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+class InferenceEngine:
+    """Slot-based continuous batching over a fixed (B_max, max_len) cache."""
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        max_batch: int = 8,
+        max_len: int = 512,
+    ):
+        assert cfg.n_experts == 0, "serving engine supports dense models"
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len
+        dtype = jnp.dtype(cfg.dtype)
+        shape = (cfg.n_layers, max_batch, max_len, cfg.n_heads, cfg.head_dim)
+        self.cache_k = jnp.zeros(shape, dtype)
+        self.cache_v = jnp.zeros(shape, dtype)
+        self.lengths = np.zeros(max_batch, np.int32)
+        self.slots: list[Optional[Request]] = [None] * max_batch
+        self.pending_prompt: list[list[int]] = [[] for _ in range(max_batch)]
+        self.emitted: np.ndarray = np.zeros(max_batch, np.int32)
+        self.next_token = np.zeros(max_batch, np.int32)
+        self.queue: "queue.Queue[Request]" = queue.Queue()
+        self._step = jax.jit(
+            functools.partial(_batched_decode_step, cfg=cfg)
+        )
+        self._rng = np.random.default_rng(0)
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        self.queue.put(req)
+        return req
+
+    def run_until_idle(self, max_steps: int = 10_000) -> None:
+        """Drive decode steps until no request is active or queued."""
+        for _ in range(max_steps):
+            self._admit()
+            if not any(s is not None for s in self.slots):
+                if self.queue.empty():
+                    return
+                continue
+            self.step()
+        raise RuntimeError("run_until_idle: step budget exhausted")
+
+    # -- engine internals ----------------------------------------------------
+
+    def _admit(self) -> None:
+        for i in range(self.max_batch):
+            if self.slots[i] is not None:
+                continue
+            try:
+                req = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            assert len(req.prompt) >= 1
+            assert len(req.prompt) + req.max_new_tokens <= self.max_len
+            self.slots[i] = req
+            self.pending_prompt[i] = list(req.prompt[1:])
+            self.next_token[i] = req.prompt[0]
+            self.lengths[i] = 0
+            self.emitted[i] = 0
+            # zero the slot's cache region
+            self.cache_k = self.cache_k.at[:, i].set(0)
+            self.cache_v = self.cache_v.at[:, i].set(0)
+
+    def step(self) -> None:
+        """One batched decode step across all slots (prefill + generate)."""
+        tokens = jnp.asarray(self.next_token)
+        lengths = jnp.asarray(self.lengths)
+        logits, self.cache_k, self.cache_v = self._step(
+            self.params, tokens, self.cache_k, self.cache_v, lengths
+        )
+        logits_np = np.asarray(logits)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.lengths[i] += 1
+            if self.pending_prompt[i]:
+                # still prefilling: feed the next prompt token
+                self.next_token[i] = self.pending_prompt[i].pop(0)
+                continue
+            # generating
+            if req.temperature > 0:
+                z = logits_np[i] / req.temperature
+                z = z - z.max()
+                p = np.exp(z) / np.exp(z).sum()
+                tok = int(self._rng.choice(len(p), p=p))
+            else:
+                tok = int(np.argmax(logits_np[i]))
+            req.output.append(tok)
+            self.emitted[i] += 1
+            self.next_token[i] = tok
+            if self.emitted[i] >= req.max_new_tokens:
+                req.done.set()
+                self.slots[i] = None
