@@ -100,6 +100,8 @@ def main(argv=None) -> int:
         help="controller worker threads",
     )
     p.add_argument("--gang-timeout", type=float, default=30.0)
+    p.add_argument("--tls-cert", default="", help="serve HTTPS with this cert")
+    p.add_argument("--tls-key", default="")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -150,7 +152,8 @@ def main(argv=None) -> int:
         controller.start()
 
     server = ExtenderServer(
-        predicate, prioritize, bind, status, host=args.host, port=args.port
+        predicate, prioritize, bind, status, host=args.host, port=args.port,
+        tls_cert=args.tls_cert, tls_key=args.tls_key,
     )
 
     stop = threading.Event()

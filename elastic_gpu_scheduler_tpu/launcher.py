@@ -148,3 +148,90 @@ def run_job(
     if ckpt and spec.checkpoint_every:
         ckpt.save(params, opt_state, spec.steps)
     return losses
+
+
+def main(argv=None) -> int:
+    """In-pod entrypoint: ``python -m elastic_gpu_scheduler_tpu.launcher``.
+
+    Reads the scheduler's allocation from the downward-API annotations file
+    (``--annotations``; a k8s "metadata.annotations" fieldRef volume) or the
+    device plugin's TPU_VISIBLE_CHIPS env, builds the mesh, trains."""
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser("tpu-launcher")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--data", default="", help="memmap token file (else synthetic)")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--container", default="main")
+    p.add_argument(
+        "--mesh", default="",
+        help="axis sizes, e.g. 'tensor=2,seq=2' (product must match devices)",
+    )
+    p.add_argument(
+        "--annotations", default="",
+        help="downward-API file with pod annotations (key=\"value\" lines)",
+    )
+    p.add_argument("--profile-dir", default="", help="write a jax profiler trace")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    mesh_kwargs = {}
+    if args.mesh:
+        for part in args.mesh.split(","):
+            k, _, v = part.partition("=")
+            mesh_kwargs[k.strip()] = int(v)
+    n_dev = len(jax.devices())
+    spec_sizes = {"data": 1, "fsdp": 1, "expert": 1, "pipe": 1, "tensor": 1, "seq": 1}
+    spec_sizes.update(mesh_kwargs)
+    from .parallel.mesh import MeshSpec
+
+    prod = 1
+    for v in spec_sizes.values():
+        prod *= v
+    if prod != n_dev:  # absorb the remainder into data parallelism
+        if n_dev % prod == 0:
+            spec_sizes["data"] *= n_dev // prod
+        else:
+            print(f"error: mesh product {prod} incompatible with {n_dev} devices")
+            return 2
+    spec = MeshSpec(**spec_sizes)
+
+    annotations = {}
+    if args.annotations and os.path.exists(args.annotations):
+        # downward-API format: one `key="value"` per line
+        for line in open(args.annotations):
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            k, _, v = line.partition("=")
+            annotations[k] = _json.loads(v) if v.startswith('"') else v
+
+    job = JobSpec(
+        mesh=spec,
+        steps=args.steps,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        lr=args.lr,
+        dataset_path=args.data,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+    losses = run_job(job, pod_annotations=annotations, container=args.container)
+    if args.profile_dir:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", args.profile_dir)
+    print(f"trained {len(losses)} steps; final loss {losses[-1]:.4f}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys as _sys
+
+    _sys.exit(main())

@@ -50,6 +50,8 @@ class ExtenderServer:
         status_fn: Callable[[], dict],
         host: str = "0.0.0.0",
         port: int = 39999,
+        tls_cert: str = "",
+        tls_key: str = "",
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -57,8 +59,21 @@ class ExtenderServer:
         self.status_fn = status_fn
         self.host = host
         self.port = port
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _maybe_wrap_tls(self, httpd) -> None:
+        """Serve HTTPS when a cert/key pair is configured (the extender
+        config's enableHTTPS option; the reference is HTTP-only)."""
+        if not self.tls_cert:
+            return
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.tls_cert, self.tls_key or None)
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
 
     # -- request plumbing ----------------------------------------------------
 
@@ -156,6 +171,7 @@ class ExtenderServer:
         self._httpd = _HTTPServer(
             (self.host, self.port), self._make_handler()
         )
+        self._maybe_wrap_tls(self._httpd)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="extender-http", daemon=True
@@ -168,6 +184,7 @@ class ExtenderServer:
         self._httpd = _HTTPServer(
             (self.host, self.port), self._make_handler()
         )
+        self._maybe_wrap_tls(self._httpd)
         self._httpd.serve_forever()
 
     def stop(self) -> None:
