@@ -115,6 +115,15 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.tls_key and not args.tls_cert:
+        print("error: --tls-key given without --tls-cert", file=sys.stderr)
+        return 2
+    if args.tls_cert and not os.path.exists(args.tls_cert):
+        print(f"error: --tls-cert {args.tls_cert}: no such file", file=sys.stderr)
+        return 2
+    if args.tls_key and not os.path.exists(args.tls_key):
+        print(f"error: --tls-key {args.tls_key}: no such file", file=sys.stderr)
+        return 2
 
     cluster = None
     if args.fake_nodes > 0:

@@ -180,24 +180,41 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    mesh_kwargs = {}
+    import sys as _sys
+
+    from .parallel.mesh import AXES, MeshSpec
+
+    spec_sizes = {a: 1 for a in AXES}
     if args.mesh:
         for part in args.mesh.split(","):
             k, _, v = part.partition("=")
-            mesh_kwargs[k.strip()] = int(v)
+            k = k.strip()
+            if k not in spec_sizes:
+                print(
+                    f"error: unknown mesh axis {k!r}; choose from {list(AXES)}",
+                    file=_sys.stderr,
+                )
+                return 2
+            try:
+                spec_sizes[k] = int(v)
+            except ValueError:
+                print(
+                    f"error: mesh axis {k}={v!r} is not an integer",
+                    file=_sys.stderr,
+                )
+                return 2
     n_dev = len(jax.devices())
-    spec_sizes = {"data": 1, "fsdp": 1, "expert": 1, "pipe": 1, "tensor": 1, "seq": 1}
-    spec_sizes.update(mesh_kwargs)
-    from .parallel.mesh import MeshSpec
-
     prod = 1
     for v in spec_sizes.values():
         prod *= v
     if prod != n_dev:  # absorb the remainder into data parallelism
-        if n_dev % prod == 0:
+        if prod > 0 and n_dev % prod == 0:
             spec_sizes["data"] *= n_dev // prod
         else:
-            print(f"error: mesh product {prod} incompatible with {n_dev} devices")
+            print(
+                f"error: mesh product {prod} incompatible with {n_dev} devices",
+                file=_sys.stderr,
+            )
             return 2
     spec = MeshSpec(**spec_sizes)
 
@@ -227,7 +244,10 @@ def main(argv=None) -> int:
     if args.profile_dir:
         jax.profiler.stop_trace()
         log.info("profiler trace written to %s", args.profile_dir)
-    print(f"trained {len(losses)} steps; final loss {losses[-1]:.4f}")
+    if losses:
+        print(f"trained {len(losses)} steps; final loss {losses[-1]:.4f}")
+    else:
+        print("no steps to run (already complete or --steps 0)")
     return 0
 
 

@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import KVCache
 from .transformer import TransformerConfig, rms_norm, rope
 from ..ops.attention import NEG_INF
 
@@ -40,6 +39,7 @@ class Request:
     temperature: float = 0.0
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
+    error: str = ""  # set (with done) when the request is rejected
 
 
 def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
@@ -126,6 +126,22 @@ class InferenceEngine:
     # -- public API ----------------------------------------------------------
 
     def submit(self, req: Request) -> Request:
+        """Validate and enqueue; invalid requests are failed immediately
+        (req.error set, done signaled) rather than poisoning the loop."""
+        if len(req.prompt) < 1:
+            req.error = "empty prompt"
+            req.done.set()
+            return req
+        if len(req.prompt) + req.max_new_tokens > self.max_len:
+            req.error = (
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len {self.max_len}"
+            )
+            req.done.set()
+            return req
+        if req.max_new_tokens <= 0:
+            req.done.set()  # nothing to generate
+            return req
         self.queue.put(req)
         return req
 
@@ -150,16 +166,13 @@ class InferenceEngine:
                 req = self.queue.get_nowait()
             except queue.Empty:
                 return
-            assert len(req.prompt) >= 1
-            assert len(req.prompt) + req.max_new_tokens <= self.max_len
             self.slots[i] = req
             self.pending_prompt[i] = list(req.prompt[1:])
             self.next_token[i] = req.prompt[0]
             self.lengths[i] = 0
             self.emitted[i] = 0
-            # zero the slot's cache region
-            self.cache_k = self.cache_k.at[:, i].set(0)
-            self.cache_v = self.cache_v.at[:, i].set(0)
+            # no cache zeroing needed: the position mask only exposes
+            # positions <= length, all of which the new request rewrites
 
     def step(self) -> None:
         """One batched decode step across all slots (prefill + generate)."""

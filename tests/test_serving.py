@@ -60,3 +60,33 @@ def test_more_requests_than_slots():
     engine.run_until_idle()
     assert all(r.done.is_set() for r in reqs)
     assert all(len(r.output) == 3 for r in reqs)
+
+
+def test_invalid_requests_rejected_cleanly():
+    params = init_params(jax.random.key(0), CFG)
+    engine = InferenceEngine(params, CFG, max_batch=2, max_len=8)
+    too_big = engine.submit(Request(prompt=[1] * 6, max_new_tokens=5))
+    assert too_big.done.is_set() and "exceeds max_len" in too_big.error
+    empty = engine.submit(Request(prompt=[], max_new_tokens=3))
+    assert empty.done.is_set() and empty.error == "empty prompt"
+    zero = engine.submit(Request(prompt=[1], max_new_tokens=0))
+    assert zero.done.is_set() and zero.output == [] and zero.error == ""
+    # a valid request still runs to completion alongside the rejections
+    ok = engine.submit(Request(prompt=[2, 3], max_new_tokens=2))
+    engine.run_until_idle()
+    assert ok.done.is_set() and len(ok.output) == 2 and ok.error == ""
+
+
+def test_slot_reuse_no_stale_leakage():
+    """A slot reused by a second request must produce the same output as a
+    fresh engine (no stale KV from the first tenant)."""
+    params = init_params(jax.random.key(0), CFG)
+    engine = InferenceEngine(params, CFG, max_batch=1, max_len=16)
+    a = engine.submit(Request(prompt=[7, 8, 9], max_new_tokens=4))
+    engine.run_until_idle()
+    b = engine.submit(Request(prompt=[11, 12], max_new_tokens=4))
+    engine.run_until_idle()
+    fresh = InferenceEngine(params, CFG, max_batch=1, max_len=16)
+    c = fresh.submit(Request(prompt=[11, 12], max_new_tokens=4))
+    fresh.run_until_idle()
+    assert b.output == c.output
