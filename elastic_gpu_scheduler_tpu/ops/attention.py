@@ -204,3 +204,129 @@ def _bwd(causal, sm_scale, res, do):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# -- blockwise kernel with softmax stats (ring-attention inner step) ---------
+
+
+def _flash_stats_kernel(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, pv_ref, m_ref, l_ref,
+    *, block_k, sm_scale, causal,
+):
+    """One (batch, q-block) program over ALL heads; emits unnormalized
+    (pv, m, l) so callers (parallel/ring.py) can merge across K/V shards.
+
+    Head dim stays inside the block so the rank-3 stats outputs tile as
+    (1, H, block_q) — H equals the full axis and block_q is lane-sized,
+    satisfying Mosaic's (sublane, lane) constraints.  Global q/k offsets
+    arrive as SMEM scalars (they vary per ring hop).
+    """
+    import jax.experimental.pallas as pl
+
+    H = q_ref.shape[1]
+    block_q = q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    q_offset = qoff_ref[0, 0] + pl.program_id(1) * block_q
+    k_offset = koff_ref[0, 0]
+
+    num_k_blocks = seq_k // block_k
+
+    for h in range(H):  # static unroll over heads
+        q = q_ref[0, h].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+        def body(j, carry):
+            acc, m_i, l_i = carry
+            k_blk = k_ref[0, h, pl.ds(j * block_k, block_k), :].astype(
+                jnp.float32
+            )
+            v_blk = v_ref[0, h, pl.ds(j * block_k, block_k), :].astype(
+                jnp.float32
+            )
+            s = jax.lax.dot_general(
+                q, k_blk,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                q_ids = q_offset + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_ids = (
+                    k_offset
+                    + j * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                )
+                s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_i - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l_i * alpha + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v_blk,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        acc, m_i, l_i = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+        pv_ref[0, h] = acc
+        m_ref[0, h] = m_i
+        l_ref[0, h] = l_i
+
+
+def flash_block_stats(
+    q, k, v, q_offset, k_offset,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Blockwise attention with stats: (B,H,Sq,D) x (B,H,Sk,D) →
+    (pv (B,H,Sq,D) fp32 unnormalized, m (B,H,Sq), l (B,H,Sq)).
+
+    ``q_offset``/``k_offset`` are global sequence starts (scalars, may be
+    traced) for cross-shard causal masking — the ring-attention inner step.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    grid = (b, sq // block_q)
+    kernel = functools.partial(
+        _flash_stats_kernel, block_k=block_k, sm_scale=scale, causal=causal
+    )
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    pv, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bi, qi: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda bi, qi: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, h, block_q, d), lambda bi, qi: (bi, 0, qi, 0)),
+            pl.BlockSpec((1, h, sk, d), lambda bi, qi: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, h, sk, d), lambda bi, qi: (bi, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, block_q, d), lambda bi, qi: (bi, 0, qi, 0)),
+            pl.BlockSpec((1, h, block_q), lambda bi, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, h, block_q), lambda bi, qi: (bi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, q, k, v)
+    return pv, m, l

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import NEG_INF
+from ..ops.attention import NEG_INF, _use_pallas, flash_block_stats
 
 
 def _block_attend(q, k, v, q_offset, k_offset, causal, scale):
@@ -34,7 +34,13 @@ def _block_attend(q, k, v, q_offset, k_offset, causal, scale):
 
     q: (B,H,Sq,D) local queries; k/v: (B,H,Sk,D) a rotating shard.
     Offsets are the shards' global sequence starts, for causal masking.
+    On TPU the Pallas stats kernel (ops/attention.flash_block_stats) computes
+    the same triple without materializing the (Sq, Sk) score matrix in HBM.
     """
+    if _use_pallas() and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0:
+        return flash_block_stats(
+            q, k, v, q_offset, k_offset, causal=causal, sm_scale=scale
+        )
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale

@@ -196,3 +196,23 @@ def test_ring_attention_long_context_causality():
     assert not np.allclose(
         np.asarray(out_a[:, :, -1024:]), np.asarray(out_b[:, :, -1024:])
     )
+
+
+def test_flash_block_stats_matches_ring_reference():
+    """The Pallas stats kernel (interpret mode) equals the ring-attention
+    reference block math at several global offsets."""
+    from elastic_gpu_scheduler_tpu.ops.attention import flash_block_stats
+    from elastic_gpu_scheduler_tpu.parallel.ring import _block_attend
+
+    B, H, S, D = 2, 4, 256, 64
+    key = jax.random.key(0)
+    q, k, v = (
+        jax.random.normal(kk, (B, H, S, D), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    for qo, ko in [(0, 0), (256, 0), (0, 256), (512, 256)]:
+        ref_pv, ref_m, ref_l = _block_attend(q, k, v, qo, ko, True, D**-0.5)
+        pv, m, l = flash_block_stats(q, k, v, qo, ko, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(ref_m), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(pv), np.asarray(ref_pv), rtol=1e-2, atol=1e-2)
