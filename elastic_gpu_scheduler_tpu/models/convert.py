@@ -1,0 +1,104 @@
+"""Weight import: HF/torch Llama-architecture checkpoints → our params.
+
+The flagship transformer (models/transformer.py) is architecturally a
+Llama-family decoder (RMSNorm pre-norm, SwiGLU MLP, non-interleaved RoPE, no
+biases), so HF ``LlamaForCausalLM`` weights map 1:1:
+
+    model.embed_tokens.weight        → embed            (V, D)
+    layers.N.input_layernorm         → attn_norm[N]     (D,)
+    layers.N.self_attn.{q,k,v}_proj  → wq/wk/wv[N]      (D, H)   [transposed]
+    layers.N.self_attn.o_proj        → wo[N]            (H, D)   [transposed]
+    layers.N.post_attention_layernorm→ mlp_norm[N]      (D,)
+    layers.N.mlp.gate_proj           → w_gate[N]        (D, F)   [transposed]
+    layers.N.mlp.up_proj             → w_in[N]          (D, F)   [transposed]
+    layers.N.mlp.down_proj           → w_out[N]         (F, D)   [transposed]
+    model.norm                       → final_norm       (D,)
+    lm_head.weight                   → unembed          (D, V)   [transposed]
+
+Restriction: multi-head attention only (num_key_value_heads == num_heads);
+GQA is a planned variant.  Conversion runs on CPU numpy — no torch on the
+TPU path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor (or array) → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf_llama(hf_config) -> TransformerConfig:
+    assert (
+        getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads)
+        == hf_config.num_attention_heads
+    ), "GQA checkpoints not supported (num_key_value_heads != num_heads)"
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        dtype="float32",
+    )
+
+
+def params_from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
+    """Build our param pytree from an HF LlamaForCausalLM state_dict."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    L = cfg.n_layers
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        mats = []
+        for i in range(L):
+            w = sd[fmt.format(i)]
+            mats.append(w.T if transpose else w)
+        return np.stack(mats)
+
+    embed = sd["model.embed_tokens.weight"]  # (V, D)
+    if "lm_head.weight" in sd:
+        unembed = sd["lm_head.weight"].T  # (D, V)
+    else:  # tied embeddings
+        unembed = embed.T.copy()
+
+    params = {
+        "embed": jnp.asarray(embed),
+        "layers": {
+            "attn_norm": jnp.asarray(
+                stack("model.layers.{}.input_layernorm.weight", False)
+            ),
+            "wq": jnp.asarray(
+                stack("model.layers.{}.self_attn.q_proj.weight", True)
+            ),
+            "wk": jnp.asarray(
+                stack("model.layers.{}.self_attn.k_proj.weight", True)
+            ),
+            "wv": jnp.asarray(
+                stack("model.layers.{}.self_attn.v_proj.weight", True)
+            ),
+            "wo": jnp.asarray(
+                stack("model.layers.{}.self_attn.o_proj.weight", True)
+            ),
+            "mlp_norm": jnp.asarray(
+                stack("model.layers.{}.post_attention_layernorm.weight", False)
+            ),
+            "w_gate": jnp.asarray(
+                stack("model.layers.{}.mlp.gate_proj.weight", True)
+            ),
+            "w_in": jnp.asarray(stack("model.layers.{}.mlp.up_proj.weight", True)),
+            "w_out": jnp.asarray(
+                stack("model.layers.{}.mlp.down_proj.weight", True)
+            ),
+        },
+        "final_norm": jnp.asarray(sd["model.norm.weight"]),
+        "unembed": jnp.asarray(unembed),
+    }
+    return params
