@@ -18,17 +18,17 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import NEG_INF
-from .transformer import TransformerConfig, rms_norm, rope
+from .transformer import TransformerConfig, repeat_kv, rms_norm, rope
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # (L, B, max_len, H, Dh)
-    v: jax.Array  # (L, B, max_len, H, Dh)
+    k: jax.Array  # (L, B, max_len, Hkv, Dh)
+    v: jax.Array  # (L, B, max_len, Hkv, Dh)
     length: jax.Array  # () int32 — valid prefix length
 
     @classmethod
     def empty(cls, cfg: TransformerConfig, batch: int, max_len: int) -> "KVCache":
-        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
         dtype = jnp.dtype(cfg.dtype)
         return cls(
             k=jnp.zeros(shape, dtype),
@@ -64,15 +64,19 @@ def decode_step(
     def layer_step(x, scanned):
         p, ck, cv = scanned  # per-layer params + cache slices
         h = rms_norm(x, p["attn_norm"])
+        Hkv = cfg.kv_heads
         q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hkv, Dh)
+        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hkv, Dh)
         posv = jnp.full((1,), pos)
         q = rope(q, posv, cfg.rope_theta)
         k = rope(k, posv, cfg.rope_theta)
         ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        o = _cached_attention(q, ck, cv, pos).reshape(B, 1, Hn * Dh)
+        n_rep = Hn // Hkv
+        o = _cached_attention(
+            q, repeat_kv(ck, n_rep), repeat_kv(cv, n_rep), pos
+        ).reshape(B, 1, Hn * Dh)
         x = x + (o @ p["wo"].astype(dtype))
         h = rms_norm(x, p["mlp_norm"])
         if cfg.n_experts > 0:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import TransformerConfig, rms_norm, rope
+from .transformer import TransformerConfig, repeat_kv, rms_norm, rope
 from ..ops.attention import NEG_INF
 
 
@@ -57,9 +57,10 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
     def layer_step(x, scanned):
         p, ck, cv = scanned  # ck/cv: (B, M, H, Dh)
         h = rms_norm(x, p["attn_norm"])
+        Hkv = cfg.kv_heads
         q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hkv, Dh)
+        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hkv, Dh)
         # rope at each slot's own position (vmap over batch)
         rope_b = jax.vmap(
             lambda xb, pos: rope(xb[None], pos[None], cfg.rope_theta)[0]
@@ -70,10 +71,11 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
         onehot = jax.nn.one_hot(lengths, M, dtype=ck.dtype)  # (B, M)
         ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k
         cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v
-        # attend over each slot's valid prefix
+        # attend over each slot's valid prefix (GQA: expand grouped heads)
+        n_rep = Hn // Hkv
         qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
-        kT = ck.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,M,Dh)
-        vT = cv.transpose(0, 2, 1, 3).astype(jnp.float32)
+        kT = repeat_kv(ck, n_rep).transpose(0, 2, 1, 3).astype(jnp.float32)
+        vT = repeat_kv(cv, n_rep).transpose(0, 2, 1, 3).astype(jnp.float32)
         s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh**-0.5)
         pos_ids = jnp.arange(M)[None, None, None, :]
         s = jnp.where(pos_ids <= lengths[:, None, None, None], s, NEG_INF)
@@ -109,7 +111,7 @@ class InferenceEngine:
         self.max_batch = max_batch
         self.max_len = max_len
         dtype = jnp.dtype(cfg.dtype)
-        shape = (cfg.n_layers, max_batch, max_len, cfg.n_heads, cfg.head_dim)
+        shape = (cfg.n_layers, max_batch, max_len, cfg.kv_heads, cfg.head_dim)
         self.cache_k = jnp.zeros(shape, dtype)
         self.cache_v = jnp.zeros(shape, dtype)
         self.lengths = np.zeros(max_batch, np.int32)

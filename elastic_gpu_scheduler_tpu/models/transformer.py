@@ -40,6 +40,7 @@ class TransformerConfig:
     n_layers: int = 4
     n_heads: int = 8
     d_ff: int = 1376
+    n_kv_heads: int = 0  # 0 → MHA; 0 < n_kv_heads < n_heads → GQA
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"  # compute dtype; params stay float32
     remat: bool = False
@@ -53,12 +54,17 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
 
 # -- init --------------------------------------------------------------------
 
 
 def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     D, H, F, L, V = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    KV = cfg.kv_heads * cfg.head_dim
     k = iter(jax.random.split(key, 16))
 
     def dense(key, shape, fan_in):
@@ -67,8 +73,8 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     layers = {
         "attn_norm": jnp.ones((L, D), jnp.float32),
         "wq": dense(next(k), (L, D, H), D),
-        "wk": dense(next(k), (L, D, H), D),
-        "wv": dense(next(k), (L, D, H), D),
+        "wk": dense(next(k), (L, D, KV), D),
+        "wv": dense(next(k), (L, D, KV), D),
         "wo": dense(next(k), (L, H, D), H),
         "mlp_norm": jnp.ones((L, D), jnp.float32),
     }
@@ -120,8 +126,21 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """(B,S,Hkv,Dh) → (B,S,Hkv*n_rep,Dh): expand grouped KV heads for GQA."""
+    if n_rep == 1:
+        return k
+    B, S, Hkv, Dh = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (B, S, Hkv, n_rep, Dh)
+    ).reshape(B, S, Hkv * n_rep, Dh)
+
+
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     """(B,S,H,Dh) → (B,S,H,Dh), dispatching to ring or flash attention."""
+    n_rep = cfg.n_heads // cfg.kv_heads
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
     qT = q.transpose(0, 2, 1, 3)  # (B,H,S,Dh)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
@@ -140,9 +159,10 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
     p = layer_params
 
     h = rms_norm(x, p["attn_norm"])
+    Hkv = cfg.kv_heads
     q = (h @ p["wq"].astype(dtype)).reshape(B, S, Hn, Dh)
-    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hn, Dh)
-    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hn, Dh)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hkv, Dh)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hkv, Dh)
     positions = jnp.arange(S)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)

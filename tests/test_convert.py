@@ -63,10 +63,29 @@ def test_greedy_generation_matches_hf(hf_model):
     np.testing.assert_array_equal(ours, hf_out)
 
 
-def test_gqa_rejected():
-    cfg = transformers.LlamaConfig(
+def test_gqa_logits_match_hf():
+    """Grouped-query attention cross-check against transformers."""
+    cfg_hf = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=False,
     )
-    with pytest.raises(AssertionError, match="GQA"):
-        config_from_hf_llama(cfg)
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(cfg_hf)
+    model.eval()
+    cfg = config_from_hf_llama(model.config)
+    assert cfg.n_kv_heads == 2
+    params = params_from_hf_llama(model.state_dict(), cfg)
+    tokens = np.array([[1, 2, 3, 4, 5, 6]])
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+    # and the KV-cache decode path (GQA cache shape)
+    out = generate(params, jnp.asarray(tokens), cfg, max_new_tokens=4)
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor(tokens), max_new_tokens=4, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+    np.testing.assert_array_equal(np.asarray(out), hf_out)
