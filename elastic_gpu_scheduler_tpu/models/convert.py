@@ -15,8 +15,9 @@ biases), so HF ``LlamaForCausalLM`` weights map 1:1:
     model.norm                       → final_norm       (D,)
     lm_head.weight                   → unembed          (D, V)   [transposed]
 
-GQA checkpoints (num_key_value_heads < num_heads) map via
-``n_kv_heads``.  Conversion runs on CPU numpy — no torch on the TPU path.
+GQA checkpoints (num_key_value_heads < num_heads) map via ``n_kv_heads``;
+Mistral-style sliding windows map via ``window_size``.  Conversion runs on
+CPU numpy — no torch on the TPU path.
 """
 
 from __future__ import annotations
@@ -36,12 +37,14 @@ def _np(t) -> np.ndarray:
 
 def config_from_hf_llama(hf_config) -> TransformerConfig:
     kv = getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads)
+    window = getattr(hf_config, "sliding_window", None) or 0
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
         n_kv_heads=0 if kv == hf_config.num_attention_heads else kv,
+        window_size=int(window),
         d_ff=hf_config.intermediate_size,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         dtype="float32",

@@ -37,7 +37,7 @@ class KVCache(NamedTuple):
         )
 
 
-def _cached_attention(q, cache_k, cache_v, length):
+def _cached_attention(q, cache_k, cache_v, length, window=0):
     """q: (B, 1, H, Dh) at position `length`; cache: (B, max_len, H, Dh)."""
     qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
     kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,S,Dh)
@@ -45,7 +45,10 @@ def _cached_attention(q, cache_k, cache_v, length):
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale  # (B,H,1,S)
     positions = jnp.arange(s.shape[-1])
-    s = jnp.where(positions[None, None, None, :] <= length, s, NEG_INF)
+    keep = positions[None, None, None, :] <= length
+    if window > 0:
+        keep = keep & (length - positions[None, None, None, :] < window)
+    s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
     return o.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,1,H,Dh)
@@ -75,7 +78,8 @@ def decode_step(
         cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
         n_rep = Hn // Hkv
         o = _cached_attention(
-            q, repeat_kv(ck, n_rep), repeat_kv(cv, n_rep), pos
+            q, repeat_kv(ck, n_rep), repeat_kv(cv, n_rep), pos,
+            window=cfg.window_size,
         ).reshape(B, 1, Hn * Dh)
         x = x + (o @ p["wo"].astype(dtype))
         h = rms_norm(x, p["mlp_norm"])

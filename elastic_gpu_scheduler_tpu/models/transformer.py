@@ -41,6 +41,7 @@ class TransformerConfig:
     n_heads: int = 8
     d_ff: int = 1376
     n_kv_heads: int = 0  # 0 → MHA; 0 < n_kv_heads < n_heads → GQA
+    window_size: int = 0  # >0 → sliding-window attention (Mistral-style)
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"  # compute dtype; params stay float32
     remat: bool = False
@@ -145,9 +146,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
     if cfg.use_ring_attention and mesh is not None:
+        assert cfg.window_size == 0, "sliding window + ring attention TBD"
         oT = ring_attention_sharded(qT, kT, vT, mesh, causal=True)
     else:
-        oT = flash_attention(qT, kT, vT, True, None)
+        oT = flash_attention(qT, kT, vT, True, None, cfg.window_size)
     return oT.transpose(0, 2, 1, 3)
 
 

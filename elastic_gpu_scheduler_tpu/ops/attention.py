@@ -33,17 +33,26 @@ def mha_reference(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (out, lse).  Shapes: q,k,v = (B, H, S, D); out same as q;
-    lse = (B, H, S) logsumexp of scaled scores (the flash residual)."""
+    lse = (B, H, S) logsumexp of scaled scores (the flash residual).
+    ``window`` > 0 adds sliding-window masking: position q attends only to
+    k in (q - window, q] (Mistral-style local attention)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
-    if causal:
+    if causal or window > 0:
         sq, sk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        q_ids = jnp.arange(sq)[:, None] + (sk - sq)
+        k_ids = jnp.arange(sk)[None, :]
+        mask = jnp.ones((sq, sk), dtype=bool)
+        if causal:
+            mask &= q_ids >= k_ids
+        if window > 0:
+            mask &= (q_ids - k_ids) < window
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     p = jnp.exp(logits - lse[..., None])
@@ -56,7 +65,8 @@ def mha_reference(
 # -- Pallas TPU kernel -------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
+                  window=0):
     """One (batch, head, q-block) program; streams K/V blocks from VMEM."""
     import jax.experimental.pallas as pl
 
@@ -70,11 +80,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
     q_offset = q_block_idx * block_q
 
     num_k_blocks = seq_k // block_k
+    start_block = 0
     if causal:
         # blocks entirely above the diagonal are fully masked — skip them
         # (the last visited block still applies the element-wise mask)
         num_k_blocks = jnp.minimum(
             num_k_blocks, pl.cdiv(q_offset + block_q, block_k)
+        )
+    if window > 0:
+        # blocks entirely below the sliding window are also fully masked
+        start_block = jnp.maximum(
+            0, (q_offset - window + 1) // block_k
         )
 
     def body(j, carry):
@@ -86,14 +102,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
-        if causal:
+        if causal or window > 0:
             q_ids = q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            keep = jnp.ones((block_q, block_k), jnp.bool_)
+            if causal:
+                keep &= q_ids >= k_ids
+            if window > 0:
+                keep &= (q_ids - k_ids) < window
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
         alpha = jnp.exp(m_i - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -108,13 +129,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal):
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m_i, l_i = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    acc, m_i, l_i = jax.lax.fori_loop(
+        start_block, num_k_blocks, body, (acc0, m0, l0)
+    )
 
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret, window=0):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -127,7 +151,8 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret
     )
     grid = (b, h, sq // block_q)
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal
+        _flash_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal,
+        window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -158,27 +183,30 @@ def _use_pallas() -> bool:
 # -- public op with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
-    """Flash attention.  q,k,v: (batch, heads, seq, head_dim) → out like q."""
-    return _forward(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None, window: int = 0):
+    """Flash attention.  q,k,v: (batch, heads, seq, head_dim) → out like q.
+    ``window`` > 0 enables sliding-window (local) attention."""
+    return _forward(q, k, v, causal, sm_scale, window)
 
 
-def _forward(q, k, v, causal, sm_scale):
+def _forward(q, k, v, causal, sm_scale, window=0):
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
     if _use_pallas():
         return _flash_forward_pallas(
-            q, k, v, causal, scale, block_q=128, block_k=128, interpret=False
+            q, k, v, causal, scale, block_q=128, block_k=128, interpret=False,
+            window=window,
         )
-    return mha_reference(q, k, v, causal, scale)[0]
+    return mha_reference(q, k, v, causal, scale, window=window)[0]
 
 
-def _fwd(q, k, v, causal, sm_scale):
-    out = _forward(q, k, v, causal, sm_scale)
+def _fwd(q, k, v, causal, sm_scale, window):
+    out = _forward(q, k, v, causal, sm_scale, window)
     return out, (q, k, v, out)
 
 
-def _bwd(causal, sm_scale, res, do):
+def _bwd(causal, sm_scale, window, res, do):
     """Recompute backward (standard flash-attention gradient algebra);
     the LSE is recomputed here rather than saved by the kernel."""
     q, k, v, out = res
@@ -188,9 +216,15 @@ def _bwd(causal, sm_scale, res, do):
     vf = v.astype(jnp.float32)
     dof = do.astype(jnp.float32)
     logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-    if causal:
+    if causal or window > 0:
         sq, sk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        q_ids = jnp.arange(sq)[:, None] + (sk - sq)
+        k_ids = jnp.arange(sk)[None, :]
+        mask = jnp.ones((sq, sk), dtype=bool)
+        if causal:
+            mask &= q_ids >= k_ids
+        if window > 0:
+            mask &= (q_ids - k_ids) < window
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     p = jnp.exp(logits - lse[..., None])  # (B,H,Sq,Sk)

@@ -216,3 +216,21 @@ def test_flash_block_stats_matches_ring_reference():
         np.testing.assert_allclose(np.asarray(m), np.asarray(ref_m), rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l), rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(np.asarray(pv), np.asarray(ref_pv), rtol=1e-2, atol=1e-2)
+
+
+def test_flash_kernel_sliding_window_interpret():
+    from elastic_gpu_scheduler_tpu.ops.attention import _flash_forward_pallas
+
+    B, H, S, D = 1, 2, 384, 64
+    key = jax.random.key(5)
+    q, k, v = (
+        jax.random.normal(kk, (B, H, S, D), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    for w in (64, 200):
+        ref, _ = mha_reference(q, k, v, causal=True, window=w)
+        out = _flash_forward_pallas(
+            q, k, v, causal=True, sm_scale=D**-0.5, block_q=128, block_k=128,
+            interpret=True, window=w,
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
