@@ -36,6 +36,21 @@ def _np(t) -> np.ndarray:
 
 
 def config_from_hf_llama(hf_config) -> TransformerConfig:
+    # refuse silently-wrong conversions: features our forward doesn't model
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError(
+            "rope_scaling (e.g. llama3 long-context scaling) not supported"
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(
+        hf_config, "mlp_bias", False
+    ):
+        raise ValueError("bias terms (attention_bias/mlp_bias) not supported")
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if explicit_hd and explicit_hd != derived_hd:
+        raise ValueError(
+            f"explicit head_dim {explicit_hd} != hidden/heads {derived_hd}"
+        )
     kv = getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads)
     window = getattr(hf_config, "sliding_window", None) or 0
     return TransformerConfig(

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import NEG_INF
-from .transformer import TransformerConfig, repeat_kv, rms_norm, rope
+from .transformer import TransformerConfig, rms_norm, rope
 
 
 class KVCache(NamedTuple):
@@ -37,21 +37,39 @@ class KVCache(NamedTuple):
         )
 
 
-def _cached_attention(q, cache_k, cache_v, length, window=0):
-    """q: (B, 1, H, Dh) at position `length`; cache: (B, max_len, H, Dh)."""
-    qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
-    kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,S,Dh)
+def cached_attention(q, cache_k, cache_v, lengths, window=0):
+    """Single-position attention against a (possibly grouped) KV cache.
+
+    q: (B, 1, H, Dh); cache: (B, max_len, Hkv, Dh) with Hkv dividing H —
+    GQA is handled by a grouped einsum (no cache expansion: the whole point
+    of GQA's decode bandwidth win).  ``lengths``: scalar or (B,) per-slot
+    positions; ``window`` > 0 applies sliding-window masking.
+    """
+    B, _, Hn, Dh = q.shape
+    M = cache_k.shape[1]
+    Hkv = cache_k.shape[2]
+    n_rep = Hn // Hkv
+    scale = Dh**-0.5
+    qg = (
+        q.transpose(0, 2, 1, 3)
+        .reshape(B, Hkv, n_rep, Dh)
+        .astype(jnp.float32)
+    )
+    kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,Hkv,M,Dh)
     vT = cache_v.transpose(0, 2, 1, 3).astype(jnp.float32)
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale  # (B,H,1,S)
-    positions = jnp.arange(s.shape[-1])
-    keep = positions[None, None, None, :] <= length
+    s = jnp.einsum("bgrd,bgkd->bgrk", qg, kT) * scale  # (B,Hkv,n_rep,M)
+    lengths = jnp.asarray(lengths)
+    if lengths.ndim == 0:
+        lengths = lengths[None]
+    lb = lengths[:, None, None, None]  # (B,1,1,1)
+    positions = jnp.arange(M)[None, None, None, :]
+    keep = positions <= lb
     if window > 0:
-        keep = keep & (length - positions[None, None, None, :] < window)
+        keep = keep & (lb - positions < window)
     s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
-    return o.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,1,H,Dh)
+    o = jnp.einsum("bgrk,bgkd->bgrd", p, vT)  # (B,Hkv,n_rep,Dh)
+    return o.reshape(B, Hn, 1, Dh).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def decode_step(
@@ -76,10 +94,8 @@ def decode_step(
         k = rope(k, posv, cfg.rope_theta)
         ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        n_rep = Hn // Hkv
-        o = _cached_attention(
-            q, repeat_kv(ck, n_rep), repeat_kv(cv, n_rep), pos,
-            window=cfg.window_size,
+        o = cached_attention(
+            q, ck, cv, pos, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
         x = x + (o @ p["wo"].astype(dtype))
         h = rms_norm(x, p["mlp_norm"])

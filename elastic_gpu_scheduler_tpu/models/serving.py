@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import TransformerConfig, repeat_kv, rms_norm, rope
+from .generate import cached_attention
+from .transformer import TransformerConfig, rms_norm, rope
 from ..ops.attention import NEG_INF
 
 
@@ -71,17 +72,11 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
         onehot = jax.nn.one_hot(lengths, M, dtype=ck.dtype)  # (B, M)
         ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k
         cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v
-        # attend over each slot's valid prefix (GQA: expand grouped heads)
-        n_rep = Hn // Hkv
-        qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,H,1,Dh)
-        kT = repeat_kv(ck, n_rep).transpose(0, 2, 1, 3).astype(jnp.float32)
-        vT = repeat_kv(cv, n_rep).transpose(0, 2, 1, 3).astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * (Dh**-0.5)
-        pos_ids = jnp.arange(M)[None, None, None, :]
-        s = jnp.where(pos_ids <= lengths[:, None, None, None], s, NEG_INF)
-        pr = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", pr, vT)
-        o = o.transpose(0, 2, 1, 3).astype(dtype).reshape(B, 1, Hn * Dh)
+        # attend over each slot's valid prefix (grouped GQA + window via
+        # the shared cached_attention from generate.py)
+        o = cached_attention(
+            q, ck, cv, lengths, window=cfg.window_size
+        ).reshape(B, 1, Hn * Dh)
         x = x + (o @ p["wo"].astype(dtype))
         h = rms_norm(x, p["mlp_norm"])
         gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
