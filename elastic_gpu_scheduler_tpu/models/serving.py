@@ -22,7 +22,7 @@ import functools
 import queue
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +30,6 @@ import numpy as np
 
 from .generate import cached_attention
 from .transformer import TransformerConfig, rms_norm, rope
-from ..ops.attention import NEG_INF
 
 
 @dataclass
