@@ -177,8 +177,19 @@ def main(argv=None) -> int:
         help="downward-API file with pod annotations (key=\"value\" lines)",
     )
     p.add_argument("--profile-dir", default="", help="write a jax profiler trace")
+    p.add_argument(
+        "--compile-cache", default=os.environ.get("JAX_COMPILE_CACHE", ""),
+        help="persistent XLA compilation cache dir (fast pod restarts)",
+    )
+    p.add_argument(
+        "--metrics-log", default="",
+        help="append per-step {step, loss} JSONL records to this file",
+    )
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     import sys as _sys
 
@@ -244,6 +255,11 @@ def main(argv=None) -> int:
     if args.profile_dir:
         jax.profiler.stop_trace()
         log.info("profiler trace written to %s", args.profile_dir)
+    if args.metrics_log:
+        with open(args.metrics_log, "a") as f:
+            start = job.steps - len(losses)
+            for i, loss in enumerate(losses):
+                f.write(_json.dumps({"step": start + i, "loss": loss}) + "\n")
     if losses:
         print(f"trained {len(losses)} steps; final loss {losses[-1]:.4f}")
     else:
