@@ -1,0 +1,77 @@
+"""Compiled-HLO evidence that the sharding rules produce the intended
+collectives — the scaling-book recipe's 'let XLA insert collectives' step,
+verified rather than assumed."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from elastic_gpu_scheduler_tpu.models.train import (
+    init_sharded_state,
+    make_jitted_train_step,
+    make_optimizer,
+)
+from elastic_gpu_scheduler_tpu.models.transformer import TransformerConfig, forward
+from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128, dtype="float32"
+)
+
+
+def compiled_text(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def test_dp_train_step_all_reduces_gradients():
+    mesh = make_mesh(MeshSpec(data=8))
+    opt = make_optimizer(lr=1e-3)
+    params, opt_state = init_sharded_state(jax.random.key(0), CFG, opt, mesh)
+    step = make_jitted_train_step(CFG, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, 128)
+    tokens = jax.device_put(
+        tokens, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(("data", "fsdp"), None))
+    )
+    txt = jax.jit(step).lower(params, opt_state, tokens).compile().as_text()
+    assert "all-reduce" in txt, "data parallelism must all-reduce gradients"
+
+
+def test_fsdp_forward_all_gathers_params():
+    from elastic_gpu_scheduler_tpu.parallel import sharding as shardlib
+
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    params = init_sharded_state(
+        jax.random.key(0), CFG, make_optimizer(), mesh
+    )[0]
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 128)
+    txt = compiled_text(lambda p, t: forward(p, t, CFG), params, tokens)
+    # XLA may all-gather the sharded params OR keep them sharded and
+    # all-reduce partial matmul results — both are the fsdp contract
+    assert any(
+        op in txt for op in ("all-gather", "all-reduce", "reduce-scatter")
+    ), "fsdp forward must involve a cross-shard collective"
+
+
+def test_tp_forward_has_cross_partition_reduction():
+    mesh = make_mesh(MeshSpec(tensor=8))
+    params = init_sharded_state(
+        jax.random.key(0), CFG, make_optimizer(), mesh
+    )[0]
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 128)
+    txt = compiled_text(lambda p, t: forward(p, t, CFG), params, tokens)
+    # row-parallel wo/w_out matmuls need a cross-partition sum (all-reduce
+    # or fused variants); accept any collective reduction
+    assert any(
+        op in txt for op in ("all-reduce", "reduce-scatter", "all-to-all")
+    ), "tensor parallelism must reduce partial matmul results"
+
+
+def test_ring_attention_uses_collective_permute():
+    from elastic_gpu_scheduler_tpu.parallel.ring import ring_attention_sharded
+
+    mesh = make_mesh(MeshSpec(seq=8))
+    q = jax.random.normal(jax.random.key(0), (1, 2, 64, 16), jnp.float32)
+    txt = compiled_text(
+        lambda q: ring_attention_sharded(q, q, q, mesh, causal=True), q
+    )
+    assert "collective-permute" in txt, "ring hops must be collective-permute"
