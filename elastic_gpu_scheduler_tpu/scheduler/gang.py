@@ -57,6 +57,10 @@ class _Plan:
     slots: list[str]  # one node name per member, mesh-ordered
     claims: dict[str, str] = field(default_factory=dict)  # pod key → node
     created: float = 0.0
+    # the member shape, so LATER plans can reserve this plan's capacity in
+    # their clones (plans don't touch real allocators until bind)
+    member_units: tuple = ()
+    member_containers: tuple = ()
 
     def claim(self, pod_key: str) -> Optional[str]:
         if pod_key in self.claims:
@@ -150,6 +154,8 @@ class GangCoordinator:
                         for n in node_names
                     }
                 plan.created = time.monotonic()
+                plan.member_units = req.units
+                plan.member_containers = req.container_names
                 self._plans[gkey] = plan
                 GANG_EVENTS.inc("planned")
             node = plan.claim(pod.key)
@@ -188,6 +194,29 @@ class GangCoordinator:
                 return _Plan(slots=slots)
         return None
 
+    def _reserve_other_plans(self, sched, clones: dict, get_clone) -> None:
+        """Replay other ACTIVE plans' unbound placements into the clones so
+        concurrent gangs don't double-count the same free chips (caller holds
+        self._lock).  Without this, two gangs planned back-to-back both pass
+        filter against the same capacity and one fails mid-commit."""
+        now = time.monotonic()
+        for other_key, other in self._plans.items():
+            if now - other.created > self.timeout or not other.member_units:
+                continue
+            for idx, node in enumerate(other.slots):
+                cs = get_clone(node)
+                if cs is None:
+                    continue
+                member_req = TPURequest(
+                    pod_uid=f"resv-{other_key}-{idx}",
+                    pod_key=f"resv/{other_key}/{idx}",
+                    units=other.member_units,
+                    container_names=other.member_containers,
+                )
+                opt = cs.trade(member_req, sched.rater)
+                if opt is not None:
+                    cs.transact(opt)
+
     def _plan_on(
         self, sched: TPUUnitScheduler, req: TPURequest, ordered: list[str]
     ) -> Optional[list[str]]:
@@ -198,6 +227,20 @@ class GangCoordinator:
         forward, making planning O(members + nodes) instead of O(m·n)
         (a v5p-2048 gang plans in one pass over 256 hosts)."""
         clones = {}
+
+        def get_clone(name):
+            cs = clones.get(name)
+            if cs is None:
+                with sched.lock:
+                    na = sched._get_allocator(name)
+                if na is None:
+                    return None
+                with na.lock:
+                    cs = na.chips.clone()
+                clones[name] = cs
+            return cs
+
+        self._reserve_other_plans(sched, clones, get_clone)
         slots: list[str] = []
         cursor = 0
         for member in range(req.gang_size):
@@ -210,16 +253,10 @@ class GangCoordinator:
             placed = False
             while cursor < len(ordered):
                 name = ordered[cursor]
-                cs = clones.get(name)
+                cs = get_clone(name)
                 if cs is None:
-                    with sched.lock:
-                        na = sched._get_allocator(name)
-                    if na is None:
-                        cursor += 1
-                        continue
-                    with na.lock:
-                        cs = na.chips.clone()
-                    clones[name] = cs
+                    cursor += 1
+                    continue
                 opt = cs.trade(member_req, sched.rater)
                 if opt is None:
                     cursor += 1  # full for this shape → full for all members

@@ -366,3 +366,49 @@ def test_gang_1024_replicas_on_v5p_2048_scale():
     slots = Counter(gang._plans["default/mega"].slots)
     assert all(v == 4 for v in slots.values()) and len(slots) == 256
     print(f"\nplan {plan_s*1000:.0f}ms, 1023 claims {claim_s*1000:.0f}ms")
+
+
+def test_two_gangs_cannot_double_book_capacity():
+    """Two gangs planned back-to-back must not both claim the same chips:
+    the second plan sees the first plan's reservations and is rejected."""
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(make_tpu_node(f"n{i}", chips=4, hbm_gib=64))
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="binpack",
+        gang_timeout=5.0,
+    )
+    nodes = [f"n{i}" for i in range(4)]
+    # gang A: 4 members x whole node = entire cluster
+    a0 = gang_pod("a-0", "gang-a", 4, core=400)
+    cluster.create_pod(a0)
+    ra = predicate.handle(ExtenderArgs(pod=a0, node_names=nodes))
+    assert ra.node_names, ra.failed_nodes
+    # gang B planned while A is pending: must be infeasible, not double-booked
+    b0 = gang_pod("b-0", "gang-b", 4, core=400)
+    cluster.create_pod(b0)
+    rb = predicate.handle(ExtenderArgs(pod=b0, node_names=nodes))
+    assert rb.node_names == [], "gang B must not double-book gang A's plan"
+    assert all("cannot fit" in v for v in rb.failed_nodes.values())
+
+
+def test_two_small_gangs_coexist():
+    """Reservation-aware planning still packs independent gangs together."""
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(make_tpu_node(f"n{i}", chips=4, hbm_gib=64))
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="binpack",
+        gang_timeout=10.0,
+    )
+    nodes = [f"n{i}" for i in range(4)]
+    placed = {}
+    for gname in ("left", "right"):
+        for m in range(2):
+            p = gang_pod(f"{gname}-{m}", gname, 2, core=400)
+            cluster.create_pod(p)
+            r = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+            assert r.node_names, (gname, m, r.failed_nodes)
+            placed[f"{gname}-{m}"] = r.node_names[0]
+    # four whole-node members over four nodes: all distinct
+    assert len(set(placed.values())) == 4, placed
