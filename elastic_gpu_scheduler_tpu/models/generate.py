@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import NEG_INF
-from .transformer import TransformerConfig, rms_norm, rope
+from .quantize import wmat
+from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 
 
 class KVCache(NamedTuple):
@@ -79,16 +80,16 @@ def decode_step(
     dtype = jnp.dtype(cfg.dtype)
     B = token.shape[0]
     Hn, Dh = cfg.n_heads, cfg.head_dim
-    x = params["embed"].astype(dtype)[token][:, None, :]  # (B,1,D)
+    x = _embed_lookup(params["embed"], token, dtype)[:, None, :]  # (B,1,D)
     pos = cache.length
 
     def layer_step(x, scanned):
         p, ck, cv = scanned  # per-layer params + cache slices
         h = rms_norm(x, p["attn_norm"])
         Hkv = cfg.kv_heads
-        q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hkv, Dh)
-        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hkv, Dh)
+        q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ wmat(p["wk"], dtype)).reshape(B, 1, Hkv, Dh)
+        v = (h @ wmat(p["wv"], dtype)).reshape(B, 1, Hkv, Dh)
         posv = jnp.full((1,), pos)
         q = rope(q, posv, cfg.rope_theta)
         k = rope(k, posv, cfg.rope_theta)
@@ -97,7 +98,7 @@ def decode_step(
         o = cached_attention(
             q, ck, cv, pos, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
-        x = x + (o @ p["wo"].astype(dtype))
+        x = x + (o @ wmat(p["wo"], dtype))
         h = rms_norm(x, p["mlp_norm"])
         if cfg.n_experts > 0:
             from .moe import moe_ffn
@@ -108,16 +109,16 @@ def decode_step(
             )
             x = x + ffn
         else:
-            gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-            up = h @ p["w_in"].astype(dtype)
-            x = x + ((gate * up) @ p["w_out"].astype(dtype))
+            gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+            up = h @ wmat(p["w_in"], dtype)
+            x = x + ((gate * up) @ wmat(p["w_out"], dtype))
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(
         layer_step, x, (params["layers"], cache.k, cache.v)
     )
     x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["unembed"].astype(dtype))[:, 0, :]
+    logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
     return logits.astype(jnp.float32), KVCache(new_k, new_v, pos + 1)
 
 

@@ -18,6 +18,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .quantize import wmat
+
 
 def moe_ffn(
     x: jax.Array,
@@ -42,7 +44,7 @@ def moe_ffn(
     capacity = max(1, int(capacity_factor * tokens / E))
 
     xf = x.reshape(tokens, D)
-    logits = (xf @ gate_w.astype(x.dtype)).astype(jnp.float32)  # (T, E)
+    logits = (xf @ wmat(gate_w, x.dtype)).astype(jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
     expert_prob = jnp.max(probs, axis=-1)  # (T,)
@@ -68,11 +70,11 @@ def moe_ffn(
     ).astype(dtype)
     # expert SwiGLU, batched over the (sharded) E axis
     gate = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dtype))
+        jnp.einsum("ecd,edf->ecf", expert_in, wmat(w_gate, dtype))
     )
-    up = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(dtype))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, wmat(w_in, dtype))
     expert_out = jnp.einsum(
-        "ecf,efd->ecd", gate * up, w_out.astype(dtype)
+        "ecf,efd->ecd", gate * up, wmat(w_out, dtype)
     )
     # combine back: (T, D)
     out = jnp.einsum(

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generate import cached_attention
-from .transformer import TransformerConfig, rms_norm, rope
+from .quantize import wmat
+from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 
 
 @dataclass
@@ -52,15 +53,15 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
     B = tokens.shape[0]
     M = cache_k.shape[2]
     Hn, Dh = cfg.n_heads, cfg.head_dim
-    x = params["embed"].astype(dtype)[tokens][:, None, :]  # (B,1,D)
+    x = _embed_lookup(params["embed"], tokens, dtype)[:, None, :]  # (B,1,D)
 
     def layer_step(x, scanned):
         p, ck, cv = scanned  # ck/cv: (B, M, H, Dh)
         h = rms_norm(x, p["attn_norm"])
         Hkv = cfg.kv_heads
-        q = (h @ p["wq"].astype(dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ p["wk"].astype(dtype)).reshape(B, 1, Hkv, Dh)
-        v = (h @ p["wv"].astype(dtype)).reshape(B, 1, Hkv, Dh)
+        q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
+        k = (h @ wmat(p["wk"], dtype)).reshape(B, 1, Hkv, Dh)
+        v = (h @ wmat(p["wv"], dtype)).reshape(B, 1, Hkv, Dh)
         # rope at each slot's own position (vmap over batch)
         rope_b = jax.vmap(
             lambda xb, pos: rope(xb[None], pos[None], cfg.rope_theta)[0]
@@ -76,16 +77,16 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
         o = cached_attention(
             q, ck, cv, lengths, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
-        x = x + (o @ p["wo"].astype(dtype))
+        x = x + (o @ wmat(p["wo"], dtype))
         h = rms_norm(x, p["mlp_norm"])
-        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-        up = h @ p["w_in"].astype(dtype)
-        x = x + ((gate * up) @ p["w_out"].astype(dtype))
+        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+        up = h @ wmat(p["w_in"], dtype)
+        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params["layers"], cache_k, cache_v))
     x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["unembed"].astype(dtype))[:, 0, :]
+    logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
     return logits.astype(jnp.float32), new_k, new_v
 
 

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from ..ops.attention import flash_attention
+from .quantize import wmat
 from ..parallel.ring import ring_attention_sharded
 
 
@@ -101,6 +102,17 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     }
 
 
+def _embed_lookup(embed, tokens, dtype):
+    """Embedding gather; for int8-quantized tables, gather THEN dequantize
+    (dequantizing first would materialize the dense (V, D) table)."""
+    from .quantize import is_qtensor
+
+    if is_qtensor(embed):
+        rows = embed["q8"][tokens].astype(dtype)
+        return rows * embed["scale"][0].astype(dtype)
+    return embed.astype(dtype)[tokens]
+
+
 def param_count(params) -> int:
     return sum(x.size for x in jax.tree.leaves(params))
 
@@ -162,14 +174,14 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
 
     h = rms_norm(x, p["attn_norm"])
     Hkv = cfg.kv_heads
-    q = (h @ p["wq"].astype(dtype)).reshape(B, S, Hn, Dh)
-    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hkv, Dh)
-    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hkv, Dh)
+    q = (h @ wmat(p["wq"], dtype)).reshape(B, S, Hn, Dh)
+    k = (h @ wmat(p["wk"], dtype)).reshape(B, S, Hkv, Dh)
+    v = (h @ wmat(p["wv"], dtype)).reshape(B, S, Hkv, Dh)
     positions = jnp.arange(S)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     o = _attention(q, k, v, cfg, mesh).reshape(B, S, Hn * Dh)
-    x = x + (o @ p["wo"].astype(dtype))
+    x = x + (o @ wmat(p["wo"], dtype))
 
     h = rms_norm(x, p["mlp_norm"])
     if cfg.n_experts > 0:
@@ -181,9 +193,9 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
         )
         x = x + ffn
     else:
-        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-        up = h @ p["w_in"].astype(dtype)
-        x = x + ((gate * up) @ p["w_out"].astype(dtype))
+        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+        up = h @ wmat(p["w_in"], dtype)
+        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
         aux = jnp.zeros((), jnp.float32)
     return x, aux
 
@@ -196,7 +208,7 @@ def forward_with_aux(
 ) -> tuple[jax.Array, jax.Array]:
     """tokens: (B, S) int32 → (logits (B, S, V), aux_loss scalar)."""
     dtype = jnp.dtype(cfg.dtype)
-    x = params["embed"].astype(dtype)[tokens]  # (B, S, D)
+    x = _embed_lookup(params["embed"], tokens, dtype)  # (B, S, D)
 
     pipelined = (
         cfg.n_microbatches > 0
@@ -228,7 +240,7 @@ def forward_with_aux(
         x, aux = lax.scan(scan_body, x, params["layers"])
         aux_total = jnp.sum(aux)
     x = rms_norm(x, params["final_norm"])
-    logits = x @ params["unembed"].astype(dtype)
+    logits = x @ wmat(params["unembed"], dtype)
     return logits.astype(jnp.float32), aux_total
 
 

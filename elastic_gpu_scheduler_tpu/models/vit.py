@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from ..ops.attention import flash_attention
+from .quantize import wmat
 from .transformer import rms_norm
 
 
@@ -90,17 +91,17 @@ def _vit_layer(x, p, cfg: ViTConfig):
     dtype = jnp.dtype(cfg.dtype)
 
     h = rms_norm(x, p["attn_norm"])
-    q = (h @ p["wq"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
-    k = (h @ p["wk"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
-    v = (h @ p["wv"].astype(dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    q = (h @ wmat(p["wq"], dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    k = (h @ wmat(p["wk"], dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
+    v = (h @ wmat(p["wv"], dtype)).reshape(B, S, Hn, Dh).transpose(0, 2, 1, 3)
     o = flash_attention(q, k, v, False, None)  # bidirectional
     o = o.transpose(0, 2, 1, 3).reshape(B, S, Hn * Dh)
-    x = x + (o @ p["wo"].astype(dtype))
+    x = x + (o @ wmat(p["wo"], dtype))
 
     h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-    up = h @ p["w_in"].astype(dtype)
-    x = x + ((gate * up) @ p["w_out"].astype(dtype))
+    gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+    up = h @ wmat(p["w_in"], dtype)
+    x = x + ((gate * up) @ wmat(p["w_out"], dtype))
     return x
 
 
@@ -108,7 +109,7 @@ def forward_vit(params: dict, images: jax.Array, cfg: ViTConfig) -> jax.Array:
     """images: (B, H, W, C) float → logits (B, n_classes)."""
     dtype = jnp.dtype(cfg.dtype)
     patches = patchify(images.astype(dtype), cfg.patch_size)
-    x = patches @ params["patch_embed"].astype(dtype)  # (B, N, D)
+    x = patches @ wmat(params["patch_embed"], dtype)  # (B, N, D)
     B = x.shape[0]
     cls = jnp.broadcast_to(
         params["cls_token"].astype(dtype), (B, 1, cfg.d_model)
@@ -121,7 +122,7 @@ def forward_vit(params: dict, images: jax.Array, cfg: ViTConfig) -> jax.Array:
         layer_fn = lambda h, p: (inner(h, p), None)
     x, _ = lax.scan(layer_fn, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
-    logits = x[:, 0, :] @ params["head"].astype(dtype)  # CLS token
+    logits = x[:, 0, :] @ wmat(params["head"], dtype)  # CLS token
     return logits.astype(jnp.float32)
 
 
