@@ -1,0 +1,119 @@
+"""Speculative decoding via prompt-lookup (n-gram) drafting.
+
+Draft-model-free speculation: propose the tokens that followed the most
+recent matching n-gram in the context, verify all K proposals with ONE
+batched pass through the cache, and keep the longest prefix the model itself
+would have produced — output is exactly greedy decoding, but repetitive
+text (code, structured data, retrieval contexts) advances several tokens per
+model pass.
+
+Cache rollback is free by design: KVCache entries beyond ``length`` are
+masked out (generate.cached_attention), so rejecting speculated tokens is
+just rewinding the length counter — the rejected K/V rows are overwritten by
+the next write at that position.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import KVCache, decode_step
+from .transformer import TransformerConfig
+
+
+def propose_ngram(context: list[int], n: int, k: int) -> list[int]:
+    """Last-match prompt lookup: find the trailing n-gram earlier in the
+    context and propose the k tokens that followed it."""
+    if len(context) < n + 1:
+        return []
+    tail = context[-n:]
+    # scan right-to-left for the most recent earlier occurrence
+    for start in range(len(context) - n - 1, -1, -1):
+        if context[start : start + n] == tail:
+            follow = context[start + n : start + n + k]
+            return list(follow)
+    return []
+
+
+def speculative_generate(
+    params: dict,
+    prompt: jax.Array,  # (1, S) int32 — single sequence
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    ngram: int = 3,
+    k: int = 5,
+    max_len: int = 0,
+) -> tuple[jax.Array, dict]:
+    """Greedy-equivalent speculative decoding.
+
+    Returns (tokens (1, S+new), stats {"model_passes", "accepted_drafts"}).
+    """
+    assert prompt.shape[0] == 1, "speculative decoding is per-sequence"
+    from .generate import prefill
+
+    S = prompt.shape[1]
+    max_len = max_len or S + max_new_tokens + k + 1
+    cache = KVCache.empty(cfg, 1, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    step_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
+    context: list[int] = [int(t) for t in np.asarray(prompt[0])]
+    produced: list[int] = []
+    passes = 0
+    accepted_total = 0
+
+    next_token = int(jnp.argmax(logits, -1)[0])
+    produced.append(next_token)
+    context.append(next_token)
+
+    while len(produced) < max_new_tokens:
+        budget = max_new_tokens - len(produced)
+        drafts = propose_ngram(context, ngram, min(k, budget - 1))
+        if drafts:
+            # feed [last_accepted, d1..dn]; logits after each position give
+            # the model's own choice to verify the NEXT draft against
+            feed = [context[-1]] + drafts
+            confirmed_len = int(cache.length)
+            toks = jnp.asarray(feed, jnp.int32)[:, None]  # (n+1, 1)
+
+            def body(c, tok):
+                lg, c = decode_step(params, tok, c, cfg)
+                return c, lg
+
+            cache2, logits_seq = jax.lax.scan(body, cache, toks)
+            passes += 1
+            choices = np.asarray(jnp.argmax(logits_seq[:, 0, :], -1))
+            n_accept = 0
+            for i, d in enumerate(drafts):
+                if int(choices[i]) == d:
+                    n_accept += 1
+                else:
+                    break
+            accepted = drafts[:n_accept]
+            # the model's own token after the last accepted draft
+            own = int(choices[n_accept])
+            produced.extend(accepted + [own])
+            context.extend(accepted + [own])
+            accepted_total += n_accept
+            # rewind: confirmed prefix + accepted drafts + 1 own token fed
+            keep = confirmed_len + n_accept + 1
+            cache = KVCache(cache2.k, cache2.v, jnp.asarray(keep, jnp.int32))
+        else:
+            logits, cache = step_fn(
+                params, jnp.asarray([context[-1]], jnp.int32), cache
+            )
+            passes += 1
+            tok = int(jnp.argmax(logits, -1)[0])
+            produced.append(tok)
+            context.append(tok)
+
+    produced = produced[:max_new_tokens]
+    out = jnp.concatenate(
+        [prompt, jnp.asarray(produced, jnp.int32)[None, :]], axis=1
+    )
+    return out, {"model_passes": passes, "accepted_drafts": accepted_total}
