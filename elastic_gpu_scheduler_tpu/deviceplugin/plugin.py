@@ -102,6 +102,8 @@ class TPUDevicePlugin:
         self.resource_name = resource_name
         self._stop = threading.Event()
         self._server: Optional[grpc.Server] = None
+        self._health: dict[str, bool] = {c: True for c, _ in self.chips}
+        self._health_event = threading.Event()  # set → re-announce now
 
     # -- device model --------------------------------------------------------
 
@@ -109,9 +111,24 @@ class TPUDevicePlugin:
         """One device per core unit: ID "<coord>/<unit>" (100 per chip)."""
         devs = []
         for coord, _path in self.chips:
+            health = HEALTHY if self._health.get(coord, True) else "Unhealthy"
             for u in range(self.core_units):
-                devs.append(pb.Device(ID=f"{coord}/{u}", health=HEALTHY))
+                devs.append(pb.Device(ID=f"{coord}/{u}", health=health))
         return devs
+
+    def set_health(self, coord: str, healthy: bool) -> None:
+        """Failure detection hook: mark a chip (un)healthy and re-announce —
+        kubelet then shrinks/restores the node's allocatable, and the
+        scheduler's capacity refresh (core/node.refresh_from_node) follows."""
+        self._health[coord] = healthy
+        self._health_event.set()
+
+    def check_devices(self) -> None:
+        """Re-probe device files; a vanished /dev/accel* marks its chip
+        Unhealthy (no-op for simulated chips without device files)."""
+        for coord, path in self.chips:
+            if path.startswith("/dev/") and os.path.exists("/dev/accel0"):
+                self.set_health(coord, os.path.exists(path))
 
     @staticmethod
     def chip_of_device(device_id: str) -> str:
@@ -126,10 +143,13 @@ class TPUDevicePlugin:
 
     def ListAndWatch(self, request, context):
         yield pb.ListAndWatchResponse(devices=self.device_list())
-        # then keep the stream open, re-announcing on a slow heartbeat
+        # re-announce on health changes immediately, else slow heartbeat
         while not self._stop.is_set():
-            if self._stop.wait(10.0):
+            self._health_event.wait(timeout=10.0)
+            if self._stop.is_set():
                 break
+            self._health_event.clear()
+            self.check_devices()
             yield pb.ListAndWatchResponse(devices=self.device_list())
 
     def GetPreferredAllocation(self, request, context):

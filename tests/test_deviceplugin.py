@@ -169,3 +169,28 @@ def test_preferred_allocation_binpacks_chips(plugin_env):
     assert len(ids) == 25
     assert all(i.startswith("0.2/") for i in ids)
     assert "0.2/0" in ids
+
+
+def test_health_transition_reannounced(plugin_env):
+    """Marking a chip unhealthy pushes an updated ListAndWatch response with
+    that chip's core-unit devices Unhealthy — kubelet's failure-detection
+    signal."""
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        stream = ch.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty(), timeout=15)
+        it = iter(stream)
+        first = next(it)
+        assert all(d.health == "Healthy" for d in first.devices)
+        plugin.set_health("0.2", False)
+        second = next(it)
+        sick = {d.ID for d in second.devices if d.health == "Unhealthy"}
+        assert sick == {f"0.2/{u}" for u in range(100)}
+        healthy = [d for d in second.devices if d.health == "Healthy"]
+        assert len(healthy) == 300
+        plugin.set_health("0.2", True)
+        third = next(it)
+        assert all(d.health == "Healthy" for d in third.devices)

@@ -90,3 +90,24 @@ def test_slot_reuse_no_stale_leakage():
     c = fresh.submit(Request(prompt=[11, 12], max_new_tokens=4))
     fresh.run_until_idle()
     assert b.output == c.output
+
+
+def test_serving_with_sharded_params():
+    """The engine's decode step is pure jit, so tensor-sharded params serve
+    transparently and outputs match the unsharded engine."""
+    from elastic_gpu_scheduler_tpu.parallel import sharding as shardlib
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+
+    params = init_params(jax.random.key(0), CFG)
+    mesh = make_mesh(MeshSpec(tensor=2, fsdp=2, data=2))
+    sharded = shardlib.shard_params(params, mesh)
+
+    plain = InferenceEngine(params, CFG, max_batch=2, max_len=32)
+    a = plain.submit(Request(prompt=[3, 1, 4], max_new_tokens=5))
+    plain.run_until_idle()
+
+    shardeng = InferenceEngine(sharded, CFG, max_batch=2, max_len=32)
+    b = shardeng.submit(Request(prompt=[3, 1, 4], max_new_tokens=5))
+    shardeng.run_until_idle()
+    assert a.output == b.output
