@@ -99,15 +99,20 @@ def test_serving_with_sharded_params():
     from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
     from elastic_gpu_scheduler_tpu.models.transformer import init_params
 
-    params = init_params(jax.random.key(0), CFG)
+    # dims divisible by the mesh axes (CFG's vocab 97 is deliberately odd)
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
     mesh = make_mesh(MeshSpec(tensor=2, fsdp=2, data=2))
     sharded = shardlib.shard_params(params, mesh)
 
-    plain = InferenceEngine(params, CFG, max_batch=2, max_len=32)
+    plain = InferenceEngine(params, cfg, max_batch=2, max_len=32)
     a = plain.submit(Request(prompt=[3, 1, 4], max_new_tokens=5))
     plain.run_until_idle()
 
-    shardeng = InferenceEngine(sharded, CFG, max_batch=2, max_len=32)
+    shardeng = InferenceEngine(sharded, cfg, max_batch=2, max_len=32)
     b = shardeng.submit(Request(prompt=[3, 1, 4], max_new_tokens=5))
     shardeng.run_until_idle()
     assert a.output == b.output
