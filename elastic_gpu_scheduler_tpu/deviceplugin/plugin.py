@@ -104,6 +104,10 @@ class TPUDevicePlugin:
         self._server: Optional[grpc.Server] = None
         self._health: dict[str, bool] = {c: True for c, _ in self.chips}
         self._health_event = threading.Event()  # set → re-announce now
+        # device files present at startup: the probe set for check_devices
+        self._probe_paths = {
+            c: path for c, path in self.chips if os.path.exists(path)
+        }
 
     # -- device model --------------------------------------------------------
 
@@ -119,16 +123,19 @@ class TPUDevicePlugin:
     def set_health(self, coord: str, healthy: bool) -> None:
         """Failure detection hook: mark a chip (un)healthy and re-announce —
         kubelet then shrinks/restores the node's allocatable, and the
-        scheduler's capacity refresh (core/node.refresh_from_node) follows."""
-        self._health[coord] = healthy
-        self._health_event.set()
+        scheduler's capacity refresh (core/node.refresh_from_node) follows.
+        Signals only on an actual transition (an unconditional signal would
+        turn the ListAndWatch heartbeat into a busy loop)."""
+        if self._health.get(coord, True) != healthy:
+            self._health[coord] = healthy
+            self._health_event.set()
 
     def check_devices(self) -> None:
-        """Re-probe device files; a vanished /dev/accel* marks its chip
-        Unhealthy (no-op for simulated chips without device files)."""
-        for coord, path in self.chips:
-            if path.startswith("/dev/") and os.path.exists("/dev/accel0"):
-                self.set_health(coord, os.path.exists(path))
+        """Re-probe the device files that existed at startup; a vanished one
+        marks its chip Unhealthy, reappearance restores it.  Simulated chips
+        (no device file at startup) are never probed."""
+        for coord, path in self._probe_paths.items():
+            self.set_health(coord, os.path.exists(path))
 
     @staticmethod
     def chip_of_device(device_id: str) -> str:
@@ -262,6 +269,7 @@ class TPUDevicePlugin:
 
     def stop(self):
         self._stop.set()
+        self._health_event.set()  # wake ListAndWatch immediately
         if self._server is not None:
             self._server.stop(grace=1)
 

@@ -1,11 +1,14 @@
 """Speculative decoding via prompt-lookup (n-gram) drafting.
 
 Draft-model-free speculation: propose the tokens that followed the most
-recent matching n-gram in the context, verify all K proposals with ONE
-batched pass through the cache, and keep the longest prefix the model itself
-would have produced — output is exactly greedy decoding, but repetitive
-text (code, structured data, retrieval contexts) advances several tokens per
-model pass.
+recent matching n-gram in the context, verify all K proposals in one
+host-level dispatch (a ``lax.scan`` of decode steps over the cache — the
+device still runs K+1 sequential steps; a wide multi-token verification
+kernel is the follow-up optimization), and keep the longest prefix the model
+itself would have produced — output is exactly greedy decoding.
+``model_passes`` in the returned stats counts host dispatches, which is the
+relevant number when per-call host/dispatch latency dominates (small models,
+remote-attached accelerators); on-device FLOPs are NOT reduced.
 
 Cache rollback is free by design: KVCache entries beyond ``length`` are
 masked out (generate.cached_attention), so rejecting speculated tokens is

@@ -61,6 +61,7 @@ class _Plan:
     # their clones (plans don't touch real allocators until bind)
     member_units: tuple = ()
     member_containers: tuple = ()
+    bound: int = 0  # members already committed to the REAL allocators
 
     def claim(self, pod_key: str) -> Optional[str]:
         if pod_key in self.claims:
@@ -188,7 +189,19 @@ class GangCoordinator:
         candidates: list[list[str]] = [g for g in slice_groups.values()]
         if len(candidates) > 1:
             candidates.append([n for _, n in ordered])  # spanning fallback
+        demand = req.total_chips_equiv * req.gang_size * 100  # core units
         for group in candidates:
+            # cheap prefilter: skip groups whose total free core can't hold
+            # the gang (saves the clone+replay work on hopeless slices)
+            free = 0
+            for name in group:
+                with sched.lock:
+                    na = sched._get_allocator(name)
+                if na is not None:
+                    with na.lock:
+                        free += na.chips.avail_core()
+            if free < demand:
+                continue
             slots = self._plan_on(sched, req, group)
             if slots is not None:
                 return _Plan(slots=slots)
@@ -203,7 +216,9 @@ class GangCoordinator:
         for other_key, other in self._plans.items():
             if now - other.created > self.timeout or not other.member_units:
                 continue
-            for idx, node in enumerate(other.slots):
+            # members already bound are in the real allocator state the
+            # clones start from — replaying them too would double-count
+            for idx, node in enumerate(other.slots[other.bound :]):
                 cs = get_clone(node)
                 if cs is None:
                     continue
@@ -295,9 +310,20 @@ class GangCoordinator:
                 raise RuntimeError(f"gang {gkey}: {g.failed}")
             g.members[pod.key] = node
             if len(g.members) >= g.size:
-                g.ready = True
-                GANG_EVENTS.inc("barrier_tripped")
-                g.cond.notify_all()
+                # pre-commit feasibility re-check: a non-gang pod may have
+                # taken planned capacity since filter time (per-pod filters
+                # don't see plans).  Verify every member still fits BEFORE
+                # anyone commits, so infeasibility fails the gang with
+                # nothing bound.  (A bind landing between this check and the
+                # commits is still possible — commit remains best-effort.)
+                if not self._members_still_fit(sched, req, g):
+                    g.failed = "planned capacity no longer available"
+                    GANG_EVENTS.inc("stale_plan")
+                    g.cond.notify_all()
+                else:
+                    g.ready = True
+                    GANG_EVENTS.inc("barrier_tripped")
+                    g.cond.notify_all()
             else:
                 deadline = g.created + self.timeout
                 while not g.ready and not g.failed:
@@ -330,11 +356,43 @@ class GangCoordinator:
                     GANG_EVENTS.inc("commit_failed")
                     g.cond.notify_all()
             raise
+        with self._lock:
+            plan = self._plans.get(gkey)
+            if plan is not None:
+                plan.bound += 1
         with g.cond:
             g.done += 1
             if g.done >= g.size:
                 GANG_EVENTS.inc("bound")
             self._maybe_gc(gkey, g)
+
+    def _members_still_fit(
+        self, sched: TPUUnitScheduler, req: TPURequest, g: _Gang
+    ) -> bool:
+        """Can every member's shape still be placed on its chosen node?
+        (Clones the current REAL allocator state per distinct node.)"""
+        clones: dict[str, object] = {}
+        for i, (pod_key, node) in enumerate(sorted(g.members.items())):
+            cs = clones.get(node)
+            if cs is None:
+                with sched.lock:
+                    na = sched._get_allocator(node)
+                if na is None:
+                    return False
+                with na.lock:
+                    cs = na.chips.clone()
+                clones[node] = cs
+            member_req = TPURequest(
+                pod_uid=f"chk-{i}",
+                pod_key=f"chk/{i}",
+                units=req.units,
+                container_names=req.container_names,
+            )
+            opt = cs.trade(member_req, sched.rater)
+            if opt is None:
+                return False
+            cs.transact(opt)
+        return True
 
     # -- bookkeeping ---------------------------------------------------------
 

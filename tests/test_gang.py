@@ -412,3 +412,59 @@ def test_two_small_gangs_coexist():
             placed[f"{gname}-{m}"] = r.node_names[0]
     # four whole-node members over four nodes: all distinct
     assert len(set(placed.values())) == 4, placed
+
+
+def test_barrier_feasibility_recheck_fails_cleanly():
+    """A non-gang pod stealing planned capacity between filter and bind must
+    fail the WHOLE gang at the barrier (nothing bound), not mid-commit."""
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(make_tpu_node(f"n{i}", chips=4, hbm_gib=64))
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster, priority="binpack",
+        gang_timeout=3.0,
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    nodes = ["n0", "n1"]
+    pods = [gang_pod(f"g-{i}", "stolen", 2, core=400) for i in range(2)]
+    targets = []
+    for p in pods:
+        cluster.create_pod(p)
+        r = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+        assert r.node_names
+        targets.append(r.node_names[0])
+    # a non-gang pod binds onto one of the planned nodes behind the plan
+    thief = make_pod(
+        "thief",
+        containers=[Container(name="main", resources=ResourceRequirements(
+            limits={consts.RESOURCE_TPU_CORE: 400}))],
+    )
+    cluster.create_pod(thief)
+    sched.bind(targets[0], thief)
+    # now the gang binds: barrier recheck must fail everyone, bind nothing
+    results = [None] * 2
+    threads = [
+        threading.Thread(
+            target=drive_member,
+            args=(cluster, predicate, bind, p, nodes, results, i),
+        )
+        for i, p in enumerate(pods)
+    ]
+    # members go straight to bind with their planned targets
+    def direct_bind(i):
+        res = bind.handle(ExtenderBindingArgs(
+            pod_name=pods[i].metadata.name, pod_namespace="default",
+            pod_uid=pods[i].metadata.uid, node=targets[i]))
+        results[i] = ("bind_err", res.error) if res.error else ("ok", targets[i])
+    threads = [threading.Thread(target=direct_bind, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert all(r and r[0] == "bind_err" for r in results), results
+    assert all("no longer available" in r[1] for r in results), results
+    for p in pods:
+        assert cluster.get_pod("default", p.metadata.name).spec.node_name == ""
+    # only the thief's chips are held
+    used = sum(400 - sched.allocators[n].chips.avail_core() for n in nodes)
+    assert used == 400
