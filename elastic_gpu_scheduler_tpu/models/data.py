@@ -36,8 +36,9 @@ class MemmapTokenDataset:
             raise ValueError(
                 f"{self.path}: {len(self.tokens)} tokens < window {length}"
             )
-        valid = len(self.tokens) - length
-        start = 0 if valid == 0 else int(start) % valid
+        # valid start positions are 0..len-length INCLUSIVE
+        valid = len(self.tokens) - length + 1
+        start = int(start) % valid
         return np.asarray(self.tokens[start : start + length], dtype=np.int32)
 
 

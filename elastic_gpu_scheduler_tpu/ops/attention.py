@@ -66,8 +66,12 @@ def mha_reference(
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
-                  window=0):
-    """One (batch, head, q-block) program; streams K/V blocks from VMEM."""
+                  window=0, q_shift=0):
+    """One (batch, head, q-block) program; streams K/V blocks from VMEM.
+
+    ``q_shift`` = sk - sq aligns rectangular causal masks with
+    ``mha_reference`` (query i corresponds to absolute position i + sk - sq,
+    i.e. the queries are the LAST sq positions of the key sequence)."""
     import jax.experimental.pallas as pl
 
     block_q = q_ref.shape[2]
@@ -77,7 +81,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, d)
 
     q_block_idx = pl.program_id(2)
-    q_offset = q_block_idx * block_q
+    q_offset = q_block_idx * block_q + q_shift
 
     num_k_blocks = seq_k // block_k
     start_block = 0
@@ -152,7 +156,7 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k,
     grid = (b, h, sq // block_q)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal,
-        window=window,
+        window=window, q_shift=sk - sq,
     )
     out = pl.pallas_call(
         kernel,

@@ -18,14 +18,27 @@ Two cooperating mechanisms (SURVEY §7 hard part (b)):
    lets N identical pods all chase the same "best" node and livelock; the
    plan is what makes 256-replica placement deterministic and fast.)
 
-2. **Barrier at bind time.**  Each member's bind verb blocks until all N
-   members' bind calls have arrived; only then does every member commit
-   (allocate + annotation write + Binding POST).  A gang that doesn't fill
-   within ``timeout`` seconds fails every waiter, releases the plan, and
-   leaves nothing bound.  If a commit fails mid-gang, members not yet bound
-   abort; already-bound members keep valid allocations (commit is
-   crash-consistent best-effort — the same consistency the reference's
-   single-pod bind path has, scheduler.go:199-227).
+2. **Barrier + single-committer all-or-nothing commit at bind time.**  Each
+   member's bind verb blocks until all N members' bind calls have arrived.
+   The LAST arriver then commits the whole gang in three reversible phases
+   (SURVEY §7 hard part (b), the assume-all-or-release protocol the
+   reference never had):
+
+   - phase 1 — allocate every member in-memory under the scheduler lock
+     (doubles as the feasibility re-check: failure → forget all, nothing
+     escaped the process);
+   - phase 2 — write the annotation ledger for ALL members (bounded
+     executor; failure → strip written annotations + forget all);
+   - phase 3 — POST all Binding subresources (failure → strip ALL members'
+     annotations + forget all allocations, so zero chips stay allocated and
+     zero pods stay annotated even though an already-accepted Binding cannot
+     be un-POSTed — such pods are bound but unprovisioned, and a Warning
+     event records it).
+
+   A gang that doesn't fill within ``timeout`` seconds fails every waiter,
+   releases the plan, and leaves nothing bound.  A bounded executor (not the
+   N blocked HTTP threads) performs the API writes, so a 256-member commit
+   doesn't thrash 256 Python threads against the GIL.
 
 Pods opt in via annotations ``elasticgpu.io/gang-name`` and
 ``elasticgpu.io/gang-size``.  Gangs are assumed homogeneous (all members
@@ -38,6 +51,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -50,27 +64,41 @@ from .scheduler import ResourceScheduler, TPUUnitScheduler
 log = logging.getLogger("tpu-scheduler")
 
 
+def _trap(fn, item):
+    """Run fn(item), returning the exception instead of raising (so an
+    executor map can collect per-member failures without cancelling peers)."""
+    try:
+        return fn(item)
+    except Exception as e:
+        return e
+
+
 @dataclass
 class _Plan:
     """Node slots for each gang member, in placement order."""
 
     slots: list[str]  # one node name per member, mesh-ordered
-    claims: dict[str, str] = field(default_factory=dict)  # pod key → node
+    claims: dict[str, int] = field(default_factory=dict)  # pod key → slot idx
     created: float = 0.0
+    last_claim: float = 0.0  # expiry is keyed off claim ACTIVITY, not age,
+    # so a slow-arriving gang keeps its plan as long as members keep coming
     # the member shape, so LATER plans can reserve this plan's capacity in
     # their clones (plans don't touch real allocators until bind)
     member_units: tuple = ()
     member_containers: tuple = ()
-    bound: int = 0  # members already committed to the REAL allocators
+    # set while the single committer is writing this plan's allocations into
+    # the REAL allocators — reservation replay must then skip it entirely
+    committing: bool = False
 
     def claim(self, pod_key: str) -> Optional[str]:
         if pod_key in self.claims:
-            return self.claims[pod_key]
+            return self.slots[self.claims[pod_key]]
         if len(self.claims) >= len(self.slots):
             return None
-        node = self.slots[len(self.claims)]
-        self.claims[pod_key] = node
-        return node
+        idx = len(self.claims)
+        self.claims[pod_key] = idx
+        self.last_claim = time.monotonic()
+        return self.slots[idx]
 
 
 @dataclass
@@ -79,19 +107,27 @@ class _Gang:
     size: int
     created: float
     cond: threading.Condition
-    members: dict[str, str] = field(default_factory=dict)  # pod key → node
-    ready: bool = False
+    # pod key → (node, pod); pods are kept so the single committer can write
+    # every member's annotations/binding itself
+    members: dict[str, tuple[str, Pod]] = field(default_factory=dict)
+    committed: bool = False
     failed: str = ""
     done: int = 0
 
 
 class GangCoordinator:
-    def __init__(self, clientset, timeout: float = 30.0):
+    def __init__(self, clientset, timeout: float = 30.0,
+                 commit_workers: int = 16):
         self.clientset = clientset
         self.timeout = timeout
         self._gangs: dict[str, _Gang] = {}
         self._plans: dict[str, _Plan] = {}
         self._lock = threading.Lock()
+        # bounded pool for the commit's API writes (annotations + bindings);
+        # the N member HTTP threads just park on the barrier condition
+        self._commit_pool = ThreadPoolExecutor(
+            max_workers=max(1, commit_workers), thread_name_prefix="gang-commit"
+        )
         # pod key → last commit duration (post-barrier); benchmark telemetry
         self.commit_secs: dict[str, float] = {}
 
@@ -143,9 +179,14 @@ class GangCoordinator:
         gkey = self.gang_key(pod, req)
         with self._lock:
             plan = self._plans.get(gkey)
-            if plan is not None and time.monotonic() - plan.created > self.timeout:
-                self._plans.pop(gkey, None)
-                plan = None
+            if plan is not None and not plan.committing:
+                # expiry keyed off last claim ACTIVITY (ADVICE r1: expiring a
+                # plan mid-arrival by age forgets members' existing claims and
+                # turns a slow gang into a guaranteed commit failure)
+                last_activity = max(plan.created, plan.last_claim)
+                if time.monotonic() - last_activity > self.timeout:
+                    self._plans.pop(gkey, None)
+                    plan = None
             if plan is None:
                 plan = self._plan(sched, req, node_names)
                 if plan is None:
@@ -208,17 +249,22 @@ class GangCoordinator:
         return None
 
     def _reserve_other_plans(self, sched, clones: dict, get_clone) -> None:
-        """Replay other ACTIVE plans' unbound placements into the clones so
+        """Replay other ACTIVE plans' placements into the clones so
         concurrent gangs don't double-count the same free chips (caller holds
         self._lock).  Without this, two gangs planned back-to-back both pass
-        filter against the same capacity and one fails mid-commit."""
+        filter against the same capacity and one fails mid-commit.
+
+        A plan being COMMITTED is skipped wholesale: its allocations are
+        landing in the real allocator state the clones start from (commit is
+        all-or-nothing, so there is never a partially-bound slot list to
+        replay — ADVICE r1's bound-counter skew cannot occur)."""
         now = time.monotonic()
         for other_key, other in self._plans.items():
-            if now - other.created > self.timeout or not other.member_units:
+            if other.committing or not other.member_units:
                 continue
-            # members already bound are in the real allocator state the
-            # clones start from — replaying them too would double-count
-            for idx, node in enumerate(other.slots[other.bound :]):
+            if now - max(other.created, other.last_claim) > self.timeout:
+                continue
+            for idx, node in enumerate(other.slots):
                 cs = get_clone(node)
                 if cs is None:
                     continue
@@ -284,7 +330,7 @@ class GangCoordinator:
                 return None
         return slots
 
-    # -- bind-time barrier ---------------------------------------------------
+    # -- bind-time barrier + single-committer commit -------------------------
 
     def bind(self, sched: ResourceScheduler, node: str, pod: Pod) -> None:
         req = request_from_pod(pod)
@@ -308,25 +354,25 @@ class GangCoordinator:
             if g.failed:
                 self._maybe_gc(gkey, g)
                 raise RuntimeError(f"gang {gkey}: {g.failed}")
-            g.members[pod.key] = node
+            if g.committed:
+                raise RuntimeError(f"gang {gkey}: already committed")
+            g.members[pod.key] = (node, pod)
             if len(g.members) >= g.size:
-                # pre-commit feasibility re-check: a non-gang pod may have
-                # taken planned capacity since filter time (per-pod filters
-                # don't see plans).  Verify every member still fits BEFORE
-                # anyone commits, so infeasibility fails the gang with
-                # nothing bound.  (A bind landing between this check and the
-                # commits is still possible — commit remains best-effort.)
-                if not self._members_still_fit(sched, req, g):
-                    g.failed = "planned capacity no longer available"
-                    GANG_EVENTS.inc("stale_plan")
-                    g.cond.notify_all()
-                else:
-                    g.ready = True
-                    GANG_EVENTS.inc("barrier_tripped")
-                    g.cond.notify_all()
+                # last arriver commits the WHOLE gang while the other
+                # members' threads stay parked on the condition (they hold
+                # no locks, so the commit runs without N-way GIL thrash)
+                GANG_EVENTS.inc("barrier_tripped")
+                try:
+                    self._commit_gang(sched, gkey, g)
+                    g.committed = True
+                    GANG_EVENTS.inc("bound")
+                except Exception as e:
+                    g.failed = str(e) or repr(e)  # failure channel is truthiness
+                    GANG_EVENTS.inc("commit_failed")
+                g.cond.notify_all()
             else:
                 deadline = g.created + self.timeout
-                while not g.ready and not g.failed:
+                while not g.committed and not g.failed:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         g.failed = (
@@ -340,59 +386,124 @@ class GangCoordinator:
                 g.members.pop(pod.key, None)
                 self._maybe_gc(gkey, g)
                 raise RuntimeError(f"gang {gkey}: {g.failed}")
+            g.done += 1
+            self._maybe_gc(gkey, g)
 
-        # barrier tripped: commit this member
-        try:
-            t0 = time.perf_counter()
-            sched.bind(node, pod)
-            commit_s = time.perf_counter() - t0
-            GANG_COMMIT.observe(value=commit_s)
-            with self._lock:
-                self.commit_secs[pod.key] = commit_s
-        except Exception as e:
-            with g.cond:
-                if not g.failed:
-                    g.failed = f"member {pod.key} bind failed: {e}"
-                    GANG_EVENTS.inc("commit_failed")
-                    g.cond.notify_all()
-            raise
+    def _commit_gang(self, sched: TPUUnitScheduler, gkey: str, g: _Gang) -> None:
+        """All-or-nothing commit of every member (caller holds g.cond).
+
+        Any failure leaves zero chips allocated and zero pods annotated; the
+        only irreversible artifact is a Binding already accepted by the API
+        server in phase 3, and such pods are stripped of their ledger entry
+        (bound-but-unprovisioned, flagged via a Warning event)."""
+        members = sorted(g.members.items())  # [(pod_key, (node, pod))]
         with self._lock:
             plan = self._plans.get(gkey)
             if plan is not None:
-                plan.bound += 1
-        with g.cond:
-            g.done += 1
-            if g.done >= g.size:
-                GANG_EVENTS.inc("bound")
-            self._maybe_gc(gkey, g)
+                plan.committing = True
 
-    def _members_still_fit(
-        self, sched: TPUUnitScheduler, req: TPURequest, g: _Gang
-    ) -> bool:
-        """Can every member's shape still be placed on its chosen node?
-        (Clones the current REAL allocator state per distinct node.)"""
-        clones: dict[str, object] = {}
-        for i, (pod_key, node) in enumerate(sorted(g.members.items())):
-            cs = clones.get(node)
-            if cs is None:
+        try:
+            # phase 1: in-memory allocation, atomic under the scheduler lock
+            # (this IS the feasibility re-check — no check-then-act window)
+            allocated: list[tuple[Pod, str, object]] = []
+            try:
                 with sched.lock:
-                    na = sched._get_allocator(node)
-                if na is None:
-                    return False
-                with na.lock:
-                    cs = na.chips.clone()
-                clones[node] = cs
-            member_req = TPURequest(
-                pod_uid=f"chk-{i}",
-                pod_key=f"chk/{i}",
-                units=req.units,
-                container_names=req.container_names,
-            )
-            opt = cs.trade(member_req, sched.rater)
-            if opt is None:
-                return False
-            cs.transact(opt)
-        return True
+                    for _, (node, pod) in members:
+                        opt = sched.gang_allocate(node, pod)
+                        allocated.append((pod, node, opt))
+            except Exception as e:
+                with sched.lock:
+                    for pod, node, opt in allocated:
+                        sched.gang_unallocate(node, pod, opt)
+                GANG_EVENTS.inc("stale_plan")
+                raise RuntimeError(
+                    f"member {len(allocated)}/{len(members)} no longer fits: {e}"
+                ) from e
+
+            # phase 2: annotation ledger for ALL members (reversible)
+            def annotate(item):
+                pod, node, opt = item
+                t0 = time.perf_counter()
+                sched.gang_annotate(pod, opt, node)
+                return pod.key, time.perf_counter() - t0
+
+            done_keys: set[str] = set()
+            secs: dict[str, float] = {}
+            phase2_err = None
+            for res in self._commit_pool.map(
+                lambda it: _trap(annotate, it), allocated
+            ):
+                if isinstance(res, Exception):
+                    phase2_err = phase2_err or res
+                else:
+                    key, dt = res
+                    done_keys.add(key)
+                    secs[key] = dt
+            if phase2_err is not None:
+                self._rollback(sched, allocated, strip_keys=done_keys)
+                raise RuntimeError(f"annotation write failed: {phase2_err}")
+
+            # phase 3: POST all bindings
+            def post(item):
+                pod, node, opt = item
+                t0 = time.perf_counter()
+                sched.gang_post_binding(pod, node)
+                return pod.key, time.perf_counter() - t0
+
+            phase3_err = None
+            for res in self._commit_pool.map(
+                lambda it: _trap(post, it), allocated
+            ):
+                if isinstance(res, Exception):
+                    phase3_err = phase3_err or res
+                else:
+                    key, dt = res
+                    secs[key] = secs.get(key, 0.0) + dt
+            if phase3_err is not None:
+                # bindings can't be un-POSTed; strip EVERY member's ledger
+                # entry + free all chips so the failure leaves no allocation
+                self._rollback(
+                    sched, allocated, strip_keys={p.key for p, _, _ in allocated}
+                )
+                for pod, node, _ in allocated:
+                    sched._record_event(
+                        pod, "Warning", "GangBindRolledBack",
+                        f"gang {gkey} commit failed after some bindings were "
+                        f"accepted; TPU allocation released",
+                    )
+                raise RuntimeError(f"binding POST failed: {phase3_err}")
+
+            # post-commit bookkeeping (events are best-effort API POSTs —
+            # fan them out too, not serially on the committer thread)
+            list(self._commit_pool.map(
+                lambda it: _trap(lambda x: sched.gang_note_bound(x[0], x[2], x[1]), it),
+                allocated,
+            ))
+            with self._lock:
+                for key, dt in secs.items():
+                    self.commit_secs[key] = dt
+                    GANG_COMMIT.observe(value=dt)
+                self._plans.pop(gkey, None)
+        except Exception:
+            with self._lock:
+                self._plans.pop(gkey, None)  # stale either way
+            raise
+
+    def _rollback(self, sched, allocated, strip_keys: set[str]) -> None:
+        """Strip written annotations (parallel, best-effort) + free chips."""
+
+        def strip(item):
+            pod, _, _ = item
+            if pod.key in strip_keys:
+                try:
+                    sched.gang_strip_annotations(pod)
+                except Exception as e:  # best-effort; resync will catch it
+                    log.warning("gang rollback: strip %s failed: %s", pod.key, e)
+
+        list(self._commit_pool.map(strip, allocated))
+        with sched.lock:
+            for pod, node, opt in allocated:
+                sched.gang_unallocate(node, pod, opt)
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -415,7 +526,7 @@ class GangCoordinator:
                         "size": g.size,
                         "arrived": len(g.members),
                         "done": g.done,
-                        "ready": g.ready,
+                        "committed": g.committed,
                         "failed": g.failed,
                         "age_s": round(time.monotonic() - g.created, 3),
                     }

@@ -254,6 +254,97 @@ class TPUUnitScheduler(ResourceScheduler):
             )
             raise
 
+    # -- gang split-phase primitives (scheduler/gang.py's commit protocol) ----
+    #
+    # The gang coordinator needs bind's three effects (allocate, annotate,
+    # POST binding) as separately reversible steps so a mid-gang failure can
+    # roll the WHOLE gang back to zero chips allocated / zero pods annotated
+    # (SURVEY §7 hard part (b): assume-all-or-release).
+
+    def gang_allocate(self, node_name: str, pod: Pod) -> Option:
+        """In-memory allocation commit; reversed by ``gang_unallocate``."""
+        request = request_from_pod(pod)
+        with self.lock:
+            na = self._get_allocator(node_name)
+            if na is None:
+                raise RuntimeError(
+                    f"gang allocate: node {node_name} has no TPU allocator"
+                )
+            opt = na.allocate(request, self.rater)
+            self.pod_maps[pod.key] = (node_name, opt)
+            self.released_pods.pop(pod.key, None)
+            return opt
+
+    def gang_unallocate(self, node_name: str, pod: Pod, opt: Option) -> None:
+        with self.lock:
+            entry = self.pod_maps.pop(pod.key, None)
+            if entry is None:
+                # already released (e.g. the controller forgot a deleted pod
+                # mid-commit) — freeing again would double-free shared-chip
+                # capacity held by OTHER pods
+                return
+            na = self.allocators.get(node_name)
+            if na is not None:
+                na.forget(opt)
+            self._update_node_gauge(node_name)
+
+    def gang_annotate(self, pod: Pod, opt: Option, node_name: str) -> Pod:
+        return self._write_annotations(pod, opt, node_name)
+
+    def gang_strip_annotations(self, pod: Pod) -> None:
+        """Rollback of ``gang_annotate``: remove the ledger entry so neither
+        restart rebuild nor the on-node agent sees an allocation.  Best-effort
+        with one optimistic-conflict retry; a deleted pod needs no strip."""
+        for attempt in range(2):
+            try:
+                cur = self.clientset.get_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except Exception as e:
+                if is_not_found(e):
+                    return
+                raise
+            if cur.metadata.uid != pod.metadata.uid:
+                return  # recreated; nothing of ours on it
+            ann = cur.metadata.annotations
+            for key in list(ann):
+                if key.startswith(consts.ANNOTATION_CONTAINER_PREFIX) or key in (
+                    consts.ANNOTATION_ASSUMED,
+                    consts.ANNOTATION_NODE,
+                    consts.ANNOTATION_TOPOLOGY,
+                ):
+                    ann.pop(key, None)
+            cur.metadata.labels.pop(consts.ANNOTATION_ASSUMED, None)
+            try:
+                self.clientset.update_pod(cur)
+                return
+            except Exception as e:
+                if is_conflict(e) and attempt == 0:
+                    continue
+                if is_not_found(e):
+                    return
+                raise
+
+    def gang_post_binding(self, pod: Pod, node_name: str) -> None:
+        self.clientset.bind(
+            Binding(
+                pod_name=pod.metadata.name,
+                pod_namespace=pod.metadata.namespace,
+                pod_uid=pod.metadata.uid,
+                node=node_name,
+            )
+        )
+
+    def gang_note_bound(self, pod: Pod, opt: Option, node_name: str) -> None:
+        """Post-commit bookkeeping (gauge + event), one member."""
+        with self.lock:
+            self._update_node_gauge(node_name)
+        self._record_event(
+            pod, "Normal", "Scheduled",
+            f"gang-bound to {node_name} "
+            f"(chips {[a.coords for a in opt.allocs if a.needs_tpu]})",
+        )
+
     def _update_node_gauge(self, node_name: str) -> None:
         na = self.allocators.get(node_name)
         if na is not None:

@@ -35,6 +35,16 @@ def test_memmap_roundtrip_and_window():
         # start is reduced modulo the valid range; never runs off the end
         w2 = ds.window(999, 16)
         assert len(w2) == 16
+        # the LAST valid start (len - length) is reachable (ADVICE r1:
+        # start % valid excluded it); the final token must be coverable
+        w3 = ds.window(1000 - 16, 16)
+        np.testing.assert_array_equal(w3, toks[-16:])
+        # an exact-length file has exactly one window
+        exact = os.path.join(d, "exact.bin")
+        write_token_file(exact, np.arange(16))
+        np.testing.assert_array_equal(
+            MemmapTokenDataset(exact).window(7, 16), np.arange(16)
+        )
         # a file shorter than the window is an error, not a short batch
         short = os.path.join(d, "short.bin")
         write_token_file(short, np.arange(10))

@@ -72,6 +72,26 @@ def test_flash_matches_reference_pallas_interpret():
     np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
 
 
+def test_flash_rectangular_causal_matches_reference():
+    """sq != sk causal: kernel q_ids must carry the (sk - sq) offset so the
+    queries align to the LAST sq key positions (ADVICE r1 medium)."""
+    key = jax.random.key(11)
+    B, H, D = 1, 2, 32
+    for sq, sk, window in ((128, 256, 0), (128, 384, 0), (128, 256, 100)):
+        kq, kk_, kv = jax.random.split(jax.random.key(sq + sk + window), 3)
+        q = jax.random.normal(kq, (B, H, sq, D), jnp.float32)
+        k = jax.random.normal(kk_, (B, H, sk, D), jnp.float32)
+        v = jax.random.normal(kv, (B, H, sk, D), jnp.float32)
+        ref, _ = mha_reference(q, k, v, causal=True, sm_scale=D**-0.5,
+                               window=window)
+        out = _flash_forward_pallas(
+            q, k, v, causal=True, sm_scale=D**-0.5, block_q=64, block_k=128,
+            interpret=True, window=window,
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2,
+                                   err_msg=f"sq={sq} sk={sk} window={window}")
+
+
 def test_flash_attention_grads_match_reference():
     key = jax.random.key(7)
     B, H, S, D = 1, 2, 32, 16
