@@ -102,6 +102,14 @@ def main(argv=None) -> int:
     p.add_argument("--gang-timeout", type=float, default=30.0)
     p.add_argument("--tls-cert", default="", help="serve HTTPS with this cert")
     p.add_argument("--tls-key", default="")
+    p.add_argument(
+        "--http-workers",
+        type=int,
+        default=_env_int("HTTP_WORKERS", 320),
+        help="pre-spawned HTTP worker threads (0 = thread per connection); "
+        "size for max expected gang concurrency — a gang bind parks one "
+        "worker per member at the barrier",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -163,6 +171,7 @@ def main(argv=None) -> int:
     server = ExtenderServer(
         predicate, prioritize, bind, status, host=args.host, port=args.port,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
+        workers=max(0, args.http_workers),
     )
 
     stop = threading.Event()

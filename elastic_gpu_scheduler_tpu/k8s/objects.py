@@ -165,7 +165,38 @@ class Pod:
         )
 
     def clone(self) -> "Pod":
-        return Pod.from_dict(copy.deepcopy(self.to_dict()))
+        """Structural copy (hot path: every fake/REST read+write clones).
+        Explicit field copies are ~10x cheaper than a to_dict→deepcopy→
+        from_dict round-trip; only ``extra`` (arbitrary JSON) needs deepcopy
+        and it is empty unless an external API server added fields."""
+        m = self.metadata
+        return Pod(
+            metadata=ObjectMeta(
+                name=m.name,
+                namespace=m.namespace,
+                uid=m.uid,
+                resource_version=m.resource_version,
+                labels=dict(m.labels),
+                annotations=dict(m.annotations),
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        name=c.name,
+                        image=c.image,
+                        resources=ResourceRequirements(
+                            requests=dict(c.resources.requests),
+                            limits=dict(c.resources.limits),
+                        ),
+                    )
+                    for c in self.spec.containers
+                ],
+                node_name=self.spec.node_name,
+                scheduler_name=self.spec.scheduler_name,
+            ),
+            status=PodStatus(phase=self.status.phase),
+            extra=copy.deepcopy(self.extra) if self.extra else {},
+        )
 
 
 @dataclass
@@ -216,7 +247,22 @@ class Node:
         )
 
     def clone(self) -> "Node":
-        return Node.from_dict(copy.deepcopy(self.to_dict()))
+        m = self.metadata
+        return Node(
+            metadata=ObjectMeta(
+                name=m.name,
+                namespace=m.namespace,
+                uid=m.uid,
+                resource_version=m.resource_version,
+                labels=dict(m.labels),
+                annotations=dict(m.annotations),
+            ),
+            status=NodeStatus(
+                capacity=dict(self.status.capacity),
+                allocatable=dict(self.status.allocatable),
+            ),
+            extra=copy.deepcopy(self.extra) if self.extra else {},
+        )
 
 
 @dataclass

@@ -1,16 +1,29 @@
-"""Continuous-batching inference engine (slot-based KV cache pool).
+"""Continuous-batching inference engine: paged KV cache + fused decode.
 
-Serving-side subsystem of the workload plane: requests join and leave a
-fixed-shape batch *between* decode steps, so the TPU always steps one static
-(B_max, …) computation while work arrives and finishes asynchronously —
-the standard continuous-batching design, kept XLA-friendly:
+Serving-side subsystem of the workload plane.  Requests join and leave a
+fixed-shape batch *between* fused decode chunks, so the TPU always steps one
+static (B_max, …) computation while work arrives and finishes asynchronously.
+Two TPU-first design points (VERDICT r1 #4/#10):
 
-- one KV cache of shape (L, B_max, max_len, H, Dh); a slot per request;
-- per-slot ``length`` and ``active`` vectors; finished/empty slots keep
-  computing (masked, harmless) so shapes never change;
-- prefill is decode-steps over the prompt (models/generate.py math) into
-  the slot's cache region; admission happens between steps;
-- greedy or temperature sampling per slot.
+- **Paged KV cache** (vLLM-style, XLA-friendly): one pool of P fixed-size
+  pages shaped (L, P, page_size, Hkv, Dh) shared by all slots, plus a
+  host-managed block table (B, max_pages) of page indices per slot.  Pages
+  are allocated on demand as sequences grow and freed on completion, so
+  total HBM is sized for the *actual* token load, not
+  max_batch × max_len worst case — mixed-length traffic admits more
+  concurrent requests than slot-contiguous allocation allows.  Page 0 is a
+  reserved scratch page: inactive slots' table rows point at it, so the
+  fixed-shape step can run without masking writes.
+- **Fused decode**: each engine step runs ``fused_steps`` decode iterations
+  in ONE jitted ``lax.scan`` with sampling inside (same recipe as
+  models/generate.py:decode_loop), so the host→device dispatch cost is paid
+  once per K tokens.  Prompt feeding happens on-device too: the scan picks
+  the next prompt token while a slot is still prefilling, else the sampled
+  token.
+
+A slot whose next chunk cannot get pages simply *stalls* (stays inactive,
+state intact) until completions free pages; if every slot is stalled the
+pool is genuinely exhausted and the engine raises.
 
 No reference analogue (SURVEY §2 #19); this is the inference-serving
 capability slot of a complete framework.
@@ -32,6 +45,8 @@ from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 
+SCRATCH_PAGE = 0  # reserved; inactive slots write here, nobody reads it
+
 
 @dataclass
 class Request:
@@ -43,20 +58,26 @@ class Request:
     error: str = ""  # set (with done) when the request is rejected
 
 
-def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
-    """One decode step for every slot at its own position.
+def _paged_decode_step(
+    params, tokens, cache_k, cache_v, tables, lengths, cfg, page_size
+):
+    """One decode step for every slot at its own position, against the page
+    pool.
 
-    tokens: (B,) int32; cache_k/v: (L, B, M, H, Dh); lengths: (B,) int32
-    (position each slot writes at).  Returns (logits (B,V), new_k, new_v).
+    tokens: (B,) int32; cache_k/v: (L, P, page, Hkv, Dh); tables:
+    (B, max_pages) int32 page ids; lengths: (B,) int32 write positions.
+    Returns (logits (B, V), new_k, new_v).
     """
     dtype = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
-    M = cache_k.shape[2]
     Hn, Dh = cfg.n_heads, cfg.head_dim
     x = _embed_lookup(params["embed"], tokens, dtype)[:, None, :]  # (B,1,D)
+    bidx = jnp.arange(B)
+    page_idx = tables[bidx, lengths // page_size]  # (B,)
+    offset = lengths % page_size  # (B,)
 
     def layer_step(x, scanned):
-        p, ck, cv = scanned  # ck/cv: (B, M, H, Dh)
+        p, ck, cv = scanned  # ck/cv: (P, page, Hkv, Dh)
         h = rms_norm(x, p["attn_norm"])
         Hkv = cfg.kv_heads
         q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
@@ -68,14 +89,18 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
         )
         q = rope_b(q, lengths)
         k = rope_b(k, lengths)
-        # write k/v at per-slot positions
-        onehot = jax.nn.one_hot(lengths, M, dtype=ck.dtype)  # (B, M)
-        ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k
-        cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v
-        # attend over each slot's valid prefix (grouped GQA + window via
-        # the shared cached_attention from generate.py)
+        # scatter k/v into each slot's current page (inactive slots target
+        # the scratch page — harmless garbage nobody attends to)
+        ck = ck.at[page_idx, offset].set(k[:, 0])
+        cv = cv.at[page_idx, offset].set(v[:, 0])
+        # gather the slot's pages into a virtually-contiguous view; position
+        # j of the view IS token position j (pages are table-ordered), so
+        # the shared cached_attention position mask applies unchanged
+        maxp = tables.shape[1]
+        k_all = ck[tables].reshape(B, maxp * page_size, Hkv, Dh)
+        v_all = cv[tables].reshape(B, maxp * page_size, Hkv, Dh)
         o = cached_attention(
-            q, ck, cv, lengths, window=cfg.window_size
+            q, k_all, v_all, lengths, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
         x = x + (o @ wmat(p["wo"], dtype))
         h = rms_norm(x, p["mlp_norm"])
@@ -84,14 +109,54 @@ def _batched_decode_step(params, tokens, cache_k, cache_v, lengths, cfg):
         x = x + ((gate * up) @ wmat(p["w_out"], dtype))
         return x, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params["layers"], cache_k, cache_v))
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache_k, cache_v)
+    )
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
     return logits.astype(jnp.float32), new_k, new_v
 
 
+def _fused_serve_chunk(
+    params, cache_k, cache_v, tables, tokens, lengths, active,
+    prompts, prompt_lens, temps, key, *, cfg, page_size, n_steps,
+):
+    """``n_steps`` decode iterations in one scan; sampling AND prompt
+    feeding happen on-device.  Returns (sampled (B, n_steps), new caches).
+
+    Step s feeds the token at position lengths+s and samples from its
+    logits; the host decides afterwards which sampled entries are real
+    emissions (position ≥ prompt_len-1) — the device only needs to know
+    which NEXT token to feed (prompt token while prefilling, else the
+    sample)."""
+
+    def body(carry, _):
+        tokens, lengths, key, ck, cv = carry
+        logits, ck, cv = _paged_decode_step(
+            params, tokens, ck, cv, tables, lengths, cfg, page_size
+        )
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        temped = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1
+        ).astype(jnp.int32)
+        sampled = jnp.where(temps > 0, temped, greedy)
+        new_len = lengths + active.astype(jnp.int32)
+        in_prompt = new_len < prompt_lens
+        nxt = jnp.minimum(new_len, prompts.shape[1] - 1)
+        prompt_next = jnp.take_along_axis(prompts, nxt[:, None], axis=1)[:, 0]
+        next_tok = jnp.where(in_prompt, prompt_next, sampled)
+        tokens = jnp.where(active, next_tok, tokens)
+        return (tokens, new_len, key, ck, cv), sampled
+
+    (tokens, lengths, key, cache_k, cache_v), sampled = jax.lax.scan(
+        body, (tokens, lengths, key, cache_k, cache_v), None, length=n_steps
+    )
+    return sampled.T, cache_k, cache_v  # (B, n_steps)
+
+
 class InferenceEngine:
-    """Slot-based continuous batching over a fixed (B_max, max_len) cache."""
+    """Paged-cache continuous batching with fused K-step decode chunks."""
 
     def __init__(
         self,
@@ -99,26 +164,52 @@ class InferenceEngine:
         cfg: TransformerConfig,
         max_batch: int = 8,
         max_len: int = 512,
+        page_size: int = 16,
+        n_pages: int = 0,
+        fused_steps: int = 8,
     ):
         assert cfg.n_experts == 0, "serving engine supports dense models"
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
+        self.page_size = page_size
+        self.max_pages_per_slot = -(-max_len // page_size)
+        # default pool: capacity-equivalent to slot-contiguous (+ scratch);
+        # pass a smaller n_pages to exploit paging's memory win
+        self.n_pages = n_pages or (max_batch * self.max_pages_per_slot + 1)
+        assert self.n_pages >= 2, "need at least scratch + one real page"
+        self.fused_steps = max(1, fused_steps)
         dtype = jnp.dtype(cfg.dtype)
-        shape = (cfg.n_layers, max_batch, max_len, cfg.kv_heads, cfg.head_dim)
+        shape = (
+            cfg.n_layers, self.n_pages, page_size, cfg.kv_heads, cfg.head_dim
+        )
         self.cache_k = jnp.zeros(shape, dtype)
         self.cache_v = jnp.zeros(shape, dtype)
+        self.free_pages = list(range(self.n_pages - 1, SCRATCH_PAGE, -1))
+        self.tables = np.zeros(
+            (max_batch, self.max_pages_per_slot), np.int32
+        )  # all → scratch
+        self.slot_pages: list[list[int]] = [[] for _ in range(max_batch)]
         self.lengths = np.zeros(max_batch, np.int32)
         self.slots: list[Optional[Request]] = [None] * max_batch
-        self.pending_prompt: list[list[int]] = [[] for _ in range(max_batch)]
-        self.emitted: np.ndarray = np.zeros(max_batch, np.int32)
+        self.prompts = np.zeros((max_batch, max_len), np.int32)
+        self.prompt_lens = np.zeros(max_batch, np.int32)
+        self.temps = np.zeros(max_batch, np.float32)
         self.next_token = np.zeros(max_batch, np.int32)
+        self.emitted = np.zeros(max_batch, np.int32)
+        self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
         self.queue: "queue.Queue[Request]" = queue.Queue()
-        self._step = jax.jit(
-            functools.partial(_batched_decode_step, cfg=cfg)
+        self._chunk = jax.jit(
+            functools.partial(
+                _fused_serve_chunk,
+                cfg=cfg,
+                page_size=page_size,
+                n_steps=self.fused_steps,
+            ),
+            donate_argnums=(1, 2),
         )
-        self._rng = np.random.default_rng(0)
+        self._key = jax.random.key(0)
 
     # -- public API ----------------------------------------------------------
 
@@ -143,7 +234,7 @@ class InferenceEngine:
         return req
 
     def run_until_idle(self, max_steps: int = 10_000) -> None:
-        """Drive decode steps until no request is active or queued."""
+        """Drive fused chunks until no request is active or queued."""
         for _ in range(max_steps):
             self._admit()
             if not any(s is not None for s in self.slots):
@@ -164,40 +255,90 @@ class InferenceEngine:
             except queue.Empty:
                 return
             self.slots[i] = req
-            self.pending_prompt[i] = list(req.prompt[1:])
+            self.prompts[i, : len(req.prompt)] = req.prompt
+            self.prompt_lens[i] = len(req.prompt)
             self.next_token[i] = req.prompt[0]
+            self.temps[i] = req.temperature
             self.lengths[i] = 0
             self.emitted[i] = 0
-            # no cache zeroing needed: the position mask only exposes
-            # positions <= length, all of which the new request rewrites
+            self.stalled[i] = False
+            # no page zeroing needed: the position mask only exposes
+            # positions <= length, all of which the new tenant rewrites
+
+    def _ensure_pages(self, i: int, upto: int) -> bool:
+        """Grow slot i's page list to cover token positions < upto.
+        Returns False (and leaves partial growth in place) on pool
+        exhaustion — the slot stalls for this chunk."""
+        upto = min(upto, self.max_len)
+        need = -(-upto // self.page_size)
+        while len(self.slot_pages[i]) < need:
+            if not self.free_pages:
+                return False
+            pg = self.free_pages.pop()
+            self.tables[i, len(self.slot_pages[i])] = pg
+            self.slot_pages[i].append(pg)
+        return True
+
+    def _release_slot(self, i: int) -> None:
+        self.free_pages.extend(reversed(self.slot_pages[i]))
+        self.slot_pages[i] = []
+        self.tables[i, :] = SCRATCH_PAGE
+        self.slots[i] = None
+        self.stalled[i] = False
 
     def step(self) -> None:
-        """One batched decode step across all slots (prefill + generate)."""
-        tokens = jnp.asarray(self.next_token)
-        lengths = jnp.asarray(self.lengths)
-        logits, self.cache_k, self.cache_v = self._step(
-            self.params, tokens, self.cache_k, self.cache_v, lengths
-        )
-        logits_np = np.asarray(logits)
+        """One fused chunk (``fused_steps`` decode iterations) across all
+        slots; page allocation, admission, and completion happen between
+        chunks on the host."""
+        K = self.fused_steps
+        active = np.zeros(self.max_batch, bool)
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
-            self.lengths[i] += 1
-            if self.pending_prompt[i]:
-                # still prefilling: feed the next prompt token
-                self.next_token[i] = self.pending_prompt[i].pop(0)
-                continue
-            # generating
-            if req.temperature > 0:
-                z = logits_np[i] / req.temperature
-                z = z - z.max()
-                p = np.exp(z) / np.exp(z).sum()
-                tok = int(self._rng.choice(len(p), p=p))
+            if self._ensure_pages(i, int(self.lengths[i]) + K):
+                active[i] = True
+                self.stalled[i] = False
             else:
-                tok = int(np.argmax(logits_np[i]))
-            req.output.append(tok)
-            self.emitted[i] += 1
-            self.next_token[i] = tok
+                self.stalled[i] = True
+        if not active.any():
+            if any(s is not None for s in self.slots):
+                raise RuntimeError(
+                    f"page pool exhausted: {sum(self.stalled)} slots stalled, "
+                    f"0 runnable (pool {self.n_pages - 1} pages)"
+                )
+            return
+        self._key, sub = jax.random.split(self._key)
+        sampled, self.cache_k, self.cache_v = self._chunk(
+            self.params,
+            self.cache_k,
+            self.cache_v,
+            jnp.asarray(self.tables),
+            jnp.asarray(self.next_token),
+            jnp.asarray(self.lengths),
+            jnp.asarray(active),
+            jnp.asarray(self.prompts),
+            jnp.asarray(self.prompt_lens),
+            jnp.asarray(self.temps),
+            sub,
+        )
+        sampled = np.asarray(sampled)  # (B, K)
+        for i, req in enumerate(self.slots):
+            if req is None or not active[i]:
+                continue
+            pos = int(self.lengths[i])
+            plen = int(self.prompt_lens[i])
+            for s in range(K):
+                # step s sampled from logits at position pos+s; that is a
+                # real emission iff it is at or past the last prompt token
+                if pos + s >= plen - 1 and self.emitted[i] < req.max_new_tokens:
+                    req.output.append(int(sampled[i, s]))
+                    self.emitted[i] += 1
+            self.lengths[i] = pos + K
+            self.next_token[i] = (
+                self.prompts[i, self.lengths[i]]
+                if self.lengths[i] < plen
+                else sampled[i, K - 1]
+            )
             if self.emitted[i] >= req.max_new_tokens:
                 req.done.set()
-                self.slots[i] = None
+                self._release_slot(i)

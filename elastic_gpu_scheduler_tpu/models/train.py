@@ -9,7 +9,7 @@ matmuls, ppermute ring hops for sequence parallelism).
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -18,6 +18,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import sharding as shardlib
 from .transformer import TransformerConfig, forward_with_aux, init_params
+
+
+class MasterState(NamedTuple):
+    """Optimizer state for low-precision-at-rest params: the fp32 master
+    copy (the standard mixed-precision recipe — bf16 weights are read by
+    the forward, fp32 masters absorb the small updates) + the inner optax
+    state, which tracks the masters."""
+
+    master: Any
+    inner: Any
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -76,6 +86,13 @@ def make_train_step(
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        if isinstance(opt_state, MasterState):
+            master, inner = opt_state
+            grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, master)
+            updates, inner = optimizer.update(grads, inner, master)
+            master = optax.apply_updates(master, updates)
+            params = jax.tree.map(lambda m, p: m.astype(p.dtype), master, params)
+            return params, MasterState(master, inner), loss
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -91,12 +108,22 @@ def init_sharded_state(
 ):
     """Init params (+opt state), placed per the sharding rules when a mesh is
     given."""
-    params = init_params(key, cfg)
+    params = init_params(key, cfg)  # already at-rest dtype (maybe bf16)
     if mesh is not None:
         pipelined = cfg.n_microbatches > 0 and mesh.shape.get("pipe", 1) > 1
         params = shardlib.shard_params(params, mesh, pipeline=pipelined)
-    opt_state = optimizer.init(params)
-    return params, opt_state
+    if any(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(params)):
+        # fp32 leaves must be COPIES, not aliases of the params leaves —
+        # the jitted step donates both trees and a shared buffer would be
+        # donated twice
+        master = jax.tree.map(
+            lambda x: x.astype(jnp.float32)
+            if x.dtype == jnp.bfloat16
+            else jnp.copy(x),
+            params,
+        )
+        return params, MasterState(master, optimizer.init(master))
+    return params, optimizer.init(params)
 
 
 def make_jitted_train_step(

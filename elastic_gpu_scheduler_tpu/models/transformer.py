@@ -44,7 +44,11 @@ class TransformerConfig:
     n_kv_heads: int = 0  # 0 → MHA; 0 < n_kv_heads < n_heads → GQA
     window_size: int = 0  # >0 → sliding-window attention (Mistral-style)
     rope_theta: float = 10000.0
-    dtype: str = "bfloat16"  # compute dtype; params stay float32
+    dtype: str = "bfloat16"  # compute dtype
+    # at-rest dtype of the big matmul weights ("" → same as `dtype`): bf16
+    # at rest halves weight HBM traffic on every read; training keeps an
+    # fp32 master copy in the optimizer state (models/train.py MasterState)
+    params_dtype: str = ""
     remat: bool = False
     use_ring_attention: bool = False  # sequence parallelism (needs mesh)
     n_experts: int = 0  # >0 → MoE FFN (models/moe.py), expert-parallel
@@ -59,6 +63,10 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+    @property
+    def rest_dtype(self):
+        return jnp.dtype(self.params_dtype or self.dtype)
 
 
 # -- init --------------------------------------------------------------------
@@ -94,12 +102,35 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
             "w_gate": dense(next(k), (L, D, F), D),
             "w_out": dense(next(k), (L, F, D), F),
         })
-    return {
+    params = {
         "embed": dense(next(k), (V, D), 1.0),
         "layers": layers,
         "final_norm": jnp.ones((D,), jnp.float32),
         "unembed": dense(next(k), (D, V), D),
     }
+    return cast_params_to_rest(params, cfg)
+
+
+# norm scales and the MoE router stay fp32 (tiny; numerics-sensitive)
+_FP32_AT_REST = ("attn_norm", "mlp_norm", "final_norm", "moe_gate")
+
+
+def cast_params_to_rest(params: dict, cfg: TransformerConfig) -> dict:
+    """Cast matmul weights to the at-rest dtype (no-op for float32).  The
+    compute path is unchanged — ``wmat`` casts to the compute dtype per use
+    either way — but bf16 at rest halves weight HBM bytes per read."""
+    pd = cfg.rest_dtype
+    if pd == jnp.float32:
+        return params
+
+    def walk(tree, name=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        if name in _FP32_AT_REST or getattr(tree, "dtype", None) != jnp.float32:
+            return tree
+        return tree.astype(pd)
+
+    return walk(params)
 
 
 def _embed_lookup(embed, tokens, dtype):

@@ -78,6 +78,11 @@ class _Plan:
     """Node slots for each gang member, in placement order."""
 
     slots: list[str]  # one node name per member, mesh-ordered
+    # the Option computed for each slot during planning — commit applies it
+    # directly (validating transact) instead of re-running the trade DFS per
+    # member, turning a 256-member commit's phase 1 from 256 searches into
+    # 256 O(chips-touched) applications
+    options: list = field(default_factory=list)
     claims: dict[str, int] = field(default_factory=dict)  # pod key → slot idx
     created: float = 0.0
     last_claim: float = 0.0  # expiry is keyed off claim ACTIVITY, not age,
@@ -113,6 +118,10 @@ class _Gang:
     committed: bool = False
     failed: str = ""
     done: int = 0
+    # phase telemetry (monotonic): barrier trip + commit completion, so the
+    # wall can be decomposed into arrival / commit / response fan-out
+    t_barrier: float = 0.0
+    t_commit_end: float = 0.0
 
 
 class GangCoordinator:
@@ -243,9 +252,10 @@ class GangCoordinator:
                         free += na.chips.avail_core()
             if free < demand:
                 continue
-            slots = self._plan_on(sched, req, group)
-            if slots is not None:
-                return _Plan(slots=slots)
+            planned = self._plan_on(sched, req, group)
+            if planned is not None:
+                slots, options = planned
+                return _Plan(slots=slots, options=options)
         return None
 
     def _reserve_other_plans(self, sched, clones: dict, get_clone) -> None:
@@ -303,6 +313,7 @@ class GangCoordinator:
 
         self._reserve_other_plans(sched, clones, get_clone)
         slots: list[str] = []
+        options: list = []
         cursor = 0
         for member in range(req.gang_size):
             member_req = TPURequest(
@@ -324,11 +335,12 @@ class GangCoordinator:
                     continue
                 cs.transact(opt)
                 slots.append(name)
+                options.append(opt)
                 placed = True
                 break
             if not placed:
                 return None
-        return slots
+        return slots, options
 
     # -- bind-time barrier + single-committer commit -------------------------
 
@@ -362,6 +374,7 @@ class GangCoordinator:
                 # members' threads stay parked on the condition (they hold
                 # no locks, so the commit runs without N-way GIL thrash)
                 GANG_EVENTS.inc("barrier_tripped")
+                g.t_barrier = time.monotonic()
                 try:
                     self._commit_gang(sched, gkey, g)
                     g.committed = True
@@ -369,6 +382,7 @@ class GangCoordinator:
                 except Exception as e:
                     g.failed = str(e) or repr(e)  # failure channel is truthiness
                     GANG_EVENTS.inc("commit_failed")
+                g.t_commit_end = time.monotonic()
                 g.cond.notify_all()
             else:
                 deadline = g.created + self.timeout
@@ -399,8 +413,15 @@ class GangCoordinator:
         members = sorted(g.members.items())  # [(pod_key, (node, pod))]
         with self._lock:
             plan = self._plans.get(gkey)
+            plan_slots: dict[str, object] = {}
             if plan is not None:
                 plan.committing = True
+                # planned per-slot options: commit can APPLY them (validating
+                # transact) instead of re-running the trade DFS per member
+                for key, idx in plan.claims.items():
+                    if idx < len(plan.options):
+                        plan_slots[key] = (plan.slots[idx], plan.options[idx])
+            plan_units = plan.member_units if plan is not None else None
 
         try:
             # phase 1: in-memory allocation, atomic under the scheduler lock
@@ -408,8 +429,21 @@ class GangCoordinator:
             allocated: list[tuple[Pod, str, object]] = []
             try:
                 with sched.lock:
-                    for _, (node, pod) in members:
-                        opt = sched.gang_allocate(node, pod)
+                    for key, (node, pod) in members:
+                        opt = None
+                        cached = plan_slots.get(key)
+                        if (
+                            cached is not None
+                            and cached[0] == node
+                            and request_from_pod(pod).units == plan_units
+                        ):
+                            try:
+                                sched.gang_apply_option(node, pod, cached[1])
+                                opt = cached[1]
+                            except ValueError:
+                                opt = None  # taken since planning → re-search
+                        if opt is None:
+                            opt = sched.gang_allocate(node, pod)
                         allocated.append((pod, node, opt))
             except Exception as e:
                 with sched.lock:
@@ -420,45 +454,58 @@ class GangCoordinator:
                     f"member {len(allocated)}/{len(members)} no longer fits: {e}"
                 ) from e
 
+            # phases 2+3 fan the API writes over the bounded pool in CHUNKS
+            # (one future per ~16 members, not per member — future/queue
+            # overhead is pure GIL churn at 256 members)
+            def run_phase(fn):
+                nchunk = 16
+                chunks = [
+                    allocated[i : i + nchunk]
+                    for i in range(0, len(allocated), nchunk)
+                ]
+
+                def run_chunk(chunk):
+                    out = []
+                    for item in chunk:
+                        t0 = time.perf_counter()
+                        try:
+                            fn(item)
+                        except Exception as e:
+                            return out, e  # keep partials for rollback scope
+                        out.append((item[0].key, time.perf_counter() - t0))
+                    return out, None
+
+                err = None
+                done: dict[str, float] = {}
+                for res in self._commit_pool.map(run_chunk, chunks):
+                    partial, chunk_err = res
+                    err = err or chunk_err
+                    done.update(partial)
+                return err, done
+
             # phase 2: annotation ledger for ALL members (reversible)
             def annotate(item):
                 pod, node, opt = item
-                t0 = time.perf_counter()
                 sched.gang_annotate(pod, opt, node)
-                return pod.key, time.perf_counter() - t0
 
-            done_keys: set[str] = set()
-            secs: dict[str, float] = {}
-            phase2_err = None
-            for res in self._commit_pool.map(
-                lambda it: _trap(annotate, it), allocated
-            ):
-                if isinstance(res, Exception):
-                    phase2_err = phase2_err or res
-                else:
-                    key, dt = res
-                    done_keys.add(key)
-                    secs[key] = dt
+            phase2_err, done2 = run_phase(annotate)
+            secs: dict[str, float] = dict(done2)
             if phase2_err is not None:
-                self._rollback(sched, allocated, strip_keys=done_keys)
+                # strip ALL members (a strip of an unwritten pod no-ops), so
+                # a member whose write outcome is ambiguous is covered too
+                self._rollback(
+                    sched, allocated, strip_keys={p.key for p, _, _ in allocated}
+                )
                 raise RuntimeError(f"annotation write failed: {phase2_err}")
 
             # phase 3: POST all bindings
             def post(item):
                 pod, node, opt = item
-                t0 = time.perf_counter()
                 sched.gang_post_binding(pod, node)
-                return pod.key, time.perf_counter() - t0
 
-            phase3_err = None
-            for res in self._commit_pool.map(
-                lambda it: _trap(post, it), allocated
-            ):
-                if isinstance(res, Exception):
-                    phase3_err = phase3_err or res
-                else:
-                    key, dt = res
-                    secs[key] = secs.get(key, 0.0) + dt
+            phase3_err, done3 = run_phase(post)
+            for key, dt in done3.items():
+                secs[key] = secs.get(key, 0.0) + dt
             if phase3_err is not None:
                 # bindings can't be un-POSTed; strip EVERY member's ledger
                 # entry + free all chips so the failure leaves no allocation

@@ -275,6 +275,20 @@ class TPUUnitScheduler(ResourceScheduler):
             self.released_pods.pop(pod.key, None)
             return opt
 
+    def gang_apply_option(self, node_name: str, pod: Pod, opt: Option) -> None:
+        """Apply a PRE-PLANNED option (validating transact — raises
+        ValueError if the placement was taken since planning).  Lets a gang
+        commit skip the per-member trade DFS."""
+        with self.lock:
+            na = self._get_allocator(node_name)
+            if na is None:
+                raise RuntimeError(
+                    f"gang apply: node {node_name} has no TPU allocator"
+                )
+            na.add(opt)
+            self.pod_maps[pod.key] = (node_name, opt)
+            self.released_pods.pop(pod.key, None)
+
     def gang_unallocate(self, node_name: str, pod: Pod, opt: Option) -> None:
         with self.lock:
             entry = self.pod_maps.pop(pod.key, None)
@@ -307,6 +321,7 @@ class TPUUnitScheduler(ResourceScheduler):
             if cur.metadata.uid != pod.metadata.uid:
                 return  # recreated; nothing of ours on it
             ann = cur.metadata.annotations
+            removed = False
             for key in list(ann):
                 if key.startswith(consts.ANNOTATION_CONTAINER_PREFIX) or key in (
                     consts.ANNOTATION_ASSUMED,
@@ -314,7 +329,11 @@ class TPUUnitScheduler(ResourceScheduler):
                     consts.ANNOTATION_TOPOLOGY,
                 ):
                     ann.pop(key, None)
-            cur.metadata.labels.pop(consts.ANNOTATION_ASSUMED, None)
+                    removed = True
+            if cur.metadata.labels.pop(consts.ANNOTATION_ASSUMED, None) is not None:
+                removed = True
+            if not removed:
+                return  # nothing of ours on it — skip the API write
             try:
                 self.clientset.update_pod(cur)
                 return

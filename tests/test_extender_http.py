@@ -319,3 +319,57 @@ def test_resync_recovers_missed_delete(stack):
             break
         time.sleep(0.05)
     assert ok, "chips were not released after the missed delete"
+
+
+def test_get_with_query_string_and_pprof_profile(stack):
+    """GET routes must tolerate query strings; the pprof endpoint samples."""
+    cluster, clientset, port, controller = stack
+    import urllib.request
+
+    base = f"http://127.0.0.1:{port}"
+    with urllib.request.urlopen(base + "/healthz?probe=1", timeout=10) as r:
+        assert r.status == 200
+    with urllib.request.urlopen(
+        base + "/debug/pprof/profile?seconds=0.2", timeout=15
+    ) as r:
+        body = r.read().decode()
+        assert r.status == 200 and "sampling rounds" in body
+
+
+def test_worker_pool_overflow_makes_progress():
+    """A burst larger than the worker pool must still be served (overflow
+    threads), not starve in the queue."""
+    import threading as _threading
+    import urllib.request
+
+    from elastic_gpu_scheduler_tpu.cli import build_stack
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+    from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+    from elastic_gpu_scheduler_tpu.k8s.objects import make_tpu_node
+    from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        FakeClientset(cluster), cluster=cluster
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0, workers=2
+    )
+    port = server.start()
+    # 8 concurrent keep-alive clients > 2 pooled workers
+    oks = []
+
+    def probe():
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            oks.append(r.status)
+
+    threads = [_threading.Thread(target=probe) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert oks.count(200) == 8
+    server.stop()

@@ -84,3 +84,37 @@ def test_generate_with_moe():
     prompt = jax.random.randint(jax.random.key(5), (1, 4), 0, cfg.vocab_size)
     out = generate(params, prompt, cfg, max_new_tokens=3)
     assert out.shape == (1, 7)
+
+
+def test_fused_decode_loop_matches_stepwise():
+    """decode_loop (one scan, sampling inside) is token-for-token identical
+    to the per-step host loop with the same key schedule (greedy + sampled)."""
+    from elastic_gpu_scheduler_tpu.models.generate import (
+        KVCache, decode_loop, decode_step, prefill, sample_token,
+    )
+
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(9), (2, 4), 0, CFG.vocab_size)
+    K = 6
+    for temperature in (0.0, 0.7):
+        cache = KVCache.empty(CFG, 2, 4 + K)
+        logits, cache = prefill(params, prompt, cache, CFG)
+        key = jax.random.key(42)
+        toks_fused, _, _ = decode_loop(
+            params, logits, cache, CFG, n_steps=K, temperature=temperature,
+            key=key,
+        )
+        # unfused replay, same key schedule
+        cache2 = KVCache.empty(CFG, 2, 4 + K)
+        logits2, cache2 = prefill(params, prompt, cache2, CFG)
+        toks_ref = []
+        k2 = jax.random.key(42)
+        for _ in range(K):
+            k2, sub = jax.random.split(k2)
+            t = sample_token(logits2, temperature, sub)
+            toks_ref.append(t)
+            logits2, cache2 = decode_step(params, t, cache2, CFG)
+        np.testing.assert_array_equal(
+            np.asarray(toks_fused), np.stack(toks_ref, axis=1),
+            err_msg=f"temperature={temperature}",
+        )

@@ -254,3 +254,49 @@ def test_flash_kernel_sliding_window_interpret():
             interpret=True, window=w,
         )
         np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
+
+
+def test_bf16_at_rest_params_and_master_weights():
+    """bf16 params at rest: forward matches fp32-at-rest exactly (compute
+    casts to bf16 either way), training runs on an fp32 master copy, and
+    loss still decreases (VERDICT r1 #3 recipe)."""
+    from elastic_gpu_scheduler_tpu.models.train import MasterState
+    from elastic_gpu_scheduler_tpu.models.transformer import cast_params_to_rest
+
+    cfg16 = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="bfloat16",
+    )
+    params16 = init_params(jax.random.key(0), cfg16)
+    # big matmul weights live in bf16; norm scales stay fp32
+    assert params16["layers"]["wq"].dtype == jnp.bfloat16
+    assert params16["embed"].dtype == jnp.bfloat16
+    assert params16["layers"]["attn_norm"].dtype == jnp.float32
+    assert params16["final_norm"].dtype == jnp.float32
+
+    # same init in fp32-at-rest form → identical logits (compute casts)
+    cfg32rest = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="bfloat16", params_dtype="float32",
+    )
+    params_ref = init_params(jax.random.key(0), cfg32rest)
+    assert params_ref["layers"]["wq"].dtype == jnp.float32
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    np.testing.assert_array_equal(
+        forward(params16, tokens, cfg16), forward(params_ref, tokens, cfg32rest)
+    )
+
+    # training: fp32 master in the optimizer state, params stay bf16
+    opt = make_optimizer(lr=1e-2)
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg16, opt)
+    assert isinstance(opt_state, MasterState)
+    assert opt_state.master["layers"]["wq"].dtype == jnp.float32
+    step = make_jitted_train_step(cfg16, opt)
+    toks = jax.random.randint(jax.random.key(2), (4, 17), 0, 128)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert params["layers"]["wq"].dtype == jnp.bfloat16
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
