@@ -31,7 +31,7 @@ from jax.sharding import Mesh
 
 from ..ops.attention import flash_attention
 from .quantize import wmat
-from ..parallel.ring import ring_attention_sharded
+from ..parallel.ring import ring_attention, ring_attention_sharded
 
 
 @dataclass(frozen=True)
@@ -180,15 +180,24 @@ def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     ).reshape(B, S, Hkv * n_rep, Dh)
 
 
-def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
-    """(B,S,H,Dh) → (B,S,H,Dh), dispatching to ring or flash attention."""
+def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh],
+               seq_axis: Optional[str] = None):
+    """(B,S,H,Dh) → (B,S,H,Dh), dispatching to ring or flash attention.
+
+    ``seq_axis``: set when already INSIDE a manual region (the pipeline's
+    shard_map) whose axis set includes the sequence axis — ring attention is
+    then called directly with its manual collectives instead of opening a
+    nested shard_map (which jax does not allow)."""
     n_rep = cfg.n_heads // cfg.kv_heads
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     qT = q.transpose(0, 2, 1, 3)  # (B,H,S,Dh)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    if cfg.use_ring_attention and mesh is not None:
+    if seq_axis is not None:
+        assert cfg.window_size == 0, "sliding window + ring attention TBD"
+        oT = ring_attention(qT, kT, vT, axis_name=seq_axis, causal=True)
+    elif cfg.use_ring_attention and mesh is not None:
         assert cfg.window_size == 0, "sliding window + ring attention TBD"
         oT = ring_attention_sharded(qT, kT, vT, mesh, causal=True)
     else:
@@ -196,8 +205,12 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     return oT.transpose(0, 2, 1, 3)
 
 
-def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
-    """One transformer block. x: (B, S, D).  Returns (x, aux_loss)."""
+def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh],
+           seq_axis: Optional[str] = None):
+    """One transformer block. x: (B, S, D).  Returns (x, aux_loss).
+
+    Under ``seq_axis`` (manual sequence sharding), S is the LOCAL shard
+    length and rope positions are offset to global coordinates."""
     B, S, D = x.shape
     Hn, Dh = cfg.n_heads, cfg.head_dim
     dtype = jnp.dtype(cfg.dtype)
@@ -209,9 +222,11 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh]):
     k = (h @ wmat(p["wk"], dtype)).reshape(B, S, Hkv, Dh)
     v = (h @ wmat(p["wv"], dtype)).reshape(B, S, Hkv, Dh)
     positions = jnp.arange(S)
+    if seq_axis is not None:
+        positions = positions + lax.axis_index(seq_axis) * S
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    o = _attention(q, k, v, cfg, mesh).reshape(B, S, Hn * Dh)
+    o = _attention(q, k, v, cfg, mesh, seq_axis).reshape(B, S, Hn * Dh)
     x = x + (o @ wmat(p["wo"], dtype))
 
     h = rms_norm(x, p["mlp_norm"])
@@ -246,11 +261,18 @@ def forward_with_aux(
         and mesh is not None
         and mesh.shape.get("pipe", 1) > 1
     )
-    # inside the pipeline's manual shard_map, attention must be plain flash
-    # (ring attention's own shard_map does not nest under pp; see
-    # parallel/pipeline.py composition note)
+    # sp × pp composition: ring attention's own shard_map cannot NEST inside
+    # the pipeline's, so when both axes are active the pipeline's manual
+    # region is widened to {pipe, seq} and the layers call ring attention's
+    # manual collectives directly (seq_axis)
+    seq_manual = (
+        pipelined
+        and cfg.use_ring_attention
+        and mesh.shape.get("seq", 1) > 1
+    )
     layer_fn = functools.partial(
-        _layer, cfg=cfg, mesh=None if pipelined else mesh
+        _layer, cfg=cfg, mesh=None if pipelined else mesh,
+        seq_axis="seq" if seq_manual else None,
     )
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
@@ -260,7 +282,8 @@ def forward_with_aux(
 
         xm = microbatch(x, cfg.n_microbatches)
         ym, aux_total = pipeline_apply(
-            lambda h, lp: layer_fn(h, lp), params["layers"], xm, mesh
+            lambda h, lp: layer_fn(h, lp), params["layers"], xm, mesh,
+            seq_axis="seq" if seq_manual else None,
         )
         x = unmicrobatch(ym)
     else:

@@ -1,11 +1,13 @@
 """Flash attention: Pallas TPU kernel with a pure-JAX fallback.
 
 The hot op of the flagship model (models/transformer.py).  TPU-first design
-(/opt/skills/guides/pallas_guide.md): the kernel streams K/V through VMEM,
-keeps a running (max, sum, acc) in fp32, and hits the MXU with
-``preferred_element_type=jnp.float32`` matmuls.  Differentiation uses
-``jax.custom_vjp`` with an LSE-based recompute backward in plain JAX (XLA
-fuses it well; a Pallas backward kernel is a later optimization).
+(/opt/skills/guides/pallas_guide.md): the forward kernel streams K/V through
+VMEM, keeps a running (max, sum, acc) in fp32, hits the MXU with
+``preferred_element_type=jnp.float32`` matmuls, and saves the per-row
+logsumexp.  Differentiation uses ``jax.custom_vjp``: on TPU the backward is
+two blockwise Pallas kernels (dQ over q-blocks, dK/dV over k-blocks) that
+recompute p = exp(s − lse) per block — no (Sq, Sk) intermediate at any
+context length; off-TPU the backward is an XLA einsum recompute.
 
 No reference-parity obligation: the reference has no kernels (SURVEY §2 #19).
 On non-TPU backends (tests run on CPU) the fallback implements identical
@@ -65,9 +67,13 @@ def mha_reference(
 # -- Pallas TPU kernel -------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
-                  window=0, q_shift=0):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_scale,
+                  causal, window=0, q_shift=0):
     """One (batch, head, q-block) program; streams K/V blocks from VMEM.
+
+    Also emits the per-row logsumexp (the flash residual) so the Pallas
+    backward kernels can recompute p = exp(s - lse) blockwise without ever
+    materializing the (Sq, Sk) score matrix.
 
     ``q_shift`` = sk - sq aligns rectangular causal masks with
     ``mha_reference`` (query i corresponds to absolute position i + sk - sq,
@@ -139,6 +145,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
 
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse carried as (..., block_q, 1): Mosaic requires the last two block
+    # dims be (8k, 128k) or equal to the full array dims — a trailing
+    # singleton satisfies that where a rank-3 (1, 1, block_q) tile cannot
+    lse_ref[0, 0] = (m_i + jnp.log(l_safe))[:, None]
 
 
 def _fit_block(n: int, want: int) -> int:
@@ -151,7 +161,7 @@ def _fit_block(n: int, want: int) -> int:
 
 
 def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret, window=0):
+                          interpret, window=0, return_lse=False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -167,7 +177,7 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k,
         _flash_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal,
         window=window, q_shift=sk - sq,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -177,12 +187,22 @@ def _flash_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
+    if return_lse:
+        return out, lse[..., 0]
     return out
 
 
@@ -191,6 +211,217 @@ def _use_pallas() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+# -- Pallas backward kernels (FlashAttention-2 style) ------------------------
+#
+# The backward never materializes the (Sq, Sk) score matrix: both kernels
+# recompute p = exp(q·kᵀ·scale − lse) one block at a time from the saved
+# logsumexp.  dQ parallelizes over q-blocks (streaming K/V); dK/dV
+# parallelizes over k-blocks (streaming Q/dO) — each a separate pallas_call
+# so neither needs atomics or cross-program reductions.
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k, sm_scale, causal, window, q_shift,
+):
+    import jax.experimental.pallas as pl
+
+    block_q = q_ref.shape[2]
+    seq_k = k_ref.shape[2]
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]  # (block_q,) — stored with trailing singleton
+    delta = delta_ref[0, 0, :, 0]
+    q_offset = pl.program_id(2) * block_q + q_shift
+
+    num_k_blocks = seq_k // block_k
+    start_block = 0
+    if causal:
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, pl.cdiv(q_offset + block_q, block_k)
+        )
+    if window > 0:
+        start_block = jnp.maximum(0, (q_offset - window + 1) // block_k)
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal or window > 0:
+            q_ids = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            keep = jnp.ones((block_q, block_k), jnp.bool_)
+            if causal:
+                keep &= q_ids >= k_ids
+            if window > 0:
+                keep &= (q_ids - k_ids) < window
+            s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # masked entries → exp(−inf) = 0
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        start_block, num_k_blocks, body,
+        jnp.zeros((block_q, q.shape[1]), jnp.float32),
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, sm_scale, causal, window, q_shift,
+):
+    import jax.experimental.pallas as pl
+
+    block_k = k_ref.shape[2]
+    seq_q = q_ref.shape[2]
+    d = k_ref.shape[3]
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    k_offset = pl.program_id(2) * block_k
+
+    num_q_blocks = seq_q // block_q
+    start_block = 0
+    end_block = num_q_blocks
+    if causal:
+        # contributes only where q_ids >= k_ids, i.e. qi + q_shift >= k_off
+        start_block = jnp.maximum(0, (k_offset - q_shift) // block_q)
+    if window > 0:
+        # and q_ids - k_ids < window
+        end_block = jnp.minimum(
+            num_q_blocks,
+            pl.cdiv(k_offset + block_k + window - q_shift, block_q),
+        )
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        delta_b = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal or window > 0:
+            q_ids = i * block_q + q_shift + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_ids = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            keep = jnp.ones((block_q, block_k), jnp.bool_)
+            if causal:
+                keep &= q_ids >= k_ids
+            if window > 0:
+                keep &= (q_ids - k_ids) < window
+            s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b[:, None]) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_block, end_block, body, (zeros, zeros))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(
+    q, k, v, out, lse, do, causal, sm_scale,
+    block_q: int = 512, block_k: int = 512, interpret: bool = False,
+    window: int = 0,
+):
+    """Blockwise dq/dk/dv from the saved lse — no (Sq, Sk) intermediate."""
+    import jax.experimental.pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
+    q_shift = sk - sq
+    dof = do.astype(q.dtype)
+    # trailing singleton for Mosaic block-shape constraints (see _flash_kernel)
+    lse = lse.reshape(b, h, sq, 1)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # (b, h, sq, 1)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, sm_scale=sm_scale,
+        causal=causal, window=window, q_shift=q_shift,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, dof, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, sm_scale=sm_scale,
+        causal=causal, window=window, q_shift=q_shift,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, dof, lse, delta)
+    return dq, dk, dv
 
 
 # -- public op with custom VJP ----------------------------------------------
@@ -218,15 +449,28 @@ def _forward(q, k, v, causal, sm_scale, window=0):
 
 
 def _fwd(q, k, v, causal, sm_scale, window):
-    out = _forward(q, k, v, causal, sm_scale, window)
-    return out, (q, k, v, out)
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    if _use_pallas():
+        out, lse = _flash_forward_pallas(
+            q, k, v, causal, scale, block_q=512, block_k=512, interpret=False,
+            window=window, return_lse=True,
+        )
+    else:
+        out, lse = mha_reference(q, k, v, causal, scale, window=window)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, sm_scale, window, res, do):
-    """Recompute backward (standard flash-attention gradient algebra);
-    the LSE is recomputed here rather than saved by the kernel."""
-    q, k, v, out = res
+    """Flash backward.  On TPU: blockwise Pallas kernels recomputing
+    p = exp(s - lse) per block — no (Sq, Sk) intermediate at any context
+    length.  Elsewhere: the XLA einsum recompute (materializes scores; fine
+    at test sizes, and tests exercise the kernels in interpret mode)."""
+    q, k, v, out, lse = res
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    if _use_pallas():
+        return _flash_backward_pallas(
+            q, k, v, out, lse, do, causal, scale, window=window
+        )
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -242,7 +486,6 @@ def _bwd(causal, sm_scale, window, res, do):
         if window > 0:
             mask &= (q_ids - k_ids) < window
         logits = jnp.where(mask[None, None], logits, NEG_INF)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
     p = jnp.exp(logits - lse[..., None])  # (B,H,Sq,Sk)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)

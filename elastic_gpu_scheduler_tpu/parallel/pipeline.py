@@ -5,12 +5,16 @@ over ``pipe`` gives each stage L/PP layers.  Microbatches march through the
 stages with one ``lax.ppermute`` hop per step — the classic GPipe schedule
 with M + PP - 1 steps and bubble fraction (PP-1)/(M+PP-1).
 
-Composition: the shard_map here is *manual only over pipe*; all other mesh
-axes (data/fsdp/expert/tensor) stay automatic, so XLA keeps sharding the
-per-stage matmuls and MoE dispatch as usual.  Sequence parallelism (ring
-attention, its own shard_map) does not nest inside the pipeline in this
-version — pp composes with dp/fsdp/ep/tp; sp composes with everything except
-pp.
+Composition: the shard_map here is *manual only over pipe* (plus ``seq``
+when sequence parallelism is active — see below); all other mesh axes
+(data/fsdp/expert/tensor) stay automatic, so XLA keeps sharding the
+per-stage matmuls and MoE dispatch as usual.
+
+sp × pp: ring attention's own shard_map cannot nest inside this one, so
+when both are requested the caller passes ``seq_axis`` — the manual region
+widens to {pipe, seq}, activations enter sequence-sharded, and the layer fn
+calls ``parallel.ring.ring_attention`` directly (its ppermute collectives
+run on the seq axis of this same manual region).
 
 No reference analogue (SURVEY §2 #19): this is the PP slot of the workload
 plane's dp/fsdp/ep/pp/tp/sq axes.
@@ -31,6 +35,7 @@ def pipeline_apply(
     stacked_params,  # pytree, leaves (L, ...) with L % pp == 0
     x: jax.Array,  # (M, mb, S, D) microbatched activations
     mesh: Mesh,
+    seq_axis: str = None,  # widen the manual region to {pipe, seq_axis}
 ) -> tuple[jax.Array, jax.Array]:
     """Run all layers over all microbatches; returns (y (M,mb,S,D), aux)."""
     pp = mesh.shape["pipe"]
@@ -47,9 +52,11 @@ def pipeline_apply(
     M = x.shape[0]
     T = M + pp - 1
 
+    manual_axes = ("pipe",) + ((seq_axis,) if seq_axis else ())
+
     def stage_fn(params_local, x_mb):
         stage = lax.axis_index("pipe")
-        vary = lambda a: lax.pcast(a, "pipe", to="varying")
+        vary = lambda a: lax.pcast(a, manual_axes, to="varying")
 
         def run_layers(h):
             def body(h, lp):
@@ -59,15 +66,25 @@ def pipeline_apply(
             h, aux = lax.scan(body, h, params_local)
             return h, jnp.sum(aux)
 
+        # carries must be varying over EVERY manual axis (x_mb is seq-varying
+        # when seq_axis is set; zeros alone would be replicated).  aux is
+        # typed over all manual axes too: MoE layers compute their router
+        # load-balance aux from seq-LOCAL activations, so it is seq-varying
+        # and the closing psum must reduce the seq axis as well (dense
+        # layers' constant aux just gets multiplied by the seq size, which
+        # the final divide undoes).
         state0 = vary(jnp.zeros(x_mb.shape[1:], x_mb.dtype))
-        outputs0 = vary(jnp.zeros_like(x_mb))
+        # fresh zeros, NOT zeros_like(x_mb): zeros_like inherits x_mb's
+        # seq-varying type and pcast refuses to re-vary an already-varying axis
+        outputs0 = vary(jnp.zeros(x_mb.shape, x_mb.dtype))
         aux0 = vary(jnp.zeros((), jnp.float32))
 
         def step(t, carry):
             state, outputs, aux_total = carry
-            # stage 0 ingests microbatch t
-            inject = x_mb[jnp.where(t < M, t, 0)]
-            state = jnp.where(stage == 0, vary(inject), state)
+            # stage 0 ingests microbatch t (x_mb is already seq-varying, so
+            # only the pipe axis needs casting here)
+            inject = lax.pcast(x_mb[jnp.where(t < M, t, 0)], "pipe", to="varying")
+            state = jnp.where(stage == 0, inject, state)
             state, aux = run_layers(state)
             # this stage held microbatch (t - stage); is it a real one?
             mb_idx = t - stage
@@ -91,16 +108,19 @@ def pipeline_apply(
         is_last = (stage == pp - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * is_last, "pipe")
         # every stage contributed its own layers' aux, once per microbatch;
-        # divide by M so the aux scale matches the unpipelined full-batch scan
-        aux_total = lax.psum(aux_total, "pipe") / M
+        # divide by M so the aux scale matches the unpipelined full-batch
+        # scan, and average over seq shards (MoE aux is per-shard)
+        seq_n = lax.psum(1, seq_axis) if seq_axis else 1
+        aux_total = lax.psum(aux_total, manual_axes) / (M * seq_n)
         return outputs, aux_total
 
+    x_spec = P(None, None, seq_axis, None) if seq_axis else P()
     y, aux = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P("pipe"), P()),
-        out_specs=(P(), P()),
-        axis_names={"pipe"},
+        in_specs=(P("pipe"), x_spec),
+        out_specs=(x_spec, P()),
+        axis_names=set(manual_axes),
     )(stacked_params, x)
     return y, aux
 

@@ -130,3 +130,66 @@ def test_pipeline_with_moe_combined():
     tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, 128)
     params, opt_state, loss = step(params, opt_state, tokens)
     assert np.isfinite(float(loss))
+
+
+def test_seq_plus_pipeline_matches_unpipelined_forward():
+    """sp × pp composition (VERDICT r1 #9): ring attention runs INSIDE the
+    pipeline's widened {pipe, seq} manual region; logits match the plain
+    scan path."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32", use_ring_attention=True, n_microbatches=2,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 128)
+    ref = forward(params, tokens, cfg, mesh=None)  # plain scan + full attn
+
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=2))
+    from elastic_gpu_scheduler_tpu.parallel import sharding as shardlib
+
+    params_s = shardlib.shard_params(params, mesh, pipeline=True)
+    out = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(params_s, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_seq_plus_pipeline_train_step():
+    """data × seq × pipe training: loss is finite and decreases."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32", use_ring_attention=True, n_microbatches=2,
+        remat=True,
+    )
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=2))
+    opt = make_optimizer(lr=1e-2)
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg, opt, mesh)
+    step = make_jitted_train_step(cfg, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, 128)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_moe_with_seq_and_pipeline():
+    """MoE aux is seq-varying inside the {pipe, seq} manual region; the
+    pipeline must reduce it over BOTH axes (review r2 finding)."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32", use_ring_attention=True, n_microbatches=2,
+        n_experts=2,
+    )
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=2))
+    opt = make_optimizer(lr=1e-2)
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg, opt, mesh)
+    step = make_jitted_train_step(cfg, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, 128)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
