@@ -309,6 +309,14 @@ class ExtenderServer:
                 ).encode("latin1")
                 self.wfile.write(head + payload)
                 self.wfile.flush()
+                # request debug-logging (reference routes.go:173-179
+                # DebugLogging wrapper); guarded so the fast path pays only
+                # an isEnabledFor check
+                if log.isEnabledFor(logging.DEBUG):
+                    log.debug(
+                        "http %s %s -> %d (%dB)", method, target, code,
+                        len(payload),
+                    )
                 return not close
 
         return Handler

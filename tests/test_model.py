@@ -72,9 +72,11 @@ def test_flash_matches_reference_pallas_interpret():
     np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2)
 
 
-def test_flash_rectangular_causal_matches_reference():
+@pytest.mark.parametrize("resident", [True, False])
+def test_flash_rectangular_causal_matches_reference(resident):
     """sq != sk causal: kernel q_ids must carry the (sk - sq) offset so the
-    queries align to the LAST sq key positions (ADVICE r1 medium)."""
+    queries align to the LAST sq key positions (ADVICE r1 medium).
+    Covers both the VMEM-resident and the streamed kernel variants."""
     key = jax.random.key(11)
     B, H, D = 1, 2, 32
     for sq, sk, window in ((128, 256, 0), (128, 384, 0), (128, 256, 100)):
@@ -86,7 +88,7 @@ def test_flash_rectangular_causal_matches_reference():
                                window=window)
         out = _flash_forward_pallas(
             q, k, v, causal=True, sm_scale=D**-0.5, block_q=64, block_k=128,
-            interpret=True, window=window,
+            interpret=True, window=window, resident=resident,
         )
         np.testing.assert_allclose(out, ref, rtol=1e-2, atol=1e-2,
                                    err_msg=f"sq={sq} sk={sk} window={window}")
@@ -328,8 +330,9 @@ def test_pallas_backward_matches_reference_s4096():
     np.testing.assert_allclose(lse, ref_lse, rtol=1e-4, atol=1e-4)
 
     def bwd(q, k, v, out, lse, do):
+        # force the STREAMED kernels — the long-context path this test proves
         return _flash_backward_pallas(
-            q, k, v, out, lse, do, True, scale, interpret=True
+            q, k, v, out, lse, do, True, scale, interpret=True, resident=False
         )
 
     jitted_bwd = jax.jit(bwd)
@@ -350,8 +353,10 @@ def test_pallas_backward_matches_reference_s4096():
     assert f"{S},{S}" not in hlo, "backward materializes an (S,S) buffer"
 
 
-def test_pallas_backward_window_and_rectangular():
-    """Backward kernels honor sliding-window and sq != sk causal masks."""
+@pytest.mark.parametrize("resident", [True, False])
+def test_pallas_backward_window_and_rectangular(resident):
+    """Backward kernels (both variants) honor sliding-window and sq != sk
+    causal masks."""
     from elastic_gpu_scheduler_tpu.ops.attention import (
         _flash_backward_pallas,
         _flash_forward_pallas,
@@ -371,7 +376,7 @@ def test_pallas_backward_window_and_rectangular():
         )
         dq, dk, dv = _flash_backward_pallas(
             q, k, v, out, lse, do, True, scale, block_q=64, block_k=64,
-            interpret=True, window=window,
+            interpret=True, window=window, resident=resident,
         )
 
         def ref_loss(q, k, v):
