@@ -453,6 +453,25 @@ def model_bench_on_tpu():
             prefill,
         )
 
+        # prefill throughput: chunked multi-token passes (one per 512
+        # tokens), not one decode step per token
+        Sp = 1024
+
+        @jax.jit
+        def prefill_fn(p, toks):
+            c = KVCache.empty(cfg, B, Sp + 64)
+            lg, c = prefill(p, toks, c, cfg)
+            return lg
+
+        ptoks = jax.random.randint(jax.random.key(7), (B, Sp), 0, V)
+        lg = prefill_fn(params, ptoks)
+        _ = float(lg[0, 0])  # compile + sync
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            lg = prefill_fn(params, ptoks)
+            _ = float(lg[0, 0])
+        prefill_ms = (_time.perf_counter() - t0) * 1000 / 3
+
         K = 64
         dloop = jax.jit(
             _ft.partial(decode_loop, cfg=cfg, n_steps=K, temperature=0.0)
@@ -482,6 +501,8 @@ def model_bench_on_tpu():
             "tpu_train_tflops": round(train_tflops, 2),
             "tpu_train_mfu": round(train_mfu, 4),
             "tpu_model_params_m": round(param_count(params) / 1e6, 2),
+            "tpu_prefill_ms": round(prefill_ms, 3),
+            "tpu_prefill_tokens_per_s": round(B * Sp * 1000 / prefill_ms, 0),
             "tpu_decode_fused_k": K,
             "tpu_decode_ms_per_token": round(decode_ms, 3),
             "tpu_decode_tokens_per_s": round(B * 1000 / decode_ms, 1),

@@ -76,50 +76,13 @@ def cached_attention(q, cache_k, cache_v, lengths, window=0):
 def decode_step(
     params: dict, token: jax.Array, cache: KVCache, cfg: TransformerConfig
 ) -> tuple[jax.Array, KVCache]:
-    """token: (B,) int32 at position cache.length → (logits (B,V), cache')."""
-    dtype = jnp.dtype(cfg.dtype)
-    B = token.shape[0]
-    Hn, Dh = cfg.n_heads, cfg.head_dim
-    x = _embed_lookup(params["embed"], token, dtype)[:, None, :]  # (B,1,D)
-    pos = cache.length
+    """token: (B,) int32 at position cache.length → (logits (B,V), cache').
 
-    def layer_step(x, scanned):
-        p, ck, cv = scanned  # per-layer params + cache slices
-        h = rms_norm(x, p["attn_norm"])
-        Hkv = cfg.kv_heads
-        q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ wmat(p["wk"], dtype)).reshape(B, 1, Hkv, Dh)
-        v = (h @ wmat(p["wv"], dtype)).reshape(B, 1, Hkv, Dh)
-        posv = jnp.full((1,), pos)
-        q = rope(q, posv, cfg.rope_theta)
-        k = rope(k, posv, cfg.rope_theta)
-        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        o = cached_attention(
-            q, ck, cv, pos, window=cfg.window_size
-        ).reshape(B, 1, Hn * Dh)
-        x = x + (o @ wmat(p["wo"], dtype))
-        h = rms_norm(x, p["mlp_norm"])
-        if cfg.n_experts > 0:
-            from .moe import moe_ffn
-
-            ffn, _ = moe_ffn(
-                h, p["moe_gate"], p["w_in"], p["w_gate"], p["w_out"],
-                capacity_factor=cfg.capacity_factor, dtype=dtype,
-            )
-            x = x + ffn
-        else:
-            gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
-            up = h @ wmat(p["w_in"], dtype)
-            x = x + ((gate * up) @ wmat(p["w_out"], dtype))
-        return x, (ck, cv)
-
-    x, (new_k, new_v) = lax.scan(
-        layer_step, x, (params["layers"], cache.k, cache.v)
-    )
-    x = rms_norm(x, params["final_norm"])
-    logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
-    return logits.astype(jnp.float32), KVCache(new_k, new_v, pos + 1)
+    The T=1 case of ``forward_cached`` — one transformer-layer body exists
+    for decode, prefill, and speculative verification, so the three paths
+    cannot drift apart."""
+    logits, cache = forward_cached(params, token[:, None], cache, cfg)
+    return logits[:, 0, :], cache
 
 
 def sample_token(
@@ -165,12 +128,121 @@ def decode_loop(
     return tokens.T, logits, cache  # (B, n_steps)
 
 
-def prefill(
+def cached_attention_multi(q, cache_k, cache_v, start, window=0):
+    """T-position attention against the cache (the multi-token
+    generalization of ``cached_attention``).
+
+    q: (B, T, H, Dh) — queries at positions start..start+T-1; cache:
+    (B, M, Hkv, Dh) with the same T new K/V rows already written at those
+    positions.  Causal: query i sees key j iff j <= start + i.  Score
+    memory is O(T·M); callers keep T a bounded block (prefill chunks,
+    speculative draft windows).
+    """
+    B, T, Hn, Dh = q.shape
+    M = cache_k.shape[1]
+    Hkv = cache_k.shape[2]
+    n_rep = Hn // Hkv
+    scale = Dh**-0.5
+    qg = (
+        q.reshape(B, T, Hkv, n_rep, Dh)
+        .transpose(0, 2, 3, 1, 4)
+        .astype(jnp.float32)
+    )  # (B, Hkv, n_rep, T, Dh)
+    kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,Hkv,M,Dh)
+    vT = cache_v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bgrtd,bgkd->bgrtk", qg, kT) * scale  # (B,Hkv,n_rep,T,M)
+    qpos = start + jnp.arange(T)  # (T,)
+    kpos = jnp.arange(M)  # (M,)
+    keep = kpos[None, :] <= qpos[:, None]  # (T, M)
+    if window > 0:
+        keep = keep & ((qpos[:, None] - kpos[None, :]) < window)
+    s = jnp.where(keep[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrtk,bgkd->bgrtd", p, vT)  # (B,Hkv,n_rep,T,Dh)
+    return (
+        o.transpose(0, 3, 1, 2, 4).reshape(B, T, Hn, Dh).astype(q.dtype)
+    )
+
+
+def forward_cached(
     params: dict, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig
 ) -> tuple[jax.Array, KVCache]:
-    """Feed the prompt one token at a time (simple, correct prefill).
+    """Multi-token cached forward: process T tokens starting at position
+    ``cache.length`` in ONE pass, returning logits for every position.
+
+    tokens: (B, T) → (logits (B, T, V), cache at length+T).  This is the
+    device-FLOP-efficient primitive behind batched prefill (T = prompt
+    length) and speculative verification (T = draft block): one wide pass
+    instead of T sequential decode steps.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    B, T = tokens.shape
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    x = _embed_lookup(params["embed"], tokens, dtype)  # (B, T, D)
+    pos0 = cache.length
+    positions = pos0 + jnp.arange(T)
+
+    def layer_step(x, scanned):
+        p, ck, cv = scanned  # ck/cv: (B, M, Hkv, Dh)
+        h = rms_norm(x, p["attn_norm"])
+        Hkv = cfg.kv_heads
+        q = (h @ wmat(p["wq"], dtype)).reshape(B, T, Hn, Dh)
+        k = (h @ wmat(p["wk"], dtype)).reshape(B, T, Hkv, Dh)
+        v = (h @ wmat(p["wv"], dtype)).reshape(B, T, Hkv, Dh)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        ck = lax.dynamic_update_slice(ck, k, (0, pos0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, pos0, 0, 0))
+        o = cached_attention_multi(
+            q, ck, cv, pos0, window=cfg.window_size
+        ).reshape(B, T, Hn * Dh)
+        x = x + (o @ wmat(p["wo"], dtype))
+        h = rms_norm(x, p["mlp_norm"])
+        if cfg.n_experts > 0:
+            from .moe import moe_ffn
+
+            ffn, _ = moe_ffn(
+                h, p["moe_gate"], p["w_in"], p["w_gate"], p["w_out"],
+                capacity_factor=cfg.capacity_factor, dtype=dtype,
+            )
+            x = x + ffn
+        else:
+            gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+            up = h @ wmat(p["w_in"], dtype)
+            x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        layer_step, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = x @ wmat(params["unembed"], dtype)  # (B, T, V)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, pos0 + T)
+
+
+def prefill(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig,
+    chunk: int = 512,
+) -> tuple[jax.Array, KVCache]:
+    """Chunked batched prefill: the prompt in ceil(S/chunk) multi-token
+    passes instead of one decode step per token — wide MXU matmuls, and the
+    O(T·M) attention-score memory stays bounded by the chunk size.
 
     tokens: (B, S) → (last-position logits (B, V), cache at length S)."""
+    S = tokens.shape[1]
+    logits = None
+    for s0 in range(0, S, chunk):
+        logits, cache = forward_cached(
+            params, tokens[:, s0 : s0 + chunk], cache, cfg
+        )
+    return logits[:, -1, :], cache
+
+
+def prefill_sequential(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """Token-at-a-time prefill (the decode_step path) — kept as the
+    equivalence oracle for ``prefill``."""
 
     def body(carry, tok):
         cache = carry

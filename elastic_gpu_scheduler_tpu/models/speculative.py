@@ -1,14 +1,15 @@
 """Speculative decoding via prompt-lookup (n-gram) drafting.
 
 Draft-model-free speculation: propose the tokens that followed the most
-recent matching n-gram in the context, verify all K proposals in one
-host-level dispatch (a ``lax.scan`` of decode steps over the cache — the
-device still runs K+1 sequential steps; a wide multi-token verification
-kernel is the follow-up optimization), and keep the longest prefix the model
-itself would have produced — output is exactly greedy decoding.
-``model_passes`` in the returned stats counts host dispatches, which is the
-relevant number when per-call host/dispatch latency dominates (small models,
-remote-attached accelerators); on-device FLOPs are NOT reduced.
+recent matching n-gram in the context, verify all K proposals in ONE
+multi-token cached forward (``generate.forward_cached`` — a single wide
+pass over the K+1 draft positions, so device time per accepted token is
+the sequential-decode cost divided by the acceptance length, the actual
+speculative-decoding win), and keep the longest prefix the model itself
+would have produced — output is exactly greedy decoding.
+
+The verify window has a FIXED width (k+1, short drafts padded), so the
+verification pass compiles once.
 
 Cache rollback is free by design: KVCache entries beyond ``length`` are
 masked out (generate.cached_attention), so rejecting speculated tokens is
@@ -25,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import KVCache, decode_step
+from .generate import KVCache, decode_step, forward_cached
 from .transformer import TransformerConfig
 
 
@@ -60,11 +61,22 @@ def speculative_generate(
     from .generate import prefill
 
     S = prompt.shape[1]
-    max_len = max_len or S + max_new_tokens + k + 1
+    need = S + max_new_tokens + k + 1
+    max_len = max_len or need
+    # the FIXED-width verify window writes up to k padded K/V rows past the
+    # accepted prefix; a smaller max_len would make dynamic_update_slice
+    # clamp the write start and silently corrupt confirmed cache rows
+    assert max_len >= need, (
+        f"max_len {max_len} < {need} (prompt + max_new_tokens + k + 1; the "
+        "padded verify window needs the headroom)"
+    )
     cache = KVCache.empty(cfg, 1, max_len)
     logits, cache = prefill(params, prompt, cache, cfg)
 
     step_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
+    # fixed-width verify window: [last_accepted, d1..dk] (drafts padded) so
+    # the multi-token pass compiles exactly once
+    verify_fn = jax.jit(functools.partial(forward_cached, cfg=cfg))
     context: list[int] = [int(t) for t in np.asarray(prompt[0])]
     produced: list[int] = []
     passes = 0
@@ -78,19 +90,15 @@ def speculative_generate(
         budget = max_new_tokens - len(produced)
         drafts = propose_ngram(context, ngram, min(k, budget - 1))
         if drafts:
-            # feed [last_accepted, d1..dn]; logits after each position give
-            # the model's own choice to verify the NEXT draft against
-            feed = [context[-1]] + drafts
+            # ONE wide pass over [last_accepted, d1..dn] (+padding): the
+            # logits at each position give the model's own choice to verify
+            # the NEXT draft against
+            feed = [context[-1]] + drafts + [0] * (k - len(drafts))
             confirmed_len = int(cache.length)
-            toks = jnp.asarray(feed, jnp.int32)[:, None]  # (n+1, 1)
-
-            def body(c, tok):
-                lg, c = decode_step(params, tok, c, cfg)
-                return c, lg
-
-            cache2, logits_seq = jax.lax.scan(body, cache, toks)
+            toks = jnp.asarray(feed, jnp.int32)[None, :]  # (1, k+1)
+            logits_seq, cache2 = verify_fn(params, toks, cache)
             passes += 1
-            choices = np.asarray(jnp.argmax(logits_seq[:, 0, :], -1))
+            choices = np.asarray(jnp.argmax(logits_seq[0], -1))  # (k+1,)
             n_accept = 0
             for i, d in enumerate(drafts):
                 if int(choices[i]) == d:

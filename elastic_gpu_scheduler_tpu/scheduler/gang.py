@@ -278,6 +278,15 @@ class GangCoordinator:
                 cs = get_clone(node)
                 if cs is None:
                     continue
+                # apply the plan's own stored option when it still fits —
+                # O(chips-touched) instead of re-running the trade DFS per
+                # reserved member (a 1024-member prior plan made the NEXT
+                # gang's planning ~2x slower via re-search)
+                if idx < len(other.options):
+                    opt = other.options[idx]
+                    if cs.can_transact(opt):
+                        cs.transact(opt)
+                        continue
                 member_req = TPURequest(
                     pod_uid=f"resv-{other_key}-{idx}",
                     pod_key=f"resv/{other_key}/{idx}",

@@ -118,3 +118,38 @@ def test_fused_decode_loop_matches_stepwise():
             np.asarray(toks_fused), np.stack(toks_ref, axis=1),
             err_msg=f"temperature={temperature}",
         )
+
+
+def test_batched_prefill_matches_sequential():
+    """Chunked multi-token prefill == token-at-a-time prefill (same logits,
+    same cache contents within the valid prefix)."""
+    from elastic_gpu_scheduler_tpu.models.generate import (
+        KVCache, forward_cached, prefill, prefill_sequential,
+    )
+
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jax.random.randint(jax.random.key(3), (2, 11), 0, CFG.vocab_size)
+    cache_a = KVCache.empty(CFG, 2, 24)
+    cache_b = KVCache.empty(CFG, 2, 24)
+    la, ca = prefill(params, tokens, cache_a, CFG, chunk=4)  # uneven chunks
+    lb, cb = prefill_sequential(params, tokens, cache_b, CFG)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ca.k[:, :, :11]), np.asarray(cb.k[:, :, :11]),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert int(ca.length) == int(cb.length) == 11
+    # forward_cached mid-stream (nonzero start) == decode steps
+    from elastic_gpu_scheduler_tpu.models.generate import decode_step
+
+    extra = jax.random.randint(jax.random.key(4), (2, 3), 0, CFG.vocab_size)
+    lg_multi, cm = forward_cached(params, extra, ca, CFG)
+    cs = cb
+    lgs = []
+    for i in range(3):
+        lg, cs = decode_step(params, extra[:, i], cs, CFG)
+        lgs.append(lg)
+    np.testing.assert_allclose(
+        np.asarray(lg_multi), np.stack(lgs, axis=1), rtol=1e-4, atol=1e-4
+    )
