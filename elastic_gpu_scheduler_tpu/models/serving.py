@@ -117,6 +117,71 @@ def _paged_decode_step(
     return logits.astype(jnp.float32), new_k, new_v
 
 
+def _paged_prefill(
+    params, tokens, cache_k, cache_v, pages, t_real, *, cfg, page_size
+):
+    """One-pass prompt ingestion for ONE slot (the paged analogue of
+    ``generate.forward_cached`` with an empty prefix): self-attention over
+    the whole prompt block, K/V scattered into the slot's pages.
+
+    tokens: (1, Tpad) — prompt padded to a bucket size; pages: (max_pages,)
+    the slot's table row; t_real: scalar count of real tokens (padding K/V
+    is routed to the scratch page).  Returns (last-real-position logits
+    (V,), caches) — only that row is ever consumed, so only it is
+    unembedded.
+    """
+    from ..ops.attention import flash_attention
+
+    dtype = jnp.dtype(cfg.dtype)
+    Tpad = tokens.shape[1]
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    x = _embed_lookup(params["embed"], tokens, dtype)  # (1, Tpad, D)
+    positions = jnp.arange(Tpad)
+    pidx = jnp.where(
+        positions < t_real, pages[positions // page_size], SCRATCH_PAGE
+    )
+    off = positions % page_size
+
+    def layer_step(x, scanned):
+        p, ck, cv = scanned  # (P, page, Hkv, Dh)
+        h = rms_norm(x, p["attn_norm"])
+        Hkv = cfg.kv_heads
+        q = (h @ wmat(p["wq"], dtype)).reshape(1, Tpad, Hn, Dh)
+        k = (h @ wmat(p["wk"], dtype)).reshape(1, Tpad, Hkv, Dh)
+        v = (h @ wmat(p["wv"], dtype)).reshape(1, Tpad, Hkv, Dh)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        ck = ck.at[pidx, off].set(k[0])
+        cv = cv.at[pidx, off].set(v[0])
+        # the prompt is the entire valid prefix, so attention is plain
+        # causal self-attention within the block — no page gather needed
+        # (padding positions sit AFTER every real one; causal masking keeps
+        # them out of real queries' windows)
+        from .transformer import repeat_kv
+
+        n_rep = Hn // Hkv
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            repeat_kv(k, n_rep).transpose(0, 2, 1, 3),
+            repeat_kv(v, n_rep).transpose(0, 2, 1, 3),
+            True, None, cfg.window_size,
+        ).transpose(0, 2, 1, 3).reshape(1, Tpad, Hn * Dh)
+        x = x + (o @ wmat(p["wo"], dtype))
+        h = rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+        up = h @ wmat(p["w_in"], dtype)
+        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache_k, cache_v)
+    )
+    x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ wmat(params["unembed"], dtype))[0, 0]  # (V,)
+    return logits.astype(jnp.float32), new_k, new_v
+
+
 def _fused_serve_chunk(
     params, cache_k, cache_v, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, key, *, cfg, page_size, n_steps,
@@ -209,6 +274,10 @@ class InferenceEngine:
             ),
             donate_argnums=(1, 2),
         )
+        self._prefill = jax.jit(
+            functools.partial(_paged_prefill, cfg=cfg, page_size=page_size),
+            donate_argnums=(2, 3),  # the caches, NOT (tokens, cache_k)
+        )
         self._key = jax.random.key(0)
 
     # -- public API ----------------------------------------------------------
@@ -264,6 +333,46 @@ class InferenceEngine:
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
             # positions <= length, all of which the new tenant rewrites
+            self._try_prefill(i, req)
+
+    def _try_prefill(self, i: int, req: Request) -> None:
+        """Ingest the WHOLE prompt in one pass (the paged analogue of
+        batched prefill) when pages are available; otherwise leave the slot
+        in the incremental prompt-feeding path (the fused chunks consume
+        the prompt at decode speed — slower but always correct)."""
+        plen = len(req.prompt)
+        if plen < 2 or not self._ensure_pages(i, plen):
+            return
+        # bucket the pad length so the prefill jit compiles per power of two
+        tpad = 8
+        while tpad < plen:
+            tpad *= 2
+        tpad = min(tpad, self.max_len)
+        toks = np.zeros((1, tpad), np.int32)
+        toks[0, :plen] = req.prompt
+        logits, self.cache_k, self.cache_v = self._prefill(
+            self.params,
+            jnp.asarray(toks),
+            self.cache_k,
+            self.cache_v,
+            jnp.asarray(self.tables[i]),
+            jnp.asarray(plen, jnp.int32),
+        )
+        if req.temperature > 0:
+            # same key stream + recipe as the fused chunks' device sampling
+            self._key, sub = jax.random.split(self._key)
+            tok = int(
+                jax.random.categorical(sub, logits / req.temperature)
+            )
+        else:
+            tok = int(jnp.argmax(logits))
+        req.output.append(tok)
+        self.emitted[i] = 1
+        self.lengths[i] = plen
+        self.next_token[i] = tok
+        if self.emitted[i] >= req.max_new_tokens:
+            req.done.set()
+            self._release_slot(i)
 
     def _ensure_pages(self, i: int, upto: int) -> bool:
         """Grow slot i's page list to cover token positions < upto.

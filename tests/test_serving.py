@@ -176,3 +176,21 @@ def test_paged_pool_exhaustion_raises():
 
     with _pytest.raises(RuntimeError, match="pool exhausted|budget"):
         engine.run_until_idle()
+
+
+def test_admission_prefills_prompt_in_one_pass():
+    """A newly admitted request's prompt is ingested by the one-pass paged
+    prefill (slot length jumps to plen and the first token is emitted at
+    admission), and outputs still match generate()."""
+    params = init_params(jax.random.key(0), CFG)
+    engine = InferenceEngine(params, CFG, max_batch=2, max_len=64,
+                             page_size=8, fused_steps=4)
+    prompt = [7, 3, 9, 1, 4, 4, 2]
+    r = engine.submit(Request(prompt=prompt, max_new_tokens=6))
+    engine._admit()
+    i = next(j for j, s in enumerate(engine.slots) if s is r)
+    assert int(engine.lengths[i]) == len(prompt)  # whole prompt ingested
+    assert len(r.output) == 1  # first token emitted at admission
+    engine.run_until_idle()
+    ref = generate(params, jax.numpy.asarray([prompt]), CFG, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ref)[0, len(prompt):], r.output)
