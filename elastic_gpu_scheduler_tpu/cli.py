@@ -103,6 +103,13 @@ def main(argv=None) -> int:
     p.add_argument("--tls-cert", default="", help="serve HTTPS with this cert")
     p.add_argument("--tls-key", default="")
     p.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="run lease-based leader election (HA: standbys gate verbs and "
+        "report /healthz 503 until they acquire the lease)",
+    )
+    p.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p.add_argument(
         "--http-workers",
         type=int,
         default=_env_int("HTTP_WORKERS", 320),
@@ -168,10 +175,25 @@ def main(argv=None) -> int:
     if controller is not None:
         controller.start()
 
+    elector = None
+    if args.leader_elect:
+        import socket as _socket
+
+        from .scheduler.leader import LeaderElector
+
+        elector = LeaderElector(
+            clientset,
+            identity=f"{_socket.gethostname()}-{os.getpid()}",
+            lease_duration=args.leader_lease_duration,
+            renew_period=max(1.0, args.leader_lease_duration / 3),
+        )
+        elector.start()
+
     server = ExtenderServer(
         predicate, prioritize, bind, status, host=args.host, port=args.port,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         workers=max(0, args.http_workers),
+        leader_check=elector.is_leader if elector is not None else None,
     )
 
     stop = threading.Event()
@@ -182,6 +204,8 @@ def main(argv=None) -> int:
             os._exit(1)
         stop.set()
         server.stop()
+        if elector is not None:
+            elector.stop()
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)

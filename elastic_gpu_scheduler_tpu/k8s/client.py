@@ -54,6 +54,17 @@ class Clientset:
         scheduling outcomes are actually recorded."""
         raise NotImplementedError
 
+    # -- leases (coordination.k8s.io; leader election) -----------------------
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def create_lease(self, lease: dict) -> dict:
+        raise NotImplementedError
+
+    def update_lease(self, lease: dict) -> dict:
+        raise NotImplementedError
+
 
 class FakeClientset(Clientset):
     def __init__(self, cluster: FakeCluster):
@@ -79,6 +90,15 @@ class FakeClientset(Clientset):
 
     def create_event(self, event):
         return self.cluster.create_event(event)
+
+    def get_lease(self, namespace, name):
+        return self.cluster.get_lease(namespace, name)
+
+    def create_lease(self, lease):
+        return self.cluster.create_lease(lease)
+
+    def update_lease(self, lease):
+        return self.cluster.update_lease(lease)
 
 
 class RestClientset(Clientset):
@@ -198,6 +218,23 @@ class RestClientset(Clientset):
     def create_event(self, event):
         ns = (event.get("involvedObject") or {}).get("namespace", "default")
         self._req("POST", f"/api/v1/namespaces/{ns}/events", event)
+
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace, name):
+        return self._req("GET", f"{self._LEASE_BASE}/{namespace}/leases/{name}")
+
+    def create_lease(self, lease):
+        md = lease.get("metadata") or {}
+        ns = md.get("namespace", "default")
+        return self._req("POST", f"{self._LEASE_BASE}/{ns}/leases", lease)
+
+    def update_lease(self, lease):
+        md = lease.get("metadata") or {}
+        ns = md.get("namespace", "default")
+        return self._req(
+            "PUT", f"{self._LEASE_BASE}/{ns}/leases/{md.get('name', '')}", lease
+        )
 
 
 class RestClusterView:

@@ -17,6 +17,7 @@ them:
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 from typing import Callable, Iterator, Optional
@@ -56,6 +57,10 @@ class FakeCluster:
         self._rv = 0
         self._watchers: list[queue.Queue] = []
         self.events: list[dict] = []  # recorded k8s Events (append-only)
+        # coordination.k8s.io/Lease analogues: ns/name → lease dict with
+        # metadata.resourceVersion enforcing optimistic concurrency — the
+        # substrate for leader election (scheduler/leader.py)
+        self._leases: dict[str, dict] = {}
 
     # -- internals -----------------------------------------------------------
 
@@ -171,6 +176,42 @@ class FakeCluster:
             cur.status.phase = phase
             cur.metadata.resource_version = self._next_rv()
             self._notify("MODIFIED", cur)
+
+    # -- leases (coordination.k8s.io analogue) -------------------------------
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            lease = self._leases.get(f"{namespace}/{name}")
+            if lease is None:
+                raise not_found(f"lease {namespace}/{name}")
+            return json.loads(json.dumps(lease))
+
+    def create_lease(self, lease: dict) -> dict:
+        md = lease.get("metadata") or {}
+        key = f"{md.get('namespace', 'default')}/{md.get('name', '')}"
+        with self._lock:
+            if key in self._leases:
+                raise ApiError("AlreadyExists", f"lease {key}", 409)
+            lease = json.loads(json.dumps(lease))
+            lease["metadata"]["resourceVersion"] = self._next_rv()
+            self._leases[key] = lease
+            return json.loads(json.dumps(lease))
+
+    def update_lease(self, lease: dict) -> dict:
+        md = lease.get("metadata") or {}
+        key = f"{md.get('namespace', 'default')}/{md.get('name', '')}"
+        with self._lock:
+            cur = self._leases.get(key)
+            if cur is None:
+                raise not_found(f"lease {key}")
+            if str(md.get("resourceVersion", "")) != str(
+                cur["metadata"]["resourceVersion"]
+            ):
+                raise conflict(f"lease {key}: stale resourceVersion")
+            lease = json.loads(json.dumps(lease))
+            lease["metadata"]["resourceVersion"] = self._next_rv()
+            self._leases[key] = lease
+            return json.loads(json.dumps(lease))
 
     def create_event(self, event: dict) -> None:
         with self._lock:

@@ -416,12 +416,30 @@ class InferenceEngine:
                     f"0 runnable (pool {self.n_pages - 1} pages)"
                 )
             return
+        # bucket the table width to the MAX pages any active slot can touch
+        # this chunk (next power of two) — attention cost per step follows
+        # the LIVE context length, not max_len; the chunk jit compiles once
+        # per bucket (log2(max_pages) variants)
+        need = max(
+            len(self.slot_pages[i])
+            for i in range(self.max_batch)
+            if active[i]
+        )
+        bucket = 1
+        while bucket < need:
+            bucket *= 2
+        bucket = min(bucket, self.max_pages_per_slot)
+        # INACTIVE rows must point at scratch in the sliced view: a stalled
+        # slot whose write position lies beyond the bucket would otherwise
+        # clamp into its own LAST visible page and corrupt confirmed K/V
+        view = self.tables[:, :bucket].copy()
+        view[~active] = SCRATCH_PAGE
         self._key, sub = jax.random.split(self._key)
         sampled, self.cache_k, self.cache_v = self._chunk(
             self.params,
             self.cache_k,
             self.cache_v,
-            jnp.asarray(self.tables),
+            jnp.asarray(view),
             jnp.asarray(self.next_token),
             jnp.asarray(self.lengths),
             jnp.asarray(active),

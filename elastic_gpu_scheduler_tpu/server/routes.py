@@ -37,7 +37,8 @@ from .handlers import Bind, Predicate, Prioritize
 log = logging.getLogger("tpu-scheduler")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
-            405: "Method Not Allowed", 500: "Internal Server Error"}
+            405: "Method Not Allowed", 500: "Internal Server Error",
+            503: "Service Unavailable"}
 
 
 def sample_cpu_profile(seconds: float, interval: float = 0.005) -> str:
@@ -158,6 +159,7 @@ class ExtenderServer:
         tls_cert: str = "",
         tls_key: str = "",
         workers: int = 0,  # >0: pre-spawned pool sized for gang concurrency
+        leader_check=None,  # callable → bool; None = always the leader
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -168,6 +170,7 @@ class ExtenderServer:
         self.tls_cert = tls_cert
         self.tls_key = tls_key
         self.workers = workers
+        self.leader_check = leader_check
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -195,6 +198,10 @@ class ExtenderServer:
         if path == "/version":
             return 200, json.dumps({"version": __version__}).encode(), "application/json"
         if path == "/healthz":
+            # readiness IS leadership under HA: standbys answer 503 so the
+            # Service's readiness probe routes kube-scheduler to the leader
+            if self.leader_check is not None and not self.leader_check():
+                return 503, b"standby (not leader)", "text/plain"
             return 200, b"ok", "text/plain"
         if path == "/metrics":
             return 200, REGISTRY.expose().encode(), "text/plain"
@@ -222,6 +229,11 @@ class ExtenderServer:
         return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
 
     def _route_post(self, path: str, raw: bytes) -> tuple[int, bytes, str]:
+        if self.leader_check is not None and not self.leader_check():
+            # a standby must not mutate (or answer from possibly-stale
+            # caches); kube-scheduler retries against the leader
+            VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "not_leader")
+            return 503, b'{"Error": "not the leader"}', "application/json"
         try:
             body = json.loads(raw or b"{}")
         except (ValueError, json.JSONDecodeError):

@@ -66,6 +66,7 @@ class K8sApiServer:
         self.pods: dict[str, dict] = {}
         self.nodes: dict[str, dict] = {}
         self.events: list[dict] = []
+        self.leases: dict[str, dict] = {}
         self.put_count = 0
         self.conflicts_to_inject = 0
         self._watchers: list = []  # per-stream queues
@@ -91,7 +92,16 @@ class K8sApiServer:
 
             def do_GET(self):
                 path = self.path
-                if path.startswith("/api/v1/pods?watch=true"):
+                if path.startswith("/apis/coordination.k8s.io/"):
+                    parts = path.split("/")
+                    ns, name = parts[5], parts[7]
+                    with outer.lock:
+                        lease = outer.leases.get(f"{ns}/{name}")
+                    if lease is None:
+                        self._json(404, {"reason": "NotFound", "message": name})
+                    else:
+                        self._json(200, lease)
+                elif path.startswith("/api/v1/pods?watch=true"):
                     self._serve_watch()
                 elif path.startswith("/api/v1/pods"):
                     sel = {}
@@ -166,6 +176,27 @@ class K8sApiServer:
                             outer._watchers.remove(q)
 
             def do_PUT(self):
+                if self.path.startswith("/apis/coordination.k8s.io/"):
+                    body = self._body()
+                    md = body.get("metadata") or {}
+                    key = f"{md.get('namespace')}/{md.get('name')}"
+                    with outer.lock:
+                        cur = outer.leases.get(key)
+                        if cur is None:
+                            self._json(404, {"reason": "NotFound", "message": key})
+                            return
+                        if str(md.get("resourceVersion", "")) != str(
+                            cur["metadata"]["resourceVersion"]
+                        ):
+                            self._json(409, {"reason": "Conflict",
+                                             "message": "stale lease rv",
+                                             "code": 409})
+                            return
+                        outer.rv += 1
+                        body["metadata"]["resourceVersion"] = str(outer.rv)
+                        outer.leases[key] = body
+                    self._json(200, body)
+                    return
                 parts = self.path.split("/")
                 ns, name = parts[4], parts[6]
                 body = self._body()
@@ -209,7 +240,19 @@ class K8sApiServer:
             def do_POST(self):
                 path = self.path
                 body = self._body()
-                if path.endswith("/binding"):
+                if path.startswith("/apis/coordination.k8s.io/"):
+                    md = body.get("metadata") or {}
+                    key = f"{md.get('namespace')}/{md.get('name')}"
+                    with outer.lock:
+                        if key in outer.leases:
+                            self._json(409, {"reason": "AlreadyExists",
+                                             "message": key, "code": 409})
+                            return
+                        outer.rv += 1
+                        body["metadata"]["resourceVersion"] = str(outer.rv)
+                        outer.leases[key] = body
+                    self._json(201, body)
+                elif path.endswith("/binding"):
                     parts = path.split("/")
                     ns, name = parts[4], parts[6]
                     with outer.lock:
@@ -516,3 +559,26 @@ def test_wire_gang_binds_all_members_over_rest(e2e):
             stored["metadata"]["annotations"][consts.ANNOTATION_NODE] == node
         )
     assert used_core(registry) == 800
+
+
+def test_leader_election_over_rest(e2e):
+    """Two electors against the REAL lease wire protocol: one wins, the
+    other takes over after the winner crashes."""
+    from elastic_gpu_scheduler_tpu.scheduler.leader import LeaderElector
+
+    api, rest, registry, ks, port = e2e
+    a = LeaderElector(rest, identity="replica-a", lease_duration=0.6,
+                      renew_period=0.2)
+    b = LeaderElector(rest, identity="replica-b", lease_duration=0.6,
+                      renew_period=0.2)
+    a.start()
+    assert poll(a.is_leader)
+    b.start()
+    time.sleep(0.3)
+    assert not b.is_leader()
+    a._stop.set()  # crash: stop renewing without releasing
+    a._thread.join(timeout=2)
+    assert poll(b.is_leader, timeout=10)
+    lease = api.leases["kube-system/tpu-elastic-scheduler"]
+    assert lease["spec"]["holderIdentity"] == "replica-b"
+    b.stop()

@@ -1,0 +1,174 @@
+"""Lease-based leader election: acquisition, failover, fail-stop renewal,
+and verb gating on standby replicas (scheduler HA — net-new vs the
+single-replica reference)."""
+
+import time
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster, conflict
+from elastic_gpu_scheduler_tpu.k8s.objects import make_tpu_node
+from elastic_gpu_scheduler_tpu.scheduler.leader import LeaderElector
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+
+
+def poll(fn, timeout=10.0, interval=0.02):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_elector(cs, name, duration=0.6):
+    return LeaderElector(
+        cs, identity=name, lease_duration=duration,
+        renew_period=duration / 3,
+    )
+
+
+def test_single_elector_acquires_and_renews():
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a")
+    a.start()
+    assert poll(a.is_leader)
+    lease = cs.get_lease("kube-system", "tpu-elastic-scheduler")
+    assert lease["spec"]["holderIdentity"] == "a"
+    rv1 = lease["metadata"]["resourceVersion"]
+    # renewals keep bumping the lease
+    assert poll(
+        lambda: cs.get_lease("kube-system", "tpu-elastic-scheduler")[
+            "metadata"
+        ]["resourceVersion"] != rv1
+    )
+    a.stop()
+
+
+def test_standby_takes_over_after_leader_dies():
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a")
+    b = make_elector(cs, "b")
+    a.start()
+    assert poll(a.is_leader)
+    b.start()
+    time.sleep(0.3)
+    assert not b.is_leader()  # healthy leader holds the lease
+    # leader dies without releasing (crash): stop its renewal thread only
+    a._stop.set()
+    a._thread.join(timeout=2)
+    assert poll(b.is_leader, timeout=10), "standby never took over"
+    lease = cs.get_lease("kube-system", "tpu-elastic-scheduler")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert int(lease["spec"]["leaseTransitions"]) >= 1
+    b.stop()
+
+
+def test_renewal_conflict_steps_down():
+    """Fail-stop: if the lease is stolen (e.g. apiserver flapped and another
+    replica acquired), the old leader must surrender immediately."""
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a")
+    a.start()
+    assert poll(a.is_leader)
+    # steal the lease out from under it
+    lease = cs.get_lease("kube-system", "tpu-elastic-scheduler")
+    lease["spec"]["holderIdentity"] = "thief"
+    cs.update_lease(lease)
+    assert poll(lambda: not a.is_leader())
+    a.stop()
+
+
+def test_creation_race_has_one_winner():
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a", duration=5.0)
+    b = make_elector(cs, "b", duration=5.0)
+    a.start()
+    b.start()
+    assert poll(lambda: a.is_leader() or b.is_leader())
+    time.sleep(0.5)
+    assert a.is_leader() != b.is_leader()  # exactly one
+    a.stop()
+    b.stop()
+
+
+def test_standby_replica_gates_verbs_and_readiness():
+    """A standby's verbs answer 503 'not the leader' and /healthz is
+    not-ready, so a Service readiness probe keeps it out of rotation."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    cs = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        cs, cluster=cluster
+    )
+    leading = {"v": False}
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+        leader_check=lambda: leading["v"],
+    )
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+
+    def get_code(path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    def post_filter():
+        req = urllib.request.Request(
+            base + "/scheduler/filter",
+            json.dumps({"Pod": {}, "NodeNames": ["n0"]}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    assert get_code("/healthz") == 503  # standby: not ready
+    code, body = post_filter()
+    assert code == 503 and "not the leader" in body["Error"]
+    leading["v"] = True  # acquires the lease
+    assert get_code("/healthz") == 200
+    code, body = post_filter()
+    assert code == 200
+    server.stop()
+
+
+def test_graceful_stop_releases_lease_for_fast_failover():
+    """stop() blanks the holder so a standby acquires on its NEXT poll —
+    a rolling restart costs one election round, not a full lease wait."""
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a", duration=5.0)  # long lease: expiry can't help
+    b = make_elector(cs, "b", duration=5.0)
+    a.start()
+    assert poll(a.is_leader)
+    b.start()
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    a.stop()
+    assert poll(b.is_leader, timeout=6)
+    assert time.monotonic() - t0 < 4.0, "failover waited out the lease"
+
+
+def test_is_leader_expires_without_successful_renewal():
+    """Leadership self-expires on the local monotonic clock when renewals
+    stop landing (hung apiserver) — before any standby may take over, so
+    split-brain is impossible."""
+    cs = FakeClientset(FakeCluster())
+    a = make_elector(cs, "a", duration=0.6)
+    a.start()
+    assert poll(a.is_leader)
+    a._stop.set()
+    a._thread.join(timeout=2)
+    assert a._leading  # never stepped down...
+    assert poll(lambda: not a.is_leader(), timeout=3)  # ...but expired
