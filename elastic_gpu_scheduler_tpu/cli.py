@@ -130,6 +130,13 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.leader_elect and args.leader_lease_duration < 1.0:
+        print(
+            "error: --leader-lease-duration must be >= 1s (sub-second leases "
+            "flap leadership)",
+            file=sys.stderr,
+        )
+        return 2
     if args.tls_key and not args.tls_cert:
         print("error: --tls-key given without --tls-cert", file=sys.stderr)
         return 2
@@ -185,7 +192,7 @@ def main(argv=None) -> int:
             clientset,
             identity=f"{_socket.gethostname()}-{os.getpid()}",
             lease_duration=args.leader_lease_duration,
-            renew_period=max(1.0, args.leader_lease_duration / 3),
+            renew_period=args.leader_lease_duration / 3.0,
         )
         elector.start()
 

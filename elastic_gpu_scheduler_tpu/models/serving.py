@@ -48,6 +48,73 @@ from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 SCRATCH_PAGE = 0  # reserved; inactive slots write here, nobody reads it
 
 
+# -- paged KV pool (optionally int8-quantized) -------------------------------
+#
+# The pool is a pytree dict so every step/prefill function threads ONE
+# argument regardless of storage format: {"k","v"} arrays of shape
+# (L, P, page, Hkv, Dh), plus {"ks","vs"} per-row scales (L, P, page, Hkv)
+# when int8.  int8-at-rest halves KV HBM bytes per token — double the
+# servable context/concurrency per pool byte — with per-token-per-head
+# symmetric scales (the weight-quantization recipe from models/quantize.py
+# applied to the cache).
+
+
+def make_kv_pool(cfg, n_pages: int, page_size: int, int8: bool) -> dict:
+    shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads, cfg.head_dim)
+    if int8:
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(shape[:-1], jnp.float32),
+            "vs": jnp.zeros(shape[:-1], jnp.float32),
+        }
+    dtype = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _quantize_rows(x):
+    """(N, Hkv, Dh) → int8 rows + per-(token, head) scale."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _kv_write_rows(lkv: dict, pidx, off, k_rows, v_rows) -> dict:
+    """Scatter new K/V rows into one LAYER's pool slice at (pidx, off)."""
+    out = dict(lkv)
+    if "ks" in lkv:
+        qk, sk_ = _quantize_rows(k_rows)
+        qv, sv_ = _quantize_rows(v_rows)
+        out["k"] = lkv["k"].at[pidx, off].set(qk)
+        out["v"] = lkv["v"].at[pidx, off].set(qv)
+        out["ks"] = lkv["ks"].at[pidx, off].set(sk_)
+        out["vs"] = lkv["vs"].at[pidx, off].set(sv_)
+    else:
+        out["k"] = lkv["k"].at[pidx, off].set(k_rows.astype(lkv["k"].dtype))
+        out["v"] = lkv["v"].at[pidx, off].set(v_rows.astype(lkv["v"].dtype))
+    return out
+
+
+def _kv_gather(lkv: dict, tables, page_size: int, dtype):
+    """One LAYER's pages → virtually-contiguous (B, M, Hkv, Dh) K and V
+    (dequantized when the pool is int8)."""
+    B, maxp = tables.shape
+    Hkv, Dh = lkv["k"].shape[-2], lkv["k"].shape[-1]
+    k = lkv["k"][tables].reshape(B, maxp * page_size, Hkv, Dh)
+    v = lkv["v"][tables].reshape(B, maxp * page_size, Hkv, Dh)
+    if "ks" in lkv:
+        ks = lkv["ks"][tables].reshape(B, maxp * page_size, Hkv)
+        vs = lkv["vs"][tables].reshape(B, maxp * page_size, Hkv)
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(dtype)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(dtype)
+    else:
+        k = k.astype(dtype)
+        v = v.astype(dtype)
+    return k, v
+
+
 @dataclass
 class Request:
     prompt: list[int]
@@ -58,15 +125,13 @@ class Request:
     error: str = ""  # set (with done) when the request is rejected
 
 
-def _paged_decode_step(
-    params, tokens, cache_k, cache_v, tables, lengths, cfg, page_size
-):
+def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size):
     """One decode step for every slot at its own position, against the page
     pool.
 
-    tokens: (B,) int32; cache_k/v: (L, P, page, Hkv, Dh); tables:
+    tokens: (B,) int32; kv: pool dict (make_kv_pool); tables:
     (B, max_pages) int32 page ids; lengths: (B,) int32 write positions.
-    Returns (logits (B, V), new_k, new_v).
+    Returns (logits (B, V), new kv).
     """
     dtype = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
@@ -77,7 +142,7 @@ def _paged_decode_step(
     offset = lengths % page_size  # (B,)
 
     def layer_step(x, scanned):
-        p, ck, cv = scanned  # ck/cv: (P, page, Hkv, Dh)
+        p, lkv = scanned  # lkv: this layer's pool slice
         h = rms_norm(x, p["attn_norm"])
         Hkv = cfg.kv_heads
         q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
@@ -91,14 +156,11 @@ def _paged_decode_step(
         k = rope_b(k, lengths)
         # scatter k/v into each slot's current page (inactive slots target
         # the scratch page — harmless garbage nobody attends to)
-        ck = ck.at[page_idx, offset].set(k[:, 0])
-        cv = cv.at[page_idx, offset].set(v[:, 0])
+        lkv = _kv_write_rows(lkv, page_idx, offset, k[:, 0], v[:, 0])
         # gather the slot's pages into a virtually-contiguous view; position
         # j of the view IS token position j (pages are table-ordered), so
         # the shared cached_attention position mask applies unchanged
-        maxp = tables.shape[1]
-        k_all = ck[tables].reshape(B, maxp * page_size, Hkv, Dh)
-        v_all = cv[tables].reshape(B, maxp * page_size, Hkv, Dh)
+        k_all, v_all = _kv_gather(lkv, tables, page_size, dtype)
         o = cached_attention(
             q, k_all, v_all, lengths, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
@@ -107,19 +169,15 @@ def _paged_decode_step(
         gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
         up = h @ wmat(p["w_in"], dtype)
         x = x + ((gate * up) @ wmat(p["w_out"], dtype))
-        return x, (ck, cv)
+        return x, lkv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache_k, cache_v)
-    )
+    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
-    return logits.astype(jnp.float32), new_k, new_v
+    return logits.astype(jnp.float32), new_kv
 
 
-def _paged_prefill(
-    params, tokens, cache_k, cache_v, pages, t_real, *, cfg, page_size
-):
+def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
     """One-pass prompt ingestion for ONE slot (the paged analogue of
     ``generate.forward_cached`` with an empty prefix): self-attention over
     the whole prompt block, K/V scattered into the slot's pages.
@@ -143,7 +201,7 @@ def _paged_prefill(
     off = positions % page_size
 
     def layer_step(x, scanned):
-        p, ck, cv = scanned  # (P, page, Hkv, Dh)
+        p, lkv = scanned  # this layer's pool slice
         h = rms_norm(x, p["attn_norm"])
         Hkv = cfg.kv_heads
         q = (h @ wmat(p["wq"], dtype)).reshape(1, Tpad, Hn, Dh)
@@ -151,8 +209,7 @@ def _paged_prefill(
         v = (h @ wmat(p["wv"], dtype)).reshape(1, Tpad, Hkv, Dh)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        ck = ck.at[pidx, off].set(k[0])
-        cv = cv.at[pidx, off].set(v[0])
+        lkv = _kv_write_rows(lkv, pidx, off, k[0], v[0])
         # the prompt is the entire valid prefix, so attention is plain
         # causal self-attention within the block — no page gather needed
         # (padding positions sit AFTER every real one; causal masking keeps
@@ -171,19 +228,17 @@ def _paged_prefill(
         gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
         up = h @ wmat(p["w_in"], dtype)
         x = x + ((gate * up) @ wmat(p["w_out"], dtype))
-        return x, (ck, cv)
+        return x, lkv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache_k, cache_v)
-    )
+    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
     x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[0, 0]  # (V,)
-    return logits.astype(jnp.float32), new_k, new_v
+    return logits.astype(jnp.float32), new_kv
 
 
 def _fused_serve_chunk(
-    params, cache_k, cache_v, tables, tokens, lengths, active,
+    params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, key, *, cfg, page_size, n_steps,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
@@ -196,9 +251,9 @@ def _fused_serve_chunk(
     sample)."""
 
     def body(carry, _):
-        tokens, lengths, key, ck, cv = carry
-        logits, ck, cv = _paged_decode_step(
-            params, tokens, ck, cv, tables, lengths, cfg, page_size
+        tokens, lengths, key, kv = carry
+        logits, kv = _paged_decode_step(
+            params, tokens, kv, tables, lengths, cfg, page_size
         )
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)
@@ -212,12 +267,12 @@ def _fused_serve_chunk(
         prompt_next = jnp.take_along_axis(prompts, nxt[:, None], axis=1)[:, 0]
         next_tok = jnp.where(in_prompt, prompt_next, sampled)
         tokens = jnp.where(active, next_tok, tokens)
-        return (tokens, new_len, key, ck, cv), sampled
+        return (tokens, new_len, key, kv), sampled
 
-    (tokens, lengths, key, cache_k, cache_v), sampled = jax.lax.scan(
-        body, (tokens, lengths, key, cache_k, cache_v), None, length=n_steps
+    (tokens, lengths, key, kv), sampled = jax.lax.scan(
+        body, (tokens, lengths, key, kv), None, length=n_steps
     )
-    return sampled.T, cache_k, cache_v  # (B, n_steps)
+    return sampled.T, kv  # (B, n_steps)
 
 
 class InferenceEngine:
@@ -232,6 +287,7 @@ class InferenceEngine:
         page_size: int = 16,
         n_pages: int = 0,
         fused_steps: int = 8,
+        kv_int8: bool = False,
     ):
         assert cfg.n_experts == 0, "serving engine supports dense models"
         self.params = params
@@ -245,12 +301,8 @@ class InferenceEngine:
         self.n_pages = n_pages or (max_batch * self.max_pages_per_slot + 1)
         assert self.n_pages >= 2, "need at least scratch + one real page"
         self.fused_steps = max(1, fused_steps)
-        dtype = jnp.dtype(cfg.dtype)
-        shape = (
-            cfg.n_layers, self.n_pages, page_size, cfg.kv_heads, cfg.head_dim
-        )
-        self.cache_k = jnp.zeros(shape, dtype)
-        self.cache_v = jnp.zeros(shape, dtype)
+        self.kv_int8 = kv_int8
+        self.kv = make_kv_pool(cfg, self.n_pages, page_size, kv_int8)
         self.free_pages = list(range(self.n_pages - 1, SCRATCH_PAGE, -1))
         self.tables = np.zeros(
             (max_batch, self.max_pages_per_slot), np.int32
@@ -272,11 +324,11 @@ class InferenceEngine:
                 page_size=page_size,
                 n_steps=self.fused_steps,
             ),
-            donate_argnums=(1, 2),
+            donate_argnums=(1,),  # the kv pool pytree
         )
         self._prefill = jax.jit(
             functools.partial(_paged_prefill, cfg=cfg, page_size=page_size),
-            donate_argnums=(2, 3),  # the caches, NOT (tokens, cache_k)
+            donate_argnums=(2,),  # the kv pool pytree
         )
         self._key = jax.random.key(0)
 
@@ -350,11 +402,10 @@ class InferenceEngine:
         tpad = min(tpad, self.max_len)
         toks = np.zeros((1, tpad), np.int32)
         toks[0, :plen] = req.prompt
-        logits, self.cache_k, self.cache_v = self._prefill(
+        logits, self.kv = self._prefill(
             self.params,
             jnp.asarray(toks),
-            self.cache_k,
-            self.cache_v,
+            self.kv,
             jnp.asarray(self.tables[i]),
             jnp.asarray(plen, jnp.int32),
         )
@@ -435,10 +486,9 @@ class InferenceEngine:
         view = self.tables[:, :bucket].copy()
         view[~active] = SCRATCH_PAGE
         self._key, sub = jax.random.split(self._key)
-        sampled, self.cache_k, self.cache_v = self._chunk(
+        sampled, self.kv = self._chunk(
             self.params,
-            self.cache_k,
-            self.cache_v,
+            self.kv,
             jnp.asarray(view),
             jnp.asarray(self.next_token),
             jnp.asarray(self.lengths),

@@ -151,10 +151,11 @@ class LeaderElector:
             if not is_not_found(e):
                 raise
             try:
+                sent_at = time.monotonic()
                 self.clientset.create_lease(
                     self._lease_body(_now_iso(), 0)
                 )
-                self._become_leader("created lease")
+                self._become_leader("created lease", acquired_at=sent_at)
             except Exception as ce:
                 # a real apiserver answers POST-of-existing with reason
                 # AlreadyExists (still 409); either way it just means we
@@ -196,13 +197,17 @@ class LeaderElector:
         body["metadata"]["resourceVersion"] = (
             lease.get("metadata", {}).get("resourceVersion", "")
         )
+        sent_at = time.monotonic()
         try:
             self.clientset.update_lease(body)
         except Exception as e:
             if is_conflict(e):
                 return  # someone else acted first
             raise
-        self._become_leader(f"took over from '{spec.get('holderIdentity', '')}'")
+        self._become_leader(
+            f"took over from '{spec.get('holderIdentity', '')}'",
+            acquired_at=sent_at,
+        )
 
     def _renew(self) -> None:
         try:
@@ -219,15 +224,21 @@ class LeaderElector:
             body["metadata"]["resourceVersion"] = (
                 lease.get("metadata", {}).get("resourceVersion", "")
             )
+            # stamp BEFORE the request goes out: standbys start their
+            # takeover clock the moment the apiserver applies the update,
+            # so our own expiry clock must not be credited with the
+            # response latency (client-go does the same)
+            sent_at = time.monotonic()
             self.clientset.update_lease(body)
-            self._last_renew_mono = time.monotonic()
+            self._last_renew_mono = sent_at
         except Exception as e:
             # fail-stop: any renewal failure surrenders leadership
             log.warning("lease renewal failed (%s); stepping down", e)
             self._step_down()
 
-    def _become_leader(self, how: str) -> None:
-        self._last_renew_mono = time.monotonic()
+    def _become_leader(self, how: str, acquired_at: float = 0.0) -> None:
+        # acquired_at: monotonic time BEFORE the acquiring request was sent
+        self._last_renew_mono = acquired_at or time.monotonic()
         if not self._leading:
             log.info("leader election: %s is leading (%s)", self.identity, how)
             self._leading = True

@@ -25,3 +25,15 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def poll(fn, timeout=10.0, interval=0.02):
+    """Wait for fn() to become truthy (shared by leader/e2e tests)."""
+    import time as _time
+
+    end = _time.monotonic() + timeout
+    while _time.monotonic() < end:
+        if fn():
+            return True
+        _time.sleep(interval)
+    return False

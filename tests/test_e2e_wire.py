@@ -411,13 +411,7 @@ def e2e():
     controller.stop()
 
 
-def poll(fn, timeout=8.0, interval=0.05):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
+from conftest import poll  # shared polling helper
 
 
 def used_core(registry):

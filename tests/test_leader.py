@@ -14,13 +14,7 @@ from elastic_gpu_scheduler_tpu.scheduler.leader import LeaderElector
 from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
 
 
-def poll(fn, timeout=10.0, interval=0.02):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
+from conftest import poll  # shared polling helper
 
 
 def make_elector(cs, name, duration=0.6):

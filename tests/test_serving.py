@@ -194,3 +194,39 @@ def test_admission_prefills_prompt_in_one_pass():
     engine.run_until_idle()
     ref = generate(params, jax.numpy.asarray([prompt]), CFG, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(ref)[0, len(prompt):], r.output)
+
+
+def test_int8_kv_cache_outputs_close_to_full_precision():
+    """int8-at-rest KV halves pool bytes per token; greedy outputs on a
+    short generation match full precision (quant noise well under the
+    argmax margin at these scales), and pool dtype/bytes actually shrink."""
+    import jax.numpy as jnp
+
+    params = init_params(jax.random.key(0), CFG)
+    full = InferenceEngine(params, CFG, max_batch=2, max_len=32)
+    q8 = InferenceEngine(params, CFG, max_batch=2, max_len=32, kv_int8=True)
+    assert q8.kv["k"].dtype == jnp.int8 and "ks" in q8.kv
+    kv_bytes = lambda e: sum(
+        x.size * x.dtype.itemsize for x in e.kv.values()
+    )
+    assert kv_bytes(q8) < kv_bytes(full)
+    prompts = [[5, 17, 3], [60, 2]]
+    outs = {}
+    for name, eng in (("full", full), ("int8", q8)):
+        reqs = [eng.submit(Request(prompt=p, max_new_tokens=6)) for p in prompts]
+        eng.run_until_idle()
+        assert all(r.done.is_set() and not r.error for r in reqs)
+        outs[name] = [r.output for r in reqs]
+    assert outs["full"] == outs["int8"]
+
+
+def test_int8_kv_quantize_roundtrip_error_bound():
+    from elastic_gpu_scheduler_tpu.models.serving import _quantize_rows
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.key(0), (16, 2, 32), jnp.float32) * 3.0
+    q, s = _quantize_rows(x)
+    back = q.astype(jnp.float32) * s[..., None]
+    # symmetric per-row int8: error ≤ scale/2 = absmax/254 per element
+    bound = np.asarray(jnp.max(jnp.abs(x), axis=-1) / 254.0)[..., None]
+    assert np.all(np.abs(np.asarray(back - x)) <= bound + 1e-6)
