@@ -55,7 +55,20 @@ class CheckpointManager:
                 opt_state=self._ocp.args.StandardRestore(opt_state_template),
             ),
         )
-        return restored["params"], restored["opt_state"], step
+        # ELASTIC resume: force every leaf onto the template's sharding.
+        # Orbax restores array shards faithfully but can leave small/scalar
+        # leaves (e.g. the optimizer step counter) on a single device, which
+        # then clashes with mesh-sharded params inside one jit.
+        import jax
+
+        def match(r, t):
+            if hasattr(t, "sharding"):
+                return jax.device_put(r, t.sharding)
+            return r
+
+        params = jax.tree.map(match, restored["params"], params_template)
+        opt_state = jax.tree.map(match, restored["opt_state"], opt_state_template)
+        return params, opt_state, step
 
     def close(self) -> None:
         self.manager.close()

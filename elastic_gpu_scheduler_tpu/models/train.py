@@ -112,6 +112,23 @@ def init_sharded_state(
     if mesh is not None:
         pipelined = cfg.n_microbatches > 0 and mesh.shape.get("pipe", 1) > 1
         params = shardlib.shard_params(params, mesh, pipeline=pipelined)
+
+    def place_scalars(opt_state):
+        """Commit scalar/unsharded optimizer leaves (e.g. adam's step count)
+        as mesh-REPLICATED.  optax.init creates them on the default device;
+        leaving them there makes checkpoint templates carry a single-device
+        sharding that conflicts with mesh-sharded params after an elastic
+        restore onto a different mesh."""
+        if mesh is None:
+            return opt_state
+        rep = NamedSharding(mesh, P())
+        return jax.tree.map(
+            lambda x: x
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else jax.device_put(x, rep),
+            opt_state,
+        )
+
     if any(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(params)):
         # fp32 leaves must be COPIES, not aliases of the params leaves —
         # the jitted step donates both trees and a shared buffer would be
@@ -122,8 +139,8 @@ def init_sharded_state(
             else jnp.copy(x),
             params,
         )
-        return params, MasterState(master, optimizer.init(master))
-    return params, optimizer.init(params)
+        return params, MasterState(master, place_scalars(optimizer.init(master)))
+    return params, place_scalars(optimizer.init(params))
 
 
 def make_jitted_train_step(
