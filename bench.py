@@ -554,6 +554,8 @@ def _serve_bench(params, cfg, V, _time):
             for toks in prompt_sets
         ]
         eng.run_until_idle(max_steps=100_000)
+        bad = [r.error for r in reqs if not r.done.is_set() or r.error]
+        assert not bad, f"serve bench requests failed/stalled: {bad[:3]}"
         return sum(len(r.output) for r in reqs)
 
     eng = InferenceEngine(

@@ -81,11 +81,42 @@ def make_train_step(
     cfg: TransformerConfig,
     optimizer: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
+    grad_accum: int = 1,
 ):
-    """Returns train_step(params, opt_state, tokens) → (params, opt_state, loss)."""
+    """Returns train_step(params, opt_state, tokens) → (params, opt_state, loss).
+
+    ``grad_accum`` > 1 splits the batch into that many microbatches and
+    accumulates fp32 gradients in a ``lax.scan`` before ONE optimizer
+    update — the standard recipe for effective batch sizes that don't fit
+    activations in HBM (complementary to remat, which trades FLOPs for
+    activation memory within one microbatch)."""
+
+    def grads_of(params, tokens):
+        if grad_accum <= 1:
+            return jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        B = tokens.shape[0]
+        assert B % grad_accum == 0, (
+            f"batch {B} not divisible by grad_accum {grad_accum}"
+        )
+        micro = tokens.reshape(grad_accum, B // grad_accum, tokens.shape[1])
+
+        def body(acc, mb):
+            loss_sum, g_acc = acc
+            loss, g = jax.value_and_grad(loss_fn)(params, mb, cfg, mesh)
+            g_acc = jax.tree.map(
+                lambda a, x: a + x.astype(jnp.float32), g_acc, g
+            )
+            return (loss_sum + loss, g_acc), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, g_sum), _ = jax.lax.scan(body, (0.0, zeros), micro)
+        inv = 1.0 / grad_accum
+        return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
 
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        loss, grads = grads_of(params, tokens)
         if isinstance(opt_state, MasterState):
             master, inner = opt_state
             grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, master)
@@ -147,8 +178,9 @@ def make_jitted_train_step(
     cfg: TransformerConfig,
     optimizer: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
+    grad_accum: int = 1,
 ):
-    step = make_train_step(cfg, optimizer, mesh)
+    step = make_train_step(cfg, optimizer, mesh, grad_accum=grad_accum)
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
@@ -157,3 +189,24 @@ def make_jitted_train_step(
         in_shardings=(None, None, batch_sharding),
         donate_argnums=(0, 1),
     )
+
+
+def evaluate(
+    params,
+    cfg: TransformerConfig,
+    batches,
+    mesh: Optional[Mesh] = None,
+) -> dict:
+    """Mean next-token loss + perplexity over an iterable of (B, S+1) token
+    batches (the standard held-out eval loop)."""
+    eval_loss = jax.jit(functools.partial(loss_fn, cfg=cfg, mesh=mesh))
+    total, n = 0.0, 0
+    for tokens in batches:
+        total += float(eval_loss(params, tokens))
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate: no batches")
+    mean = total / n
+    import math
+
+    return {"loss": mean, "perplexity": math.exp(min(mean, 30.0)), "batches": n}

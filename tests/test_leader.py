@@ -166,3 +166,87 @@ def test_is_leader_expires_without_successful_renewal():
     a._thread.join(timeout=2)
     assert a._leading  # never stepped down...
     assert poll(lambda: not a.is_leader(), timeout=3)  # ...but expired
+
+
+def test_failover_mid_gang_rebinds_cleanly():
+    """A gang planned on replica A survives A's death: kube-scheduler
+    retries filter+bind against replica B (state rebuilt from the
+    annotation ledger), and the gang lands all-or-nothing with no
+    over-commit across the two replicas' lifetimes."""
+    from elastic_gpu_scheduler_tpu.k8s.extender import (
+        ExtenderArgs,
+        ExtenderBindingArgs,
+    )
+    from elastic_gpu_scheduler_tpu.k8s.objects import (
+        Container,
+        ResourceRequirements,
+        make_pod,
+    )
+    from elastic_gpu_scheduler_tpu.utils import consts
+    import threading
+
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(make_tpu_node(f"n{i}", chips=4, hbm_gib=64))
+    cs = FakeClientset(cluster)
+
+    def gang_pod(name):
+        return make_pod(
+            name,
+            containers=[Container(name="main", resources=ResourceRequirements(
+                limits={consts.RESOURCE_TPU_CORE: 400}))],
+            annotations={consts.ANNOTATION_GANG_NAME: "ha-job",
+                         consts.ANNOTATION_GANG_SIZE: "2"},
+            uid=f"uid-{name}",
+        )
+
+    pods = [gang_pod(f"m-{i}") for i in range(2)]
+    for p in pods:
+        cluster.create_pod(p)
+
+    # replica A: plans the gang at filter time...
+    reg_a, pred_a, prio_a, bind_a, _, _, gang_a = build_stack(
+        cs, cluster=cluster, gang_timeout=2.0
+    )
+    for p in pods:
+        r = pred_a.handle(ExtenderArgs(pod=p, node_names=["n0", "n1"]))
+        assert r.node_names, r.failed_nodes
+    # ...then dies before any member binds (plan was in-memory only).
+
+    # replica B takes over: fresh stack over the same cluster state
+    reg_b, pred_b, prio_b, bind_b, _, _, gang_b = build_stack(
+        cs, cluster=cluster, gang_timeout=5.0
+    )
+    # kube-scheduler retries the full cycle against B
+    targets_b = []
+    for p in pods:
+        r = pred_b.handle(ExtenderArgs(pod=p, node_names=["n0", "n1"]))
+        assert r.node_names, r.failed_nodes
+        targets_b.append(r.node_names[0])
+    PENDING = object()
+    results = [PENDING, PENDING]
+
+    def member(i):
+        res = bind_b.handle(ExtenderBindingArgs(
+            pod_name=pods[i].metadata.name, pod_namespace="default",
+            pod_uid=pods[i].metadata.uid, node=targets_b[i]))
+        results[i] = res.error
+
+    threads = [threading.Thread(target=member, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive(), "bind hung past the join timeout"
+    assert all(r == "" or r is None for r in results), results
+    # both bound, exactly once, full packing, no over-commit
+    sched_b = reg_b[consts.RESOURCE_TPU_CORE]
+    used = sum(
+        na.chips.total_core() - na.chips.avail_core()
+        for na in sched_b.allocators.values()
+    )
+    assert used == 800
+    for p in pods:
+        cur = cluster.get_pod("default", p.metadata.name)
+        assert cur.spec.node_name in ("n0", "n1")
+        assert cur.metadata.annotations[consts.ANNOTATION_ASSUMED] == "true"
