@@ -358,6 +358,24 @@ def model_bench_on_tpu():
 
     if os.environ.get("BENCH_MODEL", "1") == "0":
         return {}
+    # probe the accelerator in a SUBPROCESS with a timeout first: a downed
+    # TPU relay makes jax.devices() hang indefinitely in-process, which
+    # would take the scheduler headline metrics down with it
+    import subprocess
+    import sys as _sys
+
+    try:
+        probe = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=120, capture_output=True,
+        )
+        if probe.returncode != 0:
+            detail = probe.stderr.decode(errors="replace")[-200:]
+            return {
+                "tpu_model_bench_error": f"no usable accelerator backend: {detail}"
+            }
+    except subprocess.TimeoutExpired:
+        return {"tpu_model_bench_error": "accelerator probe timed out (relay down?)"}
     try:
         import functools as _ft
         import time as _time
