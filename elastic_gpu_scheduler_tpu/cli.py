@@ -171,7 +171,7 @@ def main(argv=None) -> int:
         )
         return 2
 
-    _, predicate, prioritize, bind, controller, status, _ = build_stack(
+    registry, predicate, prioritize, bind, controller, status, _ = build_stack(
         clientset,
         cluster=cluster,
         priority=args.priority,
@@ -196,8 +196,12 @@ def main(argv=None) -> int:
         )
         elector.start()
 
+    from .server.handlers import Preemption
+
     server = ExtenderServer(
-        predicate, prioritize, bind, status, host=args.host, port=args.port,
+        predicate, prioritize, bind, status,
+        preemption=Preemption(registry, clientset),
+        host=args.host, port=args.port,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         workers=max(0, args.http_workers),
         leader_check=elector.is_leader if elector is not None else None,

@@ -308,24 +308,38 @@ class ChipSet:
             for c in alloc.coords:
                 self.chips[c].give(alloc.core, alloc.hbm)
 
-    def can_transact(self, option: Option) -> bool:
-        """Check the whole option fits the current state without mutating it."""
-        core_need: dict[Coord, int] = {}
-        hbm_need: dict[Coord, int] = {}
-        whole_need: set[Coord] = set()
+    def _tally(
+        self, option: Option
+    ) -> Optional[tuple[set[Coord], dict[Coord, int], dict[Coord, int]]]:
+        """Aggregate an option's per-chip demand: (whole-chip coords,
+        fractional core by coord, fractional hbm by coord).  None if any
+        coord is unknown or a whole-chip coord repeats — shared by
+        ``can_transact`` and ``can_cancel`` so the accounting can't
+        diverge."""
+        core: dict[Coord, int] = {}
+        hbm: dict[Coord, int] = {}
+        whole: set[Coord] = set()
         for a in option.allocs:
             if not a.needs_tpu:
                 continue
             for c in a.coords:
                 if c not in self.chips:
-                    return False
+                    return None
                 if a.whole:
-                    if c in whole_need:
-                        return False
-                    whole_need.add(c)
+                    if c in whole:
+                        return None
+                    whole.add(c)
                 else:
-                    core_need[c] = core_need.get(c, 0) + a.core
-                    hbm_need[c] = hbm_need.get(c, 0) + a.hbm
+                    core[c] = core.get(c, 0) + a.core
+                    hbm[c] = hbm.get(c, 0) + a.hbm
+        return whole, core, hbm
+
+    def can_transact(self, option: Option) -> bool:
+        """Check the whole option fits the current state without mutating it."""
+        tally = self._tally(option)
+        if tally is None:
+            return False
+        whole_need, core_need, hbm_need = tally
         for c in whole_need:
             if not self.chips[c].is_free or c in core_need:
                 return False
@@ -344,6 +358,30 @@ class ChipSet:
         for a in option.allocs:
             if a.needs_tpu:
                 self._apply(a)
+
+    def can_cancel(self, option: Option) -> bool:
+        """Check the option is plausibly CHARGED to the current state — i.e.
+        cancelling it frees only capacity that is actually in use.  Needed
+        because ``Chip.give`` clamps at total (a double-free would otherwise
+        silently inflate capacity): callers holding options of uncertain
+        provenance (e.g. preemption victims' annotations) must validate
+        before cancelling."""
+        tally = self._tally(option)
+        if tally is None:
+            return False
+        whole_free, core_free, hbm_free = tally
+        for c in whole_free:
+            ch = self.chips[c]
+            # a whole-chip holder has the chip exclusively and fully taken
+            if ch.core_avail != 0 or ch.hbm_avail != 0 or c in core_free:
+                return False
+        for c, freed in core_free.items():
+            ch = self.chips[c]
+            if (ch.core_total - ch.core_avail) < freed:
+                return False
+            if (ch.hbm_total - ch.hbm_avail) < hbm_free.get(c, 0):
+                return False
+        return True
 
     def cancel(self, option: Option) -> None:
         """Roll back a committed option (reference: gpu.go:177-191)."""

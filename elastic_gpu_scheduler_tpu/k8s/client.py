@@ -33,6 +33,7 @@ class Clientset:
         self,
         label_selector: Optional[dict[str, str]] = None,
         field_selector: Optional[Callable[[Pod], bool]] = None,
+        node_name: Optional[str] = None,  # server-side spec.nodeName filter
     ) -> list[Pod]:
         raise NotImplementedError
 
@@ -73,8 +74,8 @@ class FakeClientset(Clientset):
     def get_pod(self, namespace, name):
         return self.cluster.get_pod(namespace, name)
 
-    def list_pods(self, label_selector=None, field_selector=None):
-        return self.cluster.list_pods(label_selector, field_selector)
+    def list_pods(self, label_selector=None, field_selector=None, node_name=None):
+        return self.cluster.list_pods(label_selector, field_selector, node_name)
 
     def update_pod(self, pod):
         return self.cluster.update_pod(pod)
@@ -179,13 +180,25 @@ class RestClientset(Clientset):
             self._req("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
         )
 
-    def list_pods(self, label_selector=None, field_selector=None):
+    def list_pods(self, label_selector=None, field_selector=None, node_name=None):
         path = "/api/v1/pods"
+        params = []
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
-            path += "?labelSelector=" + urllib.parse.quote(sel)
+            params.append("labelSelector=" + urllib.parse.quote(sel))
+        if node_name:
+            # server-side field selector: only this node's pods cross the wire
+            params.append(
+                "fieldSelector=" + urllib.parse.quote(f"spec.nodeName={node_name}")
+            )
+        if params:
+            path += "?" + "&".join(params)
         items = self._req("GET", path).get("items", [])
         pods = [Pod.from_dict(i) for i in items]
+        if node_name:
+            # re-filter client-side too: correct even against servers that
+            # ignore unknown query params (e.g. the test mini apiserver)
+            pods = [p for p in pods if p.spec.node_name == node_name]
         if field_selector:
             pods = [p for p in pods if field_selector(p)]
         return pods
@@ -252,8 +265,8 @@ class RestClusterView:
 
     # -- reads delegate ------------------------------------------------------
 
-    def list_pods(self, label_selector=None, field_selector=None):
-        return self.rest.list_pods(label_selector, field_selector)
+    def list_pods(self, label_selector=None, field_selector=None, node_name=None):
+        return self.rest.list_pods(label_selector, field_selector, node_name)
 
     def get_pod(self, namespace, name):
         return self.rest.get_pod(namespace, name)

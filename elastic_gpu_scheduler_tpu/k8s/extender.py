@@ -101,3 +101,125 @@ class ExtenderBindingResult:
     @classmethod
     def from_dict(cls, d: dict) -> "ExtenderBindingResult":
         return cls(error=d.get("Error", ""))
+
+
+# -- preemption verb ---------------------------------------------------------
+# k8s.io/kube-scheduler/extender/v1 ProcessPreemption types.  The reference
+# never implements preemptVerb (its extender stanza has only filter/
+# priorities/bind, README.md:47-89); this build does, so high-priority TPU
+# jobs can evict lower-priority ones when the cluster is full.
+
+
+@dataclass
+class MetaPod:
+    """Victim pod identified by UID only (nodeCacheCapable=true form)."""
+
+    uid: str
+
+    def to_dict(self) -> dict:
+        return {"UID": self.uid}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaPod":
+        return cls(uid=d.get("UID", ""))
+
+
+@dataclass
+class MetaVictims:
+    pods: list[MetaPod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "Pods": [p.to_dict() for p in self.pods],
+            "NumPDBViolations": self.num_pdb_violations,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaVictims":
+        return cls(
+            pods=[MetaPod.from_dict(p) for p in d.get("Pods") or []],
+            num_pdb_violations=int(d.get("NumPDBViolations", 0)),
+        )
+
+
+@dataclass
+class Victims:
+    """Victim pods carried whole (nodeCacheCapable=false form)."""
+
+    pods: list[Pod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "Pods": [p.to_dict() for p in self.pods],
+            "NumPDBViolations": self.num_pdb_violations,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Victims":
+        return cls(
+            pods=[Pod.from_dict(p) for p in d.get("Pods") or []],
+            num_pdb_violations=int(d.get("NumPDBViolations", 0)),
+        )
+
+
+@dataclass
+class ExtenderPreemptionArgs:
+    pod: Pod
+    # kube-scheduler sends exactly one of these two maps depending on
+    # nodeCacheCapable; we accept both.
+    node_name_to_victims: dict[str, Victims] = field(default_factory=dict)
+    node_name_to_meta_victims: dict[str, MetaVictims] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d: dict = {"Pod": self.pod.to_dict()}
+        if self.node_name_to_victims:
+            d["NodeNameToVictims"] = {
+                n: v.to_dict() for n, v in self.node_name_to_victims.items()
+            }
+        if self.node_name_to_meta_victims:
+            d["NodeNameToMetaVictims"] = {
+                n: v.to_dict() for n, v in self.node_name_to_meta_victims.items()
+            }
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderPreemptionArgs":
+        pod_d = d.get("Pod") or d.get("pod") or {}
+        return cls(
+            pod=Pod.from_dict(pod_d),
+            node_name_to_victims={
+                n: Victims.from_dict(v)
+                for n, v in (d.get("NodeNameToVictims") or {}).items()
+            },
+            node_name_to_meta_victims={
+                n: MetaVictims.from_dict(v)
+                for n, v in (d.get("NodeNameToMetaVictims") or {}).items()
+            },
+        )
+
+
+@dataclass
+class ExtenderPreemptionResult:
+    """Nodes that remain preemption candidates, with the (possibly reduced)
+    victim set actually required on each.  Always keyed by UID — the
+    kube-scheduler converts back from meta form itself."""
+
+    node_name_to_meta_victims: dict[str, MetaVictims] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "NodeNameToMetaVictims": {
+                n: v.to_dict() for n, v in self.node_name_to_meta_victims.items()
+            }
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExtenderPreemptionResult":
+        return cls(
+            node_name_to_meta_victims={
+                n: MetaVictims.from_dict(v)
+                for n, v in (d.get("NodeNameToMetaVictims") or {}).items()
+            }
+        )

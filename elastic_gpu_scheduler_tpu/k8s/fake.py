@@ -114,10 +114,13 @@ class FakeCluster:
         self,
         label_selector: Optional[dict[str, str]] = None,
         field_selector: Optional[Callable[[Pod], bool]] = None,
+        node_name: Optional[str] = None,
     ) -> list[Pod]:
         with self._lock:
             out = []
             for p in self._pods.values():
+                if node_name and p.spec.node_name != node_name:
+                    continue
                 if label_selector and any(
                     (p.metadata.labels or {}).get(k) != v
                     for k, v in label_selector.items()

@@ -94,21 +94,30 @@ class PodSpec:
     containers: list[Container] = field(default_factory=list)
     node_name: str = ""
     scheduler_name: str = ""
+    # pod priority (scheduling.k8s.io PriorityClass value) — drives victim
+    # selection in the preemption verb; absent means 0, like kube-scheduler's
+    # treatment of priority-less pods
+    priority: Optional[int] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "containers": [c.to_dict() for c in self.containers],
             "nodeName": self.node_name,
             "schedulerName": self.scheduler_name,
         }
+        if self.priority is not None:
+            d["priority"] = self.priority
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PodSpec":
         d = d or {}
+        prio = d.get("priority")
         return cls(
             containers=[Container.from_dict(c) for c in d.get("containers") or []],
             node_name=d.get("nodeName", ""),
             scheduler_name=d.get("schedulerName", ""),
+            priority=int(prio) if prio is not None else None,
         )
 
 
@@ -193,6 +202,7 @@ class Pod:
                 ],
                 node_name=self.spec.node_name,
                 scheduler_name=self.spec.scheduler_name,
+                priority=self.spec.priority,
             ),
             status=PodStatus(phase=self.status.phase),
             extra=copy.deepcopy(self.extra) if self.extra else {},
@@ -308,6 +318,7 @@ def make_pod(
     annotations: Optional[dict[str, str]] = None,
     labels: Optional[dict[str, str]] = None,
     uid: str = "",
+    priority: Optional[int] = None,
 ) -> Pod:
     """Test/bench convenience constructor."""
     return Pod(
@@ -318,7 +329,7 @@ def make_pod(
             annotations=dict(annotations or {}),
             labels=dict(labels or {}),
         ),
-        spec=PodSpec(containers=containers or []),
+        spec=PodSpec(containers=containers or [], priority=priority),
     )
 
 

@@ -76,6 +76,11 @@ class ResourceScheduler:
     def forget_pod(self, pod: Pod) -> None:
         raise NotImplementedError
 
+    def preempt(
+        self, node_name: str, pod: Pod, victims: list[Pod]
+    ) -> Optional[list[Pod]]:
+        raise NotImplementedError
+
     def known_pod(self, pod: Pod) -> bool:
         raise NotImplementedError
 
@@ -253,6 +258,89 @@ class TPUUnitScheduler(ResourceScheduler):
                 pod, "Warning", "FailedScheduling", f"bind to {node_name}: {e}"
             )
             raise
+
+    def preempt(
+        self, node_name: str, pod: Pod, victims: list[Pod]
+    ) -> Optional[list[Pod]]:
+        """Preemption verb: which of ``victims`` must actually be evicted from
+        ``node_name`` for ``pod`` to fit there?
+
+        Returns the (possibly reduced) victim list, or ``None`` if the pod
+        cannot fit even with every proposed victim gone — kube-scheduler then
+        drops the node as a preemption candidate.  The reference never
+        implements preemptVerb (README.md:47-89 lists only filter/priorities/
+        bind); net-new here.
+
+        Semantics:
+        - Simulated on a clone of the node's chip state; no live state is
+          touched and nothing is evicted here — kube-scheduler performs the
+          actual deletions, and the reconciliation controller frees the chips
+          when the victims terminate.
+        - Victims holding NO TPU allocation pass through untouched: they may
+          be needed for resources (CPU/memory) this extender cannot see, so
+          we only prune victims whose TPU chips we know are unnecessary.
+        - Defensive re-check: a victim with priority >= the preemptor's is
+          never treated as evictable TPU capacity.
+        - Reprieve pass mirrors kube-scheduler's own victim minimisation:
+          restore highest-priority victims first, keep restored any whose
+          chips the preemptor does not need.
+        """
+        request = request_from_pod(pod)
+        with self.lock:
+            na = self._get_allocator(node_name)
+        if na is None:
+            return None
+        preemptor_prio = pod.spec.priority or 0
+        with na.lock:
+            scratch = na.chips.clone()
+
+        tpu_victims: list[tuple[Pod, Option]] = []
+        passthrough: list[Pod] = []
+        for v in victims:
+            if (v.spec.priority or 0) >= preemptor_prio:
+                # not evictable TPU capacity by this pod — but never SHRINK
+                # kube-scheduler's proposal on an eligibility doubt (it
+                # treats the returned set as authoritative); keep it listed,
+                # claim no capacity from it
+                passthrough.append(v)
+                continue
+            opt = None
+            with self.lock:
+                ledger = self.pod_maps.get(v.key)
+            if ledger is not None and ledger[0] == node_name:
+                opt = ledger[1]
+            else:
+                opt = option_from_pod(v, scratch.topo)
+            if opt is None:
+                passthrough.append(v)  # no TPU claim we can account for
+            else:
+                tpu_victims.append((v, opt))
+
+        freed: list[tuple[Pod, Option]] = []
+        for v, opt in tpu_victims:
+            # validate BEFORE cancelling: Chip.give clamps at total, so a
+            # skewed option (stale annotations, wrong node) would silently
+            # inflate scratch capacity and confirm an eviction that frees
+            # nothing.  Skew → keep the victim listed but claim no capacity.
+            if scratch.can_cancel(opt):
+                scratch.cancel(opt)
+                freed.append((v, opt))
+            else:
+                passthrough.append(v)
+        if scratch.trade(request, self.rater) is None:
+            return None
+
+        needed: list[Pod] = []
+        for v, opt in sorted(
+            freed, key=lambda t: -(t[0].spec.priority or 0)
+        ):
+            if scratch.can_transact(opt):
+                scratch.transact(opt)
+                if scratch.trade(request, self.rater) is not None:
+                    continue  # reprieved: pod fits without evicting v
+                scratch.cancel(opt)
+            needed.append(v)
+        return needed + passthrough
 
     # -- gang split-phase primitives (scheduler/gang.py's commit protocol) ----
     #

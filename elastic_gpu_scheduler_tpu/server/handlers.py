@@ -17,7 +17,11 @@ from ..k8s.extender import (
     ExtenderBindingArgs,
     ExtenderBindingResult,
     ExtenderFilterResult,
+    ExtenderPreemptionArgs,
+    ExtenderPreemptionResult,
     HostPriority,
+    MetaPod,
+    MetaVictims,
 )
 from ..k8s.fake import is_not_found
 from ..k8s.objects import Pod
@@ -63,6 +67,98 @@ class Prioritize:
             return [HostPriority(host=n, score=0) for n in names]
         scores = sched.score(names, pod)
         return [HostPriority(host=n, score=s) for n, s in zip(names, scores)]
+
+
+class Preemption:
+    """ProcessPreemption verb (net-new vs the reference — see k8s/extender.py).
+
+    For each candidate node, re-evaluate kube-scheduler's proposed victim set
+    against the TPU allocation ledger: drop nodes where the preemptor cannot
+    fit even with all victims gone, and prune victims whose chips are not
+    actually required (kube-scheduler's PDB-violation counts are passed
+    through unchanged — this extender has no PDB view, so the original count
+    stays an upper bound for the pruned set)."""
+
+    def __init__(self, registry: dict[str, ResourceScheduler], clientset):
+        self.registry = registry
+        self.clientset = clientset
+
+    def handle(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
+        pod = args.pod
+        sched = get_resource_scheduler(self.registry, pod)
+        # node → (victim Pods | None, pass-through victim UIDs, PDB count).
+        # victims=None means "echo the proposal, do not simulate" (the pod
+        # LIST failed, so the ledger cannot be consulted safely).
+        # Pass-through UIDs are victims we could not resolve to Pod objects
+        # (deleted mid-flight, or the pod LIST failed): the conservative
+        # answer keeps them in the victim set unchanged — an EMPTY victim
+        # set is a positive "no evictions needed" claim kube-scheduler acts
+        # on, so resolution failure must never shrink the proposal.
+        candidates: dict[str, tuple[Optional[list[Pod]], list[str], int]] = {}
+        for n, v in args.node_name_to_victims.items():
+            candidates[n] = (list(v.pods), [], v.num_pdb_violations)
+        meta_nodes = {
+            n: mv
+            for n, mv in args.node_name_to_meta_victims.items()
+            if n not in candidates
+        }
+        # Few candidates: node-scoped LISTs (server-side spec.nodeName field
+        # selector — victims run on their node).  Many candidates
+        # (kube-scheduler passes up to ~100): ONE cluster-wide LIST beats N
+        # serial round trips on the verb's critical path.
+        cluster_index: Optional[dict[str, Pod]] = None
+        if len(meta_nodes) > 4:
+            try:
+                cluster_index = {
+                    p.metadata.uid: p for p in self.clientset.list_pods()
+                }
+            except Exception as e:
+                log.warning("preemption: cluster pod list failed: %s", e)
+        for n, mv in meta_nodes.items():
+            by_uid: Optional[dict[str, Pod]] = cluster_index
+            if by_uid is None:
+                try:
+                    by_uid = {
+                        p.metadata.uid: p
+                        for p in self.clientset.list_pods(node_name=n)
+                    }
+                except Exception as e:
+                    log.warning("preemption: pod list for %s failed: %s", n, e)
+            if by_uid is None:
+                # echo the node's proposal unchanged (no pruning, no
+                # dropping — same as an extender without preemptVerb);
+                # victims=None marks "echo, do not simulate"
+                candidates[n] = (
+                    None,
+                    [p.uid for p in mv.pods],
+                    mv.num_pdb_violations,
+                )
+                continue
+            resolved, missing = [], []
+            for p in mv.pods:
+                v = by_uid.get(p.uid)
+                if v is not None:
+                    resolved.append(v)
+                else:
+                    missing.append(p.uid)
+            candidates[n] = (resolved, missing, mv.num_pdb_violations)
+
+        result: dict[str, MetaVictims] = {}
+        for n, (victims, passthrough_uids, pdb) in candidates.items():
+            if victims is None or sched is None:
+                # echo the proposal: either the LIST failed (victims=None)
+                # or the pod requests no TPU — no opinion either way
+                needed: Optional[list[Pod]] = victims or []
+            else:
+                needed = sched.preempt(n, pod, victims)
+            if needed is None:
+                continue  # node infeasible even with all victims evicted
+            result[n] = MetaVictims(
+                pods=[MetaPod(uid=v.metadata.uid) for v in needed]
+                + [MetaPod(uid=u) for u in passthrough_uids],
+                num_pdb_violations=pdb,
+            )
+        return ExtenderPreemptionResult(node_name_to_meta_victims=result)
 
 
 class Bind:

@@ -5,6 +5,8 @@ Reference: pkg/routes/routes.go.  Paths kept wire-compatible:
     POST /scheduler/filter      → Predicate
     POST /scheduler/priorities  → Prioritize
     POST /scheduler/bind        → Bind
+    POST /scheduler/preemption  → Preemption (net-new; reference has no
+                                  preemptVerb — README.md:47-89)
     GET  /scheduler/status      → per-node chip state dump (routes.go:197-218)
     GET  /version               → version JSON (routes.go:165-171)
     GET  /healthz               → liveness
@@ -30,9 +32,13 @@ from http.server import ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .. import __version__
-from ..k8s.extender import ExtenderArgs, ExtenderBindingArgs
+from ..k8s.extender import (
+    ExtenderArgs,
+    ExtenderBindingArgs,
+    ExtenderPreemptionArgs,
+)
 from ..metrics import REGISTRY, VERB_LATENCY, VERB_TOTAL
-from .handlers import Bind, Predicate, Prioritize
+from .handlers import Bind, Predicate, Preemption, Prioritize
 
 log = logging.getLogger("tpu-scheduler")
 
@@ -154,6 +160,7 @@ class ExtenderServer:
         prioritize: Prioritize,
         bind: Bind,
         status_fn: Callable[[], dict],
+        preemption: Optional[Preemption] = None,
         host: str = "0.0.0.0",
         port: int = 39999,
         tls_cert: str = "",
@@ -165,6 +172,7 @@ class ExtenderServer:
         self.prioritize = prioritize
         self.bind = bind
         self.status_fn = status_fn
+        self.preemption = preemption
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -250,6 +258,9 @@ class ExtenderServer:
         if path == "/scheduler/bind":
             return self._verb("bind", lambda: self.bind.handle(
                 ExtenderBindingArgs.from_dict(body)).to_dict())
+        if path == "/scheduler/preemption" and self.preemption is not None:
+            return self._verb("preemption", lambda: self.preemption.handle(
+                ExtenderPreemptionArgs.from_dict(body)).to_dict())
         return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
 
     def _verb(self, verb: str, fn: Callable[[], object]) -> tuple[int, bytes, str]:

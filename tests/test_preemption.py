@@ -1,0 +1,340 @@
+"""Preemption verb: victim-set evaluation against the chip ledger.
+
+Net-new vs the reference (its extender stanza has no preemptVerb,
+README.md:47-89): when the cluster is full, kube-scheduler proposes victim
+pods per candidate node and the extender answers which evictions actually
+free the TPU chips the preemptor needs.
+"""
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.handlers import Preemption
+from elastic_gpu_scheduler_tpu.k8s.extender import (
+    ExtenderPreemptionArgs,
+    MetaPod,
+    MetaVictims,
+    Victims,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, priority=None):
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        priority=priority,
+    )
+
+
+@pytest.fixture()
+def stack():
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("node-0", chips=4, hbm_gib=64))
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority="binpack"
+    )
+    sched = next(iter(registry.values()))
+    return cluster, clientset, registry, sched
+
+
+def bind_victims(cluster, sched, n, priorities):
+    """Fill node-0 with n whole-chip pods at the given priorities."""
+    victims = []
+    for i, prio in zip(range(n), priorities):
+        v = tpu_pod(f"victim-{i}", core=100, priority=prio)
+        cluster.create_pod(v)
+        ok, failed = sched.assume(["node-0"], v)
+        assert ok == ["node-0"], failed
+        bound = sched.bind("node-0", v)
+        victims.append(bound)
+    return victims
+
+
+def test_minimal_victim_set(stack):
+    """4 chips held by pri 1..4; a pri-100 pod needing 2 chips must evict
+    exactly the two LOWEST-priority victims."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+
+    # sanity: no room without eviction
+    ok, _ = sched.assume(["node-0"], preemptor)
+    assert ok == []
+
+    needed = sched.preempt("node-0", preemptor, victims)
+    assert needed is not None
+    names = sorted(v.metadata.name for v in needed)
+    assert names == ["victim-0", "victim-1"]  # priorities 1 and 2
+
+
+def test_infeasible_node_dropped(stack):
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 1, 1, 1])
+    # needs 8 chips; the node only has 4 even when empty
+    preemptor = tpu_pod("huge", core=800, priority=100)
+    assert sched.preempt("node-0", preemptor, victims) is None
+
+
+def test_equal_priority_not_evictable(stack):
+    """Defensive guard: a victim at or above the preemptor's priority is
+    never treated as evictable capacity."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [50, 50, 50, 50])
+    preemptor = tpu_pod("hi", core=200, priority=50)
+    assert sched.preempt("node-0", preemptor, victims) is None
+
+
+def test_non_tpu_victims_pass_through(stack):
+    """A victim holding no TPU allocation may be needed for resources this
+    extender can't see — it must stay in the returned set untouched."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 2, [1, 2])
+    # two chips still free: the preemptor fits WITHOUT evicting TPU pods,
+    # but kube-scheduler also proposed a CPU-only victim
+    cpu_victim = make_pod("cpu-only", priority=1)
+    cpu_victim.spec.node_name = "node-0"
+    cluster.create_pod(cpu_victim)
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    needed = sched.preempt("node-0", preemptor, victims + [cpu_victim])
+    assert needed is not None
+    names = [v.metadata.name for v in needed]
+    assert "cpu-only" in names
+    # both TPU victims reprieved: their chips aren't needed
+    assert "victim-0" not in names and "victim-1" not in names
+
+
+def test_handler_meta_victims_resolution(stack):
+    """nodeCacheCapable form: victims arrive as UIDs; the handler resolves
+    them via the pod list and returns the pruned UID set."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    cluster.create_pod(preemptor)
+
+    handler = Preemption(registry, clientset)
+    args = ExtenderPreemptionArgs(
+        pod=preemptor,
+        node_name_to_meta_victims={
+            "node-0": MetaVictims(
+                pods=[MetaPod(uid=v.metadata.uid) for v in victims],
+                num_pdb_violations=1,
+            )
+        },
+    )
+    result = handler.handle(args)
+    assert "node-0" in result.node_name_to_meta_victims
+    got = result.node_name_to_meta_victims["node-0"]
+    want = {v.metadata.uid for v in victims[:2]}  # priorities 1 and 2
+    assert {p.uid for p in got.pods} == want
+    assert got.num_pdb_violations == 1  # passed through unchanged
+
+
+def test_handler_full_victims_and_wire_roundtrip(stack):
+    """nodeCacheCapable=false form (whole pods) + JSON round-trip."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [5, 5, 1, 1])
+    preemptor = tpu_pod("hi", core=100, priority=100)
+    cluster.create_pod(preemptor)
+
+    handler = Preemption(registry, clientset)
+    args = ExtenderPreemptionArgs(
+        pod=preemptor,
+        node_name_to_victims={"node-0": Victims(pods=victims)},
+    )
+    # wire round-trip: dict → dataclass → dict
+    args2 = ExtenderPreemptionArgs.from_dict(args.to_dict())
+    assert len(args2.node_name_to_victims["node-0"].pods) == 4
+
+    result = handler.handle(args2)
+    got = result.node_name_to_meta_victims["node-0"]
+    # needs one chip → exactly one lowest-priority victim
+    assert len(got.pods) == 1
+    uids = {v.metadata.uid: v for v in victims}
+    assert uids[got.pods[0].uid].spec.priority == 1
+    # result serializes
+    d = result.to_dict()
+    assert "node-0" in d["NodeNameToMetaVictims"]
+
+
+def test_preemption_end_to_end(stack):
+    """Full cycle: schedule fails → preemption names victims → victims are
+    deleted (kube-scheduler's job) → controller releases chips → the
+    preemptor schedules."""
+    import time
+
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 1, 1, 1])
+    preemptor = tpu_pod("hi", core=400, priority=100)
+    cluster.create_pod(preemptor)
+
+    ok, _ = sched.assume(["node-0"], preemptor)
+    assert ok == []
+    needed = sched.preempt("node-0", preemptor, victims)
+    assert needed is not None and len(needed) == 4
+
+    # preempt() must not have touched live state
+    ok, _ = sched.assume(["node-0"], preemptor)
+    assert ok == []
+
+    for v in needed:
+        sched.forget_pod(v)  # what the controller does on pod deletion
+
+    ok, failed = sched.assume(["node-0"], preemptor)
+    assert ok == ["node-0"], failed
+    sched.bind("node-0", preemptor)
+    stored = clientset.get_pod("default", "hi")
+    assert stored.spec.node_name == "node-0"
+    assert any(
+        k.startswith(consts.ANNOTATION_CONTAINER_PREFIX)
+        for k in stored.metadata.annotations
+    )
+
+
+def test_unresolved_uid_passes_through(stack):
+    """A victim UID that no longer resolves to a pod (deleted mid-flight)
+    stays in the returned set — an empty victim set would wrongly claim
+    'no evictions needed'."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    cluster.create_pod(preemptor)
+
+    handler = Preemption(registry, clientset)
+    ghost_uid = "deleted-pod-uid"
+    args = ExtenderPreemptionArgs(
+        pod=preemptor,
+        node_name_to_meta_victims={
+            "node-0": MetaVictims(
+                pods=[MetaPod(uid=v.metadata.uid) for v in victims]
+                + [MetaPod(uid=ghost_uid)]
+            )
+        },
+    )
+    result = handler.handle(args)
+    got = {p.uid for p in result.node_name_to_meta_victims["node-0"].pods}
+    assert ghost_uid in got
+    assert {v.metadata.uid for v in victims[:2]} <= got
+
+
+def test_list_failure_echoes_proposal(stack):
+    """If the pod LIST fails, the proposal is echoed unchanged (no pruning,
+    no node dropping) — same behavior as an extender without preemptVerb."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+
+    class FailingClientset:
+        def list_pods(self, *a, **kw):
+            raise RuntimeError("apiserver down")
+
+    handler = Preemption(registry, FailingClientset())
+    args = ExtenderPreemptionArgs(
+        pod=preemptor,
+        node_name_to_meta_victims={
+            "node-0": MetaVictims(
+                pods=[MetaPod(uid=v.metadata.uid) for v in victims],
+                num_pdb_violations=2,
+            )
+        },
+    )
+    result = handler.handle(args)
+    got = result.node_name_to_meta_victims["node-0"]
+    assert {p.uid for p in got.pods} == {v.metadata.uid for v in victims}
+    assert got.num_pdb_violations == 2
+
+
+def test_skewed_victim_claims_no_capacity(stack):
+    """A victim whose annotations don't match the node's actual charge
+    state must not inflate simulated capacity (Chip.give clamps, so an
+    unvalidated cancel would silently free phantom chips)."""
+    from elastic_gpu_scheduler_tpu.utils import consts as C
+
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 2, [1, 2])
+    # forge a victim claiming two chips that are actually FREE — cancelling
+    # its option would be a double-free
+    forged = tpu_pod("forged", core=200, priority=1)
+    forged.spec.node_name = "node-0"
+    forged.metadata.annotations[C.ANNOTATION_ASSUMED] = "true"
+    forged.metadata.annotations[C.ANNOTATION_CONTAINER_PREFIX + "main"] = "2,3"
+    cluster.create_pod(forged)
+
+    # preemptor wants all 4 chips: really needs victim-0, victim-1 evicted
+    # AND the 2 free chips; the forged victim frees nothing
+    preemptor = tpu_pod("hi", core=400, priority=100)
+    needed = sched.preempt("node-0", preemptor, victims + [forged])
+    assert needed is not None
+    names = {v.metadata.name for v in needed}
+    # both real victims are required; forged passes through without having
+    # contributed capacity
+    assert {"victim-0", "victim-1"} <= names
+
+
+def test_http_preemption_route(stack):
+    """POST /scheduler/preemption over the real HTTP server."""
+    import json
+    import urllib.request
+
+    from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+    from elastic_gpu_scheduler_tpu.server.handlers import (
+        Bind,
+        Predicate,
+        Prioritize,
+    )
+
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    cluster.create_pod(preemptor)
+
+    server = ExtenderServer(
+        Predicate(registry),
+        Prioritize(registry),
+        Bind(registry, clientset),
+        lambda: {},
+        preemption=Preemption(registry, clientset),
+        host="127.0.0.1",
+        port=0,
+    )
+    port = server.start()
+    try:
+        body = {
+            "Pod": preemptor.to_dict(),
+            "NodeNameToMetaVictims": {
+                "node-0": {
+                    "Pods": [{"UID": v.metadata.uid} for v in victims],
+                    "NumPDBViolations": 0,
+                }
+            },
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/scheduler/preemption",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            out = json.loads(r.read())
+        got = out["NodeNameToMetaVictims"]["node-0"]["Pods"]
+        want = {v.metadata.uid for v in victims[:2]}
+        assert {p["UID"] for p in got} == want
+    finally:
+        server.stop()
