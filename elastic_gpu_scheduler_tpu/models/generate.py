@@ -86,12 +86,19 @@ def decode_step(
 
 
 def sample_token(
-    logits: jax.Array, temperature: float, key: jax.Array
+    logits: jax.Array,
+    temperature: float,
+    key: jax.Array,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
-    """(B, V) logits → (B,) tokens; greedy when temperature == 0 (static)."""
-    if temperature > 0:
-        return jax.random.categorical(key, logits / temperature, axis=-1)
-    return jnp.argmax(logits, axis=-1)
+    """(B, V) logits → (B,) tokens; greedy when temperature == 0.  All
+    sampling params are static — see models/sampling.py for semantics."""
+    from .sampling import sample_static
+
+    return sample_static(
+        logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+    )
 
 
 def decode_loop(
@@ -102,6 +109,8 @@ def decode_loop(
     n_steps: int,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> tuple[jax.Array, jax.Array, KVCache]:
     """``n_steps`` fused decode steps in ONE ``lax.scan`` — one device
     dispatch per K tokens instead of per token (sampling happens inside the
@@ -118,7 +127,7 @@ def decode_loop(
     def body(carry, _):
         logits, cache, key = carry
         key, sub = jax.random.split(key)
-        token = sample_token(logits, temperature, sub)
+        token = sample_token(logits, temperature, sub, top_k=top_k, top_p=top_p)
         logits, cache = decode_step(params, token, cache, cfg)
         return (logits, cache, key), token
 
@@ -305,6 +314,8 @@ def generate(
     max_len: int = 0,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation; returns (B, S+new).
 
@@ -320,7 +331,8 @@ def generate(
 
     loop_fn = jax.jit(
         functools.partial(
-            decode_loop, cfg=cfg, n_steps=max_new_tokens, temperature=temperature
+            decode_loop, cfg=cfg, n_steps=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
         )
     )
     tokens, _, _ = loop_fn(params, logits, cache, key=key)

@@ -1,0 +1,111 @@
+"""Token sampling: temperature, top-k, and top-p (nucleus) filtering.
+
+Two entry points for the two call shapes in this repo:
+
+- ``sample_static``: per-call Python scalars (temperature/top_k/top_p are
+  static under jit) — used by ``generate.decode_loop``/``generate.generate``
+  where one sampling config applies to the whole batch.  Filters compile
+  away entirely when disabled.
+- ``sample_batched``: per-row device arrays — used by the serving engine's
+  fused decode chunk, where every slot carries its own request's sampling
+  params and recompiling per combination is not an option.
+
+Conventions match the de-facto standard (HF ``generation``): temperature
+scales logits first, then top-k keeps the k highest-probability tokens,
+then top-p keeps the smallest prefix of the sorted distribution whose
+cumulative mass reaches p (the top-1 token is always kept).  temperature 0
+means greedy; top_k 0 and top_p >= 1 disable the respective filter.
+
+All shapes are static and the math is branch-free, so everything lives
+happily inside a ``lax.scan`` decode loop on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _topk_threshold_mask(scaled: jax.Array, k: int) -> jax.Array:
+    """keep mask for static k>0: True where scaled >= k-th largest value."""
+    kth = jax.lax.top_k(scaled, k)[0][..., -1:]  # (B,1)
+    return scaled >= kth
+
+
+def _topp_mask_from_sorted(
+    sorted_scaled: jax.Array, top_p: jax.Array | float
+) -> jax.Array:
+    """keep mask IN SORTED ORDER: smallest prefix with cumulative mass
+    reaching top_p; exclusive-cumsum comparison always keeps the top-1."""
+    probs = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    if not isinstance(top_p, jax.Array):
+        top_p = jnp.asarray(top_p, probs.dtype)
+    keep = (cum - probs) < jnp.reshape(top_p, (-1, 1) if jnp.ndim(top_p) else ())
+    # the top-1 token survives even a degenerate top_p <= 0
+    return keep.at[..., 0].set(True)
+
+
+def sample_static(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """(B, V) logits → (B,) tokens.  temperature/top_k/top_p are Python
+    scalars, so disabled filters cost nothing after jit."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    V = logits.shape[-1]
+    if top_k > 0 and top_k < V:
+        scaled = jnp.where(_topk_threshold_mask(scaled, top_k), scaled, -jnp.inf)
+    if top_p < 1.0:
+        sorted_scaled = -jnp.sort(-scaled, axis=-1)  # descending
+        keep_sorted = _topp_mask_from_sorted(sorted_scaled, top_p)
+        # threshold = smallest kept value; everything below is masked
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_scaled, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
+def sample_batched(
+    logits: jax.Array,
+    key: jax.Array,
+    temps: jax.Array,  # (B,) float32; 0 → greedy for that row
+    top_ks: jax.Array,  # (B,) int32; 0 → no top-k for that row
+    top_ps: jax.Array,  # (B,) float32; >= 1 → no top-p for that row
+) -> jax.Array:
+    """(B, V) logits → (B,) tokens with PER-ROW sampling params.
+
+    One descending argsort serves both filters: rank-based top-k and
+    cumulative-mass top-p masks are built in sorted space and gathered back
+    through the inverse permutation.
+    """
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)  # (B,V) descending
+    inv = jnp.argsort(order, axis=-1)  # inverse permutation
+    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
+
+    ranks = inv  # rank of each vocab entry in the sorted order
+    keep_k = (top_ks[:, None] <= 0) | (ranks < top_ks[:, None])
+    # SEQUENTIAL semantics (same as sample_static / HF): top-p sees the
+    # top-k-filtered, renormalized distribution — mask beyond-k positions
+    # in sorted space (position IS rank there) before the mass cumsum
+    pos = jnp.arange(V)[None, :]
+    sorted_k = jnp.where(
+        (top_ks[:, None] <= 0) | (pos < top_ks[:, None]), sorted_scaled, -jnp.inf
+    )
+    keep_sorted_p = _topp_mask_from_sorted(sorted_k, top_ps)
+    keep_p = jnp.take_along_axis(keep_sorted_p, ranks, axis=-1)
+    keep = keep_k & (keep_p | (top_ps[:, None] >= 1.0))
+
+    masked = jnp.where(keep, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)

@@ -120,6 +120,8 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     temperature: float = 0.0
+    top_k: int = 0  # 0 → disabled; per-request (see models/sampling.py)
+    top_p: float = 1.0  # >= 1 → disabled
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
     error: str = ""  # set (with done) when the request is rejected
@@ -239,7 +241,8 @@ def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
 
 def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
-    prompts, prompt_lens, temps, key, *, cfg, page_size, n_steps,
+    prompts, prompt_lens, temps, top_ks, top_ps, key,
+    *, cfg, page_size, n_steps, use_filters,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches).
@@ -248,7 +251,12 @@ def _fused_serve_chunk(
     logits; the host decides afterwards which sampled entries are real
     emissions (position ≥ prompt_len-1) — the device only needs to know
     which NEXT token to feed (prompt token while prefilling, else the
-    sample)."""
+    sample).
+
+    ``use_filters`` is static: the engine picks the filtered variant (one
+    argsort per step for per-slot top-k/top-p) only for chunks where some
+    active request asks for it, so default sampling never pays for it."""
+    from .sampling import sample_batched
 
     def body(carry, _):
         tokens, lengths, key, kv = carry
@@ -256,11 +264,14 @@ def _fused_serve_chunk(
             params, tokens, kv, tables, lengths, cfg, page_size
         )
         key, sub = jax.random.split(key)
-        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-        temped = jax.random.categorical(
-            sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1
-        ).astype(jnp.int32)
-        sampled = jnp.where(temps > 0, temped, greedy)
+        if use_filters:
+            sampled = sample_batched(logits, sub, temps, top_ks, top_ps)
+        else:
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            temped = jax.random.categorical(
+                sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1
+            ).astype(jnp.int32)
+            sampled = jnp.where(temps > 0, temped, greedy)
         new_len = lengths + active.astype(jnp.int32)
         in_prompt = new_len < prompt_lens
         nxt = jnp.minimum(new_len, prompts.shape[1] - 1)
@@ -313,19 +324,27 @@ class InferenceEngine:
         self.prompts = np.zeros((max_batch, max_len), np.int32)
         self.prompt_lens = np.zeros(max_batch, np.int32)
         self.temps = np.zeros(max_batch, np.float32)
+        self.top_ks = np.zeros(max_batch, np.int32)
+        self.top_ps = np.ones(max_batch, np.float32)
         self.next_token = np.zeros(max_batch, np.int32)
         self.emitted = np.zeros(max_batch, np.int32)
         self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
         self.queue: "queue.Queue[Request]" = queue.Queue()
-        self._chunk = jax.jit(
-            functools.partial(
-                _fused_serve_chunk,
-                cfg=cfg,
-                page_size=page_size,
-                n_steps=self.fused_steps,
-            ),
-            donate_argnums=(1,),  # the kv pool pytree
-        )
+        # two chunk variants: plain sampling, and per-slot top-k/top-p
+        # filtering (compiled lazily, only if a request ever asks for it)
+        self._chunks = {
+            use_filters: jax.jit(
+                functools.partial(
+                    _fused_serve_chunk,
+                    cfg=cfg,
+                    page_size=page_size,
+                    n_steps=self.fused_steps,
+                    use_filters=use_filters,
+                ),
+                donate_argnums=(1,),  # the kv pool pytree
+            )
+            for use_filters in (False, True)
+        }
         self._prefill = jax.jit(
             functools.partial(_paged_prefill, cfg=cfg, page_size=page_size),
             donate_argnums=(2,),  # the kv pool pytree
@@ -380,6 +399,8 @@ class InferenceEngine:
             self.prompt_lens[i] = len(req.prompt)
             self.next_token[i] = req.prompt[0]
             self.temps[i] = req.temperature
+            self.top_ks[i] = req.top_k
+            self.top_ps[i] = req.top_p
             self.lengths[i] = 0
             self.emitted[i] = 0
             self.stalled[i] = False
@@ -411,9 +432,15 @@ class InferenceEngine:
         )
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
+            from .sampling import sample_static
+
             self._key, sub = jax.random.split(self._key)
             tok = int(
-                jax.random.categorical(sub, logits / req.temperature)
+                sample_static(
+                    jnp.reshape(logits, (1, -1)), sub,
+                    temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p,
+                )[0]
             )
         else:
             tok = int(jnp.argmax(logits))
@@ -486,7 +513,10 @@ class InferenceEngine:
         view = self.tables[:, :bucket].copy()
         view[~active] = SCRATCH_PAGE
         self._key, sub = jax.random.split(self._key)
-        sampled, self.kv = self._chunk(
+        use_filters = bool(
+            (self.top_ks[active] > 0).any() or (self.top_ps[active] < 1.0).any()
+        )
+        sampled, self.kv = self._chunks[use_filters](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -496,6 +526,8 @@ class InferenceEngine:
             jnp.asarray(self.prompts),
             jnp.asarray(self.prompt_lens),
             jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps),
             sub,
         )
         sampled = np.asarray(sampled)  # (B, K)

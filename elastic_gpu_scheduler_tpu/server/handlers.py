@@ -151,6 +151,13 @@ class Preemption:
                 needed: Optional[list[Pod]] = victims or []
             else:
                 needed = sched.preempt(n, pod, victims)
+                if needed is None and passthrough_uids:
+                    # infeasible — but UNRESOLVED victims (deleted
+                    # mid-flight, chips still charged until reconciliation
+                    # catches up) may hold exactly the capacity we could
+                    # not simulate; echo the full proposal instead of
+                    # dropping a node that may become feasible
+                    needed = victims
             if needed is None:
                 continue  # node infeasible even with all victims evicted
             result[n] = MetaVictims(

@@ -233,6 +233,35 @@ def test_unresolved_uid_passes_through(stack):
     assert {v.metadata.uid for v in victims[:2]} <= got
 
 
+def test_all_victims_unresolved_echoes_instead_of_dropping(stack):
+    """Victims deleted mid-flight (UIDs no longer resolve) leave their
+    chips charged until reconciliation catches up; the simulated ledger
+    then says 'infeasible', but the node must be echoed, not dropped —
+    it becomes feasible the moment the releases land."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [1, 2, 3, 4])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    cluster.create_pod(preemptor)
+    # delete the victim pods WITHOUT releasing their chips (no controller
+    # running in this fixture — exactly the mid-flight window)
+    for v in victims:
+        cluster.delete_pod("default", v.metadata.name)
+
+    handler = Preemption(registry, clientset)
+    args = ExtenderPreemptionArgs(
+        pod=preemptor,
+        node_name_to_meta_victims={
+            "node-0": MetaVictims(
+                pods=[MetaPod(uid=v.metadata.uid) for v in victims]
+            )
+        },
+    )
+    result = handler.handle(args)
+    got = result.node_name_to_meta_victims.get("node-0")
+    assert got is not None, "node wrongly dropped"
+    assert {p.uid for p in got.pods} == {v.metadata.uid for v in victims}
+
+
 def test_list_failure_echoes_proposal(stack):
     """If the pod LIST fails, the proposal is echoed unchanged (no pruning,
     no node dropping) — same behavior as an extender without preemptVerb."""
