@@ -127,6 +127,39 @@ class Request:
     error: str = ""  # set (with done) when the request is rejected
 
 
+def _rope_rows(x, positions, theta):
+    """rope with PER-ROW positions: x (B,T,H,Dh), positions (B,T)."""
+    return jax.vmap(lambda xb, pb: rope(xb[None], pb, theta)[0])(x, positions)
+
+
+def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype):
+    """ONE transformer layer shared by every paged path (decode step,
+    plain prefill, prefixed prefill) — the paths differ only in position
+    arithmetic and the attention geometry, which arrive as ``positions``
+    (B,T) / scatter targets (B·T,) / ``attn(q, k, v, lkv)`` → (B,T,Hn·Dh).
+    """
+    B, T, _ = x.shape
+    Hn, Dh, Hkv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ wmat(p["wq"], dtype)).reshape(B, T, Hn, Dh)
+    k = (h @ wmat(p["wk"], dtype)).reshape(B, T, Hkv, Dh)
+    v = (h @ wmat(p["wv"], dtype)).reshape(B, T, Hkv, Dh)
+    q = _rope_rows(q, positions, cfg.rope_theta)
+    k = _rope_rows(k, positions, cfg.rope_theta)
+    # scatter the new rows (inactive/padding rows target the scratch page —
+    # harmless garbage nobody attends to)
+    lkv = _kv_write_rows(
+        lkv, pidx, off, k.reshape(B * T, Hkv, Dh), v.reshape(B * T, Hkv, Dh)
+    )
+    o = attn(q, k, v, lkv)
+    x = x + (o @ wmat(p["wo"], dtype))
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
+    up = h @ wmat(p["w_in"], dtype)
+    x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+    return x, lkv
+
+
 def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size):
     """One decode step for every slot at its own position, against the page
     pool.
@@ -143,35 +176,20 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size):
     page_idx = tables[bidx, lengths // page_size]  # (B,)
     offset = lengths % page_size  # (B,)
 
-    def layer_step(x, scanned):
-        p, lkv = scanned  # lkv: this layer's pool slice
-        h = rms_norm(x, p["attn_norm"])
-        Hkv = cfg.kv_heads
-        q = (h @ wmat(p["wq"], dtype)).reshape(B, 1, Hn, Dh)
-        k = (h @ wmat(p["wk"], dtype)).reshape(B, 1, Hkv, Dh)
-        v = (h @ wmat(p["wv"], dtype)).reshape(B, 1, Hkv, Dh)
-        # rope at each slot's own position (vmap over batch)
-        rope_b = jax.vmap(
-            lambda xb, pos: rope(xb[None], pos[None], cfg.rope_theta)[0]
-        )
-        q = rope_b(q, lengths)
-        k = rope_b(k, lengths)
-        # scatter k/v into each slot's current page (inactive slots target
-        # the scratch page — harmless garbage nobody attends to)
-        lkv = _kv_write_rows(lkv, page_idx, offset, k[:, 0], v[:, 0])
+    def attn(q, k, v, lkv):
         # gather the slot's pages into a virtually-contiguous view; position
         # j of the view IS token position j (pages are table-ordered), so
         # the shared cached_attention position mask applies unchanged
         k_all, v_all = _kv_gather(lkv, tables, page_size, dtype)
-        o = cached_attention(
+        return cached_attention(
             q, k_all, v_all, lengths, window=cfg.window_size
         ).reshape(B, 1, Hn * Dh)
-        x = x + (o @ wmat(p["wo"], dtype))
-        h = rms_norm(x, p["mlp_norm"])
-        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
-        up = h @ wmat(p["w_in"], dtype)
-        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
-        return x, lkv
+
+    def layer_step(x, scanned):
+        p, lkv = scanned  # lkv: this layer's pool slice
+        return _paged_layer(
+            x, p, lkv, lengths[:, None], page_idx, offset, attn, cfg, dtype
+        )
 
     x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
     x = rms_norm(x, params["final_norm"])
@@ -202,35 +220,70 @@ def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
     )
     off = positions % page_size
 
-    def layer_step(x, scanned):
-        p, lkv = scanned  # this layer's pool slice
-        h = rms_norm(x, p["attn_norm"])
-        Hkv = cfg.kv_heads
-        q = (h @ wmat(p["wq"], dtype)).reshape(1, Tpad, Hn, Dh)
-        k = (h @ wmat(p["wk"], dtype)).reshape(1, Tpad, Hkv, Dh)
-        v = (h @ wmat(p["wv"], dtype)).reshape(1, Tpad, Hkv, Dh)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        lkv = _kv_write_rows(lkv, pidx, off, k[0], v[0])
+    def attn(q, k, v, lkv):
         # the prompt is the entire valid prefix, so attention is plain
         # causal self-attention within the block — no page gather needed
         # (padding positions sit AFTER every real one; causal masking keeps
         # them out of real queries' windows)
         from .transformer import repeat_kv
 
-        n_rep = Hn // Hkv
-        o = flash_attention(
+        n_rep = Hn // cfg.kv_heads
+        return flash_attention(
             q.transpose(0, 2, 1, 3),
             repeat_kv(k, n_rep).transpose(0, 2, 1, 3),
             repeat_kv(v, n_rep).transpose(0, 2, 1, 3),
             True, None, cfg.window_size,
         ).transpose(0, 2, 1, 3).reshape(1, Tpad, Hn * Dh)
-        x = x + (o @ wmat(p["wo"], dtype))
-        h = rms_norm(x, p["mlp_norm"])
-        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
-        up = h @ wmat(p["w_in"], dtype)
-        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
-        return x, lkv
+
+    def layer_step(x, scanned):
+        p, lkv = scanned  # this layer's pool slice
+        return _paged_layer(
+            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype
+        )
+
+    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
+    x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ wmat(params["unembed"], dtype))[0, 0]  # (V,)
+    return logits.astype(jnp.float32), new_kv
+
+
+def _paged_prefill_prefixed(
+    params, tokens, kv, pages, t0, t_real, *, cfg, page_size
+):
+    """One-pass prompt ingestion BEHIND a shared cached prefix.
+
+    Same contract as ``_paged_prefill`` except the slot's pages already
+    hold K/V for positions < t0 (prefix-cache hit): the new tokens sit at
+    global positions t0..t0+t_real-1, and attention gathers the slot's
+    pages so queries see the cached prefix (generate.cached_attention_multi
+    geometry).  Padding rows write to the scratch page and their outputs
+    are never consumed.
+    """
+    from .generate import cached_attention_multi
+
+    dtype = jnp.dtype(cfg.dtype)
+    Tpad = tokens.shape[1]
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    x = _embed_lookup(params["embed"], tokens, dtype)  # (1, Tpad, D)
+    rel = jnp.arange(Tpad)
+    positions = t0 + rel
+    pidx = jnp.where(
+        rel < t_real, pages[positions // page_size], SCRATCH_PAGE
+    )
+    off = positions % page_size
+
+    def attn(q, k, v, lkv):
+        k_all, v_all = _kv_gather(lkv, pages[None, :], page_size, dtype)
+        return cached_attention_multi(
+            q, k_all, v_all, t0, window=cfg.window_size
+        ).reshape(1, Tpad, Hn * Dh)
+
+    def layer_step(x, scanned):
+        p, lkv = scanned
+        return _paged_layer(
+            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype
+        )
 
     x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
     x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
@@ -299,6 +352,7 @@ class InferenceEngine:
         n_pages: int = 0,
         fused_steps: int = 8,
         kv_int8: bool = False,
+        prefix_cache: bool = False,
     ):
         assert cfg.n_experts == 0, "serving engine supports dense models"
         self.params = params
@@ -349,7 +403,27 @@ class InferenceEngine:
             functools.partial(_paged_prefill, cfg=cfg, page_size=page_size),
             donate_argnums=(2,),  # the kv pool pytree
         )
+        self._prefill_prefixed = jax.jit(
+            functools.partial(
+                _paged_prefill_prefixed, cfg=cfg, page_size=page_size
+            ),
+            donate_argnums=(2,),
+        )
         self._key = jax.random.key(0)
+        # -- automatic prefix caching (vLLM-style, opt-in) -------------------
+        # Full pages of a finished request's prompt stay in the pool under a
+        # hash-chain key (prev_key, page_tokens); a new request's prompt is
+        # matched page-by-page and shared pages are attached read-only (its
+        # first write position is page-aligned past the match, so shared
+        # content is never overwritten).  Zero-reference cached pages are
+        # evicted LRU when the free list runs dry.
+        self.prefix_cache = prefix_cache
+        self.page_ref = np.zeros(self.n_pages, np.int32)
+        self.prefix_entries: dict = {}  # key → page id
+        self.page_key: dict[int, object] = {}  # page id → key (for eviction)
+        self.page_lru: dict[int, int] = {}
+        self._lru_clock = 0
+        self.prefix_hit_tokens = 0
 
     # -- public API ----------------------------------------------------------
 
@@ -401,35 +475,111 @@ class InferenceEngine:
             self.temps[i] = req.temperature
             self.top_ks[i] = req.top_k
             self.top_ps[i] = req.top_p
-            self.lengths[i] = 0
             self.emitted[i] = 0
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
             # positions <= length, all of which the new tenant rewrites
+            matched = self._match_prefix(i, req) if self.prefix_cache else 0
+            self.lengths[i] = matched
+            if matched:
+                self.next_token[i] = req.prompt[matched]
             self._try_prefill(i, req)
 
-    def _try_prefill(self, i: int, req: Request) -> None:
-        """Ingest the WHOLE prompt in one pass (the paged analogue of
-        batched prefill) when pages are available; otherwise leave the slot
-        in the incremental prompt-feeding path (the fused chunks consume
-        the prompt at decode speed — slower but always correct)."""
+    def _match_prefix(self, i: int, req: Request) -> int:
+        """Attach cached pages matching the prompt's leading full pages
+        (capped at plen-1 so at least one prompt token always runs through
+        the model to produce the first logits).  Returns tokens matched."""
+        ps = self.page_size
         plen = len(req.prompt)
-        if plen < 2 or not self._ensure_pages(i, plen):
+        key = ()
+        matched_pages = 0
+        for j in range(self.max_pages_per_slot):
+            end = (j + 1) * ps
+            if end > plen - 1:
+                break
+            key = (key, tuple(req.prompt[j * ps:end]))
+            pg = self.prefix_entries.get(key)
+            if pg is None:
+                break
+            self.tables[i, j] = pg
+            self.slot_pages[i].append(pg)
+            self.page_ref[pg] += 1
+            self._touch(pg)
+            matched_pages += 1
+        self.prefix_hit_tokens += matched_pages * ps
+        return matched_pages * ps
+
+    def _touch(self, pg: int) -> None:
+        self._lru_clock += 1
+        self.page_lru[pg] = self._lru_clock
+
+    def _register_prompt_pages(self, i: int, req: Request) -> None:
+        """On release: publish the slot's pages fully covered by the prompt
+        into the prefix cache (content-addressed by the token hash chain).
+        Duplicates of already-cached content stay unregistered and are
+        freed normally."""
+        ps = self.page_size
+        plen = len(req.prompt)
+        key = ()
+        for j, pg in enumerate(self.slot_pages[i]):
+            end = (j + 1) * ps
+            if end > plen:
+                break
+            key = (key, tuple(req.prompt[j * ps:end]))
+            existing = self.prefix_entries.get(key)
+            if existing is None:
+                self.prefix_entries[key] = pg
+                self.page_key[pg] = key
+                self._touch(pg)
+            elif existing == pg:
+                self._touch(pg)  # shared page we matched at admission
+
+    def _try_prefill(self, i: int, req: Request) -> None:
+        """Ingest the (rest of the) prompt in one pass when pages are
+        available; otherwise leave the slot in the incremental
+        prompt-feeding path (the fused chunks consume the prompt at decode
+        speed — slower but always correct).  A prefix-cache hit skips the
+        matched tokens entirely: only the remainder runs through the model,
+        attending to the shared pages."""
+        plen = len(req.prompt)
+        t0 = int(self.lengths[i])  # prefix-cache hit length (0 without)
+        rem = plen - t0
+        if rem < 2 or not self._ensure_pages(i, plen):
             return
         # bucket the pad length so the prefill jit compiles per power of two
         tpad = 8
-        while tpad < plen:
+        while tpad < rem:
             tpad *= 2
         tpad = min(tpad, self.max_len)
+        # bucket the table width too: the prefixed path gathers every page
+        # it is handed, so its attention cost must follow the LIVE prompt
+        # length, not max_len (same trick as step()'s table view).  Padding
+        # positions index past the slice and clamp — then route to scratch.
+        need_pages = -(-plen // self.page_size)
+        pbucket = 1
+        while pbucket < need_pages:
+            pbucket *= 2
+        pbucket = min(pbucket, self.max_pages_per_slot)
+        row = jnp.asarray(self.tables[i, :pbucket])
         toks = np.zeros((1, tpad), np.int32)
-        toks[0, :plen] = req.prompt
-        logits, self.kv = self._prefill(
-            self.params,
-            jnp.asarray(toks),
-            self.kv,
-            jnp.asarray(self.tables[i]),
-            jnp.asarray(plen, jnp.int32),
-        )
+        toks[0, :rem] = req.prompt[t0:]
+        if t0 == 0:
+            logits, self.kv = self._prefill(
+                self.params,
+                jnp.asarray(toks),
+                self.kv,
+                row,
+                jnp.asarray(rem, jnp.int32),
+            )
+        else:
+            logits, self.kv = self._prefill_prefixed(
+                self.params,
+                jnp.asarray(toks),
+                self.kv,
+                row,
+                jnp.asarray(t0, jnp.int32),
+                jnp.asarray(rem, jnp.int32),
+            )
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
@@ -452,6 +602,22 @@ class InferenceEngine:
             req.done.set()
             self._release_slot(i)
 
+    def _alloc_page(self) -> Optional[int]:
+        if self.free_pages:
+            return self.free_pages.pop()
+        if self.prefix_cache:
+            # evict the least-recently-used cached page nobody references
+            candidates = [
+                pg for pg in self.page_key if self.page_ref[pg] == 0
+            ]
+            if candidates:
+                pg = min(candidates, key=lambda p: self.page_lru.get(p, 0))
+                key = self.page_key.pop(pg)
+                self.prefix_entries.pop(key, None)
+                self.page_lru.pop(pg, None)
+                return pg
+        return None
+
     def _ensure_pages(self, i: int, upto: int) -> bool:
         """Grow slot i's page list to cover token positions < upto.
         Returns False (and leaves partial growth in place) on pool
@@ -459,15 +625,22 @@ class InferenceEngine:
         upto = min(upto, self.max_len)
         need = -(-upto // self.page_size)
         while len(self.slot_pages[i]) < need:
-            if not self.free_pages:
+            pg = self._alloc_page()
+            if pg is None:
                 return False
-            pg = self.free_pages.pop()
             self.tables[i, len(self.slot_pages[i])] = pg
             self.slot_pages[i].append(pg)
+            self.page_ref[pg] += 1
         return True
 
     def _release_slot(self, i: int) -> None:
-        self.free_pages.extend(reversed(self.slot_pages[i]))
+        req = self.slots[i]
+        if self.prefix_cache and req is not None and not req.error:
+            self._register_prompt_pages(i, req)
+        for pg in reversed(self.slot_pages[i]):
+            self.page_ref[pg] -= 1
+            if self.page_ref[pg] <= 0 and pg not in self.page_key:
+                self.free_pages.append(pg)
         self.slot_pages[i] = []
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None

@@ -84,7 +84,12 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_s
     head_dim = q_ref.shape[3]
     seq_k = k_ref.shape[2]
 
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, d)
+    # MXU inputs stay in the INPUT dtype (bf16 in the training path) with
+    # fp32 accumulation via preferred_element_type — an fp32×fp32 MXU dot
+    # runs ~8x slower on v5e than bf16-in/fp32-accum, and the cast was
+    # costing exactly that.  sm_scale is applied to the fp32 scores, not to
+    # q, so bf16 inputs lose nothing to pre-scaling.
+    q = q_ref[0, 0]  # (block_q, d), native dtype
 
     q_block_idx = pl.program_id(2)
     q_offset = q_block_idx * block_q + q_shift
@@ -105,13 +110,13 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_s
 
     def body(j, carry):
         acc, m_i, l_i = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        ) * sm_scale  # (block_q, block_k) fp32
         if causal or window > 0:
             s = jnp.where(
                 _block_mask(q_offset, j * block_k, block_q, block_k, causal,
@@ -123,7 +128,7 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_s
         p = jnp.exp(s - m_new[:, None])
         l_new = l_i * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk,
+            p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -196,14 +201,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (block_q, d)
-        k_blk = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
+        # native-dtype MXU inputs, fp32 accumulation (see resident kernel)
+        q = q_ref[0, 0]  # (block_q, d)
+        k_blk = k_ref[0, 0]  # (block_k, d)
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        ) * sm_scale  # (block_q, block_k) fp32
         if causal or window > 0:
             s = jnp.where(
                 _block_mask(q_offset, k_offset, block_q, block_k, causal,
@@ -218,7 +224,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[0] = l_i * alpha + jnp.sum(p, axis=1)
         m_ref[0] = m_new
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk,
+            p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -368,8 +374,9 @@ def _flash_bwd_dq_kernel_resident(
 
     block_q = q_ref.shape[2]
     seq_k = k_ref.shape[2]
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    # native-dtype MXU inputs, fp32 accumulation (see forward kernel)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, 0]  # (block_q,) — stored with trailing singleton
     delta = delta_ref[0, 0, :, 0]
     q_offset = pl.program_id(2) * block_q + q_shift
@@ -384,8 +391,8 @@ def _flash_bwd_dq_kernel_resident(
         start_block = jnp.maximum(0, (q_offset - window + 1) // block_k)
 
     def body(j, dq_acc):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -401,7 +408,7 @@ def _flash_bwd_dq_kernel_resident(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
         return dq_acc + jax.lax.dot_general(
             ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -424,8 +431,9 @@ def _flash_bwd_dkv_kernel_resident(
     block_k = k_ref.shape[2]
     seq_q = q_ref.shape[2]
     d = k_ref.shape[3]
-    k_blk = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    # native-dtype MXU inputs, fp32 accumulation (see forward kernel)
+    k_blk = k_ref[0, 0]  # (block_k, d)
+    v_blk = v_ref[0, 0]
     k_offset = pl.program_id(2) * block_k
 
     num_q_blocks = seq_q // block_q
@@ -443,8 +451,8 @@ def _flash_bwd_dkv_kernel_resident(
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
         lse_b = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
         delta_b = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
         s = jax.lax.dot_general(
@@ -457,16 +465,17 @@ def _flash_bwd_dkv_kernel_resident(
                             causal, window),
                 s, NEG_INF,
             )
-        p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k)
+        p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k) fp32
         dv_acc = dv_acc + jax.lax.dot_general(
-            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_b[:, None]) * sm_scale
+        ds = (p * (dp - delta_b[:, None]) * sm_scale).astype(q_blk.dtype)
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -506,12 +515,13 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # native-dtype MXU inputs, fp32 accumulation (see forward kernel)
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]  # (block_q,)
         delta = delta_ref[0, 0, :, 0]
-        k_blk = k_ref[0, 0].astype(jnp.float32)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -527,7 +537,7 @@ def _flash_bwd_dq_kernel(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
         dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
             ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -568,10 +578,11 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        k_blk = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
-        q_blk = q_ref[0, 0].astype(jnp.float32)  # (block_q, d)
-        do_blk = do_ref[0, 0].astype(jnp.float32)
+        # native-dtype MXU inputs, fp32 accumulation (see forward kernel)
+        k_blk = k_ref[0, 0]  # (block_k, d)
+        v_blk = v_ref[0, 0]
+        q_blk = q_ref[0, 0]  # (block_q, d)
+        do_blk = do_ref[0, 0]
         lse_b = lse_ref[0, 0, :, 0]
         delta_b = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
@@ -584,16 +595,17 @@ def _flash_bwd_dkv_kernel(
                             window),
                 s, NEG_INF,
             )
-        p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k)
+        p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k) fp32
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
-            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_b[:, None]) * sm_scale
+        ds = (p * (dp - delta_b[:, None]) * sm_scale).astype(q_blk.dtype)
         dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
             ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -837,21 +849,18 @@ def _flash_stats_kernel(
     num_k_blocks = seq_k // block_k
 
     for h in range(H):  # static unroll over heads
-        q = q_ref[0, h].astype(jnp.float32) * sm_scale  # (block_q, d)
+        # native-dtype MXU inputs, fp32 accumulation (see _flash_kernel)
+        q = q_ref[0, h]  # (block_q, d)
 
         def body(j, carry):
             acc, m_i, l_i = carry
-            k_blk = k_ref[0, h, pl.ds(j * block_k, block_k), :].astype(
-                jnp.float32
-            )
-            v_blk = v_ref[0, h, pl.ds(j * block_k, block_k), :].astype(
-                jnp.float32
-            )
+            k_blk = k_ref[0, h, pl.ds(j * block_k, block_k), :]
+            v_blk = v_ref[0, h, pl.ds(j * block_k, block_k), :]
             s = jax.lax.dot_general(
                 q, k_blk,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )
+            ) * sm_scale
             if causal:
                 q_ids = q_offset + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0
@@ -867,7 +876,7 @@ def _flash_stats_kernel(
             p = jnp.exp(s - m_new[:, None])
             l_new = l_i * alpha + jnp.sum(p, axis=1)
             acc = acc * alpha[:, None] + jax.lax.dot_general(
-                p, v_blk,
+                p.astype(v_blk.dtype), v_blk,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
