@@ -1,0 +1,145 @@
+"""LoRA: low-rank adapter fine-tuning for the flagship models.
+
+TPU-first shape: the transformer stores each weight family STACKED over
+layers ((L, d_in, d_out), models/transformer.py init_params), so a LoRA
+adapter is one pair of stacked low-rank factors A (L, d_in, r) and
+B (L, r, d_out) per target family, and the merge W + (alpha/r)·A@B is ONE
+batched einsum on the MXU per family — no per-layer Python loops, nothing
+for XLA to unroll.
+
+Training uses the MERGED functional view: each step materializes
+W' = W + scale·A@B inside the jit and runs the standard forward; autodiff
+flows through the merge so gradients land only on (A, B) — the base stays
+frozen bits (and can live in bf16 at rest).  The merge costs
+O(L·d·d·r/d) = r/d of one weight read — noise next to a train step — and
+XLA fuses it into the consuming matmuls' prologue.
+
+For serving, ``merge_lora`` bakes the adapters in once and returns plain
+params usable by every existing path (generate, serving engine, export).
+
+No reference analogue (the reference schedules pods, SURVEY §2 #19); this
+fills the fine-tuning capability slot of the workload plane.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .transformer import TransformerConfig
+
+# weight families eligible for adaptation (dense path)
+DEFAULT_TARGETS = ("wq", "wv")
+ALL_TARGETS = ("wq", "wk", "wv", "wo", "w_in", "w_gate", "w_out")
+
+
+def lora_init(
+    key: jax.Array,
+    params: dict,
+    rank: int,
+    targets: Iterable[str] = DEFAULT_TARGETS,
+    alpha: Optional[float] = None,
+) -> dict:
+    """Create zero-impact adapters: A ~ N(0, 1/d_in), B = 0 (the standard
+    init — the merged model starts EXACTLY equal to the base)."""
+    targets = tuple(targets)
+    layers = params["layers"]
+    adapters = {}
+    keys = jax.random.split(key, len(targets))
+    for t, kk in zip(targets, keys):
+        if t not in layers:
+            raise ValueError(f"LoRA target {t!r} not in model layers")
+        W = layers[t]
+        if W.ndim != 3:
+            raise ValueError(
+                f"LoRA target {t!r} must be stacked (L, d_in, d_out); "
+                f"got shape {W.shape} (MoE experts are not supported)"
+            )
+        L, d_in, d_out = W.shape
+        adapters[t] = {
+            "a": (
+                jax.random.normal(kk, (L, d_in, rank), jnp.float32)
+                * d_in ** -0.5
+            ),
+            "b": jnp.zeros((L, rank, d_out), jnp.float32),
+        }
+    return {
+        "adapters": adapters,
+        "alpha": float(alpha if alpha is not None else rank),
+        "rank": rank,
+    }
+
+
+def lora_param_count(lora: dict) -> int:
+    return sum(
+        x.size for x in jax.tree.leaves(lora["adapters"])
+    )
+
+
+def merge_lora(params: dict, lora: dict) -> dict:
+    """params + scale·A@B for every adapted family; returns a params tree
+    with the SAME structure/dtypes as the input (usable by every existing
+    consumer).  Differentiable in (A, B)."""
+    scale = lora["alpha"] / lora["rank"]
+    layers = dict(params["layers"])
+    for t, ab in lora["adapters"].items():
+        W = layers[t]
+        delta = jnp.einsum(
+            "lir,lro->lio", ab["a"], ab["b"],
+            preferred_element_type=jnp.float32,
+        )
+        layers[t] = (W.astype(jnp.float32) + scale * delta).astype(W.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def lora_loss_fn(
+    lora: dict, params: dict, tokens: jax.Array, cfg: TransformerConfig,
+    mesh=None,
+) -> jax.Array:
+    """The FULL-fine-tune objective (train.loss_fn) evaluated on the merged
+    model — one loss recipe for both training modes, so adapters always
+    train against exactly what a full fine-tune would."""
+    from .train import loss_fn
+
+    return loss_fn(merge_lora(params, lora), tokens, cfg, mesh)
+
+
+def make_lora_train_step(
+    cfg: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+):
+    """train_step(lora, opt_state, params, tokens) → (lora, opt_state, loss).
+
+    The optimizer state tracks only the adapters — for a 7B model at r=16
+    that is ~0.1% of a full fine-tune's optimizer memory.
+    """
+
+    def step(lora, opt_state, params, tokens):
+        # differentiate the ADAPTER leAVES only — lora also carries the
+        # (non-differentiable) alpha/rank scalars
+        def loss_of(adapters):
+            return lora_loss_fn(
+                {**lora, "adapters": adapters}, params, tokens, cfg, mesh
+            )
+
+        loss, g = jax.value_and_grad(loss_of)(lora["adapters"])
+        updates, opt_state = optimizer.update(g, opt_state, lora["adapters"])
+        adapters = optax.apply_updates(lora["adapters"], updates)
+        return {**lora, "adapters": adapters}, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+    return jax.jit(
+        step,
+        in_shardings=(None, None, None, batch_sharding),
+        donate_argnums=(0, 1),
+    )

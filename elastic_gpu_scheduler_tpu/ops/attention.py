@@ -118,11 +118,8 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_s
             preferred_element_type=jnp.float32,
         ) * sm_scale  # (block_q, block_k) fp32
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(q_offset, j * block_k, block_q, block_k, causal,
-                            window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, q_offset, j * block_k, block_q,
+                                    block_k, causal, window)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
         alpha = jnp.exp(m_i - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -160,6 +157,37 @@ def _block_mask(q_offset, k_offset, block_q, block_k, causal, window):
     if window > 0:
         keep &= (q_ids - k_ids) < window
     return keep
+
+
+def _mask_boundary_only(s, q_offset, k_offset, block_q, block_k, causal,
+                        window):
+    """Apply the element mask ONLY on tiles that straddle a band boundary.
+
+    A tile fully inside the causal/window band needs no masking at all —
+    and on a (512, 512) fp32 tile the iota + compare + select chain is real
+    VPU time on every visited block.  The band-interior test is two scalar
+    compares; ``lax.cond`` keeps the masked path off the hot blocks
+    (Mosaic lowers it to a scalar branch).
+    """
+    interior = True
+    if causal:
+        # every element satisfies q_ids >= k_ids
+        interior = k_offset + block_k - 1 <= q_offset
+    if window > 0:
+        # and every element satisfies q_ids - k_ids < window
+        interior = interior & (
+            (q_offset + block_q - 1) - k_offset < window
+        )
+    if interior is True:  # statically maskless (not causal, no window)
+        return s
+
+    def masked(s):
+        return jnp.where(
+            _block_mask(q_offset, k_offset, block_q, block_k, causal, window),
+            s, NEG_INF,
+        )
+
+    return jax.lax.cond(interior, lambda s: s, masked, s)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
@@ -211,11 +239,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             preferred_element_type=jnp.float32,
         ) * sm_scale  # (block_q, block_k) fp32
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(q_offset, k_offset, block_q, block_k, causal,
-                            window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, q_offset, k_offset, block_q, block_k,
+                                    causal, window)
         m_i = m_ref[0]  # (block_q,)
         l_i = l_ref[0]
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
@@ -398,11 +423,8 @@ def _flash_bwd_dq_kernel_resident(
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(q_offset, j * block_k, block_q, block_k, causal,
-                            window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, q_offset, j * block_k, block_q,
+                                    block_k, causal, window)
         p = jnp.exp(s - lse[:, None])  # masked entries → exp(−inf) = 0
         dp = jax.lax.dot_general(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -460,11 +482,8 @@ def _flash_bwd_dkv_kernel_resident(
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(i * block_q + q_shift, k_offset, block_q, block_k,
-                            causal, window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, i * block_q + q_shift, k_offset,
+                                    block_q, block_k, causal, window)
         p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k) fp32
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk,
@@ -527,11 +546,8 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(q_offset, k_offset, block_q, block_k, causal,
-                            window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, q_offset, k_offset, block_q, block_k,
+                                    causal, window)
         p = jnp.exp(s - lse[:, None])  # masked entries → exp(−inf) = 0
         dp = jax.lax.dot_general(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -590,11 +606,8 @@ def _flash_bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal or window > 0:
-            s = jnp.where(
-                _block_mask(q_offset, k_offset, block_q, block_k, causal,
-                            window),
-                s, NEG_INF,
-            )
+            s = _mask_boundary_only(s, q_offset, k_offset, block_q, block_k,
+                                    causal, window)
         p = jnp.exp(s - lse_b[:, None])  # (block_q, block_k) fp32
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk,
@@ -862,15 +875,10 @@ def _flash_stats_kernel(
                 preferred_element_type=jnp.float32,
             ) * sm_scale
             if causal:
-                q_ids = q_offset + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
+                s = _mask_boundary_only(
+                    s, q_offset, k_offset + j * block_k, block_q, block_k,
+                    True, 0,
                 )
-                k_ids = (
-                    k_offset
-                    + j * block_k
-                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                )
-                s = jnp.where(q_ids >= k_ids, s, NEG_INF)
             m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
             alpha = jnp.exp(m_i - m_new)
             p = jnp.exp(s - m_new[:, None])
