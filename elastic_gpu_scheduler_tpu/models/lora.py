@@ -7,15 +7,19 @@ B (L, r, d_out) per target family, and the merge W + (alpha/r)·A@B is ONE
 batched einsum on the MXU per family — no per-layer Python loops, nothing
 for XLA to unroll.
 
-Training uses the MERGED functional view: each step materializes
-W' = W + scale·A@B inside the jit and runs the standard forward; autodiff
-flows through the merge so gradients land only on (A, B) — the base stays
-frozen bits (and can live in bf16 at rest).  The merge costs
-O(L·d·d·r/d) = r/d of one weight read — noise next to a train step — and
-XLA fuses it into the consuming matmuls' prologue.
+Training uses the ACTIVATION-domain view (``inject_lora`` +
+transformer._proj): each adapted matmul computes x@W + scale·(x@A)@B with
+the low-rank delta added in fp32 before the compute-dtype cast.  Autodiff
+flows through the explicit adapter branch so gradients land only on
+(A, B) — the base stays frozen bits (and can live in bf16 at rest).  The
+adapter matmuls cost r/d of one weight read — noise next to a train step.
+(A merged view W + scale·A@B would round deltas below the bf16 base's ulp
+to zero for every token — early fine-tuning would silently stall.)
 
 For serving, ``merge_lora`` bakes the adapters in once and returns plain
-params usable by every existing path (generate, serving engine, export).
+params usable by every existing path (generate, serving engine, export);
+merging quantizes the delta into the base dtype, which is fine for a
+TRAINED adapter (its effect is far above ulp) but not for training.
 
 No reference analogue (the reference schedules pods, SURVEY §2 #19); this
 fills the fine-tuning capability slot of the workload plane.
@@ -53,6 +57,11 @@ def lora_init(
         if t not in layers:
             raise ValueError(f"LoRA target {t!r} not in model layers")
         W = layers[t]
+        if isinstance(W, dict):  # quantize.py QTensor {"q8","scale"}
+            raise ValueError(
+                f"LoRA target {t!r} is int8-quantized; adapters need a "
+                "full-precision base (quantize AFTER merge_lora if serving)"
+            )
         if W.ndim != 3:
             raise ValueError(
                 f"LoRA target {t!r} must be stacked (L, d_in, d_out); "
@@ -79,6 +88,28 @@ def lora_param_count(lora: dict) -> int:
     )
 
 
+def inject_lora(params: dict, lora: dict) -> dict:
+    """Return a params tree whose layer dict carries ``<target>_lora``
+    leaves ({"a": (L, d_in, r), "b": (L, r, d_out)} with the alpha/r scale
+    pre-folded into b) — the TRAINING view.
+
+    transformer._proj applies these in the activation domain
+    (``x@W + (x@A)@B``) with the delta added in fp32 before the compute-
+    dtype cast, so adapter contributions below the base weight's ulp are
+    NOT rounded away (they would be under a bf16 merged view — the loss
+    would sit still early in fine-tuning while adapter grads stay
+    nonzero).  The extra leaves are stacked over layers like every other
+    family, so the ``lax.scan``/pipeline over layers carries them
+    unchanged.  Differentiable in (A, B)."""
+    scale = lora["alpha"] / lora["rank"]
+    layers = dict(params["layers"])
+    for t, ab in lora["adapters"].items():
+        layers[t + "_lora"] = {"a": ab["a"], "b": ab["b"] * scale}
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
 def merge_lora(params: dict, lora: dict) -> dict:
     """params + scale·A@B for every adapted family; returns a params tree
     with the SAME structure/dtypes as the input (usable by every existing
@@ -87,6 +118,11 @@ def merge_lora(params: dict, lora: dict) -> dict:
     layers = dict(params["layers"])
     for t, ab in lora["adapters"].items():
         W = layers[t]
+        if isinstance(W, dict):
+            raise ValueError(
+                f"cannot merge into int8-quantized {t!r}; merge into the "
+                "full-precision base, then quantize_params the result"
+            )
         delta = jnp.einsum(
             "lir,lro->lio", ab["a"], ab["b"],
             preferred_element_type=jnp.float32,
@@ -101,12 +137,12 @@ def lora_loss_fn(
     lora: dict, params: dict, tokens: jax.Array, cfg: TransformerConfig,
     mesh=None,
 ) -> jax.Array:
-    """The FULL-fine-tune objective (train.loss_fn) evaluated on the merged
-    model — one loss recipe for both training modes, so adapters always
-    train against exactly what a full fine-tune would."""
+    """The FULL-fine-tune objective (train.loss_fn) on the ADAPTER-INJECTED
+    model (activation-domain application; see inject_lora for why not the
+    merged view) — the same loss recipe a full fine-tune uses."""
     from .train import loss_fn
 
-    return loss_fn(merge_lora(params, lora), tokens, cfg, mesh)
+    return loss_fn(inject_lora(params, lora), tokens, cfg, mesh)
 
 
 def make_lora_train_step(

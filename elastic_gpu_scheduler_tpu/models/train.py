@@ -31,10 +31,24 @@ class MasterState(NamedTuple):
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
-    """Mean next-token CE.  logits: (B,S,V) fp32; targets: (B,S) int32."""
+    """Masked mean next-token CE.  logits: (B,S,V) fp32; targets: (B,S)
+    int32.
+
+    Target ids outside [0, V) are IGNORED: they contribute nothing and are
+    excluded from the mean's denominator — the torch ``ignore_index``
+    convention, so padding pipelines can mark positions with -100 (or any
+    out-of-range id) and get a correct loss instead of the gather
+    default's silent NaN.  The vocab-chunked path (ops/xent.py) implements
+    exactly the same semantics, so toggling ``xent_chunks`` never changes
+    the reported loss."""
+    V = logits.shape[-1]
+    valid = (targets >= 0) & (targets < V)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    gold = jnp.take_along_axis(
+        logits, jnp.clip(targets, 0, V - 1)[..., None], axis=-1
+    )[..., 0]
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(jnp.where(valid, logz - gold, 0.0)) / n_valid
 
 
 def make_optimizer(
@@ -70,8 +84,25 @@ def loss_fn(
     targets = tokens[:, 1:]
     if mesh is not None:
         inputs = shardlib.constrain(inputs, mesh, shardlib.batch_spec())
-    logits, aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
-    loss = cross_entropy_loss(logits, targets)
+    if cfg.xent_chunks > 0:
+        # vocab-chunked CE: the (B, S, V) logits tensor never materializes
+        # (ops/xent.py) — O(S·D) activations end to end for long context
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            raise ValueError(
+                "xent_chunks requires tensor=1: the unembed is V-sharded "
+                "over the tensor axis (parallel/sharding.py) and every "
+                "chunk slice would force a reshard — use the dense path"
+            )
+        from ..ops.xent import chunked_softmax_xent
+        from .quantize import wmat
+        from .transformer import hidden_with_aux
+
+        hidden, aux = hidden_with_aux(params, inputs, cfg, mesh=mesh)
+        w = wmat(params["unembed"], jnp.dtype(cfg.dtype))
+        loss = chunked_softmax_xent(hidden, w, targets, cfg.xent_chunks)
+    else:
+        logits, aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
+        loss = cross_entropy_loss(logits, targets)
     if cfg.n_experts > 0:
         loss = loss + cfg.aux_loss_weight * aux
     return loss

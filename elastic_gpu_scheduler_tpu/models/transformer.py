@@ -55,6 +55,11 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     n_microbatches: int = 0  # >0 + mesh pipe>1 → pipeline parallelism
+    # >0 → training CE is computed in this many vocab chunks and the
+    # (B, S, V) logits never materialize (ops/xent.py); inference paths
+    # (forward/generate/serving) are unaffected.  Prefer 0 when tensor > 1
+    # (the unembed is V-sharded there).
+    xent_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -221,6 +226,25 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh],
     return oT.transpose(0, 2, 1, 3)
 
 
+def _proj(h, p, name, dtype):
+    """``h @ p[name]``, plus the LoRA adapter term when the layer tree
+    carries one (``models/lora.py inject_lora`` adds ``<name>_lora``
+    leaves).
+
+    The adapter path is the ACTIVATION-domain formulation
+    ``x@W + (x@A)@B·scale`` with the delta added in fp32 BEFORE the cast
+    to compute dtype — merging the delta into a bf16 base weight instead
+    would round contributions below W's ulp (~0.4% relative) to exactly
+    zero for every token, silently stalling early fine-tuning while
+    gradients stay nonzero."""
+    ad = p.get(name + "_lora") if isinstance(p, dict) else None
+    if ad is None:
+        return h @ wmat(p[name], dtype)
+    y = jnp.dot(h, wmat(p[name], dtype), preferred_element_type=jnp.float32)
+    t = jnp.dot(jnp.dot(h.astype(jnp.float32), ad["a"]), ad["b"])
+    return (y + t).astype(dtype)
+
+
 def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh],
            seq_axis: Optional[str] = None):
     """One transformer block. x: (B, S, D).  Returns (x, aux_loss).
@@ -234,16 +258,16 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh],
 
     h = rms_norm(x, p["attn_norm"])
     Hkv = cfg.kv_heads
-    q = (h @ wmat(p["wq"], dtype)).reshape(B, S, Hn, Dh)
-    k = (h @ wmat(p["wk"], dtype)).reshape(B, S, Hkv, Dh)
-    v = (h @ wmat(p["wv"], dtype)).reshape(B, S, Hkv, Dh)
+    q = _proj(h, p, "wq", dtype).reshape(B, S, Hn, Dh)
+    k = _proj(h, p, "wk", dtype).reshape(B, S, Hkv, Dh)
+    v = _proj(h, p, "wv", dtype).reshape(B, S, Hkv, Dh)
     positions = jnp.arange(S)
     if seq_axis is not None:
         positions = positions + lax.axis_index(seq_axis) * S
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     o = _attention(q, k, v, cfg, mesh, seq_axis).reshape(B, S, Hn * Dh)
-    x = x + (o @ wmat(p["wo"], dtype))
+    x = x + _proj(o, p, "wo", dtype)
 
     h = rms_norm(x, p["mlp_norm"])
     if cfg.n_experts > 0:
@@ -255,20 +279,24 @@ def _layer(x, layer_params, cfg: TransformerConfig, mesh: Optional[Mesh],
         )
         x = x + ffn
     else:
-        gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
-        up = h @ wmat(p["w_in"], dtype)
-        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+        gate = jax.nn.silu(_proj(h, p, "w_gate", dtype))
+        up = _proj(h, p, "w_in", dtype)
+        x = x + _proj(gate * up, p, "w_out", dtype)
         aux = jnp.zeros((), jnp.float32)
     return x, aux
 
 
-def forward_with_aux(
+def hidden_with_aux(
     params: dict,
     tokens: jax.Array,
     cfg: TransformerConfig,
     mesh: Optional[Mesh] = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """tokens: (B, S) int32 → (logits (B, S, V), aux_loss scalar)."""
+    """tokens: (B, S) int32 → (final-norm hidden (B, S, D), aux scalar).
+
+    The pre-unembed trunk, split out so the chunked-CE loss path
+    (ops/xent.py) can consume hidden states without the logits ever
+    existing; ``forward_with_aux`` adds the unembed projection."""
     dtype = jnp.dtype(cfg.dtype)
     x = _embed_lookup(params["embed"], tokens, dtype)  # (B, S, D)
 
@@ -310,7 +338,18 @@ def forward_with_aux(
         x, aux = lax.scan(scan_body, x, params["layers"])
         aux_total = jnp.sum(aux)
     x = rms_norm(x, params["final_norm"])
-    logits = x @ wmat(params["unembed"], dtype)
+    return x, aux_total
+
+
+def forward_with_aux(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """tokens: (B, S) int32 → (logits (B, S, V), aux_loss scalar)."""
+    x, aux_total = hidden_with_aux(params, tokens, cfg, mesh)
+    logits = x @ wmat(params["unembed"], jnp.dtype(cfg.dtype))
     return logits.astype(jnp.float32), aux_total
 
 

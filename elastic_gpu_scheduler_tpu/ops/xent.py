@@ -1,0 +1,167 @@
+"""Vocab-chunked softmax cross-entropy: the (B·S, V) logits tensor never
+materializes.
+
+Motivation (TPU memory, not FLOPs): for the bench flagship (B=8, S=2048,
+V=32000) the fp32 logits buffer is 2.1 GB — the single largest activation
+in the train step — and at long context (S=32k) it simply does not fit.
+The streamed flash kernels (ops/attention.py) already remove the O(S²)
+attention buffer; this op removes the O(S·V) loss buffer, so end-to-end
+long-context training is bounded by O(S·D) activations only.
+
+Design (one ``lax.scan`` over vocab chunks, everything MXU-shaped):
+
+- forward: for each chunk c of C columns, logits_c = x @ W[:, c] in bf16
+  with fp32 accumulation, folded into an ONLINE logsumexp (running max m
+  and scaled sum s — the flash-attention recipe applied to the vocab axis)
+  plus the gold logit picked up when the target id lands in the chunk.
+- backward: recompute logits_c per chunk (2·N·D·C bf16 FLOPs — the price
+  of not saving them), form d_logits_c = (softmax_c − onehot_c)·ḡ/N in
+  fp32, cast to bf16, and contract immediately: dx += d_logits_c @ W_cᵀ
+  (fp32 carry), dW_c = xᵀ @ d_logits_c (each chunk owns its columns, so
+  dW needs no cross-chunk accumulation).  Peak extra memory is one
+  (N, C) chunk.
+
+The custom VJP exists because autodiff of the scanned forward would save
+every chunk's logits as residuals — exactly the buffer this op deletes.
+Residuals here: x, W, targets (+ their validity mask), and the (N,)
+logsumexp.
+
+Targets outside [0, V) are ignored (torch ``ignore_index`` convention):
+zero loss contribution, zero gradient, excluded from the mean's
+denominator — same semantics as the dense path.
+
+Numerics: identical reduction tree to the dense path up to fp32 rounding
+(both accumulate in fp32); grads match the dense reference to bf16
+tolerance (tests/test_xent.py).
+
+Sharding note: under a mesh this composes with data/fsdp/seq-sharded x
+(chunking is over V, which those leave whole).  With a tensor-sharded
+unembed (parallel/sharding.py: (fsdp, tensor)) every chunk slice forces a
+reshard — prefer the dense path when tensor > 1.
+
+No reference analogue (the reference is a scheduler, SURVEY §2 #19); this
+is standard equipment for long-context training frameworks (same role as
+fused/linear-CE kernels in GPU stacks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_w(w: jax.Array, n_chunks: int) -> jax.Array:
+    """(D, V) → (n_chunks, D, C) scan xs."""
+    D, V = w.shape
+    if n_chunks <= 0 or V % n_chunks:
+        raise ValueError(f"vocab {V} not divisible by n_chunks {n_chunks}")
+    C = V // n_chunks
+    return w.reshape(D, n_chunks, C).transpose(1, 0, 2)
+
+
+def _fwd_scan(x2d, w, targets, n_chunks):
+    """Online logsumexp + gold-logit pickup over vocab chunks.
+
+    Returns (logz (N,) f32, gold (N,) f32)."""
+    N = x2d.shape[0]
+    V = w.shape[1]
+    C = V // n_chunks
+    wc = _chunk_w(w, n_chunks)
+
+    def body(carry, inp):
+        m, s, gold = carry
+        w_c, idx = inp
+        logits = jnp.dot(
+            x2d, w_c, preferred_element_type=jnp.float32
+        )  # (N, C) f32
+        m_c = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        local = targets - idx * C
+        in_chunk = (local >= 0) & (local < C)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, C - 1)[:, None], axis=-1
+        )[:, 0]
+        gold = gold + jnp.where(in_chunk, picked, 0.0)
+        return (m_new, s, gold), None
+
+    init = (
+        jnp.full((N,), -jnp.inf, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+    )
+    (m, s, gold), _ = lax.scan(body, init, (wc, jnp.arange(n_chunks)))
+    return m + jnp.log(s), gold
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(
+    x: jax.Array, w: jax.Array, targets: jax.Array, n_chunks: int
+) -> jax.Array:
+    """Mean next-token CE of ``(x @ w, targets)`` without materializing
+    the logits.
+
+    x: (..., D) hidden states (bf16 or f32); w: (D, V) unembedding;
+    targets: (...) int32.  V must divide evenly by ``n_chunks``.
+    """
+    return _xent_fwd(x, w, targets, n_chunks)[0]
+
+
+def _xent_fwd(x, w, targets, n_chunks):
+    x2d = x.reshape(-1, x.shape[-1])
+    # ids outside [0, V) are IGNORED (masked out of sum and denominator) —
+    # the torch ignore_index convention, identical to the dense path
+    # (models/train.py cross_entropy_loss), so the two loss modes agree on
+    # ANY input, not just well-formed ones
+    V = w.shape[1]
+    t_raw = targets.reshape(-1)
+    valid = (t_raw >= 0) & (t_raw < V)
+    t = jnp.clip(t_raw, 0, V - 1)
+    logz, gold = _fwd_scan(x2d, w, t, n_chunks)
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    loss = jnp.sum(jnp.where(valid, logz - gold, 0.0)) / n_valid
+    return loss, (x, w, t, valid, logz)
+
+
+def _xent_bwd(n_chunks, res, g):
+    x, w, t, valid, logz = res
+    x2d = x.reshape(-1, x.shape[-1])
+    N, D = x2d.shape
+    V = w.shape[1]
+    C = V // n_chunks
+    wc = _chunk_w(w, n_chunks)
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    # per-token cotangent: masked positions get exactly zero gradient
+    scale = (g / n_valid) * valid.astype(jnp.float32)  # (N,)
+
+    def body(dx_acc, inp):
+        w_c, idx = inp
+        logits = jnp.dot(x2d, w_c, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - logz[:, None])  # softmax columns of this chunk
+        local = t - idx * C
+        in_chunk = (local >= 0) & (local < C)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(local, 0, C - 1), C, dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        d_logits = ((p - onehot) * scale[:, None]).astype(x2d.dtype)  # (N, C)
+        dx_acc = dx_acc + jnp.dot(
+            d_logits, w_c.T, preferred_element_type=jnp.float32
+        )
+        dw_c = jnp.dot(x2d.T, d_logits, preferred_element_type=jnp.float32)
+        return dx_acc, dw_c.astype(w.dtype)
+
+    dx2d, dwc = lax.scan(
+        body, jnp.zeros((N, D), jnp.float32), (wc, jnp.arange(n_chunks))
+    )
+    dw = dwc.transpose(1, 0, 2).reshape(D, V)
+    dx = dx2d.astype(x.dtype).reshape(x.shape)
+    return dx, dw, None
+
+
+chunked_softmax_xent.defvjp(_xent_fwd, _xent_bwd)
