@@ -25,6 +25,14 @@ A slot whose next chunk cannot get pages simply *stalls* (stays inactive,
 state intact) until completions free pages; if every slot is stalled the
 pool is genuinely exhausted and the engine raises.
 
+**Multi-LoRA serving**: the engine can hold a bank of named LoRA adapters
+(``adapters=`` at construction; ``Request.adapter`` selects one, "" = base).
+Adapters are stacked into per-family gather banks (``build_lora_bank``) and
+every projection adds the slot's own low-rank delta inside the SAME fused
+step — requests using different adapters batch together, nothing splits or
+recompiles per adapter.  Prefix-cache keys are seeded with the adapter id,
+since cached K/V depends on the wk/wv deltas.
+
 No reference analogue (SURVEY §2 #19); this is the inference-serving
 capability slot of a complete framework.
 """
@@ -122,9 +130,60 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0  # 0 → disabled; per-request (see models/sampling.py)
     top_p: float = 1.0  # >= 1 → disabled
+    adapter: str = ""  # "" → base model; else a name registered at init
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
     error: str = ""  # set (with done) when the request is rejected
+
+
+def build_lora_bank(
+    adapters: dict[str, dict], dtype
+) -> tuple[dict, dict[str, int]]:
+    """Stack named LoRA adapters (models/lora.py ``lora_init`` trees) into
+    a per-family gatherable bank for multi-LoRA serving:
+
+        {family: {"a": (L, n_ids, d_in, r_max), "b": (L, n_ids, r_max,
+        d_out)}}
+
+    id 0 is the all-zero adapter (base model; "" requests), ids 1.. follow
+    the dict order.  Ranks are zero-padded to the max (exact: padded rank
+    dims contribute nothing) and the alpha/rank scale is folded into b,
+    mirroring lora.inject_lora.  Returns (bank, name → id)."""
+    index = {"": 0}
+    targets: dict[str, tuple] = {}
+    for name, lo in adapters.items():
+        if name == "" or name in index:
+            raise ValueError(f"bad/duplicate adapter name {name!r}")
+        index[name] = len(index)
+        for t, ab in lo["adapters"].items():
+            L, d_in, r = ab["a"].shape
+            d_out = ab["b"].shape[-1]
+            prev = targets.get(t)
+            if prev is not None and prev[:3] != (L, d_in, d_out):
+                raise ValueError(
+                    f"adapter {name!r} target {t!r} has dims "
+                    f"(L={L}, d_in={d_in}, d_out={d_out}) but another "
+                    f"adapter uses (L={prev[0]}, d_in={prev[1]}, "
+                    f"d_out={prev[2]}) — all adapters must share one base"
+                )
+            targets[t] = (L, d_in, d_out, max(r, prev[3] if prev else 0))
+    n = len(index)
+    bank: dict = {}
+    for t, (L, d_in, d_out, rmax) in targets.items():
+        a = np.zeros((L, n, d_in, rmax), np.float32)
+        b = np.zeros((L, n, rmax, d_out), np.float32)
+        for name, lo in adapters.items():
+            ab = lo["adapters"].get(t)
+            if ab is None:
+                continue
+            r = ab["a"].shape[-1]
+            scale = lo["alpha"] / lo["rank"]
+            a[:, index[name], :, :r] = np.asarray(ab["a"], np.float32)
+            b[:, index[name], :r, :] = np.asarray(ab["b"], np.float32) * scale
+        bank[t] = {
+            "a": jnp.asarray(a, dtype), "b": jnp.asarray(b, dtype)
+        }
+    return bank, index
 
 
 def _rope_rows(x, positions, theta):
@@ -132,18 +191,43 @@ def _rope_rows(x, positions, theta):
     return jax.vmap(lambda xb, pb: rope(xb[None], pb, theta)[0])(x, positions)
 
 
-def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype):
+def _sproj(x, p, name, dtype, ad, aids):
+    """``x @ p[name]`` plus the PER-SLOT LoRA delta when the layer's bank
+    slice carries this family (multi-LoRA serving: every slot applies its
+    own request's adapter inside ONE fused step — the bank is gathered by
+    adapter id, so the batch never splits by adapter).
+
+    x: (B, T, d); ad[name] = {"a": (n_adapters, d, r), "b": (n_adapters,
+    r, o)} with id 0 the all-zero base adapter; aids: (B,) int32."""
+    y = x @ wmat(p[name], dtype)
+    if ad and name in ad:
+        a = ad[name]["a"][aids]  # (B, d, r)
+        b = ad[name]["b"][aids]  # (B, r, o)
+        t = jnp.einsum(
+            "btd,bdr->btr", x, a, preferred_element_type=jnp.float32
+        )
+        y = y + jnp.einsum(
+            "btr,bro->bto", t, b, preferred_element_type=jnp.float32
+        ).astype(y.dtype)
+    return y
+
+
+def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
+                 ad=None, aids=None):
     """ONE transformer layer shared by every paged path (decode step,
     plain prefill, prefixed prefill) — the paths differ only in position
     arithmetic and the attention geometry, which arrive as ``positions``
     (B,T) / scatter targets (B·T,) / ``attn(q, k, v, lkv)`` → (B,T,Hn·Dh).
+
+    ``ad``/``aids``: this layer's multi-LoRA bank slice + per-row adapter
+    ids (empty dict / None → exactly the plain computation).
     """
     B, T, _ = x.shape
     Hn, Dh, Hkv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     h = rms_norm(x, p["attn_norm"])
-    q = (h @ wmat(p["wq"], dtype)).reshape(B, T, Hn, Dh)
-    k = (h @ wmat(p["wk"], dtype)).reshape(B, T, Hkv, Dh)
-    v = (h @ wmat(p["wv"], dtype)).reshape(B, T, Hkv, Dh)
+    q = _sproj(h, p, "wq", dtype, ad, aids).reshape(B, T, Hn, Dh)
+    k = _sproj(h, p, "wk", dtype, ad, aids).reshape(B, T, Hkv, Dh)
+    v = _sproj(h, p, "wv", dtype, ad, aids).reshape(B, T, Hkv, Dh)
     q = _rope_rows(q, positions, cfg.rope_theta)
     k = _rope_rows(k, positions, cfg.rope_theta)
     # scatter the new rows (inactive/padding rows target the scratch page —
@@ -152,21 +236,23 @@ def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype):
         lkv, pidx, off, k.reshape(B * T, Hkv, Dh), v.reshape(B * T, Hkv, Dh)
     )
     o = attn(q, k, v, lkv)
-    x = x + (o @ wmat(p["wo"], dtype))
+    x = x + _sproj(o, p, "wo", dtype, ad, aids)
     h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(h @ wmat(p["w_gate"], dtype))
-    up = h @ wmat(p["w_in"], dtype)
-    x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+    gate = jax.nn.silu(_sproj(h, p, "w_gate", dtype, ad, aids))
+    up = _sproj(h, p, "w_in", dtype, ad, aids)
+    x = x + _sproj(gate * up, p, "w_out", dtype, ad, aids)
     return x, lkv
 
 
-def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size):
+def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
+                       bank=None, aids=None):
     """One decode step for every slot at its own position, against the page
     pool.
 
     tokens: (B,) int32; kv: pool dict (make_kv_pool); tables:
-    (B, max_pages) int32 page ids; lengths: (B,) int32 write positions.
-    Returns (logits (B, V), new kv).
+    (B, max_pages) int32 page ids; lengths: (B,) int32 write positions;
+    bank/aids: multi-LoRA adapter bank (leaves stacked over layers) +
+    per-slot adapter ids.  Returns (logits (B, V), new kv).
     """
     dtype = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
@@ -186,18 +272,22 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size):
         ).reshape(B, 1, Hn * Dh)
 
     def layer_step(x, scanned):
-        p, lkv = scanned  # lkv: this layer's pool slice
+        p, lkv, ad = scanned  # this layer's pool + bank slices
         return _paged_layer(
-            x, p, lkv, lengths[:, None], page_idx, offset, attn, cfg, dtype
+            x, p, lkv, lengths[:, None], page_idx, offset, attn, cfg, dtype,
+            ad, aids,
         )
 
-    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["layers"], kv, bank or {})
+    )
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[:, 0, :]
     return logits.astype(jnp.float32), new_kv
 
 
-def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
+def _paged_prefill(params, tokens, kv, pages, t_real, bank=None, aid=None,
+                   *, cfg, page_size):
     """One-pass prompt ingestion for ONE slot (the paged analogue of
     ``generate.forward_cached`` with an empty prefix): self-attention over
     the whole prompt block, K/V scattered into the slot's pages.
@@ -236,12 +326,15 @@ def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
         ).transpose(0, 2, 1, 3).reshape(1, Tpad, Hn * Dh)
 
     def layer_step(x, scanned):
-        p, lkv = scanned  # this layer's pool slice
+        p, lkv, ad = scanned  # this layer's pool + bank slices
         return _paged_layer(
-            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype
+            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype,
+            ad, None if aid is None else aid[None],
         )
 
-    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["layers"], kv, bank or {})
+    )
     x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[0, 0]  # (V,)
@@ -249,7 +342,8 @@ def _paged_prefill(params, tokens, kv, pages, t_real, *, cfg, page_size):
 
 
 def _paged_prefill_prefixed(
-    params, tokens, kv, pages, t0, t_real, *, cfg, page_size
+    params, tokens, kv, pages, t0, t_real, bank=None, aid=None,
+    *, cfg, page_size
 ):
     """One-pass prompt ingestion BEHIND a shared cached prefix.
 
@@ -280,12 +374,15 @@ def _paged_prefill_prefixed(
         ).reshape(1, Tpad, Hn * Dh)
 
     def layer_step(x, scanned):
-        p, lkv = scanned
+        p, lkv, ad = scanned
         return _paged_layer(
-            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype
+            x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype,
+            ad, None if aid is None else aid[None],
         )
 
-    x, new_kv = jax.lax.scan(layer_step, x, (params["layers"], kv))
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["layers"], kv, bank or {})
+    )
     x = jax.lax.dynamic_slice_in_dim(x, t_real - 1, 1, axis=1)  # (1,1,D)
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype))[0, 0]  # (V,)
@@ -295,6 +392,7 @@ def _paged_prefill_prefixed(
 def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
+    bank=None, aids=None,
     *, cfg, page_size, n_steps, use_filters,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
@@ -314,7 +412,7 @@ def _fused_serve_chunk(
     def body(carry, _):
         tokens, lengths, key, kv = carry
         logits, kv = _paged_decode_step(
-            params, tokens, kv, tables, lengths, cfg, page_size
+            params, tokens, kv, tables, lengths, cfg, page_size, bank, aids
         )
         key, sub = jax.random.split(key)
         if use_filters:
@@ -353,6 +451,7 @@ class InferenceEngine:
         fused_steps: int = 8,
         kv_int8: bool = False,
         prefix_cache: bool = False,
+        adapters: Optional[dict[str, dict]] = None,
     ):
         assert cfg.n_experts == 0, "serving engine supports dense models"
         self.params = params
@@ -380,6 +479,14 @@ class InferenceEngine:
         self.temps = np.zeros(max_batch, np.float32)
         self.top_ks = np.zeros(max_batch, np.int32)
         self.top_ps = np.ones(max_batch, np.float32)
+        # multi-LoRA: stacked adapter bank + per-slot adapter ids (0 = base)
+        if adapters:
+            self.lora_bank, self.adapter_index = build_lora_bank(
+                adapters, jnp.dtype(cfg.dtype)
+            )
+        else:
+            self.lora_bank, self.adapter_index = {}, {"": 0}
+        self.adapter_ids = np.zeros(max_batch, np.int32)
         self.next_token = np.zeros(max_batch, np.int32)
         self.emitted = np.zeros(max_batch, np.int32)
         self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
@@ -441,6 +548,13 @@ class InferenceEngine:
             )
             req.done.set()
             return req
+        if req.adapter not in self.adapter_index:
+            req.error = (
+                f"unknown adapter {req.adapter!r} "
+                f"(registered: {sorted(self.adapter_index)})"
+            )
+            req.done.set()
+            return req
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
@@ -475,6 +589,7 @@ class InferenceEngine:
             self.temps[i] = req.temperature
             self.top_ks[i] = req.top_k
             self.top_ps[i] = req.top_p
+            self.adapter_ids[i] = self.adapter_index[req.adapter]
             self.emitted[i] = 0
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
@@ -491,7 +606,10 @@ class InferenceEngine:
         the model to produce the first logits).  Returns tokens matched."""
         ps = self.page_size
         plen = len(req.prompt)
-        key = ()
+        # K/V content depends on the adapter (wk/wv deltas): pages cached
+        # under one adapter must never match a request using another, so
+        # the hash chain is seeded with the adapter id
+        key = ("lora", int(self.adapter_ids[i]))
         matched_pages = 0
         for j in range(self.max_pages_per_slot):
             end = (j + 1) * ps
@@ -520,7 +638,7 @@ class InferenceEngine:
         freed normally."""
         ps = self.page_size
         plen = len(req.prompt)
-        key = ()
+        key = ("lora", int(self.adapter_ids[i]))  # same seed as _match_prefix
         for j, pg in enumerate(self.slot_pages[i]):
             end = (j + 1) * ps
             if end > plen:
@@ -563,6 +681,7 @@ class InferenceEngine:
         row = jnp.asarray(self.tables[i, :pbucket])
         toks = np.zeros((1, tpad), np.int32)
         toks[0, :rem] = req.prompt[t0:]
+        aid = jnp.asarray(self.adapter_ids[i], jnp.int32)
         if t0 == 0:
             logits, self.kv = self._prefill(
                 self.params,
@@ -570,6 +689,8 @@ class InferenceEngine:
                 self.kv,
                 row,
                 jnp.asarray(rem, jnp.int32),
+                self.lora_bank,
+                aid,
             )
         else:
             logits, self.kv = self._prefill_prefixed(
@@ -579,6 +700,8 @@ class InferenceEngine:
                 row,
                 jnp.asarray(t0, jnp.int32),
                 jnp.asarray(rem, jnp.int32),
+                self.lora_bank,
+                aid,
             )
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
@@ -702,6 +825,8 @@ class InferenceEngine:
             jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps),
             sub,
+            self.lora_bank,
+            jnp.asarray(self.adapter_ids),
         )
         sampled = np.asarray(sampled)  # (B, K)
         for i, req in enumerate(self.slots):
