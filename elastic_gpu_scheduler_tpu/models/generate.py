@@ -316,12 +316,16 @@ def generate(
     key: Optional[jax.Array] = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: Optional[int] = None,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation; returns (B, S+new).
 
     Decode is FUSED: all ``max_new_tokens`` steps run in one jitted
     ``decode_loop`` scan — one device dispatch for the whole generation
-    phase rather than one per token."""
+    phase rather than one per token.  With ``eos_id`` set, every position
+    after a row's first EOS is overwritten WITH ``eos_id`` (fixed-shape
+    padding — the fused scan still runs all steps; per-row early exit is
+    the serving engine's job, models/serving.py stop_tokens)."""
     B, S = prompt.shape
     max_len = max_len or S + max_new_tokens
     cache = KVCache.empty(cfg, B, max_len)
@@ -336,4 +340,8 @@ def generate(
         )
     )
     tokens, _, _ = loop_fn(params, logits, cache, key=key)
+    if eos_id is not None:
+        seen = jnp.cumsum((tokens == eos_id).astype(jnp.int32), axis=1)
+        after_eos = (seen - (tokens == eos_id).astype(jnp.int32)) > 0
+        tokens = jnp.where(after_eos, eos_id, tokens)
     return jnp.concatenate([prompt, tokens], axis=1)

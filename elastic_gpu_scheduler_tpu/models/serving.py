@@ -40,6 +40,7 @@ capability slot of a complete framework.
 from __future__ import annotations
 
 import functools
+import logging
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -52,6 +53,8 @@ import numpy as np
 from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
+
+log = logging.getLogger("tpu-scheduler")
 
 SCRATCH_PAGE = 0  # reserved; inactive slots write here, nobody reads it
 
@@ -131,13 +134,19 @@ class Request:
     top_k: int = 0  # 0 → disabled; per-request (see models/sampling.py)
     top_p: float = 1.0  # >= 1 → disabled
     adapter: str = ""  # "" → base model; else a name registered at init
+    # generation stops when any of these ids is emitted (the stop token IS
+    # included in output, HF-style); () → run to max_new_tokens
+    stop_tokens: tuple = ()
+    # streaming: called from the engine thread with each emitted token id,
+    # in order, before done is signaled
+    on_token: Optional[object] = None
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
     error: str = ""  # set (with done) when the request is rejected
 
 
 def build_lora_bank(
-    adapters: dict[str, dict], dtype
+    adapters: dict[str, dict], dtype, base_layers: Optional[dict] = None
 ) -> tuple[dict, dict[str, int]]:
     """Stack named LoRA adapters (models/lora.py ``lora_init`` trees) into
     a per-family gatherable bank for multi-LoRA serving:
@@ -148,7 +157,16 @@ def build_lora_bank(
     id 0 is the all-zero adapter (base model; "" requests), ids 1.. follow
     the dict order.  Ranks are zero-padded to the max (exact: padded rank
     dims contribute nothing) and the alpha/rank scale is folded into b,
-    mirroring lora.inject_lora.  Returns (bank, name → id)."""
+    mirroring lora.inject_lora.  ``base_layers`` (the model's layer tree)
+    enables shape validation at build time — an adapter trained against a
+    different base fails HERE with a named error instead of deep inside
+    the jitted serve chunk.  Returns (bank, name → id)."""
+
+    def _base_shape(t):
+        W = base_layers.get(t)
+        if W is None:
+            raise ValueError(f"adapter target {t!r} not in model layers")
+        return W["q8"].shape if isinstance(W, dict) else W.shape
     index = {"": 0}
     targets: dict[str, tuple] = {}
     for name, lo in adapters.items():
@@ -158,6 +176,13 @@ def build_lora_bank(
         for t, ab in lo["adapters"].items():
             L, d_in, r = ab["a"].shape
             d_out = ab["b"].shape[-1]
+            if base_layers is not None and _base_shape(t) != (L, d_in, d_out):
+                raise ValueError(
+                    f"adapter {name!r} target {t!r} has dims "
+                    f"(L={L}, d_in={d_in}, d_out={d_out}) but the model's "
+                    f"{t!r} is {tuple(_base_shape(t))} — this adapter was "
+                    "trained against a different base"
+                )
             prev = targets.get(t)
             if prev is not None and prev[:3] != (L, d_in, d_out):
                 raise ValueError(
@@ -482,7 +507,7 @@ class InferenceEngine:
         # multi-LoRA: stacked adapter bank + per-slot adapter ids (0 = base)
         if adapters:
             self.lora_bank, self.adapter_index = build_lora_bank(
-                adapters, jnp.dtype(cfg.dtype)
+                adapters, jnp.dtype(cfg.dtype), base_layers=params["layers"]
             )
         else:
             self.lora_bank, self.adapter_index = {}, {"": 0}
@@ -573,6 +598,24 @@ class InferenceEngine:
         raise RuntimeError("run_until_idle: step budget exhausted")
 
     # -- engine internals ----------------------------------------------------
+
+    @staticmethod
+    def _emit(req: Request, tok: int) -> None:
+        """Deliver one streamed token.  A raising user callback must never
+        unwind into the engine loop — the donated KV pool has already
+        advanced when emissions run, so an escaping exception would leave
+        lengths/next_token stale and corrupt every other in-flight slot.
+        Policy: log, disable THAT request's streaming, keep generating."""
+        req.output.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:
+                log.warning(
+                    "on_token callback raised; streaming disabled for this "
+                    "request", exc_info=True,
+                )
+                req.on_token = None
 
     def _admit(self) -> None:
         for i in range(self.max_batch):
@@ -717,11 +760,11 @@ class InferenceEngine:
             )
         else:
             tok = int(jnp.argmax(logits))
-        req.output.append(tok)
+        self._emit(req, tok)
         self.emitted[i] = 1
         self.lengths[i] = plen
         self.next_token[i] = tok
-        if self.emitted[i] >= req.max_new_tokens:
+        if tok in req.stop_tokens or self.emitted[i] >= req.max_new_tokens:
             req.done.set()
             self._release_slot(i)
 
@@ -834,18 +877,25 @@ class InferenceEngine:
                 continue
             pos = int(self.lengths[i])
             plen = int(self.prompt_lens[i])
+            stopped = False
             for s in range(K):
                 # step s sampled from logits at position pos+s; that is a
                 # real emission iff it is at or past the last prompt token
                 if pos + s >= plen - 1 and self.emitted[i] < req.max_new_tokens:
-                    req.output.append(int(sampled[i, s]))
+                    tok = int(sampled[i, s])
+                    self._emit(req, tok)
                     self.emitted[i] += 1
+                    if tok in req.stop_tokens:
+                        # stop token emitted (and kept, HF-style); tokens
+                        # the device sampled past it this chunk are dropped
+                        stopped = True
+                        break
             self.lengths[i] = pos + K
             self.next_token[i] = (
                 self.prompts[i, self.lengths[i]]
                 if self.lengths[i] < plen
                 else sampled[i, K - 1]
             )
-            if self.emitted[i] >= req.max_new_tokens:
+            if stopped or self.emitted[i] >= req.max_new_tokens:
                 req.done.set()
                 self._release_slot(i)

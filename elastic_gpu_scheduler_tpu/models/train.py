@@ -57,9 +57,14 @@ def make_optimizer(
     warmup_steps: int = 0,
     total_steps: int = 0,
     grad_clip: float = 0.0,
+    mu_dtype: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """AdamW with optional linear-warmup + cosine decay and global-norm clip
-    (the standard LM pretraining recipe)."""
+    (the standard LM pretraining recipe).
+
+    ``mu_dtype="bfloat16"`` stores the FIRST moment in bf16 — the common
+    large-run memory/bandwidth trim (m is smooth, so bf16 is safe; the
+    second moment v stays fp32 because rsqrt amplifies its error)."""
     if warmup_steps > 0 and total_steps > warmup_steps:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
@@ -70,7 +75,10 @@ def make_optimizer(
         )
     else:
         schedule = lr
-    tx = optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    tx = optax.adamw(
+        schedule, b1=0.9, b2=0.95, weight_decay=weight_decay,
+        mu_dtype=jnp.dtype(mu_dtype) if mu_dtype else None,
+    )
     if grad_clip > 0:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     return tx

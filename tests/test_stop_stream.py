@@ -1,0 +1,125 @@
+"""Stop tokens (EOS) and streaming callbacks (models/serving.py Request,
+models/generate.py eos_id)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elastic_gpu_scheduler_tpu.models.generate import generate
+from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64, dtype="float32"
+)
+
+
+def _engine(**kw):
+    params = init_params(jax.random.key(0), CFG)
+    return InferenceEngine(params, CFG, max_batch=2, max_len=64, page_size=8,
+                           **kw)
+
+
+def _greedy(eng, prompt, n=12, **kw):
+    r = Request(prompt=list(prompt), max_new_tokens=n, **kw)
+    eng.submit(r)
+    eng.run_until_idle()
+    assert not r.error, r.error
+    return r
+
+
+def test_stop_token_truncates_mid_chunk():
+    prompt = [3, 9, 14, 27, 5]
+    full = _greedy(_engine(), prompt).output
+    # pick a token first emitted somewhere in the middle of the stream
+    stop = full[5]
+    first = full.index(stop)
+    got = _greedy(_engine(), prompt, stop_tokens=(stop,)).output
+    # everything up to and INCLUDING the first stop occurrence (HF-style)
+    assert got == full[: first + 1]
+    assert got[-1] == stop
+
+
+def test_stop_token_at_prefill_first_token():
+    prompt = [3, 9, 14, 27, 5]
+    full = _greedy(_engine(), prompt).output
+    got = _greedy(_engine(), prompt, stop_tokens=(full[0],)).output
+    assert got == full[:1]
+
+
+def test_stream_callback_sees_every_token_in_order():
+    prompt = [2, 4, 6]
+    seen: list[int] = []
+    r = _greedy(_engine(), prompt, on_token=seen.append)
+    assert seen == r.output and len(seen) == 12
+
+
+def test_stream_with_stop_never_passes_the_stop():
+    prompt = [3, 9, 14, 27, 5]
+    full = _greedy(_engine(), prompt).output
+    stop = full[5]
+    seen: list[int] = []
+    r = _greedy(_engine(), prompt, stop_tokens=(stop,), on_token=seen.append)
+    assert seen == r.output
+    assert seen.count(stop) == 1 and seen[-1] == stop
+
+
+def test_raising_callback_does_not_corrupt_engine():
+    """A broken on_token callback must not unwind into the engine loop:
+    its own request keeps generating (streaming disabled), and a
+    CONCURRENT request's output is untouched."""
+    eng = _engine()
+    full_a = _greedy(_engine(), [3, 9, 14, 27, 5]).output
+    full_b = _greedy(_engine(), [2, 4, 6]).output
+
+    calls = []
+
+    def boom(tok):
+        calls.append(tok)
+        raise RuntimeError("client went away")
+
+    ra = Request(prompt=[3, 9, 14, 27, 5], max_new_tokens=12, on_token=boom)
+    rb = Request(prompt=[2, 4, 6], max_new_tokens=12)
+    eng.submit(ra)
+    eng.submit(rb)
+    eng.run_until_idle()
+    assert not ra.error and not rb.error
+    assert ra.output == full_a
+    assert rb.output == full_b
+    assert len(calls) == 1  # disabled after the first raise
+
+
+def test_bank_rejects_adapter_from_other_base():
+    from elastic_gpu_scheduler_tpu.models.lora import lora_init
+
+    other = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), CFG)
+    alien = lora_init(
+        jax.random.key(1), init_params(jax.random.key(2), other), rank=4,
+        targets=("wq",),
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="different base"):
+        InferenceEngine(params, CFG, max_batch=1, max_len=32, page_size=8,
+                        adapters={"alien": alien})
+
+
+def test_generate_eos_masks_tail():
+    params = init_params(jax.random.key(0), CFG)
+    prompt = jnp.asarray([[3, 9, 14, 27]], jnp.int32)
+    out = np.asarray(generate(params, prompt, CFG, max_new_tokens=10))[0, 4:]
+    eos = int(out[4])
+    masked = np.asarray(
+        generate(params, prompt, CFG, max_new_tokens=10, eos_id=eos)
+    )[0, 4:]
+    first = list(out).index(eos)
+    # identical up to and including the first EOS, padding after
+    assert list(masked[: first + 1]) == list(out[: first + 1])
+    assert all(t == eos for t in masked[first + 1 :])

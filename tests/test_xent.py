@@ -210,6 +210,29 @@ def test_chunked_trains_on_mesh():
     assert jnp.isfinite(float(loss))
 
 
+def test_bf16_first_moment_trains():
+    """mu_dtype=bfloat16 stores adam's first moment in bf16 (half the m
+    bandwidth) and still converges."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        dtype="float32",
+    )
+    opt = make_optimizer(lr=1e-2, mu_dtype="bfloat16")
+    params, opt_state = init_sharded_state(jax.random.key(0), cfg, opt)
+    mus = [
+        x for x in jax.tree.leaves(opt_state)
+        if hasattr(x, "dtype") and x.dtype == jnp.bfloat16
+    ]
+    assert mus, "no bf16 moment buffers found in the optimizer state"
+    step = make_jitted_train_step(cfg, opt)
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, 128)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
 def test_chunked_rejects_bad_chunking():
     x = jnp.zeros((4, 8))
     w = jnp.zeros((8, 30))
