@@ -1,0 +1,144 @@
+"""``python -m elastic_gpu_scheduler_tpu.serve`` — stand up the inference
+HTTP server around the paged serving engine.
+
+Model sources, in precedence order:
+- ``--hf DIR``: a HuggingFace Llama/Mistral checkpoint directory
+  (models/convert.py import path, GQA/sliding-window aware);
+- ``--init``: random weights from the --d-model/--n-layers/... flags
+  (smoke tests, benchmarking);
+one of the two is required.  ``--int8`` quantizes whichever base loaded.
+
+This is the workload-plane sibling of the extender CLI (cli.py): the
+scheduler places and binds the pod, the launcher builds the mesh for
+training jobs, and THIS entry serves a model over HTTP
+(server/inference.py: /v1/completions incl. SSE streaming, /v1/stats,
+/healthz).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+log = logging.getLogger("tpu-scheduler")
+
+
+def build_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="0.0.0.0")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--hf", default="", help="HF checkpoint dir to import")
+    src.add_argument("--init", action="store_true",
+                     help="random init from the model flags")
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=1376)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 quantization after load")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--n-pages", type=int, default=0,
+                   help="KV pool pages (0 = slot-contiguous equivalent)")
+    p.add_argument("--fused-steps", type=int, default=16)
+    p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend in-process (overrides a "
+                        "sticky JAX_PLATFORMS from site config; tests/dev)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = build_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    import os
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from .models.serving import InferenceEngine
+    from .models.transformer import TransformerConfig, init_params
+    from .server.inference import serve_inference
+
+    if args.hf:
+        from .models.convert import config_from_hf_llama, params_from_hf_llama
+
+        import json as _json
+        import pathlib
+
+        hf_dir = pathlib.Path(args.hf)
+        hf_cfg = _json.loads((hf_dir / "config.json").read_text())
+        cfg = config_from_hf_llama(hf_cfg)
+        sd = {}
+        # prefer safetensors when present (HF hub dirs often carry BOTH
+        # formats — loading both would double-read every tensor); in the
+        # .bin case load only weight shards, never e.g. training_args.bin
+        st_files = sorted(hf_dir.glob("*.safetensors"))
+        if st_files:
+            from safetensors.torch import load_file
+
+            for f in st_files:
+                sd.update(load_file(f))
+        else:
+            import torch
+
+            for f in sorted(hf_dir.glob("pytorch_model*.bin")):
+                sd.update(torch.load(f, map_location="cpu"))
+        if not sd:
+            raise SystemExit(f"no weight files found under {hf_dir}")
+        params = params_from_hf_llama(sd, cfg)
+    else:
+        cfg = TransformerConfig(
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
+            dtype=args.dtype,
+        )
+        params = init_params(jax.random.key(0), cfg)
+    if args.int8:
+        from .models.quantize import quantize_params
+
+        params = quantize_params(params)
+
+    engine = InferenceEngine(
+        params, cfg,
+        max_batch=args.max_batch, max_len=args.max_len,
+        page_size=args.page_size, n_pages=args.n_pages,
+        fused_steps=args.fused_steps, kv_int8=args.kv_int8,
+        prefix_cache=args.prefix_cache,
+    )
+    server, loop = serve_inference(engine, port=args.port, host=args.host)
+    log.info(
+        "serving %s model (%d layers, d=%d) on %s:%d",
+        "hf-imported" if args.hf else "random-init",
+        cfg.n_layers, cfg.d_model, args.host, server.server_address[1],
+    )
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    server.shutdown()
+    loop.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

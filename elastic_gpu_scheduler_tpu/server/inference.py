@@ -1,0 +1,263 @@
+"""HTTP front end for the serving engine: completions over the wire.
+
+The engine (models/serving.py) is a library; this module gives it the
+network surface a framework user expects:
+
+    POST /v1/completions   → {"prompt": [ids], "max_tokens": N, ...}
+                             blocking JSON response, or Server-Sent-Events
+                             streaming with {"stream": true}
+    GET  /v1/stats         → engine state (slots, pages, prefix hits,
+                             registered adapters)
+    GET  /healthz          → liveness
+
+Design notes (mirrors server/routes.py conventions — stdlib HTTP only):
+
+- ONE engine thread (``EngineLoop``) owns all engine state and drives
+  fused chunks continuously; HTTP handler threads only enqueue requests
+  (``InferenceEngine.submit`` is thread-safe) and wait on per-request
+  events/queues — the TPU never blocks on a slow client.
+- Streaming uses the engine's ``on_token`` callback to feed a bounded
+  per-connection queue; the handler thread drains it into SSE lines
+  (``data: {"token": t}``, terminated by ``data: [DONE]``).  A slow or
+  dead client only ever stalls its own handler thread.
+- The API is TOKEN-level ({"prompt": [ids]}) — the framework is
+  tokenizer-agnostic (HF tokenizers plug in client-side), same stance as
+  the rest of models/.
+
+The reference has no serving plane at all (SURVEY §2 #19); this completes
+the inference story the workload plane opened.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..models.serving import InferenceEngine, Request
+from .routes import _REASONS
+
+log = logging.getLogger("tpu-scheduler")
+
+
+class EngineLoop:
+    """Single thread that owns the engine: admit + step while work exists,
+    park on the submit queue when idle."""
+
+    def __init__(self, engine: InferenceEngine, idle_sleep: float = 0.002):
+        self.engine = engine
+        self.idle_sleep = idle_sleep
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "EngineLoop":
+        self._thread = threading.Thread(
+            target=self._run, name="engine-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        eng = self.engine
+        while not self._stop.is_set():
+            try:
+                eng._admit()
+                if any(s is not None for s in eng.slots):
+                    eng.step()
+                else:
+                    self._stop.wait(self.idle_sleep)
+            except RuntimeError as e:
+                if "page pool exhausted" in str(e):
+                    # ordinary overload, not a bug: every slot is stalled
+                    # for pages.  Preempt ONE victim — the slot holding the
+                    # most pages, so the freed capacity is maximal — and
+                    # let the others finish (the scheduler plane's
+                    # victim-pruning philosophy, applied to the KV pool).
+                    victim = max(
+                        (i for i, s in enumerate(eng.slots) if s is not None),
+                        key=lambda i: len(eng.slot_pages[i]),
+                    )
+                    req = eng.slots[victim]
+                    log.warning(
+                        "KV page pool exhausted; preempting slot %d "
+                        "(%d pages held)", victim, len(eng.slot_pages[victim]),
+                    )
+                    req.error = "preempted: KV page pool exhausted"
+                    req.done.set()
+                    eng._release_slot(victim)
+                else:
+                    self._fail_all("internal engine error")
+            except Exception:
+                self._fail_all("internal engine error")
+
+    def _fail_all(self, msg: str) -> None:
+        """An engine bug must not kill the loop thread silently: fail every
+        in-flight request so clients unblock, then keep serving."""
+        log.exception("engine loop error; failing in-flight requests")
+        for i, req in enumerate(self.engine.slots):
+            if req is not None:
+                req.error = msg
+                req.done.set()
+                self.engine._release_slot(i)
+
+
+def _request_from_body(body: dict) -> Request:
+    prompt = body.get("prompt")
+    if not isinstance(prompt, list) or not all(
+        isinstance(t, int) for t in prompt
+    ):
+        raise ValueError("'prompt' must be a list of token ids")
+    stop = body.get("stop", [])
+    if not isinstance(stop, list) or not all(isinstance(t, int) for t in stop):
+        raise ValueError("'stop' must be a list of token ids")
+    return Request(
+        prompt=prompt,
+        max_new_tokens=int(body.get("max_tokens", 16)),
+        temperature=float(body.get("temperature", 0.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        adapter=str(body.get("adapter", "")),
+        stop_tokens=tuple(stop),
+    )
+
+
+def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
+    engine = loop.engine
+
+    class InferenceHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "tpu-elastic-inference"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            log.debug("inference http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code, _REASONS.get(code, ""))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._json(200, {"ok": True})
+            if self.path == "/v1/stats":
+                eng = engine
+                return self._json(200, {
+                    "active_slots": sum(
+                        1 for s in eng.slots if s is not None
+                    ),
+                    "max_batch": eng.max_batch,
+                    "queued": eng.queue.qsize(),
+                    "free_pages": len(eng.free_pages),
+                    "total_pages": eng.n_pages - 1,
+                    "prefix_hit_tokens": int(eng.prefix_hit_tokens),
+                    "adapters": sorted(
+                        a for a in eng.adapter_index if a
+                    ),
+                })
+            return self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/completions":
+                return self._json(404, {"error": f"no route {self.path}"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                req = _request_from_body(body)
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._json(400, {"error": str(e)})
+            if body.get("stream"):
+                return self._stream(req)
+            engine.submit(req)
+            if not req.done.wait(request_timeout):
+                req.max_new_tokens = 0  # best-effort: engine ignores slot
+                return self._json(504, {"error": "generation timed out"})
+            if req.error:
+                return self._json(400, {"error": req.error})
+            return self._json(200, {"tokens": req.output})
+
+        def _stream(self, req: Request) -> None:
+            # SSE: tokens are pushed from the ENGINE thread into a bounded
+            # queue; this handler thread drains it to the socket, so a slow
+            # client never blocks generation (the queue is sized for the
+            # whole response)
+            q: "queue.Queue" = queue.Queue(maxsize=req.max_new_tokens + 2)
+            req.on_token = lambda tok: q.put(tok)
+            engine.submit(req)
+            # submit() validates synchronously — a rejected request gets
+            # the same 400 the non-streaming path returns, not a 200
+            # stream carrying an error event
+            if req.done.is_set() and req.error:
+                return self._json(400, {"error": req.error})
+            self.send_response(200, "OK")
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(payload: str) -> None:
+                data = f"data: {payload}\n\n".encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            sent = 0
+            deadline = time.monotonic() + request_timeout
+            try:
+                while time.monotonic() < deadline:
+                    try:
+                        tok = q.get(timeout=0.1)
+                        chunk(json.dumps({"token": tok}))
+                        sent += 1
+                    except queue.Empty:
+                        if req.done.is_set() and q.empty():
+                            break
+                if not req.done.is_set():
+                    # timed out mid-generation: tell the client the truth
+                    # (no clean [DONE]) and cancel engine-side so the slot
+                    # and its KV pages come back at the next chunk boundary
+                    req.max_new_tokens = 0
+                    chunk(json.dumps({"error": "generation timed out"}))
+                elif req.error:
+                    chunk(json.dumps({"error": req.error}))
+                chunk("[DONE]")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # dead client: stop generating for it — the engine checks
+                # emitted >= max_new_tokens at every chunk boundary
+                req.max_new_tokens = 0
+                log.info("stream client disconnected after %d tokens", sent)
+
+    return InferenceHandler
+
+
+def serve_inference(
+    engine: InferenceEngine,
+    port: int = 8000,
+    host: str = "0.0.0.0",
+    request_timeout: float = 300.0,
+) -> tuple[ThreadingHTTPServer, EngineLoop]:
+    """Start the engine loop + HTTP server (both daemonized); returns them
+    so the caller owns shutdown: ``server.shutdown(); loop.stop()``."""
+    loop = EngineLoop(engine).start()
+    server = ThreadingHTTPServer(
+        (host, port), make_handler(loop, request_timeout)
+    )
+    t = threading.Thread(
+        target=server.serve_forever, name="inference-http", daemon=True
+    )
+    t.start()
+    log.info("inference server on %s:%d", host, server.server_address[1])
+    return server, loop

@@ -44,7 +44,7 @@ log = logging.getLogger("tpu-scheduler")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 500: "Internal Server Error",
-            503: "Service Unavailable"}
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 
 
 def sample_cpu_profile(seconds: float, interval: float = 0.005) -> str:
