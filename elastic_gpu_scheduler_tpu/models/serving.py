@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.attention import NEG_INF
 from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
@@ -143,6 +144,18 @@ class Request:
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
     error: str = ""  # set (with done) when the request is rejected
+    # Thread ownership: the ENGINE thread owns output/error/done and all
+    # slot state; other threads may only read output after done, and may
+    # request cancellation via cancel().  ``cancelled`` is a plain bool
+    # flag (atomic under the GIL) the engine checks at every chunk
+    # boundary — tokens already emitted stay in ``output``.
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Stop generation at the next chunk boundary (client timeout or
+        disconnect).  Safe to call from any thread, idempotent; the engine
+        frees the slot/pages and signals ``done``."""
+        self.cancelled = True
 
 
 def build_lora_bank(
@@ -237,6 +250,57 @@ def _sproj(x, p, name, dtype, ad, aids):
     return y
 
 
+def _moe_ffn_serve(h, p, dtype):
+    """Drop-free top-1 MoE FFN for the serving paths.
+
+    Training's ``moe_ffn`` (models/moe.py) drops tokens past an expert's
+    capacity — acceptable as a training-time regularizer, wrong at serving
+    (a dropped token silently skips its FFN and the victim depends on
+    which other requests share the batch).  Serving routes EXACTLY, and
+    batch-composition independently: a token's output never depends on
+    other slots' routing, so engine outputs match solo ``generate()`` runs.
+
+    Two shapes of the same computation, chosen by static token count:
+    - decode-sized (≤32 tokens): gather the chosen expert's weights per
+      token — 3 (T, D, F) gathers, dense-FFN FLOPs;
+    - prefill-sized: mask-dispatch to ALL experts (onehot-scaled inputs;
+      SwiGLU maps zero inputs to zero outputs, so unrouted expert
+      contributions vanish) — E× dense FLOPs but static shapes, no
+      gather of T weight matrices.  A Pallas grouped-matmul is the
+      optimization path if expert counts grow.
+    """
+    B, T, D = h.shape
+    tokens = B * T
+    xf = h.reshape(tokens, D)
+    glog = (xf @ wmat(p["moe_gate"], h.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(glog, axis=-1)  # (T, E)
+    idx = jnp.argmax(probs, axis=-1)  # (T,)
+    prob = jnp.max(probs, axis=-1).astype(jnp.float32)  # (T,)
+    if tokens <= 32:
+        wg = wmat(p["w_gate"], dtype)[idx]  # (T, D, F)
+        wi = wmat(p["w_in"], dtype)[idx]
+        wo = wmat(p["w_out"], dtype)[idx]
+        gate = jax.nn.silu(jnp.einsum("td,tdf->tf", xf, wg))
+        up = jnp.einsum("td,tdf->tf", xf, wi)
+        out = jnp.einsum(
+            "tf,tfd->td", gate * up, wo, preferred_element_type=jnp.float32
+        )
+    else:
+        E = glog.shape[-1]
+        onehot = jax.nn.one_hot(idx, E, dtype=xf.dtype)  # (T, E)
+        expert_in = jnp.einsum("te,td->etd", onehot, xf)
+        gate = jax.nn.silu(
+            jnp.einsum("etd,edf->etf", expert_in, wmat(p["w_gate"], dtype))
+        )
+        up = jnp.einsum("etd,edf->etf", expert_in, wmat(p["w_in"], dtype))
+        out = jnp.einsum(
+            "etf,efd->td", gate * up, wmat(p["w_out"], dtype),
+            preferred_element_type=jnp.float32,
+        )
+    out = out * prob[:, None]
+    return out.astype(h.dtype).reshape(B, T, D)
+
+
 def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
                  ad=None, aids=None):
     """ONE transformer layer shared by every paged path (decode step,
@@ -263,9 +327,15 @@ def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
     o = attn(q, k, v, lkv)
     x = x + _sproj(o, p, "wo", dtype, ad, aids)
     h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(_sproj(h, p, "w_gate", dtype, ad, aids))
-    up = _sproj(h, p, "w_in", dtype, ad, aids)
-    x = x + _sproj(gate * up, p, "w_out", dtype, ad, aids)
+    if cfg.n_experts > 0:
+        # expert FFN weights are expert-stacked (E, D, F) — LoRA targets
+        # the dense projections only (build_lora_bank rejects adapters
+        # against expert-stacked shapes at construction)
+        x = x + _moe_ffn_serve(h, p, dtype)
+    else:
+        gate = jax.nn.silu(_sproj(h, p, "w_gate", dtype, ad, aids))
+        up = _sproj(h, p, "w_in", dtype, ad, aids)
+        x = x + _sproj(gate * up, p, "w_out", dtype, ad, aids)
     return x, lkv
 
 
@@ -462,6 +532,117 @@ def _fused_serve_chunk(
     return sampled.T, kv  # (B, n_steps)
 
 
+def _cached_attention_rows(q, cache_k, cache_v, starts, window=0):
+    """W-position attention against gathered pages with PER-ROW start
+    positions (the batched form of generate.cached_attention_multi).
+
+    q: (B, W, Hn, Dh) — row b's queries sit at global positions
+    starts[b]..starts[b]+W-1; cache: (B, M, Hkv, Dh) with the W new K/V
+    rows already written at those positions.  Causal: query t of row b
+    sees key m iff m <= starts[b] + t; ``window`` > 0 adds sliding-window
+    masking.  GQA via the grouped einsum (no cache expansion)."""
+    B, W, Hn, Dh = q.shape
+    M = cache_k.shape[1]
+    Hkv = cache_k.shape[2]
+    n_rep = Hn // Hkv
+    scale = Dh**-0.5
+    qg = (
+        q.reshape(B, W, Hkv, n_rep, Dh)
+        .transpose(0, 2, 3, 1, 4)
+        .astype(jnp.float32)
+    )  # (B, Hkv, n_rep, W, Dh)
+    kT = cache_k.transpose(0, 2, 1, 3).astype(jnp.float32)  # (B,Hkv,M,Dh)
+    vT = cache_v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bgrtd,bgkd->bgrtk", qg, kT) * scale
+    qpos = starts[:, None] + jnp.arange(W)  # (B, W)
+    kpos = jnp.arange(M)  # (M,)
+    keep = kpos[None, None, :] <= qpos[:, :, None]  # (B, W, M)
+    if window > 0:
+        keep = keep & ((qpos[:, :, None] - kpos[None, None, :]) < window)
+    s = jnp.where(keep[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrtk,bgkd->bgrtd", p, vT)  # (B,Hkv,n_rep,W,Dh)
+    return (
+        o.transpose(0, 3, 1, 2, 4).reshape(B, W, Hn, Dh).astype(q.dtype)
+    )
+
+
+def _fused_verify_chunk(
+    params, kv, tables, feed, lengths, active,
+    temps, top_ks, top_ps, key,
+    bank=None, aids=None,
+    *, cfg, page_size, use_filters,
+):
+    """ONE wide pass over every slot's verify window (speculative decoding
+    inside the paged engine — VERDICT r2 #2).
+
+    feed: (B, W) — row b holds the tokens at global positions
+    lengths[b]..lengths[b]+W-1: the confirmed next token at slot 0, then
+    prompt tokens (while prefilling incrementally) and/or host-proposed
+    drafts (prompt-lookup).  The pass writes all W K/V rows per slot and
+    returns ``picked`` (B, W): position j's greedy argmax (or sample, for
+    temps>0 rows) over the logits AT fed position j — i.e. the model's own
+    choice for global position lengths+j+1.  The host accepts the longest
+    fed prefix the model itself would have produced; rejected rows are
+    overwritten by the next pass before any query can attend to them, so
+    rollback is free (same masking argument as models/speculative.py).
+
+    Positions past max_len route to the scratch page (their outputs are
+    never consumed — the host caps acceptance), so slots near the end of
+    their allocation stay safe under the fixed-shape window.
+    """
+    from .sampling import sample_batched
+
+    dtype = jnp.dtype(cfg.dtype)
+    B, W = feed.shape
+    Hn, Dh = cfg.n_heads, cfg.head_dim
+    max_len = tables.shape[1] * page_size
+    x = _embed_lookup(params["embed"], feed, dtype)  # (B, W, D)
+    positions = lengths[:, None] + jnp.arange(W)  # (B, W)
+    in_range = (positions < max_len) & active[:, None]
+    page_of = jnp.clip(positions // page_size, 0, tables.shape[1] - 1)
+    pidx = jnp.where(
+        in_range,
+        jnp.take_along_axis(tables, page_of, axis=1),
+        SCRATCH_PAGE,
+    ).reshape(B * W)
+    off = (positions % page_size).reshape(B * W)
+
+    def attn(q, k, v, lkv):
+        k_all, v_all = _kv_gather(lkv, tables, page_size, dtype)
+        return _cached_attention_rows(
+            q, k_all, v_all, lengths, window=cfg.window_size
+        ).reshape(B, W, Hn * Dh)
+
+    def layer_step(x, scanned):
+        p, lkv, ad = scanned
+        return _paged_layer(
+            x, p, lkv, positions, pidx, off, attn, cfg, dtype, ad, aids
+        )
+
+    x, new_kv = jax.lax.scan(
+        layer_step, x, (params["layers"], kv, bank or {})
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ wmat(params["unembed"], dtype)).astype(jnp.float32)
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # (B, W)
+    subs = jax.random.split(key, W)
+    if use_filters:
+        sampled = jax.vmap(
+            lambda lg, k: sample_batched(lg, k, temps, top_ks, top_ps),
+            in_axes=(1, 0), out_axes=1,
+        )(logits, subs)
+    else:
+        sampled = jax.vmap(
+            lambda lg, k: jax.random.categorical(
+                k, lg / jnp.maximum(temps, 1e-6)[:, None], axis=-1
+            ).astype(jnp.int32),
+            in_axes=(1, 0), out_axes=1,
+        )(logits, subs)
+    picked = jnp.where((temps > 0)[:, None], sampled, greedy)
+    return picked, new_kv
+
+
 class InferenceEngine:
     """Paged-cache continuous batching with fused K-step decode chunks."""
 
@@ -477,8 +658,21 @@ class InferenceEngine:
         kv_int8: bool = False,
         prefix_cache: bool = False,
         adapters: Optional[dict[str, dict]] = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
     ):
-        assert cfg.n_experts == 0, "serving engine supports dense models"
+        """``spec_k`` > 0 enables speculative decoding inside the engine:
+        steps where some greedy slot is generating run a fused VERIFY
+        chunk (one wide pass over a spec_k+1 window per slot, prompt-lookup
+        drafts, per-slot variable acceptance) instead of spec_k+1
+        sequential decode steps — device time per accepted token divides
+        by the acceptance length, and greedy outputs are EXACTLY those of
+        the non-speculative engine.  Sampled (temperature>0) slots advance
+        one token per verify pass (their window still fast-feeds prompt
+        tokens); steps where only sampled slots are generating fall back
+        to the sequential fused chunk automatically.  ``spec_ngram`` is
+        the prompt-lookup match length (models/speculative.propose_ngram).
+        """
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -525,6 +719,22 @@ class InferenceEngine:
                     cfg=cfg,
                     page_size=page_size,
                     n_steps=self.fused_steps,
+                    use_filters=use_filters,
+                ),
+                donate_argnums=(1,),  # the kv pool pytree
+            )
+            for use_filters in (False, True)
+        }
+        self.spec_k = max(0, spec_k)
+        self.spec_ngram = spec_ngram
+        self.spec_passes = 0  # verify passes run
+        self.spec_accepted = 0  # accepted draft tokens (beyond the bonus)
+        self._verify_chunks = {
+            use_filters: jax.jit(
+                functools.partial(
+                    _fused_verify_chunk,
+                    cfg=cfg,
+                    page_size=page_size,
                     use_filters=use_filters,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
@@ -625,6 +835,9 @@ class InferenceEngine:
                 req = self.queue.get_nowait()
             except queue.Empty:
                 return
+            if req.cancelled:  # cancelled while still queued
+                req.done.set()
+                continue
             self.slots[i] = req
             self.prompts[i, : len(req.prompt)] = req.prompt
             self.prompt_lens[i] = len(req.prompt)
@@ -678,9 +891,16 @@ class InferenceEngine:
         """On release: publish the slot's pages fully covered by the prompt
         into the prefix cache (content-addressed by the token hash chain).
         Duplicates of already-cached content stay unregistered and are
-        freed normally."""
+        freed normally.
+
+        Coverage is capped at the WRITTEN length, not just the prompt
+        length: a request cancelled mid-prompt-feed (client timeout or
+        disconnect during incremental feeding) releases pages whose K/V
+        rows were never produced — publishing those under the prompt's
+        content hash would hand garbage pages to every later request
+        sharing the prefix."""
         ps = self.page_size
-        plen = len(req.prompt)
+        plen = min(len(req.prompt), int(self.lengths[i]))
         key = ("lora", int(self.adapter_ids[i]))  # same seed as _match_prefix
         for j, pg in enumerate(self.slot_pages[i]):
             end = (j + 1) * ps
@@ -764,7 +984,11 @@ class InferenceEngine:
         self.emitted[i] = 1
         self.lengths[i] = plen
         self.next_token[i] = tok
-        if tok in req.stop_tokens or self.emitted[i] >= req.max_new_tokens:
+        if (
+            tok in req.stop_tokens
+            or self.emitted[i] >= req.max_new_tokens
+            or req.cancelled
+        ):
             req.done.set()
             self._release_slot(i)
 
@@ -799,6 +1023,26 @@ class InferenceEngine:
             self.page_ref[pg] += 1
         return True
 
+    def _force_drop_slot(self, i: int) -> None:
+        """Last-resort slot teardown for the serving loop's failure path:
+        free the slot's pages WITHOUT prefix-cache registration and never
+        raise — if ``_release_slot`` itself failed, a bare ``slots[i] =
+        None`` would leave the dead tenant's page list attached, and the
+        next request admitted into the slot would write K/V over pages
+        still referenced (possibly shared via the prefix cache) by other
+        live requests."""
+        try:
+            for pg in reversed(self.slot_pages[i]):
+                self.page_ref[pg] -= 1
+                if self.page_ref[pg] <= 0 and pg not in self.page_key:
+                    self.free_pages.append(pg)
+        except Exception:
+            log.exception("page cleanup for slot %d failed; pages leak", i)
+        self.slot_pages[i] = []
+        self.tables[i, :] = SCRATCH_PAGE
+        self.slots[i] = None
+        self.stalled[i] = False
+
     def _release_slot(self, i: int) -> None:
         req = self.slots[i]
         if self.prefix_cache and req is not None and not req.error:
@@ -812,16 +1056,29 @@ class InferenceEngine:
         self.slots[i] = None
         self.stalled[i] = False
 
-    def step(self) -> None:
-        """One fused chunk (``fused_steps`` decode iterations) across all
-        slots; page allocation, admission, and completion happen between
-        chunks on the host."""
-        K = self.fused_steps
-        active = np.zeros(self.max_batch, bool)
+    def _prepare_step(self, lookahead: int):
+        """Host-side slot scan shared by BOTH step flavors (sequential
+        chunk and speculative verify): release cancelled slots (before the
+        pages check, so a cancelled stalled slot frees pages that may
+        unstall others), grow each live slot's pages to cover
+        ``lookahead`` more positions, raise when every live slot is
+        stalled, and build the scratch-masked power-of-two table view
+        (attention cost follows the LIVE context length, and inactive
+        rows must point at scratch — a stalled slot whose write position
+        lies beyond the bucket would otherwise clamp into its own last
+        visible page and corrupt confirmed K/V).
+
+        Returns (active, view) or None when no slot is runnable."""
+        B = self.max_batch
+        active = np.zeros(B, bool)
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
-            if self._ensure_pages(i, int(self.lengths[i]) + K):
+            if req.cancelled:
+                req.done.set()
+                self._release_slot(i)
+                continue
+            if self._ensure_pages(i, int(self.lengths[i]) + lookahead):
                 active[i] = True
                 self.stalled[i] = False
             else:
@@ -829,32 +1086,171 @@ class InferenceEngine:
         if not active.any():
             if any(s is not None for s in self.slots):
                 raise RuntimeError(
-                    f"page pool exhausted: {sum(self.stalled)} slots stalled, "
-                    f"0 runnable (pool {self.n_pages - 1} pages)"
+                    f"page pool exhausted: {sum(self.stalled)} slots "
+                    f"stalled, 0 runnable (pool {self.n_pages - 1} pages)"
                 )
-            return
-        # bucket the table width to the MAX pages any active slot can touch
-        # this chunk (next power of two) — attention cost per step follows
-        # the LIVE context length, not max_len; the chunk jit compiles once
-        # per bucket (log2(max_pages) variants)
-        need = max(
-            len(self.slot_pages[i])
-            for i in range(self.max_batch)
-            if active[i]
-        )
+            return None
+        need = max(len(self.slot_pages[i]) for i in range(B) if active[i])
         bucket = 1
         while bucket < need:
             bucket *= 2
         bucket = min(bucket, self.max_pages_per_slot)
-        # INACTIVE rows must point at scratch in the sliced view: a stalled
-        # slot whose write position lies beyond the bucket would otherwise
-        # clamp into its own LAST visible page and corrupt confirmed K/V
         view = self.tables[:, :bucket].copy()
         view[~active] = SCRATCH_PAGE
-        self._key, sub = jax.random.split(self._key)
-        use_filters = bool(
-            (self.top_ks[active] > 0).any() or (self.top_ps[active] < 1.0).any()
+        return active, view
+
+    def _filters_requested(self, active) -> bool:
+        return bool(
+            (self.top_ks[active] > 0).any()
+            or (self.top_ps[active] < 1.0).any()
         )
+
+    def _spec_useful(self) -> bool:
+        """The verify pass beats sequential chunks only when some slot can
+        actually exploit the window: a slot still feeding its prompt
+        (W tokens/pass vs 1/step) or a greedy slot generating (drafts).
+        A purely sampled generation step takes the sequential chunk."""
+        for i, req in enumerate(self.slots):
+            if req is None or req.cancelled:
+                continue
+            if self.lengths[i] < self.prompt_lens[i] - 1:
+                return True
+            if self.temps[i] == 0:
+                return True
+        return False
+
+    def step(self) -> None:
+        """One engine step: a fused decode chunk, or (speculative mode) a
+        fused verify pass; page allocation, admission, and completion
+        happen between steps on the host."""
+        if self.spec_k > 0 and self._spec_useful():
+            return self._step_verify()
+        return self._step_chunk()
+
+    def _step_verify(self) -> None:
+        """Speculative engine step (VERDICT r2 #2): build each active
+        slot's verify window host-side (confirmed token, then prompt
+        tokens and/or prompt-lookup drafts), run ONE wide fused pass, and
+        accept per-slot the longest fed prefix the model itself would have
+        produced — plus the model's own "bonus" token after it.  Greedy
+        slots emit 1..W tokens per pass, token-identical to the
+        sequential engine; sampled slots emit exactly one."""
+        from .speculative import propose_ngram
+
+        W = self.spec_k + 1
+        B = self.max_batch
+        prepared = self._prepare_step(W)
+        if prepared is None:
+            return
+        active, view = prepared
+        feed = np.zeros((B, W), np.int32)
+        for i, req in enumerate(self.slots):
+            if req is None or not active[i]:
+                continue
+            p = int(self.lengths[i])
+            plen = int(self.prompt_lens[i])
+            feed[i, 0] = self.next_token[i]
+            j = 1
+            while j < W and p + j < plen:  # prompt feeding: always valid
+                feed[i, j] = self.prompts[i, p + j]
+                j += 1
+            if j < W and self.temps[i] == 0:
+                # prompt + output is exactly the tokens at positions
+                # 0..p, so the proposer's continuation lands at the
+                # window's first generated position
+                drafts = propose_ngram(
+                    list(req.prompt) + req.output, self.spec_ngram, W - j
+                )
+                for d in drafts:
+                    feed[i, j] = d
+                    j += 1
+        self._key, sub = jax.random.split(self._key)
+        use_filters = self._filters_requested(active)
+        picked, self.kv = self._verify_chunks[use_filters](
+            self.params,
+            self.kv,
+            jnp.asarray(view),
+            jnp.asarray(feed),
+            jnp.asarray(self.lengths),
+            jnp.asarray(active),
+            jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps),
+            sub,
+            self.lora_bank,
+            jnp.asarray(self.adapter_ids),
+        )
+        picked = np.asarray(picked)  # (B, W)
+        self.spec_passes += 1
+        for i, req in enumerate(self.slots):
+            if req is None or not active[i]:
+                continue
+            p = int(self.lengths[i])
+            plen = int(self.prompt_lens[i])
+            greedy = self.temps[i] == 0
+            # longest valid fed prefix: prompt positions are valid by
+            # definition; a greedy draft is valid iff it equals the
+            # model's own choice at the previous position (a "pad" zero
+            # that matches is, by that very test, the correct token)
+            A = 1
+            while A < W:
+                if p + A < plen:
+                    A += 1
+                elif greedy and feed[i, A] == picked[i, A - 1]:
+                    A += 1
+                else:
+                    break
+            stopped = False
+            exhausted = False
+            for j in range(1, A):
+                if p + j < plen:
+                    continue  # prompt position: nothing to emit
+                tok = int(feed[i, j])
+                self._emit(req, tok)
+                self.emitted[i] += 1
+                self.spec_accepted += 1
+                if tok in req.stop_tokens:
+                    stopped = True
+                    A = j + 1  # confirmed rows end at the stop token
+                    break
+                if self.emitted[i] >= req.max_new_tokens:
+                    exhausted = True
+                    A = j + 1
+                    break
+            if not stopped and not exhausted and p + A >= plen:
+                # the model's own token after the last valid fed position
+                tok = int(picked[i, A - 1])
+                self._emit(req, tok)
+                self.emitted[i] += 1
+                if tok in req.stop_tokens:
+                    stopped = True
+            # rows p..p+A-1 hold confirmed K/V; the bonus token (position
+            # p+A) is fed — and its row written — by the next pass
+            self.lengths[i] = p + A
+            if (
+                stopped
+                or self.emitted[i] >= req.max_new_tokens
+                or req.cancelled
+            ):
+                req.done.set()
+                self._release_slot(i)
+            else:
+                self.next_token[i] = (
+                    self.prompts[i, p + A]
+                    if p + A < plen
+                    else int(picked[i, A - 1])
+                )
+
+    def _step_chunk(self) -> None:
+        """One fused chunk (``fused_steps`` decode iterations) across all
+        slots."""
+        K = self.fused_steps
+        prepared = self._prepare_step(K)
+        if prepared is None:
+            return
+        active, view = prepared
+        self._key, sub = jax.random.split(self._key)
+        use_filters = self._filters_requested(active)
         sampled, self.kv = self._chunks[use_filters](
             self.params,
             self.kv,
@@ -896,6 +1292,10 @@ class InferenceEngine:
                 if self.lengths[i] < plen
                 else sampled[i, K - 1]
             )
-            if stopped or self.emitted[i] >= req.max_new_tokens:
+            if (
+                stopped
+                or self.emitted[i] >= req.max_new_tokens
+                or req.cancelled
+            ):
                 req.done.set()
                 self._release_slot(i)

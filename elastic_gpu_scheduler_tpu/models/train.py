@@ -39,8 +39,10 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     convention, so padding pipelines can mark positions with -100 (or any
     out-of-range id) and get a correct loss instead of the gather
     default's silent NaN.  The vocab-chunked path (ops/xent.py) implements
-    exactly the same semantics, so toggling ``xent_chunks`` never changes
-    the reported loss."""
+    exactly the same semantics, so toggling ``xent_chunks`` changes the
+    reported loss only by bf16 rounding (the dense path rounds logits
+    through the bf16 matmul output; the chunked path keeps fp32 via
+    preferred_element_type — see ops/xent.py's numerics note)."""
     V = logits.shape[-1]
     valid = (targets >= 0) & (targets < V)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)

@@ -97,7 +97,12 @@ def main(argv=None) -> int:
             import torch
 
             for f in sorted(hf_dir.glob("pytorch_model*.bin")):
-                sd.update(torch.load(f, map_location="cpu"))
+                # weights_only: state dicts load fine with it and an
+                # untrusted checkpoint dir can't run arbitrary code via
+                # pickle (ADVICE r2)
+                sd.update(
+                    torch.load(f, map_location="cpu", weights_only=True)
+                )
         if not sd:
             raise SystemExit(f"no weight files found under {hf_dir}")
         params = params_from_hf_llama(sd, cfg)

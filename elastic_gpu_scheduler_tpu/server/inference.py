@@ -68,6 +68,7 @@ class EngineLoop:
 
     def _run(self) -> None:
         eng = self.engine
+        failures = 0  # consecutive _fail_all rounds, reset on any success
         while not self._stop.is_set():
             try:
                 eng._admit()
@@ -75,6 +76,7 @@ class EngineLoop:
                     eng.step()
                 else:
                     self._stop.wait(self.idle_sleep)
+                failures = 0
             except RuntimeError as e:
                 if "page pool exhausted" in str(e):
                     # ordinary overload, not a bug: every slot is stalled
@@ -95,30 +97,56 @@ class EngineLoop:
                     req.done.set()
                     eng._release_slot(victim)
                 else:
-                    self._fail_all("internal engine error")
+                    failures += 1
+                    self._fail_all("internal engine error", failures)
             except Exception:
-                self._fail_all("internal engine error")
+                failures += 1
+                self._fail_all("internal engine error", failures)
 
-    def _fail_all(self, msg: str) -> None:
+    def _fail_all(self, msg: str, failures: int = 1) -> None:
         """An engine bug must not kill the loop thread silently: fail every
-        in-flight request so clients unblock, then keep serving."""
-        log.exception("engine loop error; failing in-flight requests")
+        in-flight request so clients unblock, then keep serving.  Two
+        hardening rules (ADVICE r2): per-slot cleanup is individually
+        guarded (a raising ``_release_slot`` must not kill the loop
+        thread), and consecutive failures back off exponentially (capped
+        at 1s) so a persistent engine bug degrades to a slow error loop
+        instead of a hot one."""
+        log.exception(
+            "engine loop error (consecutive=%d); failing in-flight requests",
+            failures,
+        )
         for i, req in enumerate(self.engine.slots):
-            if req is not None:
+            if req is None:
+                continue
+            try:
                 req.error = msg
                 req.done.set()
                 self.engine._release_slot(i)
+            except Exception:
+                log.exception("cleanup of slot %d failed; force-dropping", i)
+                self.engine._force_drop_slot(i)
+        self._stop.wait(min(1.0, 0.05 * (2 ** min(failures, 10))))
 
 
-def _request_from_body(body: dict) -> Request:
-    prompt = body.get("prompt")
-    if not isinstance(prompt, list) or not all(
-        isinstance(t, int) for t in prompt
+def _token_ids(x, vocab_size: int, what: str) -> list:
+    """Validate a JSON field as a list of in-range token ids.  bool is an
+    int subclass in Python, so ``true`` would otherwise slip through; and
+    out-of-range ids would silently clamp in the embedding gather and
+    produce garbage completions instead of a 400 (ADVICE r2)."""
+    if not isinstance(x, list) or not all(
+        isinstance(t, int) and not isinstance(t, bool)
+        and 0 <= t < vocab_size
+        for t in x
     ):
-        raise ValueError("'prompt' must be a list of token ids")
-    stop = body.get("stop", [])
-    if not isinstance(stop, list) or not all(isinstance(t, int) for t in stop):
-        raise ValueError("'stop' must be a list of token ids")
+        raise ValueError(
+            f"{what!r} must be a list of token ids in [0, {vocab_size})"
+        )
+    return x
+
+
+def _request_from_body(body: dict, vocab_size: int) -> Request:
+    prompt = _token_ids(body.get("prompt"), vocab_size, "prompt")
+    stop = _token_ids(body.get("stop", []), vocab_size, "stop")
     return Request(
         prompt=prompt,
         max_new_tokens=int(body.get("max_tokens", 16)),
@@ -174,15 +202,27 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                req = _request_from_body(body)
-            except (ValueError, json.JSONDecodeError) as e:
+                req = _request_from_body(body, engine.cfg.vocab_size)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                # TypeError covers non-numeric scalars (null/list for
+                # max_tokens, temperature, ...) — a clean 400, not an
+                # aborted connection
                 return self._json(400, {"error": str(e)})
             if body.get("stream"):
                 return self._stream(req)
             engine.submit(req)
             if not req.done.wait(request_timeout):
-                req.max_new_tokens = 0  # best-effort: engine ignores slot
-                return self._json(504, {"error": "generation timed out"})
+                req.cancel()  # engine frees the slot at the next boundary
+                # wait for the engine's acknowledgement (done) before
+                # reading output — the Request thread-ownership rule; the
+                # next chunk boundary is normally well under this wait
+                acked = req.done.wait(10.0)
+                return self._json(504, {
+                    "error": "generation timed out",
+                    # tokens generated before the deadline are real work —
+                    # hand them over rather than discarding them
+                    "tokens": list(req.output) if acked else [],
+                })
             if req.error:
                 return self._json(400, {"error": req.error})
             return self._json(200, {"tokens": req.output})
@@ -227,7 +267,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     # timed out mid-generation: tell the client the truth
                     # (no clean [DONE]) and cancel engine-side so the slot
                     # and its KV pages come back at the next chunk boundary
-                    req.max_new_tokens = 0
+                    req.cancel()
                     chunk(json.dumps({"error": "generation timed out"}))
                 elif req.error:
                     chunk(json.dumps({"error": req.error}))
@@ -236,8 +276,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
                 # dead client: stop generating for it — the engine checks
-                # emitted >= max_new_tokens at every chunk boundary
-                req.max_new_tokens = 0
+                # the cancel flag at every chunk boundary
+                req.cancel()
                 log.info("stream client disconnected after %d tokens", sent)
 
     return InferenceHandler

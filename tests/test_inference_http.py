@@ -152,3 +152,19 @@ def test_stats_reflect_engine(served):
     assert body["max_batch"] == 2
     assert body["total_pages"] == engine.n_pages - 1
     assert body["adapters"] == []
+
+
+def test_bad_scalar_fields_return_400(served):
+    """null/list for numeric fields must 400 cleanly, not abort the
+    connection with a TypeError stack trace."""
+    addr, _ = served
+    for body in (
+        {"prompt": [1], "max_tokens": None},
+        {"prompt": [1], "max_tokens": [4]},
+        {"prompt": [1], "temperature": None},
+        {"prompt": [1], "top_k": {}},
+        {"prompt": [1], "top_p": None},
+        {"prompt": [1], "max_tokens": 2, "adapter": None},
+    ):
+        code, out = _post(addr, "/v1/completions", body)
+        assert code == 400 and "error" in out, (body, code, out)

@@ -121,3 +121,33 @@ def test_page_accounting_invariant():
     check()
     run_one(eng, [9, 8, 7])
     check()
+
+
+def test_cancel_mid_prompt_feed_does_not_poison_cache():
+    """A request cancelled while still feeding its prompt incrementally has
+    written only a prefix of its prompt pages; releasing it must register
+    ONLY the written pages — publishing unwritten pages under the prompt's
+    content hash would hand garbage K/V to every later request sharing the
+    prefix."""
+    prompt = list(range(1, 21))  # 20 tokens = 2 full pages + remainder
+    plain = run_one(make_engine(), prompt)
+
+    eng = make_engine(prefix_cache=True)
+    victim = Request(prompt=list(prompt), max_new_tokens=4)
+    eng.submit(victim)
+    # force the incremental prompt-feeding path (as if prefill had stalled
+    # for pages), run ONE chunk so only the first page's rows are written,
+    # then cancel
+    eng._try_prefill = lambda i, req: None
+    eng._admit()
+    eng.step()
+    assert int(eng.lengths[0]) < len(prompt)  # still mid-prompt
+    victim.cancel()
+    eng.step()  # release happens at the chunk boundary
+    assert victim.done.is_set()
+
+    # a later identical prompt may reuse whatever was registered — its
+    # output must still be exactly the no-cache engine's
+    del eng._try_prefill  # restore the class method
+    repeat = run_one(eng, prompt)
+    assert repeat == plain

@@ -1,0 +1,102 @@
+"""MoE serving in the paged engine (VERDICT r2 #3).
+
+The engine's MoE FFN is drop-free (serving._moe_ffn_serve): unlike
+training's capacity-factor ``moe_ffn``, a token's routing never depends on
+which other requests share the batch.  Correctness bar: engine outputs ==
+solo ``generate()`` runs, across the MoE × int8-KV × prefix-cache matrix.
+
+The reference has no serving plane at all (SURVEY §2 #19).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from elastic_gpu_scheduler_tpu.models.generate import generate
+from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+# capacity_factor == n_experts makes training's moe_ffn capacity equal the
+# token count, so the generate() oracle is drop-free too and the two
+# computations agree exactly (the engine path is ALWAYS drop-free)
+MOE_CFG = TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype="float32", n_experts=4, capacity_factor=4.0,
+)
+PARAMS = init_params(jax.random.key(1), MOE_CFG)
+# sharpen the router: at init-scale weights, routing argmax margins sit
+# within int8-KV quantization noise, so the int8 matrix cells would test
+# near-tie coin flips instead of engine/oracle equivalence
+PARAMS["layers"]["moe_gate"] = PARAMS["layers"]["moe_gate"] * 8.0
+
+
+def _expert_spread(params, prompts):
+    """The test is vacuous if every token routes to one expert — assert the
+    router actually spreads tokens at these scales."""
+    import jax.numpy as jnp
+
+    from elastic_gpu_scheduler_tpu.models.quantize import wmat
+
+    toks = jnp.asarray([t for p in prompts for t in p], jnp.int32)
+    x = params["embed"][toks]
+    gates = x @ wmat(params["layers"]["moe_gate"][0], x.dtype)
+    return len(set(np.asarray(jnp.argmax(gates, -1)).tolist()))
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_moe_engine_matches_generate(kv_int8, prefix_cache):
+    prompts = [[5, 17, 3], [60, 2], [9, 9, 9, 9], list(range(1, 20))]
+    assert _expert_spread(PARAMS, prompts) >= 2
+    engine = InferenceEngine(
+        PARAMS, MOE_CFG, max_batch=4, max_len=48, page_size=8,
+        kv_int8=kv_int8, prefix_cache=prefix_cache,
+    )
+    reqs = [
+        engine.submit(Request(prompt=p, max_new_tokens=6)) for p in prompts
+    ]
+    engine.run_until_idle()
+    for p, req in zip(prompts, reqs):
+        assert req.done.is_set() and not req.error
+        ref = generate(
+            PARAMS, jax.numpy.asarray([p]), MOE_CFG, max_new_tokens=6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref)[0, len(p):], req.output
+        )
+
+
+def test_moe_prefix_cache_hit_matches_cold():
+    """A prefix-cache hit skips the matched pages; the remainder must still
+    route through the experts identically."""
+    prompt = list(range(1, 18))  # 2 full pages at page_size=8
+    eng = InferenceEngine(
+        PARAMS, MOE_CFG, max_batch=2, max_len=48, page_size=8,
+        prefix_cache=True,
+    )
+    a = eng.submit(Request(prompt=prompt, max_new_tokens=8))
+    eng.run_until_idle()
+    hits0 = eng.prefix_hit_tokens
+    b = eng.submit(Request(prompt=prompt, max_new_tokens=8))
+    eng.run_until_idle()
+    assert eng.prefix_hit_tokens > hits0  # the second run actually hit
+    assert a.output == b.output
+
+
+def test_moe_int8_weights_serve():
+    """MoE expert weights quantize (expert-stacked (E,D,F) leaves) and the
+    engine serves the quantized model end to end."""
+    from elastic_gpu_scheduler_tpu.models.quantize import quantize_params
+
+    qparams = quantize_params(PARAMS)
+    eng = InferenceEngine(qparams, MOE_CFG, max_batch=2, max_len=32)
+    r = eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=6))
+    eng.run_until_idle()
+    assert r.done.is_set() and not r.error
+    ref = generate(
+        qparams, jax.numpy.asarray([[5, 17, 3]]), MOE_CFG, max_new_tokens=6
+    )
+    np.testing.assert_array_equal(np.asarray(ref)[0, 3:], r.output)

@@ -1,0 +1,168 @@
+"""Speculative decoding inside the paged continuous-batching engine
+(VERDICT r2 #2).
+
+Correctness bar: with ``spec_k > 0`` the engine's greedy outputs are
+token-identical to the non-speculative engine for every request in a mixed
+batch — speculation may only change HOW tokens are produced (fewer, wider
+passes), never WHICH.  Plus a measured acceptance win: >1 generated token
+per verify pass on self-repeating output.
+
+The acceptance test uses a model with zeroed transformer layers: logits
+then depend only on the current token, so greedy decoding iterates a
+deterministic map over the vocab and provably enters a cycle — prompt
+lookup drafts the cycle and the model accepts it, no seed hunting.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from elastic_gpu_scheduler_tpu.models.generate import generate
+from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_ff=64, dtype="float32"
+)
+PARAMS = init_params(jax.random.key(0), CFG)
+
+
+def _run(engine, reqs):
+    out = [engine.submit(r) for r in reqs]
+    engine.run_until_idle()
+    for r in out:
+        assert r.done.is_set() and not r.error, r.error
+    return [r.output for r in out]
+
+
+def _mixed_greedy_reqs():
+    return [
+        Request(prompt=[5, 17, 3], max_new_tokens=10),
+        Request(prompt=[60, 2], max_new_tokens=6),
+        Request(prompt=[9, 9, 9, 9, 9, 9, 9, 9], max_new_tokens=12),
+        Request(prompt=list(range(1, 20)), max_new_tokens=8),
+    ]
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+def test_spec_engine_token_identical_mixed_batch(kv_int8):
+    plain = InferenceEngine(
+        PARAMS, CFG, max_batch=4, max_len=48, page_size=8, kv_int8=kv_int8
+    )
+    ref = _run(plain, _mixed_greedy_reqs())
+    spec = InferenceEngine(
+        PARAMS, CFG, max_batch=4, max_len=48, page_size=8, kv_int8=kv_int8,
+        spec_k=4,
+    )
+    got = _run(spec, _mixed_greedy_reqs())
+    assert got == ref
+    assert spec.spec_passes > 0  # the verify path actually ran
+
+
+def _cyclic_params():
+    """Zero every transformer layer: the residual stream is just the
+    embedding, so next-token = f(current-token) — a deterministic finite
+    map whose greedy iteration always enters a cycle."""
+    p = jax.tree.map(lambda x: x, PARAMS)  # shallow copy of the tree
+    p["layers"] = jax.tree.map(lambda x: x * 0.0, PARAMS["layers"])
+    # keep the norm scales so rms_norm stays well-defined
+    p["layers"]["attn_norm"] = PARAMS["layers"]["attn_norm"]
+    p["layers"]["mlp_norm"] = PARAMS["layers"]["mlp_norm"]
+    return p
+
+
+def test_spec_acceptance_above_one_on_repetitive_output():
+    params = _cyclic_params()
+    n_new = 40
+    plain = InferenceEngine(params, CFG, max_batch=1, max_len=64, page_size=8)
+    ref = _run(plain, [Request(prompt=[5, 17, 3], max_new_tokens=n_new)])[0]
+    # sanity: the output really cycles (tail repeats with some period)
+    assert any(ref[-2 * p:-p] == ref[-p:] for p in range(1, 13))
+
+    spec = InferenceEngine(
+        params, CFG, max_batch=1, max_len=64, page_size=8, spec_k=5
+    )
+    got = _run(spec, [Request(prompt=[5, 17, 3], max_new_tokens=n_new)])[0]
+    assert got == ref
+    assert spec.spec_accepted > 0
+    # the win: generated tokens per verify pass strictly beats sequential
+    per_pass = n_new / spec.spec_passes
+    assert per_pass > 1.5, (n_new, spec.spec_passes, spec.spec_accepted)
+
+
+def test_spec_stop_token_inside_accepted_drafts():
+    """A stop token delivered via an ACCEPTED draft must truncate exactly
+    where the sequential engine stops (the drafts past it are dropped)."""
+    params = _cyclic_params()
+    plain = InferenceEngine(params, CFG, max_batch=1, max_len=64, page_size=8)
+    full = _run(plain, [Request(prompt=[5, 17, 3], max_new_tokens=24)])[0]
+    stop = full[len(full) // 2]  # a token the model certainly emits
+    plain2 = InferenceEngine(params, CFG, max_batch=1, max_len=64, page_size=8)
+    ref = _run(
+        plain2,
+        [Request(prompt=[5, 17, 3], max_new_tokens=24, stop_tokens=(stop,))],
+    )[0]
+    spec = InferenceEngine(
+        params, CFG, max_batch=1, max_len=64, page_size=8, spec_k=5
+    )
+    got = _run(
+        spec,
+        [Request(prompt=[5, 17, 3], max_new_tokens=24, stop_tokens=(stop,))],
+    )[0]
+    assert got == ref
+    assert got[-1] == stop and stop not in got[:-1]
+
+
+def test_spec_with_sampled_requests_in_batch():
+    """Sampled slots ride the verify passes (one token per pass) and stay
+    VALID samples; greedy slots in the same batch stay token-identical to
+    their solo generate() runs."""
+    spec = InferenceEngine(
+        PARAMS, CFG, max_batch=3, max_len=48, page_size=8, spec_k=4
+    )
+    greedy_a = Request(prompt=[5, 17, 3], max_new_tokens=8)
+    sampled = Request(
+        prompt=[60, 2], max_new_tokens=8, temperature=0.8, top_k=12
+    )
+    greedy_b = Request(prompt=[9, 9, 9, 9], max_new_tokens=8)
+    _run(spec, [greedy_a, sampled, greedy_b])
+    for req in (greedy_a, greedy_b):
+        ref = generate(
+            PARAMS,
+            jax.numpy.asarray([req.prompt]),
+            CFG,
+            max_new_tokens=req.max_new_tokens,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref)[0, len(req.prompt):], req.output
+        )
+    assert len(sampled.output) == 8
+    assert all(0 <= t < CFG.vocab_size for t in sampled.output)
+
+
+def test_spec_composes_with_moe_and_prefix_cache():
+    """Cross-feature: speculative verify passes over an MoE model with the
+    prefix cache on — outputs identical to the plain MoE engine."""
+    moe_cfg = TransformerConfig(
+        vocab_size=97, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32", n_experts=4, capacity_factor=4.0,
+    )
+    params = init_params(jax.random.key(1), moe_cfg)
+    reqs = lambda: [
+        Request(prompt=list(range(1, 18)), max_new_tokens=8),
+        Request(prompt=[60, 2], max_new_tokens=6),
+    ]
+    plain = InferenceEngine(
+        params, moe_cfg, max_batch=2, max_len=48, page_size=8,
+        prefix_cache=True,
+    )
+    ref = _run(plain, reqs())
+    spec = InferenceEngine(
+        params, moe_cfg, max_batch=2, max_len=48, page_size=8,
+        prefix_cache=True, spec_k=4,
+    )
+    got = _run(spec, reqs())
+    assert got == ref
