@@ -97,19 +97,21 @@ def loss_fn(
     if cfg.xent_chunks > 0:
         # vocab-chunked CE: the (B, S, V) logits tensor never materializes
         # (ops/xent.py) — O(S·D) activations end to end for long context
-        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
-            raise ValueError(
-                "xent_chunks requires tensor=1: the unembed is V-sharded "
-                "over the tensor axis (parallel/sharding.py) and every "
-                "chunk slice would force a reshard — use the dense path"
-            )
-        from ..ops.xent import chunked_softmax_xent
+        from ..ops.xent import chunked_softmax_xent, chunked_softmax_xent_tp
         from .quantize import wmat
         from .transformer import hidden_with_aux
 
         hidden, aux = hidden_with_aux(params, inputs, cfg, mesh=mesh)
         w = wmat(params["unembed"], jnp.dtype(cfg.dtype))
-        loss = chunked_softmax_xent(hidden, w, targets, cfg.xent_chunks)
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            # V-sharded unembed: per-rank chunk scan + one logsumexp merge
+            # (the TP×chunked composition; invalid chunk/tensor combos are
+            # rejected there with a named error)
+            loss = chunked_softmax_xent_tp(
+                hidden, w, targets, cfg.xent_chunks, mesh
+            )
+        else:
+            loss = chunked_softmax_xent(hidden, w, targets, cfg.xent_chunks)
     else:
         logits, aux = forward_with_aux(params, inputs, cfg, mesh=mesh)
         loss = cross_entropy_loss(logits, targets)

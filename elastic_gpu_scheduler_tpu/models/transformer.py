@@ -57,8 +57,9 @@ class TransformerConfig:
     n_microbatches: int = 0  # >0 + mesh pipe>1 → pipeline parallelism
     # >0 → training CE is computed in this many vocab chunks and the
     # (B, S, V) logits never materialize (ops/xent.py); inference paths
-    # (forward/generate/serving) are unaffected.  Prefer 0 when tensor > 1
-    # (the unembed is V-sharded there).
+    # (forward/generate/serving) are unaffected.  Composes with tensor>1
+    # (per-rank scan over the V-sharded unembed, ops/xent.py
+    # chunked_softmax_xent_tp); must be a multiple of the tensor size.
     xent_chunks: int = 0
 
     @property
