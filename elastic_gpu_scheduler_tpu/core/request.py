@@ -105,6 +105,14 @@ def unit_from_resources(resources: Mapping[str, object]) -> TPUUnit:
     return TPUUnit(core=core, hbm=hbm, chip_count=0)
 
 
+def pod_gang_key(pod) -> "str | None":
+    """``namespace/gang-name`` for a gang-annotated pod, else None — THE
+    gang identity every consumer (planning, preemption accounting, victim
+    expansion) must agree on."""
+    name = (pod.metadata.annotations or {}).get(consts.ANNOTATION_GANG_NAME)
+    return f"{pod.metadata.namespace}/{name}" if name else None
+
+
 def request_from_pod(pod) -> TPURequest:
     """Build a TPURequest from a k8s Pod object (see k8s/objects.py)."""
     units = []

@@ -41,9 +41,17 @@ Two cooperating mechanisms (SURVEY §7 hard part (b)):
    doesn't thrash 256 Python threads against the GIL.
 
 Pods opt in via annotations ``elasticgpu.io/gang-name`` and
-``elasticgpu.io/gang-size``.  Gangs are assumed homogeneous (all members
-request the same shape) — the SPMD case; heterogeneous members still bind,
-but the plan is computed from the first member's shape.
+``elasticgpu.io/gang-size``.  The first member's shape seeds the plan (the
+SPMD/homogeneous case needs nothing else).  A member arriving with a
+DIFFERENT shape triggers a full REPLAN (VERDICT r2 #5b): every
+already-claimed member is re-placed on its already-returned slot with its
+ACTUAL shape, the new member and the not-yet-seen members (assumed
+first-shape until they arrive) are placed fresh — so every shape the
+coordinator has SEEN is accounted exactly, and a heterogeneous gang that
+cannot fit is rejected at filter with a named error instead of silently
+mis-admitted and failed at the bind barrier.  Unseen members are the one
+remaining guess; a wrong guess degrades to the phase-1 all-or-nothing
+re-check at commit, never to over-commit.
 """
 
 from __future__ import annotations
@@ -91,6 +99,12 @@ class _Plan:
     # their clones (plans don't touch real allocators until bind)
     member_units: tuple = ()
     member_containers: tuple = ()
+    # per-slot ACTUAL shapes (VERDICT r2 #5b): seeded with the first
+    # member's shape, overwritten per slot when a heterogeneous member
+    # claims it via replan — reservation replay and the commit's
+    # cached-option check use THESE, not the single seed shape
+    slot_units: list = field(default_factory=list)
+    slot_containers: list = field(default_factory=list)
     # set while the single committer is writing this plan's allocations into
     # the REAL allocators — reservation replay must then skip it entirely
     committing: bool = False
@@ -207,14 +221,55 @@ class GangCoordinator:
                 plan.created = time.monotonic()
                 plan.member_units = req.units
                 plan.member_containers = req.container_names
+                plan.slot_units = [req.units] * len(plan.slots)
+                plan.slot_containers = [req.container_names] * len(plan.slots)
                 self._plans[gkey] = plan
                 GANG_EVENTS.inc("planned")
+            existing_idx = plan.claims.get(pod.key)
+            if existing_idx is None and len(plan.claims) >= len(plan.slots):
+                return [], {
+                    n: f"gang {gkey}: all {req.gang_size} slots claimed"
+                    for n in node_names
+                }
+            units_changed = (
+                existing_idx is not None
+                and req.units != plan.slot_units[existing_idx]
+            )
+            if (
+                existing_idx is None and req.units != plan.member_units
+            ) or units_changed:
+                # heterogeneous member (VERDICT r2 #5b): its slot was planned
+                # for a different shape — replan the whole gang with every
+                # SEEN shape pinned before handing out a slot.  Covers both
+                # a new member with a non-seed shape and a RE-FILTERED
+                # member whose pod was recreated with a new shape (its
+                # cached option would otherwise bind the old shape).
+                if not self._replan_hetero(
+                    sched, plan, req, node_names, gkey,
+                    pinned_idx=existing_idx,
+                ):
+                    GANG_EVENTS.inc("plan_hetero_infeasible")
+                    return [], {
+                        n: (
+                            f"gang {gkey}: heterogeneous member "
+                            f"{pod.key} (shape {req.units}) does not fit "
+                            "alongside the claimed members"
+                        )
+                        for n in node_names
+                    }
+                GANG_EVENTS.inc("replanned_hetero")
             node = plan.claim(pod.key)
             if node is None:
                 return [], {
                     n: f"gang {gkey}: all {req.gang_size} slots claimed"
                     for n in node_names
                 }
+            if existing_idx is None:
+                # record the actual claimed shape exactly once; an existing
+                # claim's shape is only ever rewritten via the replan above
+                idx = plan.claims[pod.key]
+                plan.slot_units[idx] = req.units
+                plan.slot_containers[idx] = req.container_names
             if node not in node_names:
                 return [], {
                     n: f"gang {gkey}: planned node {node} not in candidates"
@@ -258,7 +313,9 @@ class GangCoordinator:
                 return _Plan(slots=slots, options=options)
         return None
 
-    def _reserve_other_plans(self, sched, clones: dict, get_clone) -> None:
+    def _reserve_other_plans(
+        self, sched, clones: dict, get_clone, skip_key: Optional[str] = None
+    ) -> None:
         """Replay other ACTIVE plans' placements into the clones so
         concurrent gangs don't double-count the same free chips (caller holds
         self._lock).  Without this, two gangs planned back-to-back both pass
@@ -267,9 +324,13 @@ class GangCoordinator:
         A plan being COMMITTED is skipped wholesale: its allocations are
         landing in the real allocator state the clones start from (commit is
         all-or-nothing, so there is never a partially-bound slot list to
-        replay — ADVICE r1's bound-counter skew cannot occur)."""
+        replay — ADVICE r1's bound-counter skew cannot occur).  ``skip_key``
+        excludes the plan being REPLANNED (its old placements must not
+        shadow the capacity the replan is re-deriving)."""
         now = time.monotonic()
         for other_key, other in self._plans.items():
+            if other_key == skip_key:
+                continue
             if other.committing or not other.member_units:
                 continue
             if now - max(other.created, other.last_claim) > self.timeout:
@@ -290,23 +351,26 @@ class GangCoordinator:
                 member_req = TPURequest(
                     pod_uid=f"resv-{other_key}-{idx}",
                     pod_key=f"resv/{other_key}/{idx}",
-                    units=other.member_units,
-                    container_names=other.member_containers,
+                    units=(
+                        other.slot_units[idx]
+                        if idx < len(other.slot_units)
+                        else other.member_units
+                    ),
+                    container_names=(
+                        other.slot_containers[idx]
+                        if idx < len(other.slot_containers)
+                        else other.member_containers
+                    ),
                 )
                 opt = cs.trade(member_req, sched.rater)
                 if opt is not None:
                     cs.transact(opt)
 
-    def _plan_on(
-        self, sched: TPUUnitScheduler, req: TPURequest, ordered: list[str]
-    ) -> Optional[list[str]]:
-        """Greedy member placement over one candidate node group (cloned).
-
-        Members are homogeneous (same shape), so a node that cannot fit
-        member k cannot fit member k+1 either — the scan cursor only moves
-        forward, making planning O(members + nodes) instead of O(m·n)
-        (a v5p-2048 gang plans in one pass over 256 hosts)."""
-        clones = {}
+    @staticmethod
+    def _clone_ctx(sched: TPUUnitScheduler):
+        """(clones, get_clone): lazily clone per-node chip state for
+        plan simulation — plans never touch real allocators until bind."""
+        clones: dict = {}
 
         def get_clone(name):
             cs = clones.get(name)
@@ -319,6 +383,106 @@ class GangCoordinator:
                     cs = na.chips.clone()
                 clones[name] = cs
             return cs
+
+        return clones, get_clone
+
+    def _replan_hetero(
+        self,
+        sched: TPUUnitScheduler,
+        plan: _Plan,
+        req: TPURequest,
+        node_names: list[str],
+        gkey: str,
+        pinned_idx: Optional[int] = None,
+    ) -> bool:
+        """Re-place the WHOLE gang when a member's shape differs from the
+        plan's (caller holds self._lock).  Claimed members stay PINNED to
+        their already-returned slots (their filters answered; bind will
+        arrive with those nodes) with their ACTUAL shapes; a new member
+        claims the next index with ITS shape; members not yet seen keep the
+        seed shape.  ``pinned_idx`` set = the arriving member ALREADY holds
+        that claim (pod recreated with a new shape): its slot stays pinned
+        but its shape and option are re-derived, so the commit cache can
+        never apply the old shape's option.  Mutates ``plan`` in place on
+        success; on failure the plan is untouched and the caller rejects at
+        filter with a named error.  Full scan per member (no forward-only
+        cursor — a node full for one shape may fit another); heterogeneous
+        gangs are expected to be small."""
+        clones, get_clone = self._clone_ctx(sched)
+        self._reserve_other_plans(sched, clones, get_clone, skip_key=gkey)
+        n_claimed = len(plan.claims)
+        new_slots = list(plan.slots)
+        new_options = list(plan.options)
+        new_units = list(plan.slot_units)
+        new_containers = list(plan.slot_containers)
+        if pinned_idx is not None:
+            new_units[pinned_idx] = req.units
+            new_containers[pinned_idx] = req.container_names
+
+        # 1) pin claimed members to their slots with their actual shapes
+        for key, idx in sorted(plan.claims.items(), key=lambda kv: kv[1]):
+            cs = get_clone(plan.slots[idx])
+            if cs is None:
+                return False
+            member_req = TPURequest(
+                pod_uid=f"pin-{idx}", pod_key=f"pin/{idx}",
+                units=new_units[idx],
+                container_names=new_containers[idx],
+            )
+            opt = cs.trade(member_req, sched.rater)
+            if opt is None:
+                return False
+            cs.transact(opt)
+            new_options[idx] = opt
+
+        # 2) the arriving member (next claim index, unless it already holds
+        #    a pinned claim), then the unseen tail at the seed shape
+        ordered = [n for _, n in self._node_mesh_order(node_names)]
+        shapes = []
+        if pinned_idx is None:
+            shapes.append((req.units, req.container_names))
+        shapes += [(plan.member_units, plan.member_containers)] * (
+            len(plan.slots) - n_claimed - len(shapes)
+        )
+        for offset, (units, containers) in enumerate(shapes):
+            idx = n_claimed + offset
+            member_req = TPURequest(
+                pod_uid=f"replan-{idx}", pod_key=f"replan/{idx}",
+                units=units, container_names=containers,
+            )
+            placed = False
+            for name in ordered:
+                cs = get_clone(name)
+                if cs is None:
+                    continue
+                opt = cs.trade(member_req, sched.rater)
+                if opt is not None:
+                    cs.transact(opt)
+                    new_slots[idx] = name
+                    new_options[idx] = opt
+                    new_units[idx] = units
+                    new_containers[idx] = containers
+                    placed = True
+                    break
+            if not placed:
+                return False
+
+        plan.slots = new_slots
+        plan.options = new_options
+        plan.slot_units = new_units
+        plan.slot_containers = new_containers
+        return True
+
+    def _plan_on(
+        self, sched: TPUUnitScheduler, req: TPURequest, ordered: list[str]
+    ) -> Optional[list[str]]:
+        """Greedy member placement over one candidate node group (cloned).
+
+        Members are homogeneous (same shape), so a node that cannot fit
+        member k cannot fit member k+1 either — the scan cursor only moves
+        forward, making planning O(members + nodes) instead of O(m·n)
+        (a v5p-2048 gang plans in one pass over 256 hosts)."""
+        clones, get_clone = self._clone_ctx(sched)
 
         self._reserve_other_plans(sched, clones, get_clone)
         slots: list[str] = []
@@ -426,11 +590,19 @@ class GangCoordinator:
             if plan is not None:
                 plan.committing = True
                 # planned per-slot options: commit can APPLY them (validating
-                # transact) instead of re-running the trade DFS per member
+                # transact) instead of re-running the trade DFS per member.
+                # Each slot carries its OWN planned shape (heterogeneous
+                # gangs) — the cache check compares against that, not the
+                # seed shape.
                 for key, idx in plan.claims.items():
                     if idx < len(plan.options):
-                        plan_slots[key] = (plan.slots[idx], plan.options[idx])
-            plan_units = plan.member_units if plan is not None else None
+                        plan_slots[key] = (
+                            plan.slots[idx],
+                            plan.options[idx],
+                            plan.slot_units[idx]
+                            if idx < len(plan.slot_units)
+                            else plan.member_units,
+                        )
 
         try:
             # phase 1: in-memory allocation, atomic under the scheduler lock
@@ -444,7 +616,7 @@ class GangCoordinator:
                         if (
                             cached is not None
                             and cached[0] == node
-                            and request_from_pod(pod).units == plan_units
+                            and request_from_pod(pod).units == cached[2]
                         ):
                             try:
                                 sched.gang_apply_option(node, pod, cached[1])

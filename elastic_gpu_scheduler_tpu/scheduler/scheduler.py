@@ -37,7 +37,7 @@ from ..core.annotations import (
 )
 from ..core.node import NodeAllocator
 from ..core.rater import Rater
-from ..core.request import TPURequest, request_from_pod
+from ..core.request import TPURequest, pod_gang_key, request_from_pod
 from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
@@ -280,10 +280,21 @@ class TPUUnitScheduler(ResourceScheduler):
           be needed for resources (CPU/memory) this extender cannot see, so
           we only prune victims whose TPU chips we know are unnecessary.
         - Defensive re-check: a victim with priority >= the preemptor's is
-          never treated as evictable TPU capacity.
+          never treated as evictable TPU capacity — UNLESS it is a co-member
+          of a gang that already has a legitimately-evictable victim:
+          evicting any member kills the whole gang (the SPMD job cannot run
+          short), so the co-member's chips come free as collateral either
+          way and counting them is honest accounting, not an eligibility
+          override (VERDICT r2 #5a).
+        - Gang atomicity: victims of one gang free and reprieve AS A UNIT.
+          Evicting one member while reprieving another would strand the
+          reprieved member's chips on a dead job — exactly the silent-strand
+          path this closes.  The server-side handler expands the proposal
+          with same-node co-members first (handlers.py), so "evict one
+          member" can never leave siblings behind on this node.
         - Reprieve pass mirrors kube-scheduler's own victim minimisation:
-          restore highest-priority victims first, keep restored any whose
-          chips the preemptor does not need.
+          restore highest-priority victims/gangs first, keep restored any
+          whose chips the preemptor does not need.
         """
         request = request_from_pod(pod)
         with self.lock:
@@ -294,10 +305,20 @@ class TPUUnitScheduler(ResourceScheduler):
         with na.lock:
             scratch = na.chips.clone()
 
+        # a gang is evictable capacity if ANY member is below the
+        # preemptor's priority — eviction of that member kills the gang
+        evictable_gangs = {
+            g for g in (pod_gang_key(v) for v in victims
+                        if (v.spec.priority or 0) < preemptor_prio)
+            if g is not None
+        }
+
         tpu_victims: list[tuple[Pod, Option]] = []
         passthrough: list[Pod] = []
         for v in victims:
-            if (v.spec.priority or 0) >= preemptor_prio:
+            if (v.spec.priority or 0) >= preemptor_prio and (
+                pod_gang_key(v) not in evictable_gangs
+            ):
                 # not evictable TPU capacity by this pod — but never SHRINK
                 # kube-scheduler's proposal on an eligibility doubt (it
                 # treats the returned set as authoritative); keep it listed,
@@ -330,16 +351,41 @@ class TPUUnitScheduler(ResourceScheduler):
         if scratch.trade(request, self.rater) is None:
             return None
 
+        # reprieve whole gangs at once: restoring one member of a gang whose
+        # sibling stays evicted would "free" chips onto a dead job.  A gang
+        # with ANY member stuck in passthrough (unresolvable/skewed option —
+        # it stays in the returned victim set and WILL be evicted) is doomed:
+        # its freed members must never be reprieved into strands.
+        doomed_gangs = {
+            g for g in (pod_gang_key(v) for v in passthrough) if g is not None
+        }
+        groups: dict[str, list[tuple[Pod, Option]]] = {}
+        for v, opt in freed:
+            groups.setdefault(pod_gang_key(v) or f"solo/{v.key}", []).append(
+                (v, opt)
+            )
         needed: list[Pod] = []
-        for v, opt in sorted(
-            freed, key=lambda t: -(t[0].spec.priority or 0)
+        for gkey, group in sorted(
+            groups.items(),
+            key=lambda kv: -max((v.spec.priority or 0) for v, _ in kv[1]),
         ):
-            if scratch.can_transact(opt):
-                scratch.transact(opt)
-                if scratch.trade(request, self.rater) is not None:
-                    continue  # reprieved: pod fits without evicting v
+            if gkey in doomed_gangs:
+                needed.extend(v for v, _ in group)
+                continue
+            restored = []
+            ok = True
+            for v, opt in group:
+                if scratch.can_transact(opt):
+                    scratch.transact(opt)
+                    restored.append(opt)
+                else:
+                    ok = False
+                    break
+            if ok and scratch.trade(request, self.rater) is not None:
+                continue  # whole gang reprieved: pod fits without evicting it
+            for opt in reversed(restored):
                 scratch.cancel(opt)
-            needed.append(v)
+            needed.extend(v for v, _ in group)
         return needed + passthrough
 
     # -- gang split-phase primitives (scheduler/gang.py's commit protocol) ----

@@ -23,6 +23,7 @@ from ..k8s.extender import (
     MetaPod,
     MetaVictims,
 )
+from ..core.request import pod_gang_key
 from ..k8s.fake import is_not_found
 from ..k8s.objects import Pod
 from ..scheduler.registry import get_resource_scheduler
@@ -83,6 +84,49 @@ class Preemption:
         self.registry = registry
         self.clientset = clientset
 
+    def _expand_gang_victims(
+        self,
+        node: str,
+        victims: list[Pod],
+        node_pods: Optional[list[Pod]] = None,
+    ) -> list[Pod]:
+        """Pull same-node co-members of any gang victim into the victim set
+        (VERDICT r2 #5a).  Evicting one member of a bound gang kills the
+        whole SPMD job; its siblings on this node would otherwise survive as
+        dead weight holding chips until something else reaps them.  Listing
+        them as victims (a) evicts them with their gang and (b) lets the
+        scheduler's simulation count their chips as freed capacity.
+        Co-members on OTHER nodes are out of this verb's per-node scope —
+        the reconciliation controller frees their chips when the dead job's
+        pods terminate.  ``node_pods``: the node's already-fetched pod list
+        (the meta-victims path LISTed it moments ago); only the
+        full-Victims path pays a fresh LIST, and only when some victim is
+        gang-annotated.  Best-effort: a failed LIST leaves the proposal
+        unexpanded (never blocks the verb)."""
+        gang_keys = {
+            g for g in (pod_gang_key(v) for v in victims) if g is not None
+        }
+        if not gang_keys:
+            return victims
+        if node_pods is None:
+            try:
+                node_pods = self.clientset.list_pods(node_name=node)
+            except Exception as e:
+                log.warning(
+                    "preemption: gang expansion list for %s failed: %s",
+                    node, e,
+                )
+                return victims
+        present = {v.metadata.uid for v in victims}
+        extra = [
+            p
+            for p in node_pods
+            if pod_gang_key(p) in gang_keys
+            and p.metadata.uid not in present
+            and not p.is_completed()
+        ]
+        return victims + extra
+
     def handle(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
         pod = args.pod
         sched = get_resource_scheduler(self.registry, pod)
@@ -94,9 +138,14 @@ class Preemption:
         # answer keeps them in the victim set unchanged — an EMPTY victim
         # set is a positive "no evictions needed" claim kube-scheduler acts
         # on, so resolution failure must never shrink the proposal.
-        candidates: dict[str, tuple[Optional[list[Pod]], list[str], int]] = {}
+        # the 4th element is the node's already-fetched pod list when one
+        # exists (meta path) — gang expansion reuses it instead of re-LISTing
+        candidates: dict[
+            str,
+            tuple[Optional[list[Pod]], list[str], int, Optional[list[Pod]]],
+        ] = {}
         for n, v in args.node_name_to_victims.items():
-            candidates[n] = (list(v.pods), [], v.num_pdb_violations)
+            candidates[n] = (list(v.pods), [], v.num_pdb_violations, None)
         meta_nodes = {
             n: mv
             for n, mv in args.node_name_to_meta_victims.items()
@@ -116,14 +165,17 @@ class Preemption:
                 log.warning("preemption: cluster pod list failed: %s", e)
         for n, mv in meta_nodes.items():
             by_uid: Optional[dict[str, Pod]] = cluster_index
+            node_pods: Optional[list[Pod]] = None
             if by_uid is None:
                 try:
-                    by_uid = {
-                        p.metadata.uid: p
-                        for p in self.clientset.list_pods(node_name=n)
-                    }
+                    node_pods = list(self.clientset.list_pods(node_name=n))
+                    by_uid = {p.metadata.uid: p for p in node_pods}
                 except Exception as e:
                     log.warning("preemption: pod list for %s failed: %s", n, e)
+            else:
+                node_pods = [
+                    p for p in by_uid.values() if p.spec.node_name == n
+                ]
             if by_uid is None:
                 # echo the node's proposal unchanged (no pruning, no
                 # dropping — same as an extender without preemptVerb);
@@ -132,6 +184,7 @@ class Preemption:
                     None,
                     [p.uid for p in mv.pods],
                     mv.num_pdb_violations,
+                    None,
                 )
                 continue
             resolved, missing = [], []
@@ -141,15 +194,16 @@ class Preemption:
                     resolved.append(v)
                 else:
                     missing.append(p.uid)
-            candidates[n] = (resolved, missing, mv.num_pdb_violations)
+            candidates[n] = (resolved, missing, mv.num_pdb_violations, node_pods)
 
         result: dict[str, MetaVictims] = {}
-        for n, (victims, passthrough_uids, pdb) in candidates.items():
+        for n, (victims, passthrough_uids, pdb, node_pods) in candidates.items():
             if victims is None or sched is None:
                 # echo the proposal: either the LIST failed (victims=None)
                 # or the pod requests no TPU — no opinion either way
                 needed: Optional[list[Pod]] = victims or []
             else:
+                victims = self._expand_gang_victims(n, victims, node_pods)
                 needed = sched.preempt(n, pod, victims)
                 if needed is None and passthrough_uids:
                     # infeasible — but UNRESOLVED victims (deleted

@@ -556,3 +556,123 @@ def test_gang_binding_post_failure_rolls_back_everything():
     """Even after some Binding POSTs were accepted, a later member's POST
     failure must strip every ledger entry and free every chip."""
     _gang_rollback_scenario("bind")
+
+
+# -- heterogeneous gangs (VERDICT r2 #5b) ------------------------------------
+
+
+def test_heterogeneous_gang_plans_each_shape(small_stack):
+    """Members with DIFFERENT shapes: the plan re-derives itself from every
+    seen member's actual shape (no silent first-shape steering), all members
+    bind, and the ledger carries each member's true chip count."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+    shapes = [400, 200, 200]  # 4 + 2 + 2 chips
+    pods = [
+        gang_pod(f"het-{i}", "hetset", 3, core=c) for i, c in enumerate(shapes)
+    ]
+    for p in pods:
+        cluster.create_pod(p)
+    results = [None] * 3
+    threads = [
+        threading.Thread(
+            target=drive_member,
+            args=(cluster, predicate, bind, p, nodes, results, i),
+        )
+        for i, p in enumerate(pods)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(r is not None and r[0] == "ok" for r in results), results
+
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    st = sched.status()
+    used = sum(
+        c["core_total"] - c["core_avail"]
+        for ns in st["nodes"].values()
+        for c in ns["chips"].values()
+    )
+    assert used == sum(shapes), (
+        f"ledger charged {used} core units for shapes {shapes}"
+    )
+
+
+def test_heterogeneous_member_rejected_when_infeasible(small_stack):
+    """A member whose shape cannot fit alongside the claimed members is
+    rejected AT FILTER with a named error — not silently steered by a plan
+    that never accounted for it (the r2 mis-admission path)."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+    first = gang_pod("big-0", "bigset", 2, core=400)
+    cluster.create_pod(first)
+    filt = predicate.handle(ExtenderArgs(pod=first, node_names=nodes))
+    assert filt.node_names, filt.failed_nodes
+
+    # second member asks for 8 chips — no node holds more than 4
+    monster = gang_pod("big-1", "bigset", 2, core=800)
+    cluster.create_pod(monster)
+    filt2 = predicate.handle(ExtenderArgs(pod=monster, node_names=nodes))
+    assert not filt2.node_names
+    msgs = " ".join(filt2.failed_nodes.values())
+    assert "heterogeneous" in msgs and "big-1" in msgs, msgs
+
+
+def test_extra_hetero_member_gets_clean_rejection(small_stack):
+    """A surplus member with a NEW shape arriving after every slot is
+    claimed gets the 'all slots claimed' rejection, not an exception."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+    for i in range(2):
+        p = gang_pod(f"full-{i}", "fullset", 2, core=200)
+        cluster.create_pod(p)
+        filt = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+        assert filt.node_names, filt.failed_nodes
+    straggler = gang_pod("full-extra", "fullset", 2, core=100)
+    cluster.create_pod(straggler)
+    filt = predicate.handle(ExtenderArgs(pod=straggler, node_names=nodes))
+    assert not filt.node_names
+    assert "slots claimed" in " ".join(filt.failed_nodes.values())
+
+
+def test_recreated_member_with_new_shape_replans(small_stack):
+    """A claimed member whose pod is recreated with a different shape must
+    re-derive its slot's option — binding the OLD shape's cached option
+    would charge the wrong chip count."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+    first = gang_pod("rc-0", "rcset", 2, core=400)
+    cluster.create_pod(first)
+    filt = predicate.handle(ExtenderArgs(pod=first, node_names=nodes))
+    assert filt.node_names, filt.failed_nodes
+
+    # recreate rc-0 with HALF the shape before any bind arrives
+    cluster.delete_pod("default", "rc-0")
+    smaller = gang_pod("rc-0", "rcset", 2, core=200)
+    cluster.create_pod(smaller)
+    filt = predicate.handle(ExtenderArgs(pod=smaller, node_names=nodes))
+    assert filt.node_names, filt.failed_nodes
+
+    second = gang_pod("rc-1", "rcset", 2, core=400)
+    cluster.create_pod(second)
+    results = [None] * 2
+    threads = [
+        threading.Thread(
+            target=drive_member,
+            args=(cluster, predicate, bind, p, nodes, results, i),
+        )
+        for i, p in enumerate([smaller, second])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(r is not None and r[0] == "ok" for r in results), results
+    st = registry[consts.RESOURCE_TPU_CORE].status()
+    used = sum(
+        c["core_total"] - c["core_avail"]
+        for ns in st["nodes"].values()
+        for c in ns["chips"].values()
+    )
+    assert used == 600, f"ledger charged {used}, want 200+400"

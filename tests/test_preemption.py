@@ -367,3 +367,160 @@ def test_http_preemption_route(stack):
         assert {p["UID"] for p in got} == want
     finally:
         server.stop()
+
+
+# -- gang-aware preemption (VERDICT r2 #5a) ----------------------------------
+
+
+def gang_pod(name, gname, gsize, core=100, priority=1):
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: core}
+                ),
+            )
+        ],
+        priority=priority,
+        annotations={
+            consts.ANNOTATION_GANG_NAME: gname,
+            consts.ANNOTATION_GANG_SIZE: str(gsize),
+        },
+    )
+
+
+def bind_gang(cluster, sched, gname, n, priorities, core=100):
+    members = []
+    for i, prio in zip(range(n), priorities):
+        m = gang_pod(f"{gname}-{i}", gname, n, core=core, priority=prio)
+        cluster.create_pod(m)
+        ok, failed = sched.assume(["node-0"], m)
+        assert ok == ["node-0"], failed
+        members.append(sched.bind("node-0", m))
+    return members
+
+
+def test_evicting_one_gang_member_frees_whole_gang(stack):
+    """kube-scheduler proposes ONE member of a 2-member gang; the handler
+    expands the proposal with the same-node co-member and the simulation
+    counts BOTH members' chips — the preemptor that needs both fits, and no
+    sibling is left stranded on the dead job."""
+    cluster, clientset, registry, sched = stack
+    members = bind_gang(cluster, sched, "g1", 2, [1, 1])
+    bind_victims(cluster, sched, 2, [200, 200])  # rest of the node, high prio
+    preemptor = tpu_pod("hi", core=200, priority=100)
+
+    # scheduler-level, unexpanded: one member frees one chip -> infeasible
+    assert sched.preempt("node-0", preemptor, [members[0]]) is None
+
+    handler = Preemption(registry, clientset)
+    res = handler.handle(
+        ExtenderPreemptionArgs(
+            pod=preemptor,
+            node_name_to_victims={"node-0": Victims(pods=[members[0]])},
+        )
+    )
+    got = {p.uid for p in res.node_name_to_meta_victims["node-0"].pods}
+    assert got == {m.metadata.uid for m in members}, (
+        "both gang members must be evicted together"
+    )
+
+
+def test_gang_reprieve_is_atomic(stack):
+    """Reprieve restores whole gangs, never single members: with a free
+    chip on the node, the higher-priority group is reprieved as a unit and
+    the lower-priority group is evicted as a unit."""
+    cluster, clientset, registry, sched = stack
+    members = bind_gang(cluster, sched, "g2", 2, [3, 3])
+    solo = bind_victims(cluster, sched, 1, [1])  # 1 chip; 1 chip stays free
+    preemptor = tpu_pod("hi", core=200, priority=100)
+
+    needed = sched.preempt("node-0", preemptor, members + solo)
+    assert needed is not None
+    keys = {v.metadata.name for v in needed}
+    # gang (prio 3) restored first as a unit -> with the free chip the
+    # preemptor no longer fits -> solo (prio 1) must go; reprieving one
+    # gang member and evicting the other would be a strand
+    assert keys == {"victim-0"}, keys
+
+    # flipped priorities: the gang is the low-priority group and goes as a
+    # unit while the solo is reprieved
+    cluster2 = FakeCluster()
+    cluster2.add_node(make_tpu_node("node-0", chips=4, hbm_gib=64))
+    clientset2 = FakeClientset(cluster2)
+    registry2, *_ = build_stack(
+        clientset2, cluster=cluster2, priority="binpack"
+    )
+    sched2 = next(iter(registry2.values()))
+    members2 = bind_gang(cluster2, sched2, "g2", 2, [1, 1])
+    solo2 = bind_victims(cluster2, sched2, 1, [3])
+    needed2 = sched2.preempt("node-0", preemptor, members2 + solo2)
+    assert needed2 is not None
+    assert {v.metadata.name for v in needed2} == {"g2-0", "g2-1"}
+
+
+def test_gang_collateral_member_counts_as_capacity(stack):
+    """A co-member whose priority exceeds the preemptor's still frees its
+    chips when a legitimately-evictable sibling dies: the gang cannot run
+    short, so the chips come back either way."""
+    cluster, clientset, registry, sched = stack
+    lo = gang_pod("g3-lo", "g3", 2, core=100, priority=1)
+    hi = gang_pod("g3-hi", "g3", 2, core=100, priority=500)
+    for m in (lo, hi):
+        cluster.create_pod(m)
+        ok, failed = sched.assume(["node-0"], m)
+        assert ok == ["node-0"], failed
+    lo_b = sched.bind("node-0", lo)
+    hi_b = sched.bind("node-0", hi)
+    bind_victims(cluster, sched, 2, [600, 600])
+    preemptor = tpu_pod("hi-preemptor", core=200, priority=100)
+
+    # without the gang rule the hi member would be passthrough (prio 500 >=
+    # 100) and only one chip would free -> infeasible; with it, both count
+    needed = sched.preempt("node-0", preemptor, [lo_b, hi_b])
+    assert needed is not None
+    assert {v.metadata.name for v in needed} == {"g3-lo", "g3-hi"}
+
+
+def test_solo_equal_priority_still_not_evictable(stack):
+    """The gang-collateral rule must NOT relax the defensive passthrough
+    for non-gang victims: an equal-priority solo victim still contributes
+    no capacity."""
+    cluster, clientset, registry, sched = stack
+    victims = bind_victims(cluster, sched, 4, [100, 100, 100, 100])
+    preemptor = tpu_pod("hi", core=200, priority=100)
+    assert sched.preempt("node-0", preemptor, victims) is None
+
+
+def test_doomed_gang_member_never_reprieved(stack):
+    """A gang with one member stuck in passthrough (skewed option — it WILL
+    be evicted) is doomed: its resolvable sibling must stay evicted too,
+    not be 'reprieved' into a strand on the dead job."""
+    from elastic_gpu_scheduler_tpu.utils import consts as C
+
+    cluster, clientset, registry, sched = stack
+    # real gang member holding chip 0
+    real = gang_pod("gd-real", "gd", 2, core=100, priority=1)
+    cluster.create_pod(real)
+    ok, failed = sched.assume(["node-0"], real)
+    assert ok == ["node-0"], failed
+    real_b = sched.bind("node-0", real)
+    # sibling with a FORGED ledger claim on chips that are actually free —
+    # can_cancel fails, so it lands in passthrough
+    forged = gang_pod("gd-forged", "gd", 2, core=200, priority=1)
+    forged.spec.node_name = "node-0"
+    forged.metadata.annotations[C.ANNOTATION_ASSUMED] = "true"
+    forged.metadata.annotations[C.ANNOTATION_CONTAINER_PREFIX + "main"] = "2,3"
+    cluster.create_pod(forged)
+    # preemptor needs ONE chip; three are genuinely free, so every victim
+    # would normally be reprieved — but the doomed gang may not be
+    preemptor = tpu_pod("hi", core=100, priority=100)
+    needed = sched.preempt("node-0", preemptor, [real_b, forged])
+    assert needed is not None
+    names = {v.metadata.name for v in needed}
+    assert "gd-forged" in names  # passthrough, always listed
+    assert "gd-real" in names, (
+        "sibling of a doomed gang must stay evicted, not stranded"
+    )
