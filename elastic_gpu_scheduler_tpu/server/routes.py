@@ -13,6 +13,9 @@ Reference: pkg/routes/routes.go.  Paths kept wire-compatible:
     GET  /metrics               → Prometheus text (net-new; reference has none)
     GET  /debug/stacks          → all-thread stack dump (pprof analogue;
                                   reference mounts net/http/pprof, pprof.go)
+    GET  /debug/pprof/heap      → tracemalloc heap report; ?diff=1 = growth
+                                  since previous call (leak probe; reference
+                                  heap/allocs endpoints, pprof.go:10-64)
 
 Deviation (SURVEY §5 quirk not replicated): the reference's prioritize route
 panics on malformed input (routes.go:98,103,109); here every route returns a
@@ -80,6 +83,70 @@ def sample_cpu_profile(seconds: float, interval: float = 0.005) -> str:
     ]
     for k, v in sorted(counts.items(), key=lambda kv: -kv[1])[:300]:
         lines.append(f"{v} {k}")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_query(query: str) -> dict[str, str]:
+    """?a=b&c=d → {a: b, c: d} with URL decoding; last value wins."""
+    from urllib.parse import parse_qsl
+
+    return dict(parse_qsl(query, keep_blank_values=True))
+
+
+_heap_state: dict = {"snapshot": None}
+_heap_lock = threading.Lock()
+
+
+def heap_profile(top_n: int = 30, diff: bool = False) -> str:
+    """tracemalloc-backed heap report (the reference mounts net/http/pprof's
+    heap/allocs endpoints, pprof.go:10-64; this is the Python analogue).
+
+    Plain call: top-N live allocation sites by size.  ``diff=True``:
+    growth per site since the PREVIOUS /debug/pprof/heap call — the leak
+    probe for a long-lived scheduler (the soak test asserts steady-state
+    growth stays bounded).  Tracing starts lazily on first call: ~2x alloc
+    overhead while on, zero when never requested."""
+    import tracemalloc
+
+    started_now = False
+    if not tracemalloc.is_tracing():
+        # 1 frame/allocation: every report groups by "lineno" (single
+        # frame), so deeper stored stacks would only multiply overhead
+        tracemalloc.start(1)
+        started_now = True
+    snap = tracemalloc.take_snapshot().filter_traces([
+        tracemalloc.Filter(False, "<frozen importlib._bootstrap>"),
+        tracemalloc.Filter(False, "<frozen importlib._bootstrap_external>"),
+        tracemalloc.Filter(False, tracemalloc.__file__),
+    ])
+    cur, peak = tracemalloc.get_traced_memory()
+    lines = [
+        f"# tracemalloc: current={cur / 1024:.1f}KiB peak={peak / 1024:.1f}KiB"
+        + (
+            " (tracing just started; sites cover allocations from now on)"
+            if started_now
+            else ""
+        )
+    ]
+    with _heap_lock:
+        prev = _heap_state["snapshot"]
+        _heap_state["snapshot"] = snap
+    if diff and prev is not None:
+        lines.append(
+            "# growth since previous /debug/pprof/heap call, "
+            "largest deltas first"
+        )
+        for st in snap.compare_to(prev, "lineno")[:top_n]:
+            lines.append(
+                f"{st.size_diff / 1024:+.1f}KiB ({st.count_diff:+d} blocks, "
+                f"now {st.size / 1024:.1f}KiB) {st.traceback}"
+            )
+    else:
+        lines.append("# top live allocation sites by size")
+        for st in snap.statistics("lineno")[:top_n]:
+            lines.append(
+                f"{st.size / 1024:.1f}KiB ({st.count} blocks) {st.traceback}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -226,14 +293,23 @@ class ExtenderServer:
                 out.extend(traceback.format_stack(frame))
             return 200, "".join(out).encode(), "text/plain"
         if path == "/debug/pprof/profile":
+            params = _parse_query(query)
             try:
-                params = dict(
-                    kv.split("=", 1) for kv in query.split("&") if "=" in kv
-                )
                 secs = float(params.get("seconds", "2"))
             except ValueError:
                 secs = 2.0
             return 200, sample_cpu_profile(secs).encode(), "text/plain"
+        if path == "/debug/pprof/heap":
+            params = _parse_query(query)
+            try:
+                top = int(params.get("top", "30"))
+            except ValueError:
+                top = 30
+            diff = params.get("diff", "0") not in ("0", "", "false")
+            try:
+                return 200, heap_profile(top, diff).encode(), "text/plain"
+            except Exception as e:
+                return 500, f"heap profile failed: {e}".encode(), "text/plain"
         return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
 
     def _route_post(self, path: str, raw: bytes) -> tuple[int, bytes, str]:

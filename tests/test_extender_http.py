@@ -334,6 +334,23 @@ def test_get_with_query_string_and_pprof_profile(stack):
     ) as r:
         body = r.read().decode()
         assert r.status == 200 and "sampling rounds" in body
+    import tracemalloc
+
+    try:
+        with urllib.request.urlopen(
+            base + "/debug/pprof/heap?top=5", timeout=15
+        ) as r:
+            body = r.read().decode()
+            assert r.status == 200 and "allocation sites" in body
+        with urllib.request.urlopen(
+            base + "/debug/pprof/heap?diff=1", timeout=15
+        ) as r:
+            body = r.read().decode()
+            assert r.status == 200 and "growth since previous" in body
+    finally:
+        # the endpoint starts tracing lazily IN-PROCESS; stop it so the
+        # rest of the suite doesn't pay the ~2x allocation overhead
+        tracemalloc.stop()
 
 
 def test_worker_pool_overflow_makes_progress():
