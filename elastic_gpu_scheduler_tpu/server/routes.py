@@ -324,9 +324,21 @@ class ExtenderServer:
             VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "bad_request")
             return 400, b'{"Error": "malformed JSON body"}', "application/json"
         if path == "/scheduler/filter":
+            # the nodeCacheCapable=false (Nodes-list) form is refused by
+            # Predicate.handle itself with the reference's 200+Error shape
+            # (routes.go:59-64) — no route-level special case needed
             return self._verb("filter", lambda: self.predicate.handle(
                 ExtenderArgs.from_dict(body)).to_dict())
         if path == "/scheduler/priorities":
+            if ExtenderArgs.from_dict(body).node_names is None:
+                # nodeCacheCapable=false form: the reference PANICS here
+                # (routes.go:98,103 — SURVEY quirk not replicated);
+                # structured 400 instead
+                VERB_TOTAL.inc("priorities", "nodes_form_rejected")
+                return 400, json.dumps({
+                    "Error": "priorities requires the nodeCacheCapable=true "
+                             "NodeNames form",
+                }).encode(), "application/json"
             return self._verb("priorities", lambda: [
                 hp.to_dict()
                 for hp in self.prioritize.handle(ExtenderArgs.from_dict(body))

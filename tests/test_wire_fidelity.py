@@ -1,0 +1,278 @@
+"""Wire fidelity against k8s.io/kube-scheduler/extender/v1 (VERDICT r2 #6).
+
+The fixtures below are transcribed VERBATIM in the shape Go's encoding/json
+produces for the real extender/v1 types (k8s.io/kube-scheduler/extender/v1
+types.go — the module the reference imports, go.mod): the extender structs
+carry NO json tags, so fields marshal under their Go names ("Pod",
+"NodeNames", "FailedNodes", "NodeNameToMetaVictims", "NumPDBViolations",
+"UID", ...), while the EMBEDDED core/v1 objects use their lowerCamel tags
+("metadata", "spec", "containers", "resources") with resource quantities as
+canonical STRINGS ("2", "200m", "1Gi") — resource.Quantity marshals to a
+string, never a number.  Builder-authored tests elsewhere use ints for
+brevity; these fixtures exist to catch exactly the skew those cannot
+(reference routes.go:46-49,94-99,126-129).
+
+Every test drives the PRODUCTION HTTP server over a real socket with raw
+fixture bytes — no repo-side to_dict() on the request path.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import Pod, make_tpu_node
+from elastic_gpu_scheduler_tpu.server.handlers import Preemption
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+
+
+@pytest.fixture()
+def served():
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(make_tpu_node(f"node-{i}", chips=4, hbm_gib=64))
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, priority="binpack")
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status,
+        preemption=Preemption(registry, clientset),
+        host="127.0.0.1", port=0,
+    )
+    port = server.start()
+    yield cluster, registry, f"http://127.0.0.1:{port}"
+    server.stop()
+
+
+def post_raw(base, path, raw: str):
+    req = urllib.request.Request(
+        base + path, raw.encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+# -- golden fixtures ---------------------------------------------------------
+
+# v1.Pod exactly as the apiserver/kube-scheduler marshal it: lowerCamel keys,
+# creationTimestamp:null always present in metadata, quantities as strings
+# (cpu "200m", memory "1Gi" sit in the same map as the TPU resources and must
+# not disturb parsing), status struct always emitted.
+POD_JSON = """{
+  "metadata": {
+    "name": "tpu-train-0",
+    "namespace": "default",
+    "uid": "8f7e4c62-1f2b-4f3e-9c70-000000000001",
+    "creationTimestamp": null,
+    "labels": {"app": "trainer"},
+    "annotations": {}
+  },
+  "spec": {
+    "containers": [
+      {
+        "name": "worker",
+        "image": "trainer:v1",
+        "resources": {
+          "limits": {
+            "cpu": "2",
+            "memory": "1Gi",
+            "elasticgpu.io/tpu-core": "200",
+            "elasticgpu.io/tpu-hbm": "4"
+          },
+          "requests": {
+            "cpu": "200m",
+            "memory": "512Mi",
+            "elasticgpu.io/tpu-core": "200",
+            "elasticgpu.io/tpu-hbm": "4"
+          }
+        },
+        "terminationMessagePath": "/dev/termination-log",
+        "imagePullPolicy": "IfNotPresent"
+      }
+    ],
+    "restartPolicy": "Never",
+    "priority": 1000,
+    "schedulerName": "default-scheduler"
+  },
+  "status": {"phase": "Pending", "qosClass": "Burstable"}
+}"""
+
+FILTER_ARGS = '{"Pod": %s, "NodeNames": ["node-0", "node-1"]}' % POD_JSON
+
+# nodeCacheCapable=false form: kube-scheduler sends the FULL NodeList under
+# "Nodes" and NO "NodeNames" (encoding/json omits the nil *[]string).  The
+# reference rejects this form with a structured Error (routes.go:59-64).
+FILTER_ARGS_NODES_FORM = (
+    '{"Pod": %s, "Nodes": {"metadata": {}, "items": [{'
+    '"metadata": {"name": "node-0", "creationTimestamp": null}, '
+    '"spec": {}, '
+    '"status": {"allocatable": {"cpu": "8", "memory": "32Gi", '
+    '"elasticgpu.io/tpu-core": "400", "elasticgpu.io/tpu-hbm": "64"}}'
+    "}]}}" % POD_JSON
+)
+
+BIND_ARGS = """{
+  "PodName": "tpu-train-0",
+  "PodNamespace": "default",
+  "PodUID": "8f7e4c62-1f2b-4f3e-9c70-000000000001",
+  "Node": "node-0"
+}"""
+
+# ExtenderPreemptionArgs, nodeCacheCapable=true: victims arrive as
+# NodeNameToMetaVictims (UID-only MetaPods + int64 NumPDBViolations)
+PREEMPT_ARGS_META = """{
+  "Pod": %s,
+  "NodeNameToMetaVictims": {
+    "node-0": {
+      "Pods": [{"UID": "%s"}],
+      "NumPDBViolations": 1
+    }
+  }
+}"""
+
+EXTENDER_FILTER_RESULT_KEYS = {
+    "Nodes", "NodeNames", "FailedNodes", "FailedAndUnresolvableNodes",
+    "Error",
+}
+
+
+def test_filter_fixture_roundtrip(served):
+    cluster, registry, base = served
+    cluster.create_pod(Pod.from_dict(json.loads(POD_JSON)))
+    code, res = post_raw(base, "/scheduler/filter", FILTER_ARGS)
+    assert code == 200
+    # every key the Go client will look for must use the exact Go name
+    assert set(res) <= EXTENDER_FILTER_RESULT_KEYS, set(res)
+    assert not res.get("Error"), res
+    assert res["NodeNames"], res
+    # 200 core + cpu/memory noise parsed as 2 whole chips on one node
+    code, prio = post_raw(
+        base, "/scheduler/priorities",
+        '{"Pod": %s, "NodeNames": %s}' % (POD_JSON, json.dumps(res["NodeNames"])),
+    )
+    assert code == 200 and isinstance(prio, list)
+    for hp in prio:
+        assert set(hp) == {"Host", "Score"} and isinstance(hp["Score"], int)
+
+
+def test_filter_rejects_nodes_form(served):
+    cluster, registry, base = served
+    cluster.create_pod(Pod.from_dict(json.loads(POD_JSON)))
+    code, res = post_raw(base, "/scheduler/filter", FILTER_ARGS_NODES_FORM)
+    # reference behavior: HTTP 200 with a structured Error body, not a
+    # transport failure (routes.go:59-64)
+    assert code == 200
+    assert "nodeCacheCapable" in res.get("Error", ""), res
+    assert not res.get("NodeNames")
+
+
+def test_priorities_rejects_nodes_form_without_panic(served):
+    cluster, registry, base = served
+    cluster.create_pod(Pod.from_dict(json.loads(POD_JSON)))
+    req = urllib.request.Request(
+        base + "/scheduler/priorities", FILTER_ARGS_NODES_FORM.encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected a 4xx")
+    except urllib.error.HTTPError as e:
+        # the reference PANICS on this (routes.go:98,103) — documented
+        # deviation: structured 400
+        assert e.code == 400
+        assert "NodeNames" in json.loads(e.read()).get("Error", "")
+
+
+def test_bind_fixture_and_annotation_ledger(served):
+    cluster, registry, base = served
+    cluster.create_pod(Pod.from_dict(json.loads(POD_JSON)))
+    code, res = post_raw(base, "/scheduler/filter", FILTER_ARGS)
+    assert code == 200 and res["NodeNames"]
+    code, bres = post_raw(base, "/scheduler/bind", BIND_ARGS)
+    assert code == 200
+    assert set(bres) <= {"Error"} and not bres.get("Error"), bres
+    bound = cluster.get_pod("default", "tpu-train-0")
+    assert bound.spec.node_name == "node-0"
+    # 2 whole chips from the string quantity "200"
+    coords = bound.metadata.annotations.get(
+        "elasticgpu.io/container-worker", ""
+    )
+    assert len(coords.split(";")) == 2 or len(coords.split(",")) >= 2, coords
+
+
+def test_preemption_meta_victims_roundtrip(served):
+    cluster, registry, base = served
+    # fill node-0 with a low-priority whole-node pod bound through the wire
+    victim_json = POD_JSON.replace("tpu-train-0", "victim-a").replace(
+        '"priority": 1000', '"priority": 1'
+    ).replace('"elasticgpu.io/tpu-core": "200"', '"elasticgpu.io/tpu-core": "400"')
+    victim = Pod.from_dict(json.loads(victim_json))
+    victim.metadata.uid = "victim-uid-000000000000000000000001"
+    cluster.create_pod(victim)
+    code, res = post_raw(
+        base, "/scheduler/filter",
+        '{"Pod": %s, "NodeNames": ["node-0"]}'
+        % json.dumps(victim.to_dict()),
+    )
+    assert code == 200 and res["NodeNames"] == ["node-0"], res
+    code, bres = post_raw(
+        base, "/scheduler/bind",
+        json.dumps({
+            "PodName": "victim-a", "PodNamespace": "default",
+            "PodUID": victim.metadata.uid, "Node": "node-0",
+        }),
+    )
+    assert code == 200 and not bres.get("Error"), bres
+
+    code, res = post_raw(
+        base, "/scheduler/preemption",
+        PREEMPT_ARGS_META % (POD_JSON, victim.metadata.uid),
+    )
+    assert code == 200
+    assert set(res) == {"NodeNameToMetaVictims"}, set(res)
+    mv = res["NodeNameToMetaVictims"]["node-0"]
+    assert set(mv) == {"Pods", "NumPDBViolations"}, mv
+    assert mv["NumPDBViolations"] == 1  # PDB count passed through unchanged
+    assert {p["UID"] for p in mv["Pods"]} == {victim.metadata.uid}
+
+
+def test_quantity_parsing_matches_go_value_semantics():
+    """parse_quantity mirrors resource.Quantity.Value(): canonical string
+    forms, binary/decimal suffixes, scientific notation, ceil rounding."""
+    from elastic_gpu_scheduler_tpu.core.request import parse_quantity
+
+    assert parse_quantity("2") == 2
+    assert parse_quantity(200) == 200
+    assert parse_quantity("200m") == 1        # Value() rounds UP
+    assert parse_quantity("1500m") == 2
+    assert parse_quantity("0.5") == 1
+    assert parse_quantity("1Gi") == 1 << 30
+    assert parse_quantity("512Mi") == 512 << 20
+    assert parse_quantity("128Ki") == 128 << 10
+    for bad_suffix in ("2ki", "2K", "2i"):  # not in the Quantity grammar
+        with pytest.raises(ValueError):
+            parse_quantity(bad_suffix)
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity("2M") == 2_000_000
+    assert parse_quantity("2e3") == 2000
+    assert parse_quantity("1.5e2") == 150
+    for bad in ("abc", "1.2.3", "12x", "", True):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+
+def test_string_quantities_through_request_parse():
+    """The same pod parsed with int quantities and with the apiserver's
+    string marshaling must yield identical TPU requests."""
+    from elastic_gpu_scheduler_tpu.core.request import request_from_pod
+
+    pod = Pod.from_dict(json.loads(POD_JSON))
+    req = request_from_pod(pod)
+    assert len(req.units) == 1
+    assert req.units[0].chip_count == 2  # "200" core = 2 whole chips
+    assert req.units[0].hbm == 4
