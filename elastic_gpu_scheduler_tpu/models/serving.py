@@ -158,6 +158,37 @@ class Request:
         self.cancelled = True
 
 
+def _shard_params_for_mesh(params, mesh):
+    """Place weights under the training sharding rules fitted to this mesh
+    (parallel/sharding.shard_params strict=False: mesh-absent axes drop,
+    non-divisible dims replicate — arbitrary checkpoints must load)."""
+    from ..parallel.sharding import shard_params
+
+    if "tensor" not in mesh.axis_names:
+        raise ValueError(
+            f"serving mesh needs a 'tensor' axis, got {mesh.axis_names}"
+        )
+    return shard_params(params, mesh, strict=False)
+
+
+def _shard_kv_for_mesh(kv, cfg, mesh):
+    """Shard the paged pool's kv-head axis over ``tensor``: each rank owns
+    its heads' pages whole, so page tables and host bookkeeping need no
+    changes.  Falls back to replication when the head count doesn't divide
+    (small GQA models) — correct, just memory-unsaving."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    t = mesh.shape["tensor"]
+    heads_ok = cfg.kv_heads % t == 0
+    spec5 = P(None, None, None, "tensor", None) if heads_ok else P()
+    spec4 = P(None, None, None, "tensor") if heads_ok else P()
+    out = {}
+    for name, arr in kv.items():
+        spec = spec5 if arr.ndim == 5 else spec4
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
 def build_lora_bank(
     adapters: dict[str, dict], dtype, base_layers: Optional[dict] = None
 ) -> tuple[dict, dict[str, int]]:
@@ -660,6 +691,7 @@ class InferenceEngine:
         adapters: Optional[dict[str, dict]] = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        mesh=None,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -672,8 +704,22 @@ class InferenceEngine:
         tokens); steps where only sampled slots are generating fall back
         to the sequential fused chunk automatically.  ``spec_ngram`` is
         the prompt-lookup match length (models/speculative.propose_ngram).
+
+        ``mesh``: serve TENSOR-PARALLEL over a `jax.sharding.Mesh` with a
+        ``tensor`` axis — for checkpoints too big for one chip's HBM.
+        Weights take the training sharding rules (parallel/sharding.py)
+        restricted to the mesh's axes; the paged KV pool shards its
+        kv-head axis over ``tensor`` (each rank holds its own heads'
+        pages — pages stay whole per rank, so the host-side page/table
+        machinery is untouched); activations/collectives are GSPMD's from
+        there, exactly as in training.  Host-side state (tables, lengths,
+        prompts, prefix cache) is unsharded — the engine logic is
+        identical single-chip and multi-chip.
         """
-        self.params = params
+        self.mesh = mesh
+        self.params = (
+            params if mesh is None else _shard_params_for_mesh(params, mesh)
+        )
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len
@@ -686,6 +732,8 @@ class InferenceEngine:
         self.fused_steps = max(1, fused_steps)
         self.kv_int8 = kv_int8
         self.kv = make_kv_pool(cfg, self.n_pages, page_size, kv_int8)
+        if mesh is not None:
+            self.kv = _shard_kv_for_mesh(self.kv, cfg, mesh)
         self.free_pages = list(range(self.n_pages - 1, SCRATCH_PAGE, -1))
         self.tables = np.zeros(
             (max_batch, self.max_pages_per_slot), np.int32

@@ -86,10 +86,44 @@ def activation_spec() -> P:
     return P(("data", "fsdp"), "seq", None)
 
 
-def shard_params(params: Any, mesh: Mesh, pipeline: bool = False) -> Any:
+def _fit_spec(spec: P, mesh: Mesh, shape) -> P:
+    """Best-effort restriction of a spec to what ``mesh`` and ``shape``
+    allow: axes the mesh doesn't have are dropped (a pure-tensor serving
+    mesh has no fsdp/expert/pipe), and a dim that doesn't divide by its
+    axes' total size replicates instead of erroring (arbitrary checkpoints
+    — e.g. an odd vocab under tensor=2 — must still load)."""
+    fitted = []
+    for i, ax in enumerate(spec):
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,) if ax else ()
+        kept = tuple(a for a in axes if a in mesh.axis_names)
+        div = 1
+        for a in kept:
+            div *= mesh.shape[a]
+        if not kept or shape[i] % div != 0:
+            fitted.append(None)
+        else:
+            fitted.append(kept if isinstance(ax, (tuple, list)) else kept[0])
+    return P(*fitted)
+
+
+def shard_params(
+    params: Any, mesh: Mesh, pipeline: bool = False, strict: bool = True
+) -> Any:
+    """Place params under the sharding rules.  ``strict=False`` fits each
+    leaf's spec to the mesh and shape via ``_fit_spec`` — the mode for
+    serving arbitrary checkpoints on arbitrary meshes (and for restoring
+    onto a smaller mesh than a job trained on)."""
     specs = param_specs(params, pipeline=pipeline)
+    if strict:
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs,
+        )
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, _fit_spec(s, mesh, x.shape))
+        ),
+        params, specs,
     )
 
 

@@ -49,6 +49,10 @@ def build_args(argv=None):
     p.add_argument("--fused-steps", type=int, default=16)
     p.add_argument("--kv-int8", action="store_true")
     p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--tensor", type=int, default=1,
+                   help="serve tensor-parallel over this many devices "
+                        "(checkpoints bigger than one chip's HBM); needs "
+                        ">= that many attached devices")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend in-process (overrides a "
                         "sticky JAX_PLATFORMS from site config; tests/dev)")
@@ -73,6 +77,33 @@ def main(argv=None) -> int:
     from .models.serving import InferenceEngine
     from .models.transformer import TransformerConfig, init_params
     from .server.inference import serve_inference
+
+    # build the mesh BEFORE loading any weights: with --tensor the whole
+    # point is a checkpoint that does NOT fit one chip, so conversion and
+    # quantization must materialize on HOST (default_device cpu) and the
+    # engine then device_puts each leaf straight to its shard — no single
+    # chip ever holds the full model
+    mesh = None
+    host_ctx = None
+    if args.tensor > 1:
+        from contextlib import ExitStack
+
+        from .parallel.mesh import MeshSpec, make_mesh
+
+        devs = jax.devices()
+        if len(devs) < args.tensor:
+            raise SystemExit(
+                f"--tensor {args.tensor} needs that many devices, "
+                f"have {len(devs)}"
+            )
+        mesh = make_mesh(MeshSpec(tensor=args.tensor), devs[: args.tensor])
+        host_ctx = ExitStack()
+        try:
+            host_ctx.enter_context(
+                jax.default_device(jax.local_devices(backend="cpu")[0])
+            )
+        except RuntimeError:
+            host_ctx = None  # no CPU backend (already ON cpu): no-op
 
     if args.hf:
         from .models.convert import config_from_hf_llama, params_from_hf_llama
@@ -118,12 +149,15 @@ def main(argv=None) -> int:
 
         params = quantize_params(params)
 
+    if host_ctx is not None:
+        host_ctx.close()  # params are host-resident; sharded placement next
+
     engine = InferenceEngine(
         params, cfg,
         max_batch=args.max_batch, max_len=args.max_len,
         page_size=args.page_size, n_pages=args.n_pages,
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
-        prefix_cache=args.prefix_cache,
+        prefix_cache=args.prefix_cache, mesh=mesh,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(
