@@ -674,6 +674,74 @@ def _fused_verify_chunk(
     return picked, new_kv
 
 
+def _draft_forward(dparams, dkv, feed, starts, *, dcfg):
+    """Contiguous-cache forward for the DRAFT model (draft-model
+    speculation): W tokens per row at PER-ROW start positions against a
+    dense (L, B, M, Hkv, Dh) cache — the draft is small, so it skips the
+    paged pool entirely and with it all page bookkeeping.  Rollback is
+    free by the same argument as the big engine's verify window: rows past
+    a row's valid count hold garbage only at positions a later call
+    rewrites before they can become valid.  Returns (logits (B, W, V),
+    dkv')."""
+    dtype = jnp.dtype(dcfg.dtype)
+    B, W = feed.shape
+    Hn, Dh, Hkv = dcfg.n_heads, dcfg.head_dim, dcfg.kv_heads
+    M = dkv["k"].shape[2]  # max_len + 1: index M-1 is the overflow scratch
+    x = _embed_lookup(dparams["embed"], feed, dtype)  # (B, W, D)
+    positions = starts[:, None] + jnp.arange(W)  # (B, W)
+    pos_w = jnp.minimum(positions, M - 1)  # overflow → scratch row
+    rows = jnp.arange(B)[:, None]
+
+    def layer_step(x, scanned):
+        p, lk, lv = scanned
+        h = rms_norm(x, p["attn_norm"])
+        q = (h @ wmat(p["wq"], dtype)).reshape(B, W, Hn, Dh)
+        k = (h @ wmat(p["wk"], dtype)).reshape(B, W, Hkv, Dh)
+        v = (h @ wmat(p["wv"], dtype)).reshape(B, W, Hkv, Dh)
+        q = _rope_rows(q, positions, dcfg.rope_theta)
+        k = _rope_rows(k, positions, dcfg.rope_theta)
+        lk = lk.at[rows, pos_w].set(k.astype(lk.dtype))
+        lv = lv.at[rows, pos_w].set(v.astype(lv.dtype))
+        o = _cached_attention_rows(
+            q, lk, lv, starts, window=dcfg.window_size
+        ).reshape(B, W, Hn * Dh)
+        x = x + (o @ wmat(p["wo"], dtype))
+        h2 = rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(h2 @ wmat(p["w_gate"], dtype))
+        up = h2 @ wmat(p["w_in"], dtype)
+        x = x + ((gate * up) @ wmat(p["w_out"], dtype))
+        return x, (lk, lv)
+
+    x, (nk, nv) = jax.lax.scan(
+        layer_step, x, (dparams["layers"], dkv["k"], dkv["v"])
+    )
+    x = rms_norm(x, dparams["final_norm"])
+    logits = (x @ wmat(dparams["unembed"], dtype)).astype(jnp.float32)
+    return logits, {"k": nk, "v": nv}
+
+
+def _draft_ingest_propose(dparams, dkv, feed, starts, counts, *, dcfg, k):
+    """One fused draft pass: ingest each row's ``counts`` new context
+    tokens (window-padded), then greedily roll the draft model ``k`` steps
+    from the last real position — the draft-model replacement for
+    prompt-lookup proposing.  Returns (drafts (B, k), dkv')."""
+    logits, dkv = _draft_forward(dparams, dkv, feed, starts, dcfg=dcfg)
+    idx = jnp.maximum(counts - 1, 0)[:, None, None]
+    last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]  # (B, V)
+    tok0 = jnp.argmax(last, -1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, pos, dkv = carry
+        lg, dkv = _draft_forward(dparams, dkv, tok[:, None], pos, dcfg=dcfg)
+        nxt = jnp.argmax(lg[:, 0], -1).astype(jnp.int32)
+        return (nxt, pos + 1, dkv), tok
+
+    (_, _, dkv), toks = jax.lax.scan(
+        step, (tok0, starts + counts, dkv), None, length=k
+    )
+    return jnp.moveaxis(toks, 0, 1), dkv  # (B, k)
+
+
 class InferenceEngine:
     """Paged-cache continuous batching with fused K-step decode chunks."""
 
@@ -691,6 +759,7 @@ class InferenceEngine:
         adapters: Optional[dict[str, dict]] = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        draft: Optional[tuple] = None,
         mesh=None,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
@@ -704,6 +773,17 @@ class InferenceEngine:
         tokens); steps where only sampled slots are generating fall back
         to the sequential fused chunk automatically.  ``spec_ngram`` is
         the prompt-lookup match length (models/speculative.propose_ngram).
+
+        ``draft``: (draft_params, draft_cfg) — drafts come from a small
+        DRAFT MODEL instead of prompt lookup (requires ``spec_k`` > 0 and
+        a matching vocab; dense draft only).  The draft keeps its own
+        dense per-slot KV cache and per-slot ingested-length counter; each
+        verify pass first catches the draft up on newly-confirmed context
+        (one fused pass, chunked for long prompts) and rolls it spec_k
+        greedy steps.  The SAME verify/accept machinery runs either way —
+        greedy outputs stay token-identical to the non-speculative engine;
+        only the acceptance RATE changes (a trained draft beats n-gram
+        lookup on non-repetitive text).
 
         ``mesh``: serve TENSOR-PARALLEL over a `jax.sharding.Mesh` with a
         ``tensor`` axis — for checkpoints too big for one chip's HBM.
@@ -777,6 +857,44 @@ class InferenceEngine:
         self.spec_ngram = spec_ngram
         self.spec_passes = 0  # verify passes run
         self.spec_accepted = 0  # accepted draft tokens (beyond the bonus)
+        self.draft = draft
+        if draft is not None:
+            dparams, dcfg = draft
+            if self.spec_k <= 0:
+                raise ValueError("draft model needs spec_k > 0")
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target {cfg.vocab_size}"
+                )
+            if dcfg.n_experts > 0:
+                raise ValueError("draft model must be dense (n_experts=0)")
+            self.draft_cfg = dcfg
+            self.draft_params = dparams
+            # max_len + 1: the LAST index is a scratch row — rollout
+            # positions past max_len write there instead of clamping onto
+            # the real final position's K/V (the paged pool solves the
+            # same overflow with its scratch page)
+            dshape = (
+                dcfg.n_layers, max_batch, max_len + 1, dcfg.kv_heads,
+                dcfg.head_dim,
+            )
+            ddtype = jnp.dtype(dcfg.dtype)
+            self.dkv = {
+                "k": jnp.zeros(dshape, ddtype),
+                "v": jnp.zeros(dshape, ddtype),
+            }
+            self.draft_len = np.zeros(max_batch, np.int32)
+            self._draft_chunk = 64  # pre-ingest width for long prompts
+            self._draft_ip = jax.jit(
+                functools.partial(
+                    _draft_ingest_propose, dcfg=dcfg, k=self.spec_k
+                ),
+                donate_argnums=(1,),
+            )
+            self._draft_ingest = jax.jit(
+                functools.partial(_draft_forward, dcfg=dcfg),
+                donate_argnums=(1,),
+            )
         self._verify_chunks = {
             use_filters: jax.jit(
                 functools.partial(
@@ -1090,6 +1208,8 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        if self.draft is not None:
+            self.draft_len[i] = 0
 
     def _release_slot(self, i: int) -> None:
         req = self.slots[i]
@@ -1103,6 +1223,8 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        if self.draft is not None:
+            self.draft_len[i] = 0  # rows rewrite lazily; no device work
 
     def _prepare_step(self, lookahead: int):
         """Host-side slot scan shared by BOTH step flavors (sequential
@@ -1191,6 +1313,10 @@ class InferenceEngine:
         if prepared is None:
             return
         active, view = prepared
+        draft_rows = (
+            self._propose_draft_model(active) if self.draft is not None
+            else None
+        )
         feed = np.zeros((B, W), np.int32)
         for i, req in enumerate(self.slots):
             if req is None or not active[i]:
@@ -1203,12 +1329,19 @@ class InferenceEngine:
                 feed[i, j] = self.prompts[i, p + j]
                 j += 1
             if j < W and self.temps[i] == 0:
-                # prompt + output is exactly the tokens at positions
-                # 0..p, so the proposer's continuation lands at the
-                # window's first generated position
-                drafts = propose_ngram(
-                    list(req.prompt) + req.output, self.spec_ngram, W - j
-                )
+                if draft_rows is not None:
+                    # the draft model's continuation starts right after
+                    # the last KNOWN position q_end = max(p, plen-1); the
+                    # first unfilled window position p+j is q_end+1 by
+                    # construction, so drafts index from 0
+                    drafts = [int(t) for t in draft_rows[i, : W - j]]
+                else:
+                    # prompt + output is exactly the tokens at positions
+                    # 0..p, so the proposer's continuation lands at the
+                    # window's first generated position
+                    drafts = propose_ngram(
+                        list(req.prompt) + req.output, self.spec_ngram, W - j
+                    )
                 for d in drafts:
                     feed[i, j] = d
                     j += 1
@@ -1288,6 +1421,92 @@ class InferenceEngine:
                     if p + A < plen
                     else int(picked[i, A - 1])
                 )
+
+    def _propose_draft_model(self, active) -> np.ndarray:
+        """Catch the draft cache up on newly-confirmed context, then roll
+        the draft model spec_k greedy steps — returns drafts (B, spec_k).
+
+        Context for slot i is positions 0..q_end where q_end =
+        max(lengths, plen-1): everything already CONFIRMED (prompt tokens
+        are known before the big model ever sees them, so the draft may
+        read ahead of the paged cache).  Long prompts pre-ingest in
+        ``_draft_chunk``-wide fused passes; the steady-state pass ingests
+        at most W new tokens and proposes in the same dispatch."""
+        B, W = self.max_batch, self.spec_k + 1
+        # a pass with no draft CONSUMER (every greedy row's window still
+        # inside its prompt, or only sampled rows) skips ALL draft work —
+        # pending context just accumulates and the next consuming pass
+        # catches up (chunked below).  Returning zeros is safe: no row
+        # reads drafts on such a pass.
+        consumer = any(
+            req is not None and active[i] and self.temps[i] == 0
+            and int(self.lengths[i]) + W > int(self.prompt_lens[i])
+            for i, req in enumerate(self.slots)
+        )
+        if not consumer:
+            return np.zeros((B, self.spec_k), np.int32)
+        pend: list[list[int]] = [[] for _ in range(B)]
+        for i, req in enumerate(self.slots):
+            if req is None or not active[i]:
+                continue
+            p = int(self.lengths[i])
+            plen = int(self.prompt_lens[i])
+            q_end = max(p, plen - 1)
+            toks = []
+            for q in range(int(self.draft_len[i]), q_end + 1):
+                toks.append(
+                    int(self.prompts[i, q]) if q < plen
+                    else req.output[q - plen]
+                )
+            pend[i] = toks
+        CH = self._draft_chunk
+        while max((len(t) for t in pend), default=0) > W:
+            feed = np.zeros((B, CH), np.int32)
+            counts = np.zeros(B, np.int32)
+            for i, toks in enumerate(pend):
+                if len(toks) <= W:
+                    continue  # small backlogs wait for the propose pass:
+                    # draining them here would leave counts=0 there and
+                    # the rollout would start from pad-token logits
+                take = toks[:CH]
+                feed[i, : len(take)] = take
+                counts[i] = len(take)
+                pend[i] = toks[CH:]
+            _, self.dkv = self._draft_ingest(
+                self.draft_params, self.dkv,
+                jnp.asarray(feed), jnp.asarray(self.draft_len),
+            )
+            self.draft_len += counts
+        feed = np.zeros((B, W), np.int32)
+        counts = np.zeros(B, np.int32)
+        starts = self.draft_len.copy()
+        advance = np.zeros(B, np.int32)
+        for i, toks in enumerate(pend):
+            if not toks and self.draft_len[i] > 0:
+                # fully caught up (e.g. everything ingested in a prior
+                # pass): re-feed the LAST context token one position back
+                # so the rollout starts from real logits, not a pad's.
+                # Rewriting that position's K/V is idempotent.
+                q = int(self.draft_len[i]) - 1
+                plen = int(self.prompt_lens[i])
+                req = self.slots[i]
+                tok = (
+                    int(self.prompts[i, q]) if q < plen
+                    else req.output[q - plen] if req is not None else 0
+                )
+                feed[i, 0] = tok
+                counts[i] = 1
+                starts[i] = q
+            else:
+                feed[i, : len(toks)] = toks
+                counts[i] = len(toks)
+                advance[i] = len(toks)
+        drafts, self.dkv = self._draft_ip(
+            self.draft_params, self.dkv, jnp.asarray(feed),
+            jnp.asarray(starts), jnp.asarray(counts),
+        )
+        self.draft_len += advance
+        return np.asarray(drafts)
 
     def _step_chunk(self) -> None:
         """One fused chunk (``fused_steps`` decode iterations) across all
