@@ -869,6 +869,17 @@ class InferenceEngine:
             if dcfg.n_experts > 0:
                 raise ValueError("draft model must be dense (n_experts=0)")
             self.draft_cfg = dcfg
+            if mesh is not None:
+                # the draft is small: replicate it (and its cache, below)
+                # across the mesh — host-committed draft weights against a
+                # device-resident dkv would otherwise mix placements at
+                # the first verify pass
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(mesh, PartitionSpec())
+                dparams = jax.tree.map(
+                    lambda x: jax.device_put(x, rep), dparams
+                )
             self.draft_params = dparams
             # max_len + 1: the LAST index is a scratch row — rollout
             # positions past max_len write there instead of clamping onto
@@ -883,6 +894,10 @@ class InferenceEngine:
                 "k": jnp.zeros(dshape, ddtype),
                 "v": jnp.zeros(dshape, ddtype),
             }
+            if mesh is not None:
+                self.dkv = {
+                    k: jax.device_put(v, rep) for k, v in self.dkv.items()
+                }
             self.draft_len = np.zeros(max_batch, np.int32)
             self._draft_chunk = 64  # pre-ingest width for long prompts
             self._draft_ip = jax.jit(

@@ -53,6 +53,13 @@ def build_args(argv=None):
                    help="serve tensor-parallel over this many devices "
                         "(checkpoints bigger than one chip's HBM); needs "
                         ">= that many attached devices")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help=">0 enables speculative decoding (this many draft "
+                        "tokens per verify pass; prompt-lookup drafting "
+                        "unless --draft-hf)")
+    p.add_argument("--draft-hf", default="",
+                   help="HF checkpoint dir for a DRAFT model "
+                        "(draft-model speculation; requires --spec-k)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend in-process (overrides a "
                         "sticky JAX_PLATFORMS from site config; tests/dev)")
@@ -61,6 +68,10 @@ def build_args(argv=None):
 
 def main(argv=None) -> int:
     args = build_args(argv)
+    if args.draft_hf and args.spec_k <= 0:
+        # fail BEFORE any weight I/O — a misconfigured flag pair must not
+        # cost a multi-GB checkpoint read first
+        raise SystemExit("--draft-hf requires --spec-k > 0")
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
@@ -105,13 +116,13 @@ def main(argv=None) -> int:
         except RuntimeError:
             host_ctx = None  # no CPU backend (already ON cpu): no-op
 
-    if args.hf:
+    def load_hf(path):
         from .models.convert import config_from_hf_llama, params_from_hf_llama
 
         import json as _json
         import pathlib
 
-        hf_dir = pathlib.Path(args.hf)
+        hf_dir = pathlib.Path(path)
         hf_cfg = _json.loads((hf_dir / "config.json").read_text())
         cfg = config_from_hf_llama(hf_cfg)
         sd = {}
@@ -136,7 +147,10 @@ def main(argv=None) -> int:
                 )
         if not sd:
             raise SystemExit(f"no weight files found under {hf_dir}")
-        params = params_from_hf_llama(sd, cfg)
+        return params_from_hf_llama(sd, cfg), cfg
+
+    if args.hf:
+        params, cfg = load_hf(args.hf)
     else:
         cfg = TransformerConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
@@ -149,6 +163,10 @@ def main(argv=None) -> int:
 
         params = quantize_params(params)
 
+    draft = None
+    if args.draft_hf:
+        draft = load_hf(args.draft_hf)
+
     if host_ctx is not None:
         host_ctx.close()  # params are host-resident; sharded placement next
 
@@ -157,7 +175,8 @@ def main(argv=None) -> int:
         max_batch=args.max_batch, max_len=args.max_len,
         page_size=args.page_size, n_pages=args.n_pages,
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
-        prefix_cache=args.prefix_cache, mesh=mesh,
+        prefix_cache=args.prefix_cache, spec_k=args.spec_k, draft=draft,
+        mesh=mesh,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

@@ -138,3 +138,23 @@ def test_draft_rejects_bad_configs():
                                bad_vocab))
     with pytest.raises(ValueError, match="spec_k"):
         InferenceEngine(PARAMS, CFG, draft=(DRAFT_PARAMS, DRAFT_CFG))
+
+
+def test_draft_composes_with_tp_mesh():
+    """draft + mesh: the draft replicates across the mesh while the target
+    shards; outputs stay identical to the single-device plain engine."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    base, _ = run()
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=4, max_len=96, page_size=8,
+        spec_k=3, draft=(DRAFT_PARAMS, DRAFT_CFG), mesh=mesh,
+    )
+    reqs = [eng.submit(Request(prompt=p, max_new_tokens=10)) for p in PROMPTS]
+    eng.run_until_idle()
+    got = []
+    for r in reqs:
+        assert r.done.is_set() and not r.error, r.error
+        got.append(r.output)
+    assert got == base

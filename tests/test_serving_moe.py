@@ -100,3 +100,24 @@ def test_moe_int8_weights_serve():
         qparams, jax.numpy.asarray([[5, 17, 3]]), MOE_CFG, max_new_tokens=6
     )
     np.testing.assert_array_equal(np.asarray(ref)[0, 3:], r.output)
+
+
+def test_moe_engine_with_speculation_matches_generate():
+    """spec_k > 0 composes with MoE serving: the verify chunk routes
+    through the same drop-free expert FFN, so outputs stay equal to the
+    dense-path oracle."""
+    engine = InferenceEngine(
+        PARAMS, MOE_CFG, max_batch=4, max_len=48, page_size=8, spec_k=3,
+    )
+    prompts = [[5, 17, 3], [9, 9, 9, 9], list(range(1, 20))]
+    reqs = [
+        engine.submit(Request(prompt=p, max_new_tokens=6)) for p in prompts
+    ]
+    engine.run_until_idle()
+    assert engine.spec_passes > 0
+    for p, req in zip(prompts, reqs):
+        assert req.done.is_set() and not req.error
+        ref = generate(
+            PARAMS, jax.numpy.asarray([p]), MOE_CFG, max_new_tokens=6
+        )
+        np.testing.assert_array_equal(np.asarray(ref)[0, len(p):], req.output)
