@@ -193,6 +193,12 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "adapters": sorted(
                         a for a in eng.adapter_index if a
                     ),
+                    # speculation telemetry: accepted/passes is the mean
+                    # extra tokens each verify pass bought
+                    "spec_k": eng.spec_k,
+                    "spec_passes": int(eng.spec_passes),
+                    "spec_accepted": int(eng.spec_accepted),
+                    "draft_model": eng.draft is not None,
                 })
             return self._json(404, {"error": f"no route {self.path}"})
 
