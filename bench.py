@@ -663,10 +663,10 @@ def _tpu_section_serve():
         for i, L in enumerate(lens)
     ]
 
-    def serve_batch(eng, new):
+    def serve_batch(eng, new, prompts=None):
         reqs = [
             eng.submit(Request(prompt=list(toks), max_new_tokens=new))
-            for toks in prompt_sets
+            for toks in (prompts or prompt_sets)
         ]
         eng.run_until_idle(max_steps=100_000)
         bad = [r.error for r in reqs if not r.done.is_set() or r.error]
@@ -684,7 +684,7 @@ def _tpu_section_serve():
     t0 = _time.perf_counter()
     n_tok = serve_batch(eng, new_toks)
     serve_s = _time.perf_counter() - t0
-    return {
+    out = {
         "tpu_serve_requests": len(lens),
         "tpu_serve_warmup_s": round(warm_s, 2),
         "tpu_serve_steady_s": round(serve_s, 2),
@@ -693,6 +693,32 @@ def _tpu_section_serve():
             (n_tok + sum(lens)) / serve_s, 1
         ),
     }
+
+    del eng  # free the baseline's page pool before the spec engine's
+
+    # speculative engine, SAME workload as the baseline — the throughput
+    # keys stay comparable; a separate repetitive-prompt run (untimed)
+    # measures the acceptance rate where prompt-lookup drafts can land
+    eng2 = InferenceEngine(
+        cfg=cfg, params=params, max_batch=8, max_len=640,
+        page_size=64, fused_steps=32, spec_k=4,
+    )
+    serve_batch(eng2, new_toks)  # warm-up
+    t0 = _time.perf_counter()
+    n_tok2 = serve_batch(eng2, new_toks)
+    spec_s = _time.perf_counter() - t0
+    rep = [7, 3, 11, 5] * 16
+    spec_prompts = [list(rep[: L % 48 + 16]) for L in lens]
+    base_passes, base_acc = eng2.spec_passes, eng2.spec_accepted
+    serve_batch(eng2, new_toks, prompts=spec_prompts)
+    passes = max(1, eng2.spec_passes - base_passes)
+    out.update({
+        "tpu_serve_spec_tokens_per_s": round(n_tok2 / spec_s, 1),
+        "tpu_serve_spec_accept_per_pass": round(
+            (eng2.spec_accepted - base_acc) / passes, 2
+        ),
+    })
+    return out
 
 
 def _tpu_section_model1b():
