@@ -410,6 +410,7 @@ def model_bench_on_tpu():
         "serve": int(os.environ.get("BENCH_SECTION_TIMEOUT_SERVE", "900")),
         "model1b": int(os.environ.get("BENCH_SECTION_TIMEOUT_1B", "1800")),
         "flash32k": int(os.environ.get("BENCH_SECTION_TIMEOUT_32K", "600")),
+        "pagedattn": int(os.environ.get("BENCH_SECTION_TIMEOUT_PAGED", "600")),
     }
     chosen = os.environ.get("BENCH_SECTIONS", "")
     if chosen:
@@ -864,11 +865,70 @@ def _tpu_section_flash32k():
     }
 
 
+def _tpu_section_pagedattn():
+    """Paged decode attention: Pallas in-place page reads vs the gather
+    path at long context — the serving engine's steady-state hot op
+    (ops/paged_attention.py; opt-in in the engine until this section
+    validates the Mosaic lowering on chip)."""
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+    import jax.numpy as jnp
+
+    from elastic_gpu_scheduler_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    B, Hn, Hkv, Dh, ps = (2, 4, 2, 64, 8) if allow_cpu else (8, 8, 8, 128, 64)
+    ctx = 256 if allow_cpu else 8192
+    NB = ctx // ps
+    NP = B * NB + 1
+    dtype = jnp.float32 if allow_cpu else jnp.bfloat16
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, Hn, Dh), dtype)
+    pk = jax.random.normal(jax.random.fold_in(key, 1), (NP, ps, Hkv, Dh), dtype)
+    pv = jax.random.normal(jax.random.fold_in(key, 2), (NP, ps, Hkv, Dh), dtype)
+    tables = jnp.arange(1, B * NB + 1, dtype=jnp.int32).reshape(B, NB)
+    lengths = jnp.full((B,), ctx - 1, jnp.int32)
+
+    kernel = jax.jit(
+        lambda q: q + 0.01 * paged_attention(
+            q, pk, pv, tables, lengths, interpret=allow_cpu
+        )
+    )
+    gather = jax.jit(
+        lambda q: q + 0.01 * paged_attention_reference(
+            q, pk, pv, tables, lengths
+        )
+    )
+
+    def timed(fn, iters):
+        x = fn(q)
+        _ = float(x[0, 0, 0])  # compile + sync
+        t0 = _time.perf_counter()
+        for _i in range(iters):
+            x = fn(x)  # chained: XLA cannot elide the attention
+        _ = float(x[0, 0, 0])
+        return (_time.perf_counter() - t0) * 1000 / iters
+
+    iters = 3 if allow_cpu else 30
+    kernel_ms = timed(kernel, iters)
+    gather_ms = timed(gather, iters)
+    return {
+        "tpu_pagedattn_ctx": ctx,
+        "tpu_pagedattn_kernel_ms": round(kernel_ms, 3),
+        "tpu_pagedattn_gather_ms": round(gather_ms, 3),
+        "tpu_pagedattn_speedup": round(gather_ms / max(kernel_ms, 1e-9), 2),
+    }
+
+
 _TPU_SECTIONS = {
     "model": _tpu_section_model,
     "serve": _tpu_section_serve,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
+    "pagedattn": _tpu_section_pagedattn,
 }
 
 

@@ -371,14 +371,16 @@ def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
 
 
 def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
-                       bank=None, aids=None):
+                       bank=None, aids=None, paged_kernel=False):
     """One decode step for every slot at its own position, against the page
     pool.
 
     tokens: (B,) int32; kv: pool dict (make_kv_pool); tables:
     (B, max_pages) int32 page ids; lengths: (B,) int32 write positions;
     bank/aids: multi-LoRA adapter bank (leaves stacked over layers) +
-    per-slot adapter ids.  Returns (logits (B, V), new kv).
+    per-slot adapter ids; ``paged_kernel``: attend straight off the page
+    pool with the Pallas kernel (ops/paged_attention) instead of
+    gathering a contiguous copy.  Returns (logits (B, V), new kv).
     """
     dtype = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
@@ -389,6 +391,17 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
     offset = lengths % page_size  # (B,)
 
     def attn(q, k, v, lkv):
+        if paged_kernel:
+            # in-place page reads: HBM traffic is the live pages once,
+            # not a full gathered copy per step (ops/paged_attention)
+            from ..ops.attention import _use_pallas
+            from ..ops.paged_attention import paged_attention
+
+            o = paged_attention(
+                q[:, 0], lkv["k"], lkv["v"], tables, lengths,
+                interpret=not _use_pallas(),
+            )
+            return o.reshape(B, 1, Hn * Dh)
         # gather the slot's pages into a virtually-contiguous view; position
         # j of the view IS token position j (pages are table-ordered), so
         # the shared cached_attention position mask applies unchanged
@@ -519,7 +532,7 @@ def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
     bank=None, aids=None,
-    *, cfg, page_size, n_steps, use_filters,
+    *, cfg, page_size, n_steps, use_filters, paged_kernel=False,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches).
@@ -538,7 +551,8 @@ def _fused_serve_chunk(
     def body(carry, _):
         tokens, lengths, key, kv = carry
         logits, kv = _paged_decode_step(
-            params, tokens, kv, tables, lengths, cfg, page_size, bank, aids
+            params, tokens, kv, tables, lengths, cfg, page_size, bank, aids,
+            paged_kernel=paged_kernel,
         )
         key, sub = jax.random.split(key)
         if use_filters:
@@ -761,6 +775,7 @@ class InferenceEngine:
         spec_ngram: int = 3,
         draft: Optional[tuple] = None,
         mesh=None,
+        paged_kernel: bool = False,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -811,6 +826,21 @@ class InferenceEngine:
         assert self.n_pages >= 2, "need at least scratch + one real page"
         self.fused_steps = max(1, fused_steps)
         self.kv_int8 = kv_int8
+        self.paged_kernel = paged_kernel
+        if paged_kernel and (
+            kv_int8 or cfg.window_size > 0 or mesh is not None or spec_k > 0
+        ):
+            # spec_k is excluded because verify chunks attend via the
+            # gather path: a greedy slot's tokens would then come from two
+            # differently-rounded attention implementations depending on
+            # batch composition — the nondeterminism the engine promises
+            # away.  A kernel verify variant lifts this later.
+            raise ValueError(
+                "paged_kernel composes with bf16/f32 pools, full causal "
+                "attention, single-device non-speculative engines only "
+                "(for now) — disable kv_int8/window/mesh/spec_k or the "
+                "kernel"
+            )
         self.kv = make_kv_pool(cfg, self.n_pages, page_size, kv_int8)
         if mesh is not None:
             self.kv = _shard_kv_for_mesh(self.kv, cfg, mesh)
@@ -848,6 +878,7 @@ class InferenceEngine:
                     page_size=page_size,
                     n_steps=self.fused_steps,
                     use_filters=use_filters,
+                    paged_kernel=self.paged_kernel,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )

@@ -1,0 +1,196 @@
+"""Paged decode attention: a Pallas TPU kernel over the serving engine's
+page pool — no gathered contiguous copy.
+
+The engine's decode path today materializes each slot's whole context from
+the page pool into a contiguous (B, max_len, Hkv, Dh) buffer every step
+(serving._kv_gather) and runs dense masked attention over it.  At short
+context that copy is noise; at long context it IS the decode cost: 32k
+tokens × 8 kv-heads × 128 dims × bf16 × K+V ≈ 128 MB of pure HBM traffic
+per slot per step, none of it compute.
+
+This kernel reads the pages IN PLACE (vLLM's paged-attention idea, done
+the TPU way): the page table rides in scalar-prefetch memory so the
+BlockSpec index_map can choose which physical page each grid step DMAs —
+grid (batch, pages); block j of row b loads pool page ``tables[b, j]``.
+An online-softmax accumulator (m, l, acc — the flash recipe) carries
+across page blocks in VMEM scratch, and the final block normalizes and
+writes the (Hn, Dh) output row.  HBM traffic is exactly the live pages,
+once.
+
+Layout notes (pallas_guide.md):
+- the pool is passed as (n_pages, page_size, Hkv·Dh) — trailing dims
+  (page_size ≥ 16, lane-multiple) keep Mosaic's bf16 tiling happy; the
+  kernel reshapes loaded VALUES (not refs) back to (page_size, Hkv, Dh);
+- q/out ride as (B, Hn·Dh) rows;
+- GQA runs as a grouped einsum inside the kernel, never expanding K/V.
+
+``interpret=True`` makes the same kernel run on CPU (tests); the pure-JAX
+``paged_attention_reference`` is the engine's current gather path and the
+numerics oracle.  Opt-in at the engine (``paged_kernel=True``) until an
+on-chip run validates the Mosaic lowering.
+
+No reference-parity obligation: the reference has no serving plane
+(SURVEY §2 #19).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF
+
+
+def paged_attention_reference(q, pool_k, pool_v, tables, lengths):
+    """Gather-then-attend oracle (what serving._kv_gather + masked dense
+    attention compute today).
+
+    q: (B, Hn, Dh); pool_k/v: (n_pages, page_size, Hkv, Dh);
+    tables: (B, NB) int32; lengths: (B,) int32 — row b attends to
+    positions 0..lengths[b] inclusive (the decode convention: the query
+    sits AT position lengths[b], whose K/V row was just written).
+    Returns (B, Hn, Dh)."""
+    B, Hn, Dh = q.shape
+    NB = tables.shape[1]
+    ps = pool_k.shape[1]
+    Hkv = pool_k.shape[2]
+    n_rep = Hn // Hkv
+    k = pool_k[tables].reshape(B, NB * ps, Hkv, Dh)
+    v = pool_v[tables].reshape(B, NB * ps, Hkv, Dh)
+    qg = q.reshape(B, Hkv, n_rep, Dh).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bhrd,bthd->bhrt", qg, kf) * (Dh**-0.5)
+    pos = jnp.arange(NB * ps)[None, :]  # (1, T)
+    keep = pos <= lengths[:, None]
+    s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhrt,bthd->bhrd", p, v.astype(jnp.float32))
+    return o.reshape(B, Hn, Dh).astype(q.dtype)
+
+
+def _paged_kernel(
+    tables_ref,  # scalar-prefetch (B, NB) int32
+    lengths_ref,  # scalar-prefetch (B,) int32
+    q_ref,  # (1, Hn*Dh)
+    k_ref,  # (1, page_size, Hkv*Dh) — the page chosen by index_map
+    v_ref,
+    o_ref,  # (1, Hn*Dh)
+    m_ref,  # scratch (Hkv, n_rep) f32 running max
+    l_ref,  # scratch (Hkv, n_rep) f32 running sum
+    acc_ref,  # scratch (Hkv, n_rep, Dh) f32
+    *,
+    page_size: int,
+    n_heads: int,
+    kv_heads: int,
+    head_dim: int,
+):
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    n_rep = n_heads // kv_heads
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]  # query position == length (row just written)
+    page_start = j * page_size
+
+    @pl.when(page_start <= length)
+    def _accumulate():
+        qf = q_ref[0].reshape(kv_heads, n_rep, head_dim).astype(jnp.float32)
+        kf = k_ref[0].reshape(page_size, kv_heads, head_dim).astype(
+            jnp.float32
+        )
+        vf = v_ref[0].reshape(page_size, kv_heads, head_dim).astype(
+            jnp.float32
+        )
+        s = jnp.einsum(
+            "hrd,thd->hrt", qf, kf, preferred_element_type=jnp.float32
+        ) * (head_dim**-0.5)  # (Hkv, n_rep, T)
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2
+        )
+        s = jnp.where(pos <= length, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[..., None])  # (Hkv, n_rep, T)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum(
+            "hrt,thd->hrd", p, vf, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
+        o_ref[0] = out.reshape(n_heads * head_dim).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,  # (B, Hn, Dh)
+    pool_k: jax.Array,  # (n_pages, page_size, Hkv, Dh)
+    pool_v: jax.Array,
+    tables: jax.Array,  # (B, NB) int32
+    lengths: jax.Array,  # (B,) int32
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention straight off the page pool.  Semantics identical
+    to ``paged_attention_reference`` (one query per row at position
+    ``lengths[b]``, causal over positions 0..lengths[b])."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Hn, Dh = q.shape
+    n_pages, ps, Hkv, _ = pool_k.shape
+    NB = tables.shape[1]
+    n_rep = Hn // Hkv
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lengths
+        grid=(B, NB),
+        in_specs=[
+            pl.BlockSpec((1, Hn * Dh), lambda b, j, tbl, ln: (b, 0)),
+            pl.BlockSpec(
+                (1, ps, Hkv * Dh),
+                lambda b, j, tbl, ln: (tbl[b, j], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, Hkv * Dh),
+                lambda b, j, tbl, ln: (tbl[b, j], 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, Hn * Dh), lambda b, j, tbl, ln: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, n_rep), jnp.float32),
+            pltpu.VMEM((Hkv, n_rep), jnp.float32),
+            pltpu.VMEM((Hkv, n_rep, Dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel,
+        page_size=ps,
+        n_heads=Hn,
+        kv_heads=Hkv,
+        head_dim=Dh,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hn * Dh), q.dtype),
+        interpret=interpret,
+    )(
+        tables.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        q.reshape(B, Hn * Dh),
+        pool_k.reshape(n_pages, ps, Hkv * Dh),
+        pool_v.reshape(n_pages, ps, Hkv * Dh),
+    )
+    return out.reshape(B, Hn, Dh)
