@@ -682,13 +682,20 @@ def _tpu_section_serve():
     t0 = _time.perf_counter()
     serve_batch(eng, new_toks)  # warm-up: compiles all buckets
     warm_s = _time.perf_counter() - t0
+    steps0 = eng.steps_run + eng.prefills_run
     t0 = _time.perf_counter()
     n_tok = serve_batch(eng, new_toks)
     serve_s = _time.perf_counter() - t0
+    steps = max(1, eng.steps_run + eng.prefills_run - steps0)
     out = {
         "tpu_serve_requests": len(lens),
         "tpu_serve_warmup_s": round(warm_s, 2),
         "tpu_serve_steady_s": round(serve_s, 2),
+        # ms per engine step ≈ per fused dispatch: the key that localizes
+        # the r2 relay pathology (a 12s/call engine with normal ms/step
+        # points at transfer, not compute)
+        "tpu_serve_steps": steps,
+        "tpu_serve_ms_per_step": round(serve_s * 1000 / steps, 2),
         "tpu_serve_gen_tokens_per_s": round(n_tok / serve_s, 1),
         "tpu_serve_total_tokens_per_s": round(
             (n_tok + sum(lens)) / serve_s, 1

@@ -886,6 +886,8 @@ class InferenceEngine:
         }
         self.spec_k = max(0, spec_k)
         self.spec_ngram = spec_ngram
+        self.steps_run = 0  # decode/verify steps (device dispatches)
+        self.prefills_run = 0  # prompt-ingest dispatches
         self.spec_passes = 0  # verify passes run
         self.spec_accepted = 0  # accepted draft tokens (beyond the bonus)
         self.draft = draft
@@ -1178,6 +1180,7 @@ class InferenceEngine:
                 self.lora_bank,
                 aid,
             )
+        self.prefills_run += 1
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
@@ -1358,6 +1361,7 @@ class InferenceEngine:
         prepared = self._prepare_step(W)
         if prepared is None:
             return
+        self.steps_run += 1  # a real dispatch follows (bench: ms/step)
         active, view = prepared
         draft_rows = (
             self._propose_draft_model(active) if self.draft is not None
@@ -1561,6 +1565,7 @@ class InferenceEngine:
         prepared = self._prepare_step(K)
         if prepared is None:
             return
+        self.steps_run += 1  # a real dispatch follows (bench: ms/step)
         active, view = prepared
         self._key, sub = jax.random.split(self._key)
         use_filters = self._filters_requested(active)
