@@ -800,6 +800,11 @@ class InferenceEngine:
         only the acceptance RATE changes (a trained draft beats n-gram
         lookup on non-repetitive text).
 
+        ``paged_kernel``: decode attention reads the page pool IN PLACE
+        via the Pallas kernel (ops/paged_attention) instead of gathering
+        a contiguous copy per step — the long-context HBM-bandwidth win.
+        Opt-in; see the constructor guard for the supported combinations.
+
         ``mesh``: serve TENSOR-PARALLEL over a `jax.sharding.Mesh` with a
         ``tensor`` axis — for checkpoints too big for one chip's HBM.
         Weights take the training sharding rules (parallel/sharding.py)
