@@ -372,8 +372,8 @@ def model_bench_on_tpu():
         return {}
     # probe the accelerator in a SUBPROCESS with a timeout first: a downed
     # TPU relay makes jax.devices() hang indefinitely in-process
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "4"))
-    wait_s = float(os.environ.get("BENCH_TPU_WAIT", "45"))
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "5"))
+    wait_s = float(os.environ.get("BENCH_TPU_WAIT", "60"))
     err = ""
     if os.environ.get("BENCH_ALLOW_CPU", "0") == "1":
         attempts = 0  # sections force the CPU platform; nothing to probe
