@@ -2,13 +2,21 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test bench proto image run-fake
+.PHONY: test bench proto image run-fake tpu-validate tpu-validate-bg
 
 test:
 	python -m pytest tests/ -x -q
 
 bench:
 	python bench.py
+
+# Probe the TPU relay all round; capture + commit a green on-chip artifact
+# (BENCH_TPU_validation.json) the moment it comes up (VERDICT r3 Next #1).
+tpu-validate:
+	python tools/tpu_validate.py
+
+tpu-validate-bg:
+	nohup python tools/tpu_validate.py > tpu_validate.out 2>&1 &
 
 proto:
 	cd elastic_gpu_scheduler_tpu/deviceplugin && protoc --python_out=. deviceplugin.proto

@@ -339,6 +339,70 @@ def matmul_flops_fwd(cfg, batch: int, seq: int) -> float:
     return float(dense + attn)
 
 
+def tpu_section_table():
+    """Section name -> subprocess timeout (s); the single source of truth
+    shared with tools/tpu_validate.py so the tables cannot drift."""
+    import os
+
+    return {
+        "model": int(os.environ.get("BENCH_SECTION_TIMEOUT_MODEL", "900")),
+        "serve": int(os.environ.get("BENCH_SECTION_TIMEOUT_SERVE", "900")),
+        "model1b": int(os.environ.get("BENCH_SECTION_TIMEOUT_1B", "1800")),
+        "flash32k": int(os.environ.get("BENCH_SECTION_TIMEOUT_32K", "600")),
+        "pagedattn": int(os.environ.get("BENCH_SECTION_TIMEOUT_PAGED", "600")),
+    }
+
+
+def probe_tpu(timeout: float = 120.0):
+    """(up, detail) — detail is the chip kind when up, the error otherwise.
+    Probes in a SUBPROCESS: a downed relay makes jax.devices() hang
+    indefinitely in-process.  'NOT_TPU:<backend>' in detail marks a
+    deterministic non-TPU backend (retrying cannot change the answer)."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        p = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "assert jax.default_backend() == 'tpu', "
+             "'NOT_TPU:' + jax.default_backend(); "
+             "print(d[0].device_kind)"],
+            timeout=timeout, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout:.0f}s (relay down?)"
+    if p.returncode == 0:
+        return True, p.stdout.decode().strip()
+    return False, p.stderr.decode(errors="replace")[-200:]
+
+
+def run_tpu_section(name: str, timeout: int) -> dict:
+    """Run one --tpu-section subprocess; parse its one-line JSON result or
+    return {'tpu_<name>_error': ...}.  Shared with tools/tpu_validate.py."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, f"--tpu-section={name}"],
+            timeout=timeout, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        # structured flag (not error-text matching): callers use it to
+        # suppress retries of deterministically-slow sections
+        return {f"tpu_{name}_error": f"section timed out after {timeout}s",
+                f"tpu_{name}_timed_out": True}
+    except Exception as e:  # noqa: BLE001 — report, don't kill other sections
+        return {f"tpu_{name}_error": str(e)[:300]}
+    if p.returncode == 0:
+        try:
+            return json.loads(p.stdout.decode().strip().splitlines()[-1])
+        except Exception as e:
+            return {f"tpu_{name}_error": f"unparseable output: {e}"}
+    return {f"tpu_{name}_error": p.stderr.decode(errors="replace")[-300:]}
+
+
 def model_bench_on_tpu():
     """Secondary metrics: model step time + MFU on the real chip.
 
@@ -365,37 +429,23 @@ def model_bench_on_tpu():
       bf16 peak, so TFLOPS > peak is impossible by construction.
     """
     import os
-    import subprocess
     import sys as _sys
 
     if os.environ.get("BENCH_MODEL", "1") == "0":
         return {}
-    # probe the accelerator in a SUBPROCESS with a timeout first: a downed
-    # TPU relay makes jax.devices() hang indefinitely in-process
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "5"))
     wait_s = float(os.environ.get("BENCH_TPU_WAIT", "60"))
     err = ""
     if os.environ.get("BENCH_ALLOW_CPU", "0") == "1":
         attempts = 0  # sections force the CPU platform; nothing to probe
     for i in range(attempts):
-        try:
-            probe = subprocess.run(
-                [_sys.executable, "-c",
-                 "import jax; assert jax.default_backend() == 'tpu', "
-                 "'NOT_TPU:' + jax.default_backend()"],
-                timeout=120, capture_output=True,
-            )
-            if probe.returncode == 0:
-                err = ""
-                break
-            detail = probe.stderr.decode(errors="replace")[-200:]
-            err = "no usable accelerator backend: " + detail
-            if "NOT_TPU:" in detail:
-                # deterministic non-TPU backend (CPU-only box), not a
-                # relay flake — retrying cannot change the answer
-                return {"tpu_model_bench_error": err}
-        except subprocess.TimeoutExpired:
-            err = "accelerator probe timed out (relay down?)"
+        up, detail = probe_tpu()
+        if up:
+            err = ""
+            break
+        err = detail
+        if "NOT_TPU:" in detail:
+            return {"tpu_model_bench_error": err}
         if i < attempts - 1:
             print(
                 f"# tpu probe attempt {i + 1}/{attempts} failed ({err}); "
@@ -405,40 +455,20 @@ def model_bench_on_tpu():
     if err:
         return {"tpu_model_bench_error": err}
 
-    sections = {
-        "model": int(os.environ.get("BENCH_SECTION_TIMEOUT_MODEL", "900")),
-        "serve": int(os.environ.get("BENCH_SECTION_TIMEOUT_SERVE", "900")),
-        "model1b": int(os.environ.get("BENCH_SECTION_TIMEOUT_1B", "1800")),
-        "flash32k": int(os.environ.get("BENCH_SECTION_TIMEOUT_32K", "600")),
-        "pagedattn": int(os.environ.get("BENCH_SECTION_TIMEOUT_PAGED", "600")),
-    }
+    sections = tpu_section_table()
     chosen = os.environ.get("BENCH_SECTIONS", "")
     if chosen:
         sections = {k: v for k, v in sections.items() if k in chosen.split(",")}
     out = {}
     for name, timeout in sections.items():
-        serr = ""
-        for _attempt in range(2):
-            try:
-                p = subprocess.run(
-                    [_sys.executable, __file__, f"--tpu-section={name}"],
-                    timeout=timeout, capture_output=True,
-                )
-                if p.returncode == 0:
-                    line = p.stdout.decode().strip().splitlines()[-1]
-                    out.update(json.loads(line))
-                    serr = ""
-                    break
-                serr = p.stderr.decode(errors="replace")[-300:]
-            except subprocess.TimeoutExpired:
-                # a full-timeout section is deterministically slow, not a
-                # transient flake — rerunning it doubles the wasted wall
-                serr = f"section timed out after {timeout}s"
-                break
-            except Exception as e:
-                serr = str(e)[:300]
-        if serr:
-            out[f"tpu_{name}_error"] = serr
+        res = run_tpu_section(name, timeout)
+        if f"tpu_{name}_error" in res and not res.get(
+            f"tpu_{name}_timed_out"
+        ):
+            # one retry for transient flakes; a full-timeout section is
+            # deterministically slow — rerunning doubles the wasted wall
+            res = run_tpu_section(name, timeout)
+        out.update(res)
     return out
 
 

@@ -1,0 +1,1 @@
+exec python tools/tpu_validate.py

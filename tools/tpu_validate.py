@@ -1,0 +1,148 @@
+"""Opportunistic TPU validation: probe the relay all round, capture a green
+artifact the moment it comes up.
+
+Rounds 1-3 bet every on-chip number on the driver's end-of-round bench run,
+and the relay was down at every round boundary (VERDICT r3 Missing #1).  This
+script inverts the strategy: started at round BEGIN (``make tpu-validate`` or
+``make tpu-validate-bg``), it probes the accelerator every PROBE_INTERVAL
+seconds for up to DEADLINE hours.  Each attempt is appended to
+``TPU_PROBE_LOG.jsonl`` (the committed proof-of-attempts the verdict asks
+for).  On the first successful probe it runs every TPU bench section via the
+shared ``bench.run_tpu_section`` runner, writes ``BENCH_TPU_validation.json``,
+and commits both files.  Sections that fail are retried on later green
+probes; the script exits once every section has produced real metrics (or
+the deadline passes).
+
+Reference slot: /root/reference/README.md:47-89 (the reference exists to run
+live); SURVEY §6 (this repo's own measured numbers are the baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
+ARTIFACT = os.path.join(REPO, "BENCH_TPU_validation.json")
+
+sys.path.insert(0, REPO)
+from bench import probe_tpu, run_tpu_section, tpu_section_table  # noqa: E402
+
+SECTIONS = tpu_section_table()
+
+
+def log_attempt(entry: dict) -> None:
+    entry["ts"] = round(time.time(), 1)
+    entry["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def git_commit(paths: list[str], msg: str) -> bool:
+    """Commit ONLY these paths from the background without racing the
+    foreground session: ``git add`` tracks them, ``commit --only -- paths``
+    never sweeps files the foreground may have staged concurrently.
+    Retries through transient index.lock contention."""
+    for _ in range(6):
+        try:
+            subprocess.run(["git", "add", "--", *paths], cwd=REPO,
+                           capture_output=True, timeout=60)
+            p = subprocess.run(
+                ["git", "commit", "--only", "-m", msg, "--", *paths],
+                cwd=REPO, capture_output=True, timeout=60,
+            )
+            blob = p.stdout + p.stderr
+            if p.returncode == 0 or b"nothing" in blob:  # clean no-op is ok
+                return True
+        except Exception:
+            pass
+        time.sleep(10)
+    return False
+
+
+def main() -> int:
+    interval = float(os.environ.get("TPU_PROBE_INTERVAL", "180"))
+    deadline = time.time() + float(
+        os.environ.get("TPU_PROBE_DEADLINE_H", "11")
+    ) * 3600
+    results: dict = {}
+    done: set[str] = set()
+    committed: set[str] = set()
+    timeouts: dict[str, int] = {}  # section -> full-timeout count
+    green_runs = 0
+    n = 0
+    def settled():
+        """Every section green or given up on (2 full timeouts)."""
+        return done | {
+            s for s, c in timeouts.items() if c >= 2
+        } == set(SECTIONS)
+
+    while time.time() < deadline and not settled():
+        n += 1
+        up, detail = probe_tpu()
+        log_attempt({"attempt": n, "up": up, "detail": detail})
+        if not up:
+            if "NOT_TPU:" in detail:
+                # deterministic non-TPU backend (CPU-only box), not a relay
+                # flake — retrying cannot change the answer
+                break
+            time.sleep(interval)
+            continue
+        # relay is up: run every not-yet-green section now, while it lasts
+        green_runs += 1
+        results["tpu_chip_kind_probe"] = detail
+        for name, timeout in SECTIONS.items():
+            if name in done:
+                continue
+            if timeouts.get(name, 0) >= 2:
+                continue  # deterministically slow — rerunning wastes wall
+            if name != next(iter(SECTIONS)):
+                # cheap re-probe between sections: if the relay dropped
+                # mid-window, don't burn the remaining sections' full
+                # timeouts against a dead relay
+                still_up, _d = probe_tpu(timeout=60)
+                if not still_up:
+                    log_attempt({"window": green_runs,
+                                 "relay_dropped_mid_window": True})
+                    break
+            out = run_tpu_section(name, timeout)
+            if out.pop(f"tpu_{name}_timed_out", None):
+                timeouts[name] = timeouts.get(name, 0) + 1
+            results.update(out)
+            if f"tpu_{name}_error" not in out:
+                done.add(name)
+                results.pop(f"tpu_{name}_error", None)
+            log_attempt({"section": name,
+                         "ok": f"tpu_{name}_error" not in out})
+        # commit only on PROGRESS (a new section went green) — an artifact
+        # with zero green sections proves nothing, and re-committing an
+        # unchanged one every probe interval would spam history
+        if done and done != committed:
+            results["validated_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+            results["sections_green"] = sorted(done)
+            with open(ARTIFACT, "w") as f:
+                json.dump(results, f, indent=1, sort_keys=True)
+                f.write("\n")
+            if git_commit(
+                [ARTIFACT, LOG],
+                f"On-chip TPU validation artifact: {len(done)}/"
+                f"{len(SECTIONS)} sections green ({', '.join(sorted(done))})",
+            ):  # on failure leave `committed` stale so the next green
+                # window retries the commit
+                committed = set(done)
+        if not settled():
+            time.sleep(interval)
+    # deadline or full success: commit the attempt log either way
+    git_commit([LOG], f"TPU relay probe log: {n} attempts, "
+                      f"{green_runs} green windows")
+    return 0 if done == set(SECTIONS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
