@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import socket
 import sys
 import threading
@@ -1068,9 +1069,23 @@ def main():
         ExtenderArgs(pod=xl_pod, node_names=[f"xl-h{j}" for j in range(256)])
     )
     assert filt.node_names, filt.failed_nodes
-    results["v5p2048_gang1024_plan_ms"] = round(
-        (time.perf_counter() - t0) * 1000, 3
-    )
+    plan_ms = round((time.perf_counter() - t0) * 1000, 3)
+    results["v5p2048_gang1024_plan_ms"] = plan_ms
+    # loud-but-not-fatal budget (VERDICT r3 #4): the r02→r03 27% regression
+    # went unnoticed because nothing asserted a bound.  135ms = the r02
+    # level this was recovered to (77ms measured after the free-anchored
+    # enumeration fix, so the budget has ~1.75x noise headroom).
+    try:
+        budget_ms = float(os.environ.get("BENCH_PLAN_BUDGET_MS", "135"))
+    except ValueError:
+        budget_ms = 135.0  # loud-but-not-fatal: a bad override must not
+        # kill the bench after the expensive configs already ran
+    if plan_ms > budget_ms:
+        results["v5p2048_gang1024_plan_over_budget"] = True
+        print(
+            f"# WARNING: 1024-member plan {plan_ms}ms exceeds "
+            f"{budget_ms}ms budget", file=sys.stderr,
+        )
 
     results.update(model_bench_on_tpu())
 

@@ -168,11 +168,16 @@ class ChipSet:
         Large meshes use the native C++ enumerator (core/native.py); results
         are identical to the Python path (tests/test_native.py).
         """
-        free = {c.coord for c in self.free_chips()}
+        free = {co for co, ch in self.chips.items() if ch.is_free}
         if len(free) < count:
             return
         emitted = 0
-        if self.topo.num_chips >= self.NATIVE_THRESHOLD:
+        # the C++ mask scan is O(mesh); it wins only when this set OWNS a
+        # large share of the mesh.  A host view (4-8 chips of a 1024-chip
+        # slice) enumerates faster from its own free cells (placements_at)
+        # than by scanning the full mesh — keying the threshold on owned
+        # chips, not mesh size, was the 1024-member gang-plan hot fix.
+        if len(self.chips) >= self.NATIVE_THRESHOLD:
             from .native import get_placement
 
             native = get_placement()
@@ -192,8 +197,9 @@ class ChipSet:
                     yield fallback, False
                 return
         seen: set[frozenset] = set()
+        sorted_free = sorted(free, key=self.topo.index)
         for shape in self.topo.box_shapes(count):
-            for box in self.topo.placements(shape):
+            for box in self.topo.placements_at(shape, sorted_free):
                 if emitted >= max_candidates:
                     break
                 if all(c in free for c in box):
@@ -206,8 +212,7 @@ class ChipSet:
             if emitted >= max_candidates:
                 break
         if emitted == 0:
-            fallback = tuple(sorted(free, key=self.topo.index)[:count])
-            yield fallback, False
+            yield tuple(sorted_free[:count]), False
 
     def _fractional_candidates(self, core: int, hbm: int) -> Iterator[Coord]:
         for ch in sorted(self.chips.values(), key=lambda c: self.topo.index(c.coord)):
@@ -234,13 +239,14 @@ class ChipSet:
         chosen: list[ContainerAlloc] = []
         best: list[Optional[Option]] = [None]
         budget = [search_budget]
+        req_hash = request.hash()  # invariant across the whole search
 
         def dfs(i: int) -> None:
             if budget[0] <= 0:
                 return
             if i == len(units):
                 budget[0] -= 1
-                opt = Option(request.hash(), tuple(chosen))
+                opt = Option(req_hash, tuple(chosen))
                 score = rater.rate(self, opt)
                 opt.score = score
                 if best[0] is None or score > best[0].score:

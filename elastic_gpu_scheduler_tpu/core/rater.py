@@ -42,6 +42,11 @@ def _locality_bonus(chips: ChipSet, option: Option) -> float:
         if not a.contiguous:
             scores.append(0.0)
             continue
+        if len(a.coords) == 1:
+            # single chip: bb=(1,..), fill=1, elong=1 → 1·(1-0.3) exactly;
+            # skipping bounding_box here halves gang-plan rating cost
+            scores.append(0.7)
+            continue
         bb = bounding_box(a.coords)
         vol = 1
         for d in bb:

@@ -110,11 +110,12 @@ def parse_quantity(v: object) -> int:
 
         # suffixes: milli "m"; decimal k M G T P E (lowercase k only);
         # binary Ki Mi Gi Ti Pi Ei (uppercase + i) — the exact
-        # resource.Quantity grammar, nothing looser
+        # resource.Quantity grammar, nothing looser: an exponent and a
+        # suffix are mutually exclusive ("2e3Ki" is malformed in Go's
+        # parser and must 400, not parse)
         _QUANTITY_RE = re.compile(
             r"^([+-]?[0-9]+(?:\.[0-9]*)?|[+-]?\.[0-9]+)"
-            r"(?:[eE]([+-]?[0-9]+))?"
-            r"(m|[KMGTPE]i|[kMGTPE])?$"
+            r"(?:[eE]([+-]?[0-9]+)|(m|[KMGTPE]i|[kMGTPE]))?$"
         )
     s = str(v).strip()
     mt = _QUANTITY_RE.match(s)

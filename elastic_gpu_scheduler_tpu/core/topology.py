@@ -85,6 +85,12 @@ class Topology:
             object.__setattr__(self, "wrap", (False,) * len(self.dims))
         if len(self.wrap) != len(self.dims):
             raise ValueError("wrap length must match dims")
+        n = 1
+        for d in self.dims:
+            n *= d
+        # cached: num_chips sits on the allocator's DFS hot path (range
+        # checks in coord_of), where a per-call np.prod dominated profiles
+        object.__setattr__(self, "_num_chips", n)
 
     @classmethod
     def from_spec(cls, spec: str, family: str = "v5e") -> "Topology":
@@ -97,7 +103,7 @@ class Topology:
 
     @property
     def num_chips(self) -> int:
-        return int(np.prod(self.dims))
+        return self._num_chips
 
     def spec(self) -> str:
         return format_topology(self.dims)
@@ -165,6 +171,38 @@ class Topology:
                 )
                 box.append(c)
             yield tuple(box)
+
+    def placements_at(
+        self, shape: Sequence[int], origins: Sequence[Coord]
+    ) -> Iterator[tuple[Coord, ...]]:
+        """``placements(shape)`` restricted to the given candidate origins.
+
+        Because a box always contains its own origin cell (offset 0), every
+        all-free box's origin is a free cell — so enumerating origins from
+        the free set alone yields the SAME valid boxes as a full-mesh scan,
+        in the same canonical order when ``origins`` is sorted by row-major
+        index, at O(|free|·|shape|) instead of O(|mesh|·|shape|).  Origins
+        outside ``placements``'s origin ranges are skipped identically.
+        """
+        if len(shape) != self.ndim:
+            raise ValueError(f"shape {shape} has wrong rank for {self.dims}")
+        if any(s > d for s, d in zip(shape, self.dims)):
+            return
+        lims = tuple(
+            d if (w and s < d) else d - s + 1
+            for s, d, w in zip(shape, self.dims, self.wrap)
+        )
+        offs_all = list(itertools.product(*(range(s) for s in shape)))
+        for origin in origins:
+            if any(o >= lim for o, lim in zip(origin, lims)):
+                continue
+            yield tuple(
+                tuple(
+                    (o + f) % d if w else o + f
+                    for o, f, d, w in zip(origin, offs, self.dims, self.wrap)
+                )
+                for offs in offs_all
+            )
 
     def box_shapes(self, count: int, max_shapes: int = 64) -> list[tuple[int, ...]]:
         """Axis-aligned box shapes with `count` chips that fit in this mesh.

@@ -1537,11 +1537,14 @@ class InferenceEngine:
         starts = self.draft_len.copy()
         advance = np.zeros(B, np.int32)
         for i, toks in enumerate(pend):
-            if not toks and self.draft_len[i] > 0:
+            if not toks and self.draft_len[i] > 0 and active[i]:
                 # fully caught up (e.g. everything ingested in a prior
                 # pass): re-feed the LAST context token one position back
                 # so the rollout starts from real logits, not a pad's.
-                # Rewriting that position's K/V is idempotent.
+                # Rewriting that position's K/V is idempotent.  Active
+                # rows only — a stalled row with prior ingestion would
+                # otherwise re-ingest its last token every verify pass
+                # (wasted dispatch width; counts=0 is correct for it).
                 q = int(self.draft_len[i]) - 1
                 plen = int(self.prompt_lens[i])
                 req = self.slots[i]

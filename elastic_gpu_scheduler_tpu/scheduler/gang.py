@@ -231,13 +231,15 @@ class GangCoordinator:
                     n: f"gang {gkey}: all {req.gang_size} slots claimed"
                     for n in node_names
                 }
-            units_changed = (
-                existing_idx is not None
-                and req.units != plan.slot_units[existing_idx]
+            shape_changed = existing_idx is not None and (
+                req.units != plan.slot_units[existing_idx]
+                or req.container_names != plan.slot_containers[existing_idx]
             )
             if (
-                existing_idx is None and req.units != plan.member_units
-            ) or units_changed:
+                existing_idx is None
+                and (req.units, req.container_names)
+                != (plan.member_units, plan.member_containers)
+            ) or shape_changed:
                 # heterogeneous member (VERDICT r2 #5b): its slot was planned
                 # for a different shape — replan the whole gang with every
                 # SEEN shape pinned before handing out a slot.  Covers both
@@ -602,6 +604,9 @@ class GangCoordinator:
                             plan.slot_units[idx]
                             if idx < len(plan.slot_units)
                             else plan.member_units,
+                            plan.slot_containers[idx]
+                            if idx < len(plan.slot_containers)
+                            else plan.member_containers,
                         )
 
         try:
@@ -613,10 +618,16 @@ class GangCoordinator:
                     for key, (node, pod) in members:
                         opt = None
                         cached = plan_slots.get(key)
+                        # full request identity, not just units: a pod
+                        # recreated with identical units but renamed or
+                        # reordered containers must NOT reuse the planned
+                        # Option (its ContainerAllocs carry container names)
+                        creq = request_from_pod(pod)
                         if (
                             cached is not None
                             and cached[0] == node
-                            and request_from_pod(pod).units == cached[2]
+                            and creq.units == cached[2]
+                            and creq.container_names == cached[3]
                         ):
                             try:
                                 sched.gang_apply_option(node, pod, cached[1])

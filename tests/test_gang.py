@@ -349,7 +349,10 @@ def test_gang_1024_replicas_on_v5p_2048_scale():
     filt = predicate.handle(ExtenderArgs(pod=req_pod, node_names=hosts))
     plan_s = time.time() - t0
     assert filt.node_names, filt.failed_nodes
-    assert plan_s < 2.0, f"planning took {plan_s:.2f}s"
+    # budget (VERDICT r3 #4): ~77ms after the free-anchored enumeration fix;
+    # 0.5s leaves 6x headroom for loaded CI boxes while still catching a
+    # structural regression loudly
+    assert plan_s < 0.5, f"planning took {plan_s:.2f}s"
     # claim the remaining 1023 slots (each filter is a dict lookup now)
     t0 = time.time()
     for i in range(1, 1024):
@@ -676,3 +679,65 @@ def test_recreated_member_with_new_shape_replans(small_stack):
         for c in ns["chips"].values()
     )
     assert used == 600, f"ledger charged {used}, want 200+400"
+
+
+def test_recreated_member_with_renamed_container_rebinds_names(small_stack):
+    """Same units, renamed container: the cached planned Option carries
+    ContainerAllocs under the OLD container name — reusing it would write
+    chip-coordinate annotations for a container that no longer exists
+    (ADVICE r3).  The commit must fall through to a fresh allocation keyed
+    by the new name."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+
+    def named_pod(container):
+        return make_pod(
+            "rn-0",
+            containers=[
+                Container(
+                    name=container,
+                    resources=ResourceRequirements(
+                        limits={consts.RESOURCE_TPU_CORE: 400}
+                    ),
+                )
+            ],
+            annotations={
+                consts.ANNOTATION_GANG_NAME: "rnset",
+                consts.ANNOTATION_GANG_SIZE: "2",
+            },
+        )
+
+    first = named_pod("main")
+    cluster.create_pod(first)
+    filt = predicate.handle(ExtenderArgs(pod=first, node_names=nodes))
+    assert filt.node_names, filt.failed_nodes
+
+    # recreate with IDENTICAL units but a renamed container
+    cluster.delete_pod("default", "rn-0")
+    renamed = named_pod("worker")
+    cluster.create_pod(renamed)
+    filt = predicate.handle(ExtenderArgs(pod=renamed, node_names=nodes))
+    assert filt.node_names, filt.failed_nodes
+
+    second = gang_pod("rn-1", "rnset", 2, core=400)
+    cluster.create_pod(second)
+    results = [None] * 2
+    threads = [
+        threading.Thread(
+            target=drive_member,
+            args=(cluster, predicate, bind, p, nodes, results, i),
+        )
+        for i, p in enumerate([renamed, second])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(r is not None and r[0] == "ok" for r in results), results
+
+    bound = cluster.get_pod("default", "rn-0")
+    ann = bound.metadata.annotations
+    new_key = consts.ANNOTATION_CONTAINER_PREFIX + "worker"
+    old_key = consts.ANNOTATION_CONTAINER_PREFIX + "main"
+    assert new_key in ann, sorted(ann)
+    assert old_key not in ann, sorted(ann)

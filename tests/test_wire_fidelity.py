@@ -261,7 +261,9 @@ def test_quantity_parsing_matches_go_value_semantics():
     assert parse_quantity("2M") == 2_000_000
     assert parse_quantity("2e3") == 2000
     assert parse_quantity("1.5e2") == 150
-    for bad in ("abc", "1.2.3", "12x", "", True):
+    # exponent and suffix are mutually exclusive in the Quantity grammar:
+    # Go's parser rejects "2e3Ki" — so must we (ADVICE r3)
+    for bad in ("abc", "1.2.3", "12x", "", True, "2e3Ki", "1e2m", "3E1M"):
         with pytest.raises(ValueError):
             parse_quantity(bad)
 
