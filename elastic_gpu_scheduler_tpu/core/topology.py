@@ -152,25 +152,13 @@ class Topology:
         On wrapped (torus) axes the box may wrap around; on mesh axes it must
         fit inside.  `shape` must have self.ndim axes.
         """
-        if len(shape) != self.ndim:
-            raise ValueError(f"shape {shape} has wrong rank for {self.dims}")
-        if any(s > d for s, d in zip(shape, self.dims)):
-            return
-        origin_ranges = []
-        for s, d, w in zip(shape, self.dims, self.wrap):
-            if w and s < d:
-                origin_ranges.append(range(d))
-            else:
-                origin_ranges.append(range(d - s + 1))
-        for origin in itertools.product(*origin_ranges):
-            box = []
-            for offs in itertools.product(*(range(s) for s in shape)):
-                c = tuple(
-                    (o + f) % d if w else o + f
-                    for o, f, d, w in zip(origin, offs, self.dims, self.wrap)
-                )
-                box.append(c)
-            yield tuple(box)
+        origin_ranges = [
+            range(d) if (w and s < d) else range(d - s + 1)
+            for s, d, w in zip(shape, self.dims, self.wrap)
+        ]
+        yield from self.placements_at(
+            shape, itertools.product(*origin_ranges)
+        )
 
     def placements_at(
         self, shape: Sequence[int], origins: Sequence[Coord]
@@ -183,6 +171,8 @@ class Topology:
         in the same canonical order when ``origins`` is sorted by row-major
         index, at O(|free|·|shape|) instead of O(|mesh|·|shape|).  Origins
         outside ``placements``'s origin ranges are skipped identically.
+        ``placements`` itself delegates here (one copy of the wrap/offset
+        geometry).
         """
         if len(shape) != self.ndim:
             raise ValueError(f"shape {shape} has wrong rank for {self.dims}")

@@ -197,3 +197,66 @@ GANG_COMMIT = REGISTRY.register(
         "(allocate + annotation write + binding; excludes barrier wait)",
     )
 )
+LOCK_WAIT = REGISTRY.register(
+    Histogram(
+        "tpu_scheduler_lock_wait_seconds",
+        "Time spent WAITING to acquire the engine-global scheduler lock "
+        "and the gang coordinator lock (the mutex/block-profile parity "
+        "slot: reference pprof.go:10-64 mounts Go's block/mutex profiles)",
+        ("lock",),
+    )
+)
+
+
+class TimedLock:
+    """Lock/RLock wrapper that records acquisition WAIT time in LOCK_WAIT.
+
+    The scheduler's single coarse lock is its scaling cliff (the
+    reference's GPUUnitScheduler carries the same design, scheduler.go:44);
+    CPU/heap/stack profiling existed here but nothing measured how long
+    binds queue on the mutex.  Hold time is deliberately NOT measured —
+    waiters' wait IS holders' hold, and wait is the operative signal."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self._inner = (
+            threading.RLock() if reentrant else threading.Lock()
+        )
+        self._name = name
+        # owner/depth: reentrant re-acquires by the holder wait 0 by
+        # definition — sampling them would flood the histogram with ~0s
+        # entries and mask real queueing (the signal this exists for).
+        # _owner is written only by the holder; a racing reader sees
+        # either None or another thread's id, and measures — correct
+        # either way.
+        self._owner: int | None = None
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:  # reentrant re-acquire: no wait, no sample
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+            return ok
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:  # failed acquires (timeout / non-blocking miss) are not
+            # waits that ended in the lock — don't pollute the histogram
+            self._owner = me
+            self._depth = 1
+            LOCK_WAIT.observe(self._name, value=time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

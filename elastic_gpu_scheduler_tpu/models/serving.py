@@ -281,7 +281,7 @@ def _sproj(x, p, name, dtype, ad, aids):
     return y
 
 
-def _moe_ffn_serve(h, p, dtype):
+def _moe_ffn_serve(h, p, dtype, ep=False):
     """Drop-free top-1 MoE FFN for the serving paths.
 
     Training's ``moe_ffn`` (models/moe.py) drops tokens past an expert's
@@ -299,6 +299,13 @@ def _moe_ffn_serve(h, p, dtype):
       contributions vanish) — E× dense FLOPs but static shapes, no
       gather of T weight matrices.  A Pallas grouped-matmul is the
       optimization path if expert counts grow.
+
+    ``ep`` (expert-parallel serving mesh, expert axis > 1): force the
+    mask-dispatch form even at decode size — per-token weight GATHERS over
+    an expert-sharded (E, D, F) array would all-gather whole expert
+    matrices across ranks every step, while mask-dispatch keeps each
+    rank's experts local and GSPMD reduces the combine (the same
+    dispatch/combine geometry as training's all-to-all, models/moe.py).
     """
     B, T, D = h.shape
     tokens = B * T
@@ -307,7 +314,7 @@ def _moe_ffn_serve(h, p, dtype):
     probs = jax.nn.softmax(glog, axis=-1)  # (T, E)
     idx = jnp.argmax(probs, axis=-1)  # (T,)
     prob = jnp.max(probs, axis=-1).astype(jnp.float32)  # (T,)
-    if tokens <= 32:
+    if tokens <= 32 and not ep:
         wg = wmat(p["w_gate"], dtype)[idx]  # (T, D, F)
         wi = wmat(p["w_in"], dtype)[idx]
         wo = wmat(p["w_out"], dtype)[idx]
@@ -333,7 +340,7 @@ def _moe_ffn_serve(h, p, dtype):
 
 
 def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
-                 ad=None, aids=None):
+                 ad=None, aids=None, ep=False):
     """ONE transformer layer shared by every paged path (decode step,
     plain prefill, prefixed prefill) — the paths differ only in position
     arithmetic and the attention geometry, which arrive as ``positions``
@@ -362,7 +369,7 @@ def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
         # expert FFN weights are expert-stacked (E, D, F) — LoRA targets
         # the dense projections only (build_lora_bank rejects adapters
         # against expert-stacked shapes at construction)
-        x = x + _moe_ffn_serve(h, p, dtype)
+        x = x + _moe_ffn_serve(h, p, dtype, ep=ep)
     else:
         gate = jax.nn.silu(_sproj(h, p, "w_gate", dtype, ad, aids))
         up = _sproj(h, p, "w_in", dtype, ad, aids)
@@ -370,8 +377,57 @@ def _paged_layer(x, p, lkv, positions, pidx, off, attn, cfg, dtype,
     return x, lkv
 
 
+def _mesh_ep(mesh) -> bool:
+    """True when the serving mesh distributes experts (expert axis > 1)."""
+    return mesh is not None and mesh.shape.get("expert", 1) > 1
+
+
+def _paged_attn_call(q, lkv, tables, lengths, cfg, mesh, dtype):
+    """Attend straight off one layer's page pool with the Pallas kernel
+    (ops/paged_attention) — in-place page reads, int8 dequant in-kernel,
+    sliding window, W-query verify windows.
+
+    q: (B, Hn, Dh) decode or (B, W, Hn, Dh) verify.  Under a mesh the
+    kernel is shard_mapped over the ``tensor`` axis on the head dims
+    (tables/lengths replicated): each rank attends its own heads against
+    its own shard of the pool — no collectives, the output stays
+    head-sharded exactly like the gather path's einsums."""
+    from ..ops.attention import _use_pallas
+    from ..ops.paged_attention import paged_attention
+
+    kw = dict(
+        window=cfg.window_size, dtype=dtype, interpret=not _use_pallas()
+    )
+    sk, sv = lkv.get("ks"), lkv.get("vs")
+    if mesh is None:
+        return paged_attention(
+            q, lkv["k"], lkv["v"], tables, lengths,
+            scales_k=sk, scales_v=sv, **kw,
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    qspec = P(*([None] * (q.ndim - 2)), "tensor", None)
+    pspec = P(None, None, "tensor", None)
+    in_specs = [qspec, pspec, pspec, P(), P()]
+    operands = [q, lkv["k"], lkv["v"], tables, lengths]
+    if sk is not None:
+        in_specs += [P(None, None, "tensor")] * 2
+        operands += [sk, sv]
+
+    def local(q_, k_, v_, tbl, ln, *scales):
+        s = dict(zip(("scales_k", "scales_v"), scales))
+        return paged_attention(q_, k_, v_, tbl, ln, **s, **kw)
+
+    fn = shard_map(
+        local, mesh=mesh, in_specs=tuple(in_specs), out_specs=qspec,
+        check_rep=False,
+    )
+    return fn(*operands)
+
+
 def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
-                       bank=None, aids=None, paged_kernel=False):
+                       bank=None, aids=None, paged_kernel=False, mesh=None):
     """One decode step for every slot at its own position, against the page
     pool.
 
@@ -394,12 +450,8 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
         if paged_kernel:
             # in-place page reads: HBM traffic is the live pages once,
             # not a full gathered copy per step (ops/paged_attention)
-            from ..ops.attention import _use_pallas
-            from ..ops.paged_attention import paged_attention
-
-            o = paged_attention(
-                q[:, 0], lkv["k"], lkv["v"], tables, lengths,
-                interpret=not _use_pallas(),
+            o = _paged_attn_call(
+                q[:, 0], lkv, tables, lengths, cfg, mesh, dtype
             )
             return o.reshape(B, 1, Hn * Dh)
         # gather the slot's pages into a virtually-contiguous view; position
@@ -414,7 +466,7 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
         p, lkv, ad = scanned  # this layer's pool + bank slices
         return _paged_layer(
             x, p, lkv, lengths[:, None], page_idx, offset, attn, cfg, dtype,
-            ad, aids,
+            ad, aids, ep=_mesh_ep(mesh),
         )
 
     x, new_kv = jax.lax.scan(
@@ -426,7 +478,7 @@ def _paged_decode_step(params, tokens, kv, tables, lengths, cfg, page_size,
 
 
 def _paged_prefill(params, tokens, kv, pages, t_real, bank=None, aid=None,
-                   *, cfg, page_size):
+                   *, cfg, page_size, mesh=None):
     """One-pass prompt ingestion for ONE slot (the paged analogue of
     ``generate.forward_cached`` with an empty prefix): self-attention over
     the whole prompt block, K/V scattered into the slot's pages.
@@ -468,7 +520,7 @@ def _paged_prefill(params, tokens, kv, pages, t_real, bank=None, aid=None,
         p, lkv, ad = scanned  # this layer's pool + bank slices
         return _paged_layer(
             x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype,
-            ad, None if aid is None else aid[None],
+            ad, None if aid is None else aid[None], ep=_mesh_ep(mesh),
         )
 
     x, new_kv = jax.lax.scan(
@@ -482,7 +534,7 @@ def _paged_prefill(params, tokens, kv, pages, t_real, bank=None, aid=None,
 
 def _paged_prefill_prefixed(
     params, tokens, kv, pages, t0, t_real, bank=None, aid=None,
-    *, cfg, page_size
+    *, cfg, page_size, mesh=None
 ):
     """One-pass prompt ingestion BEHIND a shared cached prefix.
 
@@ -516,7 +568,7 @@ def _paged_prefill_prefixed(
         p, lkv, ad = scanned
         return _paged_layer(
             x, p, lkv, positions[None, :], pidx, off, attn, cfg, dtype,
-            ad, None if aid is None else aid[None],
+            ad, None if aid is None else aid[None], ep=_mesh_ep(mesh),
         )
 
     x, new_kv = jax.lax.scan(
@@ -532,7 +584,7 @@ def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
     bank=None, aids=None,
-    *, cfg, page_size, n_steps, use_filters, paged_kernel=False,
+    *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches).
@@ -552,7 +604,7 @@ def _fused_serve_chunk(
         tokens, lengths, key, kv = carry
         logits, kv = _paged_decode_step(
             params, tokens, kv, tables, lengths, cfg, page_size, bank, aids,
-            paged_kernel=paged_kernel,
+            paged_kernel=paged_kernel, mesh=mesh,
         )
         key, sub = jax.random.split(key)
         if use_filters:
@@ -616,7 +668,7 @@ def _fused_verify_chunk(
     params, kv, tables, feed, lengths, active,
     temps, top_ks, top_ps, key,
     bank=None, aids=None,
-    *, cfg, page_size, use_filters,
+    *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
 ):
     """ONE wide pass over every slot's verify window (speculative decoding
     inside the paged engine — VERDICT r2 #2).
@@ -654,6 +706,12 @@ def _fused_verify_chunk(
     off = (positions % page_size).reshape(B * W)
 
     def attn(q, k, v, lkv):
+        if paged_kernel:
+            # the W-query kernel variant: verify attends through the SAME
+            # kernel as plain decode, so a mixed greedy batch never mixes
+            # two differently-rounded attention implementations
+            o = _paged_attn_call(q, lkv, tables, lengths, cfg, mesh, dtype)
+            return o.reshape(B, W, Hn * Dh)
         k_all, v_all = _kv_gather(lkv, tables, page_size, dtype)
         return _cached_attention_rows(
             q, k_all, v_all, lengths, window=cfg.window_size
@@ -662,7 +720,8 @@ def _fused_verify_chunk(
     def layer_step(x, scanned):
         p, lkv, ad = scanned
         return _paged_layer(
-            x, p, lkv, positions, pidx, off, attn, cfg, dtype, ad, aids
+            x, p, lkv, positions, pidx, off, attn, cfg, dtype, ad, aids,
+            ep=_mesh_ep(mesh),
         )
 
     x, new_kv = jax.lax.scan(
@@ -803,7 +862,13 @@ class InferenceEngine:
         ``paged_kernel``: decode attention reads the page pool IN PLACE
         via the Pallas kernel (ops/paged_attention) instead of gathering
         a contiguous copy per step — the long-context HBM-bandwidth win.
-        Opt-in; see the constructor guard for the supported combinations.
+        Composes with kv_int8 (in-kernel dequant), sliding windows,
+        spec_k/draft speculation (the W-query verify-window kernel), and
+        a mesh (shard_map over the tensor axis); the only hard
+        requirement is head counts divisible by the tensor axis when
+        both paged_kernel and mesh are on.  Opt-in (default off) until
+        an on-chip run validates the Mosaic lowering
+        (bench --tpu-section=pagedattn).
 
         ``mesh``: serve TENSOR-PARALLEL over a `jax.sharding.Mesh` with a
         ``tensor`` axis — for checkpoints too big for one chip's HBM.
@@ -832,20 +897,22 @@ class InferenceEngine:
         self.fused_steps = max(1, fused_steps)
         self.kv_int8 = kv_int8
         self.paged_kernel = paged_kernel
-        if paged_kernel and (
-            kv_int8 or cfg.window_size > 0 or mesh is not None or spec_k > 0
-        ):
-            # spec_k is excluded because verify chunks attend via the
-            # gather path: a greedy slot's tokens would then come from two
-            # differently-rounded attention implementations depending on
-            # batch composition — the nondeterminism the engine promises
-            # away.  A kernel verify variant lifts this later.
-            raise ValueError(
-                "paged_kernel composes with bf16/f32 pools, full causal "
-                "attention, single-device non-speculative engines only "
-                "(for now) — disable kv_int8/window/mesh/spec_k or the "
-                "kernel"
-            )
+        # round 4 (VERDICT r3 #2): the kernel composes with kv_int8
+        # (in-kernel dequant through the compute dtype — bit-identical to
+        # _kv_gather), sliding windows (dead pages skipped, DMA included),
+        # spec_k (a W-query verify-window kernel variant — decode and
+        # verify share one attention implementation, so determinism
+        # holds), and a mesh (shard_map over the tensor axis on the head
+        # dims).  The only remaining constraint is structural: head
+        # sharding requires the head counts to divide the tensor axis.
+        if paged_kernel and mesh is not None:
+            t = mesh.shape.get("tensor", 1)
+            if cfg.n_heads % t or cfg.kv_heads % t:
+                raise ValueError(
+                    f"paged_kernel over a tensor={t} mesh needs n_heads "
+                    f"({cfg.n_heads}) and kv_heads ({cfg.kv_heads}) "
+                    "divisible by the tensor axis"
+                )
         self.kv = make_kv_pool(cfg, self.n_pages, page_size, kv_int8)
         if mesh is not None:
             self.kv = _shard_kv_for_mesh(self.kv, cfg, mesh)
@@ -884,6 +951,7 @@ class InferenceEngine:
                     n_steps=self.fused_steps,
                     use_filters=use_filters,
                     paged_kernel=self.paged_kernel,
+                    mesh=mesh,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
@@ -955,18 +1023,23 @@ class InferenceEngine:
                     cfg=cfg,
                     page_size=page_size,
                     use_filters=use_filters,
+                    paged_kernel=self.paged_kernel,
+                    mesh=mesh,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
         }
         self._prefill = jax.jit(
-            functools.partial(_paged_prefill, cfg=cfg, page_size=page_size),
+            functools.partial(
+                _paged_prefill, cfg=cfg, page_size=page_size, mesh=mesh
+            ),
             donate_argnums=(2,),  # the kv pool pytree
         )
         self._prefill_prefixed = jax.jit(
             functools.partial(
-                _paged_prefill_prefixed, cfg=cfg, page_size=page_size
+                _paged_prefill_prefixed, cfg=cfg, page_size=page_size,
+                mesh=mesh,
             ),
             donate_argnums=(2,),
         )

@@ -1,12 +1,12 @@
 """Paged decode attention: a Pallas TPU kernel over the serving engine's
 page pool — no gathered contiguous copy.
 
-The engine's decode path today materializes each slot's whole context from
-the page pool into a contiguous (B, max_len, Hkv, Dh) buffer every step
-(serving._kv_gather) and runs dense masked attention over it.  At short
-context that copy is noise; at long context it IS the decode cost: 32k
-tokens × 8 kv-heads × 128 dims × bf16 × K+V ≈ 128 MB of pure HBM traffic
-per slot per step, none of it compute.
+The engine's decode path otherwise materializes each slot's whole context
+from the page pool into a contiguous (B, max_len, Hkv, Dh) buffer every
+step (serving._kv_gather) and runs dense masked attention over it.  At
+short context that copy is noise; at long context it IS the decode cost:
+32k tokens × 8 kv-heads × 128 dims × bf16 × K+V ≈ 128 MB of pure HBM
+traffic per slot per step, none of it compute.
 
 This kernel reads the pages IN PLACE (vLLM's paged-attention idea, done
 the TPU way): the page table rides in scalar-prefetch memory so the
@@ -14,18 +14,34 @@ BlockSpec index_map can choose which physical page each grid step DMAs —
 grid (batch, pages); block j of row b loads pool page ``tables[b, j]``.
 An online-softmax accumulator (m, l, acc — the flash recipe) carries
 across page blocks in VMEM scratch, and the final block normalizes and
-writes the (Hn, Dh) output row.  HBM traffic is exactly the live pages,
-once.
+writes the output rows.  HBM traffic is exactly the live pages, once.
+
+Round-4 composition lifts (VERDICT r3 #2) — one parameterized kernel:
+
+- **verify window (spec_k)**: W queries per slot at positions
+  lengths[b]..lengths[b]+W-1, each causally masked to its own position —
+  speculative verify runs through the SAME kernel as plain decode, so a
+  mixed greedy batch no longer mixes two differently-rounded attention
+  implementations;
+- **int8 KV**: per-(token, head) scales dequantize inside the kernel,
+  THROUGH the pool's compute dtype (matching _kv_gather's bf16 round-trip
+  bit-for-bit, so the kernel and gather paths stay token-identical);
+- **sliding window**: pages wholly below every query's window are skipped
+  (compute and, via the index_map routing them to the scratch page, their
+  DMA too);
+- **mesh**: the engine wraps this kernel in ``shard_map`` over the
+  kv-head axis (serving._paged_attn_sharded); the kernel itself is
+  shard-oblivious — it just sees fewer heads per shard.
 
 Layout notes (pallas_guide.md):
 - the pool is passed as (n_pages, page_size, Hkv·Dh) — trailing dims
   (page_size ≥ 16, lane-multiple) keep Mosaic's bf16 tiling happy; the
   kernel reshapes loaded VALUES (not refs) back to (page_size, Hkv, Dh);
-- q/out ride as (B, Hn·Dh) rows;
+- q/out ride as (B, W, Hn·Dh) rows;
 - GQA runs as a grouped einsum inside the kernel, never expanding K/V.
 
 ``interpret=True`` makes the same kernel run on CPU (tests); the pure-JAX
-``paged_attention_reference`` is the engine's current gather path and the
+``paged_attention_reference`` is the engine's gather path and the
 numerics oracle.  Opt-in at the engine (``paged_kernel=True``) until an
 on-chip run validates the Mosaic lowering.
 
@@ -43,55 +59,89 @@ import jax.numpy as jnp
 from .attention import NEG_INF
 
 
-def paged_attention_reference(q, pool_k, pool_v, tables, lengths):
+def _dequant(k, scales, dtype):
+    """int8 rows × per-(token, head) scale → compute dtype, exactly as
+    serving._kv_gather does it (through ``dtype``, so bf16 rounding is
+    identical between the kernel and gather paths)."""
+    return (k.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+def paged_attention_reference(
+    q, pool_k, pool_v, tables, lengths, *, scales_k=None, scales_v=None,
+    window: int = 0, dtype=None,
+):
     """Gather-then-attend oracle (what serving._kv_gather + masked dense
     attention compute today).
 
-    q: (B, Hn, Dh); pool_k/v: (n_pages, page_size, Hkv, Dh);
-    tables: (B, NB) int32; lengths: (B,) int32 — row b attends to
-    positions 0..lengths[b] inclusive (the decode convention: the query
-    sits AT position lengths[b], whose K/V row was just written).
-    Returns (B, Hn, Dh)."""
-    B, Hn, Dh = q.shape
+    q: (B, Hn, Dh) — one query per row at position lengths[b] — or
+    (B, W, Hn, Dh) — W queries at positions lengths[b]..lengths[b]+W-1
+    (the speculative verify window); pool_k/v: (n_pages, page_size, Hkv,
+    Dh); tables: (B, NB) int32; lengths: (B,) int32.  Query w of row b
+    attends to positions 0..lengths[b]+w inclusive (the decode
+    convention: the query sits AT its position, whose K/V row was just
+    written), minus anything outside the sliding ``window`` when > 0.
+    ``scales_k/v``: (n_pages, page_size, Hkv) int8-pool scales.
+    Returns the same rank as q."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    B, W, Hn, Dh = q.shape
     NB = tables.shape[1]
     ps = pool_k.shape[1]
     Hkv = pool_k.shape[2]
     n_rep = Hn // Hkv
+    dtype = dtype or q.dtype
     k = pool_k[tables].reshape(B, NB * ps, Hkv, Dh)
     v = pool_v[tables].reshape(B, NB * ps, Hkv, Dh)
-    qg = q.reshape(B, Hkv, n_rep, Dh).astype(jnp.float32)
+    if scales_k is not None:
+        ks = scales_k[tables].reshape(B, NB * ps, Hkv)
+        vs = scales_v[tables].reshape(B, NB * ps, Hkv)
+        k = _dequant(k, ks, dtype)
+        v = _dequant(v, vs, dtype)
+    qg = q.reshape(B, W, Hkv, n_rep, Dh).astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    s = jnp.einsum("bhrd,bthd->bhrt", qg, kf) * (Dh**-0.5)
-    pos = jnp.arange(NB * ps)[None, :]  # (1, T)
-    keep = pos <= lengths[:, None]
-    s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+    s = jnp.einsum("bwhrd,bthd->bwhrt", qg, kf) * (Dh**-0.5)
+    kpos = jnp.arange(NB * ps)[None, None, :]  # (1, 1, T)
+    qpos = lengths[:, None, None] + jnp.arange(W)[None, :, None]  # (B, W, 1)
+    keep = kpos <= qpos
+    if window > 0:
+        keep = keep & ((qpos - kpos) < window)
+    s = jnp.where(keep[:, :, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhrt,bthd->bhrd", p, v.astype(jnp.float32))
-    return o.reshape(B, Hn, Dh).astype(q.dtype)
+    o = jnp.einsum("bwhrt,bthd->bwhrd", p, v.astype(jnp.float32))
+    o = o.reshape(B, W, Hn, Dh).astype(q.dtype)
+    return o[:, 0] if squeeze else o
 
 
 def _paged_kernel(
     tables_ref,  # scalar-prefetch (B, NB) int32
     lengths_ref,  # scalar-prefetch (B,) int32
-    q_ref,  # (1, Hn*Dh)
+    q_ref,  # (1, W, Hn*Dh)
     k_ref,  # (1, page_size, Hkv*Dh) — the page chosen by index_map
     v_ref,
-    o_ref,  # (1, Hn*Dh)
-    m_ref,  # scratch (Hkv, n_rep) f32 running max
-    l_ref,  # scratch (Hkv, n_rep) f32 running sum
-    acc_ref,  # scratch (Hkv, n_rep, Dh) f32
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     page_size: int,
     n_heads: int,
     kv_heads: int,
     head_dim: int,
+    n_queries: int,
+    window: int,
+    quantized: bool,
+    dtype,
 ):
     import jax.experimental.pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
 
     b = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
     n_rep = n_heads // kv_heads
+    W = n_queries
 
     @pl.when(j == 0)
     def _init():
@@ -99,79 +149,127 @@ def _paged_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    length = lengths_ref[b]  # query position == length (row just written)
+    length = lengths_ref[b]  # first query position (row just written)
     page_start = j * page_size
 
-    @pl.when(page_start <= length)
+    live = page_start <= length + W - 1  # keys exist up to length+W-1
+    if window > 0:
+        # the earliest query (w=0) keeps kpos >= length-window+1; pages
+        # wholly below that horizon contribute nothing for ANY query
+        live = jnp.logical_and(
+            live, page_start + page_size - 1 >= length - window + 1
+        )
+
+    @pl.when(live)
     def _accumulate():
-        qf = q_ref[0].reshape(kv_heads, n_rep, head_dim).astype(jnp.float32)
-        kf = k_ref[0].reshape(page_size, kv_heads, head_dim).astype(
+        qf = q_ref[0].reshape(W, kv_heads, n_rep, head_dim).astype(
             jnp.float32
         )
-        vf = v_ref[0].reshape(page_size, kv_heads, head_dim).astype(
-            jnp.float32
-        )
+        kf = k_ref[0].reshape(page_size, kv_heads, head_dim)
+        vf = v_ref[0].reshape(page_size, kv_heads, head_dim)
+        if quantized:
+            kf = _dequant(kf, ks_ref[0].reshape(page_size, kv_heads), dtype)
+            vf = _dequant(vf, vs_ref[0].reshape(page_size, kv_heads), dtype)
+        kf = kf.astype(jnp.float32)
+        vf = vf.astype(jnp.float32)
         s = jnp.einsum(
-            "hrd,thd->hrt", qf, kf, preferred_element_type=jnp.float32
-        ) * (head_dim**-0.5)  # (Hkv, n_rep, T)
-        pos = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, page_size), 2
+            "whrd,thd->whrt", qf, kf, preferred_element_type=jnp.float32
+        ) * (head_dim**-0.5)  # (W, Hkv, n_rep, T)
+        kpos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, page_size), 3
         )
-        s = jnp.where(pos <= length, s, NEG_INF)
+        qpos = length + jax.lax.broadcasted_iota(
+            jnp.int32, (W, 1, 1, 1), 0
+        )
+        keep = kpos <= qpos
+        if window > 0:
+            keep = jnp.logical_and(keep, (qpos - kpos) < window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[..., None])  # (Hkv, n_rep, T)
+        p = jnp.exp(s - m_new[..., None])  # (W, Hkv, n_rep, T)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum(
-            "hrt,thd->hrd", p, vf, preferred_element_type=jnp.float32
+            "whrt,thd->whrd", p, vf, preferred_element_type=jnp.float32
         )
         m_ref[...] = m_new
 
     @pl.when(j == nb - 1)
     def _finalize():
         out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
-        o_ref[0] = out.reshape(n_heads * head_dim).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(W, n_heads * head_dim).astype(o_ref.dtype)
 
 
 def paged_attention(
-    q: jax.Array,  # (B, Hn, Dh)
+    q: jax.Array,  # (B, Hn, Dh) or (B, W, Hn, Dh)
     pool_k: jax.Array,  # (n_pages, page_size, Hkv, Dh)
     pool_v: jax.Array,
     tables: jax.Array,  # (B, NB) int32
     lengths: jax.Array,  # (B,) int32
+    *,
+    scales_k: jax.Array | None = None,  # (n_pages, page_size, Hkv)
+    scales_v: jax.Array | None = None,
+    window: int = 0,
+    dtype=None,
     interpret: bool = False,
 ) -> jax.Array:
     """Decode attention straight off the page pool.  Semantics identical
-    to ``paged_attention_reference`` (one query per row at position
-    ``lengths[b]``, causal over positions 0..lengths[b])."""
+    to ``paged_attention_reference``: query w of row b sits at position
+    ``lengths[b] + w`` and attends causally to everything at or before
+    it (W=1 when q is rank-3 — plain decode; W=spec_k+1 — the
+    speculative verify window), restricted to the sliding ``window``
+    when > 0, dequantizing int8 pools via ``scales_k/v`` in-kernel."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    B, Hn, Dh = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    B, W, Hn, Dh = q.shape
     n_pages, ps, Hkv, _ = pool_k.shape
     NB = tables.shape[1]
     n_rep = Hn // Hkv
+    quantized = scales_k is not None
+    dtype = dtype or q.dtype
+
+    def page_map(b, j, tbl, ln):
+        if window > 0:
+            # out-of-window pages route their DMA to the scratch page
+            # (page 0): compute is skipped by the kernel's `live` guard
+            # either way, but this also kills the HBM read
+            dead = j * ps + ps - 1 < ln[b] - window + 1
+            return jax.lax.select(dead, 0, tbl[b, j]), 0, 0
+        return tbl[b, j], 0, 0
+
+    in_specs = [
+        pl.BlockSpec((1, W, Hn * Dh), lambda b, j, tbl, ln: (b, 0, 0)),
+        pl.BlockSpec((1, ps, Hkv * Dh), page_map),
+        pl.BlockSpec((1, ps, Hkv * Dh), page_map),
+    ]
+    operands = [
+        q.reshape(B, W, Hn * Dh),
+        pool_k.reshape(n_pages, ps, Hkv * Dh),
+        pool_v.reshape(n_pages, ps, Hkv * Dh),
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, ps, Hkv), page_map),
+            pl.BlockSpec((1, ps, Hkv), page_map),
+        ]
+        operands += [scales_k, scales_v]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, lengths
         grid=(B, NB),
-        in_specs=[
-            pl.BlockSpec((1, Hn * Dh), lambda b, j, tbl, ln: (b, 0)),
-            pl.BlockSpec(
-                (1, ps, Hkv * Dh),
-                lambda b, j, tbl, ln: (tbl[b, j], 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, ps, Hkv * Dh),
-                lambda b, j, tbl, ln: (tbl[b, j], 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec((1, Hn * Dh), lambda b, j, tbl, ln: (b, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, W, Hn * Dh), lambda b, j, tbl, ln: (b, 0, 0)
+        ),
         scratch_shapes=[
-            pltpu.VMEM((Hkv, n_rep), jnp.float32),
-            pltpu.VMEM((Hkv, n_rep), jnp.float32),
-            pltpu.VMEM((Hkv, n_rep, Dh), jnp.float32),
+            pltpu.VMEM((W, Hkv, n_rep), jnp.float32),
+            pltpu.VMEM((W, Hkv, n_rep), jnp.float32),
+            pltpu.VMEM((W, Hkv, n_rep, Dh), jnp.float32),
         ],
     )
     kernel = functools.partial(
@@ -180,17 +278,20 @@ def paged_attention(
         n_heads=Hn,
         kv_heads=Hkv,
         head_dim=Dh,
+        n_queries=W,
+        window=window,
+        quantized=quantized,
+        dtype=jnp.dtype(dtype),
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hn * Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, W, Hn * Dh), q.dtype),
         interpret=interpret,
     )(
         tables.astype(jnp.int32),
         lengths.astype(jnp.int32),
-        q.reshape(B, Hn * Dh),
-        pool_k.reshape(n_pages, ps, Hkv * Dh),
-        pool_v.reshape(n_pages, ps, Hkv * Dh),
+        *operands,
     )
-    return out.reshape(B, Hn, Dh)
+    out = out.reshape(B, W, Hn, Dh)
+    return out[:, 0] if squeeze else out

@@ -65,7 +65,7 @@ from typing import Optional
 
 from ..core.request import TPURequest, request_from_pod
 from ..k8s.objects import Pod
-from ..metrics import GANG_COMMIT, GANG_EVENTS
+from ..metrics import GANG_COMMIT, GANG_EVENTS, TimedLock
 from ..utils import consts
 from .scheduler import ResourceScheduler, TPUUnitScheduler
 
@@ -145,7 +145,7 @@ class GangCoordinator:
         self.timeout = timeout
         self._gangs: dict[str, _Gang] = {}
         self._plans: dict[str, _Plan] = {}
-        self._lock = threading.Lock()
+        self._lock = TimedLock("gang")  # wait-time → metrics.LOCK_WAIT
         # bounded pool for the commit's API writes (annotations + bindings);
         # the N member HTTP threads just park on the barrier condition
         self._commit_pool = ThreadPoolExecutor(

@@ -41,7 +41,7 @@ from ..core.request import TPURequest, pod_gang_key, request_from_pod
 from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
-from ..metrics import CHIPS_ALLOCATED
+from ..metrics import CHIPS_ALLOCATED, TimedLock
 from ..utils import consts
 
 log = logging.getLogger("tpu-scheduler")
@@ -97,7 +97,9 @@ class TPUUnitScheduler(ResourceScheduler):
         self.clientset = config.clientset
         self.rater = config.rater
         self.assume_workers = max(1, config.assume_workers)
-        self.lock = threading.RLock()
+        # wait-time-instrumented (metrics.LOCK_WAIT): the single coarse
+        # lock is the scaling cliff; /metrics shows how long binds queue
+        self.lock = TimedLock("scheduler", reentrant=True)
         self.allocators: dict[str, NodeAllocator] = {}
         # pod key → (node, committed Option); the at-most-once ledger
         self.pod_maps: dict[str, tuple[str, Option]] = {}

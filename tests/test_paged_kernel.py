@@ -49,6 +49,66 @@ def test_kernel_matches_reference(dtype, heads):
     )
 
 
+def _rand_pool(key, NP, ps, Hkv, Dh, dtype, int8):
+    pk = jax.random.normal(jax.random.fold_in(key, 1), (NP, ps, Hkv, Dh))
+    pv = jax.random.normal(jax.random.fold_in(key, 2), (NP, ps, Hkv, Dh))
+    if not int8:
+        return pk.astype(dtype), pv.astype(dtype), None, None
+    from elastic_gpu_scheduler_tpu.models.serving import _quantize_rows
+
+    qk, sk = _quantize_rows(pk.reshape(-1, Hkv, Dh))
+    qv, sv = _quantize_rows(pv.reshape(-1, Hkv, Dh))
+    return (
+        qk.reshape(NP, ps, Hkv, Dh),
+        qv.reshape(NP, ps, Hkv, Dh),
+        sk.reshape(NP, ps, Hkv),
+        sv.reshape(NP, ps, Hkv),
+    )
+
+
+@pytest.mark.parametrize("W", [1, 4])
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("window", [0, 20])
+def test_kernel_composition_matrix(W, int8, window):
+    """VERDICT r3 #2: the kernel composes with the verify window (W>1),
+    int8 pools (in-kernel dequant), and sliding-window attention — parity
+    against the gather oracle for every combination."""
+    Hn, Hkv, Dh, ps, NP, NB, B = 8, 4, 64, 16, 12, 4, 4
+    dtype = jnp.float32
+    key = jax.random.key(7)
+    q = jax.random.normal(key, (B, W, Hn, Dh), dtype)
+    pk, pv, sk, sv = _rand_pool(
+        jax.random.fold_in(key, 9), NP, ps, Hkv, Dh, dtype, int8
+    )
+    tables = jax.random.randint(
+        jax.random.fold_in(key, 3), (B, NB), 1, NP, jnp.int32
+    )
+    lengths = jnp.array([0, 15, 30, NB * ps - W], jnp.int32)
+    kw = dict(scales_k=sk, scales_v=sv, window=window, dtype=dtype)
+    ref = paged_attention_reference(q, pk, pv, tables, lengths, **kw)
+    got = paged_attention(
+        q, pk, pv, tables, lengths, interpret=True, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(got, np.float32), atol=2e-5
+    )
+
+
+def test_kernel_rank3_equals_w1():
+    """(B, Hn, Dh) decode q is exactly the W=1 window variant."""
+    Hn, Hkv, Dh, ps, NP, NB, B = 4, 2, 64, 16, 8, 3, 2
+    key = jax.random.key(11)
+    q = jax.random.normal(key, (B, Hn, Dh), jnp.float32)
+    pk, pv, _, _ = _rand_pool(key, NP, ps, Hkv, Dh, jnp.float32, False)
+    tables = jax.random.randint(key, (B, NB), 0, NP, jnp.int32)
+    lengths = jnp.array([5, 40], jnp.int32)
+    a = paged_attention(q, pk, pv, tables, lengths, interpret=True)
+    b = paged_attention(
+        q[:, None], pk, pv, tables, lengths, interpret=True
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
 def test_engine_with_paged_kernel_matches_gather():
     """Full engine: decode through the kernel (interpret mode on CPU) must
     reproduce the gather engine's tokens."""
@@ -74,21 +134,57 @@ def test_engine_with_paged_kernel_matches_gather():
     assert run(paged_kernel=True) == run()
 
 
-def test_paged_kernel_rejects_unsupported_combos():
-    cfg = TransformerConfig(
-        vocab_size=97, d_model=32, n_layers=1, n_heads=2, d_ff=64,
-        dtype="float32",
+def _engine_tokens(cfg, params, prompts, **kw):
+    eng = InferenceEngine(
+        params, cfg, max_batch=4, max_len=64, page_size=8, **kw
     )
-    params = init_params(jax.random.key(0), cfg)
-    with pytest.raises(ValueError, match="paged_kernel"):
-        InferenceEngine(params, cfg, paged_kernel=True, kv_int8=True)
+    reqs = [eng.submit(Request(prompt=p, max_new_tokens=8)) for p in prompts]
+    eng.run_until_idle()
+    for r in reqs:
+        assert r.done.is_set() and not r.error, r.error
+    return [r.output for r in reqs]
 
 
-def test_paged_kernel_rejects_speculation():
+@pytest.mark.parametrize(
+    "combo",
+    [dict(kv_int8=True), dict(spec_k=3), dict(kv_int8=True, spec_k=3)],
+    ids=lambda c: "+".join(sorted(c)),
+)
+def test_engine_paged_kernel_composes(combo):
+    """Round 4 (VERDICT r3 #2): the lifted fences — kernel engines must be
+    token-identical to the gather engines for the SAME feature combo."""
     cfg = TransformerConfig(
-        vocab_size=97, d_model=32, n_layers=1, n_heads=2, d_ff=64,
-        dtype="float32",
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, dtype="float32",
+    )
+    params = init_params(jax.random.key(2), cfg)
+    prompts = [[5, 17, 3], [60, 2, 9, 9], list(range(1, 17)), [42]]
+    want = _engine_tokens(cfg, params, prompts, **combo)
+    got = _engine_tokens(cfg, params, prompts, paged_kernel=True, **combo)
+    assert got == want
+
+
+def test_engine_paged_kernel_sliding_window():
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, dtype="float32", window_size=12,
+    )
+    params = init_params(jax.random.key(3), cfg)
+    prompts = [list(range(1, 30)), [7, 8, 9], [50] * 20, [1]]
+    want = _engine_tokens(cfg, params, prompts)
+    got = _engine_tokens(cfg, params, prompts, paged_kernel=True)
+    assert got == want
+
+
+def test_paged_kernel_mesh_requires_divisible_heads():
+    """The one structurally impossible combo that still raises."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=48, n_layers=1, n_heads=3, n_kv_heads=3,
+        d_ff=64, dtype="float32",
     )
     params = init_params(jax.random.key(0), cfg)
-    with pytest.raises(ValueError, match="paged_kernel"):
-        InferenceEngine(params, cfg, paged_kernel=True, spec_k=3)
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="divisible"):
+        InferenceEngine(params, cfg, paged_kernel=True, mesh=mesh)

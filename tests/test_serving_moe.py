@@ -121,3 +121,64 @@ def test_moe_engine_with_speculation_matches_generate():
             PARAMS, jax.numpy.asarray([p]), MOE_CFG, max_new_tokens=6
         )
         np.testing.assert_array_equal(np.asarray(ref)[0, len(p):], req.output)
+
+
+# -- MoE on a mesh (VERDICT r3 #3) -------------------------------------------
+
+
+def _moe_engine_tokens(prompts, **kw):
+    engine = InferenceEngine(
+        PARAMS, MOE_CFG, max_batch=4, max_len=48, page_size=8, **kw
+    )
+    reqs = [
+        engine.submit(Request(prompt=p, max_new_tokens=6)) for p in prompts
+    ]
+    engine.run_until_idle()
+    for r in reqs:
+        assert r.done.is_set() and not r.error, r.error
+    return [r.output for r in reqs], engine
+
+
+@pytest.mark.parametrize(
+    "axes", [dict(tensor=2), dict(expert=2), dict(expert=2, tensor=2)],
+    ids=lambda a: "x".join(f"{k}{v}" for k, v in sorted(a.items())),
+)
+def test_moe_engine_on_mesh_matches_single_device(axes):
+    """MoE serving over a mesh — tensor-sharded expert FFNs, true expert
+    parallelism (expert axis), and both at once — must be token-identical
+    to the single-device engine.  Sharding is placement, never behavior."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    prompts = [[5, 17, 3], [60, 2], [9, 9, 9, 9], list(range(1, 20))]
+    want, _ = _moe_engine_tokens(prompts)
+    n = int(np.prod(list(axes.values())))
+    mesh = make_mesh(MeshSpec(**axes), jax.devices()[:n])
+    got, eng = _moe_engine_tokens(prompts, mesh=mesh)
+    assert got == want
+    # expert weights measurably sharded, not replicated
+    for name in ("w_gate", "w_in", "w_out"):
+        arr = eng.params["layers"][name]
+        assert not arr.sharding.is_fully_replicated, (name, arr.sharding)
+
+
+def test_moe_mesh_expert_weights_sharded_on_expert_axis():
+    """expert=2: each rank holds HALF the experts (the checkpoint-bigger-
+    than-one-chip case MoE exists for), not a full replica."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(expert=2), jax.devices()[:2])
+    _, eng = _moe_engine_tokens([[5, 17, 3]], mesh=mesh)
+    w = eng.params["layers"]["w_gate"]  # (L, E, D, F)
+    (shard,) = {s.data.shape for s in w.addressable_shards}
+    assert shard[1] == w.shape[1] // 2, (shard, w.shape)
+
+
+def test_moe_engine_mesh_with_speculation():
+    """MoE × mesh × spec_k: the composed production path."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    prompts = [[5, 17, 3, 5, 17, 3, 5, 17], [60, 2] * 6]
+    want, _ = _moe_engine_tokens(prompts, spec_k=3)
+    mesh = make_mesh(MeshSpec(expert=2, tensor=2), jax.devices()[:4])
+    got, _ = _moe_engine_tokens(prompts, mesh=mesh, spec_k=3)
+    assert got == want

@@ -81,3 +81,21 @@ def test_tp_mesh_requires_tensor_axis():
     mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
     with pytest.raises(ValueError, match="tensor"):
         InferenceEngine(PARAMS, CFG, max_batch=2, mesh=mesh)
+
+
+def test_tp_engine_with_paged_kernel_matches_single_device():
+    """Round 4 (VERDICT r3 #2d): the Pallas paged kernel under a mesh —
+    shard_mapped over the tensor axis on the head dims — must reproduce
+    the single-device gather engine's tokens exactly."""
+    baseline = run_engine()
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    got = run_engine(mesh=mesh, paged_kernel=True)
+    assert got == baseline
+
+
+def test_tp_engine_paged_kernel_speculative():
+    """kernel + mesh + spec_k all at once: the full production combo."""
+    baseline = run_engine(spec_k=3)
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    got = run_engine(mesh=mesh, paged_kernel=True, spec_k=3)
+    assert got == baseline
