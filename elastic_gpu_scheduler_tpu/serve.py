@@ -60,6 +60,11 @@ def build_args(argv=None):
     p.add_argument("--draft-hf", default="",
                    help="HF checkpoint dir for a DRAFT model "
                         "(draft-model speculation; requires --spec-k)")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="decode attention reads the page pool in place "
+                        "via the Pallas kernel (long-context HBM win); "
+                        "composes with --kv-int8/--spec-k/--tensor and "
+                        "sliding-window models")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend in-process (overrides a "
                         "sticky JAX_PLATFORMS from site config; tests/dev)")
@@ -176,7 +181,7 @@ def main(argv=None) -> int:
         page_size=args.page_size, n_pages=args.n_pages,
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
         prefix_cache=args.prefix_cache, spec_k=args.spec_k, draft=draft,
-        mesh=mesh,
+        mesh=mesh, paged_kernel=args.paged_kernel,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

@@ -187,3 +187,24 @@ def test_hbm_envelope_production_shapes():
     # this test is the tripwire that makes that growth loud.
     assert acct["total"] < 14 * GiB, {k: v / GiB for k, v in acct.items()}
     assert acct["draft_cache_bytes"] < acct["kv_pool_bytes"], acct
+
+
+def test_cfg_param_count_matches_real_params():
+    """_cfg_param_count (the HBM estimator's shape arithmetic) must track
+    init_params exactly — otherwise the envelope tripwire drifts."""
+    from elastic_gpu_scheduler_tpu.models.serving import _cfg_param_count
+    from elastic_gpu_scheduler_tpu.models.transformer import param_count
+
+    for cfg in (
+        CFG,
+        TransformerConfig(
+            vocab_size=97, d_model=48, n_layers=3, n_heads=4, n_kv_heads=2,
+            d_ff=96, dtype="float32",
+        ),
+        TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            dtype="float32", n_experts=4, capacity_factor=4.0,
+        ),
+    ):
+        real = param_count(init_params(jax.random.key(0), cfg))
+        assert _cfg_param_count(cfg) == real, (cfg, _cfg_param_count(cfg), real)

@@ -182,3 +182,37 @@ def test_moe_engine_mesh_with_speculation():
     mesh = make_mesh(MeshSpec(expert=2, tensor=2), jax.devices()[:4])
     got, _ = _moe_engine_tokens(prompts, mesh=mesh, spec_k=3)
     assert got == want
+
+
+def test_moe_grouped_matmul_prefill_matches_generate():
+    """Prompts past the decode-size threshold run the grouped-matmul
+    (lax.ragged_dot) dispatch — dense FLOPs per token instead of the old
+    E× mask dispatch — and must still match the generate() oracle."""
+    prompts = [list(np.random.default_rng(3).integers(1, 60, 40)),
+               [5, 17, 3]]
+    assert _expert_spread(PARAMS, prompts) >= 2
+    engine = InferenceEngine(
+        PARAMS, MOE_CFG, max_batch=2, max_len=64, page_size=8
+    )
+    reqs = [
+        engine.submit(Request(prompt=p, max_new_tokens=6)) for p in prompts
+    ]
+    engine.run_until_idle()
+    for r, p in zip(reqs, prompts):
+        assert not r.error, r.error
+        ref = generate(
+            PARAMS, jax.numpy.asarray([p]), MOE_CFG, max_new_tokens=6
+        )
+        np.testing.assert_array_equal(np.asarray(ref)[0, len(p):], r.output)
+
+
+def test_moe_grouped_matmul_on_tensor_mesh():
+    """The ragged_dot dispatch under tensor sharding (F over tensor):
+    long-prompt MoE on a tensor=2 mesh stays token-identical."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    prompts = [list(np.random.default_rng(4).integers(1, 60, 40))]
+    want, _ = _moe_engine_tokens(prompts)
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    got, _ = _moe_engine_tokens(prompts, mesh=mesh)
+    assert got == want
