@@ -141,8 +141,14 @@ class Request:
     # streaming: called from the engine thread with each emitted token id,
     # in order, before done is signaled
     on_token: Optional[object] = None
+    # >0 → return per-emitted-token logprobs: the chosen token's logprob
+    # in ``token_logprobs`` and this many top alternatives (id, logprob)
+    # in ``top_logprobs``.  Clamped to the engine's compiled logprobs_k.
+    logprobs: int = 0
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
+    token_logprobs: list = field(default_factory=list)
+    top_logprobs: list = field(default_factory=list)
     error: str = ""  # set (with done) when the request is rejected
     # Thread ownership: the ENGINE thread owns output/error/done and all
     # slot state; other threads may only read output after done, and may
@@ -678,14 +684,32 @@ def _paged_prefill_prefixed(
     return logits.astype(jnp.float32), new_kv
 
 
+def _logprob_rows(logits, chosen, k):
+    """(chosen_lp, top_ids, top_lps) for one step's logits.
+
+    logits: (..., V) f32; chosen: (...) int32.  Log-softmax via one
+    logsumexp; top-k alternatives share the same normalizer."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    chosen_lp = (
+        jnp.take_along_axis(logits, chosen[..., None], axis=-1)[..., 0] - lse
+    )
+    top_lg, top_ids = jax.lax.top_k(logits, k)
+    return chosen_lp, top_ids, top_lg - lse[..., None]
+
+
 def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
     bank=None, aids=None,
     *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
+    logprobs_k=0,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
-    feeding happen on-device.  Returns (sampled (B, n_steps), new caches).
+    feeding happen on-device.  Returns (sampled (B, n_steps), new caches);
+    with ``logprobs_k`` > 0 (a separately-compiled variant, chosen only
+    when some active request asked) the first element becomes
+    (sampled, chosen_lp (B, n_steps), top_ids (B, n_steps, k),
+    top_lps (B, n_steps, k)).
 
     Step s feeds the token at position lengths+s and samples from its
     logits; the host decides afterwards which sampled entries are real
@@ -719,12 +743,22 @@ def _fused_serve_chunk(
         prompt_next = jnp.take_along_axis(prompts, nxt[:, None], axis=1)[:, 0]
         next_tok = jnp.where(in_prompt, prompt_next, sampled)
         tokens = jnp.where(active, next_tok, tokens)
-        return (tokens, new_len, key, kv), sampled
+        if logprobs_k > 0:
+            out = (sampled, *_logprob_rows(logits, sampled, logprobs_k))
+        else:
+            out = sampled
+        return (tokens, new_len, key, kv), out
 
-    (tokens, lengths, key, kv), sampled = jax.lax.scan(
+    (tokens, lengths, key, kv), outs = jax.lax.scan(
         body, (tokens, lengths, key, kv), None, length=n_steps
     )
-    return sampled.T, kv  # (B, n_steps)
+    if logprobs_k > 0:
+        sampled, chosen_lp, top_ids, top_lps = outs
+        return (
+            sampled.T, chosen_lp.T,
+            jnp.moveaxis(top_ids, 0, 1), jnp.moveaxis(top_lps, 0, 1),
+        ), kv
+    return outs.T, kv  # (B, n_steps)
 
 
 def _cached_attention_rows(q, cache_k, cache_v, starts, window=0):
@@ -767,6 +801,7 @@ def _fused_verify_chunk(
     temps, top_ks, top_ps, key,
     bank=None, aids=None,
     *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
+    logprobs_k=0,
 ):
     """ONE wide pass over every slot's verify window (speculative decoding
     inside the paged engine — VERDICT r2 #2).
@@ -842,6 +877,11 @@ def _fused_verify_chunk(
             in_axes=(1, 0), out_axes=1,
         )(logits, subs)
     picked = jnp.where((temps > 0)[:, None], sampled, greedy)
+    if logprobs_k > 0:
+        # logits[:, j] is the distribution at fed position j — the one
+        # the accepted token at window position j+1 (== picked[:, j])
+        # was drawn from; the host indexes these by window position
+        return (picked, *_logprob_rows(logits, picked, logprobs_k)), new_kv
     return picked, new_kv
 
 
@@ -933,6 +973,7 @@ class InferenceEngine:
         draft: Optional[tuple] = None,
         mesh=None,
         paged_kernel: bool = False,
+        logprobs_k: int = 5,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -967,6 +1008,11 @@ class InferenceEngine:
         both paged_kernel and mesh are on.  Opt-in (default off) until
         an on-chip run validates the Mosaic lowering
         (bench --tpu-section=pagedattn).
+
+        ``logprobs_k``: compiled top-k width for per-token logprobs.
+        Requests opt in per-request (``Request.logprobs`` ≤ this cap);
+        the logprob-emitting chunk variants compile lazily and only
+        batches containing an asking request pay the device top-k.
 
         ``mesh``: serve TENSOR-PARALLEL over a `jax.sharding.Mesh` with a
         ``tensor`` axis — for checkpoints too big for one chip's HBM.
@@ -1042,8 +1088,9 @@ class InferenceEngine:
         self.queue: "queue.Queue[Request]" = queue.Queue()
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
+        self.logprobs_k = max(0, logprobs_k)
         self._chunks = {
-            use_filters: jax.jit(
+            (use_filters, want_lp): jax.jit(
                 functools.partial(
                     _fused_serve_chunk,
                     cfg=cfg,
@@ -1052,10 +1099,12 @@ class InferenceEngine:
                     use_filters=use_filters,
                     paged_kernel=self.paged_kernel,
                     mesh=mesh,
+                    logprobs_k=self.logprobs_k if want_lp else 0,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
+            for want_lp in (False, True)
         }
         self.spec_k = max(0, spec_k)
         self.spec_ngram = spec_ngram
@@ -1117,7 +1166,7 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         self._verify_chunks = {
-            use_filters: jax.jit(
+            (use_filters, want_lp): jax.jit(
                 functools.partial(
                     _fused_verify_chunk,
                     cfg=cfg,
@@ -1125,10 +1174,12 @@ class InferenceEngine:
                     use_filters=use_filters,
                     paged_kernel=self.paged_kernel,
                     mesh=mesh,
+                    logprobs_k=self.logprobs_k if want_lp else 0,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
+            for want_lp in (False, True)
         }
         self._prefill = jax.jit(
             functools.partial(
@@ -1185,6 +1236,15 @@ class InferenceEngine:
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
+        if req.logprobs > 0 and self.logprobs_k <= 0:
+            # a silent drop would be indistinguishable from a bug to the
+            # caller; fail the request like any other invalid ask
+            req.error = "engine built with logprobs_k=0 (logprobs off)"
+            req.done.set()
+            return req
+        # the top-k width is compiled into the chunk (engine logprobs_k);
+        # a wider ask gets the compiled width
+        req.logprobs = min(max(0, req.logprobs), self.logprobs_k)
         self.queue.put(req)
         return req
 
@@ -1202,13 +1262,20 @@ class InferenceEngine:
     # -- engine internals ----------------------------------------------------
 
     @staticmethod
-    def _emit(req: Request, tok: int) -> None:
+    def _emit(req: Request, tok: int, lp=None, top=None) -> None:
         """Deliver one streamed token.  A raising user callback must never
         unwind into the engine loop — the donated KV pool has already
         advanced when emissions run, so an escaping exception would leave
         lengths/next_token stale and corrupt every other in-flight slot.
-        Policy: log, disable THAT request's streaming, keep generating."""
+        Policy: log, disable THAT request's streaming, keep generating.
+
+        ``lp``/``top``: the token's logprob and [(id, logprob), ...]
+        alternatives — appended in lockstep with ``output`` so the three
+        lists always align."""
         req.output.append(tok)
+        if req.logprobs > 0:
+            req.token_logprobs.append(None if lp is None else float(lp))
+            req.top_logprobs.append([] if top is None else top)
         if req.on_token is not None:
             try:
                 req.on_token(tok)
@@ -1373,7 +1440,20 @@ class InferenceEngine:
             )
         else:
             tok = int(jnp.argmax(logits))
-        self._emit(req, tok)
+        if req.logprobs > 0:
+            # first emission comes from the prefill's (V,) logits row —
+            # host-side numpy log-softmax, no extra device dispatch
+            lg = np.asarray(logits, np.float32)
+            lse = float(np.logaddexp.reduce(lg))
+            n = req.logprobs
+            top = np.argpartition(-lg, n - 1)[:n]
+            top = top[np.argsort(-lg[top])]
+            self._emit(
+                req, tok, lg[tok] - lse,
+                [(int(t), float(lg[t] - lse)) for t in top],
+            )
+        else:
+            self._emit(req, tok)
         self.emitted[i] = 1
         self.lengths[i] = plen
         self.next_token[i] = tok
@@ -1502,6 +1582,23 @@ class InferenceEngine:
             or (self.top_ps[active] < 1.0).any()
         )
 
+    def _logprobs_requested(self, active) -> bool:
+        """Pick the logprob-emitting chunk variant only when some active
+        request asked — the default path never pays the top-k."""
+        return any(
+            req is not None and active[i] and req.logprobs > 0
+            for i, req in enumerate(self.slots)
+        )
+
+    @staticmethod
+    def _top_list(ids_row, lps_row, n) -> list:
+        """[(token_id, logprob), ...] for one emission, truncated to the
+        request's asked-for width."""
+        return [
+            (int(t), float(l))
+            for t, l in zip(ids_row[:n], lps_row[:n])
+        ]
+
     def _spec_useful(self) -> bool:
         """The verify pass beats sequential chunks only when some slot can
         actually exploit the window: a slot still feeding its prompt
@@ -1575,7 +1672,8 @@ class InferenceEngine:
                     j += 1
         self._key, sub = jax.random.split(self._key)
         use_filters = self._filters_requested(active)
-        picked, self.kv = self._verify_chunks[use_filters](
+        want_lp = self._logprobs_requested(active)
+        out, self.kv = self._verify_chunks[(use_filters, want_lp)](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -1589,8 +1687,25 @@ class InferenceEngine:
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
         )
-        picked = np.asarray(picked)  # (B, W)
+        if want_lp:
+            picked, chosen_lp, top_ids, top_lps = (
+                np.asarray(a) for a in out
+            )
+        else:
+            picked = np.asarray(out)  # (B, W)
         self.spec_passes += 1
+
+        def emit_at(req, i, tok, w):
+            """Emit with logprobs from window position w's distribution —
+            the one the token at fed position w+1 was drawn from."""
+            if want_lp and req.logprobs > 0:
+                self._emit(
+                    req, tok, chosen_lp[i, w],
+                    self._top_list(top_ids[i, w], top_lps[i, w],
+                                   req.logprobs),
+                )
+            else:
+                self._emit(req, tok)
         for i, req in enumerate(self.slots):
             if req is None or not active[i]:
                 continue
@@ -1615,7 +1730,9 @@ class InferenceEngine:
                 if p + j < plen:
                     continue  # prompt position: nothing to emit
                 tok = int(feed[i, j])
-                self._emit(req, tok)
+                # accepted ⇒ feed[i, j] == picked[i, j-1], so position
+                # j-1's distribution is the one this token came from
+                emit_at(req, i, tok, j - 1)
                 self.emitted[i] += 1
                 self.spec_accepted += 1
                 if tok in req.stop_tokens:
@@ -1629,7 +1746,7 @@ class InferenceEngine:
             if not stopped and not exhausted and p + A >= plen:
                 # the model's own token after the last valid fed position
                 tok = int(picked[i, A - 1])
-                self._emit(req, tok)
+                emit_at(req, i, tok, A - 1)
                 self.emitted[i] += 1
                 if tok in req.stop_tokens:
                     stopped = True
@@ -1750,7 +1867,8 @@ class InferenceEngine:
         active, view = prepared
         self._key, sub = jax.random.split(self._key)
         use_filters = self._filters_requested(active)
-        sampled, self.kv = self._chunks[use_filters](
+        want_lp = self._logprobs_requested(active)
+        out, self.kv = self._chunks[(use_filters, want_lp)](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -1766,7 +1884,12 @@ class InferenceEngine:
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
         )
-        sampled = np.asarray(sampled)  # (B, K)
+        if want_lp:
+            sampled, chosen_lp, top_ids, top_lps = (
+                np.asarray(a) for a in out
+            )
+        else:
+            sampled = np.asarray(out)  # (B, K)
         for i, req in enumerate(self.slots):
             if req is None or not active[i]:
                 continue
@@ -1778,7 +1901,15 @@ class InferenceEngine:
                 # real emission iff it is at or past the last prompt token
                 if pos + s >= plen - 1 and self.emitted[i] < req.max_new_tokens:
                     tok = int(sampled[i, s])
-                    self._emit(req, tok)
+                    if want_lp and req.logprobs > 0:
+                        self._emit(
+                            req, tok, chosen_lp[i, s],
+                            self._top_list(
+                                top_ids[i, s], top_lps[i, s], req.logprobs
+                            ),
+                        )
+                    else:
+                        self._emit(req, tok)
                     self.emitted[i] += 1
                     if tok in req.stop_tokens:
                         # stop token emitted (and kept, HF-style); tokens

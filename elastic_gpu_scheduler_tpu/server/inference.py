@@ -147,6 +147,14 @@ def _token_ids(x, vocab_size: int, what: str) -> list:
 def _request_from_body(body: dict, vocab_size: int) -> Request:
     prompt = _token_ids(body.get("prompt"), vocab_size, "prompt")
     stop = _token_ids(body.get("stop", []), vocab_size, "stop")
+    logprobs = body.get("logprobs", 0)
+    # same strictness as _token_ids: bool is an int subclass, and a float
+    # would silently truncate — both are client bugs deserving a 400
+    if (
+        not isinstance(logprobs, int) or isinstance(logprobs, bool)
+        or logprobs < 0
+    ):
+        raise ValueError("'logprobs' must be a non-negative integer")
     return Request(
         prompt=prompt,
         max_new_tokens=int(body.get("max_tokens", 16)),
@@ -155,7 +163,18 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         top_p=float(body.get("top_p", 1.0)),
         adapter=str(body.get("adapter", "")),
         stop_tokens=tuple(stop),
+        logprobs=logprobs,
     )
+
+
+def _logprobs_payload(req: Request) -> dict:
+    return {
+        "token_logprobs": req.token_logprobs,
+        "top_logprobs": [
+            [{"id": t, "logprob": lp} for t, lp in top]
+            for top in req.top_logprobs
+        ],
+    }
 
 
 def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
@@ -226,12 +245,20 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 return self._json(504, {
                     "error": "generation timed out",
                     # tokens generated before the deadline are real work —
-                    # hand them over rather than discarding them
+                    # hand them over rather than discarding them (and so
+                    # are their logprobs, equally complete after the ack)
                     "tokens": list(req.output) if acked else [],
+                    **(
+                        {"logprobs": _logprobs_payload(req)}
+                        if acked and req.logprobs > 0 else {}
+                    ),
                 })
             if req.error:
                 return self._json(400, {"error": req.error})
-            return self._json(200, {"tokens": req.output})
+            resp = {"tokens": req.output}
+            if req.logprobs > 0:
+                resp["logprobs"] = _logprobs_payload(req)
+            return self._json(200, resp)
 
         def _stream(self, req: Request) -> None:
             # SSE: tokens are pushed from the ENGINE thread into a bounded
@@ -239,7 +266,18 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             # client never blocks generation (the queue is sized for the
             # whole response)
             q: "queue.Queue" = queue.Queue(maxsize=req.max_new_tokens + 2)
-            req.on_token = lambda tok: q.put(tok)
+
+            def on_token(tok):
+                # runs on the ENGINE thread, after _emit appended the
+                # token's logprob entries — reading [-1] here is the
+                # documented ownership-safe window
+                if req.logprobs > 0:
+                    q.put((tok, req.token_logprobs[-1],
+                           req.top_logprobs[-1]))
+                else:
+                    q.put((tok, None, None))
+
+            req.on_token = on_token
             engine.submit(req)
             # submit() validates synchronously — a rejected request gets
             # the same 400 the non-streaming path returns, not a 200
@@ -263,8 +301,14 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             try:
                 while time.monotonic() < deadline:
                     try:
-                        tok = q.get(timeout=0.1)
-                        chunk(json.dumps({"token": tok}))
+                        tok, lp, top = q.get(timeout=0.1)
+                        ev = {"token": tok}
+                        if lp is not None:
+                            ev["logprob"] = lp
+                            ev["top_logprobs"] = [
+                                {"id": t, "logprob": l} for t, l in top
+                            ]
+                        chunk(json.dumps(ev))
                         sent += 1
                     except queue.Empty:
                         if req.done.is_set() and q.empty():

@@ -168,3 +168,41 @@ def test_bad_scalar_fields_return_400(served):
     ):
         code, out = _post(addr, "/v1/completions", body)
         assert code == 400 and "error" in out, (body, code, out)
+
+
+def test_logprobs_over_http(served):
+    addr, engine = served
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5, 17, 3], "max_tokens": 4, "logprobs": 2,
+    })
+    assert code == 200, out
+    lp = out["logprobs"]
+    assert len(lp["token_logprobs"]) == len(out["tokens"]) == 4
+    for k, (val, top) in enumerate(
+        zip(lp["token_logprobs"], lp["top_logprobs"])
+    ):
+        assert val <= 0 and len(top) == 2
+        assert top[0]["logprob"] >= top[1]["logprob"]
+        # greedy: the emitted token IS the argmax alternative
+        assert top[0]["id"] == out["tokens"][k]
+    # streaming carries the same per-token fields
+    conn = http.client.HTTPConnection(*addr, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [5, 17, 3], "max_tokens": 4,
+                             "logprobs": 2, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    events = [json.loads(raw[len("data: "):])
+              for raw in resp.read().decode().split("\n\n")
+              if raw.startswith("data: ") and "[DONE]" not in raw]
+    conn.close()
+    assert [e["token"] for e in events] == out["tokens"]
+    assert [round(e["logprob"], 5) for e in events] == [
+        round(v, 5) for v in lp["token_logprobs"]
+    ]
+    assert all(len(e["top_logprobs"]) == 2 for e in events)
+    # negative width is a 400, not a silent clamp
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5], "max_tokens": 2, "logprobs": -1,
+    })
+    assert code == 400
