@@ -145,6 +145,12 @@ class Request:
     # in ``token_logprobs`` and this many top alternatives (id, logprob)
     # in ``top_logprobs``.  Clamped to the engine's compiled logprobs_k.
     logprobs: int = 0
+    # token id → additive logit bias (OpenAI semantics): applied to every
+    # sampling distribution for this request, in the fused chunks, the
+    # speculative verify pass, and the admission prefill.  ±large values
+    # ban/force tokens; reported logprobs are post-bias (they describe
+    # the distribution actually sampled from).
+    logit_bias: dict = field(default_factory=dict)
     done: threading.Event = field(default_factory=threading.Event)
     output: list[int] = field(default_factory=list)
     token_logprobs: list = field(default_factory=list)
@@ -700,7 +706,7 @@ def _logprob_rows(logits, chosen, k):
 def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
-    bank=None, aids=None,
+    bank=None, aids=None, bias=None,
     *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
     logprobs_k=0,
 ):
@@ -728,6 +734,10 @@ def _fused_serve_chunk(
             params, tokens, kv, tables, lengths, cfg, page_size, bank, aids,
             paged_kernel=paged_kernel, mesh=mesh,
         )
+        if bias is not None:
+            # per-slot additive logit bias (zero rows are a bitwise
+            # no-op, so non-biased slots/batches are unaffected)
+            logits = logits + bias
         key, sub = jax.random.split(key)
         if use_filters:
             sampled = sample_batched(logits, sub, temps, top_ks, top_ps)
@@ -799,7 +809,7 @@ def _cached_attention_rows(q, cache_k, cache_v, starts, window=0):
 def _fused_verify_chunk(
     params, kv, tables, feed, lengths, active,
     temps, top_ks, top_ps, key,
-    bank=None, aids=None,
+    bank=None, aids=None, bias=None,
     *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
     logprobs_k=0,
 ):
@@ -862,6 +872,8 @@ def _fused_verify_chunk(
     )
     x = rms_norm(x, params["final_norm"])
     logits = (x @ wmat(params["unembed"], dtype)).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias[:, None, :]  # per-slot additive logit bias
     greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # (B, W)
     subs = jax.random.split(key, W)
     if use_filters:
@@ -1082,6 +1094,14 @@ class InferenceEngine:
         else:
             self.lora_bank, self.adapter_index = {}, {"": 0}
         self.adapter_ids = np.zeros(max_batch, np.int32)
+        # per-slot additive logit-bias rows, DEVICE-resident so the fused
+        # chunks pay no per-dispatch transfer; zero rows are a bitwise
+        # no-op on the logits.  _bias_set tracks which rows need clearing
+        # at release (so bias-free serving never dispatches the updates).
+        self._bias_dev = jnp.zeros(
+            (max_batch, cfg.vocab_size), jnp.float32
+        )
+        self._bias_set = np.zeros(max_batch, bool)
         self.next_token = np.zeros(max_batch, np.int32)
         self.emitted = np.zeros(max_batch, np.int32)
         self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
@@ -1236,6 +1256,18 @@ class InferenceEngine:
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
+        if req.logit_bias and not all(
+            isinstance(k, int) and not isinstance(k, bool)
+            and 0 <= k < self.cfg.vocab_size
+            and isinstance(v, (int, float)) and np.isfinite(v)
+            for k, v in req.logit_bias.items()
+        ):
+            req.error = (
+                f"logit_bias keys must be token ids in "
+                f"[0, {self.cfg.vocab_size}) with finite values"
+            )
+            req.done.set()
+            return req
         if req.logprobs > 0 and self.logprobs_k <= 0:
             # a silent drop would be indistinguishable from a bug to the
             # caller; fail the request like any other invalid ask
@@ -1305,6 +1337,12 @@ class InferenceEngine:
             self.top_ks[i] = req.top_k
             self.top_ps[i] = req.top_p
             self.adapter_ids[i] = self.adapter_index[req.adapter]
+            if req.logit_bias:
+                row = np.zeros(self.cfg.vocab_size, np.float32)
+                for t, b in req.logit_bias.items():
+                    row[t] = b
+                self._bias_dev = self._bias_dev.at[i].set(row)
+                self._bias_set[i] = True
             self.emitted[i] = 0
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
@@ -1426,6 +1464,12 @@ class InferenceEngine:
                 aid,
             )
         self.prefills_run += 1
+        if req.logit_bias:
+            # same additive semantics as the fused chunks' bias rows
+            lgb = np.asarray(logits, np.float32).copy()
+            for t_, b_ in req.logit_bias.items():
+                lgb[t_] += b_
+            logits = jnp.asarray(lgb)
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
@@ -1515,6 +1559,7 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0
 
@@ -1530,6 +1575,7 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0  # rows rewrite lazily; no device work
 
@@ -1589,6 +1635,13 @@ class InferenceEngine:
             req is not None and active[i] and req.logprobs > 0
             for i, req in enumerate(self.slots)
         )
+
+    def _clear_bias(self, i: int) -> None:
+        """Zero a released slot's bias row — only if it was ever set, so
+        bias-free serving never dispatches the update."""
+        if self._bias_set[i]:
+            self._bias_dev = self._bias_dev.at[i].set(0.0)
+            self._bias_set[i] = False
 
     @staticmethod
     def _top_list(ids_row, lps_row, n) -> list:
@@ -1686,6 +1739,7 @@ class InferenceEngine:
             sub,
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
+            self._bias_dev,
         )
         if want_lp:
             picked, chosen_lp, top_ids, top_lps = (
@@ -1883,6 +1937,7 @@ class InferenceEngine:
             sub,
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
+            self._bias_dev,
         )
         if want_lp:
             sampled, chosen_lp, top_ids, top_lps = (

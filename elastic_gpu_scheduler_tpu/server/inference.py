@@ -155,6 +155,18 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         or logprobs < 0
     ):
         raise ValueError("'logprobs' must be a non-negative integer")
+    bias_raw = body.get("logit_bias", {})
+    if not isinstance(bias_raw, dict):
+        raise ValueError("'logit_bias' must be an object of id -> bias")
+    bias = {}
+    for k, v in bias_raw.items():
+        try:
+            tid = int(k)  # OpenAI-style string keys (JSON objects)
+        except (TypeError, ValueError):
+            raise ValueError(f"logit_bias key {k!r} is not a token id")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise ValueError(f"logit_bias value for {k!r} must be a number")
+        bias[tid] = float(v)
     return Request(
         prompt=prompt,
         max_new_tokens=int(body.get("max_tokens", 16)),
@@ -164,6 +176,7 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         adapter=str(body.get("adapter", "")),
         stop_tokens=tuple(stop),
         logprobs=logprobs,
+        logit_bias=bias,
     )
 
 
@@ -228,7 +241,12 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 req = _request_from_body(body, engine.cfg.vocab_size)
-            except (ValueError, TypeError, json.JSONDecodeError) as e:
+            except (
+                ValueError, TypeError, OverflowError, json.JSONDecodeError,
+            ) as e:
+                # OverflowError: float(huge-json-int) — JSON ints are
+                # arbitrary-precision, float() of one past 1e308 raises
+                # OverflowError (not ValueError) and must still 400
                 # TypeError covers non-numeric scalars (null/list for
                 # max_tokens, temperature, ...) — a clean 400, not an
                 # aborted connection

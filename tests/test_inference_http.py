@@ -206,3 +206,31 @@ def test_logprobs_over_http(served):
         "prompt": [5], "max_tokens": 2, "logprobs": -1,
     })
     assert code == 400
+
+
+def test_logit_bias_over_http(served):
+    addr, engine = served
+    # OpenAI-style: string keys in the JSON object; force token 42
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5, 17, 3], "max_tokens": 3, "logit_bias": {"42": 1e9},
+    })
+    assert code == 200 and set(out["tokens"]) == {42}, out
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5], "max_tokens": 2, "logit_bias": {"notanid": 1.0},
+    })
+    assert code == 400
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5], "max_tokens": 2, "logit_bias": {"99999": 1.0},
+    })
+    assert code == 400
+
+
+def test_huge_json_int_bias_returns_400(served):
+    """JSON ints are arbitrary-precision; float() of one past 1e308
+    raises OverflowError — must be a clean 400, not a dropped socket."""
+    addr, _ = served
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5], "max_tokens": 2,
+        "logit_bias": {"5": int("9" * 400)},
+    })
+    assert code == 400 and "error" in out
