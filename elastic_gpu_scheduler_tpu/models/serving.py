@@ -986,6 +986,7 @@ class InferenceEngine:
         mesh=None,
         paged_kernel: bool = False,
         logprobs_k: int = 5,
+        prefill_chunk: int = 0,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -1102,6 +1103,12 @@ class InferenceEngine:
             (max_batch, cfg.vocab_size), jnp.float32
         )
         self._bias_set = np.zeros(max_batch, bool)
+        # chunked prefill (>0): long prompts ingest at most this many
+        # tokens per engine-loop iteration instead of one monolithic
+        # pass, so decoding slots keep emitting between chunks (no
+        # head-of-line blocking behind a 7k-token admission)
+        self.prefill_chunk = max(0, prefill_chunk)
+        self.prefilling = np.zeros(max_batch, bool)
         self.next_token = np.zeros(max_batch, np.int32)
         self.emitted = np.zeros(max_batch, np.int32)
         self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
@@ -1412,6 +1419,46 @@ class InferenceEngine:
             elif existing == pg:
                 self._touch(pg)  # shared page we matched at admission
 
+    def _prefill_dispatch(self, i: int, req: Request, t0: int, n: int):
+        """One prefill pass over prompt[t0:t0+n] (pages must already cover
+        position t0+n).  Shared by the emitting final pass and the
+        logit-discarding chunked-ingest passes — one copy of the
+        pad/bucket/dispatch recipe.  Returns the last-real-position
+        logits (V,).
+
+        Pad length buckets to a power of two so the prefill jit compiles
+        per bucket.  The table width buckets too: the prefixed path
+        gathers every page it is handed, so its attention cost must
+        follow the LIVE prompt length, not max_len (same trick as
+        step()'s table view).  Padding positions index past the slice
+        and clamp — then route to scratch."""
+        tpad = 8
+        while tpad < n:
+            tpad *= 2
+        tpad = min(tpad, self.max_len)
+        need_pages = -(-(t0 + n) // self.page_size)
+        pbucket = 1
+        while pbucket < need_pages:
+            pbucket *= 2
+        pbucket = min(pbucket, self.max_pages_per_slot)
+        row = jnp.asarray(self.tables[i, :pbucket])
+        toks = np.zeros((1, tpad), np.int32)
+        toks[0, :n] = req.prompt[t0:t0 + n]
+        aid = jnp.asarray(self.adapter_ids[i], jnp.int32)
+        if t0 == 0:
+            logits, self.kv = self._prefill(
+                self.params, jnp.asarray(toks), self.kv, row,
+                jnp.asarray(n, jnp.int32), self.lora_bank, aid,
+            )
+        else:
+            logits, self.kv = self._prefill_prefixed(
+                self.params, jnp.asarray(toks), self.kv, row,
+                jnp.asarray(t0, jnp.int32), jnp.asarray(n, jnp.int32),
+                self.lora_bank, aid,
+            )
+        self.prefills_run += 1
+        return logits
+
     def _try_prefill(self, i: int, req: Request) -> None:
         """Ingest the (rest of the) prompt in one pass when pages are
         available; otherwise leave the slot in the incremental
@@ -1422,48 +1469,22 @@ class InferenceEngine:
         plen = len(req.prompt)
         t0 = int(self.lengths[i])  # prefix-cache hit length (0 without)
         rem = plen - t0
+        C = self.prefill_chunk
+        if C > 0 and rem - 1 > C:
+            # chunked: ingest the next C tokens only, no emission — the
+            # engine loop interleaves other slots' decode chunks between
+            # these passes (_continue_prefills), and pages are claimed
+            # incrementally so admission doesn't grab plen pages upfront
+            self.prefilling[i] = True
+            if not self._ensure_pages(i, t0 + C):
+                return  # pool pressure: retried next loop iteration
+            self._prefill_dispatch(i, req, t0, C)  # logits discarded
+            self.lengths[i] = t0 + C
+            return
         if rem < 2 or not self._ensure_pages(i, plen):
             return
-        # bucket the pad length so the prefill jit compiles per power of two
-        tpad = 8
-        while tpad < rem:
-            tpad *= 2
-        tpad = min(tpad, self.max_len)
-        # bucket the table width too: the prefixed path gathers every page
-        # it is handed, so its attention cost must follow the LIVE prompt
-        # length, not max_len (same trick as step()'s table view).  Padding
-        # positions index past the slice and clamp — then route to scratch.
-        need_pages = -(-plen // self.page_size)
-        pbucket = 1
-        while pbucket < need_pages:
-            pbucket *= 2
-        pbucket = min(pbucket, self.max_pages_per_slot)
-        row = jnp.asarray(self.tables[i, :pbucket])
-        toks = np.zeros((1, tpad), np.int32)
-        toks[0, :rem] = req.prompt[t0:]
-        aid = jnp.asarray(self.adapter_ids[i], jnp.int32)
-        if t0 == 0:
-            logits, self.kv = self._prefill(
-                self.params,
-                jnp.asarray(toks),
-                self.kv,
-                row,
-                jnp.asarray(rem, jnp.int32),
-                self.lora_bank,
-                aid,
-            )
-        else:
-            logits, self.kv = self._prefill_prefixed(
-                self.params,
-                jnp.asarray(toks),
-                self.kv,
-                row,
-                jnp.asarray(t0, jnp.int32),
-                jnp.asarray(rem, jnp.int32),
-                self.lora_bank,
-                aid,
-            )
-        self.prefills_run += 1
+        self.prefilling[i] = False  # final (or only) pass emits below
+        logits = self._prefill_dispatch(i, req, t0, rem)
         if req.logit_bias:
             # same additive semantics as the fused chunks' bias rows
             lgb = np.asarray(logits, np.float32).copy()
@@ -1559,6 +1580,7 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        self.prefilling[i] = False
         self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0
@@ -1575,6 +1597,7 @@ class InferenceEngine:
         self.tables[i, :] = SCRATCH_PAGE
         self.slots[i] = None
         self.stalled[i] = False
+        self.prefilling[i] = False
         self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0  # rows rewrite lazily; no device work
@@ -1601,13 +1624,20 @@ class InferenceEngine:
                 req.done.set()
                 self._release_slot(i)
                 continue
+            if self.prefilling[i]:
+                continue  # mid-chunked-prefill: fed by _continue_prefills
             if self._ensure_pages(i, int(self.lengths[i]) + lookahead):
                 active[i] = True
                 self.stalled[i] = False
             else:
                 self.stalled[i] = True
         if not active.any():
-            if any(s is not None for s in self.slots):
+            if self.stalled.any():
+                # genuine page pressure: SOME slot (decode or prefill)
+                # could not get pages and nothing is runnable — surface
+                # the overload so the serving loop can preempt a victim.
+                # Prefilling slots that are progressing don't stall, so a
+                # lone long admission never trips this.
                 raise RuntimeError(
                     f"page pool exhausted: {sum(self.stalled)} slots "
                     f"stalled, 0 runnable (pool {self.n_pages - 1} pages)"
@@ -1658,7 +1688,9 @@ class InferenceEngine:
         (W tokens/pass vs 1/step) or a greedy slot generating (drafts).
         A purely sampled generation step takes the sequential chunk."""
         for i, req in enumerate(self.slots):
-            if req is None or req.cancelled:
+            if req is None or req.cancelled or self.prefilling[i]:
+                # mid-chunked-prefill slots are excluded from the verify
+                # batch (_prepare_step), so they can't justify it either
                 continue
             if self.lengths[i] < self.prompt_lens[i] - 1:
                 return True
@@ -1667,12 +1699,36 @@ class InferenceEngine:
         return False
 
     def step(self) -> None:
-        """One engine step: a fused decode chunk, or (speculative mode) a
-        fused verify pass; page allocation, admission, and completion
-        happen between steps on the host."""
+        """One engine step: pending chunked-prefill slots each ingest one
+        chunk, then a fused decode chunk (or, speculative mode, a fused
+        verify pass) runs for everyone else; page allocation, admission,
+        and completion happen between steps on the host."""
+        self._continue_prefills()
         if self.spec_k > 0 and self._spec_useful():
             return self._step_verify()
         return self._step_chunk()
+
+    def _continue_prefills(self) -> bool:
+        """Advance every mid-chunked-prefill slot by one chunk.  Returns
+        True if any slot made progress (used to distinguish a stalled
+        pool from a still-prefilling engine)."""
+        progressed = False
+        for i, req in enumerate(self.slots):
+            if req is None or not self.prefilling[i]:
+                continue
+            if req.cancelled:
+                req.done.set()
+                self._release_slot(i)
+                progressed = True
+                continue
+            before = int(self.lengths[i])
+            self._try_prefill(i, req)
+            if not self.prefilling[i] or int(self.lengths[i]) > before:
+                progressed = True
+                self.stalled[i] = False
+            else:
+                self.stalled[i] = True  # pool-pressure stall; retried
+        return progressed
 
     def _step_verify(self) -> None:
         """Speculative engine step (VERDICT r2 #2): build each active

@@ -60,6 +60,10 @@ def build_args(argv=None):
     p.add_argument("--draft-hf", default="",
                    help="HF checkpoint dir for a DRAFT model "
                         "(draft-model speculation; requires --spec-k)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help=">0: long prompts ingest this many tokens per "
+                        "engine iteration (chunked prefill) so decoding "
+                        "requests keep streaming during big admissions")
     p.add_argument("--paged-kernel", action="store_true",
                    help="decode attention reads the page pool in place "
                         "via the Pallas kernel (long-context HBM win); "
@@ -182,6 +186,7 @@ def main(argv=None) -> int:
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
         prefix_cache=args.prefix_cache, spec_k=args.spec_k, draft=draft,
         mesh=mesh, paged_kernel=args.paged_kernel,
+        prefill_chunk=args.prefill_chunk,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(
