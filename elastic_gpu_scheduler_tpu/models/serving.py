@@ -145,6 +145,15 @@ class Request:
     # in ``token_logprobs`` and this many top alternatives (id, logprob)
     # in ``top_logprobs``.  Clamped to the engine's compiled logprobs_k.
     logprobs: int = 0
+    # OpenAI-semantics repetition penalties: logits -= frequency_penalty
+    # × count(token among GENERATED tokens so far) + presence_penalty ×
+    # (count > 0).  Prompt tokens do NOT count (matching OpenAI/vLLM: the
+    # first sampled token is never penalized); applied in every sampling
+    # distribution (fused chunks via an in-scan count carry, the verify
+    # pass via an in-window running count) with exact sequential
+    # semantics.
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
     # token id → additive logit bias (OpenAI semantics): applied to every
     # sampling distribution for this request, in the fused chunks, the
     # speculative verify pass, and the admission prefill.  ±large values
@@ -706,9 +715,9 @@ def _logprob_rows(logits, chosen, k):
 def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
-    bank=None, aids=None, bias=None,
+    bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
     *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0,
+    logprobs_k=0, use_pen=False,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches);
@@ -729,7 +738,11 @@ def _fused_serve_chunk(
     from .sampling import sample_batched
 
     def body(carry, _):
-        tokens, lengths, key, kv = carry
+        if use_pen:
+            tokens, lengths, key, kv, cnt = carry
+        else:
+            tokens, lengths, key, kv = carry
+            cnt = None
         logits, kv = _paged_decode_step(
             params, tokens, kv, tables, lengths, cfg, page_size, bank, aids,
             paged_kernel=paged_kernel, mesh=mesh,
@@ -738,6 +751,17 @@ def _fused_serve_chunk(
             # per-slot additive logit bias (zero rows are a bitwise
             # no-op, so non-biased slots/batches are unaffected)
             logits = logits + bias
+        if use_pen:
+            # count the token FED this step iff it is a GENERATED one
+            # (position `lengths` ≥ prompt length — prompt tokens never
+            # count, so the first sampled token is never penalized),
+            # then penalize this step's distribution
+            B = tokens.shape[0]
+            gen = jnp.logical_and(active, lengths >= prompt_lens)
+            cnt = cnt.at[jnp.arange(B), tokens].add(gen.astype(cnt.dtype))
+            logits = logits - fpens[:, None] * cnt - ppens[:, None] * (
+                cnt > 0
+            )
         key, sub = jax.random.split(key)
         if use_filters:
             sampled = sample_batched(logits, sub, temps, top_ks, top_ps)
@@ -757,11 +781,18 @@ def _fused_serve_chunk(
             out = (sampled, *_logprob_rows(logits, sampled, logprobs_k))
         else:
             out = sampled
-        return (tokens, new_len, key, kv), out
+        carry = (
+            (tokens, new_len, key, kv, cnt) if use_pen
+            else (tokens, new_len, key, kv)
+        )
+        return carry, out
 
-    (tokens, lengths, key, kv), outs = jax.lax.scan(
-        body, (tokens, lengths, key, kv), None, length=n_steps
+    init = (
+        (tokens, lengths, key, kv, counts.astype(jnp.float32))
+        if use_pen else (tokens, lengths, key, kv)
     )
+    carry, outs = jax.lax.scan(body, init, None, length=n_steps)
+    kv = carry[3]
     if logprobs_k > 0:
         sampled, chosen_lp, top_ids, top_lps = outs
         return (
@@ -809,9 +840,10 @@ def _cached_attention_rows(q, cache_k, cache_v, starts, window=0):
 def _fused_verify_chunk(
     params, kv, tables, feed, lengths, active,
     temps, top_ks, top_ps, key,
-    bank=None, aids=None, bias=None,
+    bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
+    plens=None,
     *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0,
+    logprobs_k=0, use_pen=False,
 ):
     """ONE wide pass over every slot's verify window (speculative decoding
     inside the paged engine — VERDICT r2 #2).
@@ -874,6 +906,29 @@ def _fused_verify_chunk(
     logits = (x @ wmat(params["unembed"], dtype)).astype(jnp.float32)
     if bias is not None:
         logits = logits + bias[:, None, :]  # per-slot additive logit bias
+    if use_pen:
+        # window position j's generated-so-far counts = ``counts``
+        # (generated tokens at positions < lengths) plus the GENERATED
+        # fed tokens among fed[0..j].  A W-length scan carries one (B, V)
+        # running count (no dense (B, W, V) one-hot/cumsum — W is tiny).
+        # Exact for every ACCEPTED position (the fed prefix equals what
+        # sequential decoding would have fed); rejected positions'
+        # outputs are discarded by the host's acceptance cap.
+        Bdim = feed.shape[0]
+        gen = positions >= plens[:, None]  # fed token j is generated?
+
+        def pen_step(cnt, inp):
+            fj, lj, gj = inp  # (B,), (B, V), (B,)
+            cnt = cnt.at[jnp.arange(Bdim), fj].add(gj.astype(cnt.dtype))
+            pl = lj - fpens[:, None] * cnt - ppens[:, None] * (cnt > 0)
+            return cnt, pl
+
+        _, pen_logits = jax.lax.scan(
+            pen_step,
+            counts.astype(jnp.float32),
+            (feed.T, jnp.moveaxis(logits, 1, 0), gen.T),
+        )
+        logits = jnp.moveaxis(pen_logits, 0, 1)
     greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # (B, W)
     subs = jax.random.split(key, W)
     if use_filters:
@@ -1103,6 +1158,8 @@ class InferenceEngine:
             (max_batch, cfg.vocab_size), jnp.float32
         )
         self._bias_set = np.zeros(max_batch, bool)
+        self.freq_pens = np.zeros(max_batch, np.float32)
+        self.pres_pens = np.zeros(max_batch, np.float32)
         # chunked prefill (>0): long prompts ingest at most this many
         # tokens per engine-loop iteration instead of one monolithic
         # pass, so decoding slots keep emitting between chunks (no
@@ -1117,7 +1174,7 @@ class InferenceEngine:
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
         self._chunks = {
-            (use_filters, want_lp): jax.jit(
+            (use_filters, want_lp, use_pen): jax.jit(
                 functools.partial(
                     _fused_serve_chunk,
                     cfg=cfg,
@@ -1127,11 +1184,13 @@ class InferenceEngine:
                     paged_kernel=self.paged_kernel,
                     mesh=mesh,
                     logprobs_k=self.logprobs_k if want_lp else 0,
+                    use_pen=use_pen,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
+            for use_pen in (False, True)
         }
         self.spec_k = max(0, spec_k)
         self.spec_ngram = spec_ngram
@@ -1193,7 +1252,7 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         self._verify_chunks = {
-            (use_filters, want_lp): jax.jit(
+            (use_filters, want_lp, use_pen): jax.jit(
                 functools.partial(
                     _fused_verify_chunk,
                     cfg=cfg,
@@ -1202,11 +1261,13 @@ class InferenceEngine:
                     paged_kernel=self.paged_kernel,
                     mesh=mesh,
                     logprobs_k=self.logprobs_k if want_lp else 0,
+                    use_pen=use_pen,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
+            for use_pen in (False, True)
         }
         self._prefill = jax.jit(
             functools.partial(
@@ -1263,6 +1324,11 @@ class InferenceEngine:
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
+        for pen in (req.frequency_penalty, req.presence_penalty):
+            if not np.isfinite(pen):
+                req.error = "penalties must be finite"
+                req.done.set()
+                return req
         if req.logit_bias and not all(
             isinstance(k, int) and not isinstance(k, bool)
             and 0 <= k < self.cfg.vocab_size
@@ -1344,6 +1410,8 @@ class InferenceEngine:
             self.top_ks[i] = req.top_k
             self.top_ps[i] = req.top_p
             self.adapter_ids[i] = self.adapter_index[req.adapter]
+            self.freq_pens[i] = req.frequency_penalty
+            self.pres_pens[i] = req.presence_penalty
             if req.logit_bias:
                 row = np.zeros(self.cfg.vocab_size, np.float32)
                 for t, b in req.logit_bias.items():
@@ -1491,6 +1559,8 @@ class InferenceEngine:
             for t_, b_ in req.logit_bias.items():
                 lgb[t_] += b_
             logits = jnp.asarray(lgb)
+        # penalties: nothing to apply at admission — counts cover
+        # GENERATED tokens only, and none exist before the first sample
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
@@ -1658,6 +1728,30 @@ class InferenceEngine:
             or (self.top_ps[active] < 1.0).any()
         )
 
+    def _pens_requested(self, active) -> bool:
+        return bool(
+            (self.freq_pens[active] != 0).any()
+            or (self.pres_pens[active] != 0).any()
+        )
+
+    def _host_counts(self) -> np.ndarray:
+        """(B, V) counts of every GENERATED token at positions <
+        lengths[i] — the authoritative penalty state, rebuilt per
+        dispatch from host output lists so no device/host sync
+        bookkeeping can drift.  Cost is O(tokens generated) per slot
+        (bounded by max_new_tokens, never the full context) plus the
+        (B, V) buffer — paid only by batches with a penalized request."""
+        out = np.zeros((self.max_batch, self.cfg.vocab_size), np.int32)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            n_gen = int(self.lengths[i]) - int(self.prompt_lens[i])
+            if n_gen > 0:
+                np.add.at(
+                    out[i], np.asarray(req.output[:n_gen], np.int64), 1
+                )
+        return out
+
     def _logprobs_requested(self, active) -> bool:
         """Pick the logprob-emitting chunk variant only when some active
         request asked — the default path never pays the top-k."""
@@ -1782,7 +1876,8 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         use_filters = self._filters_requested(active)
         want_lp = self._logprobs_requested(active)
-        out, self.kv = self._verify_chunks[(use_filters, want_lp)](
+        use_pen = self._pens_requested(active)
+        out, self.kv = self._verify_chunks[(use_filters, want_lp, use_pen)](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -1796,6 +1891,10 @@ class InferenceEngine:
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
             self._bias_dev,
+            jnp.asarray(self.freq_pens) if use_pen else None,
+            jnp.asarray(self.pres_pens) if use_pen else None,
+            jnp.asarray(self._host_counts()) if use_pen else None,
+            jnp.asarray(self.prompt_lens) if use_pen else None,
         )
         if want_lp:
             picked, chosen_lp, top_ids, top_lps = (
@@ -1978,7 +2077,8 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         use_filters = self._filters_requested(active)
         want_lp = self._logprobs_requested(active)
-        out, self.kv = self._chunks[(use_filters, want_lp)](
+        use_pen = self._pens_requested(active)
+        out, self.kv = self._chunks[(use_filters, want_lp, use_pen)](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -1994,6 +2094,9 @@ class InferenceEngine:
             self.lora_bank,
             jnp.asarray(self.adapter_ids),
             self._bias_dev,
+            jnp.asarray(self.freq_pens) if use_pen else None,
+            jnp.asarray(self.pres_pens) if use_pen else None,
+            jnp.asarray(self._host_counts()) if use_pen else None,
         )
         if want_lp:
             sampled, chosen_lp, top_ids, top_lps = (

@@ -177,6 +177,8 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         stop_tokens=tuple(stop),
         logprobs=logprobs,
         logit_bias=bias,
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
     )
 
 

@@ -234,3 +234,18 @@ def test_huge_json_int_bias_returns_400(served):
         "logit_bias": {"5": int("9" * 400)},
     })
     assert code == 400 and "error" in out
+
+
+def test_penalties_over_http(served):
+    addr, _ = served
+    _, base = _post(addr, "/v1/completions",
+                    {"prompt": [3, 9, 14], "max_tokens": 10})
+    code, pen = _post(addr, "/v1/completions", {
+        "prompt": [3, 9, 14], "max_tokens": 10,
+        "frequency_penalty": 1.5, "presence_penalty": 0.5,
+    })
+    assert code == 200 and pen["tokens"] != base["tokens"]
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [3], "max_tokens": 2, "frequency_penalty": "high",
+    })
+    assert code == 400
