@@ -154,6 +154,10 @@ class Request:
     # semantics.
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # stop tokens are ignored until this many tokens have been emitted
+    # (vLLM's min_tokens): a stop id sampled early is kept and generation
+    # continues; max_new_tokens still caps the total.
+    min_tokens: int = 0
     # token id → additive logit bias (OpenAI semantics): applied to every
     # sampling distribution for this request, in the fused chunks, the
     # speculative verify pass, and the admission prefill.  ±large values
@@ -1366,6 +1370,11 @@ class InferenceEngine:
 
     # -- engine internals ----------------------------------------------------
 
+    def _stops(self, i: int, req: Request, tok: int) -> bool:
+        """Stop-token check honoring min_tokens (emitted counter already
+        includes ``tok`` at every call site)."""
+        return tok in req.stop_tokens and self.emitted[i] >= req.min_tokens
+
     @staticmethod
     def _emit(req: Request, tok: int, lp=None, top=None) -> None:
         """Deliver one streamed token.  A raising user callback must never
@@ -1593,7 +1602,7 @@ class InferenceEngine:
         self.lengths[i] = plen
         self.next_token[i] = tok
         if (
-            tok in req.stop_tokens
+            self._stops(i, req, tok)
             or self.emitted[i] >= req.max_new_tokens
             or req.cancelled
         ):
@@ -1944,7 +1953,7 @@ class InferenceEngine:
                 emit_at(req, i, tok, j - 1)
                 self.emitted[i] += 1
                 self.spec_accepted += 1
-                if tok in req.stop_tokens:
+                if self._stops(i, req, tok):
                     stopped = True
                     A = j + 1  # confirmed rows end at the stop token
                     break
@@ -1957,7 +1966,7 @@ class InferenceEngine:
                 tok = int(picked[i, A - 1])
                 emit_at(req, i, tok, A - 1)
                 self.emitted[i] += 1
-                if tok in req.stop_tokens:
+                if self._stops(i, req, tok):
                     stopped = True
             # rows p..p+A-1 hold confirmed K/V; the bonus token (position
             # p+A) is fed — and its row written — by the next pass
@@ -2125,7 +2134,7 @@ class InferenceEngine:
                     else:
                         self._emit(req, tok)
                     self.emitted[i] += 1
-                    if tok in req.stop_tokens:
+                    if self._stops(i, req, tok):
                         # stop token emitted (and kept, HF-style); tokens
                         # the device sampled past it this chunk are dropped
                         stopped = True

@@ -179,6 +179,7 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         logit_bias=bias,
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
+        min_tokens=int(body.get("min_tokens", 0)),
     )
 
 

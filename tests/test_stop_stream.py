@@ -123,3 +123,27 @@ def test_generate_eos_masks_tail():
     # identical up to and including the first EOS, padding after
     assert list(masked[: first + 1]) == list(out[: first + 1])
     assert all(t == eos for t in masked[first + 1 :])
+
+
+def test_min_tokens_suppresses_early_stop():
+    """A stop id emitted before min_tokens is kept and generation
+    continues (vLLM min_tokens); the same id past the floor stops."""
+    params = init_params(jax.random.key(0), CFG)
+    eng = InferenceEngine(params, CFG, max_batch=1, max_len=64, page_size=8)
+    base = eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=12))
+    eng.run_until_idle()
+    stop = base.output[2]  # appears at emission index 2 (< floor)
+    floor = InferenceEngine(
+        init_params(jax.random.key(0), CFG), CFG, max_batch=1, max_len=64,
+        page_size=8,
+    )
+    r = floor.submit(Request(prompt=[3, 9, 14], max_new_tokens=12,
+                             stop_tokens=(stop,), min_tokens=6))
+    floor.run_until_idle()
+    assert not r.error
+    assert len(r.output) >= 6  # early stop id did not end generation
+    assert r.output[2] == stop  # ...and was kept in the output
+    # past the floor, the first occurrence (if any) stops generation
+    later = [k for k, t in enumerate(r.output) if t == stop and k >= 5]
+    if later:
+        assert later[0] == len(r.output) - 1  # stopped right there
