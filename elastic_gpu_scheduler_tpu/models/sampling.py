@@ -78,12 +78,17 @@ def sample_batched(
     temps: jax.Array,  # (B,) float32; 0 → greedy for that row
     top_ks: jax.Array,  # (B,) int32; 0 → no top-k for that row
     top_ps: jax.Array,  # (B,) float32; >= 1 → no top-p for that row
+    row_keys: jax.Array = None,  # (B,) typed keys; overrides ``key``
 ) -> jax.Array:
     """(B, V) logits → (B,) tokens with PER-ROW sampling params.
 
     One descending argsort serves both filters: rank-based top-k and
     cumulative-mass top-p masks are built in sorted space and gathered back
     through the inverse permutation.
+
+    ``row_keys`` (per-request seeding): each row draws with its own key
+    instead of slicing one batch key — reproducible per request,
+    independent of batch composition.
     """
     logits = logits.astype(jnp.float32)
     V = logits.shape[-1]
@@ -107,5 +112,12 @@ def sample_batched(
     keep = keep_k & (keep_p | (top_ps[:, None] >= 1.0))
 
     masked = jnp.where(keep, scaled, -jnp.inf)
-    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    if row_keys is not None:
+        sampled = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg)
+        )(row_keys, masked).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(key, masked, axis=-1).astype(
+            jnp.int32
+        )
     return jnp.where(temps > 0, sampled, greedy)

@@ -158,6 +158,17 @@ class Request:
     # (vLLM's min_tokens): a stop id sampled early is kept and generation
     # continues; max_new_tokens still caps the total.
     min_tokens: int = 0
+    # per-request sampling seed: draws key off fold_in(key(seed),
+    # position) — reproducible across batch composition, slot placement,
+    # restarts, AND engine modes (a seeded sampled request produces the
+    # same tokens under speculative and sequential decoding, because
+    # both key by the distribution's position).  None → engine stream.
+    seed: Optional[int] = None
+    # hard constraint: when non-empty, ONLY these token ids can ever be
+    # sampled (everything else gets -1e9 — classification / multiple-
+    # choice / tool-call-id decoding).  Implemented through the same
+    # device-resident bias rows as logit_bias and composes with it.
+    allowed_tokens: tuple = ()
     # token id → additive logit bias (OpenAI semantics): applied to every
     # sampling distribution for this request, in the fused chunks, the
     # speculative verify pass, and the admission prefill.  ±large values
@@ -703,6 +714,36 @@ def _paged_prefill_prefixed(
     return logits.astype(jnp.float32), new_kv
 
 
+def _bias_row(req: "Request", vocab_size: int) -> np.ndarray:
+    """The additive logit row for a request's allowed_tokens +
+    logit_bias — ONE construction shared by the admission prefill
+    (host-side add) and the device-resident per-slot bias rows, so the
+    two distributions cannot diverge."""
+    row = np.zeros(vocab_size, np.float32)
+    if req.allowed_tokens:
+        row -= 1e9
+        row[np.asarray(req.allowed_tokens, np.int64)] = 0.0
+    for t, b in req.logit_bias.items():
+        row[t] += b
+    return row
+
+
+def _row_sample_keys(seed_keys, seeded, positions, sub):
+    """(B,) per-row sampling keys: seeded rows key off
+    fold_in(key(seed), position) — deterministic per request and
+    position, independent of batch composition and engine mode; unseeded
+    rows key off the engine stream (split per row)."""
+    B = positions.shape[0]
+    pos_keys = jax.vmap(jax.random.fold_in)(seed_keys, positions)
+    stream_keys = jax.random.split(sub, B)
+    kd = jnp.where(
+        seeded[:, None],
+        jax.random.key_data(pos_keys),
+        jax.random.key_data(stream_keys),
+    )
+    return jax.random.wrap_key_data(kd)
+
+
 def _logprob_rows(logits, chosen, k):
     """(chosen_lp, top_ids, top_lps) for one step's logits.
 
@@ -720,8 +761,9 @@ def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
     bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
+    seed_keys=None, seeded=None,
     *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0, use_pen=False,
+    logprobs_k=0, use_pen=False, use_seed=False,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches);
@@ -767,13 +809,25 @@ def _fused_serve_chunk(
                 cnt > 0
             )
         key, sub = jax.random.split(key)
+        row_keys = (
+            _row_sample_keys(seed_keys, seeded, lengths, sub)
+            if use_seed else None
+        )
         if use_filters:
-            sampled = sample_batched(logits, sub, temps, top_ks, top_ps)
+            sampled = sample_batched(
+                logits, sub, temps, top_ks, top_ps, row_keys=row_keys
+            )
         else:
             greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-            temped = jax.random.categorical(
-                sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1
-            ).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if use_seed:
+                temped = jax.vmap(
+                    lambda k, lg: jax.random.categorical(k, lg)
+                )(row_keys, scaled).astype(jnp.int32)
+            else:
+                temped = jax.random.categorical(
+                    sub, scaled, axis=-1
+                ).astype(jnp.int32)
             sampled = jnp.where(temps > 0, temped, greedy)
         new_len = lengths + active.astype(jnp.int32)
         in_prompt = new_len < prompt_lens
@@ -845,9 +899,9 @@ def _fused_verify_chunk(
     params, kv, tables, feed, lengths, active,
     temps, top_ks, top_ps, key,
     bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
-    plens=None,
+    plens=None, seed_keys=None, seeded=None,
     *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0, use_pen=False,
+    logprobs_k=0, use_pen=False, use_seed=False,
 ):
     """ONE wide pass over every slot's verify window (speculative decoding
     inside the paged engine — VERDICT r2 #2).
@@ -935,7 +989,25 @@ def _fused_verify_chunk(
         logits = jnp.moveaxis(pen_logits, 0, 1)
     greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # (B, W)
     subs = jax.random.split(key, W)
-    if use_filters:
+    if use_seed:
+        # per-(row, position) keys: a seeded row samples position p with
+        # fold_in(key(seed), p) — exactly the sequential chunk's key for
+        # the same position, so seeded sampled requests produce the SAME
+        # tokens under speculative and sequential decoding
+        def sample_j(lg, k, pos):
+            rk = _row_sample_keys(seed_keys, seeded, pos, k)
+            if use_filters:
+                return sample_batched(
+                    lg, k, temps, top_ks, top_ps, row_keys=rk
+                )
+            return jax.vmap(
+                lambda kk, l: jax.random.categorical(kk, l)
+            )(rk, lg / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
+
+        sampled = jax.vmap(sample_j, in_axes=(1, 0, 1), out_axes=1)(
+            logits, subs, positions
+        )
+    elif use_filters:
         sampled = jax.vmap(
             lambda lg, k: sample_batched(lg, k, temps, top_ks, top_ps),
             in_axes=(1, 0), out_axes=1,
@@ -1164,6 +1236,12 @@ class InferenceEngine:
         self._bias_set = np.zeros(max_batch, bool)
         self.freq_pens = np.zeros(max_batch, np.float32)
         self.pres_pens = np.zeros(max_batch, np.float32)
+        # per-request sampling seeds: typed key per slot + a host-side
+        # flag; unseeded slots keep drawing from the engine stream
+        self._seed_keys = jax.vmap(jax.random.key)(
+            jnp.zeros(max_batch, jnp.uint32)
+        )
+        self._seeded = np.zeros(max_batch, bool)
         # chunked prefill (>0): long prompts ingest at most this many
         # tokens per engine-loop iteration instead of one monolithic
         # pass, so decoding slots keep emitting between chunks (no
@@ -1178,7 +1256,7 @@ class InferenceEngine:
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
         self._chunks = {
-            (use_filters, want_lp, use_pen): jax.jit(
+            (use_filters, want_lp, use_pen, use_seed): jax.jit(
                 functools.partial(
                     _fused_serve_chunk,
                     cfg=cfg,
@@ -1189,12 +1267,14 @@ class InferenceEngine:
                     mesh=mesh,
                     logprobs_k=self.logprobs_k if want_lp else 0,
                     use_pen=use_pen,
+                    use_seed=use_seed,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
             for use_pen in (False, True)
+            for use_seed in (False, True)
         }
         self.spec_k = max(0, spec_k)
         self.spec_ngram = spec_ngram
@@ -1256,7 +1336,7 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         self._verify_chunks = {
-            (use_filters, want_lp, use_pen): jax.jit(
+            (use_filters, want_lp, use_pen, use_seed): jax.jit(
                 functools.partial(
                     _fused_verify_chunk,
                     cfg=cfg,
@@ -1266,12 +1346,14 @@ class InferenceEngine:
                     mesh=mesh,
                     logprobs_k=self.logprobs_k if want_lp else 0,
                     use_pen=use_pen,
+                    use_seed=use_seed,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
             for use_pen in (False, True)
+            for use_seed in (False, True)
         }
         self._prefill = jax.jit(
             functools.partial(
@@ -1328,11 +1410,33 @@ class InferenceEngine:
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
+        if req.seed is not None:
+            if isinstance(req.seed, bool) or not isinstance(req.seed, int):
+                req.error = "seed must be an integer"
+                req.done.set()
+                return req
+            if req.temperature <= 0:
+                req.seed = None  # greedy ignores draws; don't pay the
+                # seeded chunk variant's compile for a no-op
+            else:
+                req.seed &= 0xFFFFFFFF  # uint32 domain (np.uint32 of an
+                # out-of-range int raises OverflowError under NumPy 2)
         for pen in (req.frequency_penalty, req.presence_penalty):
             if not np.isfinite(pen):
                 req.error = "penalties must be finite"
                 req.done.set()
                 return req
+        if req.allowed_tokens and not all(
+            isinstance(k, int) and not isinstance(k, bool)
+            and 0 <= k < self.cfg.vocab_size
+            for k in req.allowed_tokens
+        ):
+            req.error = (
+                f"allowed_tokens must be token ids in "
+                f"[0, {self.cfg.vocab_size})"
+            )
+            req.done.set()
+            return req
         if req.logit_bias and not all(
             isinstance(k, int) and not isinstance(k, bool)
             and 0 <= k < self.cfg.vocab_size
@@ -1421,11 +1525,15 @@ class InferenceEngine:
             self.adapter_ids[i] = self.adapter_index[req.adapter]
             self.freq_pens[i] = req.frequency_penalty
             self.pres_pens[i] = req.presence_penalty
-            if req.logit_bias:
-                row = np.zeros(self.cfg.vocab_size, np.float32)
-                for t, b in req.logit_bias.items():
-                    row[t] = b
-                self._bias_dev = self._bias_dev.at[i].set(row)
+            if req.seed is not None:
+                self._seed_keys = self._seed_keys.at[i].set(
+                    jax.random.key(np.uint32(req.seed))
+                )
+                self._seeded[i] = True
+            if req.logit_bias or req.allowed_tokens:
+                self._bias_dev = self._bias_dev.at[i].set(
+                    _bias_row(req, self.cfg.vocab_size)
+                )
                 self._bias_set[i] = True
             self.emitted[i] = 0
             self.stalled[i] = False
@@ -1562,19 +1670,27 @@ class InferenceEngine:
             return
         self.prefilling[i] = False  # final (or only) pass emits below
         logits = self._prefill_dispatch(i, req, t0, rem)
-        if req.logit_bias:
-            # same additive semantics as the fused chunks' bias rows
-            lgb = np.asarray(logits, np.float32).copy()
-            for t_, b_ in req.logit_bias.items():
-                lgb[t_] += b_
-            logits = jnp.asarray(lgb)
+        if req.logit_bias or req.allowed_tokens:
+            # the SAME row the fused chunks add, applied host-side
+            logits = jnp.asarray(
+                np.asarray(logits, np.float32)
+                + _bias_row(req, self.cfg.vocab_size)
+            )
         # penalties: nothing to apply at admission — counts cover
         # GENERATED tokens only, and none exist before the first sample
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
 
-            self._key, sub = jax.random.split(self._key)
+            if req.seed is not None:
+                # position-keyed, like the chunks: the distribution sits
+                # at the prompt's last position
+                sub = jax.random.fold_in(
+                    jax.random.key(np.uint32(req.seed)), plen - 1
+                )
+                self._key, _ = jax.random.split(self._key)
+            else:
+                self._key, sub = jax.random.split(self._key)
             tok = int(
                 sample_static(
                     jnp.reshape(logits, (1, -1)), sub,
@@ -1660,6 +1776,7 @@ class InferenceEngine:
         self.slots[i] = None
         self.stalled[i] = False
         self.prefilling[i] = False
+        self._seeded[i] = False
         self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0
@@ -1677,6 +1794,7 @@ class InferenceEngine:
         self.slots[i] = None
         self.stalled[i] = False
         self.prefilling[i] = False
+        self._seeded[i] = False
         self._clear_bias(i)
         if self.draft is not None:
             self.draft_len[i] = 0  # rows rewrite lazily; no device work
@@ -1760,6 +1878,9 @@ class InferenceEngine:
                     out[i], np.asarray(req.output[:n_gen], np.int64), 1
                 )
         return out
+
+    def _seeds_requested(self, active) -> bool:
+        return bool(self._seeded[active].any())
 
     def _logprobs_requested(self, active) -> bool:
         """Pick the logprob-emitting chunk variant only when some active
@@ -1886,7 +2007,10 @@ class InferenceEngine:
         use_filters = self._filters_requested(active)
         want_lp = self._logprobs_requested(active)
         use_pen = self._pens_requested(active)
-        out, self.kv = self._verify_chunks[(use_filters, want_lp, use_pen)](
+        use_seed = self._seeds_requested(active)
+        out, self.kv = self._verify_chunks[
+            (use_filters, want_lp, use_pen, use_seed)
+        ](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -1904,6 +2028,8 @@ class InferenceEngine:
             jnp.asarray(self.pres_pens) if use_pen else None,
             jnp.asarray(self._host_counts()) if use_pen else None,
             jnp.asarray(self.prompt_lens) if use_pen else None,
+            self._seed_keys if use_seed else None,
+            jnp.asarray(self._seeded) if use_seed else None,
         )
         if want_lp:
             picked, chosen_lp, top_ids, top_lps = (
@@ -2087,7 +2213,10 @@ class InferenceEngine:
         use_filters = self._filters_requested(active)
         want_lp = self._logprobs_requested(active)
         use_pen = self._pens_requested(active)
-        out, self.kv = self._chunks[(use_filters, want_lp, use_pen)](
+        use_seed = self._seeds_requested(active)
+        out, self.kv = self._chunks[
+            (use_filters, want_lp, use_pen, use_seed)
+        ](
             self.params,
             self.kv,
             jnp.asarray(view),
@@ -2106,6 +2235,8 @@ class InferenceEngine:
             jnp.asarray(self.freq_pens) if use_pen else None,
             jnp.asarray(self.pres_pens) if use_pen else None,
             jnp.asarray(self._host_counts()) if use_pen else None,
+            self._seed_keys if use_seed else None,
+            jnp.asarray(self._seeded) if use_seed else None,
         )
         if want_lp:
             sampled, chosen_lp, top_ids, top_lps = (

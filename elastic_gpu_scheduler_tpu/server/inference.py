@@ -180,6 +180,12 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         min_tokens=int(body.get("min_tokens", 0)),
+        seed=(None if body.get("seed") is None
+              else int(body["seed"])),
+        allowed_tokens=tuple(
+            _token_ids(body.get("allowed_tokens", []), vocab_size,
+                       "allowed_tokens")
+        ),
     )
 
 

@@ -97,3 +97,41 @@ def test_forced_token_logprob_near_zero():
     eng.run_until_idle()
     assert not r.error and set(r.output) == {42}
     assert all(lp > -1e-3 for lp in r.token_logprobs), r.token_logprobs
+
+
+def test_allowed_tokens_constrains_output():
+    """allowed_tokens: only the whitelisted ids are ever sampled, across
+    sequential AND speculative engines, composing with logit_bias."""
+    allowed = (10, 20, 30)
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=48, page_size=8, fused_steps=4,
+    )
+    a = eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=8,
+                           allowed_tokens=allowed))
+    b = eng.submit(Request(prompt=[60, 2], max_new_tokens=8,
+                           allowed_tokens=allowed, temperature=0.9))
+    eng.run_until_idle()
+    assert not a.error and not b.error
+    assert set(a.output) <= set(allowed), a.output
+    assert set(b.output) <= set(allowed), b.output
+    # speculative engine: same constraint, greedy token-identical
+    eng2 = InferenceEngine(
+        PARAMS, CFG, max_batch=1, max_len=48, page_size=8, fused_steps=4,
+        spec_k=3,
+    )
+    c = eng2.submit(Request(prompt=[5, 17, 3], max_new_tokens=8,
+                            allowed_tokens=allowed))
+    eng2.run_until_idle()
+    assert c.output == a.output
+    # composes with logit_bias: boosting one allowed id forces it
+    eng3 = InferenceEngine(
+        PARAMS, CFG, max_batch=1, max_len=48, page_size=8,
+    )
+    d = eng3.submit(Request(prompt=[5, 17, 3], max_new_tokens=4,
+                            allowed_tokens=allowed, logit_bias={20: 1e8}))
+    eng3.run_until_idle()
+    assert set(d.output) == {20}
+    # validation
+    bad = eng3.submit(Request(prompt=[5], max_new_tokens=2,
+                              allowed_tokens=(9999,)))
+    assert bad.done.is_set() and "allowed_tokens" in bad.error

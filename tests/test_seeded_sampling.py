@@ -1,0 +1,71 @@
+"""Per-request seeds: reproducible sampling independent of batch
+composition, slot placement, and engine mode.  Seeded rows key each draw
+off fold_in(key(seed), position) — the SAME key in the sequential chunk,
+the speculative verify pass, and the admission prefill — so a seeded
+sampled request is deterministic everywhere.
+"""
+
+import jax
+
+from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype="float32",
+)
+PARAMS = init_params(jax.random.key(0), CFG)
+
+
+def run_one(prompt, seed, companions=(), **kw):
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=4, max_len=48, page_size=8, fused_steps=4,
+        **kw,
+    )
+    others = [
+        eng.submit(Request(prompt=list(c), max_new_tokens=6))
+        for c in companions
+    ]
+    r = eng.submit(Request(prompt=list(prompt), max_new_tokens=8,
+                           temperature=0.9, seed=seed))
+    eng.run_until_idle()
+    for o in others:
+        assert not o.error
+    assert not r.error, r.error
+    return r.output
+
+
+def test_seed_reproducible_across_batch_composition():
+    alone = run_one([5, 17, 3], seed=1234)
+    crowded = run_one([5, 17, 3], seed=1234,
+                      companions=([60, 2], [9, 9, 9], [1, 2, 3, 4]))
+    assert alone == crowded
+    assert run_one([5, 17, 3], seed=1234) == alone  # restart-stable
+    assert run_one([5, 17, 3], seed=99) != alone  # seeds differentiate
+
+
+def test_seed_identical_under_speculation():
+    """A seeded SAMPLED request produces the same tokens in speculative
+    and sequential engines (position-keyed draws)."""
+    seq = run_one([5, 17, 3], seed=7)
+    spec = run_one([5, 17, 3], seed=7, spec_k=3)
+    assert seq == spec
+
+
+def test_seed_with_filters():
+    a = run_one([5, 17, 3], seed=42)
+    # engage the filtered sampling variant via top_k on a companion —
+    # the seeded row's draws must not change
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=48, page_size=8, fused_steps=4,
+    )
+    c = eng.submit(Request(prompt=[60, 2], max_new_tokens=6,
+                           temperature=0.8, top_k=5))
+    r = eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=8,
+                           temperature=0.9, seed=42))
+    eng.run_until_idle()
+    assert not r.error and not c.error
+    assert r.output == a
