@@ -720,11 +720,15 @@ def _bias_row(req: "Request", vocab_size: int) -> np.ndarray:
     (host-side add) and the device-resident per-slot bias rows, so the
     two distributions cannot diverge."""
     row = np.zeros(vocab_size, np.float32)
-    if req.allowed_tokens:
-        row -= 1e9
-        row[np.asarray(req.allowed_tokens, np.int64)] = 0.0
     for t, b in req.logit_bias.items():
         row[t] += b
+    if req.allowed_tokens:
+        # the whitelist DOMINATES: non-allowed ids are flat -1e9 no
+        # matter how large a positive bias asked for them — 'only these
+        # ids can ever be sampled' is a hard guarantee, not additive
+        banned = np.ones(vocab_size, bool)
+        banned[np.asarray(req.allowed_tokens, np.int64)] = False
+        row[banned] = -1e9
     return row
 
 

@@ -144,6 +144,17 @@ def _token_ids(x, vocab_size: int, what: str) -> list:
     return x
 
 
+def _strict_seed(v):
+    """None, or an int — floats/bools/strings 400 (silent coercion would
+    hand two different client values the same completion, the exact
+    reproducibility bug seeds exist to prevent)."""
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise ValueError("'seed' must be an integer")
+    return v
+
+
 def _request_from_body(body: dict, vocab_size: int) -> Request:
     prompt = _token_ids(body.get("prompt"), vocab_size, "prompt")
     stop = _token_ids(body.get("stop", []), vocab_size, "stop")
@@ -180,8 +191,7 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         min_tokens=int(body.get("min_tokens", 0)),
-        seed=(None if body.get("seed") is None
-              else int(body["seed"])),
+        seed=_strict_seed(body.get("seed")),
         allowed_tokens=tuple(
             _token_ids(body.get("allowed_tokens", []), vocab_size,
                        "allowed_tokens")
@@ -249,7 +259,23 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                req = _request_from_body(body, engine.cfg.vocab_size)
+                n = body.get("n", 1)
+                if (
+                    not isinstance(n, int) or isinstance(n, bool)
+                    or not 1 <= n <= engine.max_batch
+                ):
+                    raise ValueError(
+                        f"'n' must be an integer in [1, {engine.max_batch}]"
+                    )
+                if n > 1 and body.get("stream"):
+                    raise ValueError("'n' > 1 does not support streaming")
+                reqs = []
+                for k in range(n):
+                    req = _request_from_body(body, engine.cfg.vocab_size)
+                    if n > 1 and req.seed is not None:
+                        req.seed = req.seed + k  # choice k's derived seed
+                    reqs.append(req)
+                req = reqs[0]
             except (
                 ValueError, TypeError, OverflowError, json.JSONDecodeError,
             ) as e:
@@ -262,6 +288,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 return self._json(400, {"error": str(e)})
             if body.get("stream"):
                 return self._stream(req)
+            if n > 1:
+                return self._multi(reqs, n)
             engine.submit(req)
             if not req.done.wait(request_timeout):
                 req.cancel()  # engine frees the slot at the next boundary
@@ -286,6 +314,39 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if req.logprobs > 0:
                 resp["logprobs"] = _logprobs_payload(req)
             return self._json(200, resp)
+
+        def _multi(self, reqs, n: int) -> None:
+            """n parallel completions (OpenAI's ``n``): submit every
+            choice (identical prompts share prefix-cache pages when the
+            engine caches; a given "seed" derives per-choice seeds as
+            seed+k), wait for all, return indexed choices."""
+            deadline = time.monotonic() + request_timeout
+            for r in reqs:
+                engine.submit(r)
+            timed_out = False
+            for r in reqs:
+                if not r.done.wait(max(0.0, deadline - time.monotonic())):
+                    timed_out = True
+                    r.cancel()
+            acked = {
+                id(r): r.done.wait(10.0) if timed_out else True
+                for r in reqs
+            }  # thread-ownership rule: only read output after done
+            errs = [r.error for r in reqs if r.error]
+            if errs:
+                return self._json(400, {"error": errs[0]})
+            choices = []
+            for k, r in enumerate(reqs):
+                ok = acked[id(r)]
+                c = {"index": k, "tokens": list(r.output) if ok else []}
+                if r.logprobs > 0 and ok:
+                    c["logprobs"] = _logprobs_payload(r)
+                choices.append(c)
+            code = 504 if timed_out else 200
+            out = {"choices": choices}
+            if timed_out:
+                out["error"] = "generation timed out"
+            return self._json(code, out)
 
         def _stream(self, req: Request) -> None:
             # SSE: tokens are pushed from the ENGINE thread into a bounded

@@ -249,3 +249,32 @@ def test_penalties_over_http(served):
         "prompt": [3], "max_tokens": 2, "frequency_penalty": "high",
     })
     assert code == 400
+
+
+def test_n_parallel_completions(served):
+    addr, engine = served
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5, 17, 3], "max_tokens": 6, "n": 2,
+        "temperature": 0.9, "seed": 7,
+    })
+    assert code == 200 and len(out["choices"]) == 2
+    a, b = out["choices"]
+    assert a["index"] == 0 and b["index"] == 1
+    assert len(a["tokens"]) == 6 and len(b["tokens"]) == 6
+    assert a["tokens"] != b["tokens"]  # derived seeds differentiate
+    # reproducible: same request → same choices
+    _, out2 = _post(addr, "/v1/completions", {
+        "prompt": [5, 17, 3], "max_tokens": 6, "n": 2,
+        "temperature": 0.9, "seed": 7,
+    })
+    assert out2["choices"] == out["choices"]
+    # n validation + stream exclusion
+    code, _ = _post(addr, "/v1/completions",
+                    {"prompt": [5], "max_tokens": 2, "n": 0})
+    assert code == 400
+    code, _ = _post(addr, "/v1/completions",
+                    {"prompt": [5], "max_tokens": 2, "n": 99})
+    assert code == 400
+    code, _ = _post(addr, "/v1/completions",
+                    {"prompt": [5], "max_tokens": 2, "n": 2, "stream": True})
+    assert code == 400

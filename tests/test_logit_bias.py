@@ -135,3 +135,14 @@ def test_allowed_tokens_constrains_output():
     bad = eng3.submit(Request(prompt=[5], max_new_tokens=2,
                               allowed_tokens=(9999,)))
     assert bad.done.is_set() and "allowed_tokens" in bad.error
+
+
+def test_allowed_tokens_dominates_logit_bias():
+    """A huge positive bias on a NON-allowed id must not escape the
+    whitelist — 'only these ids can ever be sampled' is hard."""
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8)
+    r = eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=6,
+                           allowed_tokens=(10, 20, 30),
+                           logit_bias={5: 2e9}))
+    eng.run_until_idle()
+    assert not r.error and set(r.output) <= {10, 20, 30}, r.output
