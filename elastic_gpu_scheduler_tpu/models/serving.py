@@ -723,11 +723,15 @@ def _bias_row(req: "Request", vocab_size: int) -> np.ndarray:
     for t, b in req.logit_bias.items():
         row[t] += b
     if req.allowed_tokens:
-        # the whitelist DOMINATES: non-allowed ids are flat -1e9 no
-        # matter how large a positive bias asked for them — 'only these
-        # ids can ever be sampled' is a hard guarantee, not additive
+        # the whitelist DOMINATES in both directions: non-allowed ids sit
+        # at a flat -1e9 regardless of positive bias, and allowed ids'
+        # bias is clamped ABOVE that floor so a huge negative bias on an
+        # allowed token can't push it beneath the banned set — 'only
+        # these ids can ever be sampled' is a hard guarantee
+        allowed_idx = np.asarray(req.allowed_tokens, np.int64)
+        row[allowed_idx] = np.maximum(row[allowed_idx], -1e8)
         banned = np.ones(vocab_size, bool)
-        banned[np.asarray(req.allowed_tokens, np.int64)] = False
+        banned[allowed_idx] = False
         row[banned] = -1e9
     return row
 

@@ -60,6 +60,9 @@ def build_args(argv=None):
     p.add_argument("--draft-hf", default="",
                    help="HF checkpoint dir for a DRAFT model "
                         "(draft-model speculation; requires --spec-k)")
+    p.add_argument("--logprobs-k", type=int, default=5,
+                   help="compiled top-k width for per-token logprobs "
+                        "(0 disables; requests asking more are clamped)")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help=">0: long prompts ingest this many tokens per "
                         "engine iteration (chunked prefill) so decoding "
@@ -186,7 +189,7 @@ def main(argv=None) -> int:
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
         prefix_cache=args.prefix_cache, spec_k=args.spec_k, draft=draft,
         mesh=mesh, paged_kernel=args.paged_kernel,
-        prefill_chunk=args.prefill_chunk,
+        prefill_chunk=args.prefill_chunk, logprobs_k=args.logprobs_k,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

@@ -146,3 +146,13 @@ def test_allowed_tokens_dominates_logit_bias():
                            logit_bias={5: 2e9}))
     eng.run_until_idle()
     assert not r.error and set(r.output) <= {10, 20, 30}, r.output
+
+
+def test_allowed_tokens_dominates_negative_bias_too():
+    """The symmetric hole: a huge NEGATIVE bias on the only allowed id
+    must not let banned ids outrank it."""
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8)
+    r = eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=4,
+                           allowed_tokens=(10,), logit_bias={10: -2e9}))
+    eng.run_until_idle()
+    assert not r.error and set(r.output) == {10}, r.output

@@ -188,3 +188,41 @@ def test_paged_kernel_mesh_requires_divisible_heads():
     mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
     with pytest.raises(ValueError, match="divisible"):
         InferenceEngine(params, cfg, paged_kernel=True, mesh=mesh)
+
+
+def test_engine_paged_kernel_with_multilora_and_prefix_cache():
+    """Adapters touch the projections, not the attention geometry — the
+    kernel engine must be token-identical to the gather engine for a
+    mixed-adapter batch with prefix caching on."""
+    from elastic_gpu_scheduler_tpu.models.lora import lora_init
+
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, dtype="float32",
+    )
+    params = init_params(jax.random.key(2), cfg)
+    lo = lora_init(jax.random.key(5), params, rank=2, targets=("wq", "wv"))
+    for tgt, ab in lo["adapters"].items():
+        lo["adapters"][tgt]["b"] = (
+            jax.random.normal(jax.random.key(6), ab["b"].shape) * 0.08
+        )
+    adapters = {"style": lo}
+
+    def run(**kw):
+        eng = InferenceEngine(
+            params, cfg, max_batch=4, max_len=64, page_size=8,
+            adapters=adapters, prefix_cache=True, **kw,
+        )
+        reqs = [
+            eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=8,
+                               adapter="style")),
+            eng.submit(Request(prompt=[5, 17, 3], max_new_tokens=8)),
+            eng.submit(Request(prompt=list(range(1, 17)),
+                               max_new_tokens=8, adapter="style")),
+        ]
+        eng.run_until_idle()
+        for r in reqs:
+            assert r.done.is_set() and not r.error, r.error
+        return [r.output for r in reqs]
+
+    assert run(paged_kernel=True) == run()
