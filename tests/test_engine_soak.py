@@ -79,6 +79,7 @@ def test_engine_soak_mixed_workload():
         PARAMS, CFG, max_batch=4, max_len=48, page_size=8,
         n_pages=17,  # 16 real pages vs 4 slots × 6 pages peak → pressure
         fused_steps=4, spec_k=2, prefix_cache=True, adapters=_adapters(),
+        prefill_chunk=8, logprobs_k=3,
     )
     shared_prefix = [7, 8, 9, 10, 11, 12, 13, 14]  # one full page
     waves_done = 0
@@ -93,12 +94,23 @@ def test_engine_soak_mixed_workload():
                 if kind <= 1 else
                 [int(t) for t in rng.integers(1, 60, rng.integers(2, 20))]
             )
+            extra = int(rng.integers(0, 5))
             r = Request(
                 prompt=prompt,
                 max_new_tokens=int(rng.integers(2, 14)),
                 temperature=0.7 if kind == 2 else 0.0,
                 stop_tokens=(3, 5) if kind == 3 else (),
                 adapter="style" if kind == 4 else "",
+                # round-4 per-request features churn alongside (each wave
+                # mixes them arbitrarily so every chunk-variant pair and
+                # bias/penalty row lifecycle gets exercised)
+                logprobs=2 if extra == 0 else 0,
+                logit_bias={int(rng.integers(1, 60)): 3.0}
+                if extra == 1 else {},
+                frequency_penalty=0.8 if extra == 2 else 0.0,
+                seed=int(rng.integers(0, 1 << 31))
+                if extra == 3 and kind == 2 else None,
+                min_tokens=2 if extra == 4 else 0,
             )
             reqs.append(eng.submit(r))
         # cancel a couple mid-flight-ish (engine checks at chunk bounds)
@@ -108,7 +120,13 @@ def test_engine_soak_mixed_workload():
         for r in reqs:
             assert r.done.is_set(), "request stalled forever"
             assert not r.error, r.error
+            if r.logprobs:  # lockstep invariant across all emission paths
+                assert len(r.token_logprobs) == len(r.output)
+                assert len(r.top_logprobs) == len(r.output)
         check_page_accounting(eng)
+        # per-slot feature state fully reset after drain
+        assert not eng._bias_set.any() and not eng._seeded.any()
+        assert not eng.prefilling.any()
         waves_done += 1
         if wave == 1:  # after warm-up (compiles, caches) stabilizes
             baseline = tracemalloc.get_traced_memory()[0]
