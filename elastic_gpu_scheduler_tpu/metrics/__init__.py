@@ -89,6 +89,35 @@ class Histogram:
         with self._lock:
             return list(self._samples.get(labels, []))
 
+    def summary(self) -> dict:
+        """Exact per-label summary in ONE lock acquisition: counts/sums
+        from the authoritative counters (never the trimmed sample
+        buffer), quantiles/max from the retained samples.  The public
+        read API for profile endpoints."""
+        out = {}
+        with self._lock:
+            items = [
+                (labels, self._totals[labels], self._sums[labels],
+                 sorted(self._samples.get(labels, [])))
+                for labels in self._totals
+            ]
+        for labels, total, s, samples in items:
+            def q(p):
+                if not samples:
+                    return 0.0
+                idx = min(len(samples) - 1,
+                          max(0, int(p * len(samples) + 0.5) - 1))
+                return samples[idx]
+
+            out[",".join(labels)] = {
+                "acquisitions": total,
+                "wait_total_s": round(s, 6),
+                "wait_max_s": round(samples[-1], 6) if samples else 0.0,
+                "wait_p50_s": round(q(0.5), 6),
+                "wait_p99_s": round(q(0.99), 6),
+            }
+        return out
+
     def quantile(self, q: float, *labels: str) -> float:
         """Exact quantile from retained samples (for bench/tests)."""
         with self._lock:

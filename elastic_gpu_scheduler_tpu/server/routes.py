@@ -13,6 +13,7 @@ Reference: pkg/routes/routes.go.  Paths kept wire-compatible:
     GET  /metrics               → Prometheus text (net-new; reference has none)
     GET  /debug/stacks          → all-thread stack dump (pprof analogue;
                                   reference mounts net/http/pprof, pprof.go)
+    GET  /debug/pprof/mutex     → lock wait-time summary (scheduler/gang)
     GET  /debug/pprof/heap      → tracemalloc heap report; ?diff=1 = growth
                                   since previous call (leak probe; reference
                                   heap/allocs endpoints, pprof.go:10-64)
@@ -299,6 +300,17 @@ class ExtenderServer:
             except ValueError:
                 secs = 2.0
             return 200, sample_cpu_profile(secs).encode(), "text/plain"
+        if path == "/debug/pprof/mutex":
+            # lock-contention profile (reference mounts Go's mutex/block
+            # profiles, pkg/routes/pprof.go:10-64): wait-time summary of
+            # the TimedLock-instrumented scheduler/gang locks
+            from ..metrics import LOCK_WAIT
+
+            return (
+                200,
+                json.dumps(LOCK_WAIT.summary(), indent=1).encode(),
+                "application/json",
+            )
         if path == "/debug/pprof/heap":
             params = _parse_query(query)
             try:

@@ -390,3 +390,23 @@ def test_worker_pool_overflow_makes_progress():
         t.join(timeout=15)
     assert oks.count(200) == 8
     server.stop()
+
+
+def test_pprof_mutex_reports_lock_waits(stack):
+    """/debug/pprof/mutex: the Go block/mutex-profile parity slot — after
+    any traffic the scheduler lock has wait samples and a JSON summary."""
+    cluster, clientset, port, controller = stack
+    import json as _json
+    import urllib.request
+
+    base = f"http://127.0.0.1:{port}"
+    # generate some lock traffic through a normal verb round-trip
+    with urllib.request.urlopen(base + "/scheduler/status", timeout=10) as r:
+        assert r.status == 200
+    with urllib.request.urlopen(base + "/debug/pprof/mutex", timeout=10) as r:
+        assert r.status == 200
+        out = _json.loads(r.read())
+    assert "scheduler" in out, out
+    s = out["scheduler"]
+    assert s["acquisitions"] > 0
+    assert s["wait_total_s"] >= 0 and s["wait_p99_s"] >= s["wait_p50_s"]
