@@ -45,6 +45,15 @@ class Gauge(Counter):
                 yield f"{self.name}{_fmt_labels(self.label_names, labels)} {v}"
 
 
+def _exact_quantile(sorted_samples: list, q: float) -> float:
+    """Nearest-rank quantile over an ascending list (one rounding rule
+    shared by Histogram.quantile and Histogram.summary)."""
+    if not sorted_samples:
+        return 0.0
+    n = len(sorted_samples)
+    return sorted_samples[min(n - 1, max(0, int(q * n + 0.5) - 1))]
+
+
 DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
@@ -92,29 +101,26 @@ class Histogram:
     def summary(self) -> dict:
         """Exact per-label summary in ONE lock acquisition: counts/sums
         from the authoritative counters (never the trimmed sample
-        buffer), quantiles/max from the retained samples.  The public
-        read API for profile endpoints."""
+        buffer), quantiles/max from the retained samples.  Sorting
+        happens AFTER the lock releases — observe() runs inside
+        TimedLock.acquire with the instrumented lock already held, so a
+        scrape must never stall it behind an O(n log n) sort.  The
+        public read API for profile endpoints."""
         out = {}
         with self._lock:
             items = [
                 (labels, self._totals[labels], self._sums[labels],
-                 sorted(self._samples.get(labels, [])))
+                 list(self._samples.get(labels, ())))
                 for labels in self._totals
             ]
         for labels, total, s, samples in items:
-            def q(p):
-                if not samples:
-                    return 0.0
-                idx = min(len(samples) - 1,
-                          max(0, int(p * len(samples) + 0.5) - 1))
-                return samples[idx]
-
+            samples.sort()
             out[",".join(labels)] = {
                 "acquisitions": total,
                 "wait_total_s": round(s, 6),
                 "wait_max_s": round(samples[-1], 6) if samples else 0.0,
-                "wait_p50_s": round(q(0.5), 6),
-                "wait_p99_s": round(q(0.99), 6),
+                "wait_p50_s": round(_exact_quantile(samples, 0.5), 6),
+                "wait_p99_s": round(_exact_quantile(samples, 0.99), 6),
             }
         return out
 
@@ -122,10 +128,7 @@ class Histogram:
         """Exact quantile from retained samples (for bench/tests)."""
         with self._lock:
             samples = sorted(self._samples.get(labels, []))
-        if not samples:
-            return 0.0
-        idx = min(len(samples) - 1, max(0, int(q * len(samples) + 0.5) - 1))
-        return samples[idx]
+        return _exact_quantile(samples, q)
 
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"

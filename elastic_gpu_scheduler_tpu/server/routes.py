@@ -41,7 +41,7 @@ from ..k8s.extender import (
     ExtenderBindingArgs,
     ExtenderPreemptionArgs,
 )
-from ..metrics import REGISTRY, VERB_LATENCY, VERB_TOTAL
+from ..metrics import LOCK_WAIT, REGISTRY, VERB_LATENCY, VERB_TOTAL
 from .handlers import Bind, Predicate, Preemption, Prioritize
 
 log = logging.getLogger("tpu-scheduler")
@@ -304,8 +304,6 @@ class ExtenderServer:
             # lock-contention profile (reference mounts Go's mutex/block
             # profiles, pkg/routes/pprof.go:10-64): wait-time summary of
             # the TimedLock-instrumented scheduler/gang locks
-            from ..metrics import LOCK_WAIT
-
             return (
                 200,
                 json.dumps(LOCK_WAIT.summary(), indent=1).encode(),

@@ -396,16 +396,10 @@ def test_pprof_mutex_reports_lock_waits(stack):
     """/debug/pprof/mutex: the Go block/mutex-profile parity slot — after
     any traffic the scheduler lock has wait samples and a JSON summary."""
     cluster, clientset, port, controller = stack
-    import json as _json
-    import urllib.request
-
-    base = f"http://127.0.0.1:{port}"
     # generate some lock traffic through a normal verb round-trip
-    with urllib.request.urlopen(base + "/scheduler/status", timeout=10) as r:
-        assert r.status == 200
-    with urllib.request.urlopen(base + "/debug/pprof/mutex", timeout=10) as r:
-        assert r.status == 200
-        out = _json.loads(r.read())
+    assert get(port, "/scheduler/status")[0] == 200
+    status, out = get(port, "/debug/pprof/mutex")
+    assert status == 200
     assert "scheduler" in out, out
     s = out["scheduler"]
     assert s["acquisitions"] > 0
