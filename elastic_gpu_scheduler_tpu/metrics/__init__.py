@@ -125,9 +125,12 @@ class Histogram:
         return out
 
     def quantile(self, q: float, *labels: str) -> float:
-        """Exact quantile from retained samples (for bench/tests)."""
+        """Exact quantile from retained samples (for bench/tests).
+        Copies under the lock, sorts OUTSIDE it — observe() runs with
+        instrumented locks held, so no reader may stall it on a sort."""
         with self._lock:
-            samples = sorted(self._samples.get(labels, []))
+            samples = list(self._samples.get(labels, ()))
+        samples.sort()
         return _exact_quantile(samples, q)
 
     def collect(self) -> Iterable[str]:

@@ -328,8 +328,10 @@ def _moe_ffn_serve(h, p, dtype, ep=False):
     other slots' routing, so engine outputs match solo ``generate()`` runs.
 
     Three shapes of the same computation, chosen statically:
-    - decode-sized (≤32 tokens, single device): gather the chosen
-      expert's weights per token — 3 (T, D, F) gathers, dense-FFN FLOPs;
+    - decode-sized (≤32 tokens AND ≤E tokens, single device): gather the
+      chosen expert's weights per token — 3 (T, D, F) gathers, dense-FFN
+      FLOPs.  Past E tokens the gather reads MORE weight bytes than the
+      grouped matmul touches (T matrices vs ≤E), so ragged wins;
     - prefill-sized (single device / tensor-sharded): grouped matmul —
       sort tokens by expert, ``lax.ragged_dot`` per projection (XLA's
       TPU grouped GEMM), unsort.  Dense FLOPs per token; this retired
@@ -354,7 +356,7 @@ def _moe_ffn_serve(h, p, dtype, ep=False):
     probs = jax.nn.softmax(glog, axis=-1)  # (T, E)
     idx = jnp.argmax(probs, axis=-1)  # (T,)
     prob = jnp.max(probs, axis=-1).astype(jnp.float32)  # (T,)
-    if tokens <= 32 and not ep:
+    if tokens <= min(32, glog.shape[-1]) and not ep:
         wg = wmat(p["w_gate"], dtype)[idx]  # (T, D, F)
         wi = wmat(p["w_in"], dtype)[idx]
         wo = wmat(p["w_out"], dtype)[idx]

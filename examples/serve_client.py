@@ -28,8 +28,11 @@ import json
 import urllib.request
 
 
-def make_codec(tokenizer_dir: str | None, vocab_size: int):
-    """(encode, decode) — a HF tokenizer when given, else byte-level."""
+def make_codec(tokenizer_dir: str | None):
+    """(encode, decode) — a HF tokenizer when given, else byte-level
+    (id = byte value + 1; needs a server vocab ≥ 257, which any real
+    checkpoint has.  Generated ids past the byte range — possible with a
+    random-init smoke server — clamp for display)."""
     if tokenizer_dir:
         from transformers import AutoTokenizer
 
@@ -38,10 +41,8 @@ def make_codec(tokenizer_dir: str | None, vocab_size: int):
             lambda s: tok.encode(s, add_special_tokens=False),
             lambda ids: tok.decode(ids),
         )
-    # byte-level stand-in: id = byte value + 1 (0 reserved; ids past the
-    # byte range — possible with a random-init model — clamp for display)
     return (
-        lambda s: [b + 1 for b in s.encode()][: vocab_size - 1],
+        lambda s: [b + 1 for b in s.encode()],
         lambda ids: bytes(
             min(255, max(0, i - 1)) for i in ids
         ).decode(errors="replace"),
@@ -90,9 +91,7 @@ def main():
         stats = json.loads(r.read())
     print("server stats:", json.dumps(stats, indent=1))
 
-    # vocab size isn't in stats; probe a huge id for the 400 bound
-    vocab = 32000
-    encode, decode = make_codec(args.tokenizer or None, vocab)
+    encode, decode = make_codec(args.tokenizer or None)
     ids = encode(args.prompt)
     print(f"\nprompt {args.prompt!r} -> {len(ids)} tokens")
 
@@ -130,6 +129,9 @@ def main():
     for ev in stream(base, {"prompt": ids, "max_tokens": 24,
                             "temperature": 0.7, "seed": 1,
                             "frequency_penalty": 0.8}):
+        if "error" in ev:  # timeout/engine errors arrive as events
+            print(f"\n[stream error: {ev['error']}]")
+            break
         print(decode([ev["token"]]), end="", flush=True)
     print()
 
