@@ -128,3 +128,82 @@ def test_launcher_env_fallback(monkeypatch):
                    batch_size=2, seq_len=16)
     losses = run_job(spec, devices=jax.devices()[:2])
     assert len(losses) == 2 and np.isfinite(losses).all()
+
+
+def test_schedule_then_serve_end_to_end():
+    """The deploy/tpu-inference-server.yaml loop, in-process: an inference
+    pod is scheduled through the extender HTTP stack (4-chip contiguous
+    sub-box, coordinate annotations), the placement becomes a tensor
+    mesh, and the paged engine serves requests over it — token-identical
+    to a single-device engine."""
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+    from elastic_gpu_scheduler_tpu.parallel.mesh import mesh_from_allocation
+
+    cluster = FakeCluster()
+    cluster.add_node(
+        make_tpu_node(
+            "tpu-host", chips=4, hbm_gib=64, accelerator="v5e",
+            slice_topology="2x2", host_topology="2x2", host_offset="0.0",
+        )
+    )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, priority="ici-locality")
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0
+    )
+    port = server.start()
+    pod = make_pod(
+        "inference-server",
+        containers=[
+            Container(
+                name="server",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: 400}
+                ),
+            )
+        ],
+    )
+    cluster.create_pod(pod)
+    filt = post(port, "/scheduler/filter",
+                {"Pod": pod.to_dict(), "NodeNames": ["tpu-host"]})
+    assert filt["NodeNames"] == ["tpu-host"]
+    res = post(port, "/scheduler/bind", {
+        "PodName": "inference-server", "PodNamespace": "default",
+        "PodUID": pod.metadata.uid, "Node": "tpu-host",
+    })
+    assert res["Error"] == ""
+    ann = cluster.get_pod("default", "inference-server").metadata.annotations
+    server.stop()
+
+    # the pod's 4 allocated chips → a tensor=4 serving mesh
+    mesh = mesh_from_allocation(
+        ann, "server", MeshSpec(tensor=4), devices=jax.devices()[:4]
+    )
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, dtype="float32",
+    )
+    params = init_params(jax.random.key(2), cfg)
+    prompts = [[5, 17, 3], [60, 2, 9, 9]]
+
+    def run(mesh_arg):
+        eng = InferenceEngine(
+            params, cfg, max_batch=2, max_len=48, page_size=8,
+            mesh=mesh_arg,
+        )
+        reqs = [
+            eng.submit(Request(prompt=list(p), max_new_tokens=8))
+            for p in prompts
+        ]
+        eng.run_until_idle()
+        for r in reqs:
+            assert r.done.is_set() and not r.error, r.error
+        return [r.output for r in reqs]
+
+    assert run(mesh) == run(None)
