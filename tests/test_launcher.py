@@ -40,8 +40,10 @@ def post(port, path, body):
         return json.loads(r.read())
 
 
-def test_schedule_then_launch_end_to_end():
-    """BASELINE north star: placed, bound, and launched — no GPU in the loop."""
+def _schedule_and_bind(pod_name: str, container: str) -> dict:
+    """Drive a 4-chip pod through the extender HTTP stack on a 2x2 v5e
+    host; returns the bound pod's annotations (asserted non-empty for the
+    container — the coordinates ARE the product under test)."""
     cluster = FakeCluster()
     cluster.add_node(
         make_tpu_node(
@@ -55,36 +57,37 @@ def test_schedule_then_launch_end_to_end():
     )
     server = ExtenderServer(predicate, prioritize, bind, status, host="127.0.0.1", port=0)
     port = server.start()
+    try:
+        pod = make_pod(
+            pod_name,
+            containers=[
+                Container(
+                    name=container,
+                    resources=ResourceRequirements(
+                        limits={consts.RESOURCE_TPU_CORE: 400}
+                    ),
+                )
+            ],
+        )
+        cluster.create_pod(pod)
+        filt = post(port, "/scheduler/filter",
+                    {"Pod": pod.to_dict(), "NodeNames": ["tpu-host"]})
+        assert filt["NodeNames"] == ["tpu-host"]
+        res = post(port, "/scheduler/bind", {
+            "PodName": pod_name, "PodNamespace": "default",
+            "PodUID": pod.metadata.uid, "Node": "tpu-host",
+        })
+        assert res["Error"] == ""
+        ann = cluster.get_pod("default", pod_name).metadata.annotations
+        assert ann[consts.ANNOTATION_CONTAINER_PREFIX + container]
+        return ann
+    finally:
+        server.stop()
 
-    pod = make_pod(
-        "trainer",
-        containers=[
-            Container(
-                name="main",
-                resources=ResourceRequirements(
-                    limits={consts.RESOURCE_TPU_CORE: 400}
-                ),
-            )
-        ],
-    )
-    cluster.create_pod(pod)
-    filt = post(port, "/scheduler/filter", {"Pod": pod.to_dict(), "NodeNames": ["tpu-host"]})
-    assert filt["NodeNames"] == ["tpu-host"]
-    res = post(
-        port,
-        "/scheduler/bind",
-        {
-            "PodName": "trainer",
-            "PodNamespace": "default",
-            "PodUID": pod.metadata.uid,
-            "Node": "tpu-host",
-        },
-    )
-    assert res["Error"] == ""
-    bound = cluster.get_pod("default", "trainer")
-    ann = bound.metadata.annotations
-    assert ann[consts.ANNOTATION_CONTAINER_PREFIX + "main"]
-    server.stop()
+
+def test_schedule_then_launch_end_to_end():
+    """BASELINE north star: placed, bound, and launched — no GPU in the loop."""
+    ann = _schedule_and_bind("trainer", "main")
 
     # launch: 4 allocated chips → data=1, tensor=2, seq=2 mesh on CPU devices
     spec = JobSpec(
@@ -143,43 +146,7 @@ def test_schedule_then_serve_end_to_end():
     from elastic_gpu_scheduler_tpu.models.transformer import init_params
     from elastic_gpu_scheduler_tpu.parallel.mesh import mesh_from_allocation
 
-    cluster = FakeCluster()
-    cluster.add_node(
-        make_tpu_node(
-            "tpu-host", chips=4, hbm_gib=64, accelerator="v5e",
-            slice_topology="2x2", host_topology="2x2", host_offset="0.0",
-        )
-    )
-    clientset = FakeClientset(cluster)
-    registry, predicate, prioritize, bind, controller, status, gang = (
-        build_stack(clientset, cluster=cluster, priority="ici-locality")
-    )
-    server = ExtenderServer(
-        predicate, prioritize, bind, status, host="127.0.0.1", port=0
-    )
-    port = server.start()
-    pod = make_pod(
-        "inference-server",
-        containers=[
-            Container(
-                name="server",
-                resources=ResourceRequirements(
-                    limits={consts.RESOURCE_TPU_CORE: 400}
-                ),
-            )
-        ],
-    )
-    cluster.create_pod(pod)
-    filt = post(port, "/scheduler/filter",
-                {"Pod": pod.to_dict(), "NodeNames": ["tpu-host"]})
-    assert filt["NodeNames"] == ["tpu-host"]
-    res = post(port, "/scheduler/bind", {
-        "PodName": "inference-server", "PodNamespace": "default",
-        "PodUID": pod.metadata.uid, "Node": "tpu-host",
-    })
-    assert res["Error"] == ""
-    ann = cluster.get_pod("default", "inference-server").metadata.annotations
-    server.stop()
+    ann = _schedule_and_bind("inference-server", "server")
 
     # the pod's 4 allocated chips → a tensor=4 serving mesh
     mesh = mesh_from_allocation(
