@@ -250,6 +250,12 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "spec_passes": int(eng.spec_passes),
                     "spec_accepted": int(eng.spec_accepted),
                     "draft_model": eng.draft is not None,
+                    # round-4 engine config, so clients can discover the
+                    # feature surface before sending requests
+                    "logprobs_k": eng.logprobs_k,
+                    "prefill_chunk": eng.prefill_chunk,
+                    "paged_kernel": eng.paged_kernel,
+                    "vocab_size": eng.cfg.vocab_size,
                 })
             return self._json(404, {"error": f"no route {self.path}"})
 

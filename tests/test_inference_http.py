@@ -152,6 +152,9 @@ def test_stats_reflect_engine(served):
     assert body["max_batch"] == 2
     assert body["total_pages"] == engine.n_pages - 1
     assert body["adapters"] == []
+    assert body["logprobs_k"] == engine.logprobs_k
+    assert body["vocab_size"] == CFG.vocab_size
+    assert body["paged_kernel"] is False
 
 
 def test_bad_scalar_fields_return_400(served):
