@@ -103,3 +103,33 @@ def test_chunked_prefill_under_page_pressure():
     assert len(a.output) == 10 and len(b.output) == 6
     want, _ = run([LONG[:56]], max_new=6)
     assert b.output == want[0]
+
+
+def test_chunked_prefill_with_paged_kernel():
+    """Chunk passes write pages; the kernel decode path reads them in
+    place — the composed engine stays token-identical."""
+    want, _ = run([LONG, [5, 17, 3]])
+    got, _ = run([LONG, [5, 17, 3]], prefill_chunk=16, paged_kernel=True)
+    assert got == want
+
+
+def test_all_request_features_together():
+    """One request using every round-4 knob at once (seed + penalties +
+    logprobs + bias + min_tokens) through a chunked-prefill speculative
+    engine: completes, stays reproducible, and keeps logprob lockstep."""
+    def go():
+        eng = InferenceEngine(
+            PARAMS, CFG, max_batch=2, max_len=128, page_size=8,
+            fused_steps=4, spec_k=2, prefill_chunk=16, prefix_cache=True,
+        )
+        r = eng.submit(Request(
+            prompt=list(LONG[:40]), max_new_tokens=10, temperature=0.8,
+            seed=77, frequency_penalty=0.5, presence_penalty=0.2,
+            logprobs=2, logit_bias={13: 1.5}, min_tokens=3,
+        ))
+        eng.run_until_idle(max_steps=100_000)
+        assert r.done.is_set() and not r.error, r.error
+        assert len(r.token_logprobs) == len(r.output)
+        return r.output
+
+    assert go() == go()  # seeded: the whole composition reproduces
