@@ -146,7 +146,8 @@ def run_job(
         if ckpt and spec.checkpoint_every and (step + 1) % spec.checkpoint_every == 0:
             ckpt.save(params, opt_state, step + 1)
     if ckpt and spec.checkpoint_every:
-        ckpt.save(params, opt_state, spec.steps)
+        # the job's FINAL save must be durable before the pod exits
+        ckpt.save(params, opt_state, spec.steps, block=True)
     return losses
 
 

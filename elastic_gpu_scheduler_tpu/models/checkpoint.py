@@ -28,16 +28,29 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep),
         )
 
-    def save(self, params: Any, opt_state: Any, step: int) -> None:
-        self.manager.save(
+    def save(
+        self, params: Any, opt_state: Any, step: int, block: bool = False
+    ) -> None:
+        """ASYNC by default: orbax snapshots device arrays now and
+        serializes in background threads while training continues — the
+        save costs the train loop a device-to-host copy, not the disk
+        write.  orbax joins any in-flight save internally before writing
+        (and on close); restore() adds its own join so a reader never
+        races a write.  ``block=True`` for the final save of a job."""
+        saved = self.manager.save(
             step,
             args=self._ocp.args.Composite(
                 params=self._ocp.args.StandardSave(params),
                 opt_state=self._ocp.args.StandardSave(opt_state),
             ),
         )
-        self.manager.wait_until_finished()
-        log.info("checkpoint saved at step %d", step)
+        if block:
+            self.manager.wait_until_finished()
+        if saved:
+            log.info("checkpoint save dispatched at step %d (block=%s)",
+                     step, block)
+        else:  # orbax no-opped (step already saved / should_save False)
+            log.info("checkpoint save skipped at step %d", step)
 
     def restore(
         self, params_template: Any, opt_state_template: Any
@@ -45,6 +58,7 @@ class CheckpointManager:
         """Restore the latest checkpoint, or None if none exists.
 
         Templates provide structure/shardings for sharded restore."""
+        self.manager.wait_until_finished()  # join any in-flight save
         step = self.manager.latest_step()
         if step is None:
             return None
@@ -71,4 +85,4 @@ class CheckpointManager:
         return params, opt_state, step
 
     def close(self) -> None:
-        self.manager.close()
+        self.manager.close()  # joins any in-flight save internally

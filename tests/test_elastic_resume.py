@@ -109,3 +109,22 @@ def test_elastic_resume_bf16_master_state():
         _, _, losses = _train(r_params, r_opt, step_b, tokens, 1)
         np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-3)
         mgr.close()
+
+
+def test_async_saves_join_before_restore(tmp_path):
+    """Async checkpointing: back-to-back non-blocking saves serialize in
+    the background; restore() joins in-flight work first and sees the
+    LAST save's values exactly."""
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d)
+    p1 = {"w": jax.numpy.ones((8, 8))}
+    p2 = {"w": jax.numpy.full((8, 8), 3.0)}
+    opt = {"mu": jax.numpy.zeros((8, 8))}
+    mgr.save(p1, opt, 1)          # async
+    mgr.save(p2, opt, 2)          # joins save 1, dispatches save 2 async
+    out = mgr.restore(p1, opt)    # joins save 2 before reading
+    assert out is not None
+    params, _, step = out
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(params["w"]), 3.0)
+    mgr.close()
