@@ -38,10 +38,33 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..metrics import REGISTRY, Counter, Histogram
 from ..models.serving import InferenceEngine, Request
 from .routes import _REASONS
 
 log = logging.getLogger("tpu-scheduler")
+
+SERVE_REQUESTS = REGISTRY.register(
+    Counter(
+        "tpu_serve_requests_total",
+        "Inference requests by result (ok/error/timeout/cancelled)",
+        ("result",),
+    )
+)
+SERVE_TOKENS = REGISTRY.register(
+    Counter(
+        "tpu_serve_tokens_total",
+        "Tokens emitted to clients",
+    )
+)
+SERVE_LATENCY = REGISTRY.register(
+    Histogram(
+        "tpu_serve_request_seconds",
+        "End-to-end request latency (submit to done)",
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                 60.0, 120.0),
+    )
+)
 
 
 class EngineLoop:
@@ -230,6 +253,16 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
         def do_GET(self):
             if self.path == "/healthz":
                 return self._json(200, {"ok": True})
+            if self.path == "/metrics":
+                data = REGISTRY.expose().encode()
+                self.send_response(200, "OK")
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if self.path == "/v1/stats":
                 eng = engine
                 return self._json(200, {
@@ -296,6 +329,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 return self._stream(req)
             if n > 1:
                 return self._multi(reqs, n)
+            t0 = time.monotonic()
             engine.submit(req)
             if not req.done.wait(request_timeout):
                 req.cancel()  # engine frees the slot at the next boundary
@@ -303,6 +337,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # reading output — the Request thread-ownership rule; the
                 # next chunk boundary is normally well under this wait
                 acked = req.done.wait(10.0)
+                SERVE_REQUESTS.inc("timeout")
+                SERVE_LATENCY.observe(value=time.monotonic() - t0)
+                if acked:  # partial tokens handed over are emitted work
+                    SERVE_TOKENS.inc(value=len(req.output))
                 return self._json(504, {
                     "error": "generation timed out",
                     # tokens generated before the deadline are real work —
@@ -314,8 +352,12 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                         if acked and req.logprobs > 0 else {}
                     ),
                 })
+            SERVE_LATENCY.observe(value=time.monotonic() - t0)
             if req.error:
+                SERVE_REQUESTS.inc("error")
                 return self._json(400, {"error": req.error})
+            SERVE_REQUESTS.inc("ok")
+            SERVE_TOKENS.inc(value=len(req.output))
             resp = {"tokens": req.output}
             if req.logprobs > 0:
                 resp["logprobs"] = _logprobs_payload(req)
@@ -326,7 +368,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             choice (identical prompts share prefix-cache pages when the
             engine caches; a given "seed" derives per-choice seeds as
             seed+k), wait for all, return indexed choices."""
-            deadline = time.monotonic() + request_timeout
+            t0 = time.monotonic()
+            deadline = t0 + request_timeout
             for r in reqs:
                 engine.submit(r)
             timed_out = False
@@ -338,13 +381,20 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 id(r): r.done.wait(10.0) if timed_out else True
                 for r in reqs
             }  # thread-ownership rule: only read output after done
+            SERVE_LATENCY.observe(value=time.monotonic() - t0)
             errs = [r.error for r in reqs if r.error]
             if errs:
+                SERVE_REQUESTS.inc("error", value=float(len(reqs)))
                 return self._json(400, {"error": errs[0]})
+            SERVE_REQUESTS.inc(
+                "timeout" if timed_out else "ok", value=float(len(reqs))
+            )
             choices = []
             for k, r in enumerate(reqs):
                 ok = acked[id(r)]
                 c = {"index": k, "tokens": list(r.output) if ok else []}
+                if ok:
+                    SERVE_TOKENS.inc(value=len(r.output))
                 if r.logprobs > 0 and ok:
                     c["logprobs"] = _logprobs_payload(r)
                 choices.append(c)
@@ -372,6 +422,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     q.put((tok, None, None))
 
             req.on_token = on_token
+            t0 = time.monotonic()
             engine.submit(req)
             # submit() validates synchronously — a rejected request gets
             # the same 400 the non-streaming path returns, not a 200
@@ -412,9 +463,13 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     # (no clean [DONE]) and cancel engine-side so the slot
                     # and its KV pages come back at the next chunk boundary
                     req.cancel()
+                    SERVE_REQUESTS.inc("timeout")
                     chunk(json.dumps({"error": "generation timed out"}))
                 elif req.error:
+                    SERVE_REQUESTS.inc("error")
                     chunk(json.dumps({"error": req.error}))
+                else:
+                    SERVE_REQUESTS.inc("ok")
                 chunk("[DONE]")
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
@@ -422,7 +477,11 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # dead client: stop generating for it — the engine checks
                 # the cancel flag at every chunk boundary
                 req.cancel()
+                SERVE_REQUESTS.inc("cancelled")
                 log.info("stream client disconnected after %d tokens", sent)
+            finally:
+                SERVE_LATENCY.observe(value=time.monotonic() - t0)
+                SERVE_TOKENS.inc(value=sent)
 
     return InferenceHandler
 

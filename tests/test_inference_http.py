@@ -281,3 +281,43 @@ def test_n_parallel_completions(served):
     code, _ = _post(addr, "/v1/completions",
                     {"prompt": [5], "max_tokens": 2, "n": 2, "stream": True})
     assert code == 400
+
+
+def test_serving_prometheus_metrics(served):
+    """/metrics on the inference server: request counters, token counter,
+    and the latency histogram — observability parity with the scheduler
+    plane's endpoint."""
+    addr, _ = served
+    code, out = _post(addr, "/v1/completions",
+                      {"prompt": [3, 9, 14], "max_tokens": 5})
+    assert code == 200
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert 'tpu_serve_requests_total{result="ok"}' in text
+    assert "tpu_serve_tokens_total" in text
+    assert "tpu_serve_request_seconds_count" in text
+    # streaming requests count too (every path is instrumented)
+    from elastic_gpu_scheduler_tpu.server.inference import SERVE_TOKENS
+
+    before = SERVE_TOKENS._values.get((), 0.0)
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [2, 4], "max_tokens": 3,
+                             "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read()
+    conn.close()
+    # the handler's accounting runs after the terminal chunk flushes —
+    # poll briefly rather than racing it
+    import time as _time
+
+    for _ in range(50):
+        if SERVE_TOKENS._values.get((), 0.0) == before + 3:
+            break
+        _time.sleep(0.05)
+    assert SERVE_TOKENS._values.get((), 0.0) == before + 3
