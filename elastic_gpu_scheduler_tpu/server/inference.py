@@ -306,8 +306,6 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     raise ValueError(
                         f"'n' must be an integer in [1, {engine.max_batch}]"
                     )
-                if n > 1 and body.get("stream"):
-                    raise ValueError("'n' > 1 does not support streaming")
                 reqs = []
                 for k in range(n):
                     req = _request_from_body(body, engine.cfg.vocab_size)
@@ -326,7 +324,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # aborted connection
                 return self._json(400, {"error": str(e)})
             if body.get("stream"):
-                return self._stream(req)
+                return self._stream(reqs)
             if n > 1:
                 return self._multi(reqs, n)
             t0 = time.monotonic()
@@ -404,31 +402,43 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 out["error"] = "generation timed out"
             return self._json(code, out)
 
-        def _stream(self, req: Request) -> None:
+        def _stream(self, reqs: list) -> None:
             # SSE: tokens are pushed from the ENGINE thread into a bounded
-            # queue; this handler thread drains it to the socket, so a slow
-            # client never blocks generation (the queue is sized for the
-            # whole response)
-            q: "queue.Queue" = queue.Queue(maxsize=req.max_new_tokens + 2)
+            # shared queue; this handler thread drains it to the socket,
+            # so a slow client never blocks generation (the queue is
+            # sized for every choice's whole response).  Events carry an
+            # "index" field when n > 1 (single-choice streams keep the
+            # legacy flat shape).
+            n = len(reqs)
+            q: "queue.Queue" = queue.Queue(
+                maxsize=sum(r.max_new_tokens for r in reqs) + 2 * n
+            )
 
-            def on_token(tok):
-                # runs on the ENGINE thread, after _emit appended the
-                # token's logprob entries — reading [-1] here is the
-                # documented ownership-safe window
-                if req.logprobs > 0:
-                    q.put((tok, req.token_logprobs[-1],
-                           req.top_logprobs[-1]))
-                else:
-                    q.put((tok, None, None))
+            def make_on_token(k, r):
+                def on_token(tok):
+                    # runs on the ENGINE thread, after _emit appended the
+                    # token's logprob entries — reading [-1] here is the
+                    # documented ownership-safe window
+                    if r.logprobs > 0:
+                        q.put((k, tok, r.token_logprobs[-1],
+                               r.top_logprobs[-1]))
+                    else:
+                        q.put((k, tok, None, None))
+                return on_token
 
-            req.on_token = on_token
+            for k, r in enumerate(reqs):
+                r.on_token = make_on_token(k, r)
             t0 = time.monotonic()
-            engine.submit(req)
+            for r in reqs:
+                engine.submit(r)
             # submit() validates synchronously — a rejected request gets
             # the same 400 the non-streaming path returns, not a 200
             # stream carrying an error event
-            if req.done.is_set() and req.error:
-                return self._json(400, {"error": req.error})
+            bad = [r for r in reqs if r.done.is_set() and r.error]
+            if bad:
+                for r in reqs:
+                    r.cancel()
+                return self._json(400, {"error": bad[0].error})
             self.send_response(200, "OK")
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -446,8 +456,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             try:
                 while time.monotonic() < deadline:
                     try:
-                        tok, lp, top = q.get(timeout=0.1)
+                        k, tok, lp, top = q.get(timeout=0.1)
                         ev = {"token": tok}
+                        if n > 1:
+                            ev["index"] = k
                         if lp is not None:
                             ev["logprob"] = lp
                             ev["top_logprobs"] = [
@@ -456,28 +468,36 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                         chunk(json.dumps(ev))
                         sent += 1
                     except queue.Empty:
-                        if req.done.is_set() and q.empty():
+                        if all(r.done.is_set() for r in reqs) and q.empty():
                             break
-                if not req.done.is_set():
+                timed_out = not all(r.done.is_set() for r in reqs)
+                if timed_out:
                     # timed out mid-generation: tell the client the truth
-                    # (no clean [DONE]) and cancel engine-side so the slot
-                    # and its KV pages come back at the next chunk boundary
-                    req.cancel()
-                    SERVE_REQUESTS.inc("timeout")
+                    # (no clean [DONE]) and cancel engine-side so slots
+                    # and KV pages come back at the next chunk boundary
+                    for r in reqs:
+                        r.cancel()
+                    SERVE_REQUESTS.inc("timeout", value=float(n))
                     chunk(json.dumps({"error": "generation timed out"}))
-                elif req.error:
-                    SERVE_REQUESTS.inc("error")
-                    chunk(json.dumps({"error": req.error}))
                 else:
-                    SERVE_REQUESTS.inc("ok")
+                    for k, r in enumerate(reqs):
+                        if r.error:
+                            SERVE_REQUESTS.inc("error")
+                            ev = {"error": r.error}
+                            if n > 1:
+                                ev["index"] = k
+                            chunk(json.dumps(ev))
+                        else:
+                            SERVE_REQUESTS.inc("ok")
                 chunk("[DONE]")
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
                 # dead client: stop generating for it — the engine checks
                 # the cancel flag at every chunk boundary
-                req.cancel()
-                SERVE_REQUESTS.inc("cancelled")
+                for r in reqs:
+                    r.cancel()
+                SERVE_REQUESTS.inc("cancelled", value=float(n))
                 log.info("stream client disconnected after %d tokens", sent)
             finally:
                 SERVE_LATENCY.observe(value=time.monotonic() - t0)

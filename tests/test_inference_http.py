@@ -271,16 +271,32 @@ def test_n_parallel_completions(served):
         "temperature": 0.9, "seed": 7,
     })
     assert out2["choices"] == out["choices"]
-    # n validation + stream exclusion
+    # n validation
     code, _ = _post(addr, "/v1/completions",
                     {"prompt": [5], "max_tokens": 2, "n": 0})
     assert code == 400
     code, _ = _post(addr, "/v1/completions",
                     {"prompt": [5], "max_tokens": 2, "n": 99})
     assert code == 400
-    code, _ = _post(addr, "/v1/completions",
-                    {"prompt": [5], "max_tokens": 2, "n": 2, "stream": True})
-    assert code == 400
+    # n>1 streaming: every event carries its choice index; per-choice
+    # tokens reassemble to exactly the blocking response's choices
+    conn = http.client.HTTPConnection(*addr, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [5, 17, 3], "max_tokens": 6,
+                             "n": 2, "temperature": 0.9, "seed": 7,
+                             "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    events = [json.loads(raw[len("data: "):])
+              for raw in resp.read().decode().split("\n\n")
+              if raw.startswith("data: ") and "[DONE]" not in raw]
+    conn.close()
+    by_idx = {0: [], 1: []}
+    for ev in events:
+        by_idx[ev["index"]].append(ev["token"])
+    assert by_idx[0] == out["choices"][0]["tokens"][:6]
+    assert by_idx[1] == out["choices"][1]["tokens"][:6]
 
 
 def test_serving_prometheus_metrics(served):
