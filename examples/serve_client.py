@@ -124,7 +124,17 @@ def main():
                       "allowed_tokens": choices})
     print("\nconstrained answer:", decode(out["tokens"]))
 
-    # 5. streaming with repetition penalties
+    # 5. indexed streaming: n choices interleave on one SSE stream
+    print("\nstreaming n=2 (indexed events):")
+    parts = {0: [], 1: []}
+    for ev in stream(base, {"prompt": ids, "max_tokens": 8, "n": 2,
+                            "temperature": 0.9, "seed": 7}):
+        if "error" not in ev:
+            parts[ev["index"]].append(ev["token"])
+    for k in (0, 1):
+        print(f"  [{k}]", decode(parts[k]))
+
+    # 6. streaming with repetition penalties
     print("\nstreaming (frequency_penalty=0.8): ", end="", flush=True)
     for ev in stream(base, {"prompt": ids, "max_tokens": 24,
                             "temperature": 0.7, "seed": 1,
