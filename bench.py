@@ -354,6 +354,7 @@ def tpu_section_table():
         "longserve": int(
             os.environ.get("BENCH_SECTION_TIMEOUT_LONGSERVE", "900")
         ),
+        "ttft": int(os.environ.get("BENCH_SECTION_TIMEOUT_TTFT", "900")),
     }
 
 
@@ -494,6 +495,23 @@ def _section_env():
     return jax, allow_cpu
 
 
+def _bench_cfg(allow_cpu: bool):
+    """The ONE bench model shape (toy on CPU, flagship-bench on TPU) —
+    shared by the model/serve/longserve/ttft sections so they cannot
+    silently benchmark different models."""
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+    )
+
+    return TransformerConfig(
+        vocab_size=512 if allow_cpu else 32000,
+        d_model=128 if allow_cpu else 1024,
+        n_layers=2 if allow_cpu else 8,
+        n_heads=8, d_ff=256 if allow_cpu else 2752,
+        dtype="bfloat16",
+    )
+
+
 def _dispatch_floor_ms(jax, jnp, shape, V, iters=20):
     """Host→device dispatch floor: the same chained-iteration pattern on a
     trivial function — subtracted from every measured per-iter wall."""
@@ -525,7 +543,6 @@ def _tpu_section_model():
         make_optimizer,
     )
     from elastic_gpu_scheduler_tpu.models.transformer import (
-        TransformerConfig,
         forward,
         init_params,
         param_count,
@@ -535,13 +552,9 @@ def _tpu_section_model():
     # floor (the flagship default is test-sized; MFU on it would measure
     # the relay, not the chip)
     B, S = (2, 128) if allow_cpu else (8, 2048)
-    cfg = TransformerConfig(
-        vocab_size=512 if allow_cpu else 32000,
-        d_model=128 if allow_cpu else 1024,
-        n_layers=2 if allow_cpu else 8,
-        n_heads=8, d_ff=256 if allow_cpu else 2752,
-        dtype="bfloat16",  # bf16 at rest + fp32 masters (models/train.py)
-    )  # head_dim 128 = MXU-native (measured ~2x attention speedup vs 64)
+    # bf16 at rest + fp32 masters (models/train.py); head_dim 128 =
+    # MXU-native (measured ~2x attention speedup vs 64)
+    cfg = _bench_cfg(allow_cpu)
     V = cfg.vocab_size
     params = init_params(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (B, S), 0, V)
@@ -670,17 +683,10 @@ def _tpu_section_serve():
         Request,
     )
     from elastic_gpu_scheduler_tpu.models.transformer import (
-        TransformerConfig,
         init_params,
     )
 
-    cfg = TransformerConfig(
-        vocab_size=512 if allow_cpu else 32000,
-        d_model=128 if allow_cpu else 1024,
-        n_layers=2 if allow_cpu else 8,
-        n_heads=8, d_ff=256 if allow_cpu else 2752,
-        dtype="bfloat16",
-    )
+    cfg = _bench_cfg(allow_cpu)
     V = cfg.vocab_size
     params = init_params(jax.random.key(0), cfg)
 
@@ -793,17 +799,10 @@ def _tpu_section_longserve():
         Request,
     )
     from elastic_gpu_scheduler_tpu.models.transformer import (
-        TransformerConfig,
         init_params,
     )
 
-    cfg = TransformerConfig(
-        vocab_size=512 if allow_cpu else 32000,
-        d_model=128 if allow_cpu else 1024,
-        n_layers=2 if allow_cpu else 8,
-        n_heads=8, d_ff=256 if allow_cpu else 2752,
-        dtype="bfloat16",
-    )
+    cfg = _bench_cfg(allow_cpu)
     V = cfg.vocab_size
     params = init_params(jax.random.key(0), cfg)
     B = 2 if allow_cpu else 4
@@ -859,6 +858,97 @@ def _tpu_section_longserve():
             kernel_tps / max(gather_tps, 1e-9), 2
         ),
     }
+
+
+def _tpu_section_ttft():
+    """Time-to-first-token under STAGGERED arrivals through the
+    continuous-batching loop (EngineLoop) — the latency a client actually
+    feels: queue wait + admission prefill, while other requests decode.
+    Chunked prefill keeps long admissions from blocking the batch."""
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+    from elastic_gpu_scheduler_tpu.server.inference import EngineLoop
+
+    cfg = _bench_cfg(allow_cpu)
+    V = cfg.vocab_size
+    params = init_params(jax.random.key(0), cfg)
+    eng = InferenceEngine(
+        cfg=cfg, params=params, max_batch=8,
+        max_len=256 if allow_cpu else 1024,
+        page_size=16 if allow_cpu else 64,
+        fused_steps=4 if allow_cpu else 8,
+        prefill_chunk=64 if allow_cpu else 512,
+    )
+    loop = EngineLoop(eng).start()
+    try:
+        import numpy as _np
+
+        n_req = 6 if allow_cpu else 24
+        gap_s = 0.02 if allow_cpu else 0.03
+        lens = [(24 if allow_cpu else 256) + 17 * (i % 5)
+                for i in range(n_req)]
+        prompts = [
+            _np.random.default_rng(i).integers(1, V, L).tolist()
+            for i, L in enumerate(lens)
+        ]
+
+        def make_req(toks):
+            t_submit = _time.perf_counter()
+            state = {"first": None}
+
+            def on_token(_tok):
+                if state["first"] is None:
+                    state["first"] = _time.perf_counter() - t_submit
+
+            r = Request(prompt=list(toks),
+                        max_new_tokens=8 if allow_cpu else 32,
+                        on_token=on_token)
+            return r, state
+
+        # warm-up: pay the prefill-bucket compiles for EVERY distinct
+        # power-of-two pad bucket the timed lens will hit — otherwise the
+        # first timed request in each bucket reports compile time as TTFT
+        def bucket(n):
+            b = 8
+            while b < n:
+                b *= 2
+            return b
+
+        for L in sorted({bucket(x) for x in lens}):
+            w, _s = make_req(prompts[0][:1] * min(L, max(lens)))
+            eng.submit(w)
+            assert w.done.wait(600), "warm-up stalled"
+            assert not w.error, w.error
+
+        pairs = []
+        t0 = _time.perf_counter()
+        for toks in prompts:
+            r, st = make_req(toks)
+            eng.submit(r)
+            pairs.append((r, st))
+            _time.sleep(gap_s)
+        for r, _st in pairs:
+            assert r.done.wait(600), "request never finished"
+            assert not r.error, r.error
+        wall = _time.perf_counter() - t0
+        ttfts = sorted(st["first"] for _r, st in pairs)
+        n_tok = sum(len(r.output) for r, _ in pairs)
+        return {
+            "tpu_ttft_requests": n_req,
+            "tpu_ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1000, 1),
+            # the sample MAX, honestly named (24 samples have no p99)
+            "tpu_ttft_max_ms": round(ttfts[-1] * 1000, 1),
+            "tpu_ttft_gen_tokens_per_s": round(n_tok / wall, 1),
+        }
+    finally:
+        loop.stop()
 
 
 def _tpu_section_model1b():
@@ -1069,6 +1159,7 @@ _TPU_SECTIONS = {
     "flash32k": _tpu_section_flash32k,
     "pagedattn": _tpu_section_pagedattn,
     "longserve": _tpu_section_longserve,
+    "ttft": _tpu_section_ttft,
 }
 
 
