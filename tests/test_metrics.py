@@ -1,0 +1,69 @@
+"""Metrics primitives: TimedLock wait accounting and Histogram summary
+exactness — the properties the /metrics and /debug/pprof/mutex surfaces
+depend on, pinned directly.
+"""
+
+import threading
+import time
+
+from elastic_gpu_scheduler_tpu.metrics import (
+    LOCK_WAIT,
+    Histogram,
+    TimedLock,
+)
+
+
+def test_timedlock_reentrant_acquires_sample_once():
+    """Only the top-level acquisition samples: re-entrant re-acquires by
+    the holder wait 0 by definition and must not flood the histogram
+    with ~0s entries that mask real contention."""
+    lock = TimedLock("t-reentrant", reentrant=True)
+    before = len(LOCK_WAIT.samples("t-reentrant"))
+    with lock:
+        with lock:
+            with lock:
+                pass
+    assert len(LOCK_WAIT.samples("t-reentrant")) == before + 1
+
+
+def test_timedlock_failed_acquire_not_sampled():
+    """A timeout/non-blocking miss is not a wait that ended in the lock."""
+    lock = TimedLock("t-miss")
+    lock.acquire()
+    n0 = len(LOCK_WAIT.samples("t-miss"))  # the successful acquire
+    t = threading.Thread(target=lambda: lock.acquire(blocking=False))
+    t.start()
+    t.join()
+    assert len(LOCK_WAIT.samples("t-miss")) == n0
+    lock.release()
+
+
+def test_timedlock_measures_contended_wait():
+    lock = TimedLock("t-contend")
+    lock.acquire()
+
+    def worker():
+        with lock:
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    lock.release()
+    t.join()
+    assert max(LOCK_WAIT.samples("t-contend")) >= 0.04
+
+
+def test_histogram_summary_exact_counts_after_sample_trim():
+    """summary() reads the authoritative count/sum counters — the trimmed
+    retained-sample buffer must never understate acquisitions (the
+    /debug/pprof/mutex exactness property)."""
+    h = Histogram("trim_test", "t", ("l",))
+    n = 12_000  # past the 10k retention cap → buffer halves at least once
+    for _ in range(n):
+        h.observe("x", value=0.001)
+    assert len(h.samples("x")) < n  # the buffer really did trim
+    s = h.summary()["x"]
+    assert s["acquisitions"] == n
+    assert abs(s["wait_total_s"] - n * 0.001) < 1e-6
+    assert s["wait_p50_s"] == 0.001 and s["wait_max_s"] == 0.001
