@@ -41,13 +41,16 @@ def test_timedlock_failed_acquire_not_sampled():
 def test_timedlock_measures_contended_wait():
     lock = TimedLock("t-contend")
     lock.acquire()
+    entering = threading.Event()
 
     def worker():
+        entering.set()  # about to block on acquire()
         with lock:
             pass
 
     t = threading.Thread(target=worker)
     t.start()
+    assert entering.wait(5.0)
     time.sleep(0.05)
     lock.release()
     t.join()
