@@ -253,6 +253,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
         def do_GET(self):
             if self.path == "/healthz":
                 return self._json(200, {"ok": True})
+            if self.path == "/version":
+                from .. import __version__
+
+                return self._json(200, {"version": __version__})
             if self.path == "/metrics":
                 data = REGISTRY.expose().encode()
                 self.send_response(200, "OK")

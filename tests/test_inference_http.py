@@ -105,6 +105,8 @@ def test_validation_and_routes(served):
     assert code == 404
     code, body = _get(addr, "/healthz")
     assert code == 200 and body["ok"]
+    code, body = _get(addr, "/version")
+    assert code == 200 and body["version"]
 
 
 def test_stream_validation_returns_400(served):
