@@ -9,6 +9,7 @@ network surface a framework user expects:
     GET  /v1/stats         → engine state (slots, pages, prefix hits,
                              registered adapters)
     GET  /healthz          → liveness
+    GET  /version          → build version (scheduler-plane parity)
 
 Design notes (mirrors server/routes.py conventions — stdlib HTTP only):
 
@@ -38,6 +39,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .. import __version__
 from ..metrics import REGISTRY, Counter, Histogram
 from ..models.serving import InferenceEngine, Request
 from .routes import _REASONS
@@ -254,8 +256,6 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if self.path == "/healthz":
                 return self._json(200, {"ok": True})
             if self.path == "/version":
-                from .. import __version__
-
                 return self._json(200, {"version": __version__})
             if self.path == "/metrics":
                 data = REGISTRY.expose().encode()
