@@ -20,9 +20,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+try:
+    import jax
+except ImportError:  # smoke tier (scheduler plane) needs no jax at all
+    jax = None
+else:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
