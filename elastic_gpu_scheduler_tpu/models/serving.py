@@ -154,9 +154,12 @@ class Request:
     # semantics.
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
-    # stop tokens are ignored until this many tokens have been emitted
-    # (vLLM's min_tokens): a stop id sampled early is kept and generation
-    # continues; max_new_tokens still caps the total.
+    # stop ids CANNOT be sampled until this many tokens have been
+    # emitted (vLLM's min_tokens: their logits sit at -1e9 while the
+    # emitted count is below the floor, in every sampling distribution —
+    # fused chunks, verify pass, admission prefill — so clients never
+    # see a stop id embedded mid-completion and the penalty counts never
+    # include one); max_new_tokens still caps the total.
     min_tokens: int = 0
     # per-request sampling seed: draws key off fold_in(key(seed),
     # position) — reproducible across batch composition, slot placement,
@@ -738,6 +741,19 @@ def _bias_row(req: "Request", vocab_size: int) -> np.ndarray:
     return row
 
 
+def _stop_row(req: "Request", vocab_size: int) -> np.ndarray:
+    """The min_tokens suppression row: -1e9 at the request's stop ids,
+    added to every sampling distribution while the emitted count is
+    below the floor (vLLM semantics — a stop id can never be generated
+    pre-floor).  Out-of-range ids are skipped: they can never be sampled
+    anyway, and the host-side ``_stops`` check still honors them."""
+    row = np.zeros(vocab_size, np.float32)
+    ids = [t for t in req.stop_tokens if 0 <= t < vocab_size]
+    if ids:
+        row[np.asarray(ids, np.int64)] = -1e9
+    return row
+
+
 def _row_sample_keys(seed_keys, seeded, positions, sub):
     """(B,) per-row sampling keys: seeded rows key off
     fold_in(key(seed), position) — deterministic per request and
@@ -771,9 +787,9 @@ def _fused_serve_chunk(
     params, kv, tables, tokens, lengths, active,
     prompts, prompt_lens, temps, top_ks, top_ps, key,
     bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
-    seed_keys=None, seeded=None,
+    seed_keys=None, seeded=None, stop_rows=None, min_toks=None,
     *, cfg, page_size, n_steps, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0, use_pen=False, use_seed=False,
+    logprobs_k=0, use_pen=False, use_seed=False, use_min=False,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
     feeding happen on-device.  Returns (sampled (B, n_steps), new caches);
@@ -807,6 +823,15 @@ def _fused_serve_chunk(
             # per-slot additive logit bias (zero rows are a bitwise
             # no-op, so non-biased slots/batches are unaffected)
             logits = logits + bias
+        if use_min:
+            # min_tokens (vLLM): this step samples the token at global
+            # position lengths+1, whose emitted index is
+            # lengths+1-prompt_lens; while that index is below the
+            # slot's floor, stop ids sit at -1e9.  Exact mid-chunk: the
+            # gate is per scan step, so a chunk spanning the floor
+            # suppresses only its pre-floor positions.
+            pre = (lengths + 1 - prompt_lens) < min_toks
+            logits = logits + jnp.where(pre[:, None], stop_rows, 0.0)
         if use_pen:
             # count the token FED this step iff it is a GENERATED one
             # (position `lengths` ≥ prompt length — prompt tokens never
@@ -909,9 +934,9 @@ def _fused_verify_chunk(
     params, kv, tables, feed, lengths, active,
     temps, top_ks, top_ps, key,
     bank=None, aids=None, bias=None, fpens=None, ppens=None, counts=None,
-    plens=None, seed_keys=None, seeded=None,
+    plens=None, seed_keys=None, seeded=None, stop_rows=None, min_toks=None,
     *, cfg, page_size, use_filters, paged_kernel=False, mesh=None,
-    logprobs_k=0, use_pen=False, use_seed=False,
+    logprobs_k=0, use_pen=False, use_seed=False, use_min=False,
 ):
     """ONE wide pass over every slot's verify window (speculative decoding
     inside the paged engine — VERDICT r2 #2).
@@ -974,6 +999,15 @@ def _fused_verify_chunk(
     logits = (x @ wmat(params["unembed"], dtype)).astype(jnp.float32)
     if bias is not None:
         logits = logits + bias[:, None, :]  # per-slot additive logit bias
+    if use_min:
+        # min_tokens (vLLM): window position j's pick is the token for
+        # global position lengths+j+1, emitted index positions+1-plens;
+        # suppress stop ids wherever that index is below the floor
+        # (``plens`` is passed whenever use_min, independent of use_pen)
+        pre = (positions + 1 - plens[:, None]) < min_toks[:, None]
+        logits = logits + jnp.where(
+            pre[..., None], stop_rows[:, None, :], 0.0
+        )
     if use_pen:
         # window position j's generated-so-far counts = ``counts``
         # (generated tokens at positions < lengths) plus the GENERATED
@@ -1244,6 +1278,15 @@ class InferenceEngine:
             (max_batch, cfg.vocab_size), jnp.float32
         )
         self._bias_set = np.zeros(max_batch, bool)
+        # min_tokens stop suppression: per-slot -1e9 rows at stop ids,
+        # device-resident like the bias rows; the use_min chunk variant
+        # gates them per scan position so the floor is exact even when a
+        # chunk spans it.  Both the variant's compile AND the (B, V)
+        # buffer are lazy — a deployment that never combines stop_tokens
+        # with min_tokens > 0 pays neither the compile nor the HBM.
+        self._stop_dev = None
+        self._stop_set = np.zeros(max_batch, bool)
+        self.min_toks = np.zeros(max_batch, np.int32)
         self.freq_pens = np.zeros(max_batch, np.float32)
         self.pres_pens = np.zeros(max_batch, np.float32)
         # per-request sampling seeds: typed key per slot + a host-side
@@ -1266,7 +1309,7 @@ class InferenceEngine:
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
         self._chunks = {
-            (use_filters, want_lp, use_pen, use_seed): jax.jit(
+            (use_filters, want_lp, use_pen, use_seed, use_min): jax.jit(
                 functools.partial(
                     _fused_serve_chunk,
                     cfg=cfg,
@@ -1278,6 +1321,7 @@ class InferenceEngine:
                     logprobs_k=self.logprobs_k if want_lp else 0,
                     use_pen=use_pen,
                     use_seed=use_seed,
+                    use_min=use_min,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
@@ -1285,6 +1329,7 @@ class InferenceEngine:
             for want_lp in (False, True)
             for use_pen in (False, True)
             for use_seed in (False, True)
+            for use_min in (False, True)
         }
         self.spec_k = max(0, spec_k)
         self.spec_ngram = spec_ngram
@@ -1346,7 +1391,7 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         self._verify_chunks = {
-            (use_filters, want_lp, use_pen, use_seed): jax.jit(
+            (use_filters, want_lp, use_pen, use_seed, use_min): jax.jit(
                 functools.partial(
                     _fused_verify_chunk,
                     cfg=cfg,
@@ -1357,6 +1402,7 @@ class InferenceEngine:
                     logprobs_k=self.logprobs_k if want_lp else 0,
                     use_pen=use_pen,
                     use_seed=use_seed,
+                    use_min=use_min,
                 ),
                 donate_argnums=(1,),  # the kv pool pytree
             )
@@ -1364,6 +1410,7 @@ class InferenceEngine:
             for want_lp in (False, True)
             for use_pen in (False, True)
             for use_seed in (False, True)
+            for use_min in (False, True)
         }
         self._prefill = jax.jit(
             functools.partial(
@@ -1545,6 +1592,16 @@ class InferenceEngine:
                     _bias_row(req, self.cfg.vocab_size)
                 )
                 self._bias_set[i] = True
+            self.min_toks[i] = max(0, req.min_tokens)
+            if req.min_tokens > 0 and req.stop_tokens:
+                if self._stop_dev is None:
+                    self._stop_dev = jnp.zeros(
+                        (self.max_batch, self.cfg.vocab_size), jnp.float32
+                    )
+                self._stop_dev = self._stop_dev.at[i].set(
+                    _stop_row(req, self.cfg.vocab_size)
+                )
+                self._stop_set[i] = True
             self.emitted[i] = 0
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
@@ -1686,6 +1743,14 @@ class InferenceEngine:
                 np.asarray(logits, np.float32)
                 + _bias_row(req, self.cfg.vocab_size)
             )
+        if req.min_tokens > 0 and req.stop_tokens:
+            # the first emission has emitted index 0 < min_tokens, so
+            # the floor suppression always applies here (same row the
+            # fused chunks gate per position)
+            logits = jnp.asarray(
+                np.asarray(logits, np.float32)
+                + _stop_row(req, self.cfg.vocab_size)
+            )
         # penalties: nothing to apply at admission — counts cover
         # GENERATED tokens only, and none exist before the first sample
         if req.temperature > 0:
@@ -1788,6 +1853,7 @@ class InferenceEngine:
         self.prefilling[i] = False
         self._seeded[i] = False
         self._clear_bias(i)
+        self._clear_stop(i)
         if self.draft is not None:
             self.draft_len[i] = 0
 
@@ -1806,6 +1872,7 @@ class InferenceEngine:
         self.prefilling[i] = False
         self._seeded[i] = False
         self._clear_bias(i)
+        self._clear_stop(i)
         if self.draft is not None:
             self.draft_len[i] = 0  # rows rewrite lazily; no device work
 
@@ -1906,6 +1973,25 @@ class InferenceEngine:
         if self._bias_set[i]:
             self._bias_dev = self._bias_dev.at[i].set(0.0)
             self._bias_set[i] = False
+
+    def _clear_stop(self, i: int) -> None:
+        """Zero a released slot's min_tokens suppression row (same
+        only-if-set discipline as the bias rows)."""
+        self.min_toks[i] = 0
+        if self._stop_set[i]:
+            self._stop_dev = self._stop_dev.at[i].set(0.0)
+            self._stop_set[i] = False
+
+    def _min_requested(self, active) -> bool:
+        """Pick the stop-suppressing chunk variant only while some active
+        request with stop tokens is still below its min_tokens floor —
+        once every floor is passed the engine reverts to the cheaper
+        variant on its own."""
+        return any(
+            req is not None and active[i] and req.stop_tokens
+            and self.emitted[i] < req.min_tokens
+            for i, req in enumerate(self.slots)
+        )
 
     @staticmethod
     def _top_list(ids_row, lps_row, n) -> list:
@@ -2018,8 +2104,9 @@ class InferenceEngine:
         want_lp = self._logprobs_requested(active)
         use_pen = self._pens_requested(active)
         use_seed = self._seeds_requested(active)
+        use_min = self._min_requested(active)
         out, self.kv = self._verify_chunks[
-            (use_filters, want_lp, use_pen, use_seed)
+            (use_filters, want_lp, use_pen, use_seed, use_min)
         ](
             self.params,
             self.kv,
@@ -2037,9 +2124,12 @@ class InferenceEngine:
             jnp.asarray(self.freq_pens) if use_pen else None,
             jnp.asarray(self.pres_pens) if use_pen else None,
             jnp.asarray(self._host_counts()) if use_pen else None,
-            jnp.asarray(self.prompt_lens) if use_pen else None,
+            jnp.asarray(self.prompt_lens)
+            if (use_pen or use_min) else None,
             self._seed_keys if use_seed else None,
             jnp.asarray(self._seeded) if use_seed else None,
+            self._stop_dev if use_min else None,
+            jnp.asarray(self.min_toks) if use_min else None,
         )
         if want_lp:
             picked, chosen_lp, top_ids, top_lps = (
@@ -2224,8 +2314,9 @@ class InferenceEngine:
         want_lp = self._logprobs_requested(active)
         use_pen = self._pens_requested(active)
         use_seed = self._seeds_requested(active)
+        use_min = self._min_requested(active)
         out, self.kv = self._chunks[
-            (use_filters, want_lp, use_pen, use_seed)
+            (use_filters, want_lp, use_pen, use_seed, use_min)
         ](
             self.params,
             self.kv,
@@ -2247,6 +2338,8 @@ class InferenceEngine:
             jnp.asarray(self._host_counts()) if use_pen else None,
             self._seed_keys if use_seed else None,
             jnp.asarray(self._seeded) if use_seed else None,
+            self._stop_dev if use_min else None,
+            jnp.asarray(self.min_toks) if use_min else None,
         )
         if want_lp:
             sampled, chosen_lp, top_ids, top_lps = (

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import queue
 import threading
 import time
@@ -180,17 +181,31 @@ def _strict_seed(v):
     return v
 
 
+def _strict_nonneg_int(body: dict, field: str, default: int = 0) -> int:
+    """Non-negative JSON integer: bool is an int subclass, and a float
+    (e.g. 2.9) would silently truncate — both are client bugs deserving
+    a 400, same strictness as _token_ids/seed."""
+    v = body.get(field, default)
+    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+        raise ValueError(f"'{field}' must be a non-negative integer")
+    return v
+
+
+def _strict_finite_number(body: dict, field: str) -> float:
+    """Finite JSON number (int or float, not bool, not NaN/inf) — the
+    engine rejects non-finite penalties anyway; catching it here keeps
+    validation consistent across the endpoint's fields."""
+    v = body.get(field, 0.0)
+    if isinstance(v, bool) or not isinstance(v, (int, float)) \
+            or not math.isfinite(v):
+        raise ValueError(f"'{field}' must be a finite number")
+    return float(v)
+
+
 def _request_from_body(body: dict, vocab_size: int) -> Request:
     prompt = _token_ids(body.get("prompt"), vocab_size, "prompt")
     stop = _token_ids(body.get("stop", []), vocab_size, "stop")
-    logprobs = body.get("logprobs", 0)
-    # same strictness as _token_ids: bool is an int subclass, and a float
-    # would silently truncate — both are client bugs deserving a 400
-    if (
-        not isinstance(logprobs, int) or isinstance(logprobs, bool)
-        or logprobs < 0
-    ):
-        raise ValueError("'logprobs' must be a non-negative integer")
+    logprobs = _strict_nonneg_int(body, "logprobs")
     bias_raw = body.get("logit_bias", {})
     if not isinstance(bias_raw, dict):
         raise ValueError("'logit_bias' must be an object of id -> bias")
@@ -213,9 +228,9 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         stop_tokens=tuple(stop),
         logprobs=logprobs,
         logit_bias=bias,
-        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
-        presence_penalty=float(body.get("presence_penalty", 0.0)),
-        min_tokens=int(body.get("min_tokens", 0)),
+        frequency_penalty=_strict_finite_number(body, "frequency_penalty"),
+        presence_penalty=_strict_finite_number(body, "presence_penalty"),
+        min_tokens=_strict_nonneg_int(body, "min_tokens"),
         seed=_strict_seed(body.get("seed")),
         allowed_tokens=tuple(
             _token_ids(body.get("allowed_tokens", []), vocab_size,
@@ -375,18 +390,35 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             for r in reqs:
                 engine.submit(r)
             timed_out = False
+            cancelled_for_err = False
             for r in reqs:
+                if not cancelled_for_err and any(x.error for x in reqs):
+                    # fail fast: one choice errored (admission rejection
+                    # or an engine-side failure) — cancel its siblings
+                    # instead of letting them generate toward a response
+                    # that is already a 400 (cancel is idempotent and a
+                    # no-op on already-done requests)
+                    cancelled_for_err = True
+                    for s in reqs:
+                        s.cancel()
                 if not r.done.wait(max(0.0, deadline - time.monotonic())):
                     timed_out = True
                     r.cancel()
             acked = {
-                id(r): r.done.wait(10.0) if timed_out else True
+                id(r): r.done.wait(10.0) if (timed_out or cancelled_for_err)
+                else True
                 for r in reqs
             }  # thread-ownership rule: only read output after done
             SERVE_LATENCY.observe(value=time.monotonic() - t0)
             errs = [r.error for r in reqs if r.error]
             if errs:
-                SERVE_REQUESTS.inc("error", value=float(len(reqs)))
+                # only the actually-errored choices count as errors; the
+                # cancelled siblings are exactly that
+                SERVE_REQUESTS.inc("error", value=float(len(errs)))
+                if len(errs) < len(reqs):
+                    SERVE_REQUESTS.inc(
+                        "cancelled", value=float(len(reqs) - len(errs))
+                    )
                 return self._json(400, {"error": errs[0]})
             SERVE_REQUESTS.inc(
                 "timeout" if timed_out else "ok", value=float(len(reqs))

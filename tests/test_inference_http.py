@@ -170,6 +170,16 @@ def test_bad_scalar_fields_return_400(served):
         {"prompt": [1], "top_k": {}},
         {"prompt": [1], "top_p": None},
         {"prompt": [1], "max_tokens": 2, "adapter": None},
+        # strict typing on the same endpoint (ADVICE r4): a float
+        # min_tokens must not silently truncate, penalties must be
+        # finite numbers, bools are not integers
+        {"prompt": [1], "min_tokens": 2.9},
+        {"prompt": [1], "min_tokens": True},
+        {"prompt": [1], "min_tokens": -1},
+        {"prompt": [1], "min_tokens": "3"},
+        {"prompt": [1], "frequency_penalty": "0.5"},
+        {"prompt": [1], "frequency_penalty": float("nan")},
+        {"prompt": [1], "presence_penalty": True},
     ):
         code, out = _post(addr, "/v1/completions", body)
         assert code == 400 and "error" in out, (body, code, out)
@@ -299,6 +309,41 @@ def test_n_parallel_completions(served):
         by_idx[ev["index"]].append(ev["token"])
     assert by_idx[0] == out["choices"][0]["tokens"][:6]
     assert by_idx[1] == out["choices"][1]["tokens"][:6]
+
+
+def test_n_choice_error_cancels_siblings(served, monkeypatch):
+    """ADVICE r4: when one of n choices errors, its siblings are
+    cancelled instead of left generating toward a doomed 400, and only
+    the actually-errored choices count toward the error metric (the
+    siblings count as cancelled)."""
+    addr, engine = served
+    from elastic_gpu_scheduler_tpu.server.inference import SERVE_REQUESTS
+
+    real_submit = engine.submit
+    k = {"n": 0}
+
+    def flaky(req):
+        k["n"] += 1
+        if k["n"] == 2:  # second choice fails engine-side
+            req.error = "injected slot failure"
+            req.done.set()
+            return req
+        return real_submit(req)
+
+    monkeypatch.setattr(engine, "submit", flaky)
+    err0 = SERVE_REQUESTS._values.get(("error",), 0.0)
+    can0 = SERVE_REQUESTS._values.get(("cancelled",), 0.0)
+    code, out = _post(addr, "/v1/completions", {
+        "prompt": [5, 17, 3], "max_tokens": 40, "n": 2,
+    })
+    assert code == 400 and "injected" in out["error"]
+    assert SERVE_REQUESTS._values.get(("error",), 0.0) == err0 + 1
+    assert SERVE_REQUESTS._values.get(("cancelled",), 0.0) == can0 + 1
+    # the cancelled siblings are fully released: the engine accepts and
+    # completes a fresh request afterwards
+    code, out = _post(addr, "/v1/completions",
+                      {"prompt": [5], "max_tokens": 3})
+    assert code == 200 and len(out["tokens"]) == 3
 
 
 def test_serving_prometheus_metrics(served):

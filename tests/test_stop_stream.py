@@ -126,13 +126,15 @@ def test_generate_eos_masks_tail():
 
 
 def test_min_tokens_suppresses_early_stop():
-    """A stop id emitted before min_tokens is kept and generation
-    continues (vLLM min_tokens); the same id past the floor stops."""
+    """vLLM min_tokens semantics: a stop id CANNOT be sampled before the
+    floor (its logit sits at -1e9 in every pre-floor distribution), so
+    clients never see stop ids embedded mid-completion; past the floor
+    the first occurrence stops generation and is kept (HF-style)."""
     params = init_params(jax.random.key(0), CFG)
     eng = InferenceEngine(params, CFG, max_batch=1, max_len=64, page_size=8)
     base = eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=12))
     eng.run_until_idle()
-    stop = base.output[2]  # appears at emission index 2 (< floor)
+    stop = base.output[2]  # greedy pick at emission index 2 (< floor)
     floor = InferenceEngine(
         init_params(jax.random.key(0), CFG), CFG, max_batch=1, max_len=64,
         page_size=8,
@@ -141,9 +143,61 @@ def test_min_tokens_suppresses_early_stop():
                              stop_tokens=(stop,), min_tokens=6))
     floor.run_until_idle()
     assert not r.error
-    assert len(r.output) >= 6  # early stop id did not end generation
-    assert r.output[2] == stop  # ...and was kept in the output
+    assert len(r.output) >= 6  # the floor was honored
+    # the stop id never appears before the floor — suppressed, not kept
+    assert stop not in r.output[:6]
     # past the floor, the first occurrence (if any) stops generation
-    later = [k for k, t in enumerate(r.output) if t == stop and k >= 5]
+    later = [k for k, t in enumerate(r.output) if t == stop and k >= 6]
     if later:
         assert later[0] == len(r.output) - 1  # stopped right there
+
+
+def test_min_tokens_suppression_exact_mid_chunk():
+    """The floor gate is per scan position: with fused_steps wider than
+    the floor, one chunk spans the boundary and must suppress only its
+    pre-floor positions.  Cross-check against a fused_steps=1 engine —
+    token streams must be identical (same params, greedy)."""
+    params = init_params(jax.random.key(0), CFG)
+    probe = InferenceEngine(params, CFG, max_batch=1, max_len=64,
+                            page_size=8)
+    base = probe.submit(Request(prompt=[5, 11], max_new_tokens=10))
+    probe.run_until_idle()
+    stop = base.output[1]
+    outs = []
+    for steps in (1, 8):
+        eng = InferenceEngine(
+            init_params(jax.random.key(0), CFG), CFG, max_batch=1,
+            max_len=64, page_size=8, fused_steps=steps,
+        )
+        r = eng.submit(Request(prompt=[5, 11], max_new_tokens=10,
+                               stop_tokens=(stop,), min_tokens=4))
+        eng.run_until_idle()
+        assert not r.error
+        assert stop not in r.output[:4]
+        outs.append(list(r.output))
+    assert outs[0] == outs[1]
+
+
+def test_min_tokens_suppression_under_speculation():
+    """The verify pass applies the same positional floor gate as the
+    sequential chunks, so a speculative engine stays token-identical to
+    the sequential engine under min_tokens (greedy)."""
+    params = init_params(jax.random.key(0), CFG)
+    probe = InferenceEngine(params, CFG, max_batch=1, max_len=64,
+                            page_size=8)
+    base = probe.submit(Request(prompt=[3, 9, 14], max_new_tokens=12))
+    probe.run_until_idle()
+    stop = base.output[2]
+    outs = []
+    for kw in ({}, {"spec_k": 3}):
+        eng = InferenceEngine(
+            init_params(jax.random.key(0), CFG), CFG, max_batch=1,
+            max_len=64, page_size=8, **kw,
+        )
+        r = eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=12,
+                               stop_tokens=(stop,), min_tokens=6))
+        eng.run_until_idle()
+        assert not r.error
+        assert stop not in r.output[:6]
+        outs.append(list(r.output))
+    assert outs[0] == outs[1]
