@@ -1,18 +1,35 @@
-# Two-stage build (reference: Dockerfile:1-18 uses golang → debian-slim; here
-# the runtime is Python + grpc; protobuf messages are pre-generated in-tree).
-# g++ is included so core/native.py can build the C++ placement extension at
-# startup; numpy is a hard dependency of the topology core.
+# Two-stage build (reference: Dockerfile:1-18 uses golang → debian-slim).
+# Two runtime images from one file:
+#   scheduler (default) — extender + controller + device plugin; no JAX.
+#       docker build --target scheduler -t tpu-elastic-scheduler:latest .
+#   workload — inference server / training launcher; adds the pinned JAX
+#       stack so `python -m elastic_gpu_scheduler_tpu.serve` can import.
+#       docker build --target workload -t tpu-elastic-inference:latest .
+# Dependencies are pinned via requirements*.txt (the go.mod/go.sum
+# analogue) so builds are reproducible.
+# g++ is included so core/native.py can build the C++ placement extension
+# at startup; numpy is a hard dependency of the topology core.
 FROM python:3.12-slim AS base
 
 RUN apt-get update \
     && apt-get install -y --no-install-recommends g++ \
-    && rm -rf /var/lib/apt/lists/* \
-    && pip install --no-cache-dir grpcio protobuf numpy
+    && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app
+COPY requirements.txt requirements-workload.txt ./
+
+FROM base AS scheduler
+RUN pip install --no-cache-dir -r requirements.txt
 COPY elastic_gpu_scheduler_tpu/ elastic_gpu_scheduler_tpu/
 COPY native/ native/
 COPY bench.py ./
-
 EXPOSE 39999
 ENTRYPOINT ["python", "-m", "elastic_gpu_scheduler_tpu.cli"]
+
+FROM base AS workload
+RUN pip install --no-cache-dir -r requirements-workload.txt
+COPY elastic_gpu_scheduler_tpu/ elastic_gpu_scheduler_tpu/
+COPY native/ native/
+COPY bench.py ./
+EXPOSE 8000
+ENTRYPOINT ["python", "-m", "elastic_gpu_scheduler_tpu.serve"]

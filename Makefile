@@ -41,8 +41,15 @@ tpu-validate-bg:
 proto:
 	cd elastic_gpu_scheduler_tpu/deviceplugin && protoc --python_out=. deviceplugin.proto
 
+# Both image targets also tag :latest — the deploy manifests reference the
+# :latest tags, so a bare `make image image-workload && kubectl apply` works.
 image:
-	docker build -t $(IMAGE) .
+	docker build --target scheduler -t $(IMAGE) \
+		-t tpu-elastic-scheduler:latest .
+
+image-workload:
+	docker build --target workload -t tpu-elastic-inference:$(TAG) \
+		-t tpu-elastic-inference:latest .
 
 run-fake:
 	python -m elastic_gpu_scheduler_tpu.cli --fake-nodes 4 --priority ici-locality

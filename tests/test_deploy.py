@@ -1,0 +1,343 @@
+"""Deploy-path coverage (VERDICT r4 Next #2): the shipped manifests must
+actually deploy the shipped code.
+
+Two invariants, both derived from the artifacts rather than asserted by
+hand where possible:
+
+1. RBAC coverage — every Kubernetes API call the code paths deployed by
+   ``deploy/tpu-elastic-scheduler.yaml`` make (k8s/client.py RestClientset,
+   scheduler/leader.py lease election) is granted by the manifest's
+   ClusterRole.  The reference grants its binary everything it calls
+   (reference deploy/elastic-gpu-scheduler.yaml:7-45); round 4 shipped
+   --leader-elect without coordination.k8s.io/leases and would have
+   failed RBAC on first real deploy.
+
+2. Image/entrypoint import closure — each manifest container's Python
+   entrypoint module must be importable from the image it runs in: the
+   transitive module-level third-party imports of the entrypoint (walked
+   over the package's import graph with ast) must be covered by the pip
+   pins the Dockerfile stage installs.  Round 4 shipped
+   ``python -m elastic_gpu_scheduler_tpu.serve`` on an image without jax.
+"""
+
+import ast
+import os
+import re
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "elastic_gpu_scheduler_tpu"
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+# -- 1. RBAC coverage ---------------------------------------------------------
+
+# Every (apiGroup, resource, verb) the deployed scheduler code calls.
+# Derived from the REST surface: k8s/client.py RestClientset (pods
+# get/list/update, pods watch via RestClusterView._watch_loop, binding
+# create, nodes get/list, events create) and scheduler/leader.py through
+# get/create/update_lease.  Update this table when the client grows a verb.
+NEEDED = [
+    ("", "pods", "get"),        # client.py get_pod
+    ("", "pods", "list"),       # client.py list_pods
+    ("", "pods", "watch"),      # client.py _watch_loop (?watch=true)
+    ("", "pods", "update"),     # client.py update_pod (PUT)
+    ("", "pods/binding", "create"),  # client.py bind (POST .../binding)
+    ("", "nodes", "get"),       # client.py get_node
+    ("", "nodes", "list"),      # client.py list_nodes
+    ("", "events", "create"),   # client.py create_event
+    ("coordination.k8s.io", "leases", "get"),     # leader.py acquire
+    ("coordination.k8s.io", "leases", "create"),  # leader.py first acquire
+    ("coordination.k8s.io", "leases", "update"),  # leader.py renew/steal
+]
+
+
+def test_cluster_role_covers_every_api_call():
+    docs = _load_all(os.path.join(DEPLOY, "tpu-elastic-scheduler.yaml"))
+    roles = [d for d in docs if d.get("kind") == "ClusterRole"]
+    assert roles, "manifest must ship a ClusterRole"
+    granted = set()
+    for role in roles:
+        for rule in role.get("rules", []):
+            for g in rule.get("apiGroups", []):
+                for r in rule.get("resources", []):
+                    for v in rule.get("verbs", []):
+                        granted.add((g, r, v))
+    missing = [
+        n for n in NEEDED
+        if n not in granted
+        and (n[0], n[1], "*") not in granted
+        and (n[0], "*", n[2]) not in granted
+        and (n[0], "*", "*") not in granted
+    ]
+    assert not missing, f"ClusterRole missing grants: {missing}"
+    # and the Deployment actually runs under the bound ServiceAccount
+    dep = next(d for d in docs if d.get("kind") == "Deployment")
+    sa = dep["spec"]["template"]["spec"]["serviceAccountName"]
+    binding = next(d for d in docs if d.get("kind") == "ClusterRoleBinding")
+    assert any(
+        s.get("kind") == "ServiceAccount" and s.get("name") == sa
+        for s in binding.get("subjects", [])
+    )
+    assert binding["roleRef"]["name"] in {r["metadata"]["name"] for r in roles}
+
+
+# -- 2. image / entrypoint import closure -------------------------------------
+
+# pip distribution name -> importable top-level module(s)
+DIST_TO_MODULES = {
+    "numpy": {"numpy"},
+    "grpcio": {"grpc"},
+    "protobuf": {"google"},
+    "jax": {"jax"},
+    "jaxlib": {"jaxlib"},
+    "optax": {"optax"},
+    "orbax-checkpoint": {"orbax"},
+}
+
+
+def _parse_requirements(path, seen=None):
+    """Pinned dist names from a requirements file, following -r includes."""
+    seen = seen if seen is not None else set()
+    dists = set()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if line.startswith("-r"):
+                sub = os.path.join(
+                    os.path.dirname(path), line[2:].strip()
+                )
+                if sub not in seen:
+                    seen.add(sub)
+                    dists |= _parse_requirements(sub, seen)
+                continue
+            m = re.match(r"([A-Za-z0-9._-]+)==", line)
+            assert m, f"unpinned requirement {line!r} in {path}"
+            dists.add(m.group(1))
+    return dists
+
+
+def _parse_dockerfile():
+    """stage name -> {"modules": importable third-party modules,
+    "entrypoint": python -m module or None}."""
+    stages = {}
+    cur = None
+    with open(os.path.join(REPO, "Dockerfile")) as f:
+        for raw in f:
+            line = raw.strip()
+            m = re.match(r"FROM\s+\S+\s+AS\s+(\w+)", line, re.I)
+            if m:
+                cur = m.group(1)
+                stages[cur] = {"modules": set(), "entrypoint": None}
+                continue
+            if cur is None:
+                continue
+            m = re.search(r"pip install .*?-r\s+(\S+)", line)
+            if m:
+                reqs = _parse_requirements(os.path.join(REPO, m.group(1)))
+                for d in reqs:
+                    stages[cur]["modules"] |= DIST_TO_MODULES.get(
+                        d, {d.replace("-", "_")}
+                    )
+            m = re.match(r"ENTRYPOINT\s+(\[.*\])", line)
+            if m:
+                cmd = [s.strip('", ') for s in m.group(1)[1:-1].split(",")]
+                if cmd[:2] == ["python", "-m"]:
+                    stages[cur]["entrypoint"] = cmd[2]
+    return stages
+
+
+def _module_file(dotted):
+    rel = dotted.replace(".", os.sep)
+    for cand in (
+        os.path.join(REPO, rel + ".py"),
+        os.path.join(REPO, rel, "__init__.py"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _third_party_imports(entry_module):
+    """Transitive third-party imports reachable from ``entry_module``
+    through the package's import graph — what must be importable for
+    ``python -m entry_module`` to start and serve.
+
+    In-package edges are followed at ANY depth (entrypoints import their
+    machinery inside main(), e.g. serve.py pulls models.serving there),
+    but third-party names are collected at MODULE level only, so lazy
+    in-function imports of optional deps (transformers, torch,
+    safetensors on the --hf path) stay out of the required set."""
+    stdlib = set(sys.stdlib_module_names)
+    todo, seen, third = [entry_module], set(), set()
+    while todo:
+        mod = todo.pop()
+        if mod in seen:
+            continue
+        seen.add(mod)
+        path = _module_file(mod)
+        if path is None:
+            continue
+        tree = ast.parse(open(path).read())
+        pkg_parts = mod.split(".")[:-1] if not path.endswith(
+            "__init__.py"
+        ) else mod.split(".")
+        module_level = set(tree.body)
+        for node in ast.walk(tree):
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative: resolve against pkg
+                    base = pkg_parts[: len(pkg_parts) - node.level + 1]
+                    stem = ".".join(base + ([node.module]
+                                            if node.module else []))
+                    names = [stem] + [f"{stem}.{a.name}"
+                                      for a in node.names]
+                else:
+                    names = [node.module]
+            for name in names:
+                if not name:
+                    continue
+                top = name.split(".")[0]
+                if top == PKG:
+                    todo.append(name)
+                elif (
+                    node in module_level
+                    and top not in stdlib and top != "__future__"
+                ):
+                    third.add(top)
+    return third
+
+
+def _manifest_entrypoints():
+    """(manifest, image, module) for every container in deploy/ that runs
+    a python module — from an explicit ``command`` or the image's
+    Dockerfile ENTRYPOINT."""
+    stages = _parse_dockerfile()
+    image_to_stage = {
+        "tpu-elastic-scheduler": "scheduler",
+        "tpu-elastic-inference": "workload",
+    }
+    out = []
+    for fn in sorted(os.listdir(DEPLOY)):
+        if not fn.endswith(".yaml"):
+            continue
+        for doc in _load_all(os.path.join(DEPLOY, fn)):
+            tmpl = (doc.get("spec", {}) or {}).get("template", {})
+            spec = tmpl.get("spec", {}) or {}
+            for c in spec.get("containers", []):
+                image = c["image"].split(":")[0]
+                if image not in image_to_stage:
+                    continue
+                stage = image_to_stage[image]
+                cmd = c.get("command")
+                if cmd and cmd[:2] == ["python", "-m"]:
+                    module = cmd[2]
+                elif cmd:
+                    continue  # not a python -m entrypoint
+                else:
+                    module = stages[stage]["entrypoint"]
+                assert module, f"{fn}/{c['name']}: no resolvable entrypoint"
+                out.append((fn, stage, module, stages[stage]["modules"]))
+    return out
+
+
+def test_every_manifest_entrypoint_imports_on_its_image():
+    entries = _manifest_entrypoints()
+    assert len(entries) >= 3, entries  # scheduler, device plugin, serve
+    for fn, stage, module, installed in entries:
+        assert _module_file(module), f"{fn}: module {module} not in repo"
+        need = _third_party_imports(module)
+        missing = need - installed
+        assert not missing, (
+            f"{fn}: entrypoint {module} (image stage {stage!r}) imports "
+            f"{sorted(missing)} which the image does not install"
+        )
+
+
+def test_serve_entrypoint_runs_on_workload_image_only():
+    """The regression that motivated this file: serve needs jax, the
+    scheduler image doesn't ship it, so the inference manifest must run
+    on the workload image."""
+    stages = _parse_dockerfile()
+    assert "jax" in stages["workload"]["modules"]
+    assert "jax" not in stages["scheduler"]["modules"]
+    need = _third_party_imports(f"{PKG}.serve")
+    assert "jax" in need  # transitively, via the engine modules
+    assert not need - stages["workload"]["modules"]
+
+
+def test_requirements_pins_match_installed():
+    """The pins are real: every pinned dist matches the version installed
+    here (this environment is what the pins were taken from).  Scheduler-
+    plane pins are mandatory; workload pins skip gracefully on a
+    scheduler-plane-only box (the smoke tier's contract)."""
+    from importlib import metadata
+
+    for path, mandatory in (
+        ("requirements.txt", True),
+        ("requirements-workload.txt", False),
+    ):
+        with open(os.path.join(REPO, path)) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                m = re.match(r"([A-Za-z0-9._-]+)==(.+)", line)
+                if not m:
+                    continue
+                dist, ver = m.groups()
+                try:
+                    got = metadata.version(dist)
+                except metadata.PackageNotFoundError:
+                    if mandatory:
+                        raise
+                    continue  # jax-less scheduler-plane environment
+                assert got == ver, (dist, ver, got)
+
+
+def test_pyproject_pins_match_requirements():
+    """pyproject's [project.dependencies] + [workload] extra must not
+    drift from the requirements files the images and tests validate."""
+    import tomllib
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        proj = tomllib.load(f)["project"]
+
+    def pins(path):
+        out = {}
+        with open(os.path.join(REPO, path)) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                m = re.match(r"([A-Za-z0-9._-]+)==(.+)", line)
+                if m:
+                    out[m.group(1)] = m.group(2)
+        return out
+
+    def spec_pins(specs):
+        out = {}
+        for s in specs:
+            m = re.match(r"([A-Za-z0-9._-]+)==(.+)", s)
+            assert m, f"unpinned pyproject dependency {s!r}"
+            out[m.group(1)] = m.group(2)
+        return out
+
+    assert spec_pins(proj["dependencies"]) == pins("requirements.txt")
+    # the workload file's own pins = the [workload] extra, and it pulls
+    # the scheduler pins in via -r (so the union can't drift either)
+    assert spec_pins(
+        proj["optional-dependencies"]["workload"]
+    ) == pins("requirements-workload.txt")
+    with open(os.path.join(REPO, "requirements-workload.txt")) as f:
+        assert any(
+            line.strip().startswith("-r") and "requirements.txt" in line
+            for line in f
+        )
