@@ -9,7 +9,28 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Iterable
+
+# Live TimedLocks (weak: engines/tests create many short-lived ones).
+# _DRAIN_LOCK serializes every structural touch of the set and of the
+# per-lock wait buffers' heads (drains, registration, GC flushes, the
+# over-cap trim) — WeakSet iteration is not safe against concurrent adds.
+_TIMED_LOCKS: "weakref.WeakSet" = weakref.WeakSet()
+_DRAIN_LOCK = threading.Lock()
+# Per-lock buffer cap when nothing ever scrapes LOCK_WAIT (the histogram
+# path used to trim retained samples at 10k; the buffers must too).
+_WAITS_CAP = 20000
+
+
+def _flush_orphan(name: str, waits: list) -> None:
+    """weakref.finalize hook: commit a dying TimedLock's buffered waits
+    so counts/sums stay complete for locks that die between scrapes."""
+    with _DRAIN_LOCK:
+        vals = waits[:]
+        waits.clear()
+    if vals:
+        LOCK_WAIT.observe_batch(name, values=vals)
 
 
 class Counter:
@@ -87,6 +108,26 @@ class Histogram:
             self._totals[labels] = self._totals.get(labels, 0) + 1
             samples = self._samples.setdefault(labels, [])
             samples.append(value)
+            if len(samples) > 10000:
+                del samples[: len(samples) // 2]
+
+    def observe_batch(self, *labels: str, values: list) -> None:
+        """Fold many observations in ONE lock acquisition (the lazy
+        TimedLock drain path)."""
+        if not values:
+            return
+        with self._lock:
+            counts = self._counts.setdefault(
+                labels, [0] * len(self.buckets)
+            )
+            for v in values:
+                for i, b in enumerate(self.buckets):
+                    if v <= b:
+                        counts[i] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + sum(values)
+            self._totals[labels] = self._totals.get(labels, 0) + len(values)
+            samples = self._samples.setdefault(labels, [])
+            samples.extend(values)
             if len(samples) > 10000:
                 del samples[: len(samples) // 2]
 
@@ -232,8 +273,42 @@ GANG_COMMIT = REGISTRY.register(
         "(allocate + annotation write + binding; excludes barrier wait)",
     )
 )
+class _LockWaitHistogram(Histogram):
+    """LOCK_WAIT with lazy ingestion: every read API drains the
+    TimedLock wait buffers first.
+
+    Why: observe() inside TimedLock.acquire runs with the instrumented
+    lock ALREADY HELD, so its cost (histogram mutex + bucket loop)
+    extends hold time at exactly the contention point and compounds
+    across every queued waiter — the round-4 cfg5 gang-wall regression
+    (42.9 → 78.5 ms) was precisely this.  Recording is now one
+    GIL-atomic list append on the hot path; bucketing happens here, on
+    the scrape/read path, where stalls are harmless."""
+
+    def _drain(self) -> None:
+        with _DRAIN_LOCK:  # guards WeakSet iteration vs concurrent adds
+            for tl in list(_TIMED_LOCKS):
+                tl._drain_locked(self)
+
+    def samples(self, *labels: str) -> list:
+        self._drain()
+        return super().samples(*labels)
+
+    def summary(self) -> dict:
+        self._drain()
+        return super().summary()
+
+    def quantile(self, q: float, *labels: str) -> float:
+        self._drain()
+        return super().quantile(q, *labels)
+
+    def collect(self):
+        self._drain()
+        yield from super().collect()
+
+
 LOCK_WAIT = REGISTRY.register(
-    Histogram(
+    _LockWaitHistogram(
         "tpu_scheduler_lock_wait_seconds",
         "Time spent WAITING to acquire the engine-global scheduler lock "
         "and the gang coordinator lock (the mutex/block-profile parity "
@@ -250,7 +325,13 @@ class TimedLock:
     reference's GPUUnitScheduler carries the same design, scheduler.go:44);
     CPU/heap/stack profiling existed here but nothing measured how long
     binds queue on the mutex.  Hold time is deliberately NOT measured —
-    waiters' wait IS holders' hold, and wait is the operative signal."""
+    waiters' wait IS holders' hold, and wait is the operative signal.
+
+    Recording is ONE GIL-atomic list append; the sample is bucketed into
+    LOCK_WAIT lazily, when a reader scrapes.  observe() here would run
+    with the instrumented lock already held, lengthening hold time at
+    exactly the contention point and compounding across queued waiters
+    (the round-4 cfg5 gang-wall regression)."""
 
     def __init__(self, name: str, reentrant: bool = False):
         self._inner = (
@@ -265,6 +346,12 @@ class TimedLock:
         # either way.
         self._owner: int | None = None
         self._depth = 0
+        self._waits: list[float] = []
+        with _DRAIN_LOCK:
+            _TIMED_LOCKS.add(self)
+        # a lock GC'd between scrapes must not drop its buffered waits
+        # (the finalizer closes over the buffer, not the lock)
+        weakref.finalize(self, _flush_orphan, name, self._waits)
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         me = threading.get_ident()
@@ -279,8 +366,28 @@ class TimedLock:
             # waits that ended in the lock — don't pollute the histogram
             self._owner = me
             self._depth = 1
-            LOCK_WAIT.observe(self._name, value=time.perf_counter() - t0)
+            self._waits.append(time.perf_counter() - t0)
+            if len(self._waits) > _WAITS_CAP and _DRAIN_LOCK.acquire(
+                blocking=False
+            ):  # nothing is scraping: trim like the histogram would.
+                # try-acquire keeps the hot path non-blocking; a losing
+                # race just retries at the next over-cap acquire.
+                try:
+                    del self._waits[: _WAITS_CAP // 2]
+                finally:
+                    _DRAIN_LOCK.release()
         return ok
+
+    def _drain_locked(self, hist: Histogram) -> None:
+        """Move buffered waits into the histogram (scrape path; caller
+        holds _DRAIN_LOCK).  Atomic list ops only: concurrent hot-path
+        appends land at the tail and survive the in-place del."""
+        buf = self._waits
+        n = len(buf)
+        if n:
+            vals = buf[:n]
+            del buf[:n]
+            hist.observe_batch(self._name, values=vals)
 
     def release(self) -> None:
         self._depth -= 1
