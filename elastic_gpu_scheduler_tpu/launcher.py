@@ -33,7 +33,13 @@ from .models.train import (
     make_optimizer,
 )
 from .models.transformer import TransformerConfig
-from .parallel.mesh import MeshSpec, coords_from_annotations, mesh_from_allocation
+from .parallel.mesh import (
+    MeshSpec,
+    coords_from_annotations,
+    gang_slices_from_annotations,
+    hierarchical_mesh,
+    mesh_from_allocation,
+)
 
 log = logging.getLogger("tpu-launcher")
 
@@ -92,8 +98,41 @@ def run_job(
         ann[consts.ANNOTATION_CONTAINER_PREFIX + container] = ",".join(
             format_coord(c) for c in coords
         )
-    mesh = mesh_from_allocation(ann, container, spec.mesh, devices=devices)
-    log.info("mesh: %s over %d devices", spec.mesh.sizes, spec.mesh.num_devices)
+    slices = gang_slices_from_annotations(ann)
+    if len(slices) > 1 and spec.mesh.data % len(slices) == 0:
+        # straddling gang (scheduler/gang.py wrote the DCN boundary):
+        # hierarchical mesh — data axis spans slices over DCN, every
+        # other axis stays inside one slice on ICI.  Same device
+        # selection as the flat path: first num_devices of the given
+        # (or all) devices — per-pod coords cover only THIS member's
+        # chips, never the whole gang.
+        devs = list(devices) if devices is not None else list(jax.devices())
+        mesh = hierarchical_mesh(
+            spec.mesh, len(slices), devices=devs[: spec.mesh.num_devices]
+        )
+        log.info(
+            "hierarchical mesh: %s across %d slices (DCN on the data "
+            "axis) over %d devices",
+            spec.mesh.sizes, len(slices), spec.mesh.num_devices,
+        )
+    else:
+        if len(slices) > 1:
+            # a valid placement must still LAUNCH: a spec whose data axis
+            # can't host the DCN boundary (e.g. pure-FSDP data=1) falls
+            # back to the flat mesh — loudly, because its fsdp/tensor
+            # collectives will ride DCN
+            log.warning(
+                "gang spans %d slices but mesh data axis %d is not "
+                "divisible by the slice count; building a FLAT mesh — "
+                "intra-slice collectives will cross the DCN boundary. "
+                "Set MeshSpec(data=k*%d, ...) to get the hierarchical "
+                "layout.",
+                len(slices), spec.mesh.data, len(slices),
+            )
+        mesh = mesh_from_allocation(ann, container, spec.mesh, devices=devices)
+        log.info(
+            "mesh: %s over %d devices", spec.mesh.sizes, spec.mesh.num_devices
+        )
 
     opt = make_optimizer(
         lr=spec.lr,

@@ -116,6 +116,124 @@ def _ici_order(devs: list[jax.Device]) -> list[jax.Device]:
         return devs
 
 
+def _slice_partition(
+    devs: list[jax.Device], n_slices: int
+) -> list[list[jax.Device]]:
+    """Partition devices into per-slice groups.
+
+    Real multi-slice TPU devices expose ``slice_index``; group by it (and
+    ICI-order within each slice).  CPU simulation has no slice attribute:
+    contiguous equal chunks stand in, which preserves the property the
+    hierarchical mesh needs — each group's devices are "ICI-local" to
+    each other and the boundary between groups is the DCN."""
+    by_slice: dict[int, list[jax.Device]] = {}
+    for d in devs:
+        si = getattr(d, "slice_index", None)
+        if si is None:
+            by_slice = {}
+            break
+        by_slice.setdefault(si, []).append(d)
+    if by_slice:
+        if len(by_slice) != n_slices:
+            # the hardware's slice count is authoritative; chunking a
+            # 3-real-slice device list into 2 "slices" would put a DCN
+            # boundary inside an "ICI-local" group — fail loudly instead
+            raise ValueError(
+                f"devices span {len(by_slice)} hardware slices but the "
+                f"gang annotation says {n_slices}; stale placement?"
+            )
+        return [_ici_order(by_slice[k]) for k in sorted(by_slice)]
+    if len(devs) % n_slices:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by {n_slices} slices"
+        )
+    per = len(devs) // n_slices
+    return [devs[i * per : (i + 1) * per] for i in range(n_slices)]
+
+
+def hierarchical_mesh(
+    spec: MeshSpec,
+    n_slices: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh for a gang that STRADDLES the DCN boundary.
+
+    The DATA axis is the outer, slowest-varying axis and spans slices —
+    pure data parallelism's one gradient all-reduce per step is the only
+    collective that can afford DCN latency (the scaling-book recipe).
+    Every other axis (fsdp/expert/pipe/tensor/seq) lays out INSIDE one
+    slice, so param all-gathers, grad reduce-scatters, TP reductions and
+    ring hops all ride ICI.
+
+    Device order is slice-major: with ``data`` leading the axis tuple,
+    the slice boundary falls exactly between data-axis blocks, so XLA's
+    intra-slice collectives get replica groups wholly within a slice and
+    the cross-slice all-reduce pairs same-position devices across slices
+    (test_sharding_collectives.py asserts this on the lowered HLO).
+
+    Requires ``spec.data % n_slices == 0`` and the per-slice device count
+    to equal ``(data // n_slices) × fsdp × expert × pipe × tensor × seq``.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if spec.data % n_slices:
+        raise ValueError(
+            f"data axis {spec.data} must be divisible by {n_slices} "
+            "slices (the DCN boundary lives inside the data axis)"
+        )
+    if len(devs) != spec.num_devices:
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices, have {len(devs)}"
+        )
+    groups = _slice_partition(devs, n_slices)
+    inner = spec.num_devices // spec.data
+    per_slice = (spec.data // n_slices) * inner
+    for g in groups:
+        if len(g) != per_slice:
+            raise ValueError(
+                f"slice group of {len(g)} devices != {per_slice} "
+                "(= data/n_slices × inner axes); the gang placement does "
+                "not tile the mesh spec"
+            )
+    flat = [d for g in groups for d in g]  # slice-major
+    arr = np.array(flat, dtype=object).reshape(
+        spec.data, spec.fsdp, spec.expert, spec.pipe, spec.tensor, spec.seq
+    )
+    return Mesh(arr, AXES)
+
+
+def classify_replica_groups(
+    hlo_text: str, per_slice: int
+) -> tuple[list[list[int]], list[list[int]]]:
+    """Parse every replica group out of compiled HLO and split them into
+    (cross_slice, intra_slice) by whether a group's device ids fall on
+    both sides of the ``per_slice`` boundary.  The hierarchical-mesh
+    evidence check shared by tests/test_sharding_collectives.py and the
+    driver's dryrun config E."""
+    import re
+
+    groups = [
+        [int(x) for x in g.split(",")]
+        for m in re.finditer(r"replica_groups=\{(\{[0-9,{}]+\})\}", hlo_text)
+        for g in re.findall(r"\{([0-9,]+)\}", m.group(1))
+    ]
+    crosses = [g for g in groups if len({d // per_slice for d in g}) > 1]
+    intra = [
+        g for g in groups
+        if len(g) > 1 and len({d // per_slice for d in g}) == 1
+    ]
+    return crosses, intra
+
+
+def gang_slices_from_annotations(annotations: dict[str, str]) -> list[str]:
+    """The ordered slice list a straddling gang's commit wrote (empty for
+    single-slice placements — scheduler/gang.py annotates only when the
+    plan crosses the DCN)."""
+    from ..utils import consts
+
+    raw = annotations.get(consts.ANNOTATION_GANG_SLICES, "")
+    return [s for s in raw.split(",") if s]
+
+
 def coords_from_annotations(
     annotations: dict[str, str], container: str
 ) -> list[Coord]:

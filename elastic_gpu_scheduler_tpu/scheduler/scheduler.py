@@ -438,8 +438,13 @@ class TPUUnitScheduler(ResourceScheduler):
                 na.forget(opt)
             self._update_node_gauge(node_name)
 
-    def gang_annotate(self, pod: Pod, opt: Option, node_name: str) -> Pod:
-        return self._write_annotations(pod, opt, node_name)
+    def gang_annotate(
+        self, pod: Pod, opt: Option, node_name: str, extra=None
+    ) -> Pod:
+        """``extra``: additional annotation keys the gang commit wants on
+        the ledger (the DCN-boundary slice annotations for straddling
+        gangs)."""
+        return self._write_annotations(pod, opt, node_name, extra=extra)
 
     def gang_strip_annotations(self, pod: Pod) -> None:
         """Rollback of ``gang_annotate``: remove the ledger entry so neither
@@ -463,6 +468,8 @@ class TPUUnitScheduler(ResourceScheduler):
                     consts.ANNOTATION_ASSUMED,
                     consts.ANNOTATION_NODE,
                     consts.ANNOTATION_TOPOLOGY,
+                    consts.ANNOTATION_SLICE,
+                    consts.ANNOTATION_GANG_SLICES,
                 ):
                     ann.pop(key, None)
                     removed = True
@@ -532,13 +539,17 @@ class TPUUnitScheduler(ResourceScheduler):
         except Exception:  # events are best-effort
             pass
 
-    def _write_annotations(self, pod: Pod, opt: Option, node_name: str) -> Pod:
+    def _write_annotations(
+        self, pod: Pod, opt: Option, node_name: str, extra=None
+    ) -> Pod:
         """Annotation-ledger write with one optimistic-conflict retry
         (reference: scheduler.go:199-213)."""
         attempts = 2
         cur = pod
         for i in range(attempts):
             cur.metadata.annotations.update(annotations_for_option(opt, node_name))
+            if extra:
+                cur.metadata.annotations.update(extra)
             cur.metadata.labels[consts.ANNOTATION_ASSUMED] = "true"
             try:
                 return self.clientset.update_pod(cur)

@@ -25,6 +25,12 @@ ANNOTATION_TOPOLOGY = "elasticgpu.io/allocated-topology"  # box shape, e.g. "2x2
 # Gang scheduling (net-new vs reference).
 ANNOTATION_GANG_NAME = "elasticgpu.io/gang-name"
 ANNOTATION_GANG_SIZE = "elasticgpu.io/gang-size"  # min members for all-or-nothing
+# DCN boundary (written only when a gang STRADDLES slices — last-resort
+# placement): the member's own slice, and the gang's ordered slice list.
+# The launcher builds a hierarchical mesh from these (outer DCN data axis
+# × inner ICI axes, parallel/mesh.py hierarchical_mesh).
+ANNOTATION_SLICE = "elasticgpu.io/slice"
+ANNOTATION_GANG_SLICES = "elasticgpu.io/gang-slices"  # "sliceA,sliceB,..."
 
 # Node labels describing TPU topology (mirrors GKE's
 # cloud.google.com/gke-tpu-topology convention).

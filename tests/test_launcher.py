@@ -174,3 +174,88 @@ def test_schedule_then_serve_end_to_end():
         return [r.output for r in reqs]
 
     assert run(mesh) == run(None)
+
+
+def test_multislice_gang_launches_hierarchical_mesh():
+    """Config-E end to end (VERDICT r4 #3): a gang forced to straddle two
+    slices is scheduled + bound through the stack, its members' ledgers
+    carry the DCN boundary, and run_job builds the hierarchical mesh
+    (data axis across slices over DCN, fsdp/tensor inside a slice) and
+    trains to finite decreasing loss on 8 virtual devices."""
+    import threading
+
+    from elastic_gpu_scheduler_tpu.k8s.extender import (
+        ExtenderArgs,
+        ExtenderBindingArgs,
+    )
+
+    cluster = FakeCluster()
+    for sname in ["ms-a", "ms-b"]:
+        cluster.add_node(
+            make_tpu_node(
+                f"{sname}-h0", chips=4, hbm_gib=64, accelerator="v5e",
+                slice_topology="2x2", host_topology="2x2", host_offset="0.0",
+                slice_name=sname,
+            )
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority="ici-locality", gang_timeout=5.0,
+    )
+    nodes = [n.metadata.name for n in cluster.list_nodes()]
+    pods = []
+    for i in range(2):
+        p = make_pod(
+            f"ms-{i}",
+            containers=[
+                Container(
+                    name="main",
+                    resources=ResourceRequirements(
+                        limits={consts.RESOURCE_TPU_CORE: 400}
+                    ),
+                )
+            ],
+            annotations={
+                consts.ANNOTATION_GANG_NAME: "msgang",
+                consts.ANNOTATION_GANG_SIZE: "2",
+            },
+        )
+        cluster.create_pod(p)
+        pods.append(p)
+
+    def member(p):
+        filt = predicate.handle(ExtenderArgs(pod=p, node_names=list(nodes)))
+        assert filt.node_names, filt.failed_nodes
+        res = bind.handle(ExtenderBindingArgs(
+            pod_name=p.metadata.name, pod_namespace=p.metadata.namespace,
+            pod_uid=p.metadata.uid, node=filt.node_names[0],
+        ))
+        assert not res.error, res.error
+
+    threads = [threading.Thread(target=member, args=(p,)) for p in pods]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+
+    ann = cluster.get_pod("default", "ms-0").metadata.annotations
+    assert ann[consts.ANNOTATION_GANG_SLICES] == "ms-a,ms-b"
+
+    # the job side: 8 virtual devices standing in for the gang's 2x4
+    # chips; data=2 spans the two slices, fsdp=2 x tensor=2 stay inside
+    spec = JobSpec(
+        model=TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            dtype="float32",
+        ),
+        mesh=MeshSpec(data=2, fsdp=2, tensor=2),
+        steps=4,
+        batch_size=8,
+        seq_len=32,
+        lr=1e-2,
+    )
+    losses = run_job(spec, pod_annotations=ann, container="main",
+                     devices=jax.devices()[:8])
+    assert len(losses) == 4
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -75,3 +75,35 @@ def test_ring_attention_uses_collective_permute():
         lambda q: ring_attention_sharded(q, q, q, mesh, causal=True), q
     )
     assert "collective-permute" in txt, "ring hops must be collective-permute"
+
+
+def test_hierarchical_mesh_decomposes_gradient_sync():
+    """Multi-slice (config E): with the DCN boundary inside the data axis
+    (hierarchical_mesh), the compiled train step's gradient sync must
+    decompose hierarchically — slice-LOCAL collectives (fsdp
+    all-gather/reduce-scatter with replica groups wholly inside one
+    slice) plus a CROSS-slice collective pairing same-position devices
+    across slices (the data-axis all-reduce that rides DCN)."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import (
+        classify_replica_groups,
+        hierarchical_mesh,
+    )
+
+    n_slices = 2
+    spec = MeshSpec(data=2, fsdp=2, tensor=2)
+    mesh = hierarchical_mesh(spec, n_slices, devices=jax.devices()[:8])
+    opt = make_optimizer(lr=1e-3)
+    params, opt_state = init_sharded_state(jax.random.key(0), CFG, opt, mesh)
+    step = make_jitted_train_step(CFG, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, 128)
+    txt = jax.jit(step).lower(params, opt_state, tokens).compile().as_text()
+    per_slice = spec.num_devices // n_slices
+    crosses, intra = classify_replica_groups(txt, per_slice)
+    assert crosses, "no cross-slice collective in the compiled step"
+    assert intra, "no slice-local collective in the compiled step"
+    # the cross-slice groups pair same-position devices across slices
+    for g in crosses:
+        rel = {d % per_slice for d in g}
+        sl = {d // per_slice for d in g}
+        if len(g) == n_slices:
+            assert len(rel) == 1 and len(sl) == n_slices, g
