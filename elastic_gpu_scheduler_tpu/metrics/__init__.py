@@ -45,6 +45,12 @@ class Counter:
         with self._lock:
             self._values[labels] = self._values.get(labels, 0.0) + value
 
+    def reset(self) -> None:
+        """Drop every label series (scrape-time gauges rebuilt per scrape
+        use this so vanished labels don't linger at stale values)."""
+        with self._lock:
+            self._values.clear()
+
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"

@@ -40,6 +40,7 @@ capability slot of a complete framework.
 from __future__ import annotations
 
 import functools
+import itertools
 import logging
 import queue
 import threading
@@ -172,6 +173,19 @@ class Request:
     # choice / tool-call-id decoding).  Implemented through the same
     # device-resident bias rows as logit_bias and composes with it.
     allowed_tokens: tuple = ()
+    # admission priority / SLO class (higher = more important; 0 =
+    # default, negative = batch/best-effort).  Admission pops the
+    # highest-priority queued request first (FIFO within a class), and
+    # under KV page pressure the engine SPILLS the lowest-priority slot
+    # (frees its pages, requeues it for an exact resume) instead of
+    # stalling everyone — the serving-plane mirror of the scheduler's
+    # preemption verb (server/handlers.py Preemption).
+    priority: int = 0
+    # internal: times this request was evicted by the LAST-RESORT pool
+    # preemption (all slots stalled, no lower class to spill).  The first
+    # eviction requeues for an exact resume; a second means the request
+    # genuinely cannot fit the pool and fails terminally.
+    pool_spills: int = 0
     # token id → additive logit bias (OpenAI semantics): applied to every
     # sampling distribution for this request, in the fused chunks, the
     # speculative verify pass, and the admission prefill.  ±large values
@@ -1304,7 +1318,15 @@ class InferenceEngine:
         self.next_token = np.zeros(max_batch, np.int32)
         self.emitted = np.zeros(max_batch, np.int32)
         self.stalled = np.zeros(max_batch, bool)  # couldn't get pages
-        self.queue: "queue.Queue[Request]" = queue.Queue()
+        # generated tokens already in the FED prompt (non-zero only for a
+        # spilled-and-resumed request, whose fed prompt = prompt + output
+        # so far); every output-by-position index shifts by this
+        self.gen_before = np.zeros(max_batch, np.int32)
+        self.priorities = np.zeros(max_batch, np.int32)  # per-slot class
+        # priority admission: highest class first, FIFO within a class
+        self.queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._submit_seq = itertools.count()
+        self.spills = 0  # low-priority slots spilled under page pressure
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
@@ -1512,11 +1534,31 @@ class InferenceEngine:
             req.error = "engine built with logprobs_k=0 (logprobs off)"
             req.done.set()
             return req
+        if isinstance(req.priority, bool) or not isinstance(
+            req.priority, int
+        ):
+            req.error = "priority must be an integer"
+            req.done.set()
+            return req
         # the top-k width is compiled into the chunk (engine logprobs_k);
         # a wider ask gets the compiled width
         req.logprobs = min(max(0, req.logprobs), self.logprobs_k)
-        self.queue.put(req)
+        self._enqueue(req)
         return req
+
+    def _enqueue(self, req: Request) -> None:
+        """Priority-ordered admission queue entry (also the spill-requeue
+        path): highest class first, FIFO within a class."""
+        self.queue.put((-req.priority, next(self._submit_seq), req))
+
+    def queue_depths(self) -> dict[int, int]:
+        """Queued requests per priority class (metrics/stats)."""
+        with self.queue.mutex:
+            snapshot = [item[2] for item in self.queue.queue]
+        out: dict[int, int] = {}
+        for r in snapshot:
+            out[r.priority] = out.get(r.priority, 0) + 1
+        return out
 
     def run_until_idle(self, max_steps: int = 10_000) -> None:
         """Drive fused chunks until no request is active or queued."""
@@ -1562,20 +1604,40 @@ class InferenceEngine:
                 req.on_token = None
 
     def _admit(self) -> None:
+        # anti-thrash: while a stalled slot outranks the queue's best,
+        # admitting lower classes would immediately re-trigger the spill
+        # they were evicted by — leave them queued until pressure clears
+        stalled_pris = [
+            int(self.priorities[i])
+            for i in range(self.max_batch)
+            if self.slots[i] is not None and self.stalled[i]
+        ]
+        stall_floor = max(stalled_pris) if stalled_pris else None
         for i in range(self.max_batch):
             if self.slots[i] is not None:
                 continue
             try:
-                req = self.queue.get_nowait()
+                neg, seq, req = self.queue.get_nowait()
             except queue.Empty:
                 return
+            if stall_floor is not None and req.priority < stall_floor:
+                self.queue.put((neg, seq, req))  # keeps its FIFO position
+                return  # everything below is lower-priority still
             if req.cancelled:  # cancelled while still queued
                 req.done.set()
                 continue
+            # fed prompt: the original prompt, plus — for a spilled-and-
+            # resumed request — everything already generated, so the
+            # resume re-prefills exactly the sequence it was at.  Global
+            # token positions are unchanged, which keeps seeded sampling
+            # (position-keyed) bit-identical across a spill.
+            fed = list(req.prompt) + list(req.output)
             self.slots[i] = req
-            self.prompts[i, : len(req.prompt)] = req.prompt
-            self.prompt_lens[i] = len(req.prompt)
-            self.next_token[i] = req.prompt[0]
+            self.prompts[i, : len(fed)] = fed
+            self.prompt_lens[i] = len(fed)
+            self.next_token[i] = fed[0]
+            self.gen_before[i] = len(req.output)
+            self.priorities[i] = req.priority
             self.temps[i] = req.temperature
             self.top_ks[i] = req.top_k
             self.top_ps[i] = req.top_p
@@ -1592,8 +1654,10 @@ class InferenceEngine:
                     _bias_row(req, self.cfg.vocab_size)
                 )
                 self._bias_set[i] = True
-            self.min_toks[i] = max(0, req.min_tokens)
-            if req.min_tokens > 0 and req.stop_tokens:
+            # remaining floor: tokens generated before a spill count
+            floor = max(0, req.min_tokens - int(self.gen_before[i]))
+            self.min_toks[i] = floor
+            if floor > 0 and req.stop_tokens:
                 if self._stop_dev is None:
                     self._stop_dev = jnp.zeros(
                         (self.max_batch, self.cfg.vocab_size), jnp.float32
@@ -1602,14 +1666,14 @@ class InferenceEngine:
                     _stop_row(req, self.cfg.vocab_size)
                 )
                 self._stop_set[i] = True
-            self.emitted[i] = 0
+            self.emitted[i] = int(self.gen_before[i])
             self.stalled[i] = False
             # no page zeroing needed: the position mask only exposes
             # positions <= length, all of which the new tenant rewrites
             matched = self._match_prefix(i, req) if self.prefix_cache else 0
             self.lengths[i] = matched
             if matched:
-                self.next_token[i] = req.prompt[matched]
+                self.next_token[i] = int(self.prompts[i, matched])
             self._try_prefill(i, req)
 
     def _match_prefix(self, i: int, req: Request) -> int:
@@ -1617,7 +1681,8 @@ class InferenceEngine:
         (capped at plen-1 so at least one prompt token always runs through
         the model to produce the first logits).  Returns tokens matched."""
         ps = self.page_size
-        plen = len(req.prompt)
+        plen = int(self.prompt_lens[i])  # the FED prompt (incl. resumed
+        # output for a spilled request — cached pages match by content)
         # K/V content depends on the adapter (wk/wv deltas): pages cached
         # under one adapter must never match a request using another, so
         # the hash chain is seeded with the adapter id
@@ -1627,7 +1692,7 @@ class InferenceEngine:
             end = (j + 1) * ps
             if end > plen - 1:
                 break
-            key = (key, tuple(req.prompt[j * ps:end]))
+            key = (key, tuple(int(t) for t in self.prompts[i, j * ps:end]))
             pg = self.prefix_entries.get(key)
             if pg is None:
                 break
@@ -1695,7 +1760,7 @@ class InferenceEngine:
         pbucket = min(pbucket, self.max_pages_per_slot)
         row = jnp.asarray(self.tables[i, :pbucket])
         toks = np.zeros((1, tpad), np.int32)
-        toks[0, :n] = req.prompt[t0:t0 + n]
+        toks[0, :n] = self.prompts[i, t0:t0 + n]  # the FED prompt
         aid = jnp.asarray(self.adapter_ids[i], jnp.int32)
         if t0 == 0:
             logits, self.kv = self._prefill(
@@ -1718,7 +1783,7 @@ class InferenceEngine:
         speed — slower but always correct).  A prefix-cache hit skips the
         matched tokens entirely: only the remainder runs through the model,
         attending to the shared pages."""
-        plen = len(req.prompt)
+        plen = int(self.prompt_lens[i])  # the FED prompt
         t0 = int(self.lengths[i])  # prefix-cache hit length (0 without)
         rem = plen - t0
         C = self.prefill_chunk
@@ -1743,16 +1808,29 @@ class InferenceEngine:
                 np.asarray(logits, np.float32)
                 + _bias_row(req, self.cfg.vocab_size)
             )
-        if req.min_tokens > 0 and req.stop_tokens:
-            # the first emission has emitted index 0 < min_tokens, so
-            # the floor suppression always applies here (same row the
-            # fused chunks gate per position)
+        if self.min_toks[i] > 0 and req.stop_tokens:
+            # this emission's index is gen_before < the remaining floor,
+            # so the suppression applies (same row the fused chunks gate
+            # per position; min_toks holds the REMAINING floor, already 0
+            # for a resumed request that passed it before its spill)
             logits = jnp.asarray(
                 np.asarray(logits, np.float32)
                 + _stop_row(req, self.cfg.vocab_size)
             )
-        # penalties: nothing to apply at admission — counts cover
-        # GENERATED tokens only, and none exist before the first sample
+        # penalties: counts cover GENERATED tokens only — none exist at a
+        # fresh admission, but a spilled-and-resumed request re-enters
+        # with its prior output, which the next distribution must count
+        if (
+            (req.frequency_penalty or req.presence_penalty)
+            and self.gen_before[i] > 0
+        ):
+            cnt = np.zeros(self.cfg.vocab_size, np.float32)
+            np.add.at(cnt, np.asarray(req.output, np.int64), 1.0)
+            logits = jnp.asarray(
+                np.asarray(logits, np.float32)
+                - req.frequency_penalty * cnt
+                - req.presence_penalty * (cnt > 0)
+            )
         if req.temperature > 0:
             # same key stream + recipe as the fused chunks' device sampling
             from .sampling import sample_static
@@ -1789,7 +1867,7 @@ class InferenceEngine:
             )
         else:
             self._emit(req, tok)
-        self.emitted[i] = 1
+        self.emitted[i] = int(self.gen_before[i]) + 1
         self.lengths[i] = plen
         self.next_token[i] = tok
         if (
@@ -1851,6 +1929,8 @@ class InferenceEngine:
         self.slots[i] = None
         self.stalled[i] = False
         self.prefilling[i] = False
+        self.gen_before[i] = 0
+        self.priorities[i] = 0
         self._seeded[i] = False
         self._clear_bias(i)
         self._clear_stop(i)
@@ -1870,6 +1950,8 @@ class InferenceEngine:
         self.slots[i] = None
         self.stalled[i] = False
         self.prefilling[i] = False
+        self.gen_before[i] = 0
+        self.priorities[i] = 0
         self._seeded[i] = False
         self._clear_bias(i)
         self._clear_stop(i)
@@ -1888,35 +1970,45 @@ class InferenceEngine:
         lies beyond the bucket would otherwise clamp into its own last
         visible page and corrupt confirmed K/V).
 
-        Returns (active, view) or None when no slot is runnable."""
+        Returns (active, view) or None when no slot is runnable.
+
+        Priority (VERDICT r4 #8): when a stalled slot outranks a live
+        lower-priority slot, the low one is SPILLED — pages freed, request
+        requeued for an exact resume — instead of the high one waiting
+        out a blanket stall.  One spill per rescan, re-checked until no
+        eligible victim remains (bounded by max_batch)."""
         B = self.max_batch
-        active = np.zeros(B, bool)
-        for i, req in enumerate(self.slots):
-            if req is None:
-                continue
-            if req.cancelled:
-                req.done.set()
-                self._release_slot(i)
-                continue
-            if self.prefilling[i]:
-                continue  # mid-chunked-prefill: fed by _continue_prefills
-            if self._ensure_pages(i, int(self.lengths[i]) + lookahead):
-                active[i] = True
-                self.stalled[i] = False
-            else:
-                self.stalled[i] = True
-        if not active.any():
-            if self.stalled.any():
-                # genuine page pressure: SOME slot (decode or prefill)
-                # could not get pages and nothing is runnable — surface
-                # the overload so the serving loop can preempt a victim.
-                # Prefilling slots that are progressing don't stall, so a
-                # lone long admission never trips this.
-                raise RuntimeError(
-                    f"page pool exhausted: {sum(self.stalled)} slots "
-                    f"stalled, 0 runnable (pool {self.n_pages - 1} pages)"
-                )
-            return None
+        while True:
+            active = np.zeros(B, bool)
+            for i, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                if req.cancelled:
+                    req.done.set()
+                    self._release_slot(i)
+                    continue
+                if self.prefilling[i]:
+                    continue  # mid-chunked-prefill: fed by _continue_prefills
+                if self._ensure_pages(i, int(self.lengths[i]) + lookahead):
+                    active[i] = True
+                    self.stalled[i] = False
+                else:
+                    self.stalled[i] = True
+            if self.stalled.any() and self._maybe_spill():
+                continue  # freed a lower-priority slot's pages; rescan
+            if not active.any():
+                if self.stalled.any():
+                    # genuine page pressure: SOME slot (decode or prefill)
+                    # could not get pages and nothing is runnable — surface
+                    # the overload so the serving loop can preempt a victim.
+                    # Prefilling slots that are progressing don't stall, so a
+                    # lone long admission never trips this.
+                    raise RuntimeError(
+                        f"page pool exhausted: {sum(self.stalled)} slots "
+                        f"stalled, 0 runnable (pool {self.n_pages - 1} pages)"
+                    )
+                return None
+            break
         need = max(len(self.slot_pages[i]) for i in range(B) if active[i])
         bucket = 1
         while bucket < need:
@@ -1925,6 +2017,49 @@ class InferenceEngine:
         view = self.tables[:, :bucket].copy()
         view[~active] = SCRATCH_PAGE
         return active, view
+
+    def _maybe_spill(self) -> bool:
+        """Spill ONE lower-priority slot to unblock a stalled higher-
+        priority one: free its pages and requeue its request with an
+        exact-resume continuation (the fed prompt on readmission is
+        prompt + output so far — greedy and seeded streams are
+        bit-identical across the spill).  Victim = the lowest-priority
+        slot strictly below the neediest stalled slot's class; ties go to
+        the slot holding the most pages (maximal relief).  Returns True
+        if a slot was spilled."""
+        stalled_pri = [
+            int(self.priorities[i])
+            for i in range(self.max_batch)
+            if self.stalled[i] and self.slots[i] is not None
+        ]
+        if not stalled_pri:
+            return False
+        need = max(stalled_pri)
+        victims = [
+            i for i, req in enumerate(self.slots)
+            if req is not None and int(self.priorities[i]) < need
+            and not self.stalled[i]
+        ]
+        if not victims:
+            return False
+        v = min(
+            victims,
+            key=lambda i: (int(self.priorities[i]), -len(self.slot_pages[i])),
+        )
+        req = self.slots[v]
+        log.info(
+            "page pressure: spilling priority-%d slot %d (%d pages, %d "
+            "tokens generated) for a priority-%d request",
+            int(self.priorities[v]), v, len(self.slot_pages[v]),
+            len(req.output), need,
+        )
+        self.spills += 1
+        # _release_slot (not teardown): prefix-cache registration keeps
+        # the spilled prompt's pages warm, so the resume's re-prefill is
+        # mostly cache hits when the pages survive the pressure window
+        self._release_slot(v)
+        self._enqueue(req)
+        return True
 
     def _filters_requested(self, active) -> bool:
         return bool(
@@ -1949,7 +2084,10 @@ class InferenceEngine:
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
-            n_gen = int(self.lengths[i]) - int(self.prompt_lens[i])
+            n_gen = (
+                int(self.lengths[i]) - int(self.prompt_lens[i])
+                + int(self.gen_before[i])
+            )  # output holds pre-spill tokens too; all of them count
             if n_gen > 0:
                 np.add.at(
                     out[i], np.asarray(req.output[:n_gen], np.int64), 1
@@ -2245,7 +2383,7 @@ class InferenceEngine:
             for q in range(int(self.draft_len[i]), q_end + 1):
                 toks.append(
                     int(self.prompts[i, q]) if q < plen
-                    else req.output[q - plen]
+                    else req.output[int(self.gen_before[i]) + q - plen]
                 )
             pend[i] = toks
         CH = self._draft_chunk
@@ -2284,7 +2422,8 @@ class InferenceEngine:
                 req = self.slots[i]
                 tok = (
                     int(self.prompts[i, q]) if q < plen
-                    else req.output[q - plen] if req is not None else 0
+                    else req.output[int(self.gen_before[i]) + q - plen]
+                    if req is not None else 0
                 )
                 feed[i, 0] = tok
                 counts[i] = 1

@@ -41,7 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import __version__
-from ..metrics import REGISTRY, Counter, Histogram
+from ..metrics import REGISTRY, Counter, Gauge, Histogram
 from ..models.serving import InferenceEngine, Request
 from .routes import _REASONS
 
@@ -58,6 +58,22 @@ SERVE_TOKENS = REGISTRY.register(
     Counter(
         "tpu_serve_tokens_total",
         "Tokens emitted to clients",
+    )
+)
+SERVE_QUEUE_DEPTH = REGISTRY.register(
+    Gauge(
+        "tpu_serve_queue_depth",
+        "Queued requests per priority class (set at scrape time)",
+        ("priority",),
+    )
+)
+_SCRAPE_LOCK = threading.Lock()  # reset+set+expose of scrape-time gauges
+SERVE_SPILLS = REGISTRY.register(
+    Gauge(
+        "tpu_serve_spills",
+        "Low-priority slots spilled (pages freed, request requeued for "
+        "exact resume) under page pressure — the serving-plane mirror of "
+        "the scheduler's preemption verb",
     )
 )
 SERVE_LATENCY = REGISTRY.register(
@@ -106,22 +122,36 @@ class EngineLoop:
             except RuntimeError as e:
                 if "page pool exhausted" in str(e):
                     # ordinary overload, not a bug: every slot is stalled
-                    # for pages.  Preempt ONE victim — the slot holding the
-                    # most pages, so the freed capacity is maximal — and
-                    # let the others finish (the scheduler plane's
-                    # victim-pruning philosophy, applied to the KV pool).
-                    victim = max(
+                    # for pages (the engine's priority spill found no
+                    # lower class to evict).  Preempt ONE victim — the
+                    # LOWEST-priority slot, most pages held as tiebreak —
+                    # honoring the SLO classes even on this last-resort
+                    # path.  First eviction is a requeue (exact resume);
+                    # a repeat offender genuinely doesn't fit the pool
+                    # and gets the terminal error (no infinite thrash).
+                    victim = min(
                         (i for i, s in enumerate(eng.slots) if s is not None),
-                        key=lambda i: len(eng.slot_pages[i]),
+                        key=lambda i: (
+                            int(eng.priorities[i]),
+                            -len(eng.slot_pages[i]),
+                        ),
                     )
                     req = eng.slots[victim]
                     log.warning(
-                        "KV page pool exhausted; preempting slot %d "
-                        "(%d pages held)", victim, len(eng.slot_pages[victim]),
+                        "KV page pool exhausted; preempting priority-%d "
+                        "slot %d (%d pages held)",
+                        int(eng.priorities[victim]), victim,
+                        len(eng.slot_pages[victim]),
                     )
-                    req.error = "preempted: KV page pool exhausted"
-                    req.done.set()
-                    eng._release_slot(victim)
+                    if req.pool_spills < 1:
+                        req.pool_spills += 1
+                        eng.spills += 1
+                        eng._release_slot(victim)
+                        eng._enqueue(req)
+                    else:
+                        req.error = "preempted: KV page pool exhausted"
+                        req.done.set()
+                        eng._release_slot(victim)
                 else:
                     failures += 1
                     self._fail_all("internal engine error", failures)
@@ -204,6 +234,9 @@ def _strict_finite_number(body: dict, field: str) -> float:
 
 def _request_from_body(body: dict, vocab_size: int) -> Request:
     prompt = _token_ids(body.get("prompt"), vocab_size, "prompt")
+    priority = body.get("priority", 0)
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise ValueError("'priority' must be an integer")
     stop = _token_ids(body.get("stop", []), vocab_size, "stop")
     logprobs = _strict_nonneg_int(body, "logprobs")
     bias_raw = body.get("logit_bias", {})
@@ -231,6 +264,7 @@ def _request_from_body(body: dict, vocab_size: int) -> Request:
         frequency_penalty=_strict_finite_number(body, "frequency_penalty"),
         presence_penalty=_strict_finite_number(body, "presence_penalty"),
         min_tokens=_strict_nonneg_int(body, "min_tokens"),
+        priority=priority,
         seed=_strict_seed(body.get("seed")),
         allowed_tokens=tuple(
             _token_ids(body.get("allowed_tokens", []), vocab_size,
@@ -273,7 +307,17 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if self.path == "/version":
                 return self._json(200, {"version": __version__})
             if self.path == "/metrics":
-                data = REGISTRY.expose().encode()
+                # scrape-time gauges from live engine state (reset first
+                # so a drained priority class doesn't linger stale); the
+                # lock makes reset+set+expose atomic across concurrent
+                # scrapes — without it one scrape's reset can blank
+                # another's series mid-exposition
+                with _SCRAPE_LOCK:
+                    SERVE_QUEUE_DEPTH.reset()
+                    for pri, depth in engine.queue_depths().items():
+                        SERVE_QUEUE_DEPTH.set(str(pri), value=float(depth))
+                    SERVE_SPILLS.set(value=float(engine.spills))
+                    data = REGISTRY.expose().encode()
                 self.send_response(200, "OK")
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
@@ -285,6 +329,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if self.path == "/v1/stats":
                 eng = engine
                 return self._json(200, {
+                    "queued_by_priority": {
+                        str(k): v for k, v in eng.queue_depths().items()
+                    },
+                    "spills": int(eng.spills),
                     "active_slots": sum(
                         1 for s in eng.slots if s is not None
                     ),

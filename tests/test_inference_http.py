@@ -157,6 +157,8 @@ def test_stats_reflect_engine(served):
     assert body["logprobs_k"] == engine.logprobs_k
     assert body["vocab_size"] == CFG.vocab_size
     assert body["paged_kernel"] is False
+    assert body["spills"] == 0
+    assert body["queued_by_priority"] == {}
 
 
 def test_bad_scalar_fields_return_400(served):
@@ -180,6 +182,9 @@ def test_bad_scalar_fields_return_400(served):
         {"prompt": [1], "frequency_penalty": "0.5"},
         {"prompt": [1], "frequency_penalty": float("nan")},
         {"prompt": [1], "presence_penalty": True},
+        {"prompt": [1], "priority": "high"},
+        {"prompt": [1], "priority": 1.5},
+        {"prompt": [1], "priority": True},
     ):
         code, out = _post(addr, "/v1/completions", body)
         assert code == 400 and "error" in out, (body, code, out)

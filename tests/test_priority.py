@@ -1,0 +1,190 @@
+"""Serving admission control (VERDICT r4 #8): priority/SLO classes,
+priority admission order, and spill-preemption under page pressure — the
+serving-plane mirror of the scheduler's preemption verb."""
+
+import numpy as np
+import jax
+
+from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype="float32",
+)
+PARAMS = init_params(jax.random.key(0), CFG)
+
+
+def test_priority_admission_order():
+    """With one slot, queued requests admit highest-class first (FIFO
+    within a class) — not submission order."""
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8)
+    order = []
+
+    def mk(name, pri):
+        return Request(
+            prompt=[3, 9], max_new_tokens=2, priority=pri,
+            on_token=lambda t, n=name: order.append(n) if n not in order
+            else None,
+        )
+
+    # all five queue before the loop runs: admission is pure priority
+    # order, FIFO within a class (low before low2)
+    for name, pri in (("first", 0), ("low", -1), ("high", 5), ("mid", 2),
+                      ("low2", -1)):
+        eng.submit(mk(name, pri))
+    eng.run_until_idle()
+    assert order == ["high", "mid", "first", "low", "low2"]
+
+
+def test_priority_must_be_integer():
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8)
+    r = eng.submit(Request(prompt=[3], max_new_tokens=1, priority="x"))
+    assert r.error and "priority" in r.error
+    r = eng.submit(Request(prompt=[3], max_new_tokens=1, priority=True))
+    assert r.error and "priority" in r.error
+
+
+def test_spill_resumes_token_identical():
+    """Under page pressure a lower-priority slot is spilled (pages freed,
+    requeued) so the higher class runs; the spilled request RESUMES and
+    its final output is bit-identical to an uncontended run (greedy
+    determinism across the spill)."""
+    # uncontended reference run
+    ref_eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+    )
+    victim_prompt = [3, 9, 14, 27, 5, 1, 2, 6]
+    ref = ref_eng.submit(Request(prompt=list(victim_prompt),
+                                 max_new_tokens=30))
+    ref_eng.run_until_idle()
+    assert not ref.error and len(ref.output) == 30
+
+    # contended: 5 real pages; the victim grows into all of them, then a
+    # high-priority request arrives and must spill it
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+        fused_steps=2,
+    )
+    victim = eng.submit(Request(prompt=list(victim_prompt),
+                                max_new_tokens=30, priority=0))
+    # let the victim run until the pool is nearly exhausted (small fused
+    # chunks so it is still mid-flight when the high class arrives)
+    for _ in range(40):
+        eng._admit()
+        if not any(s is not None for s in eng.slots):
+            break
+        eng.step()
+        if len(eng.free_pages) == 0:
+            break  # pool exhausted, victim mid-flight
+    assert victim.done.is_set() is False
+    high = eng.submit(Request(prompt=[2, 4, 6, 8, 10, 12, 1, 7],
+                              max_new_tokens=8, priority=5))
+    eng.run_until_idle(max_steps=100_000)
+    assert not high.error and len(high.output) == 8
+    assert not victim.error, victim.error
+    assert eng.spills >= 1  # the victim was spilled at least once
+    # exact resume: identical to the uncontended run
+    assert victim.output == ref.output
+    # and the high class's own output matches ITS uncontended run
+    ref2_eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+    )
+    ref2 = ref2_eng.submit(Request(prompt=[2, 4, 6, 8, 10, 12, 1, 7],
+                                   max_new_tokens=8))
+    ref2_eng.run_until_idle()
+    assert high.output == ref2.output
+
+
+def test_high_priority_unaffected_by_low_priority_flood():
+    """Fairness: a burst of best-effort work must not delay the high
+    class.  With a flood of low-priority requests saturating slots and
+    pages, a later high-priority request still completes before every
+    flood member that had not already started."""
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+        fused_steps=2,
+    )
+    flood = [
+        eng.submit(Request(prompt=[5, 11, 7, 3], max_new_tokens=12,
+                           priority=-1))
+        for _ in range(6)
+    ]
+    # let the flood occupy both slots
+    for _ in range(4):
+        eng._admit()
+        eng.step()
+    high = eng.submit(Request(prompt=[9, 2, 13], max_new_tokens=6,
+                              priority=3))
+    finish_order = []
+    seen = set()
+    for _ in range(100_000):
+        eng._admit()
+        if not any(s is not None for s in eng.slots):
+            if eng.queue.empty():
+                break
+            continue
+        eng.step()
+        for r in [high, *flood]:
+            if r.done.is_set() and id(r) not in seen:
+                seen.add(id(r))
+                finish_order.append(r)
+    assert high.done.is_set() and not high.error
+    assert len(high.output) == 6
+    # at most the two flood members already running when the high class
+    # arrived may finish before it; the queued flood must NOT cut ahead
+    assert finish_order.index(high) <= 2, [
+        ("high" if r is high else "flood") for r in finish_order
+    ]
+    for r in flood:
+        assert r.done.is_set() and not r.error, r.error
+        assert len(r.output) == 12
+
+
+def test_queue_depths_by_priority():
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8)
+    eng.submit(Request(prompt=[3], max_new_tokens=1, priority=0))
+    for pri in (2, 2, -1):
+        eng.submit(Request(prompt=[3], max_new_tokens=1, priority=pri))
+    eng._admit()  # highest class takes the one slot; the rest queue
+    assert eng.queue_depths() == {2: 1, 0: 1, -1: 1}
+    eng.run_until_idle()
+    assert eng.queue_depths() == {}
+
+
+def test_spill_composes_with_speculation_and_seeds():
+    """The spill/resume path preserves position-keyed seeded sampling and
+    composes with the speculative engine: spilled+resumed output equals
+    the uncontended run under both."""
+    for kw in ({"spec_k": 2}, {}):
+        ref_eng = InferenceEngine(
+            PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+            **kw,
+        )
+        req_kw = dict(prompt=[3, 9, 14, 27, 5, 1, 2, 6],
+                      max_new_tokens=30, temperature=0.9, seed=11)
+        ref = ref_eng.submit(Request(**req_kw))
+        ref_eng.run_until_idle()
+        assert not ref.error
+
+        eng = InferenceEngine(
+            PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+            fused_steps=2, **kw,
+        )
+        victim = eng.submit(Request(**req_kw, priority=0))
+        for _ in range(40):
+            eng._admit()
+            if not any(s is not None for s in eng.slots):
+                break
+            eng.step()
+            if len(eng.free_pages) == 0:
+                break
+        high = eng.submit(Request(prompt=[2, 4, 6], max_new_tokens=6,
+                                  priority=5))
+        eng.run_until_idle(max_steps=100_000)
+        assert not victim.error and not high.error
+        assert eng.spills >= 1, kw
+        assert victim.output == ref.output, kw
