@@ -20,6 +20,37 @@ on-node half of the coordinate contract whose other half is the scheduler's
 ``elasticgpu.io/container-<name>`` annotation (reference delegates this to
 the sibling Elastic GPU Agent, README.md:30-34; here it's in-repo).
 
+Fractional core-% contract (the qGPU slot, SURVEY §7(d) — "no NVML
+analogue; define what tpu-core % means"):
+
+- A container requesting ``tpu-chip: N`` where N is not a multiple of 100
+  is a FRACTIONAL tenant: it shares its chip(s) with other fractional
+  tenants the scheduler binpacked onto the same chip (core/rater.py).
+- The on-node meaning is COOPERATIVE time-slicing, not hardware
+  partitioning — TPUs have no MIG/MPS analogue; a TensorCore runs one
+  program at a time, so the share is a scheduling weight, not an
+  enforced slice.  The plugin exports the contract as env and the
+  workload runtime honors it:
+    TPU_VISIBLE_CHIPS    the chip coordinates this container may use
+    TPU_CHIP_CORE_UNITS  total core units allocated (100 = one chip)
+    TPU_CORE_PERCENT     share of each allocated chip in percent
+                         (units / chips / 100-units-per-chip)
+    XLA_PYTHON_CLIENT_MEM_FRACTION
+                         set to percent/100 for fractional tenants only,
+                         so JAX's allocator pre-reserves at most the
+                         tenant's HBM share (whole-chip tenants keep
+                         the default full preallocation)
+- SLO stance: fractional tenants get throughput proportional to their
+  share only under cooperative neighbors; latency SLOs require whole
+  chips (core: a multiple of 100), which the scheduler places with
+  exclusive chip ownership (core/allocator.py owned-chips rule).
+
+Kubelet-restart lifecycle (the real device-plugin contract): kubelet
+forgets every plugin on restart and recreates kubelet.sock.
+``start_kubelet_watch`` polls the socket inode; on change it re-serves
+the plugin socket if the restart removed it, then re-registers — so the
+DaemonSet pod survives kubelet restarts without a restart of its own.
+
 gRPC note: messages are protoc-generated (deviceplugin_pb2.py); service
 stubs are hand-wired with grpc generic handlers since grpcio-tools is not in
 this environment.
@@ -200,9 +231,22 @@ class TPUDevicePlugin:
             )
             cresp = pb.ContainerAllocateResponse()
             cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(chip_coords)
+            units = len(creq.devices_i_ds)
             cresp.envs["TPU_CHIP_CORE_UNITS"] = str(
-                len(creq.devices_i_ds)
+                units
             )  # fractional share size in core units
+            # the fractional contract (module docstring): per-chip share
+            # in percent, plus a JAX allocator cap for fractional tenants
+            whole = len(chip_coords) * self.core_units
+            pct = round(100 * units / whole) if chip_coords else 0
+            cresp.envs["TPU_CORE_PERCENT"] = str(pct)
+            # fractionality decides from EXACT units (a 199/200-unit
+            # tenant rounds to "100" for display but still needs the
+            # allocator cap — its chip has a neighbor)
+            if chip_coords and 0 < units < whole:
+                cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] = (
+                    f"{units / whole:.2f}"
+                )
             for coord in chip_coords:
                 path = by_path.get(coord)
                 if path:
@@ -273,6 +317,75 @@ class TPUDevicePlugin:
         if self._server is not None:
             self._server.stop(grace=1)
 
+    @staticmethod
+    def _sock_ino(path: str):
+        """Socket identity: (inode, ctime_ns) — a recreated socket can
+        reuse the inode on tmpfs, but not the creation stamp."""
+        try:
+            st = os.stat(path)
+            return (st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def start_kubelet_watch(
+        self,
+        plugin_dir: str,
+        endpoint: str = PLUGIN_SOCKET_NAME,
+        interval: float = 1.0,
+    ) -> threading.Thread:
+        """The kubelet-restart contract: a restarted kubelet forgets every
+        registered plugin and recreates kubelet.sock (new inode).  Poll
+        the inode; on change, re-serve our socket if the restart removed
+        it, then re-register (with bounded retry — the kubelet may not be
+        accepting yet).  Returns the watcher thread (daemon)."""
+        ksock = os.path.join(plugin_dir, "kubelet.sock")
+        own = os.path.join(plugin_dir, endpoint)
+
+        def loop():
+            last = self._sock_ino(ksock)
+            while not self._stop.wait(interval):
+                cur = self._sock_ino(ksock)
+                if cur is None:
+                    last = None  # kubelet down; any reappearance is new
+                    continue
+                if cur == last:
+                    continue
+                last = cur
+                log.info(
+                    "kubelet.sock inode changed (kubelet restart); "
+                    "re-registering %s", self.resource_name,
+                )
+                if not os.path.exists(own):
+                    # some kubelet versions clean the plugin dir on
+                    # restart: bring our socket back before registering
+                    if self._server is not None:
+                        self._server.stop(grace=0.5)
+                    self.serve(own)
+                registered = False
+                for attempt in range(5):
+                    try:
+                        self.register(
+                            kubelet_socket=ksock, endpoint=endpoint
+                        )
+                        registered = True
+                        break
+                    except Exception as e:
+                        log.warning(
+                            "re-register attempt %d failed: %s",
+                            attempt + 1, e,
+                        )
+                        if self._stop.wait(0.5 * (attempt + 1)):
+                            return
+                if not registered:
+                    # forget the inode so the next poll retries — giving
+                    # up here would leave the node advertising zero
+                    # chips until ANOTHER kubelet restart
+                    last = None
+
+        t = threading.Thread(target=loop, name="kubelet-watch", daemon=True)
+        t.start()
+        return t
+
     def register(
         self,
         kubelet_socket: str = KUBELET_SOCKET,
@@ -316,6 +429,7 @@ def main(argv=None) -> int:  # pragma: no cover - thin wrapper
         plugin.register(
             kubelet_socket=os.path.join(args.plugin_dir, "kubelet.sock")
         )
+        plugin.start_kubelet_watch(args.plugin_dir)
     try:
         while True:
             time.sleep(3600)

@@ -194,3 +194,118 @@ def test_health_transition_reannounced(plugin_env):
         plugin.set_health("0.2", True)
         third = next(it)
         assert all(d.health == "Healthy" for d in third.devices)
+
+
+def test_fractional_core_percent_contract(plugin_env):
+    """The fractional contract (plugin module docstring): per-chip share
+    percent + a JAX allocator cap for fractional tenants only."""
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        allocate = ch.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        resp = allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    # 12.5% tenant: 12.5 rounds to 12 (the scheduler's
+                    # core-unit granularity is integral units)
+                    pb.ContainerAllocateRequest(
+                        devices_i_ds=[f"0.2/{u}" for u in range(12)]
+                    ),
+                    # one whole chip: 100% — no allocator cap
+                    pb.ContainerAllocateRequest(
+                        devices_i_ds=[f"0.3/{u}" for u in range(100)]
+                    ),
+                    # two whole chips: still 100% per chip
+                    pb.ContainerAllocateRequest(
+                        devices_i_ds=[f"0.2/{u}" for u in range(100)]
+                        + [f"0.3/{u}" for u in range(100)]
+                    ),
+                ]
+            ),
+            timeout=5,
+        )
+    frac, whole, two = resp.container_responses
+    assert frac.envs["TPU_CORE_PERCENT"] == "12"
+    assert frac.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.12"
+    assert whole.envs["TPU_CORE_PERCENT"] == "100"
+    assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in whole.envs
+    assert two.envs["TPU_CORE_PERCENT"] == "100"
+    assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in two.envs
+
+
+def test_kubelet_restart_reregisters(plugin_env):
+    """The real kubelet contract: a restarted kubelet forgets every
+    plugin and recreates kubelet.sock; the plugin's inode watcher must
+    re-register without a pod restart."""
+    kubelet, plugin, kubelet_sock, plugin_sock = plugin_env
+    plugin.register(kubelet_socket=kubelet_sock)
+    first = kubelet.requests.get(timeout=5)
+    assert first.resource_name == consts.RESOURCE_TPU_CORE
+
+    watcher = plugin.start_kubelet_watch(
+        os.path.dirname(kubelet_sock), interval=0.05
+    )
+    # kubelet "restart": tear the registration server down, remove the
+    # socket, bring a fresh one up (new inode)
+    kubelet.stop()
+    if os.path.exists(kubelet_sock):  # grpc may remove it on stop
+        os.unlink(kubelet_sock)
+    new_kubelet = FakeKubelet(kubelet_sock)
+    try:
+        req = new_kubelet.requests.get(timeout=10)
+        assert req.resource_name == consts.RESOURCE_TPU_CORE
+        assert req.endpoint == PLUGIN_SOCKET_NAME
+    finally:
+        new_kubelet.stop()
+    assert watcher.is_alive()
+
+
+def test_health_flap_during_allocate(plugin_env):
+    """A chip going unhealthy between the kubelet's ListAndWatch refresh
+    and an in-flight Allocate must not break the Allocate — the kubelet
+    retries placement after the shrink; the plugin's job is a coherent
+    answer for the devices the kubelet names."""
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        stream = ch.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty())
+        first = next(stream)
+        assert all(d.health == "Healthy" for d in first.devices)
+
+        allocate = ch.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        # flap mid-flight: the chip the allocation names goes unhealthy
+        plugin.set_health("0.2", False)
+        resp = allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devices_i_ds=[f"0.2/{u}" for u in range(100)]
+                    )
+                ]
+            ),
+            timeout=5,
+        )
+        # coherent response for the named devices
+        assert resp.container_responses[0].envs[
+            "TPU_VISIBLE_CHIPS"
+        ] == "0.2"
+        # and the flap IS announced on the stream (kubelet shrinks)
+        second = next(stream)
+        unhealthy = [
+            d.ID for d in second.devices if d.health != "Healthy"
+        ]
+        assert unhealthy and all(i.startswith("0.2/") for i in unhealthy)
+        # recovery restores the full allocatable
+        plugin.set_health("0.2", True)
+        third = next(stream)
+        assert all(d.health == "Healthy" for d in third.devices)
