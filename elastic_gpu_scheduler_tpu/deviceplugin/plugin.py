@@ -33,13 +33,18 @@ analogue; define what tpu-core % means"):
   workload runtime honors it:
     TPU_VISIBLE_CHIPS    the chip coordinates this container may use
     TPU_CHIP_CORE_UNITS  total core units allocated (100 = one chip)
-    TPU_CORE_PERCENT     share of each allocated chip in percent
-                         (units / chips / 100-units-per-chip)
+    TPU_CHIP_SHARES      exact per-chip breakdown ("coord=units,...") —
+                         the kubelet may split an allocation unevenly
+                         across chips
+    TPU_CORE_PERCENT     the MINIMUM per-chip share in percent (the
+                         conservative figure a process-wide limit must
+                         respect)
     XLA_PYTHON_CLIENT_MEM_FRACTION
-                         set to percent/100 for fractional tenants only,
-                         so JAX's allocator pre-reserves at most the
-                         tenant's HBM share (whole-chip tenants keep
-                         the default full preallocation)
+                         min-share/100, set for fractional tenants only:
+                         the XLA fraction applies process-wide across
+                         all visible chips, so only the smallest chip
+                         share is safe against that chip's neighbors
+                         (whole-chip tenants keep full preallocation)
 - SLO stance: fractional tenants get throughput proportional to their
   share only under cooperative neighbors; latency SLOs require whole
   chips (core: a multiple of 100), which the scheduler places with
@@ -237,15 +242,27 @@ class TPUDevicePlugin:
             )  # fractional share size in core units
             # the fractional contract (module docstring): per-chip share
             # in percent, plus a JAX allocator cap for fractional tenants
-            whole = len(chip_coords) * self.core_units
-            pct = round(100 * units / whole) if chip_coords else 0
+            # per-chip shares from the ACTUAL device distribution — the
+            # kubelet treats core-unit device ids as fungible, so an
+            # allocation can split unevenly across chips (40 on A + 10 on
+            # B); a cross-chip average would overstate the smaller share
+            # and oversubscribe HBM against that chip's neighbors
+            by_chip: dict[str, int] = {}
+            for d in creq.devices_i_ds:
+                c = self.chip_of_device(d)
+                by_chip[c] = by_chip.get(c, 0) + 1
+            cresp.envs["TPU_CHIP_SHARES"] = ",".join(
+                f"{c}={u}" for c, u in sorted(by_chip.items())
+            )
+            min_units = min(by_chip.values()) if by_chip else 0
+            # the conservative contract: the MINIMUM per-chip share (the
+            # XLA mem fraction is process-wide across visible chips, so
+            # only the smallest share is safe against neighbors)
+            pct = round(100 * min_units / self.core_units)
             cresp.envs["TPU_CORE_PERCENT"] = str(pct)
-            # fractionality decides from EXACT units (a 199/200-unit
-            # tenant rounds to "100" for display but still needs the
-            # allocator cap — its chip has a neighbor)
-            if chip_coords and 0 < units < whole:
+            if by_chip and min_units < self.core_units:
                 cresp.envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] = (
-                    f"{units / whole:.2f}"
+                    f"{min_units / self.core_units:.2f}"
                 )
             for coord in chip_coords:
                 path = by_path.get(coord)
@@ -344,13 +361,21 @@ class TPUDevicePlugin:
         def loop():
             last = self._sock_ino(ksock)
             while not self._stop.wait(interval):
+                try:
+                    last = tick(last)
+                except Exception:
+                    # the watcher must survive anything (a dying watcher
+                    # disables restart recovery until a pod restart);
+                    # re-evaluate from scratch next poll
+                    log.exception("kubelet watch iteration failed")
+                    last = None
+
+        def tick(last):
                 cur = self._sock_ino(ksock)
                 if cur is None:
-                    last = None  # kubelet down; any reappearance is new
-                    continue
+                    return None  # kubelet down; any reappearance is new
                 if cur == last:
-                    continue
-                last = cur
+                    return last
                 log.info(
                     "kubelet.sock inode changed (kubelet restart); "
                     "re-registering %s", self.resource_name,
@@ -380,7 +405,8 @@ class TPUDevicePlugin:
                     # forget the inode so the next poll retries — giving
                     # up here would leave the node advertising zero
                     # chips until ANOTHER kubelet restart
-                    last = None
+                    return None
+                return cur
 
         t = threading.Thread(target=loop, name="kubelet-watch", daemon=True)
         t.start()

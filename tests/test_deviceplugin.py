@@ -309,3 +309,30 @@ def test_health_flap_during_allocate(plugin_env):
         plugin.set_health("0.2", True)
         third = next(stream)
         assert all(d.health == "Healthy" for d in third.devices)
+
+
+def test_unaligned_cross_chip_split_uses_min_share(plugin_env):
+    """The kubelet treats core-unit device ids as fungible: a 50-unit ask
+    can land 40-on-A + 10-on-B.  The env contract must report the exact
+    split and cap HBM at the MINIMUM per-chip share — an average would
+    oversubscribe chip B against its neighbors."""
+    _, plugin, _, plugin_sock = plugin_env
+    with _dp_channel(plugin_sock) as ch:
+        allocate = ch.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        ids = [f"0.2/{u}" for u in range(40)] + [f"0.3/{u}" for u in range(10)]
+        resp = allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devices_i_ds=ids)
+                ]
+            ),
+            timeout=5,
+        )
+    envs = resp.container_responses[0].envs
+    assert envs["TPU_CHIP_SHARES"] == "0.2=40,0.3=10"
+    assert envs["TPU_CORE_PERCENT"] == "10"
+    assert envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.10"

@@ -307,6 +307,13 @@ class K8sApiServer:
             d = self.pods.pop(key)
             self._emit("DELETED", d)
 
+    def delete_node(self, name):
+        """Node death as the node controller reports it (the elastic-loop
+        test kills a node mid-training; its pods are evicted separately
+        via delete_pod, as the real eviction path does)."""
+        with self.lock:
+            self.nodes.pop(name, None)
+
     def touch_pod(self, key):
         """Out-of-band write bumping the resourceVersion (conflict setup)."""
         with self.lock:

@@ -1,0 +1,177 @@
+"""The full elastic loop, end to end (VERDICT r4 #6): a gang-scheduled
+"training job" loses a node mid-run, the controller frees its capacity
+through the real watch stream, the job reschedules onto the surviving
+node, and training resumes from checkpoint on the SMALLER mesh with a
+continuous loss trajectory.
+
+Every piece already exists separately (controller release on delete:
+test_e2e_wire; gang planning: test_gang; elastic orbax resume across mesh
+shapes: test_elastic_resume); this composes them through the production
+stack — mini API server, REST clientset + watch view, extender HTTP
+server, reconciliation controller, launcher."""
+
+import tempfile
+import threading
+
+import jax
+import numpy as np
+
+from test_e2e_wire import (
+    K8sApiServer,
+    KubeSchedulerClient,
+    used_core,
+)
+from conftest import poll
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import RestClientset, RestClusterView
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.launcher import JobSpec, run_job
+from elastic_gpu_scheduler_tpu.models.transformer import TransformerConfig
+from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+TINY = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype="float32",
+)
+
+
+def gang_pod(name, gang, size, core):
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: core}
+                ),
+            )
+        ],
+        annotations={
+            consts.ANNOTATION_GANG_NAME: gang,
+            consts.ANNOTATION_GANG_SIZE: str(size),
+        },
+        uid=f"uid-{name}",
+    )
+
+
+def test_node_death_replan_resume_end_to_end():
+    api = K8sApiServer()
+    for i in range(2):
+        api.add_node(
+            make_tpu_node(f"n{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    rest = RestClientset(base_url=f"http://127.0.0.1:{api.port}")
+    view = RestClusterView(rest, reconnect_delay=0.1)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(rest, cluster=view, priority="binpack", gang_timeout=15.0)
+    )
+    controller.resync_period = 0.3
+    controller.start()
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+        workers=8,
+    )
+    port = server.start()
+    ks = KubeSchedulerClient(port)
+    try:
+        # 1. gang-schedule the 2-member job (one whole node each) through
+        # the wire — all-or-nothing barrier, so both bind concurrently
+        pods = [gang_pod(f"train-{i}", "elastic-job", 2, 400)
+                for i in range(2)]
+        docs = [api.create_pod(p) for p in pods]
+        errs = []
+
+        def member(doc):
+            node = ks.schedule(doc, ["n0", "n1"])
+            res = ks.bind(doc, node)
+            if res.get("Error"):
+                errs.append(res["Error"])
+
+        ts = [threading.Thread(target=member, args=(d,)) for d in docs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert used_core(registry) == 800
+        ann0 = rest.get_pod("default", "train-0").metadata.annotations
+        assert ann0[consts.ANNOTATION_CONTAINER_PREFIX + "main"]
+
+        with tempfile.TemporaryDirectory() as ckpt_dir:
+            common = dict(
+                model=TINY, batch_size=8, seq_len=16, lr=1e-2, seed=3,
+            )
+            # 2. "training" on the gang's 8 chips (2 nodes × 4): 3 steps,
+            # checkpoint every step
+            spec_a = JobSpec(
+                mesh=MeshSpec(data=2, fsdp=2, tensor=2), steps=3,
+                checkpoint_dir=ckpt_dir, checkpoint_every=1, **common,
+            )
+            losses_a = run_job(spec_a, pod_annotations=ann0,
+                               container="main", devices=jax.devices()[:8])
+            assert len(losses_a) == 3 and np.isfinite(losses_a).all()
+
+            # the uninterrupted reference: same job, same data stream, 6
+            # steps straight through on the original mesh
+            ref = run_job(
+                JobSpec(mesh=MeshSpec(data=2, fsdp=2, tensor=2), steps=6,
+                        **common),
+                devices=jax.devices()[:8],
+            )
+            assert np.allclose(ref[:3], losses_a, rtol=1e-4)
+
+            # 3. node n1 dies mid-job: the node controller removes the
+            # node and evicts its pod; the job controller tears down the
+            # remaining member (gang semantics: all-or-nothing)
+            api.delete_node("n1")
+            api.delete_pod("default/train-1")
+            api.delete_pod("default/train-0")
+            # the watch stream delivers the deletes; the controller
+            # releases ALL the gang's chips
+            assert poll(lambda: used_core(registry) == 0, timeout=10)
+
+            # 4. elastic replan: the job comes back at half size on the
+            # surviving node — a single whole-node member
+            solo = make_pod(
+                "train-r0",
+                containers=[
+                    Container(
+                        name="main",
+                        resources=ResourceRequirements(
+                            limits={consts.RESOURCE_TPU_CORE: 400}
+                        ),
+                    )
+                ],
+                uid="uid-train-r0",
+            )
+            doc = api.create_pod(solo)
+            node = ks.schedule(doc, ["n0"])  # n1 is gone from the cluster
+            assert node == "n0"
+            res = ks.bind(doc, node)
+            assert not res.get("Error"), res
+            assert used_core(registry) == 400
+            ann_r = rest.get_pod("default", "train-r0").metadata.annotations
+
+            # 5. resume from checkpoint on the SMALLER mesh (4 chips):
+            # trajectory continues exactly where the big mesh left off
+            spec_b = JobSpec(
+                mesh=MeshSpec(fsdp=2, tensor=2), steps=6,
+                checkpoint_dir=ckpt_dir, checkpoint_every=1, **common,
+            )
+            losses_b = run_job(spec_b, pod_annotations=ann_r,
+                               container="main", devices=jax.devices()[:4])
+            assert len(losses_b) == 3  # resumed at step 3, ran 3..5
+            assert np.allclose(losses_b, ref[3:], rtol=1e-4), (
+                losses_b, ref[3:],
+            )
+    finally:
+        server.stop()
+        controller.stop()
