@@ -22,6 +22,17 @@ _DRAIN_LOCK = threading.Lock()
 # path used to trim retained samples at 10k; the buffers must too).
 _WAITS_CAP = 20000
 
+# Lock-ordering enforcement (VERDICT r4 #9): each ranked TimedLock may
+# only be acquired while every lock this thread already holds has a
+# STRICTLY LOWER rank.  The codebase's documented hierarchy:
+#     gang coordinator (10)  →  scheduler engine (20)
+# (per-gang condition vars sit below 10 and per-node allocator locks
+# above 20; they are plain locks today — the two big ranked locks are
+# where an inversion would deadlock the whole control plane.)  An
+# inversion raises immediately: it is a deadlock that hasn't happened
+# yet, and the GIL hides it from every stress test.
+_HELD_RANKS = threading.local()
+
 
 def _flush_orphan(name: str, waits: list) -> None:
     """weakref.finalize hook: commit a dying TimedLock's buffered waits
@@ -339,11 +350,14 @@ class TimedLock:
     exactly the contention point and compounding across queued waiters
     (the round-4 cfg5 gang-wall regression)."""
 
-    def __init__(self, name: str, reentrant: bool = False):
+    def __init__(
+        self, name: str, reentrant: bool = False, rank: int | None = None
+    ):
         self._inner = (
             threading.RLock() if reentrant else threading.Lock()
         )
         self._name = name
+        self._rank = rank  # lock-order position; None = unranked
         # owner/depth: reentrant re-acquires by the holder wait 0 by
         # definition — sampling them would flood the histogram with ~0s
         # entries and mask real queueing (the signal this exists for).
@@ -366,12 +380,36 @@ class TimedLock:
             if ok:
                 self._depth += 1
             return ok
+        if self._rank is not None and blocking and timeout < 0:
+            # only INDEFINITE blocking acquires can deadlock; try-locks
+            # and timeout-bounded acquires are legal in any order
+            held = getattr(_HELD_RANKS, "stack", None)
+            if held:
+                top = max(held)  # releases may interleave; check the max
+                if top[0] >= self._rank:
+                    raise RuntimeError(
+                        f"lock-order inversion: acquiring {self._name!r} "
+                        f"(rank {self._rank}) while holding {top[1]!r} "
+                        f"(rank {top[0]}) — locks must be taken in "
+                        "strictly increasing rank order (see the rank "
+                        "assignments for the documented hierarchy); this "
+                        "ordering would deadlock under contention"
+                    )
         t0 = time.perf_counter()
         ok = self._inner.acquire(blocking, timeout)
         if ok:  # failed acquires (timeout / non-blocking miss) are not
             # waits that ended in the lock — don't pollute the histogram
             self._owner = me
             self._depth = 1
+            if self._rank is not None:
+                if not hasattr(_HELD_RANKS, "stack"):
+                    _HELD_RANKS.stack = []
+                entry = (self._rank, self._name)
+                _HELD_RANKS.stack.append(entry)
+                # remember WHICH thread's stack holds the entry, so a
+                # cross-thread release (legal on the plain-Lock variant)
+                # still removes it from the acquirer's stack
+                self._rank_entry = (_HELD_RANKS.stack, entry)
             self._waits.append(time.perf_counter() - t0)
             if len(self._waits) > _WAITS_CAP and _DRAIN_LOCK.acquire(
                 blocking=False
@@ -399,6 +437,17 @@ class TimedLock:
         self._depth -= 1
         if self._depth == 0:
             self._owner = None
+            if self._rank is not None:
+                ref = getattr(self, "_rank_entry", None)
+                if ref is not None:
+                    stack, entry = ref
+                    self._rank_entry = None
+                    try:
+                        stack.remove(entry)  # list ops are GIL-atomic,
+                        # and this is the ACQUIRER's stack even when a
+                        # different thread releases
+                    except ValueError:
+                        pass
         self._inner.release()
 
     def __enter__(self):

@@ -149,7 +149,9 @@ class GangCoordinator:
         self.timeout = timeout
         self._gangs: dict[str, _Gang] = {}
         self._plans: dict[str, _Plan] = {}
-        self._lock = TimedLock("gang")  # wait-time → metrics.LOCK_WAIT
+        self._lock = TimedLock("gang", rank=10)  # wait-time →
+        # metrics.LOCK_WAIT; rank: may be held while TAKING the
+        # scheduler lock (filter->plan), never the reverse
         # bounded pool for the commit's API writes (annotations + bindings);
         # the N member HTTP threads just park on the barrier condition
         self._commit_pool = ThreadPoolExecutor(

@@ -99,7 +99,7 @@ class TPUUnitScheduler(ResourceScheduler):
         self.assume_workers = max(1, config.assume_workers)
         # wait-time-instrumented (metrics.LOCK_WAIT): the single coarse
         # lock is the scaling cliff; /metrics shows how long binds queue
-        self.lock = TimedLock("scheduler", reentrant=True)
+        self.lock = TimedLock("scheduler", reentrant=True, rank=20)
         self.allocators: dict[str, NodeAllocator] = {}
         # pod key → (node, committed Option); the at-most-once ledger
         self.pod_maps: dict[str, tuple[str, Option]] = {}

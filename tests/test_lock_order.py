@@ -1,0 +1,224 @@
+"""Lock-ordering enforcement (VERDICT r4 #9): the two big control-plane
+locks carry ranks (gang=10 → scheduler=20) and TimedLock raises on any
+inversion — a deadlock that hasn't happened yet, which the GIL hides from
+every stress test.  Plus a multi-process bind storm through real sockets:
+contention from OS processes, not GIL-serialized threads."""
+
+import json
+import multiprocessing as mp
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.metrics import TimedLock
+
+
+def test_rank_order_allows_hierarchy():
+    gang = TimedLock("t-gang", rank=10)
+    sched = TimedLock("t-sched", reentrant=True, rank=20)
+    with gang:
+        with sched:
+            with sched:  # reentrant re-acquire is always fine
+                pass
+    # sequential (non-nested) acquisitions in any order are fine
+    with sched:
+        pass
+    with gang:
+        pass
+
+
+def test_rank_inversion_raises():
+    gang = TimedLock("t-gang2", rank=10)
+    sched = TimedLock("t-sched2", reentrant=True, rank=20)
+    with sched:
+        with pytest.raises(RuntimeError, match="lock-order inversion"):
+            gang.acquire()
+    # the failed acquire must not poison later legal ordering
+    with gang:
+        with sched:
+            pass
+
+
+def test_same_rank_is_an_inversion():
+    a = TimedLock("t-a", rank=10)
+    b = TimedLock("t-b", rank=10)
+    with a:
+        with pytest.raises(RuntimeError, match="lock-order inversion"):
+            b.acquire()
+
+
+def test_unranked_locks_unaffected():
+    plain = TimedLock("t-plain")
+    ranked = TimedLock("t-ranked", rank=20)
+    with ranked:
+        with plain:  # unranked locks opt out of the hierarchy
+            pass
+
+
+# -- multi-process bind storm -------------------------------------------------
+
+
+def _storm_client(port, pods, out):
+    """One OS process: full scheduling cycles over real HTTP (pods are
+    wire-shape dicts built by the parent)."""
+    import time
+
+    def post(path, obj):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            json.dumps(obj).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    try:
+        for pod in pods:
+            name = pod["metadata"]["name"]
+            last = "no attempt ran"
+            for attempt in range(12):
+                # full scheduling cycle, retried on a lost bind race —
+                # exactly what kube-scheduler does when an extender bind
+                # fails (the storm's 4 processes are 4 racing schedulers)
+                filt = post("/scheduler/filter", {
+                    "Pod": pod,
+                    "NodeNames": [f"mp-n{i}" for i in range(10)],
+                })
+                if filt.get("Error") or not filt.get("NodeNames"):
+                    last = f"filter: {filt}"
+                    time.sleep(0.02 * (attempt + 1))
+                    continue
+                prio = post("/scheduler/priorities", {
+                    "Pod": pod, "NodeNames": filt["NodeNames"],
+                })
+                host = max(prio, key=lambda hp: hp["Score"])["Host"]
+                res = post("/scheduler/bind", {
+                    "PodName": name, "PodNamespace": "default",
+                    "PodUID": f"uid-{name}", "Node": host,
+                })
+                if not res.get("Error"):
+                    last = None
+                    break
+                last = res["Error"]
+                time.sleep(0.02 * (attempt + 1))
+            out.put((name, last))
+    except Exception as e:  # pragma: no cover
+        out.put(("__proc__", repr(e)))
+
+
+def test_multiprocess_bind_storm_exact_capacity():
+    """4 OS processes race 40 one-chip binds onto exactly 40 chips over
+    real sockets — no GIL serialization between clients.  Every bind
+    lands, capacity is exactly exhausted, and the ranked locks see true
+    cross-process-driven contention without an inversion."""
+    from elastic_gpu_scheduler_tpu.cli import build_stack
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+    from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+    from elastic_gpu_scheduler_tpu.k8s.objects import (
+        Container,
+        ResourceRequirements,
+        make_pod,
+        make_tpu_node,
+    )
+    from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+    from elastic_gpu_scheduler_tpu.utils import consts
+
+    cluster = FakeCluster()
+    for i in range(10):
+        cluster.add_node(
+            make_tpu_node(f"mp-n{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(FakeClientset(cluster), cluster=cluster,
+                    priority="binpack")
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+        workers=16,
+    )
+    port = server.start()
+    names = [f"storm-{k}" for k in range(40)]
+    pod_dicts = []
+    for name in names:
+        pod = make_pod(
+            name,
+            containers=[Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: 100}
+                ),
+            )],
+            uid=f"uid-{name}",
+        )
+        cluster.create_pod(pod)
+        pod_dicts.append(pod.to_dict())
+    ctx = mp.get_context("spawn")
+    out = ctx.Queue()
+    procs = [
+        ctx.Process(target=_storm_client,
+                    args=(port, pod_dicts[k * 10:(k + 1) * 10], out))
+        for k in range(4)
+    ]
+    import queue as q
+    import time as t
+
+    try:
+        for p in procs:
+            p.start()
+        results = {}
+        deadline = t.monotonic() + 180
+        # drain until all 40 report or the deadline hits — a client that
+        # died mid-batch emits a '__proc__' sentinel which must surface
+        # in the assertion, not as an opaque queue.Empty timeout
+        while len(results) < 40 and t.monotonic() < deadline:
+            try:
+                name, err = out.get(timeout=2)
+            except q.Empty:
+                if not any(p.is_alive() for p in procs):
+                    break
+                continue
+            results[name] = err
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        server.stop()
+    errs = {n: e for n, e in results.items() if e}
+    assert not errs, errs
+    assert len(results) == 40, sorted(results)
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    with sched.lock:
+        free = sum(
+            na.chips.avail_core() for na in sched.allocators.values()
+        )
+    assert free == 0  # exactly exhausted, no over- or under-commit
+
+
+def test_cross_thread_release_clears_rank_entry():
+    """threading.Lock permits release from another thread; the rank
+    bookkeeping must remove the entry from the ACQUIRER's stack, or the
+    acquirer false-trips the checker forever after."""
+    import threading
+
+    lk = TimedLock("t-xthread", rank=20)
+    low = TimedLock("t-xlow", rank=10)
+    lk.acquire()
+    t = threading.Thread(target=lk.release)
+    t.start()
+    t.join()
+    # the acquiring thread's stack must be clean: taking a LOWER-ranked
+    # lock now is legal
+    with low:
+        pass
+
+
+def test_try_lock_is_exempt_from_ordering():
+    """Non-blocking acquires cannot deadlock and are legal in any
+    order (the classic try-lock pattern)."""
+    gang = TimedLock("t-try-gang", rank=10)
+    sched = TimedLock("t-try-sched", reentrant=True, rank=20)
+    with sched:
+        assert gang.acquire(blocking=False)
+        gang.release()
