@@ -14,6 +14,8 @@ Reference: pkg/routes/routes.go.  Paths kept wire-compatible:
     GET  /debug/stacks          → all-thread stack dump (pprof analogue;
                                   reference mounts net/http/pprof, pprof.go)
     GET  /debug/pprof/mutex     → lock wait-time summary (scheduler/gang)
+    GET  /debug/pprof/trace     → per-thread execution timeline, Chrome
+                                  trace-event JSON (runtime-trace slot)
     GET  /debug/pprof/heap      → tracemalloc heap report; ?diff=1 = growth
                                   since previous call (leak probe; reference
                                   heap/allocs endpoints, pprof.go:10-64)
@@ -85,6 +87,64 @@ def sample_cpu_profile(seconds: float, interval: float = 0.005) -> str:
     for k, v in sorted(counts.items(), key=lambda kv: -kv[1])[:300]:
         lines.append(f"{v} {k}")
     return "\n".join(lines) + "\n"
+
+
+def execution_trace(seconds: float, interval: float = 0.002) -> str:
+    """Per-thread execution timeline in Chrome trace-event JSON (open in
+    Perfetto / chrome://tracing) — the runtime-trace slot of the
+    reference's pprof mount (pprof.go:10-64 serves /debug/pprof/trace).
+
+    Sampling-based like the CPU profile, but shaped as a TIMELINE: each
+    thread gets a lane of complete events, one span per contiguous run
+    of the same executing function, so lock convoys / phase structure /
+    idle gaps are visible in time rather than aggregated away."""
+    me = threading.get_ident()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    seconds = min(max(seconds, 0.1), 10.0)
+    events: list[dict] = []
+    open_spans: dict[int, tuple[str, float]] = {}
+    t0 = time.monotonic()
+    end = t0 + seconds
+
+    def close(tid: int, sig: str, start_us: float, now_us: float) -> None:
+        events.append({
+            "name": sig, "ph": "X", "ts": round(start_us, 1),
+            "dur": round(max(now_us - start_us, 1.0), 1),
+            "pid": 1, "tid": tid,
+        })
+
+    while time.monotonic() < end:
+        now_us = (time.monotonic() - t0) * 1e6
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            if tid == me:
+                continue
+            code = frame.f_code
+            sig = (
+                f"{code.co_name} "
+                f"({code.co_filename.rsplit('/', 1)[-1]})"
+            )
+            cur = open_spans.get(tid)
+            if cur is None:
+                open_spans[tid] = (sig, now_us)
+            elif cur[0] != sig:
+                close(tid, cur[0], cur[1], now_us)
+                open_spans[tid] = (sig, now_us)
+        for tid in list(open_spans):
+            if tid not in frames:  # thread exited: close its span
+                sig, st = open_spans.pop(tid)
+                close(tid, sig, st, now_us)
+        time.sleep(interval)
+    now_us = (time.monotonic() - t0) * 1e6
+    for tid, (sig, st) in open_spans.items():
+        close(tid, sig, st, now_us)
+    for tid, name in names.items():
+        if tid is not None and tid != me:
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": name},
+            })
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
 
 
 def _parse_query(query: str) -> dict[str, str]:
@@ -300,6 +360,15 @@ class ExtenderServer:
             except ValueError:
                 secs = 2.0
             return 200, sample_cpu_profile(secs).encode(), "text/plain"
+        if path == "/debug/pprof/trace":
+            # per-thread execution timeline, Chrome trace-event JSON
+            # (the runtime-trace pprof slot; open in Perfetto)
+            params = _parse_query(query)
+            try:
+                secs = float(params.get("seconds", "1"))
+            except ValueError:
+                secs = 1.0
+            return 200, execution_trace(secs).encode(), "application/json"
         if path == "/debug/pprof/mutex":
             # lock-contention profile (reference mounts Go's mutex/block
             # profiles, pkg/routes/pprof.go:10-64): wait-time summary of

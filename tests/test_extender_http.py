@@ -404,3 +404,43 @@ def test_pprof_mutex_reports_lock_waits(stack):
     s = out["scheduler"]
     assert s["acquisitions"] > 0
     assert s["wait_total_s"] >= 0 and s["wait_p99_s"] >= s["wait_p50_s"]
+
+
+def test_pprof_trace_emits_chrome_timeline(stack):
+    """/debug/pprof/trace: the runtime-trace pprof slot — a per-thread
+    Chrome trace-event timeline with thread-name metadata and complete
+    (ph=X) spans, parseable by Perfetto."""
+    import json as _json
+    import threading
+    import time as _time
+    import urllib.request
+
+    cluster, clientset, port, controller = stack
+    stop = threading.Event()
+
+    def busy():  # a live thread so the trace has something to show
+        while not stop.is_set():
+            sum(range(500))
+            _time.sleep(0.001)
+
+    t = threading.Thread(target=busy, name="trace-busy", daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/trace?seconds=0.3",
+            timeout=15,
+        ) as r:
+            assert r.status == 200
+            doc = _json.loads(r.read())
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    events = doc["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert metas and spans, doc
+    assert any(
+        e["args"]["name"] == "trace-busy" for e in metas
+    ), [e["args"]["name"] for e in metas]
+    for e in spans:
+        assert e["dur"] > 0 and e["ts"] >= 0 and "name" in e
