@@ -18,6 +18,20 @@ CFG = TransformerConfig(
 PARAMS = init_params(jax.random.key(0), CFG)
 
 
+def _run_until_page_pressure(eng, victim, max_iters=40):
+    """Drive the engine until the page pool is exhausted with the victim
+    still mid-flight (the precondition every spill test needs)."""
+    for _ in range(max_iters):
+        eng._admit()
+        if not any(s is not None for s in eng.slots):
+            break
+        eng.step()
+        if len(eng.free_pages) == 0:
+            break
+    assert victim.done.is_set() is False, "victim finished before pressure"
+    assert len(eng.free_pages) == 0, "page pool never exhausted"
+
+
 def test_priority_admission_order():
     """With one slot, queued requests admit highest-class first (FIFO
     within a class) — not submission order."""
@@ -71,16 +85,8 @@ def test_spill_resumes_token_identical():
     )
     victim = eng.submit(Request(prompt=list(victim_prompt),
                                 max_new_tokens=30, priority=0))
-    # let the victim run until the pool is nearly exhausted (small fused
-    # chunks so it is still mid-flight when the high class arrives)
-    for _ in range(40):
-        eng._admit()
-        if not any(s is not None for s in eng.slots):
-            break
-        eng.step()
-        if len(eng.free_pages) == 0:
-            break  # pool exhausted, victim mid-flight
-    assert victim.done.is_set() is False
+    # small fused chunks so the victim is still mid-flight at pressure
+    _run_until_page_pressure(eng, victim)
     high = eng.submit(Request(prompt=[2, 4, 6, 8, 10, 12, 1, 7],
                               max_new_tokens=8, priority=5))
     eng.run_until_idle(max_steps=100_000)
@@ -175,16 +181,41 @@ def test_spill_composes_with_speculation_and_seeds():
             fused_steps=2, **kw,
         )
         victim = eng.submit(Request(**req_kw, priority=0))
-        for _ in range(40):
-            eng._admit()
-            if not any(s is not None for s in eng.slots):
-                break
-            eng.step()
-            if len(eng.free_pages) == 0:
-                break
+        _run_until_page_pressure(eng, victim)
         high = eng.submit(Request(prompt=[2, 4, 6], max_new_tokens=6,
                                   priority=5))
         eng.run_until_idle(max_steps=100_000)
         assert not victim.error and not high.error
         assert eng.spills >= 1, kw
         assert victim.output == ref.output, kw
+
+
+def test_spill_resume_on_tensor_mesh():
+    """Spill-preemption composes with tensor-parallel serving: on a
+    tensor=2 mesh the spilled request's resume is token-identical to the
+    uncontended mesh run (the re-prefill rebuilds sharded KV pages)."""
+    from elastic_gpu_scheduler_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(tensor=2), jax.devices()[:2])
+    ref_eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+        mesh=mesh,
+    )
+    prompt = [3, 9, 14, 27, 5, 1, 2, 6]
+    ref = ref_eng.submit(Request(prompt=list(prompt), max_new_tokens=30))
+    ref_eng.run_until_idle()
+    assert not ref.error and len(ref.output) == 30
+
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+        fused_steps=2, mesh=mesh,
+    )
+    victim = eng.submit(Request(prompt=list(prompt), max_new_tokens=30,
+                                priority=0))
+    _run_until_page_pressure(eng, victim)
+    high = eng.submit(Request(prompt=[2, 4, 6, 8, 10, 12, 1, 7],
+                              max_new_tokens=8, priority=5))
+    eng.run_until_idle(max_steps=100_000)
+    assert not high.error and len(high.output) == 8
+    assert not victim.error and eng.spills >= 1
+    assert victim.output == ref.output
