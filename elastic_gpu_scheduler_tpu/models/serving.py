@@ -56,6 +56,10 @@ from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 
+# Structured drain-rejection sentinel: the HTTP layer maps THIS string to
+# 503 (retryable) on every request shape; compare by constant, not prose.
+DRAINING_ERROR = "server draining"
+
 log = logging.getLogger("tpu-scheduler")
 
 SCRATCH_PAGE = 0  # reserved; inactive slots write here, nobody reads it
@@ -1327,6 +1331,11 @@ class InferenceEngine:
         self.queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._submit_seq = itertools.count()
         self.spills = 0  # low-priority slots spilled under page pressure
+        # graceful drain (k8s SIGTERM contract): True → submit() rejects
+        # new requests while in-flight ones run to completion; the HTTP
+        # front end turns this into 503s + a not-ready /healthz so the
+        # Service stops routing here before the pod exits
+        self.draining = False
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
@@ -1468,6 +1477,10 @@ class InferenceEngine:
     def submit(self, req: Request) -> Request:
         """Validate and enqueue; invalid requests are failed immediately
         (req.error set, done signaled) rather than poisoning the loop."""
+        if self.draining:
+            req.error = DRAINING_ERROR
+            req.done.set()
+            return req
         if len(req.prompt) < 1:
             req.error = "empty prompt"
             req.done.set()

@@ -67,6 +67,10 @@ def build_args(argv=None):
                    help=">0: long prompts ingest this many tokens per "
                         "engine iteration (chunked prefill) so decoding "
                         "requests keep streaming during big admissions")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="graceful-drain window on SIGTERM/SIGINT: stop "
+                        "admitting (healthz 503), let in-flight requests "
+                        "finish, then exit; a second signal hard-stops")
     p.add_argument("--paged-kernel", action="store_true",
                    help="decode attention reads the page pool in place "
                         "via the Pallas kernel (long-context HBM win); "
@@ -198,10 +202,27 @@ def main(argv=None) -> int:
         cfg.n_layers, cfg.d_model, args.host, server.server_address[1],
     )
     stop = threading.Event()
+    signals_seen = []
 
     def on_signal(signum, frame):
-        log.info("signal %d: shutting down", signum)
-        stop.set()
+        signals_seen.append(signum)
+        if len(signals_seen) > 1:
+            log.info("second signal: hard stop")
+            stop.set()
+            return
+        log.info(
+            "signal %d: draining (in-flight requests finish; new ones "
+            "get 503; second signal hard-stops)", signum,
+        )
+
+        def _drain():
+            from .server.inference import drain
+
+            ok = drain(loop, timeout=args.drain_timeout)
+            log.info("drain %s", "complete" if ok else "timed out")
+            stop.set()
+
+        threading.Thread(target=_drain, name="drain", daemon=True).start()
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)

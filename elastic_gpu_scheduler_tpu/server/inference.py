@@ -42,7 +42,7 @@ from typing import Optional
 
 from .. import __version__
 from ..metrics import REGISTRY, Counter, Gauge, Histogram
-from ..models.serving import InferenceEngine, Request
+from ..models.serving import DRAINING_ERROR, InferenceEngine, Request
 from .routes import _REASONS
 
 log = logging.getLogger("tpu-scheduler")
@@ -95,6 +95,22 @@ class EngineLoop:
         self.idle_sleep = idle_sleep
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # drain support: the LOOP thread (sole mutator of queue/slot
+        # state) sets ``drained`` when it observes draining + idle — no
+        # TOCTOU against mid-admission or spill-requeue transitions.
+        # ``http_inflight`` counts handler threads still writing
+        # responses, so drain waits for flushes too (slow SSE clients).
+        self.drained = threading.Event()
+        self.http_inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def inflight_enter(self) -> None:
+        with self._inflight_lock:
+            self.http_inflight += 1
+
+    def inflight_exit(self) -> None:
+        with self._inflight_lock:
+            self.http_inflight -= 1
 
     def start(self) -> "EngineLoop":
         self._thread = threading.Thread(
@@ -117,6 +133,10 @@ class EngineLoop:
                 if any(s is not None for s in eng.slots):
                     eng.step()
                 else:
+                    if eng.draining and eng.queue.empty():
+                        # consistent snapshot: this thread just ran
+                        # _admit and owns every queue→slot transition
+                        self.drained.set()
                     self._stop.wait(self.idle_sleep)
                 failures = 0
             except RuntimeError as e:
@@ -303,6 +323,11 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
 
         def do_GET(self):
             if self.path == "/healthz":
+                if engine.draining:
+                    # not-ready during drain: the Service stops routing
+                    # new requests here while in-flight ones finish
+                    return self._json(503, {"ok": False,
+                                            "draining": True})
                 return self._json(200, {"ok": True})
             if self.path == "/version":
                 return self._json(200, {"version": __version__})
@@ -360,6 +385,15 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             return self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            # drain accounting: the response (incl. a long SSE stream)
+            # must fully flush before a draining process may exit
+            loop.inflight_enter()
+            try:
+                return self._do_post()
+            finally:
+                loop.inflight_exit()
+
+        def _do_post(self):
             if self.path != "/v1/completions":
                 return self._json(404, {"error": f"no route {self.path}"})
             try:
@@ -420,7 +454,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             SERVE_LATENCY.observe(value=time.monotonic() - t0)
             if req.error:
                 SERVE_REQUESTS.inc("error")
-                return self._json(400, {"error": req.error})
+                code = 503 if req.error == DRAINING_ERROR else 400
+                return self._json(code, {"error": req.error})
             SERVE_REQUESTS.inc("ok")
             SERVE_TOKENS.inc(value=len(req.output))
             resp = {"tokens": req.output}
@@ -467,7 +502,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     SERVE_REQUESTS.inc(
                         "cancelled", value=float(len(reqs) - len(errs))
                     )
-                return self._json(400, {"error": errs[0]})
+                code = 503 if errs[0] == DRAINING_ERROR else 400
+                return self._json(code, {"error": errs[0]})
             SERVE_REQUESTS.inc(
                 "timeout" if timed_out else "ok", value=float(len(reqs))
             )
@@ -522,7 +558,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if bad:
                 for r in reqs:
                     r.cancel()
-                return self._json(400, {"error": bad[0].error})
+                code = 503 if bad[0].error == DRAINING_ERROR else 400
+                return self._json(code, {"error": bad[0].error})
             self.send_response(200, "OK")
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -588,6 +625,34 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 SERVE_TOKENS.inc(value=sent)
 
     return InferenceHandler
+
+
+def drain(
+    loop: EngineLoop, timeout: float = 30.0, poll: float = 0.05
+) -> bool:
+    """Graceful drain (the k8s SIGTERM contract): stop admitting new
+    requests (submit → DRAINING_ERROR → 503, /healthz → 503 so the
+    Service pulls this pod), wait for every in-flight request to finish
+    — engine-side via the LOOP thread's own idle observation (no race
+    against queue→slot transitions), then HTTP-side until handler
+    threads have flushed their responses (slow streaming clients).
+    Returns True when fully drained, False on timeout (the caller
+    decides whether to hard-stop).  The engine loop must keep running
+    while draining."""
+    engine = loop.engine
+    engine.draining = True
+    deadline = time.monotonic() + timeout
+    engine_idle = loop.drained.wait(max(0.0, deadline - time.monotonic()))
+    while time.monotonic() < deadline and loop.http_inflight > 0:
+        time.sleep(poll)
+    # final re-check: a timeout=0 call on an idle server must say True
+    return (
+        engine_idle
+        or (
+            not any(s is not None for s in engine.slots)
+            and engine.queue.empty()
+        )
+    ) and loop.http_inflight == 0
 
 
 def serve_inference(

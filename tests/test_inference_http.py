@@ -389,3 +389,68 @@ def test_serving_prometheus_metrics(served):
             break
         _time.sleep(0.05)
     assert SERVE_TOKENS._values.get((), 0.0) == before + 3
+
+
+def test_graceful_drain_finishes_inflight_rejects_new():
+    """The k8s SIGTERM contract (rolling updates): draining stops
+    admission (503 + not-ready healthz, so the Service pulls the pod)
+    while in-flight requests run to completion — no client sees a
+    severed stream."""
+    import threading
+
+    from elastic_gpu_scheduler_tpu.server.inference import (
+        drain,
+        serve_inference,
+    )
+
+    params = init_params(jax.random.key(0), CFG)
+    engine = InferenceEngine(params, CFG, max_batch=2, max_len=64,
+                             page_size=8, fused_steps=2)
+    server, loop = serve_inference(engine, port=0, host="127.0.0.1")
+    addr = server.server_address
+    try:
+        # a long in-flight request via real HTTP, in its own thread
+        result = {}
+
+        def client():
+            result["resp"] = _post(addr, "/v1/completions",
+                                   {"prompt": [3, 9, 14],
+                                    "max_tokens": 40})
+
+        t = threading.Thread(target=client)
+        t.start()
+        # wait until it is actually running in a slot
+        for _ in range(200):
+            if any(s is not None for s in engine.slots):
+                break
+            import time
+            time.sleep(0.02)
+        assert any(s is not None for s in engine.slots)
+
+        drained = {}
+
+        def drainer():
+            drained["ok"] = drain(loop, timeout=60)
+
+        d = threading.Thread(target=drainer)
+        d.start()
+        # new work is rejected with 503 while draining
+        for _ in range(100):
+            if engine.draining:
+                break
+            import time
+            time.sleep(0.01)
+        code, out = _post(addr, "/v1/completions",
+                          {"prompt": [5], "max_tokens": 2})
+        assert code == 503 and "draining" in out["error"]
+        code, out = _get(addr, "/healthz")
+        assert code == 503 and out["draining"] is True
+        # the in-flight request still completes fully
+        t.join(timeout=120)
+        code, out = result["resp"]
+        assert code == 200 and len(out["tokens"]) == 40
+        d.join(timeout=120)
+        assert drained["ok"] is True
+    finally:
+        server.shutdown()
+        loop.stop()
