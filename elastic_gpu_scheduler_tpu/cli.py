@@ -81,7 +81,7 @@ def main(argv=None) -> int:
         help="placement policy: binpack|spread|random|ici-locality",
     )
     p.add_argument(
-        "--mode", default="tpushare", help="comma-separated scheduler modes"
+        "--mode", default="tpushare", help="scheduler mode: tpushare (fractional + whole-chip) or tpuwhole (whole-chip exclusive admission for latency-SLO clusters); exactly one"
     )
     p.add_argument("--port", type=int, default=_env_int("PORT", 39999))
     p.add_argument("--host", default="0.0.0.0")

@@ -205,6 +205,9 @@ class GangCoordinator:
     ) -> tuple[list[str], dict[str, str]]:
         """Plan-once, steer-each-member filter for gang pods."""
         req = request_from_pod(pod)
+        reason = sched.admits(req)
+        if reason is not None:  # mode policy (tpuwhole) covers gangs too
+            return [], {n: reason for n in node_names}
         gkey = self.gang_key(pod, req)
         with self._lock:
             plan = self._plans.get(gkey)
@@ -534,6 +537,9 @@ class GangCoordinator:
         if not self.is_gang_pod(req):
             sched.bind(node, pod)
             return
+        reason = sched.admits(req)
+        if reason is not None:  # a gang bind can arrive without filter
+            raise RuntimeError(f"bind: {reason}")
         gkey = self.gang_key(pod, req)
         with self._lock:
             g = self._gangs.get(gkey)

@@ -4,19 +4,49 @@ Reference: BuildResourceSchedulers / GetResourceScheduler
 (pkg/scheduler/scheduler.go:292-334).  One engine instance is registered under
 *both* the core and HBM resource names (scheduler.go:308-309); dispatch scans
 the pod's container requests for a registered resource (scheduler.go:323-334).
-The reference's pgpu/qgpu modes are commented-out TODOs; here the mode set is
-just ``tpushare`` (fractional + whole-chip in one engine).
+
+The reference's pgpu/qgpu modes are commented-out TODOs (scheduler.go:
+296-316); here BOTH intended modes are live:
+
+- ``tpushare`` — fractional + whole-chip in one engine (the qgpu/gpushare
+  analogue);
+- ``tpuwhole`` — whole-chip-only admission (the pgpu analogue): every
+  container must request whole chips (core a positive multiple of 100,
+  or chip_count), so every tenant gets exclusive TensorCores — the mode
+  for latency-SLO clusters where cooperative fractional sharing
+  (deviceplugin/plugin.py contract) is not acceptable.
+
+The two modes claim the same resource names, so exactly one may be active.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+from ..core.request import TPURequest
 from ..k8s.objects import Pod
 from ..utils import consts
 from .scheduler import ResourceScheduler, SchedulerConfig, TPUUnitScheduler
 
-KNOWN_MODES = ("tpushare",)
+KNOWN_MODES = ("tpushare", "tpuwhole")
+
+
+class TPUWholeScheduler(TPUUnitScheduler):
+    """Whole-chip-only engine: rejects fractional shapes at admission
+    (filter AND bind — a bind can arrive without a filter pass)."""
+
+    def admits(self, request: TPURequest) -> Optional[str]:
+        for name, u in zip(request.container_names, request.units):
+            if not u.needs_tpu or u.wants_whole_chips:
+                continue
+            if u.core <= 0 or u.core % consts.CORE_PER_CHIP:
+                return (
+                    f"mode tpuwhole: container {name!r} requests a "
+                    f"fractional share (core={u.core}, hbm={u.hbm}); "
+                    "whole chips only (core a positive multiple of "
+                    f"{consts.CORE_PER_CHIP})"
+                )
+        return None
 
 
 def build_resource_schedulers(
@@ -25,14 +55,25 @@ def build_resource_schedulers(
     registry: dict[str, ResourceScheduler] = {}
     for mode in modes:
         if mode == "tpushare":
-            engine = TPUUnitScheduler(config, name="tpushare")
-            for res in (
-                *consts.RESOURCE_TPU_CORE_ALIASES,
-                *consts.RESOURCE_TPU_HBM_ALIASES,
-            ):
-                registry[res] = engine
+            engine: TPUUnitScheduler = TPUUnitScheduler(
+                config, name="tpushare"
+            )
+        elif mode == "tpuwhole":
+            engine = TPUWholeScheduler(config, name="tpuwhole")
         else:
-            raise ValueError(f"unknown scheduler mode {mode!r}; known: {KNOWN_MODES}")
+            raise ValueError(
+                f"unknown scheduler mode {mode!r}; known: {KNOWN_MODES}"
+            )
+        for res in (
+            *consts.RESOURCE_TPU_CORE_ALIASES,
+            *consts.RESOURCE_TPU_HBM_ALIASES,
+        ):
+            if res in registry:
+                raise ValueError(
+                    f"modes {registry[res].name!r} and {mode!r} both "
+                    f"claim {res}; run exactly one of tpushare/tpuwhole"
+                )
+            registry[res] = engine
     return registry
 
 

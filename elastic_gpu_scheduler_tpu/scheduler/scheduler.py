@@ -173,12 +173,21 @@ class TPUUnitScheduler(ResourceScheduler):
 
     # -- verbs ---------------------------------------------------------------
 
+    def admits(self, request: TPURequest) -> Optional[str]:
+        """Mode-level admission policy hook: return a rejection reason or
+        None.  The base engine (tpushare) admits every valid request;
+        TPUWholeScheduler (tpuwhole) rejects fractional shapes."""
+        return None
+
     def assume(
         self, node_names: list[str], pod: Pod
     ) -> tuple[list[str], dict[str, str]]:
         """Filter: which candidate nodes can host the pod
         (reference: scheduler.go:112-168)."""
         request = request_from_pod(pod)
+        reason = self.admits(request)
+        if reason is not None:
+            return [], {n: reason for n in node_names}
         with self.lock:
             allocators = [
                 (n, self._get_allocator(n)) for n in node_names
@@ -227,6 +236,9 @@ class TPUUnitScheduler(ResourceScheduler):
         annotation write or binding POST cannot be completed.
         """
         request = request_from_pod(pod)
+        reason = self.admits(request)
+        if reason is not None:  # bind can arrive without a filter pass
+            raise RuntimeError(f"bind: {reason}")
         with self.lock:
             na = self._get_allocator(node_name)
             if na is None:
@@ -274,6 +286,9 @@ class TPUUnitScheduler(ResourceScheduler):
         bind); net-new here.
 
         Semantics:
+        - Mode policy first: a preemptor admits() rejects could never bind
+          after the evictions — return None so kube-scheduler drops the
+          node instead of killing victims for nothing.
         - Simulated on a clone of the node's chip state; no live state is
           touched and nothing is evicted here — kube-scheduler performs the
           actual deletions, and the reconciliation controller frees the chips
@@ -299,6 +314,10 @@ class TPUUnitScheduler(ResourceScheduler):
           whose chips the preemptor does not need.
         """
         request = request_from_pod(pod)
+        if self.admits(request) is not None:
+            # mode policy (tpuwhole): this preemptor could never bind even
+            # with every victim gone — don't kill workloads for nothing
+            return None
         with self.lock:
             na = self._get_allocator(node_name)
         if na is None:

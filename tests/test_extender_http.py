@@ -444,3 +444,149 @@ def test_pprof_trace_emits_chrome_timeline(stack):
     ), [e["args"]["name"] for e in metas]
     for e in spans:
         assert e["dur"] > 0 and e["ts"] >= 0 and "name" in e
+
+
+def test_tpuwhole_mode_rejects_fractional():
+    """The reference's pgpu mode was a commented-out TODO
+    (scheduler.go:296-316); here it is live as ``tpuwhole``: whole-chip
+    exclusive admission for latency-SLO clusters.  Fractional shapes are
+    rejected at filter AND at bind with a named reason; whole-chip pods
+    schedule normally; configuring both modes at once is an error."""
+    from elastic_gpu_scheduler_tpu.cli import build_stack
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+    from elastic_gpu_scheduler_tpu.k8s.extender import (
+        ExtenderArgs,
+        ExtenderBindingArgs,
+    )
+    from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+    from elastic_gpu_scheduler_tpu.k8s.objects import make_tpu_node
+
+    cluster = FakeCluster()
+    cluster.add_node(
+        make_tpu_node("w-n0", chips=4, hbm_gib=64, accelerator="v5e")
+    )
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(FakeClientset(cluster), cluster=cluster,
+                    priority="binpack", modes=("tpuwhole",))
+    )
+    whole = tpu_pod("w-ok", core=200)
+    cluster.create_pod(whole)
+    r = predicate.handle(ExtenderArgs(pod=whole, node_names=["w-n0"]))
+    assert r.node_names == ["w-n0"], r.failed_nodes
+    res = bind.handle(ExtenderBindingArgs(
+        pod_name="w-ok", pod_namespace="default",
+        pod_uid=whole.metadata.uid, node="w-n0",
+    ))
+    assert not res.error, res.error
+
+    frac = tpu_pod("w-frac", core=50)
+    cluster.create_pod(frac)
+    r = predicate.handle(ExtenderArgs(pod=frac, node_names=["w-n0"]))
+    assert not r.node_names
+    assert "tpuwhole" in r.failed_nodes["w-n0"]
+    assert "fractional" in r.failed_nodes["w-n0"]
+    # bind without a filter pass is rejected too
+    res = bind.handle(ExtenderBindingArgs(
+        pod_name="w-frac", pod_namespace="default",
+        pod_uid=frac.metadata.uid, node="w-n0",
+    ))
+    assert res.error and "tpuwhole" in res.error
+
+    # both modes at once: a configuration error, not a silent override
+    import pytest
+
+    from elastic_gpu_scheduler_tpu.scheduler.registry import (
+        build_resource_schedulers,
+    )
+    from elastic_gpu_scheduler_tpu.scheduler.scheduler import SchedulerConfig
+    from elastic_gpu_scheduler_tpu.core.rater import get_rater
+
+    with pytest.raises(ValueError, match="claim"):
+        build_resource_schedulers(
+            ["tpushare", "tpuwhole"],
+            SchedulerConfig(clientset=FakeClientset(cluster),
+                            rater=get_rater("binpack")),
+        )
+
+
+def test_tpuwhole_covers_gangs_and_preemption():
+    """The mode policy must hold on EVERY scheduling path: a fractional
+    GANG is rejected at gang filter and gang bind, and a fractional
+    preemptor gets no victims (it could never bind after the evictions)."""
+    from elastic_gpu_scheduler_tpu.cli import build_stack
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+    from elastic_gpu_scheduler_tpu.k8s.extender import (
+        ExtenderArgs,
+        ExtenderBindingArgs,
+    )
+    from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+    from elastic_gpu_scheduler_tpu.k8s.objects import (
+        Container,
+        ResourceRequirements,
+        make_pod,
+        make_tpu_node,
+    )
+
+    cluster = FakeCluster()
+    cluster.add_node(
+        make_tpu_node("wg-n0", chips=4, hbm_gib=64, accelerator="v5e")
+    )
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(FakeClientset(cluster), cluster=cluster,
+                    priority="binpack", modes=("tpuwhole",))
+    )
+
+    def frac_gang_pod(name):
+        return make_pod(
+            name,
+            containers=[Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: 50}
+                ),
+            )],
+            annotations={
+                consts.ANNOTATION_GANG_NAME: "wg",
+                consts.ANNOTATION_GANG_SIZE: "2",
+            },
+            uid=f"uid-{name}",
+        )
+
+    g0 = frac_gang_pod("wg-0")
+    cluster.create_pod(g0)
+    r = predicate.handle(ExtenderArgs(pod=g0, node_names=["wg-n0"]))
+    assert not r.node_names
+    assert "tpuwhole" in r.failed_nodes["wg-n0"]
+    res = bind.handle(ExtenderBindingArgs(
+        pod_name="wg-0", pod_namespace="default",
+        pod_uid=g0.metadata.uid, node="wg-n0",
+    ))
+    assert res.error and "tpuwhole" in res.error
+
+    # fractional preemptor: no victims proposed, nothing evicted
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    victim = make_pod(
+        "wg-victim",
+        containers=[Container(
+            name="main",
+            resources=ResourceRequirements(
+                limits={consts.RESOURCE_TPU_CORE: 400}
+            ),
+        )],
+        uid="uid-wg-victim",
+    )
+    cluster.create_pod(victim)
+    assert sched.assume(["wg-n0"], victim)[0] == ["wg-n0"]
+    sched.bind("wg-n0", victim)
+    frac_preemptor = make_pod(
+        "wg-pre",
+        containers=[Container(
+            name="main",
+            resources=ResourceRequirements(
+                limits={consts.RESOURCE_TPU_CORE: 50}
+            ),
+        )],
+        uid="uid-wg-pre",
+    )
+    frac_preemptor.spec.priority = 1000
+    assert sched.preempt("wg-n0", frac_preemptor, [victim]) is None
