@@ -56,9 +56,11 @@ from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
 
-# Structured drain-rejection sentinel: the HTTP layer maps THIS string to
-# 503 (retryable) on every request shape; compare by constant, not prose.
+# Structured rejection sentinels: the HTTP layer maps THESE strings to
+# retryable statuses (503 / 429) on every request shape; compare by
+# constant, not prose.
 DRAINING_ERROR = "server draining"
+QUEUE_FULL_ERROR = "admission queue full"
 
 log = logging.getLogger("tpu-scheduler")
 
@@ -1180,6 +1182,7 @@ class InferenceEngine:
         paged_kernel: bool = False,
         logprobs_k: int = 5,
         prefill_chunk: int = 0,
+        max_queue: int = 0,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -1331,6 +1334,13 @@ class InferenceEngine:
         self.queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._submit_seq = itertools.count()
         self.spills = 0  # low-priority slots spilled under page pressure
+        # bounded admission (0 = unbounded): when the queue holds this
+        # many requests, submit() rejects with QUEUE_FULL_ERROR (HTTP
+        # 429) instead of growing tail latency without bound.  Spill
+        # requeues bypass the cap — they are in-flight work, not new
+        # admissions.
+        self.max_queue = max(0, max_queue)
+        self._cap_lock = threading.Lock()  # atomic cap-check + enqueue
         # graceful drain (k8s SIGTERM contract): True → submit() rejects
         # new requests while in-flight ones run to completion; the HTTP
         # front end turns this into 503s + a not-ready /healthz so the
@@ -1556,8 +1566,40 @@ class InferenceEngine:
         # the top-k width is compiled into the chunk (engine logprobs_k);
         # a wider ask gets the compiled width
         req.logprobs = min(max(0, req.logprobs), self.logprobs_k)
+        if self.max_queue:
+            # cap-check + enqueue must be atomic across handler threads
+            # (ThreadingHTTPServer), else a burst overshoots the bound;
+            # entries whose clients already cancelled (timeout 504s) are
+            # purged first so dead requests can't 429 live traffic
+            with self._cap_lock:
+                if self.queue.qsize() >= self.max_queue:
+                    self._purge_cancelled_queued()
+                    if self.queue.qsize() >= self.max_queue:
+                        req.error = QUEUE_FULL_ERROR
+                        req.done.set()
+                        return req
+                self._enqueue(req)
+            return req
         self._enqueue(req)
         return req
+
+    def _purge_cancelled_queued(self) -> None:
+        """Drop queued entries whose requests were cancelled while
+        waiting (client timeout/disconnect) — normally reaped lazily by
+        _admit, but the admission cap must not count them against live
+        traffic.  Safe against the engine thread: all list surgery is
+        under the queue's own mutex."""
+        import heapq
+
+        with self.queue.mutex:
+            q = self.queue.queue
+            dead = [e for e in q if e[2].cancelled]
+            for e in dead:
+                q.remove(e)
+            if dead:
+                heapq.heapify(q)
+        for e in dead:
+            e[2].done.set()
 
     def _enqueue(self, req: Request) -> None:
         """Priority-ordered admission queue entry (also the spill-requeue

@@ -67,6 +67,9 @@ def build_args(argv=None):
                    help=">0: long prompts ingest this many tokens per "
                         "engine iteration (chunked prefill) so decoding "
                         "requests keep streaming during big admissions")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help=">0: bound the admission queue; excess requests "
+                        "get 429 instead of unbounded tail latency")
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    help="graceful-drain window on SIGTERM/SIGINT: stop "
                         "admitting (healthz 503), let in-flight requests "
@@ -193,7 +196,8 @@ def main(argv=None) -> int:
         fused_steps=args.fused_steps, kv_int8=args.kv_int8,
         prefix_cache=args.prefix_cache, spec_k=args.spec_k, draft=draft,
         mesh=mesh, paged_kernel=args.paged_kernel,
-        prefill_chunk=args.prefill_chunk, logprobs_k=args.logprobs_k,
+        prefill_chunk=args.prefill_chunk,
+        max_queue=args.max_queue, logprobs_k=args.logprobs_k,
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

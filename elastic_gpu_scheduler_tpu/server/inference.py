@@ -42,7 +42,12 @@ from typing import Optional
 
 from .. import __version__
 from ..metrics import REGISTRY, Counter, Gauge, Histogram
-from ..models.serving import DRAINING_ERROR, InferenceEngine, Request
+from ..models.serving import (
+    DRAINING_ERROR,
+    QUEUE_FULL_ERROR,
+    InferenceEngine,
+    Request,
+)
 from .routes import _REASONS
 
 log = logging.getLogger("tpu-scheduler")
@@ -357,6 +362,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "queued_by_priority": {
                         str(k): v for k, v in eng.queue_depths().items()
                     },
+                    "max_queue": eng.max_queue,
                     "spills": int(eng.spills),
                     "active_slots": sum(
                         1 for s in eng.slots if s is not None
@@ -454,7 +460,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             SERVE_LATENCY.observe(value=time.monotonic() - t0)
             if req.error:
                 SERVE_REQUESTS.inc("error")
-                code = 503 if req.error == DRAINING_ERROR else 400
+                code = _reject_code(req.error)
                 return self._json(code, {"error": req.error})
             SERVE_REQUESTS.inc("ok")
             SERVE_TOKENS.inc(value=len(req.output))
@@ -502,7 +508,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     SERVE_REQUESTS.inc(
                         "cancelled", value=float(len(reqs) - len(errs))
                     )
-                code = 503 if errs[0] == DRAINING_ERROR else 400
+                code = _reject_code(errs[0])
                 return self._json(code, {"error": errs[0]})
             SERVE_REQUESTS.inc(
                 "timeout" if timed_out else "ok", value=float(len(reqs))
@@ -558,7 +564,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             if bad:
                 for r in reqs:
                     r.cancel()
-                code = 503 if bad[0].error == DRAINING_ERROR else 400
+                code = _reject_code(bad[0].error)
                 return self._json(code, {"error": bad[0].error})
             self.send_response(200, "OK")
             self.send_header("Content-Type", "text/event-stream")
@@ -625,6 +631,17 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 SERVE_TOKENS.inc(value=sent)
 
     return InferenceHandler
+
+
+def _reject_code(error: str) -> int:
+    """Map structured engine rejections to retryable statuses: draining →
+    503 (pod going away; retry elsewhere), queue full → 429 (back off);
+    everything else is a client error (400)."""
+    if error == DRAINING_ERROR:
+        return 503
+    if error == QUEUE_FULL_ERROR:
+        return 429
+    return 400
 
 
 def drain(

@@ -49,6 +49,7 @@ from .handlers import Bind, Predicate, Preemption, Prioritize
 log = logging.getLogger("tpu-scheduler")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            429: "Too Many Requests",
             405: "Method Not Allowed", 500: "Internal Server Error",
             503: "Service Unavailable", 504: "Gateway Timeout"}
 

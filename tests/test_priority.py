@@ -219,3 +219,97 @@ def test_spill_resume_on_tensor_mesh():
     assert not high.error and len(high.output) == 8
     assert not victim.error and eng.spills >= 1
     assert victim.output == ref.output
+
+
+def test_bounded_admission_queue():
+    """max_queue caps the admission queue: excess submissions are
+    rejected with the structured QUEUE_FULL_ERROR (HTTP 429) instead of
+    growing tail latency without bound; spill requeues bypass the cap
+    (they are in-flight work, not new admissions)."""
+    from elastic_gpu_scheduler_tpu.models.serving import QUEUE_FULL_ERROR
+
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8,
+                          max_queue=2)
+    a = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    eng._admit()  # a takes the slot
+    b = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    c = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    assert not b.error and not c.error  # queue holds 2
+    d = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    assert d.error == QUEUE_FULL_ERROR
+    eng.run_until_idle()
+    for r in (a, b, c):
+        assert not r.error and len(r.output) == 1
+    # a spill requeue is NOT subject to the cap: _enqueue directly
+    eng2 = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8,
+                           max_queue=1)
+    queued = eng2.submit(Request(prompt=[3], max_new_tokens=1))
+    extra = Request(prompt=[5], max_new_tokens=1)
+    eng2._enqueue(extra)  # internal path (spill) bypasses max_queue
+    eng2.run_until_idle()
+    assert not queued.error and len(extra.output) == 1
+
+
+def test_queue_full_maps_to_429_over_http():
+    import http.client
+    import json as _json
+
+    from elastic_gpu_scheduler_tpu.server.inference import serve_inference
+
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8,
+                          fused_steps=1, max_queue=1)
+    server, loop = serve_inference(eng, port=0, host="127.0.0.1")
+    addr = server.server_address
+    try:
+        import threading
+
+        def post(body):
+            conn = http.client.HTTPConnection(*addr, timeout=60)
+            conn.request("POST", "/v1/completions", _json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            out = r.status, _json.loads(r.read())
+            conn.close()
+            return out
+
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(
+                post({"prompt": [3, 9], "max_tokens": 24})
+            ))
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        codes = sorted(c for c, _ in results)
+        assert 429 in codes, codes  # at least one rejected under burst
+        assert 200 in codes, codes  # and the admitted ones completed
+        for c, body in results:
+            if c == 429:
+                assert "queue full" in body["error"]
+    finally:
+        server.shutdown()
+        loop.stop()
+
+
+def test_cancelled_queued_entries_do_not_count_against_cap():
+    """Dead queue entries (client cancelled while waiting) must not 429
+    live traffic: the cap path purges them before rejecting."""
+    from elastic_gpu_scheduler_tpu.models.serving import QUEUE_FULL_ERROR
+
+    eng = InferenceEngine(PARAMS, CFG, max_batch=1, max_len=32, page_size=8,
+                          max_queue=2)
+    eng.submit(Request(prompt=[3], max_new_tokens=1))
+    eng._admit()  # slot taken
+    dead1 = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    dead2 = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    dead1.cancel()
+    dead2.cancel()
+    # queue is "full" of corpses; a live submission must still admit
+    live = eng.submit(Request(prompt=[3], max_new_tokens=1))
+    assert not live.error, live.error
+    assert dead1.done.is_set() and dead2.done.is_set()  # purged + acked
+    eng.run_until_idle()
+    assert len(live.output) == 1
