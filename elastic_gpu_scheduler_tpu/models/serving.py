@@ -1671,13 +1671,17 @@ class InferenceEngine:
         for i in range(self.max_batch):
             if self.slots[i] is not None:
                 continue
-            try:
-                neg, seq, req = self.queue.get_nowait()
-            except queue.Empty:
-                return
-            if stall_floor is not None and req.priority < stall_floor:
-                self.queue.put((neg, seq, req))  # keeps its FIFO position
-                return  # everything below is lower-priority still
+            # pop-or-putback under the cap lock: without it, a submit
+            # between this pop and the stall-floor put-back would see a
+            # transiently short queue and overshoot max_queue by one
+            with self._cap_lock:
+                try:
+                    neg, seq, req = self.queue.get_nowait()
+                except queue.Empty:
+                    return
+                if stall_floor is not None and req.priority < stall_floor:
+                    self.queue.put((neg, seq, req))  # keeps FIFO position
+                    return  # everything below is lower-priority still
             if req.cancelled:  # cancelled while still queued
                 req.done.set()
                 continue

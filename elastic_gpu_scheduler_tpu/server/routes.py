@@ -398,19 +398,50 @@ class ExtenderServer:
             # caches); kube-scheduler retries against the leader
             VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "not_leader")
             return 503, b'{"Error": "not the leader"}', "application/json"
+        # route existence FIRST: unknown paths are 404s regardless of
+        # body, and metric labels only ever come from this fixed verb
+        # set (an attacker cycling random paths must not grow /metrics)
+        known = {
+            "/scheduler/filter", "/scheduler/priorities", "/scheduler/bind",
+        }
+        if self.preemption is not None:
+            known.add("/scheduler/preemption")
+        if path not in known:
+            return (
+                404, json.dumps({"error": f"no route {path}"}).encode(),
+                "application/json",
+            )
+        verb = path.rsplit("/", 1)[-1]
         try:
             body = json.loads(raw or b"{}")
         except (ValueError, json.JSONDecodeError):
-            VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "bad_request")
+            VERB_TOTAL.inc(verb, "bad_request")
             return 400, b'{"Error": "malformed JSON body"}', "application/json"
+        if not isinstance(body, dict):
+            # parses but isn't an object ([], null, 42): a structured 400,
+            # never a 500 from from_dict choking downstream
+            VERB_TOTAL.inc(verb, "bad_request")
+            return (
+                400, b'{"Error": "body must be a JSON object"}',
+                "application/json",
+            )
         if path == "/scheduler/filter":
             # the nodeCacheCapable=false (Nodes-list) form is refused by
             # Predicate.handle itself with the reference's 200+Error shape
             # (routes.go:59-64) — no route-level special case needed
-            return self._verb("filter", lambda: self.predicate.handle(
-                ExtenderArgs.from_dict(body)).to_dict())
+            args, err = self._parse("filter", ExtenderArgs.from_dict, body)
+            if err is not None:
+                return err
+            return self._verb(
+                "filter", lambda: self.predicate.handle(args).to_dict()
+            )
         if path == "/scheduler/priorities":
-            if ExtenderArgs.from_dict(body).node_names is None:
+            args, err = self._parse(
+                "priorities", ExtenderArgs.from_dict, body
+            )
+            if err is not None:
+                return err
+            if args.node_names is None:
                 # nodeCacheCapable=false form: the reference PANICS here
                 # (routes.go:98,103 — SURVEY quirk not replicated);
                 # structured 400 instead
@@ -420,16 +451,43 @@ class ExtenderServer:
                              "NodeNames form",
                 }).encode(), "application/json"
             return self._verb("priorities", lambda: [
-                hp.to_dict()
-                for hp in self.prioritize.handle(ExtenderArgs.from_dict(body))
+                hp.to_dict() for hp in self.prioritize.handle(args)
             ])
         if path == "/scheduler/bind":
-            return self._verb("bind", lambda: self.bind.handle(
-                ExtenderBindingArgs.from_dict(body)).to_dict())
-        if path == "/scheduler/preemption" and self.preemption is not None:
-            return self._verb("preemption", lambda: self.preemption.handle(
-                ExtenderPreemptionArgs.from_dict(body)).to_dict())
-        return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
+            args, err = self._parse(
+                "bind", ExtenderBindingArgs.from_dict, body
+            )
+            if err is not None:
+                return err
+            return self._verb(
+                "bind", lambda: self.bind.handle(args).to_dict()
+            )
+        # path == "/scheduler/preemption" (membership checked above)
+        args, err = self._parse(
+            "preemption", ExtenderPreemptionArgs.from_dict, body
+        )
+        if err is not None:
+            return err
+        return self._verb(
+            "preemption", lambda: self.preemption.handle(args).to_dict()
+        )
+
+    def _parse(self, verb: str, parser: Callable, body: dict):
+        """Wire-type parsing as a structured 400 (malformed client input
+        must never surface as a 500 from deep inside a from_dict — the
+        fuzz suite pins this)."""
+        try:
+            return parser(body), None
+        except Exception as e:
+            VERB_TOTAL.inc(verb, "bad_request")
+            return None, (
+                400,
+                json.dumps({
+                    "Error": f"malformed {verb} body: "
+                             f"{e.__class__.__name__}: {e}"
+                }).encode(),
+                "application/json",
+            )
 
     def _verb(self, verb: str, fn: Callable[[], object]) -> tuple[int, bytes, str]:
         try:

@@ -226,3 +226,61 @@ def test_cfg_param_count_matches_real_params():
     ):
         real = param_count(init_params(jax.random.key(0), cfg))
         assert _cfg_param_count(cfg) == real, (cfg, _cfg_param_count(cfg), real)
+
+
+def test_priority_spill_churn_soak():
+    """Priority/spill soak (round 5): waves of mixed-priority requests
+    at heavy page pressure — spills, resumes, queue-cap rejections and
+    last-resort pool preemptions all churning together — with the exact
+    page-accounting partition checked between waves and every surviving
+    request's floor/length contract intact."""
+    rng = np.random.default_rng(11)
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=3, max_len=64, page_size=8, n_pages=7,
+        fused_steps=2, prefix_cache=True, max_queue=8,
+    )
+    from elastic_gpu_scheduler_tpu.models.serving import QUEUE_FULL_ERROR
+    from elastic_gpu_scheduler_tpu.server.inference import EngineLoop
+
+    # the production driver: EngineLoop owns the last-resort pool
+    # preemption that total exhaustion falls back to
+    loop = EngineLoop(eng).start()
+    completed = rejected = preempted = 0
+    for wave in range(8):
+        reqs = []
+        for k in range(6):
+            pri = int(rng.integers(-1, 3))
+            n_new = int(rng.integers(8, 25))
+            plen = int(rng.integers(4, 13))
+            reqs.append(eng.submit(Request(
+                prompt=[int(t) for t in rng.integers(0, 64, plen)],
+                max_new_tokens=n_new,
+                priority=pri,
+                temperature=0.7 if k % 3 == 0 else 0.0,
+                seed=int(wave * 10 + k) if k % 3 == 0 else None,
+            )))
+        if wave % 3 == 1:
+            reqs[2].cancel()  # churn the cancel path too
+        for r in reqs:
+            assert r.done.wait(timeout=180), "request never finished"
+        for r in reqs:
+            if r.error == QUEUE_FULL_ERROR:
+                rejected += 1
+            elif "preempted" in (r.error or ""):
+                preempted += 1
+            elif not r.error and not r.cancelled:
+                completed += 1
+                assert 1 <= len(r.output) <= r.max_new_tokens
+        # quiesce the loop before auditing shared page state
+        for _ in range(2000):
+            if not any(s is not None for s in eng.slots) and eng.queue.empty():
+                break
+            import time as _t
+            _t.sleep(0.005)
+        check_page_accounting(eng)
+    loop.stop()
+    assert completed >= 20, (completed, rejected, preempted, eng.spills)
+    # the soak actually exercised the pressure machinery
+    assert eng.spills >= 1 or preempted >= 1 or rejected >= 1, (
+        eng.spills, preempted, rejected
+    )

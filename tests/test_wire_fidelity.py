@@ -278,3 +278,50 @@ def test_string_quantities_through_request_parse():
     assert len(req.units) == 1
     assert req.units[0].chip_count == 2  # "200" core = 2 whole chips
     assert req.units[0].hbm == 4
+
+
+def test_malformed_wire_input_never_5xxes(served):
+    """Adversarial wire fuzz: random/malformed bodies against every POST
+    verb must produce structured 4xx responses — never a 5xx, never a
+    crashed worker (the reference PANICS on malformed prioritize input,
+    routes.go:98-109; this pins the deliberate deviation)."""
+    import http.client
+    import json as _json
+    import random
+
+    _, _, base = served
+    port = int(base.rsplit(":", 1)[1])
+    rng = random.Random(7)
+    payloads = [
+        b"",                      # empty body
+        b"{",                     # truncated JSON
+        b"[]",                    # wrong top-level type
+        b"null",
+        b'{"Pod": null, "NodeNames": null}',
+        b'{"Pod": 42, "NodeNames": "x"}',
+        b'{"Pod": {"metadata": {"name": 5}}, "NodeNames": [1, 2]}',
+        b'{"NodeNames": ["n"]}',  # missing Pod
+        b'{"PodName": null, "Node": 7}',
+        _json.dumps({"Pod": {"x": "y" * 10000}}).encode(),
+    ] + [
+        bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+        for _ in range(20)
+    ]
+    paths = ["/scheduler/filter", "/scheduler/priorities",
+             "/scheduler/bind", "/scheduler/preemption"]
+    for path in paths:
+        for body in payloads:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+            try:
+                conn.request("POST", path, body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status < 500, (path, body[:50], resp.status)
+                resp.read()
+            finally:
+                conn.close()
+    # the server survived the storm: a well-formed probe still answers
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    conn.request("GET", "/healthz")
+    assert conn.getresponse().status == 200
+    conn.close()
