@@ -145,6 +145,20 @@ def main():
         print(decode([ev["token"]]), end="", flush=True)
     print()
 
+    # 7. priority / SLO classes: an interactive request outranks batch
+    # work — under KV page pressure the engine spills the lower class
+    # (exact resume) instead of stalling this one.  min_tokens floors
+    # the length (vLLM semantics: stop ids unsampleable pre-floor).
+    # Operational statuses worth handling: 429 = admission queue full
+    # (--max-queue; retry with backoff), 503 = server draining
+    # (rolling update; retry against another replica).
+    out = post(base, {
+        "prompt": ids, "max_tokens": 12,
+        "priority": 5,          # higher = more important; default 0
+        "min_tokens": 4,
+    })
+    print("\nhigh-priority answer:", decode(out["tokens"]))
+
 
 if __name__ == "__main__":
     main()
