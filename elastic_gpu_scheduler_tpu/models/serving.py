@@ -2047,7 +2047,38 @@ class InferenceEngine:
                     self._release_slot(i)
                     continue
                 if self.prefilling[i]:
-                    continue  # mid-chunked-prefill: fed by _continue_prefills
+                    # mid-chunked-prefill: fed by _continue_prefills (it
+                    # retries regardless of the stalled flag; the flag
+                    # only feeds the exhaustion check and spill
+                    # accounting).  A pool-pressure stall it recorded may
+                    # be stale after a spill freed pages — clear it iff
+                    # the FULL next-pass target is grantable (the same
+                    # t0+C / plen branch _try_prefill takes; a partial
+                    # probe would be satisfied by leftover partial growth
+                    # and mask a real stall), and never by grabbing pages
+                    # a higher-priority stalled slot is waiting for.
+                    if self.stalled[i]:
+                        hp = max(
+                            (
+                                int(self.priorities[j])
+                                for j, r in enumerate(self.slots)
+                                if r is not None and self.stalled[j]
+                                and j != i
+                            ),
+                            default=None,
+                        )
+                        if hp is not None and hp > int(self.priorities[i]):
+                            continue  # yield the freed pages upward
+                        t0 = int(self.lengths[i])
+                        plen = int(self.prompt_lens[i])
+                        C = self.prefill_chunk
+                        target = (
+                            t0 + C if C > 0 and (plen - t0) - 1 > C
+                            else plen
+                        )
+                        if self._ensure_pages(i, target):
+                            self.stalled[i] = False
+                    continue
                 if self._ensure_pages(i, int(self.lengths[i]) + lookahead):
                     active[i] = True
                     self.stalled[i] = False
@@ -2097,8 +2128,10 @@ class InferenceEngine:
         victims = [
             i for i, req in enumerate(self.slots)
             if req is not None and int(self.priorities[i]) < need
-            and not self.stalled[i]
         ]
+        # a STALLED lower-priority slot is still a victim: when both
+        # classes are page-starved, the lower one yields (the strict <
+        # comparison already keeps the needer from victimizing itself)
         if not victims:
             return False
         v = min(

@@ -313,3 +313,106 @@ def test_cancelled_queued_entries_do_not_count_against_cap():
     assert dead1.done.is_set() and dead2.done.is_set()  # purged + acked
     eng.run_until_idle()
     assert len(live.output) == 1
+
+
+def test_spill_victim_mid_chunked_prefill_resumes_exact():
+    """A victim spilled while still in CHUNKED PREFILL (nothing emitted
+    yet) restarts cleanly: prefilling state resets with the slot and the
+    resumed run is token-identical to an uncontended one.  A spy on
+    _maybe_spill asserts the spill REALLY fired while the victim was
+    prefilling — the scenario cannot silently degrade to the plain
+    mid-decode spill the sibling test covers."""
+    long_prompt = [int(t) for t in np.arange(1, 33) % 60]  # 32 tokens
+    ref_eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+        prefill_chunk=8,
+    )
+    ref = ref_eng.submit(Request(prompt=list(long_prompt),
+                                 max_new_tokens=8))
+    ref_eng.run_until_idle()
+    assert not ref.error and len(ref.output) == 8
+
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+        prefill_chunk=8, fused_steps=2,
+    )
+    spilled_while_prefilling = []
+    orig_spill = eng._maybe_spill
+
+    def spy():
+        before = eng.prefilling.copy()
+        slots_before = list(eng.slots)
+        did = orig_spill()
+        if did:
+            for i, s in enumerate(slots_before):
+                if s is not None and eng.slots[i] is None:
+                    spilled_while_prefilling.append(bool(before[i]))
+        return did
+
+    eng._maybe_spill = spy
+    victim = eng.submit(Request(prompt=list(long_prompt),
+                                max_new_tokens=8, priority=0))
+    # drive until the victim holds 3 pages and is STILL mid-prefill
+    for _ in range(10):
+        eng._admit()
+        if len(eng.slot_pages[0]) >= 3:
+            break
+        eng.step()
+    assert eng.prefilling[0] and len(victim.output) == 0
+    # high class sized so its prefill fits the remaining 2 pages and its
+    # FIRST decode step crosses a page boundary: it stalls while the
+    # victim is still prefilling, forcing the mid-prefill spill
+    high = eng.submit(Request(
+        prompt=[2, 4, 6, 8, 10, 12, 1, 7, 3, 5, 9, 11, 13, 15, 17],
+        max_new_tokens=16, priority=5,
+    ))
+    eng.run_until_idle(max_steps=100_000)
+    assert not high.error and len(high.output) == 16
+    assert not victim.error, victim.error
+    assert eng.spills >= 1
+    assert True in spilled_while_prefilling, spilled_while_prefilling
+    assert victim.output == ref.output
+
+
+def test_spill_resume_keeps_logprobs_lockstep():
+    """Across a spill/resume, the logprobs lists stay in lockstep with
+    the output and the VALUES match the uncontended run (greedy — same
+    distributions either way)."""
+    ref_eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=9,
+        logprobs_k=3,
+    )
+    prompt = [3, 9, 14, 27, 5, 1, 2, 6]
+    ref = ref_eng.submit(Request(prompt=list(prompt), max_new_tokens=30,
+                                 logprobs=2))
+    ref_eng.run_until_idle()
+    assert not ref.error
+
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+        fused_steps=2, logprobs_k=3,
+    )
+    victim = eng.submit(Request(prompt=list(prompt), max_new_tokens=30,
+                                logprobs=2, priority=0))
+    _run_until_page_pressure(eng, victim)
+    high = eng.submit(Request(prompt=[2, 4, 6, 8, 10, 12, 1, 7],
+                              max_new_tokens=8, priority=5))
+    eng.run_until_idle(max_steps=100_000)
+    assert not victim.error and not high.error
+    assert eng.spills >= 1
+    assert victim.output == ref.output
+    assert len(victim.token_logprobs) == len(victim.output)
+    assert len(victim.top_logprobs) == len(victim.output)
+    np.testing.assert_allclose(
+        np.array(victim.token_logprobs, np.float64),
+        np.array(ref.token_logprobs, np.float64),
+        rtol=2e-4, atol=2e-5,
+    )
+    # the per-token ALTERNATIVES match too: ids exact, values close
+    for got, want in zip(victim.top_logprobs, ref.top_logprobs):
+        assert [t for t, _ in got] == [t for t, _ in want], (got, want)
+        np.testing.assert_allclose(
+            np.array([lp for _, lp in got], np.float64),
+            np.array([lp for _, lp in want], np.float64),
+            rtol=2e-4, atol=2e-5,
+        )
