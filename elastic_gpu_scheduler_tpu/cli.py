@@ -117,8 +117,19 @@ def main(argv=None) -> int:
         "size for max expected gang concurrency — a gang bind parks one "
         "worker per member at the barrier",
     )
+    p.add_argument(
+        "--trace-sample", type=float, default=None,
+        help="scheduling-trace sampling rate (1.0 = trace every pod, 0 = "
+        "off; default from TPU_TRACE_SAMPLE, else 1.0).  /traces and "
+        "/debug/schedule/<pod> serve the result",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
+
+    if args.trace_sample is not None:
+        from .tracing import TRACER
+
+        TRACER.configure(args.trace_sample)
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -29,6 +29,7 @@ from ..k8s.objects import Pod
 from ..scheduler.registry import get_resource_scheduler, is_tpu_pod
 from ..scheduler.scheduler import ResourceScheduler
 from ..core.annotations import assigned_node, is_assumed
+from ..tracing import NOOP_SPAN, TRACER
 
 log = logging.getLogger("tpu-scheduler")
 
@@ -193,22 +194,40 @@ class Controller:
                 self.wq.add_rate_limited(key)
 
     def sync_pod(self, key: str) -> None:
-        """Reference: syncPod (controller.go:154-185)."""
-        ns, _, name = key.partition("/")
-        try:
-            pod = self.cluster.get_pod(ns, name)
-        except Exception as e:
-            if is_not_found(e):
-                with self._seen_lock:
-                    pod = self._last_seen.pop(key, None)
-                if pod is not None:
-                    self._release(pod)
-                return
-            raise
-        if pod.is_completed():
-            self._release(pod)
-        elif pod.spec.node_name and is_assumed(pod):
-            self._assign(pod)
+        """Reference: syncPod (controller.go:154-185).
+
+        Traced only when the pod already has an open scheduling trace
+        (a pod mid-placement): the periodic resync walks EVERY TPU pod,
+        and minting a span per walked pod would bury real traces."""
+        ctx = TRACER.pod_context(key)
+        sp = (
+            TRACER.span("controller.sync", parent=ctx, pod=key)
+            if ctx is not None
+            else NOOP_SPAN
+        )
+        with sp:
+            ns, _, name = key.partition("/")
+            try:
+                pod = self.cluster.get_pod(ns, name)
+            except Exception as e:
+                if is_not_found(e):
+                    with self._seen_lock:
+                        pod = self._last_seen.pop(key, None)
+                    if pod is not None:
+                        sp.set_attr("action", "release_deleted")
+                        self._release(pod)
+                    # a deleted pod's scheduling story is over — close its
+                    # trace instead of waiting for FIFO eviction
+                    TRACER.finish_pod(key, status="deleted")
+                    return
+                raise
+            if pod.is_completed():
+                sp.set_attr("action", "release_completed")
+                self._release(pod)
+                TRACER.finish_pod(key, status="completed")
+            elif pod.spec.node_name and is_assumed(pod):
+                sp.set_attr("action", "assign")
+                self._assign(pod)
 
     def _release(self, pod: Pod) -> None:
         """Reference: releasePod bridge (controller.go:301-307)."""

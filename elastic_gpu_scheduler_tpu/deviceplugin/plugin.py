@@ -75,9 +75,25 @@ import grpc
 
 from . import deviceplugin_pb2 as pb
 from ..core.topology import Topology, parse_coord, parse_topology
+from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..utils import consts
 
 log = logging.getLogger("tpu-device-plugin")
+
+
+def _grpc_traceparent(context) -> str:
+    """W3C trace context from gRPC invocation metadata (the DevicePlugin
+    API carries no pod identity, so the ``traceparent`` metadata key —
+    populated by a tracing-aware caller from the pod's
+    ``elasticgpu.io/traceparent`` annotation — is how an Allocate joins
+    the pod's scheduling trace).  Best-effort: kubelet sends none."""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k.lower() == TRACEPARENT_HEADER:
+                return v
+    except Exception:
+        pass
+    return ""
 
 API_VERSION = "v1beta1"
 KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
@@ -228,8 +244,18 @@ class TPUDevicePlugin:
         return resp
 
     def Allocate(self, request, context):
+        with TRACER.span(
+            "deviceplugin.allocate",
+            parent=_grpc_traceparent(context) or None,
+            containers=len(request.container_requests),
+        ) as sp:
+            return self._allocate(request, sp)
+
+    def _allocate(self, request, sp):
         by_path = dict(self.chips)
         resp = pb.AllocateResponse()
+        all_chips: list[str] = []
+        total_units = 0
         for creq in request.container_requests:
             chip_coords = sorted(
                 {self.chip_of_device(d) for d in creq.devices_i_ds}
@@ -273,6 +299,10 @@ class TPUDevicePlugin:
                         )
                     )
             resp.container_responses.append(cresp)
+            all_chips.extend(chip_coords)
+            total_units += units
+        sp.set_attr("chips", sorted(set(all_chips)))
+        sp.set_attr("core_units", total_units)
         return resp
 
     def PreStartContainer(self, request, context):

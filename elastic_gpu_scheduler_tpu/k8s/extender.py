@@ -16,19 +16,32 @@ from .objects import Pod
 
 @dataclass
 class ExtenderArgs:
-    """filter / priorities request body."""
+    """filter / priorities request body.
+
+    ``traceparent`` is a wire extension (tracing/__init__.py): our own
+    clients and tests can thread a W3C trace context through the verb
+    body; kube-scheduler never sends the key and ``to_dict`` only emits
+    it when set, so the reference wire shape is unchanged."""
 
     pod: Pod
     node_names: Optional[list[str]] = None  # requires nodeCacheCapable=true
+    traceparent: str = ""
 
     def to_dict(self) -> dict:
-        return {"Pod": self.pod.to_dict(), "NodeNames": self.node_names}
+        d = {"Pod": self.pod.to_dict(), "NodeNames": self.node_names}
+        if self.traceparent:
+            d["Traceparent"] = self.traceparent
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExtenderArgs":
         pod_d = d.get("Pod") or d.get("pod") or {}
         names = d.get("NodeNames", d.get("nodeNames"))
-        return cls(pod=Pod.from_dict(pod_d), node_names=names)
+        return cls(
+            pod=Pod.from_dict(pod_d),
+            node_names=names,
+            traceparent=str(d.get("Traceparent", "") or ""),
+        )
 
 
 @dataclass
@@ -72,14 +85,19 @@ class ExtenderBindingArgs:
     pod_namespace: str
     pod_uid: str
     node: str
+    # wire extension, emitted only when set (see ExtenderArgs.traceparent)
+    traceparent: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "PodName": self.pod_name,
             "PodNamespace": self.pod_namespace,
             "PodUID": self.pod_uid,
             "Node": self.node,
         }
+        if self.traceparent:
+            d["Traceparent"] = self.traceparent
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExtenderBindingArgs":
@@ -88,6 +106,7 @@ class ExtenderBindingArgs:
             pod_namespace=d.get("PodNamespace", "default"),
             pod_uid=d.get("PodUID", ""),
             node=d.get("Node", ""),
+            traceparent=str(d.get("Traceparent", "") or ""),
         )
 
 
@@ -171,9 +190,13 @@ class ExtenderPreemptionArgs:
     # nodeCacheCapable; we accept both.
     node_name_to_victims: dict[str, Victims] = field(default_factory=dict)
     node_name_to_meta_victims: dict[str, MetaVictims] = field(default_factory=dict)
+    # wire extension, emitted only when set (see ExtenderArgs.traceparent)
+    traceparent: str = ""
 
     def to_dict(self) -> dict:
         d: dict = {"Pod": self.pod.to_dict()}
+        if self.traceparent:
+            d["Traceparent"] = self.traceparent
         if self.node_name_to_victims:
             d["NodeNameToVictims"] = {
                 n: v.to_dict() for n, v in self.node_name_to_victims.items()
@@ -197,6 +220,7 @@ class ExtenderPreemptionArgs:
                 n: MetaVictims.from_dict(v)
                 for n, v in (d.get("NodeNameToMetaVictims") or {}).items()
             },
+            traceparent=str(d.get("Traceparent", "") or ""),
         )
 
 

@@ -34,14 +34,32 @@ _WAITS_CAP = 20000
 _HELD_RANKS = threading.local()
 
 
+# Waits parked by _flush_orphan when a TimedLock dies (ADVICE r5 #1).
+# Appends/dels are GIL-atomic list ops; the scrape-path _drain folds the
+# parked batches into LOCK_WAIT.
+_ORPHAN_WAITS: list[tuple[str, list]] = []
+
+
 def _flush_orphan(name: str, waits: list) -> None:
-    """weakref.finalize hook: commit a dying TimedLock's buffered waits
-    so counts/sums stay complete for locks that die between scrapes."""
-    with _DRAIN_LOCK:
-        vals = waits[:]
-        waits.clear()
-    if vals:
-        LOCK_WAIT.observe_batch(name, values=vals)
+    """weakref.finalize hook: park a dying TimedLock's buffered waits so
+    counts/sums stay complete for locks that die between scrapes.
+
+    This is a GC callback: it can run synchronously on ANY thread at any
+    allocation — including one already inside _DRAIN_LOCK (a drain's
+    observe_batch allocating) or holding LOCK_WAIT._lock.  A blocking
+    acquire here self-deadlocked that thread (ADVICE r5 #1), so the
+    finalizer takes NO locks at all: it moves the buffer into a global
+    parking list with GIL-atomic list ops and lets the next scrape-path
+    _drain commit the batch."""
+    n = len(waits)
+    if n:
+        vals = waits[:n]
+        del waits[:n]
+        if len(_ORPHAN_WAITS) < 4096:
+            _ORPHAN_WAITS.append((name, vals))
+        # else drop: when nothing ever scrapes, losing dying locks' tail
+        # samples beats unbounded growth (same stance as _WAITS_CAP); a
+        # bound-and-trim here would race the scrape-path slice/del pair
 
 
 class Counter:
@@ -306,6 +324,15 @@ class _LockWaitHistogram(Histogram):
         with _DRAIN_LOCK:  # guards WeakSet iteration vs concurrent adds
             for tl in list(_TIMED_LOCKS):
                 tl._drain_locked(self)
+            # fold in waits parked by dying locks' finalizers (which must
+            # not lock — see _flush_orphan); the slice-then-del pair is
+            # safe against concurrent finalizer appends landing at the tail
+            n = len(_ORPHAN_WAITS)
+            if n:
+                parked = _ORPHAN_WAITS[:n]
+                del _ORPHAN_WAITS[:n]
+                for name, vals in parked:
+                    self.observe_batch(name, values=vals)
 
     def samples(self, *labels: str) -> list:
         self._drain()

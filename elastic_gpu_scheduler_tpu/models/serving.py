@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import NEG_INF
+from ..tracing import TRACER
 from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
@@ -192,6 +193,11 @@ class Request:
     # eviction requeues for an exact resume; a second means the request
     # genuinely cannot fit the pool and fails terminally.
     pool_spills: int = 0
+    # tracing (tracing/__init__.py): the serving request's SpanContext,
+    # set by the HTTP layer from the client's ``traceparent`` header.
+    # The engine drops instant markers (queued/admitted/spilled) into the
+    # trace from ITS thread via this context — no shared span mutation.
+    trace_ctx: Optional[object] = None
     # token id → additive logit bias (OpenAI semantics): applied to every
     # sampling distribution for this request, in the fused chunks, the
     # speculative verify pass, and the admission prefill.  ±large values
@@ -563,8 +569,9 @@ def _paged_attn_call(q, lkv, tables, lengths, cfg, mesh, dtype):
             q, lkv["k"], lkv["v"], tables, lengths,
             scales_k=sk, scales_v=sv, **kw,
         )
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxcompat import shard_map
 
     qspec = P(*([None] * (q.ndim - 2)), "tensor", None)
     pspec = P(None, None, "tensor", None)
@@ -1604,6 +1611,11 @@ class InferenceEngine:
     def _enqueue(self, req: Request) -> None:
         """Priority-ordered admission queue entry (also the spill-requeue
         path): highest class first, FIFO within a class."""
+        if req.trace_ctx is not None:
+            TRACER.point(
+                "engine.queued", parent=req.trace_ctx,
+                priority=req.priority, resumed=bool(req.output),
+            )
         self.queue.put((-req.priority, next(self._submit_seq), req))
 
     def queue_depths(self) -> dict[int, int]:
@@ -1691,6 +1703,11 @@ class InferenceEngine:
             # token positions are unchanged, which keeps seeded sampling
             # (position-keyed) bit-identical across a spill.
             fed = list(req.prompt) + list(req.output)
+            if req.trace_ctx is not None:
+                TRACER.point(
+                    "engine.admitted", parent=req.trace_ctx, slot=i,
+                    prefill_tokens=len(fed),
+                )
             self.slots[i] = req
             self.prompts[i, : len(fed)] = fed
             self.prompt_lens[i] = len(fed)

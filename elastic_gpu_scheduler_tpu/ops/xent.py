@@ -57,6 +57,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.jaxcompat import pcast
+
 
 def _chunk_w(w: jax.Array, n_chunks: int) -> jax.Array:
     """(D, V) → (n_chunks, D, C) scan xs."""
@@ -108,7 +110,7 @@ def _fwd_scan_parts(x2d, w, targets, n_chunks, vary_axis=None):
     )
     if vary_axis is not None:
         init = jax.tree.map(
-            lambda a: lax.pcast(a, vary_axis, to="varying"), init
+            lambda a: pcast(a, vary_axis, to="varying"), init
         )
     (m, s, gold), _ = lax.scan(body, init, (wc, jnp.arange(n_chunks)))
     return m, s, gold
@@ -181,7 +183,7 @@ def _bwd_scan(x2d, w, t, logz, scale, n_chunks, vary_axis=None):
 
     init = jnp.zeros((N, D), jnp.float32)
     if vary_axis is not None:
-        init = lax.pcast(init, vary_axis, to="varying")
+        init = pcast(init, vary_axis, to="varying")
     dx2d, dwc = lax.scan(body, init, (wc, jnp.arange(n_chunks)))
     return dx2d, dwc.transpose(1, 0, 2).reshape(D, V)
 
@@ -291,7 +293,9 @@ def chunked_softmax_xent_tp(
         # positional bind: custom_vjp nondiff args may not pass by keyword
         return _xent_tp_shard(x, w_local, targets, n_chunks // T, axis, V)
 
-    fn = jax.shard_map(
+    from ..utils.jaxcompat import shard_map
+
+    fn = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(None, axis), P()),

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jaxcompat import pcast
+
 
 def pipeline_apply(
     layer_fn: Callable,  # (x_mb, layer_params) -> (x_mb, aux_scalar)
@@ -56,7 +58,7 @@ def pipeline_apply(
 
     def stage_fn(params_local, x_mb):
         stage = lax.axis_index("pipe")
-        vary = lambda a: lax.pcast(a, manual_axes, to="varying")
+        vary = lambda a: pcast(a, manual_axes, to="varying")
 
         def run_layers(h):
             def body(h, lp):
@@ -83,7 +85,7 @@ def pipeline_apply(
             state, outputs, aux_total = carry
             # stage 0 ingests microbatch t (x_mb is already seq-varying, so
             # only the pipe axis needs casting here)
-            inject = lax.pcast(x_mb[jnp.where(t < M, t, 0)], "pipe", to="varying")
+            inject = pcast(x_mb[jnp.where(t < M, t, 0)], "pipe", to="varying")
             state = jnp.where(stage == 0, inject, state)
             state, aux = run_layers(state)
             # this stage held microbatch (t - stage); is it a real one?
@@ -114,8 +116,10 @@ def pipeline_apply(
         aux_total = lax.psum(aux_total, manual_axes) / (M * seq_n)
         return outputs, aux_total
 
+    from ..utils.jaxcompat import shard_map
+
     x_spec = P(None, None, seq_axis, None) if seq_axis else P()
-    y, aux = jax.shard_map(
+    y, aux = shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P("pipe"), x_spec),

@@ -129,6 +129,8 @@ def ring_attention_sharded(
     fn = functools.partial(
         ring_attention, axis_name="seq", causal=causal, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    from ..utils.jaxcompat import shard_map
+
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

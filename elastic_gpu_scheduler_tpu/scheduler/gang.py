@@ -66,6 +66,7 @@ from typing import Optional
 from ..core.request import TPURequest, request_from_pod
 from ..k8s.objects import Pod
 from ..metrics import GANG_COMMIT, GANG_EVENTS, TimedLock
+from ..tracing import AUDIT, NOOP_SPAN, TRACER
 from ..utils import consts
 from .scheduler import ResourceScheduler, TPUUnitScheduler
 
@@ -223,6 +224,13 @@ class GangCoordinator:
                 plan = self._plan(sched, req, node_names)
                 if plan is None:
                     GANG_EVENTS.inc("plan_infeasible")
+                    AUDIT.record(
+                        pod.key, "gang", gang=gkey, event="plan_infeasible",
+                        detail=(
+                            f"{req.gang_size} members cannot fit on "
+                            f"{len(node_names)} candidate node(s)"
+                        ),
+                    )
                     return [], {
                         n: f"gang {gkey}: {req.gang_size} members cannot fit"
                         for n in node_names
@@ -260,6 +268,12 @@ class GangCoordinator:
                     pinned_idx=existing_idx,
                 ):
                     GANG_EVENTS.inc("plan_hetero_infeasible")
+                    AUDIT.record(
+                        pod.key, "gang", gang=gkey,
+                        event="hetero_replan_infeasible",
+                        detail=f"shape {req.units} does not fit alongside "
+                               "the claimed members",
+                    )
                     return [], {
                         n: (
                             f"gang {gkey}: heterogeneous member "
@@ -275,6 +289,13 @@ class GangCoordinator:
                     n: f"gang {gkey}: all {req.gang_size} slots claimed"
                     for n in node_names
                 }
+            AUDIT.record(
+                pod.key, "gang", gang=gkey, event="slot_claimed",
+                detail=(
+                    f"slot {plan.claims[pod.key]}/{len(plan.slots)} "
+                    f"→ {node}"
+                ),
+            )
             if existing_idx is None:
                 # record the actual claimed shape exactly once; an existing
                 # claim's shape is only ever rewritten via the replan above
@@ -289,6 +310,18 @@ class GangCoordinator:
             return [node], {}
 
     def _plan(
+        self, sched: TPUUnitScheduler, req: TPURequest, node_names: list[str]
+    ) -> Optional[_Plan]:
+        with TRACER.span(
+            "gang.plan", size=req.gang_size, candidates=len(node_names),
+        ) as sp:
+            plan = self._plan_inner(sched, req, node_names)
+            sp.set_attr("feasible", plan is not None)
+            if plan is not None:
+                sp.set_attr("hosts", len(set(plan.slots)))
+            return plan
+
+    def _plan_inner(
         self, sched: TPUUnitScheduler, req: TPURequest, node_names: list[str]
     ) -> Optional[_Plan]:
         """Place all members onto cloned chip state.
@@ -567,7 +600,14 @@ class GangCoordinator:
                 GANG_EVENTS.inc("barrier_tripped")
                 g.t_barrier = time.monotonic()
                 try:
-                    self._commit_gang(sched, gkey, g)
+                    # the commit span lives on the LAST arriver's trace
+                    # (nested under its extender.bind span); every other
+                    # member's trace records the outcome via its own
+                    # audit entry from gang_note_bound
+                    with TRACER.span(
+                        "gang.commit", gang=gkey, members=g.size,
+                    ):
+                        self._commit_gang(sched, gkey, g)
                     g.committed = True
                     GANG_EVENTS.inc("bound")
                 except Exception as e:
@@ -577,16 +617,23 @@ class GangCoordinator:
                 g.cond.notify_all()
             else:
                 deadline = g.created + self.timeout
-                while not g.committed and not g.failed:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        g.failed = (
-                            f"timed out with {len(g.members)}/{g.size} members"
-                        )
-                        GANG_EVENTS.inc("timeout")
-                        g.cond.notify_all()
-                        break
-                    g.cond.wait(timeout=remaining)
+                with TRACER.span(
+                    "gang.barrier.wait", pod=pod.key, gang=gkey,
+                    arrived=len(g.members), size=g.size,
+                ) as wsp:
+                    while not g.committed and not g.failed:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            g.failed = (
+                                f"timed out with {len(g.members)}/{g.size} "
+                                "members"
+                            )
+                            GANG_EVENTS.inc("timeout")
+                            g.cond.notify_all()
+                            break
+                        g.cond.wait(timeout=remaining)
+                    if g.failed:
+                        wsp.set_attr("failed", g.failed)
             if g.failed:
                 g.members.pop(pod.key, None)
                 self._maybe_gc(gkey, g)
@@ -602,6 +649,9 @@ class GangCoordinator:
         server in phase 3, and such pods are stripped of their ledger entry
         (bound-but-unprovisioned, flagged via a Warning event)."""
         members = sorted(g.members.items())  # [(pod_key, (node, pod))]
+        # phase telemetry onto the committer's open gang.commit span
+        # (event appends are GIL-atomic, so pool threads could add too)
+        csp = TRACER.current() or NOOP_SPAN
         with self._lock:
             plan = self._plans.get(gkey)
             plan_slots: dict[str, object] = {}
@@ -664,6 +714,7 @@ class GangCoordinator:
                 raise RuntimeError(
                     f"member {len(allocated)}/{len(members)} no longer fits: {e}"
                 ) from e
+            csp.event("phase1_allocated", members=len(allocated))
 
             # phases 2+3 fan the API writes over the bounded pool in CHUNKS
             # (one future per ~16 members, not per member — future/queue
@@ -753,6 +804,7 @@ class GangCoordinator:
                     sched, allocated, strip_keys={p.key for p, _, _ in allocated}
                 )
                 raise RuntimeError(f"annotation write failed: {phase2_err}")
+            csp.event("phase2_annotated", members=len(done2))
 
             # phase 3: POST all bindings
             def post(item):
@@ -775,6 +827,7 @@ class GangCoordinator:
                         f"accepted; TPU allocation released",
                     )
                 raise RuntimeError(f"binding POST failed: {phase3_err}")
+            csp.event("phase3_bindings_posted", members=len(done3))
 
             # post-commit bookkeeping (events are best-effort API POSTs —
             # fan them out too, not serially on the committer thread)

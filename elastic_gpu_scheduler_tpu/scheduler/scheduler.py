@@ -42,6 +42,7 @@ from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
 from ..metrics import CHIPS_ALLOCATED, TimedLock
+from ..tracing import AUDIT, TRACER
 from ..utils import consts
 
 log = logging.getLogger("tpu-scheduler")
@@ -188,46 +189,55 @@ class TPUUnitScheduler(ResourceScheduler):
         reason = self.admits(request)
         if reason is not None:
             return [], {n: reason for n in node_names}
-        with self.lock:
-            allocators = [
-                (n, self._get_allocator(n)) for n in node_names
-            ]
+        with TRACER.span(
+            "sched.assume", pod=pod.key, nodes=len(node_names),
+        ) as sp:
+            with self.lock:
+                allocators = [
+                    (n, self._get_allocator(n)) for n in node_names
+                ]
 
-        ok: list[str] = []
-        failed: dict[str, str] = {}
+            ok: list[str] = []
+            failed: dict[str, str] = {}
 
-        def try_node(item):
-            name, na = item
-            if na is None:
-                return name, "no TPU capacity visible"
-            opt = na.assume(request, self.rater)
-            if opt is None:
-                return name, "insufficient TPU resources"
-            return name, None
+            def try_node(item):
+                name, na = item
+                if na is None:
+                    return name, "no TPU capacity visible"
+                opt = na.assume(request, self.rater)
+                if opt is None:
+                    return name, "insufficient TPU resources"
+                return name, None
 
-        results = list(self._pool.map(try_node, allocators))
-        for name, err in results:
-            if err is None:
-                ok.append(name)
-            else:
-                failed[name] = err
-        return ok, failed
+            results = list(self._pool.map(try_node, allocators))
+            for name, err in results:
+                if err is None:
+                    ok.append(name)
+                else:
+                    failed[name] = err
+            sp.set_attr("feasible", len(ok))
+            return ok, failed
 
     def score(self, node_names: list[str], pod: Pod) -> list[int]:
         """Priorities verb (reference: scheduler.go:170-184)."""
         from ..core.rater import to_extender_score
 
         request = request_from_pod(pod)
-        scores = []
-        for n in node_names:
-            with self.lock:
-                na = self._get_allocator(n)
-            if na is None:
-                scores.append(consts.SCORE_MIN)
-                continue
-            s = na.score(request, self.rater)
-            scores.append(consts.SCORE_MIN if s is None else to_extender_score(s))
-        return scores
+        with TRACER.span(
+            "sched.score", pod=pod.key, nodes=len(node_names),
+        ):
+            scores = []
+            for n in node_names:
+                with self.lock:
+                    na = self._get_allocator(n)
+                if na is None:
+                    scores.append(consts.SCORE_MIN)
+                    continue
+                s = na.score(request, self.rater)
+                scores.append(
+                    consts.SCORE_MIN if s is None else to_extender_score(s)
+                )
+            return scores
 
     def bind(self, node_name: str, pod: Pod) -> Pod:
         """Commit + persist + bind (reference: scheduler.go:186-227).
@@ -239,39 +249,53 @@ class TPUUnitScheduler(ResourceScheduler):
         reason = self.admits(request)
         if reason is not None:  # bind can arrive without a filter pass
             raise RuntimeError(f"bind: {reason}")
-        with self.lock:
-            na = self._get_allocator(node_name)
-            if na is None:
-                raise RuntimeError(f"bind: node {node_name} has no TPU allocator")
-            opt = na.allocate(request, self.rater)
-            self.pod_maps[pod.key] = (node_name, opt)
-            self.released_pods.pop(pod.key, None)
-
-        try:
-            updated = self._write_annotations(pod, opt, node_name)
-            self.clientset.bind(
-                Binding(
-                    pod_name=pod.metadata.name,
-                    pod_namespace=pod.metadata.namespace,
-                    pod_uid=pod.metadata.uid,
-                    node=node_name,
-                )
-            )
-            self._update_node_gauge(node_name)
-            self._record_event(
-                pod, "Normal", "Scheduled",
-                f"bound to {node_name} "
-                f"(chips {[a.coords for a in opt.allocs if a.needs_tpu]})",
-            )
-            return updated
-        except Exception as e:
+        with TRACER.span(
+            "sched.bind", pod=pod.key, node=node_name,
+        ) as sp:
             with self.lock:
-                self.pod_maps.pop(pod.key, None)
-                na.forget(opt)
-            self._record_event(
-                pod, "Warning", "FailedScheduling", f"bind to {node_name}: {e}"
-            )
-            raise
+                na = self._get_allocator(node_name)
+                if na is None:
+                    raise RuntimeError(
+                        f"bind: node {node_name} has no TPU allocator"
+                    )
+                opt = na.allocate(request, self.rater)
+                self.pod_maps[pod.key] = (node_name, opt)
+                self.released_pods.pop(pod.key, None)
+            sp.event("allocated")
+
+            try:
+                updated = self._write_annotations(pod, opt, node_name)
+                sp.event("annotated")
+                self.clientset.bind(
+                    Binding(
+                        pod_name=pod.metadata.name,
+                        pod_namespace=pod.metadata.namespace,
+                        pod_uid=pod.metadata.uid,
+                        node=node_name,
+                    )
+                )
+                sp.event("binding_posted")
+                self._update_node_gauge(node_name)
+                chips = [a.coords for a in opt.allocs if a.needs_tpu]
+                sp.set_attr("chips", [str(c) for c in chips])
+                AUDIT.record(
+                    pod.key, "bind", trace_id=sp.trace_id, node=node_name,
+                    chips=[str(c) for c in chips],
+                )
+                self._record_event(
+                    pod, "Normal", "Scheduled",
+                    f"bound to {node_name} (chips {chips})",
+                )
+                return updated
+            except Exception as e:
+                with self.lock:
+                    self.pod_maps.pop(pod.key, None)
+                    na.forget(opt)
+                self._record_event(
+                    pod, "Warning", "FailedScheduling",
+                    f"bind to {node_name}: {e}",
+                )
+                raise
 
     def preempt(
         self, node_name: str, pod: Pod, victims: list[Pod]
@@ -489,6 +513,7 @@ class TPUUnitScheduler(ResourceScheduler):
                     consts.ANNOTATION_TOPOLOGY,
                     consts.ANNOTATION_SLICE,
                     consts.ANNOTATION_GANG_SLICES,
+                    consts.ANNOTATION_TRACEPARENT,
                 ):
                     ann.pop(key, None)
                     removed = True
@@ -517,13 +542,18 @@ class TPUUnitScheduler(ResourceScheduler):
         )
 
     def gang_note_bound(self, pod: Pod, opt: Option, node_name: str) -> None:
-        """Post-commit bookkeeping (gauge + event), one member."""
+        """Post-commit bookkeeping (gauge + event + audit), one member."""
         with self.lock:
             self._update_node_gauge(node_name)
+        chips = [a.coords for a in opt.allocs if a.needs_tpu]
+        ctx = TRACER.pod_context(pod.key)
+        AUDIT.record(
+            pod.key, "bind", trace_id=ctx.trace_id if ctx else "",
+            node=node_name, chips=[str(c) for c in chips], gang=True,
+        )
         self._record_event(
             pod, "Normal", "Scheduled",
-            f"gang-bound to {node_name} "
-            f"(chips {[a.coords for a in opt.allocs if a.needs_tpu]})",
+            f"gang-bound to {node_name} (chips {chips})",
         )
 
     def _update_node_gauge(self, node_name: str) -> None:
@@ -562,11 +592,25 @@ class TPUUnitScheduler(ResourceScheduler):
         self, pod: Pod, opt: Option, node_name: str, extra=None
     ) -> Pod:
         """Annotation-ledger write with one optimistic-conflict retry
-        (reference: scheduler.go:199-213)."""
+        (reference: scheduler.go:199-213).
+
+        The write carries the pod's trace context (W3C traceparent
+        annotation) alongside the allocation: the durable ledger is how
+        the on-node side (device plugin Allocate, launcher) learns which
+        scheduling trace it belongs to.  ``pod_traceparent`` resolves by
+        pod key so gang commits writing from pool threads (no span on
+        their stack) still propagate the member's own trace."""
+        traceparent = (
+            TRACER.pod_traceparent(pod.key) or TRACER.current_traceparent()
+        )
         attempts = 2
         cur = pod
         for i in range(attempts):
             cur.metadata.annotations.update(annotations_for_option(opt, node_name))
+            if traceparent:
+                cur.metadata.annotations[consts.ANNOTATION_TRACEPARENT] = (
+                    traceparent
+                )
             if extra:
                 cur.metadata.annotations.update(extra)
             cur.metadata.labels[consts.ANNOTATION_ASSUMED] = "true"

@@ -82,6 +82,10 @@ def build_args(argv=None):
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend in-process (overrides a "
                         "sticky JAX_PLATFORMS from site config; tests/dev)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="request-trace sampling rate (1.0 = every request, "
+                        "0 = off; default from TPU_TRACE_SAMPLE, else 1.0); "
+                        "GET /traces serves the result")
     return p.parse_args(argv)
 
 
@@ -91,6 +95,10 @@ def main(argv=None) -> int:
         # fail BEFORE any weight I/O — a misconfigured flag pair must not
         # cost a multi-GB checkpoint read first
         raise SystemExit("--draft-hf requires --spec-k > 0")
+    if args.trace_sample is not None:
+        from .tracing import TRACER
+
+        TRACER.configure(args.trace_sample)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

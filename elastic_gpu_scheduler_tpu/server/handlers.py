@@ -28,8 +28,22 @@ from ..k8s.fake import is_not_found
 from ..k8s.objects import Pod
 from ..scheduler.registry import get_resource_scheduler
 from ..scheduler.scheduler import ResourceScheduler
+from ..tracing import AUDIT, TRACER
+from ..utils import consts
 
 log = logging.getLogger("tpu-scheduler")
+
+
+def _pod_root(pod: Pod, traceparent: str = ""):
+    """The pod's trace root, honoring remote context in precedence order:
+    explicit wire traceparent, then a submission-time pod annotation, then
+    a fresh per-pod trace.  Verb spans for one pod all join this root, so
+    filter → priorities → bind forms ONE trace despite arriving as
+    independent HTTP requests."""
+    parent = traceparent or (pod.metadata.annotations or {}).get(
+        consts.ANNOTATION_TRACEPARENT, ""
+    )
+    return TRACER.pod_span(pod.key, parent=parent or None)
 
 
 class Predicate:
@@ -49,10 +63,30 @@ class Predicate:
             return ExtenderFilterResult(node_names=list(args.node_names))
         from ..core.request import request_from_pod
 
-        if self.gang is not None and self.gang.is_gang_pod(request_from_pod(pod)):
-            ok, failed = self.gang.filter(sched, pod, list(args.node_names))
-        else:
-            ok, failed = sched.assume(list(args.node_names), pod)
+        with TRACER.span(
+            "extender.filter",
+            parent=_pod_root(pod, args.traceparent),
+            pod=pod.key,
+            candidates=len(args.node_names),
+        ) as sp:
+            if self.gang is not None and self.gang.is_gang_pod(
+                request_from_pod(pod)
+            ):
+                ok, failed = self.gang.filter(
+                    sched, pod, list(args.node_names)
+                )
+            else:
+                ok, failed = sched.assume(list(args.node_names), pod)
+            sp.set_attr("feasible", len(ok))
+            if failed:
+                sp.set_attr("rejected", len(failed))
+            if AUDIT.enabled:
+                # the per-node verdict IS the audit: which nodes could
+                # host the pod, and the named constraint each rejected on
+                AUDIT.record(
+                    pod.key, "filter", trace_id=sp.trace_id,
+                    ok=list(ok), failed=dict(failed),
+                )
         return ExtenderFilterResult(node_names=ok, failed_nodes=failed)
 
 
@@ -66,7 +100,22 @@ class Prioritize:
         sched = get_resource_scheduler(self.registry, pod)
         if sched is None:
             return [HostPriority(host=n, score=0) for n in names]
-        scores = sched.score(names, pod)
+        with TRACER.span(
+            "extender.priorities",
+            parent=_pod_root(pod, args.traceparent),
+            pod=pod.key,
+            candidates=len(names),
+        ) as sp:
+            scores = sched.score(names, pod)
+            by_node = dict(zip(names, scores))
+            if by_node:
+                best = max(by_node, key=by_node.get)
+                sp.set_attr("best", f"{best}={by_node[best]}")
+            if AUDIT.enabled:
+                AUDIT.record(
+                    pod.key, "priorities", trace_id=sp.trace_id,
+                    scores=by_node,
+                )
         return [HostPriority(host=n, score=s) for n, s in zip(names, scores)]
 
 
@@ -128,6 +177,26 @@ class Preemption:
         return victims + extra
 
     def handle(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
+        pod = args.pod
+        with TRACER.span(
+            "extender.preemption",
+            parent=_pod_root(pod, args.traceparent),
+            pod=pod.key,
+        ) as sp:
+            result = self._handle(args)
+            victims = {
+                n: len(v.pods)
+                for n, v in result.node_name_to_meta_victims.items()
+            }
+            sp.set_attr("candidate_nodes", len(victims))
+            if AUDIT.enabled:
+                AUDIT.record(
+                    pod.key, "preemption", trace_id=sp.trace_id,
+                    nodes=len(victims), victims=victims,
+                )
+            return result
+
+    def _handle(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
         pod = args.pod
         sched = get_resource_scheduler(self.registry, pod)
         # node → (victim Pods | None, pass-through victim UIDs, PDB count).
@@ -247,12 +316,28 @@ class Bind:
         sched = get_resource_scheduler(self.registry, pod)
         if sched is None:
             return ExtenderBindingResult(error=f"pod {pod.key} requests no TPU")
-        try:
-            if self.gang is not None:
-                self.gang.bind(sched, args.node, pod)
-            else:
-                sched.bind(args.node, pod)
-        except Exception as e:
-            log.warning("bind %s -> %s failed: %s", pod.key, args.node, e)
-            return ExtenderBindingResult(error=str(e))
+        with TRACER.span(
+            "extender.bind",
+            parent=_pod_root(pod, args.traceparent),
+            pod=pod.key,
+            node=args.node,
+        ) as sp:
+            try:
+                if self.gang is not None:
+                    self.gang.bind(sched, args.node, pod)
+                else:
+                    sched.bind(args.node, pod)
+            except Exception as e:
+                log.warning("bind %s -> %s failed: %s", pod.key, args.node, e)
+                sp.set_attr("error", str(e))
+                sp.end(status="error")
+                if AUDIT.enabled:
+                    AUDIT.record(
+                        pod.key, "bind", trace_id=sp.trace_id,
+                        node=args.node, error=str(e),
+                    )
+                return ExtenderBindingResult(error=str(e))
+        # the pod's scheduling story is complete: close its trace (the
+        # commit layer recorded the chips-level audit entry)
+        TRACER.finish_pod(pod.key)
         return ExtenderBindingResult()

@@ -42,6 +42,7 @@ from typing import Optional
 
 from .. import __version__
 from ..metrics import REGISTRY, Counter, Gauge, Histogram
+from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..models.serving import (
     DRAINING_ERROR,
     QUEUE_FULL_ERROR,
@@ -132,11 +133,37 @@ class EngineLoop:
     def _run(self) -> None:
         eng = self.engine
         failures = 0  # consecutive _fail_all rounds, reset on any success
+        step_seq = 0  # steps since a traced batch started (span pacing)
         while not self._stop.is_set():
             try:
                 eng._admit()
                 if any(s is not None for s in eng.slots):
-                    eng.step()
+                    # a traced request in a slot gets engine.step spans in
+                    # its trace (request → engine step → SSE flush) — but
+                    # PACED, one span per 32 steps: a single long
+                    # generation must not flood the span ring and evict
+                    # every other request's trace.  Untraced batches pay
+                    # one generator-expression scan only.
+                    traced = next(
+                        (
+                            s.trace_ctx
+                            for s in eng.slots
+                            if s is not None and s.trace_ctx is not None
+                        ),
+                        None,
+                    )
+                    if traced is not None and step_seq % 32 == 0:
+                        with TRACER.span(
+                            "engine.step", parent=traced,
+                            step=step_seq,
+                            slots=sum(
+                                1 for s in eng.slots if s is not None
+                            ),
+                        ):
+                            eng.step()
+                    else:
+                        eng.step()
+                    step_seq = step_seq + 1 if traced is not None else 0
                 else:
                     if eng.draining and eng.queue.empty():
                         # consistent snapshot: this thread just ran
@@ -356,6 +383,16 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 self.end_headers()
                 self.wfile.write(data)
                 return
+            if self.path.split("?", 1)[0] == "/traces":
+                # serving-plane traces (request → engine step → SSE flush);
+                # one response shape shared with the scheduler's /traces
+                from urllib.parse import parse_qsl
+
+                from ..tracing import traces_response
+
+                _, _, query = self.path.partition("?")
+                params = dict(parse_qsl(query, keep_blank_values=True))
+                return self._json(200, traces_response(params))
             if self.path == "/v1/stats":
                 eng = engine
                 return self._json(200, {
@@ -430,10 +467,28 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # max_tokens, temperature, ...) — a clean 400, not an
                 # aborted connection
                 return self._json(400, {"error": str(e)})
-            if body.get("stream"):
-                return self._stream(reqs)
-            if n > 1:
-                return self._multi(reqs, n)
+            # serving-plane tracing: a client traceparent header joins its
+            # trace; otherwise each request roots a fresh one.  The span
+            # context rides on the Request so the ENGINE thread can drop
+            # queued/admitted/step markers into the same trace.
+            with TRACER.span(
+                "serve.request",
+                parent=self.headers.get(TRACEPARENT_HEADER) or None,
+                n=n,
+                stream=bool(body.get("stream")),
+                prompt_tokens=len(req.prompt),
+                max_tokens=req.max_new_tokens,
+            ) as sp:
+                ctx = sp.context() if sp else None
+                for r in reqs:
+                    r.trace_ctx = ctx
+                if body.get("stream"):
+                    return self._stream(reqs)
+                if n > 1:
+                    return self._multi(reqs, n)
+                return self._single(req, sp)
+
+        def _single(self, req, sp):
             t0 = time.monotonic()
             engine.submit(req)
             if not req.done.wait(request_timeout):
@@ -460,10 +515,12 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             SERVE_LATENCY.observe(value=time.monotonic() - t0)
             if req.error:
                 SERVE_REQUESTS.inc("error")
+                sp.set_attr("error", req.error)
                 code = _reject_code(req.error)
                 return self._json(code, {"error": req.error})
             SERVE_REQUESTS.inc("ok")
             SERVE_TOKENS.inc(value=len(req.output))
+            sp.set_attr("tokens", len(req.output))
             resp = {"tokens": req.output}
             if req.logprobs > 0:
                 resp["logprobs"] = _logprobs_payload(req)
@@ -572,11 +629,20 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
+            # the serve.request span is on THIS thread's stack (the
+            # _do_post with-block); flush markers land in the same trace
+            sp = TRACER.current() or None
+            first_flush = [True]
+
             def chunk(payload: str) -> None:
                 data = f"data: {payload}\n\n".encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode())
                 self.wfile.write(data + b"\r\n")
                 self.wfile.flush()
+                if first_flush[0]:
+                    first_flush[0] = False
+                    if sp is not None:
+                        sp.event("sse_first_flush")
 
             sent = 0
             deadline = time.monotonic() + request_timeout
@@ -629,6 +695,8 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             finally:
                 SERVE_LATENCY.observe(value=time.monotonic() - t0)
                 SERVE_TOKENS.inc(value=sent)
+                if sp is not None:
+                    sp.set_attr("sse_chunks", sent)
 
     return InferenceHandler
 

@@ -44,6 +44,7 @@ from ..k8s.extender import (
     ExtenderPreemptionArgs,
 )
 from ..metrics import LOCK_WAIT, REGISTRY, VERB_LATENCY, VERB_TOTAL
+from ..tracing import AUDIT, TRACER
 from .handlers import Bind, Predicate, Preemption, Prioritize
 
 log = logging.getLogger("tpu-scheduler")
@@ -146,6 +147,112 @@ def execution_trace(seconds: float, interval: float = 0.002) -> str:
                 "args": {"name": name},
             })
     return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+_PARK_NAMES = {
+    # threading.py / queue.py / selectors.py primitives a parked thread's
+    # INNERMOST frames sit in; the co_name → blocking-kind map drives the
+    # per-site attribution below
+    "wait": "condition",
+    "wait_for": "condition",
+    "get": "queue",
+    "put": "queue",
+    "join": "join",
+    "acquire": "lock",
+    "select": "io",
+    "poll": "io",
+}
+
+
+def sample_block_profile(seconds: float, interval: float = 0.005) -> str:
+    """Block-profile analogue (the reference mounts Go's block profile,
+    pprof.go:10-64): sample every thread and attribute time spent PARKED
+    on queues/condition variables/locks/IO to the innermost application
+    frame that called into the wait primitive.
+
+    The mutex profile (/debug/pprof/mutex) only sees TimedLock waits;
+    this sees every ``queue.Queue.get``, ``Condition.wait``, executor
+    future wait and selector poll — the gang barrier, the controller
+    workqueue, the HTTP worker pool and the engine loop all park there."""
+    import queue as _queue
+    import selectors as _selectors
+
+    park_files = {
+        threading.__file__,
+        _queue.__file__,
+        _selectors.__file__,
+    }
+    me = threading.get_ident()
+    seconds = min(max(seconds, 0.1), 30.0)
+    counts: dict[tuple[str, str], int] = {}
+    rounds = 0
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            # walk inner → outer: find the innermost park primitive, then
+            # the first frame OUTSIDE the primitive files = the park site
+            f = frame
+            kind = None
+            depth = 0
+            while f is not None and depth < 50:
+                code = f.f_code
+                if code.co_filename in park_files:
+                    k = _PARK_NAMES.get(code.co_name)
+                    if k is not None:
+                        kind = k
+                elif kind is not None:
+                    site = (
+                        f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{f.f_lineno}:{code.co_name}"
+                    )
+                    counts[(site, kind)] = counts.get((site, kind), 0) + 1
+                    break
+                f = f.f_back
+                depth += 1
+        rounds += 1
+        time.sleep(interval)
+    lines = [
+        f"# block profile: {rounds} sampling rounds over {seconds}s "
+        f"(interval {interval * 1e3:.0f}ms); samples blocked-kind site, "
+        "most-parked first"
+    ]
+    for (site, kind), n in sorted(counts.items(), key=lambda kv: -kv[1])[:200]:
+        lines.append(f"{n} {kind} {site}")
+    return "\n".join(lines) + "\n"
+
+
+_DEBUG_INDEX = """\
+<html><head><title>/debug/</title></head><body>
+<h2>tpu-elastic-scheduler debug index</h2>
+<p>Profiles (the reference mounts Go's net/http/pprof index; these are
+the Python analogues):</p>
+<ul>
+<li><a href="/debug/pprof/profile?seconds=2">/debug/pprof/profile</a>
+ — sampling CPU profile, collapsed stacks (?seconds=N)</li>
+<li><a href="/debug/pprof/heap">/debug/pprof/heap</a>
+ — tracemalloc live-allocation sites (?diff=1 → growth since last call)</li>
+<li><a href="/debug/pprof/mutex">/debug/pprof/mutex</a>
+ — TimedLock wait-time summary (scheduler/gang locks)</li>
+<li><a href="/debug/pprof/block?seconds=2">/debug/pprof/block</a>
+ — park-site profile: threads blocked on queues/conditions/locks/IO</li>
+<li><a href="/debug/pprof/trace?seconds=1">/debug/pprof/trace</a>
+ — per-thread execution timeline, Chrome trace-event JSON</li>
+<li><a href="/debug/stacks">/debug/stacks</a> — all-thread stack dump</li>
+</ul>
+<p>Scheduling provenance:</p>
+<ul>
+<li><a href="/traces">/traces</a> — recent scheduling traces
+ (?trace=ID for one trace, ?format=chrome for Perfetto export)</li>
+<li>/debug/schedule/&lt;namespace&gt;/&lt;pod&gt;
+ — per-node filter verdicts, scores and the bind decision for one pod</li>
+<li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
+<li><a href="/scheduler/status">/scheduler/status</a>
+ — per-node chip state dump</li>
+</ul>
+</body></html>
+"""
 
 
 def _parse_query(query: str) -> dict[str, str]:
@@ -347,6 +454,30 @@ class ExtenderServer:
                 return 200, json.dumps(self.status_fn()).encode(), "application/json"
             except Exception as e:
                 return 500, json.dumps({"error": str(e)}).encode(), "application/json"
+        if path == "/traces":
+            from ..tracing import traces_response
+
+            return (
+                200,
+                json.dumps(
+                    traces_response(_parse_query(query)), indent=1
+                ).encode(),
+                "application/json",
+            )
+        if path.startswith("/debug/schedule/"):
+            pod_key = path[len("/debug/schedule/"):]
+            if "/" not in pod_key:
+                pod_key = f"default/{pod_key}"
+            return 200, AUDIT.explain(pod_key).encode(), "text/plain"
+        if path in ("/debug", "/debug/", "/debug/pprof", "/debug/pprof/"):
+            return 200, _DEBUG_INDEX.encode(), "text/html"
+        if path == "/debug/pprof/block":
+            params = _parse_query(query)
+            try:
+                secs = float(params.get("seconds", "2"))
+            except ValueError:
+                secs = 2.0
+            return 200, sample_block_profile(secs).encode(), "text/plain"
         if path == "/debug/stacks":
             frames = sys._current_frames()
             out = []
@@ -392,7 +523,9 @@ class ExtenderServer:
                 return 500, f"heap profile failed: {e}".encode(), "text/plain"
         return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
 
-    def _route_post(self, path: str, raw: bytes) -> tuple[int, bytes, str]:
+    def _route_post(
+        self, path: str, raw: bytes, traceparent: str = ""
+    ) -> tuple[int, bytes, str]:
         if self.leader_check is not None and not self.leader_check():
             # a standby must not mutate (or answer from possibly-stale
             # caches); kube-scheduler retries against the leader
@@ -425,6 +558,13 @@ class ExtenderServer:
                 400, b'{"Error": "body must be a JSON object"}',
                 "application/json",
             )
+        def merge_tp(args):
+            # HTTP-header form of the W3C trace context; an explicit body
+            # Traceparent wins (one precedence rule, applied per verb)
+            if traceparent and not args.traceparent:
+                args.traceparent = traceparent
+            return args
+
         if path == "/scheduler/filter":
             # the nodeCacheCapable=false (Nodes-list) form is refused by
             # Predicate.handle itself with the reference's 200+Error shape
@@ -432,6 +572,7 @@ class ExtenderServer:
             args, err = self._parse("filter", ExtenderArgs.from_dict, body)
             if err is not None:
                 return err
+            args = merge_tp(args)
             return self._verb(
                 "filter", lambda: self.predicate.handle(args).to_dict()
             )
@@ -441,6 +582,7 @@ class ExtenderServer:
             )
             if err is not None:
                 return err
+            args = merge_tp(args)
             if args.node_names is None:
                 # nodeCacheCapable=false form: the reference PANICS here
                 # (routes.go:98,103 — SURVEY quirk not replicated);
@@ -459,6 +601,7 @@ class ExtenderServer:
             )
             if err is not None:
                 return err
+            args = merge_tp(args)
             return self._verb(
                 "bind", lambda: self.bind.handle(args).to_dict()
             )
@@ -468,6 +611,7 @@ class ExtenderServer:
         )
         if err is not None:
             return err
+        args = merge_tp(args)
         return self._verb(
             "preemption", lambda: self.preemption.handle(args).to_dict()
         )
@@ -528,6 +672,7 @@ class ExtenderServer:
                     return False
                 clen = 0
                 close = version == "HTTP/1.0"
+                traceparent = ""
                 while True:
                     h = self.rfile.readline(8192)
                     if h in (b"\r\n", b"\n", b""):
@@ -541,12 +686,18 @@ class ExtenderServer:
                             return False
                     elif k == b"connection" and v.strip().lower() == b"close":
                         close = True
+                    elif k == b"traceparent":
+                        # W3C trace context: a tracing-aware client's verb
+                        # joins its trace (tracing/__init__.py)
+                        traceparent = v.strip().decode("latin1")
                 raw = self.rfile.read(clen) if clen > 0 else b""
                 path, _, query = target.partition("?")
                 if method == "GET":
                     code, payload, ctype = server_self._route_get(path, query)
                 elif method == "POST":
-                    code, payload, ctype = server_self._route_post(path, raw)
+                    code, payload, ctype = server_self._route_post(
+                        path, raw, traceparent
+                    )
                 else:
                     code, payload, ctype = 405, b"method not allowed", "text/plain"
                 head = (

@@ -1,0 +1,755 @@
+"""End-to-end scheduling trace & decision audit (stdlib only).
+
+The control plane makes multi-stage placement decisions (filter →
+priorities → gang admission → bind → device-plugin Allocate) whose
+outcomes were previously visible only as aggregate histograms
+(metrics/__init__.py).  This module adds per-decision provenance:
+
+- **Spans.**  A thread-safe ring-buffer tracer with W3C-style trace/span
+  ids, wall + monotonic timestamps and structured attributes.  Finished
+  spans land in a bounded deque (old traces evict FIFO — a long-lived
+  scheduler never grows without limit); export is Chrome trace-event
+  JSON (open in Perfetto) or a per-trace JSON tree, both served by
+  ``/traces`` (server/routes.py).
+
+- **Pod-scoped traces.**  kube-scheduler's verbs arrive as independent
+  HTTP requests with no trace headers, so the tracer keeps a bounded
+  registry of per-pod root spans: the first filter for a pod opens its
+  trace, every later verb for the same pod joins it, and bind (or
+  registry eviction) closes it.  One pod = one trace spanning all verbs.
+
+- **Propagation.**  ``traceparent`` carries context across process
+  boundaries in the standard ``00-<trace>-<span>-<flags>`` form:
+  HTTP header (extender verbs, inference requests), pod annotation
+  ``elasticgpu.io/traceparent`` (written with the bind-time allocation
+  ledger, so the on-node side can continue the scheduling trace), and
+  gRPC metadata (device-plugin Allocate).
+
+- **Decision audit.**  ``ScheduleAudit`` records each verb's PER-NODE
+  verdict — the score, or the rejection reason with the failed
+  constraint — keyed by pod.  ``/debug/schedule/<pod>`` renders the
+  human-readable "why did this pod land on that node" answer.
+
+- **Sampling knob.**  ``TPU_TRACE_SAMPLE`` (or ``Tracer.configure``):
+  1.0 traces everything (default — the control plane's verb rate is
+  trivially low), 0 < p < 1 head-samples per trace, 0 disables.  When a
+  trace is not sampled every span call returns the shared no-op span:
+  no ids, no clock reads, no locks — the hot path pays one attribute
+  load and one comparison.
+
+The reference has none of this (its pprof mount is aggregate-only);
+contention-aware schedulers (BandPilot, Gavel — PAPERS.md) rely on
+exactly this per-decision provenance to debug placement quality.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+__all__ = [
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "ScheduleAudit",
+    "TRACER",
+    "AUDIT",
+    "TRACEPARENT_HEADER",
+    "format_traceparent",
+    "parse_traceparent",
+]
+
+TRACEPARENT_HEADER = "traceparent"
+
+# one Random instance behind a lock would serialize span starts; os.urandom
+# is kernel-backed and thread-safe, and span creation is verb-rate (not
+# chip-rate), so two small reads per span are in the noise
+def _gen_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _gen_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id, sampled) triple — what propagates."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def traceparent(self) -> str:
+        return format_traceparent(self)
+
+
+def format_traceparent(ctx) -> str:
+    """W3C traceparent: version 00, 16-byte trace id, 8-byte span id,
+    flags (01 = sampled)."""
+    if not ctx:
+        return ""
+    flags = "01" if getattr(ctx, "sampled", True) else "00"
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{flags}"
+
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str, n: int) -> bool:
+    # strict per-character check: int(x, 16) tolerates underscores and
+    # sign prefixes, which would re-emit malformed ids downstream
+    return len(s) == n and all(c in _HEX for c in s)
+
+
+def parse_traceparent(value: str) -> Optional[SpanContext]:
+    """``00-<32 hex>-<16 hex>-<2 hex>`` → SpanContext, or None on any
+    malformation (a bad header must never fail the verb carrying it,
+    and must never be propagated verbatim to spec-compliant parsers)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if not (
+        _is_hex(version, 2)
+        and _is_hex(trace_id, 32)
+        and _is_hex(span_id, 16)
+        and _is_hex(flags, 2)
+    ):
+        return None
+    if version == "ff":  # forbidden by the W3C spec
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id, sampled=bool(int(flags, 16) & 1))
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the sampled-out path: every method is a
+    constant return, __bool__ is False so callers can branch, and the
+    context-manager protocol works so ``with TRACER.span(...)`` costs
+    nothing extra when tracing is off."""
+
+    __slots__ = ()
+
+    trace_id = ""
+    span_id = ""
+    name = ""
+
+    def __bool__(self) -> bool:
+        return False
+
+    def set_attr(self, key, value) -> "_NoopSpan":
+        return self
+
+    def event(self, name, **attrs) -> "_NoopSpan":
+        return self
+
+    def context(self) -> Optional[SpanContext]:
+        return None
+
+    def traceparent(self) -> str:
+        return ""
+
+    def end(self, status: str = "ok") -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One timed operation.  Mutation is single-writer by convention (the
+    thread that opened the span); ``event`` uses GIL-atomic list appends
+    so commit-pool threads can annotate a committer's span safely."""
+
+    __slots__ = (
+        "tracer", "trace_id", "span_id", "parent_id", "name",
+        "t_wall", "t0", "duration", "attrs", "events", "status",
+        "_on_stack",
+    )
+
+    def __init__(self, tracer, trace_id, parent_id, name, attrs=None):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = _gen_span_id()
+        self.parent_id = parent_id
+        self.name = name
+        self.t_wall = time.time()
+        self.t0 = time.perf_counter()
+        self.duration: Optional[float] = None  # None while open
+        self.attrs: dict = dict(attrs) if attrs else {}
+        self.events: list = []
+        self.status = "ok"
+        self._on_stack = False
+
+    def __bool__(self) -> bool:
+        return True
+
+    def set_attr(self, key, value) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def event(self, name, **attrs) -> "Span":
+        self.events.append(
+            {"name": name, "t": time.perf_counter() - self.t0, **attrs}
+        )
+        return self
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.context())
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self.duration is not None:
+            return  # idempotent: double-end keeps the first timing
+        self.duration = time.perf_counter() - self.t0
+        if status is not None:
+            self.status = status
+        self.tracer._finish(self)
+
+    def __enter__(self) -> "Span":
+        self.tracer._push(self)
+        self._on_stack = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._on_stack:
+            self.tracer._pop(self)
+            self._on_stack = False
+        if exc_type is not None:
+            self.set_attr("error", f"{exc_type.__name__}: {exc}")
+            self.end(status="error")
+        else:
+            self.end()
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_unix": round(self.t_wall, 6),
+            "duration_ms": (
+                round(self.duration * 1000, 3)
+                if self.duration is not None
+                else None
+            ),
+            "status": self.status,
+            "attrs": self.attrs,
+            "events": [
+                {**e, "t": round(e["t"] * 1000, 3)} for e in self.events
+            ],
+        }
+
+
+class Tracer:
+    """Ring-buffer tracer.
+
+    Concurrency model: finished spans append into a ``deque(maxlen=N)``
+    under one small lock (append + evict is O(1)); the per-thread active
+    span stack is ``threading.local`` (no lock); the pod-root registry is
+    an OrderedDict under the same lock (get-or-create is rare — once per
+    pod per scheduling attempt)."""
+
+    def __init__(self, capacity: int = 4096, sample: Optional[float] = None,
+                 pod_capacity: int = 2048):
+        if sample is None:
+            try:
+                sample = float(os.environ.get("TPU_TRACE_SAMPLE", "1"))
+            except ValueError:
+                sample = 1.0
+        self.sample = max(0.0, min(1.0, sample))
+        self.capacity = capacity
+        self.pod_capacity = pod_capacity
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # pod key → open root Span (bounded FIFO: an evicted root is
+        # force-closed so it still shows up in the ring)
+        self._pod_roots: "OrderedDict[str, Span]" = OrderedDict()
+        self.dropped = 0  # spans evicted from the ring (telemetry)
+
+    # -- config --------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    def configure(self, sample: float) -> None:
+        """Set the sampling rate (0 disables; the knob behind
+        ``--trace-sample`` / ``TPU_TRACE_SAMPLE``)."""
+        self.sample = max(0.0, min(1.0, sample))
+
+    def reset(self) -> None:
+        """Drop all state (tests)."""
+        with self._lock:
+            self._spans.clear()
+            self._pod_roots.clear()
+            self.dropped = 0
+
+    # -- span lifecycle ------------------------------------------------------
+
+    def _sampled(self) -> bool:
+        if self.sample >= 1.0:
+            return True
+        if self.sample <= 0.0:
+            return False
+        # head sampling at root-span creation; os.urandom avoids sharing
+        # a locked Random instance across verb threads
+        return int.from_bytes(os.urandom(2), "big") / 65536.0 < self.sample
+
+    def span(self, name: str, parent=None, **attrs):
+        """Open a span.  ``parent``: a Span, SpanContext, traceparent
+        string, or None (→ the thread's current span, else a new trace).
+        Returns NOOP_SPAN when tracing is disabled or the trace was not
+        sampled — use as a context manager either way."""
+        if self.sample <= 0.0:
+            return NOOP_SPAN
+        ctx = self._resolve_parent(parent)
+        if ctx is None:
+            # new root: head-sampling decision happens here
+            if self.sample < 1.0 and not self._sampled():
+                return NOOP_SPAN
+            return Span(self, _gen_trace_id(), "", name, attrs)
+        if not ctx.sampled:
+            return NOOP_SPAN
+        return Span(self, ctx.trace_id, ctx.span_id, name, attrs)
+
+    def point(self, name: str, parent=None, **attrs):
+        """Zero-duration finished span (an instant marker another thread
+        can drop into a remote trace without owning an open span)."""
+        sp = self.span(name, parent=parent, **attrs)
+        sp.end()
+        return sp
+
+    def _resolve_parent(self, parent) -> Optional[SpanContext]:
+        if parent is None:
+            cur = self.current()
+            return cur.context() if cur is not None else None
+        if isinstance(parent, Span):
+            return parent.context()
+        if isinstance(parent, SpanContext):
+            return parent
+        if isinstance(parent, str):
+            return parse_traceparent(parent)
+        if isinstance(parent, _NoopSpan):
+            # child of an unsampled span stays unsampled
+            return SpanContext("0" * 32, "0" * 16, sampled=False)
+        return None
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(span)
+
+    # thread-local active-span stack (context-manager protocol only)
+
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif stack is not None:
+            try:
+                stack.remove(span)
+            except ValueError:
+                pass
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def current_traceparent(self) -> str:
+        cur = self.current()
+        return cur.traceparent() if cur is not None else ""
+
+    # -- pod-scoped traces ---------------------------------------------------
+    #
+    # kube-scheduler's filter/priorities/bind are independent HTTP calls;
+    # the pod key is the join key.  First touch opens the pod's root span,
+    # bind (or FIFO eviction) closes it.
+
+    def pod_span(self, pod_key: str, parent=None) -> Span:
+        """Get-or-create the pod's open root span.
+
+        The head-sampling decision is PER TRACE: an unsampled roll is
+        memoized (the shared no-op span occupies the registry slot), so
+        a later verb for the same pod cannot re-roll and produce a trace
+        that starts at bind with no filter/priorities history."""
+        if self.sample <= 0.0:
+            return NOOP_SPAN
+        with self._lock:
+            sp = self._pod_roots.get(pod_key)
+            if sp is not None:
+                self._pod_roots.move_to_end(pod_key)
+                return sp
+        ctx = self._resolve_parent(parent)
+        if (ctx is not None and not ctx.sampled) or (
+            ctx is None and self.sample < 1.0 and not self._sampled()
+        ):
+            sp = NOOP_SPAN  # memoized negative decision
+        else:
+            sp = Span(
+                self,
+                ctx.trace_id if ctx else _gen_trace_id(),
+                ctx.span_id if ctx else "",
+                f"schedule {pod_key}",
+                {"pod": pod_key},
+            )
+        evicted = None
+        with self._lock:
+            cur = self._pod_roots.get(pod_key)
+            if cur is not None:  # lost the creation race
+                return cur
+            self._pod_roots[pod_key] = sp
+            if len(self._pod_roots) > self.pod_capacity:
+                _, evicted = self._pod_roots.popitem(last=False)
+        if evicted is not None:
+            evicted.end(status="evicted")
+        return sp
+
+    def pod_context(self, pod_key: str) -> Optional[SpanContext]:
+        """The pod's trace context if a trace is open, else None (never
+        creates — the controller uses this so resyncs don't mint traces
+        for pods that were never filtered)."""
+        with self._lock:
+            sp = self._pod_roots.get(pod_key)
+        return sp.context() if sp is not None else None
+
+    def pod_traceparent(self, pod_key: str) -> str:
+        ctx = self.pod_context(pod_key)
+        return format_traceparent(ctx) if ctx is not None else ""
+
+    def finish_pod(self, pod_key: str, status: str = "ok") -> None:
+        with self._lock:
+            sp = self._pod_roots.pop(pod_key, None)
+        if sp is not None:
+            sp.end(status=status)
+
+    # -- export --------------------------------------------------------------
+
+    def finished(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def open_pod_roots(self) -> list:
+        with self._lock:
+            return [
+                s
+                for s in self._pod_roots.values()
+                if not isinstance(s, _NoopSpan)  # memoized unsampled rolls
+            ]
+
+    def traces(self, limit: int = 50) -> list:
+        """Most-recent-first trace summaries assembled from the ring
+        (plus still-open pod roots, so an unbound pod is visible)."""
+        spans = self.finished() + self.open_pod_roots()
+        by_trace: "OrderedDict[str, list]" = OrderedDict()
+        for sp in spans:
+            by_trace.setdefault(sp.trace_id, []).append(sp)
+        out = []
+        for trace_id, group in by_trace.items():
+            group.sort(key=lambda s: s.t_wall)
+            root = next((s for s in group if not s.parent_id), group[0])
+            t_end = max(
+                (s.t_wall + (s.duration or 0.0)) for s in group
+            )
+            out.append({
+                "trace_id": trace_id,
+                "name": root.name,
+                "start_unix": round(group[0].t_wall, 6),
+                "duration_ms": round((t_end - group[0].t_wall) * 1000, 3),
+                "spans": len(group),
+                "open": any(s.duration is None for s in group),
+                "status": (
+                    "error"
+                    if any(s.status == "error" for s in group)
+                    else root.status
+                ),
+            })
+        out.sort(key=lambda t: -t["start_unix"])
+        return out[:limit]
+
+    def trace(self, trace_id: str) -> list:
+        """Every span of one trace, start-ordered, as dicts."""
+        spans = [
+            sp
+            for sp in self.finished() + self.open_pod_roots()
+            if sp.trace_id == trace_id
+        ]
+        spans.sort(key=lambda s: s.t_wall)
+        return [sp.to_dict() for sp in spans]
+
+    def chrome_trace(self, trace_id: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing).  Spans
+        become complete ("X") events on one lane per trace; span events
+        become instant ("i") markers."""
+        spans = self.finished() + self.open_pod_roots()
+        if trace_id is not None:
+            spans = [sp for sp in spans if sp.trace_id == trace_id]
+        lanes: dict[str, int] = {}
+        events = []
+        for sp in sorted(spans, key=lambda s: s.t_wall):
+            tid = lanes.setdefault(sp.trace_id, len(lanes) + 1)
+            ts_us = sp.t_wall * 1e6
+            dur_us = (sp.duration or 0.0) * 1e6
+            events.append({
+                "name": sp.name, "ph": "X", "ts": round(ts_us, 1),
+                "dur": round(max(dur_us, 1.0), 1), "pid": 1, "tid": tid,
+                "args": {
+                    **sp.attrs,
+                    "trace_id": sp.trace_id,
+                    "span_id": sp.span_id,
+                    "status": sp.status,
+                },
+            })
+            for ev in sp.events:
+                events.append({
+                    "name": f"{sp.name}.{ev['name']}", "ph": "i",
+                    "ts": round(ts_us + ev["t"] * 1e6, 1), "pid": 1,
+                    "tid": tid, "s": "t",
+                    "args": {
+                        k: v for k, v in ev.items() if k not in ("name", "t")
+                    },
+                })
+        for trace_id_, tid in lanes.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": f"trace {trace_id_[:8]}"},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "sample": self.sample,
+                "finished_spans": len(self._spans),
+                "capacity": self.capacity,
+                "open_pod_traces": len(self._pod_roots),
+                "dropped_spans": self.dropped,
+            }
+
+
+class ScheduleAudit:
+    """Per-pod decision audit: every verb appends one record carrying the
+    PER-NODE verdict (score, or rejection reason naming the failed
+    constraint).  Bounded two ways: ``capacity`` pods FIFO, and
+    ``max_records`` entries per pod (a crash-looping pod re-filtering
+    forever must not grow one record list without limit)."""
+
+    def __init__(self, capacity: int = 1024, max_records: int = 64,
+                 enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("TPU_TRACE_AUDIT", "1") not in (
+                "0", "false", "",
+            )
+        self.enabled = enabled
+        self.capacity = capacity
+        self.max_records = max_records
+        self._pods: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # per-record verdict payloads are truncated to this many nodes: a
+    # 500-node cluster's filter verdict times 64 records times 1024 pods
+    # would otherwise hold multi-GB of audit state in a long-lived
+    # scheduler — the first N verdicts answer "why not here" for the
+    # nodes that matter and a count records what was elided
+    MAX_NODES_PER_RECORD = 64
+
+    @classmethod
+    def _clip(cls, v):
+        cap = cls.MAX_NODES_PER_RECORD
+        if isinstance(v, list) and len(v) > cap:
+            return v[:cap] + [f"... +{len(v) - cap} more"]
+        if isinstance(v, dict) and len(v) > cap:
+            out = dict(list(v.items())[:cap])
+            out["..."] = f"+{len(v) - cap} more"
+            return out
+        return v
+
+    def record(self, pod_key: str, stage: str, trace_id: str = "",
+               **fields) -> None:
+        if not self.enabled:
+            return
+        rec = {
+            "stage": stage,
+            "t_unix": round(time.time(), 6),
+            **{k: self._clip(v) for k, v in fields.items()},
+        }
+        with self._lock:
+            entry = self._pods.get(pod_key)
+            if entry is None:
+                entry = {"pod": pod_key, "trace_id": trace_id, "records": []}
+                self._pods[pod_key] = entry
+                if len(self._pods) > self.capacity:
+                    self._pods.popitem(last=False)
+            else:
+                self._pods.move_to_end(pod_key)
+                if trace_id:
+                    entry["trace_id"] = trace_id
+            entry["records"].append(rec)
+            if len(entry["records"]) > self.max_records:
+                del entry["records"][: -self.max_records]
+
+    def get(self, pod_key: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._pods.get(pod_key)
+            if entry is None:
+                return None
+            return {
+                "pod": entry["pod"],
+                "trace_id": entry["trace_id"],
+                "records": [dict(r) for r in entry["records"]],
+            }
+
+    def pods(self) -> list:
+        with self._lock:
+            return list(self._pods)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pods.clear()
+
+    def explain(self, pod_key: str) -> str:
+        """The human-readable "why this node" answer for
+        ``/debug/schedule/<pod>``."""
+        entry = self.get(pod_key)
+        if entry is None:
+            return (
+                f"no scheduling audit for pod {pod_key!r} — it was never "
+                "filtered by this scheduler (or its record aged out of the "
+                f"{self.capacity}-pod audit window)\n"
+            )
+        lines = [f"scheduling audit for {pod_key}"]
+        if entry["trace_id"]:
+            lines.append(f"trace: {entry['trace_id']}  (see /traces)")
+        for rec in entry["records"]:
+            t = time.strftime(
+                "%H:%M:%S", time.localtime(rec["t_unix"])
+            ) + f".{int(rec['t_unix'] * 1000) % 1000:03d}"
+            stage = rec["stage"]
+            if stage == "filter":
+                # verdict payloads may end in a _clip() elision marker
+                # ("... +N more" list entry / "..." dict key) — render it
+                # as an elision line, never as a fake node verdict
+                ok = rec.get("ok", [])
+                ok_marker = (
+                    ok[-1]
+                    if ok and str(ok[-1]).startswith("... +")
+                    else None
+                )
+                if ok_marker is not None:
+                    ok = ok[:-1]
+                failed = dict(rec.get("failed", {}))
+                failed_marker = failed.pop("...", None)
+                lines.append(
+                    f"{t}  filter: {len(ok)}/{len(ok) + len(failed)} "
+                    "nodes feasible"
+                    + (
+                        " (verdict lists truncated)"
+                        if ok_marker is not None or failed_marker
+                        else ""
+                    )
+                )
+                for n in ok:
+                    lines.append(f"          {n}: ok")
+                if ok_marker is not None:
+                    lines.append(f"          {ok_marker} feasible")
+                for n, why in sorted(failed.items()):
+                    lines.append(f"          {n}: REJECTED — {why}")
+                if failed_marker:
+                    lines.append(
+                        f"          ... {failed_marker} rejected"
+                    )
+            elif stage == "priorities":
+                scores = dict(rec.get("scores", {}))
+                elided = scores.pop("...", None)  # _clip() marker is a
+                # string — it must not reach the numeric sort key
+                ranked = sorted(scores.items(), key=lambda kv: -kv[1])
+                lines.append(
+                    f"{t}  priorities: "
+                    + " ".join(f"{n}={s}" for n, s in ranked)
+                    + (f" (... {elided})" if elided else "")
+                )
+            elif stage == "bind":
+                node = rec.get("node", "?")
+                err = rec.get("error", "")
+                if err:
+                    lines.append(f"{t}  bind → {node}: FAILED — {err}")
+                else:
+                    extra = ""
+                    if rec.get("chips"):
+                        extra = f"  chips={rec['chips']}"
+                    if rec.get("duration_ms") is not None:
+                        extra += f"  ({rec['duration_ms']}ms)"
+                    lines.append(f"{t}  bind → {node}: ok{extra}")
+            elif stage == "gang":
+                lines.append(
+                    f"{t}  gang {rec.get('gang', '?')}: "
+                    f"{rec.get('event', '?')}"
+                    + (
+                        f" — {rec['detail']}" if rec.get("detail") else ""
+                    )
+                )
+            elif stage == "preemption":
+                lines.append(
+                    f"{t}  preemption: candidate on "
+                    f"{rec.get('nodes', 0)} node(s), "
+                    f"victims {rec.get('victims', {})}"
+                )
+            else:
+                rest = {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("stage", "t_unix")
+                }
+                lines.append(f"{t}  {stage}: {json.dumps(rest, default=str)}")
+        return "\n".join(lines) + "\n"
+
+
+# Process-global instances: instrumentation sites import these the same
+# way they import the metric families (metrics/__init__.py REGISTRY).
+TRACER = Tracer()
+AUDIT = ScheduleAudit()
+
+
+def traces_response(params: dict, tracer: Optional[Tracer] = None) -> dict:
+    """The one ``GET /traces`` response shape, shared by the extender and
+    inference servers (query params: ``trace`` for one trace's span tree,
+    ``format=chrome`` for Perfetto export, ``limit`` for the summary
+    list)."""
+    tracer = tracer if tracer is not None else TRACER
+    trace_id = params.get("trace", "")
+    if params.get("format") == "chrome":
+        return tracer.chrome_trace(trace_id or None)
+    if trace_id:
+        return {"trace_id": trace_id, "spans": tracer.trace(trace_id)}
+    try:
+        limit = int(params.get("limit", "50"))
+    except (TypeError, ValueError):
+        limit = 50
+    return {"tracer": tracer.status(), "traces": tracer.traces(limit)}

@@ -32,6 +32,11 @@ ANNOTATION_GANG_SIZE = "elasticgpu.io/gang-size"  # min members for all-or-nothi
 ANNOTATION_SLICE = "elasticgpu.io/slice"
 ANNOTATION_GANG_SLICES = "elasticgpu.io/gang-slices"  # "sliceA,sliceB,..."
 
+# Scheduling-trace propagation (tracing/__init__.py): written with the
+# bind-time allocation ledger so the on-node side (device plugin, launcher)
+# can continue the pod's scheduling trace.  W3C traceparent format.
+ANNOTATION_TRACEPARENT = "elasticgpu.io/traceparent"
+
 # Node labels describing TPU topology (mirrors GKE's
 # cloud.google.com/gke-tpu-topology convention).
 LABEL_TPU_ACCELERATOR = "elasticgpu.io/tpu-accelerator"  # v4|v5e|v5p|v6e

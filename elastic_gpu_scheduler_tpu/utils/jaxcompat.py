@@ -1,0 +1,74 @@
+"""Version-portable JAX API shims.
+
+``jax.experimental.shard_map`` is deprecated as of JAX v0.8.0 in favor of
+top-level ``jax.shard_map``, whose ``check_rep`` flag was also renamed to
+``check_vma``.  The repo pins its JAX, but pins get bumped — and older
+pins (0.4.x) predate ``jax.shard_map`` entirely.  Every call site goes
+through this one shim so a pin bump in either direction is a no-op:
+
+- prefer ``jax.shard_map`` when the installed JAX has it (non-deprecated
+  path, no DeprecationWarning in the suite);
+- translate ``check_rep`` → ``check_vma`` when the new API renamed it;
+- fall back to ``jax.experimental.shard_map.shard_map`` on old pins.
+
+Import this module only from JAX-plane code (models/ops/parallel); the
+scheduler plane must stay importable without JAX installed.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_API = getattr(jax, "shard_map", None)
+if _API is not None:
+    _PARAMS = frozenset(inspect.signature(_API).parameters)
+else:  # pre-0.6 pin: the experimental module is the only spelling
+    from jax.experimental.shard_map import shard_map as _API  # noqa: N813
+
+    _PARAMS = frozenset(inspect.signature(_API).parameters)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = True,
+              axis_names=None):
+    """``shard_map`` across JAX versions.
+
+    - replication checking is passed under whichever name the installed
+      API uses (``check_vma`` / ``check_rep``);
+    - ``axis_names`` (partial-manual: manual ONLY over these axes) maps
+      to the old API's complementary ``auto`` set on pins that predate
+      the rename.
+    """
+    kw = {}
+    if "check_vma" in _PARAMS:
+        kw["check_vma"] = check_rep
+    elif "check_rep" in _PARAMS:
+        kw["check_rep"] = check_rep
+    if axis_names is not None:
+        if "axis_names" in _PARAMS:
+            kw["axis_names"] = set(axis_names)
+        elif "auto" in _PARAMS:
+            # old spelling: list the axes the body does NOT shard over
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        else:
+            # axis_names carries SEMANTICS (partial-manual); silently
+            # dropping it would compile the body fully-manual over every
+            # mesh axis and corrupt collectives far from the cause
+            raise RuntimeError(
+                "installed jax.shard_map supports neither axis_names nor "
+                "auto; cannot express partial-manual semantics"
+            )
+    return _API(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pcast(x, axis_name, to: str = "varying"):
+    """``lax.pcast`` where the installed JAX has varying-axis types
+    (the VMA system that came with ``check_vma``); identity on pins
+    that predate it — pcast only adjusts the type-level variance
+    annotation, never the value, and pre-VMA JAX has no annotation to
+    adjust."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_name, to=to)

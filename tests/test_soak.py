@@ -143,11 +143,15 @@ def test_heap_growth_bounded_over_churn():
             sched.forget_pod(pod)
             cluster.delete_pod("default", pod.metadata.name)
 
+    from elastic_gpu_scheduler_tpu.tracing import AUDIT, TRACER
+
     report = heap_profile(top_n=5)  # starts tracing
     assert "tracemalloc" in report
     for _ in range(10):  # warm-up: caches, pools, interned strings
         cycle()
     cluster.events.clear()  # test-harness accumulation, not scheduler state
+    TRACER.reset()
+    AUDIT.reset()
     gc.collect()
     import tracemalloc
 
@@ -155,6 +159,13 @@ def test_heap_growth_bounded_over_churn():
     for _ in range(50):
         cycle()
     cluster.events.clear()
+    # the span ring and audit registry are INTENDED bounded retention
+    # (deque maxlen / FIFO-capped dicts) still filling toward their caps
+    # at this churn volume — drop them so the assertion measures leaks,
+    # not observability buffers; the bounds themselves are pinned by
+    # tests/test_tracing.py
+    TRACER.reset()
+    AUDIT.reset()
     gc.collect()
     grown = tracemalloc.get_traced_memory()[0] - base
     diff_report = heap_profile(top_n=10, diff=True)

@@ -1,0 +1,585 @@
+"""End-to-end scheduling trace & decision audit (tracing/__init__.py).
+
+Covers: traceparent round trips, ring-buffer bounds under concurrent
+writers, the pod trace spanning filter → priorities → bind over real HTTP,
+trace propagation into the device plugin's Allocate via gRPC-style
+metadata, per-node rejection reasons in /debug/schedule/<pod>, the
+/debug/ index + block profile endpoints, and the disabled-sampling
+overhead guard."""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.tracing import (
+    AUDIT,
+    NOOP_SPAN,
+    TRACER,
+    ScheduleAudit,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    TRACER.configure(1.0)
+    TRACER.reset()
+    AUDIT.enabled = True
+    AUDIT.reset()
+    yield
+    TRACER.configure(1.0)
+    AUDIT.enabled = True
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0, annotations=None):
+    ann = dict(annotations or {})
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+@pytest.fixture()
+def stack():
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, priority="binpack",
+                    gang_timeout=2.0)
+    )
+    from elastic_gpu_scheduler_tpu.server.handlers import Preemption
+
+    server = ExtenderServer(
+        predicate, prioritize, bind, status,
+        preemption=Preemption(registry, clientset),
+        host="127.0.0.1", port=0,
+    )
+    port = server.start()
+    yield cluster, clientset, port
+    server.stop()
+
+
+def post(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        body = r.read()
+        try:
+            return r.status, json.loads(body)
+        except ValueError:
+            return r.status, body.decode()
+
+
+# -- wire format -------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    sp = TRACER.span("x")
+    tp = sp.traceparent()
+    ctx = parse_traceparent(tp)
+    assert ctx is not None
+    assert ctx.trace_id == sp.trace_id and ctx.span_id == sp.span_id
+    assert ctx.sampled
+    assert format_traceparent(ctx) == tp
+    sp.end()
+
+
+@pytest.mark.parametrize("bad", [
+    "", None, "garbage", "00-abc-def-01",
+    "00-" + "g" * 32 + "-" + "0" * 16 + "-01",  # non-hex
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+    "00-" + "1" * 32 + "-" + "1" * 16,          # missing flags
+    "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",  # non-hex version
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+    "00-a_" + "a" * 30 + "-" + "b" * 16 + "-01",  # int() underscore hole
+    "00-+" + "a" * 31 + "-" + "b" * 16 + "-01",   # int() sign hole
+])
+def test_traceparent_rejects_malformed(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_preemption_header_joins_client_trace(stack):
+    """The traceparent header must join the client's trace on EVERY verb
+    — preemption included (it has no kube-scheduler traceparent field, so
+    the header is its only propagation channel)."""
+    cluster, clientset, port = stack
+    pod = tpu_pod("preemptor", core=100)
+    cluster.create_pod(pod)
+    client_tp = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+    code, _ = post(port, "/scheduler/preemption",
+                   {"Pod": pod.to_dict(), "NodeNameToMetaVictims": {}},
+                   headers={"traceparent": client_tp})
+    assert code == 200
+    spans = [s for s in TRACER.finished()
+             if s.name == "extender.preemption"]
+    assert spans and spans[-1].trace_id == "c" * 32
+
+
+def test_unsampled_flag_propagates():
+    tp = "00-" + "a" * 32 + "-" + "b" * 16 + "-00"  # sampled bit clear
+    assert TRACER.span("x", parent=tp) is NOOP_SPAN
+
+
+# -- ring buffer -------------------------------------------------------------
+
+
+def test_ring_buffer_bounds_under_concurrent_writers():
+    tr = Tracer(capacity=256, sample=1.0)
+    n_threads, per_thread = 8, 400
+
+    def writer(k):
+        for i in range(per_thread):
+            with tr.span(f"w{k}-{i}", idx=i):
+                pass
+
+    threads = [
+        threading.Thread(target=writer, args=(k,)) for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    finished = tr.finished()
+    assert len(finished) == 256  # bounded, oldest evicted
+    assert tr.dropped == n_threads * per_thread - 256
+    # the survivors are real, finished spans
+    assert all(s.duration is not None for s in finished)
+
+
+def test_pod_root_registry_bounded_and_evicted_roots_closed():
+    tr = Tracer(capacity=64, sample=1.0, pod_capacity=4)
+    for i in range(10):
+        tr.pod_span(f"default/p{i}")
+    assert len(tr.open_pod_roots()) == 4
+    # evicted roots were force-closed into the ring with evicted status
+    evicted = [s for s in tr.finished() if s.status == "evicted"]
+    assert len(evicted) == 6
+
+
+def test_audit_bounded():
+    audit = ScheduleAudit(capacity=3, max_records=5, enabled=True)
+    for i in range(6):
+        audit.record(f"default/p{i}", "filter", ok=["n"], failed={})
+    assert len(audit.pods()) == 3
+    for _ in range(12):
+        audit.record("default/p5", "filter", ok=["n"], failed={})
+    assert len(audit.get("default/p5")["records"]) == 5
+
+
+def test_explain_survives_truncated_records():
+    """explain() must render clipped records (>64-node clusters) instead
+    of crashing on the elision markers (the '...' scores key is a string
+    the numeric sort key would choke on)."""
+    audit = ScheduleAudit(capacity=8, max_records=8, enabled=True)
+    n = ScheduleAudit.MAX_NODES_PER_RECORD + 36
+    audit.record(
+        "default/big", "filter",
+        ok=[f"n{i}" for i in range(n)],
+        failed={f"m{i}": "insufficient TPU resources" for i in range(n)},
+    )
+    audit.record(
+        "default/big", "priorities",
+        scores={f"n{i}": i % 10 for i in range(n)},
+    )
+    text = audit.explain("default/big")
+    assert "verdict lists truncated" in text
+    assert "+36 more feasible" in text and "+36 more rejected" in text
+    assert "priorities:" in text and "(... +36 more)" in text
+    # no fake node line from the marker
+    assert "... +36 more: ok" not in text
+
+
+def test_audit_record_payloads_truncated():
+    """A 500-node cluster's verdict lists must not ride whole into every
+    audit record (nodes x records x pods would be multi-GB resident)."""
+    audit = ScheduleAudit(capacity=8, max_records=8, enabled=True)
+    cap = ScheduleAudit.MAX_NODES_PER_RECORD
+    ok = [f"n{i}" for i in range(500)]
+    failed = {f"m{i}": "insufficient TPU resources" for i in range(500)}
+    audit.record("default/big", "filter", ok=ok, failed=failed)
+    rec = audit.get("default/big")["records"][0]
+    assert len(rec["ok"]) == cap + 1 and "+436 more" in rec["ok"][-1]
+    assert len(rec["failed"]) == cap + 1
+    assert rec["failed"]["..."] == "+436 more"
+
+
+# -- end-to-end over HTTP ----------------------------------------------------
+
+
+def test_one_trace_spans_filter_priorities_bind(stack):
+    cluster, clientset, port = stack
+    pod = tpu_pod("traced", core=100)
+    cluster.create_pod(pod)
+    nodes = ["node-0", "node-1"]
+
+    code, filt = post(port, "/scheduler/filter",
+                      {"Pod": pod.to_dict(), "NodeNames": nodes})
+    assert code == 200 and filt["NodeNames"]
+    code, prio = post(port, "/scheduler/priorities",
+                      {"Pod": pod.to_dict(), "NodeNames": filt["NodeNames"]})
+    assert code == 200
+    best = max(prio, key=lambda hp: hp["Score"])["Host"]
+    code, bound = post(port, "/scheduler/bind", {
+        "PodName": "traced", "PodNamespace": "default",
+        "PodUID": pod.metadata.uid, "Node": best,
+    })
+    assert code == 200 and not bound["Error"]
+
+    # ONE trace contains the whole story
+    code, listing = get(port, "/traces")
+    assert code == 200
+    roots = [t for t in listing["traces"] if t["name"] == "schedule default/traced"]
+    assert roots, listing
+    trace_id = roots[0]["trace_id"]
+    code, detail = get(port, f"/traces?trace={trace_id}")
+    names = {s["name"] for s in detail["spans"]}
+    assert {"schedule default/traced", "extender.filter",
+            "extender.priorities", "extender.bind", "sched.assume",
+            "sched.score", "sched.bind"} <= names
+    # every span belongs to the same trace and the verb spans parent back
+    # to the pod root
+    assert all(s["trace_id"] == trace_id for s in detail["spans"])
+    root = next(s for s in detail["spans"]
+                if s["name"] == "schedule default/traced")
+    verb_parents = {
+        s["parent_id"] for s in detail["spans"]
+        if s["name"].startswith("extender.")
+    }
+    assert verb_parents == {root["span_id"]}
+    # bind closed the pod trace
+    assert TRACER.pod_context("default/traced") is None
+
+    # the annotation ledger carries the trace context for the on-node side
+    bound_pod = clientset.get_pod("default", "traced")
+    tp = bound_pod.metadata.annotations.get(consts.ANNOTATION_TRACEPARENT)
+    assert tp and parse_traceparent(tp).trace_id == trace_id
+
+    # chrome export round-trips
+    code, chrome = get(port, f"/traces?trace={trace_id}&format=chrome")
+    assert code == 200
+    assert any(
+        e.get("ph") == "X" and e["name"] == "extender.bind"
+        for e in chrome["traceEvents"]
+    )
+
+
+def test_device_plugin_allocate_joins_trace(stack):
+    """The bound pod's traceparent annotation, passed as gRPC metadata,
+    links the on-node Allocate into the scheduling trace."""
+    cluster, clientset, port = stack
+    pod = tpu_pod("onnode", core=100)
+    cluster.create_pod(pod)
+    code, filt = post(port, "/scheduler/filter",
+                      {"Pod": pod.to_dict(), "NodeNames": ["node-0"]})
+    assert filt["NodeNames"]
+    post(port, "/scheduler/bind", {
+        "PodName": "onnode", "PodNamespace": "default",
+        "PodUID": pod.metadata.uid, "Node": "node-0",
+    })
+    tp = clientset.get_pod("default", "onnode").metadata.annotations[
+        consts.ANNOTATION_TRACEPARENT
+    ]
+
+    from elastic_gpu_scheduler_tpu.deviceplugin import deviceplugin_pb2 as pb
+    from elastic_gpu_scheduler_tpu.deviceplugin.plugin import TPUDevicePlugin
+
+    class Ctx:
+        def invocation_metadata(self):
+            return (("traceparent", tp),)
+
+    plugin = TPUDevicePlugin(chips=[("0", "/dev/accel0"), ("1", "/dev/accel1")])
+    resp = plugin.Allocate(
+        pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devices_i_ds=[f"0/{u}" for u in range(100)]
+            )
+        ]),
+        Ctx(),
+    )
+    assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0"
+    alloc = [s for s in TRACER.finished()
+             if s.name == "deviceplugin.allocate"]
+    assert alloc and alloc[-1].trace_id == parse_traceparent(tp).trace_id
+    assert alloc[-1].attrs["chips"] == ["0"]
+    assert alloc[-1].attrs["core_units"] == 100
+
+
+def test_rejection_reasons_in_schedule_debug(stack):
+    cluster, clientset, port = stack
+    big = tpu_pod("toobig", core=10000)  # 100 chips: nowhere fits
+    cluster.create_pod(big)
+    code, filt = post(port, "/scheduler/filter",
+                      {"Pod": big.to_dict(), "NodeNames": ["node-0", "node-1"]})
+    assert code == 200 and not filt["NodeNames"]
+    assert set(filt["FailedNodes"]) == {"node-0", "node-1"}
+
+    code, text = get(port, "/debug/schedule/toobig")  # default ns inferred
+    assert code == 200
+    assert "0/2 nodes feasible" in text
+    assert "node-0: REJECTED — insufficient TPU resources" in text
+    assert "node-1: REJECTED — insufficient TPU resources" in text
+
+    # a pod never filtered answers honestly
+    code, text = get(port, "/debug/schedule/nonexistent")
+    assert "no scheduling audit" in text
+
+
+def test_schedule_debug_shows_scores_and_bind(stack):
+    cluster, clientset, port = stack
+    pod = tpu_pod("why", core=200)
+    cluster.create_pod(pod)
+    _, filt = post(port, "/scheduler/filter",
+                   {"Pod": pod.to_dict(), "NodeNames": ["node-0", "node-1"]})
+    post(port, "/scheduler/priorities",
+         {"Pod": pod.to_dict(), "NodeNames": filt["NodeNames"]})
+    post(port, "/scheduler/bind", {
+        "PodName": "why", "PodNamespace": "default",
+        "PodUID": pod.metadata.uid, "Node": filt["NodeNames"][0],
+    })
+    _, text = get(port, "/debug/schedule/default/why")
+    assert "filter: 2/2 nodes feasible" in text
+    assert "priorities:" in text
+    assert f"bind → {filt['NodeNames'][0]}: ok" in text
+    assert "chips=" in text
+
+
+def test_gang_members_share_audit_and_commit_trace(stack):
+    cluster, clientset, port = stack
+    pods = [tpu_pod(f"g-{i}", core=200, gang="tg", gang_size=2)
+            for i in range(2)]
+    for p in pods:
+        cluster.create_pod(p)
+    for p in pods:
+        code, filt = post(port, "/scheduler/filter",
+                          {"Pod": p.to_dict(),
+                           "NodeNames": ["node-0", "node-1"]})
+        assert filt["NodeNames"], filt
+        p.planned = filt["NodeNames"][0]
+
+    results = {}
+
+    def bind(p):
+        results[p.metadata.name] = post(port, "/scheduler/bind", {
+            "PodName": p.metadata.name, "PodNamespace": "default",
+            "PodUID": p.metadata.uid, "Node": p.planned,
+        })
+
+    threads = [threading.Thread(target=bind, args=(p,)) for p in pods]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(not r[1]["Error"] for r in results.values()), results
+
+    # commit span exists, with all three phases marked
+    commits = [s for s in TRACER.finished() if s.name == "gang.commit"]
+    assert len(commits) == 1
+    phases = {e["name"] for e in commits[0].events}
+    assert {"phase1_allocated", "phase2_annotated",
+            "phase3_bindings_posted"} <= phases
+    # each member's audit shows its slot claim and gang bind
+    for p in pods:
+        entry = AUDIT.get(p.key)
+        stages = [r["stage"] for r in entry["records"]]
+        assert "gang" in stages and "bind" in stages
+        bind_rec = next(r for r in entry["records"] if r["stage"] == "bind")
+        assert bind_rec.get("gang") is True and bind_rec.get("chips")
+
+
+def test_gang_infeasible_audited(stack):
+    cluster, clientset, port = stack
+    p = tpu_pod("g-big-0", core=400, gang="huge", gang_size=64)
+    cluster.create_pod(p)
+    code, filt = post(port, "/scheduler/filter",
+                      {"Pod": p.to_dict(), "NodeNames": ["node-0", "node-1"]})
+    assert not filt["NodeNames"]
+    _, text = get(port, "/debug/schedule/default/g-big-0")
+    assert "plan_infeasible" in text and "cannot fit" in text
+
+
+# -- debug surface -----------------------------------------------------------
+
+
+def test_debug_index_lists_everything(stack):
+    _, _, port = stack
+    code, html = get(port, "/debug/")
+    assert code == 200
+    for endpoint in ("/debug/pprof/profile", "/debug/pprof/heap",
+                     "/debug/pprof/mutex", "/debug/pprof/block",
+                     "/debug/pprof/trace", "/debug/stacks", "/traces",
+                     "/debug/schedule/", "/metrics"):
+        assert endpoint in html
+    code2, html2 = get(port, "/debug/pprof")
+    assert code2 == 200 and html2 == html
+
+
+def test_block_profile_attributes_park_sites(stack):
+    _, _, port = stack
+    q = queue.Queue()
+    stop = threading.Event()
+
+    def parked():
+        while not stop.is_set():
+            try:
+                q.get(timeout=0.1)
+            except queue.Empty:
+                pass
+
+    t = threading.Thread(target=parked, name="park-probe", daemon=True)
+    t.start()
+    try:
+        code, text = get(port, "/debug/pprof/block?seconds=0.4")
+        assert code == 200
+        lines = [l for l in text.splitlines() if not l.startswith("#")]
+        assert lines, text
+        # the probe thread parks in queue.get from THIS file: attributed
+        # to an application frame, classified as a queue park
+        assert any(
+            " queue " in f" {l} " and "test_tracing.py" in l for l in lines
+        ), text
+    finally:
+        stop.set()
+        t.join(timeout=2)
+
+
+# -- sampling knob -----------------------------------------------------------
+
+
+def test_disabled_sampling_is_noop_singleton():
+    TRACER.configure(0.0)
+    before = len(TRACER.finished())
+    s = TRACER.span("x", a=1)
+    assert s is NOOP_SPAN
+    with s as inner:
+        inner.set_attr("b", 2).event("e")
+    assert TRACER.pod_span("default/p") is NOOP_SPAN
+    assert TRACER.pod_traceparent("default/p") == ""
+    TRACER.finish_pod("default/p")
+    assert len(TRACER.finished()) == before
+    assert TRACER.status()["open_pod_traces"] == 0
+
+
+def test_disabled_sampling_overhead_under_one_percent_of_bind():
+    """Acceptance guard: with sampling off, the tracer's per-verb cost
+    must be <1% of the bind path.  A bind is ~1ms+ (HTTP + allocate +
+    two API writes); the bind path makes ~6 tracer touches (handler span,
+    sched spans, pod-root lookups, audit gate) — so the per-touch no-op
+    cost must stay well under 1000ns * 1% * ~1/6 ≈ 1.6us.  Measured over
+    50k iterations to amortize timer noise."""
+    TRACER.configure(0.0)
+    AUDIT.enabled = False
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with TRACER.span("bind", pod="p", node="n"):
+            pass
+        TRACER.pod_traceparent("default/p")
+        if AUDIT.enabled:
+            AUDIT.record("default/p", "bind")
+    per_op_us = (time.perf_counter() - t0) / n * 1e6
+    # three tracer touches per iteration; generous CI headroom (a no-op
+    # span is ~0.3us on an idle box)
+    assert per_op_us < 8.0, f"{per_op_us:.2f}us per disabled-path iteration"
+
+
+def test_sampling_rate_partial():
+    tr = Tracer(capacity=4096, sample=0.5)
+    kept = sum(1 for i in range(400) if tr.span(f"s{i}"))
+    assert 100 < kept < 300  # binomial(400, .5), 6-sigma bounds
+
+
+def test_partial_sampling_decision_sticks_per_pod():
+    """The head-sampling roll happens ONCE per pod trace: whatever filter
+    decided (sampled or not), priorities/bind for the same pod see the
+    same answer — never a trace that begins at bind."""
+    tr = Tracer(capacity=1024, sample=0.5, pod_capacity=128)
+    sampled = unsampled = 0
+    for i in range(60):
+        first = tr.pod_span(f"default/s{i}")
+        for _ in range(3):  # later verbs must reuse the memoized decision
+            assert tr.pod_span(f"default/s{i}") is first
+        if first:
+            sampled += 1
+        else:
+            unsampled += 1
+    assert sampled and unsampled  # both outcomes occurred at p=0.5
+    # unsampled memoization slots are invisible to trace listings
+    assert len(tr.open_pod_roots()) == sampled
+
+
+# -- metrics satellite (orphan-wait parking) ---------------------------------
+
+
+def test_flush_orphan_takes_no_locks():
+    """The weakref.finalize hook must be callable while _DRAIN_LOCK is
+    held (GC can fire it on a thread inside a drain) without
+    deadlocking, and the parked waits must fold into the histogram on
+    the next scrape."""
+    from elastic_gpu_scheduler_tpu import metrics as m
+
+    buf = [0.001, 0.002]
+    with m._DRAIN_LOCK:  # simulate GC during a drain
+        m._flush_orphan("orphan-probe", buf)  # returns immediately
+    assert buf == []  # buffer consumed
+    summary = m.LOCK_WAIT.summary()  # scrape path folds the parked batch
+    assert "orphan-probe" in summary
+    assert summary["orphan-probe"]["acquisitions"] >= 2
+
+
+def test_dying_timedlock_waits_survive():
+    import gc
+
+    from elastic_gpu_scheduler_tpu import metrics as m
+
+    tl = m.TimedLock("dying-probe")
+    for _ in range(3):
+        with tl:
+            pass
+    del tl
+    gc.collect()
+    summary = m.LOCK_WAIT.summary()
+    assert summary.get("dying-probe", {}).get("acquisitions", 0) >= 3
