@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -29,6 +29,13 @@ test-par:
 
 bench:
 	python bench.py
+
+# Hard-fail when the 1024-member gang-plan microbench (min of 5) exceeds
+# BENCH_PLAN_BUDGET_MS (default 135ms) — the regression tripwire bench.py
+# only warns about.  Run after any change near core/allocator, core/chip,
+# native/placement.cc, or scheduler/gang.
+check-plan-budget:
+	python tools/check_plan_budget.py
 
 # Probe the TPU relay all round; capture + commit a green on-chip artifact
 # (BENCH_TPU_validation.json) the moment it comes up (VERDICT r3 Next #1).

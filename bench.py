@@ -309,6 +309,47 @@ def p99(xs):
     return xs[max(0, int(0.99 * len(xs)) - 1)] if xs else 0.0
 
 
+def plan_microbench(trials: int = 5) -> list:
+    """Whole-gang planning wall for 1024 members on a v5p-2048 mesh, one
+    fresh stack per trial (a reused coordinator would answer later filters
+    from the cached plan).  Returns per-trial milliseconds; min-of-trials is
+    the reported metric.  Shared with tools/check_plan_budget.py so the CI
+    tripwire and the bench artifact cannot measure different things."""
+    from elastic_gpu_scheduler_tpu.k8s.extender import ExtenderArgs
+
+    plan_trials_ms = []
+    for _trial in range(trials):
+        cluster = FakeCluster()
+        i = 0
+        for x in range(0, 8, 2):
+            for y in range(0, 16, 2):
+                for z in range(8):
+                    cluster.add_node(
+                        make_tpu_node(
+                            f"xl-h{i}", chips=4, hbm_gib=380,
+                            accelerator="v5p", slice_topology="8x16x8",
+                            host_topology="2x2x1", host_offset=f"{x}.{y}.{z}",
+                            slice_name="v5p-2048",
+                        )
+                    )
+                    i += 1
+        clientset = FakeClientset(cluster)
+        registry, predicate, prioritize, bind, controller, status, gang = (
+            build_stack(clientset, cluster=cluster, priority="ici-locality")
+        )
+        xl_pod = tpu_pod("xl-probe", core=100, gang="xl", gang_size=1024)
+        cluster.create_pod(xl_pod)
+        t0 = time.perf_counter()
+        filt = predicate.handle(
+            ExtenderArgs(
+                pod=xl_pod, node_names=[f"xl-h{j}" for j in range(256)]
+            )
+        )
+        assert filt.node_names, filt.failed_nodes
+        plan_trials_ms.append((time.perf_counter() - t0) * 1000)
+    return plan_trials_ms
+
+
 def chip_peak_tflops_bf16() -> float:
     """Detected chip's bf16 peak (TFLOPS) for MFU accounting."""
     import jax
@@ -438,6 +479,12 @@ def model_bench_on_tpu():
 
     if os.environ.get("BENCH_MODEL", "1") == "0":
         return {}
+    if os.environ.get("BENCH_SKIP_TPU_PROBE", "0") == "1":
+        # local/dev escape hatch: with the relay down, the probe's 5×60s
+        # retry wall dominates the run while the scheduler metrics are
+        # already computed — skip the TPU sections entirely, but say so in
+        # the artifact so a missing MFU number is attributable
+        return {"tpu_model_bench_skipped": "BENCH_SKIP_TPU_PROBE=1"}
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "5"))
     wait_s = float(os.environ.get("BENCH_TPU_WAIT", "60"))
     err = ""
@@ -1270,38 +1317,7 @@ def main():
     # (a reused coordinator would answer later filters from the cached
     # plan); min is the metric, median+trials record the spread so
     # artifact readers can see the noise without bench.py archaeology.
-    from elastic_gpu_scheduler_tpu.k8s.extender import ExtenderArgs
-
-    plan_trials_ms = []
-    for _trial in range(5):
-        cluster = FakeCluster()
-        i = 0
-        for x in range(0, 8, 2):
-            for y in range(0, 16, 2):
-                for z in range(8):
-                    cluster.add_node(
-                        make_tpu_node(
-                            f"xl-h{i}", chips=4, hbm_gib=380,
-                            accelerator="v5p", slice_topology="8x16x8",
-                            host_topology="2x2x1", host_offset=f"{x}.{y}.{z}",
-                            slice_name="v5p-2048",
-                        )
-                    )
-                    i += 1
-        clientset = FakeClientset(cluster)
-        registry, predicate, prioritize, bind, controller, status, gang = (
-            build_stack(clientset, cluster=cluster, priority="ici-locality")
-        )
-        xl_pod = tpu_pod("xl-probe", core=100, gang="xl", gang_size=1024)
-        cluster.create_pod(xl_pod)
-        t0 = time.perf_counter()
-        filt = predicate.handle(
-            ExtenderArgs(
-                pod=xl_pod, node_names=[f"xl-h{j}" for j in range(256)]
-            )
-        )
-        assert filt.node_names, filt.failed_nodes
-        plan_trials_ms.append((time.perf_counter() - t0) * 1000)
+    plan_trials_ms = plan_microbench(trials=5)
     plan_ms = round(min(plan_trials_ms), 3)
     results["v5p2048_gang1024_plan_ms"] = plan_ms
     results["v5p2048_gang1024_plan_median_ms"] = round(
@@ -1326,7 +1342,14 @@ def main():
             f"{budget_ms}ms budget", file=sys.stderr,
         )
 
-    results.update(model_bench_on_tpu())
+    # the TPU sections are strictly additive: a probe/section CRASH must
+    # not take down the scheduler headline metrics already in `results`
+    # (v5p2048_gang1024_plan_ms et al. are computed above and emit either
+    # way; before this guard an uncaught probe exception lost them all)
+    try:
+        results.update(model_bench_on_tpu())
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["tpu_model_bench_error"] = f"orchestrator crashed: {e}"[:300]
 
     headline = p99(per_pod) * 1000
     out = {

@@ -23,11 +23,11 @@ gang scheduler reuses this search unchanged at slice scale.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable, Iterator, Optional
+from typing import Iterable, Iterator, Mapping, Optional
 
-from .chip import Chip
+from .chip import Chip, ChipRef
 from .request import TPURequest
-from .topology import Coord, Topology
+from .topology import Coord, Topology, bounding_box
 
 # Search budget: max complete assignments rated per trade() call.  The
 # reference's DFS is unbounded (gpu.go:65-129) and explodes combinatorially;
@@ -77,8 +77,87 @@ class Rater:
 
     name = "rater"
 
+    # True → the score depends only on the RELATIVE geometry of the chips
+    # touched plus candidate-invariant aggregates, never on absolute mesh
+    # coordinates — the gang planner may then replay a memoized placement
+    # found on one node onto a congruent node (option_from_template) without
+    # re-rating.  Default False: an unknown custom rater silently losing its
+    # absolute-position signal would be a correctness bug, so subclasses
+    # must opt in (rater.py sets it on the stock policies).
+    translation_invariant = False
+    # True → for a single whole-chip container every non-locality score term
+    # is identical across candidate boxes (the box consumes the same totals
+    # whichever free chips it lands on), so argmax(rate) == argmax(locality
+    # bonus) with first-wins ties.  Lets the gang planner use the native
+    # plan_gang kernel instead of the per-member trade DFS.  Same opt-in
+    # stance as translation_invariant.
+    whole_chip_compact_first = False
+
     def rate(self, chips: "ChipSet", option: Option) -> float:
         raise NotImplementedError
+
+
+def iter_bits(bits: int) -> Iterator[int]:
+    """Indices of set bits, ascending."""
+    while bits:
+        low = bits & -bits
+        yield low.bit_length() - 1
+        bits ^= low
+
+
+def iter_contiguous_boxes(
+    topo: Topology,
+    sorted_free: list[Coord],
+    free_set: set,
+    count: int,
+    max_candidates: int,
+) -> Iterator[tuple[Coord, ...]]:
+    """THE canonical contiguous-candidate stream: compact-first shapes ×
+    free-anchored origins, fully-free boxes only, deduped, capped at
+    ``max_candidates``.  The one Python copy — shared by
+    ``ChipSet._whole_chip_candidates`` and ``plan_gang_fallback`` so the
+    per-container search and the whole-gang kernel can never walk different
+    streams (native/placement.cc replicates it in C++; tests/test_native.py
+    asserts equality)."""
+    emitted = 0
+    seen: set[frozenset] = set()
+    for shape in topo.box_shapes(count):
+        for box in topo.placements_at(shape, sorted_free):
+            if emitted >= max_candidates:
+                return
+            if all(c in free_set for c in box):
+                key = frozenset(box)
+                if key in seen:
+                    continue
+                seen.add(key)
+                emitted += 1
+                yield box
+
+
+class _ChipsView(Mapping):
+    """Read/write mapping view over a ChipSet's packed chip state.
+
+    Keeps the classic ``cs.chips[coord].take_whole()`` surface working on
+    top of the array/bitset representation; yields ``ChipRef`` views whose
+    mutations write through to the owning set.
+    """
+
+    __slots__ = ("_cs",)
+
+    def __init__(self, cs: "ChipSet"):
+        self._cs = cs
+
+    def __getitem__(self, coord: Coord) -> ChipRef:
+        return ChipRef(self._cs, self._cs._slot[coord])
+
+    def __iter__(self) -> Iterator[Coord]:
+        return iter(self._cs._coords)
+
+    def __len__(self) -> int:
+        return len(self._cs._coords)
+
+    def __contains__(self, coord: object) -> bool:
+        return coord in self._cs._slot
 
 
 class ChipSet:
@@ -86,61 +165,216 @@ class ChipSet:
 
     ``topo`` describes the full mesh the coordinates live in; ``chips`` may
     cover only part of it (a host's chips within a slice).
+
+    State is packed: parallel total/avail arrays in canonical (row-major)
+    coordinate order plus a ``_free_bits`` bitset (untouched chips)
+    maintained incrementally by the single ``_set_slot`` choke point.  ``clone()`` is
+    therefore a handful of list copies and int assignments (O(words)), not
+    O(chips) Python objects: the gang planner clones per-node state for
+    every candidate node of every plan, which made object-graph cloning a
+    measurable slice of the 1024-member plan wall.  ``chips`` remains a
+    mapping view (`ChipRef` values) for compatibility.
     """
 
     def __init__(self, topo: Topology, chips: Iterable[Chip]):
         self.topo = topo
-        self.chips: dict[Coord, Chip] = {}
+        entries: dict[Coord, Chip] = {}
         for ch in chips:
             if not topo.contains(ch.coord):
                 raise ValueError(f"chip coord {ch.coord} outside topology {topo.dims}")
-            if ch.coord in self.chips:
+            if ch.coord in entries:
                 raise ValueError(f"duplicate chip coord {ch.coord}")
-            self.chips[ch.coord] = ch
+            entries[ch.coord] = ch
+        ordered = sorted(entries.values(), key=lambda c: topo.index(c.coord))
+        self._coords: tuple[Coord, ...] = tuple(c.coord for c in ordered)
+        self._slot: dict[Coord, int] = {c: i for i, c in enumerate(self._coords)}
+        self._mesh_idx: tuple[int, ...] = tuple(
+            topo.index(c) for c in self._coords
+        )
+        self._core_total: list[int] = [c.core_total for c in ordered]
+        self._hbm_total: list[int] = [c.hbm_total for c in ordered]
+        self._core_avail: list[int] = [c.core_avail for c in ordered]
+        self._hbm_avail: list[int] = [c.hbm_avail for c in ordered]
+        self._geom = None  # lazy relative-geometry token (plan_key)
+        self._resync()
+
+    def _resync(self) -> None:
+        """Rebuild bitsets + sums from the arrays (construction / refresh)."""
+        free = 0
+        for i in range(len(self._coords)):
+            if (
+                self._core_avail[i] == self._core_total[i]
+                and self._hbm_avail[i] == self._hbm_total[i]
+            ):
+                free |= 1 << i
+        self._free_bits = free
+        self._avail_core_sum = sum(self._core_avail)
+        self._avail_hbm_sum = sum(self._hbm_avail)
+        self._total_core_sum = sum(self._core_total)
+        self._total_hbm_sum = sum(self._hbm_total)
+
+    def _set_slot(self, i: int, core_avail: int, hbm_avail: int) -> None:
+        """THE mutation choke point: every chip-state change lands here so
+        the bitsets and sums can never drift from the arrays."""
+        self._avail_core_sum += core_avail - self._core_avail[i]
+        self._avail_hbm_sum += hbm_avail - self._hbm_avail[i]
+        self._core_avail[i] = core_avail
+        self._hbm_avail[i] = hbm_avail
+        if core_avail == self._core_total[i] and hbm_avail == self._hbm_total[i]:
+            self._free_bits |= 1 << i
+        else:
+            self._free_bits &= ~(1 << i)
+
+    def _set_total(self, i: int, core_total: int | None = None,
+                   hbm_total: int | None = None) -> None:
+        if core_total is not None:
+            self._total_core_sum += core_total - self._core_total[i]
+            self._core_total[i] = core_total
+        if hbm_total is not None:
+            self._total_hbm_sum += hbm_total - self._hbm_total[i]
+            self._hbm_total[i] = hbm_total
+        # re-derive this chip's free/partial bits under the new totals
+        self._set_slot(i, self._core_avail[i], self._hbm_avail[i])
 
     # -- introspection -------------------------------------------------------
 
     @property
-    def num_chips(self) -> int:
-        return len(self.chips)
+    def chips(self) -> _ChipsView:
+        return _ChipsView(self)
 
-    def free_chips(self) -> list[Chip]:
+    @property
+    def num_chips(self) -> int:
+        return len(self._coords)
+
+    def free_count(self) -> int:
+        """Untouched-chip count in O(1) (popcount of the free bitset)."""
+        return self._free_bits.bit_count()
+
+    def free_chips(self) -> list[ChipRef]:
         """Untouched chips in canonical (row-major) coordinate order."""
-        return sorted(
-            (c for c in self.chips.values() if c.is_free),
-            key=lambda c: self.topo.index(c.coord),
-        )
+        return [ChipRef(self, i) for i in iter_bits(self._free_bits)]
 
     def total_core(self) -> int:
-        return sum(c.core_total for c in self.chips.values())
+        return self._total_core_sum
 
     def avail_core(self) -> int:
-        return sum(c.core_avail for c in self.chips.values())
+        return self._avail_core_sum
 
     def total_hbm(self) -> int:
-        return sum(c.hbm_total for c in self.chips.values())
+        return self._total_hbm_sum
 
     def avail_hbm(self) -> int:
-        return sum(c.hbm_avail for c in self.chips.values())
+        return self._avail_hbm_sum
 
     def clone(self) -> "ChipSet":
-        return ChipSet(self.topo, (c.clone() for c in self.chips.values()))
+        new = ChipSet.__new__(ChipSet)
+        new.topo = self.topo
+        # immutable identity: shared across the whole clone lineage
+        new._coords = self._coords
+        new._slot = self._slot
+        new._mesh_idx = self._mesh_idx
+        new._geom = self._geom
+        # mutable state: flat int-list copies + bitset ints — O(words)
+        new._core_total = self._core_total[:]
+        new._hbm_total = self._hbm_total[:]
+        new._core_avail = self._core_avail[:]
+        new._hbm_avail = self._hbm_avail[:]
+        new._free_bits = self._free_bits
+        new._avail_core_sum = self._avail_core_sum
+        new._avail_hbm_sum = self._avail_hbm_sum
+        new._total_core_sum = self._total_core_sum
+        new._total_hbm_sum = self._total_hbm_sum
+        return new
 
     def status(self) -> dict:
         return {
             "topology": self.topo.spec(),
             "chips": {
                 ".".join(map(str, co)): {
-                    "core_avail": ch.core_avail,
-                    "core_total": ch.core_total,
-                    "hbm_avail": ch.hbm_avail,
-                    "hbm_total": ch.hbm_total,
+                    "core_avail": self._core_avail[i],
+                    "core_total": self._core_total[i],
+                    "hbm_avail": self._hbm_avail[i],
+                    "hbm_total": self._hbm_total[i],
                 }
-                for co, ch in sorted(
-                    self.chips.items(), key=lambda kv: self.topo.index(kv[0])
-                )
+                for i, co in enumerate(self._coords)
             },
         }
+
+    # -- plan memoization keys ----------------------------------------------
+
+    def _geometry(self) -> tuple:
+        """Translation-normalized geometry token: two ChipSets with equal
+        tokens own congruent coordinate sets (same relative positions in the
+        same mesh), so a placement found on one maps slot-for-slot onto the
+        other.  A set that straddles a torus seam on a wrapped axis contains
+        both 0 and dims-1 there, forcing base 0 — such sets only compare
+        equal to absolutely-identical ones, so wrapping candidate boxes can
+        never be mis-translated."""
+        g = self._geom
+        if g is None:
+            if not self._coords:
+                g = (self.topo.dims, self.topo.wrap, ())
+            else:
+                nd = len(self.topo.dims)
+                base = tuple(
+                    min(c[a] for c in self._coords) for a in range(nd)
+                )
+                rel = tuple(
+                    tuple(v - b for v, b in zip(c, base)) for c in self._coords
+                )
+                g = (self.topo.dims, self.topo.wrap, rel)
+            self._geom = g
+        return g
+
+    def plan_key(self) -> tuple:
+        """Hashable token of relative geometry + full chip state.  Equal
+        keys → ``trade`` walks an identical candidate stream and (for
+        translation-invariant raters) scores candidates identically, so the
+        winning placement can be replayed by local slot index
+        (``option_from_template``) without re-running the DFS."""
+        return (
+            self._geometry(),
+            tuple(self._core_total),
+            tuple(self._hbm_total),
+            tuple(self._core_avail),
+            tuple(self._hbm_avail),
+        )
+
+    def option_template(self, option: Option) -> tuple:
+        """Strip an Option to slot indices (coordinate-free, memoizable)."""
+        return (
+            option.score,
+            tuple(
+                (
+                    a.container,
+                    tuple(self._slot[c] for c in a.coords),
+                    a.whole,
+                    a.core,
+                    a.hbm,
+                    a.contiguous,
+                )
+                for a in option.allocs
+            ),
+        )
+
+    def option_from_template(self, tmpl: tuple, request_hash: str) -> Option:
+        """Rehydrate a memoized placement onto THIS set's coordinates."""
+        score, allocs = tmpl
+        return Option(
+            request_hash,
+            tuple(
+                ContainerAlloc(
+                    container=name,
+                    coords=tuple(self._coords[i] for i in slots),
+                    whole=whole,
+                    core=core,
+                    hbm=hbm,
+                    contiguous=contiguous,
+                )
+                for name, slots, whole, core, hbm, contiguous in allocs
+            ),
+            score,
+        )
 
     # -- candidate generation ------------------------------------------------
 
@@ -150,9 +384,9 @@ class ChipSet:
     def _free_mask(self) -> bytes:
         """Row-major 0/1 mask over the FULL mesh (unowned coords = 0)."""
         mask = bytearray(self.topo.num_chips)
-        for c in self.chips.values():
-            if c.is_free:
-                mask[self.topo.index(c.coord)] = 1
+        mesh_idx = self._mesh_idx
+        for i in iter_bits(self._free_bits):
+            mask[mesh_idx[i]] = 1
         return bytes(mask)
 
     def _whole_chip_candidates(
@@ -168,16 +402,19 @@ class ChipSet:
         Large meshes use the native C++ enumerator (core/native.py); results
         are identical to the Python path (tests/test_native.py).
         """
-        free = {co for co, ch in self.chips.items() if ch.is_free}
-        if len(free) < count:
+        if self._free_bits.bit_count() < count:
             return
+        # slots are canonical (row-major) order, so free coords come out
+        # already sorted by mesh index
+        sorted_free = [self._coords[i] for i in iter_bits(self._free_bits)]
+        free = set(sorted_free)
         emitted = 0
         # the C++ mask scan is O(mesh); it wins only when this set OWNS a
         # large share of the mesh.  A host view (4-8 chips of a 1024-chip
         # slice) enumerates faster from its own free cells (placements_at)
         # than by scanning the full mesh — keying the threshold on owned
         # chips, not mesh size, was the 1024-member gang-plan hot fix.
-        if len(self.chips) >= self.NATIVE_THRESHOLD:
+        if len(self._coords) >= self.NATIVE_THRESHOLD:
             from .native import get_placement
 
             native = get_placement()
@@ -193,31 +430,20 @@ class ChipSet:
                     emitted += 1
                     yield tuple(self.topo.coord_of(i) for i in idx_box), True
                 if emitted == 0:
-                    fallback = tuple(sorted(free, key=self.topo.index)[:count])
-                    yield fallback, False
+                    yield tuple(sorted_free[:count]), False
                 return
-        seen: set[frozenset] = set()
-        sorted_free = sorted(free, key=self.topo.index)
-        for shape in self.topo.box_shapes(count):
-            for box in self.topo.placements_at(shape, sorted_free):
-                if emitted >= max_candidates:
-                    break
-                if all(c in free for c in box):
-                    key = frozenset(box)
-                    if key in seen:
-                        continue
-                    seen.add(key)
-                    emitted += 1
-                    yield box, True
-            if emitted >= max_candidates:
-                break
+        for box in iter_contiguous_boxes(
+            self.topo, sorted_free, free, count, max_candidates
+        ):
+            emitted += 1
+            yield box, True
         if emitted == 0:
             yield tuple(sorted_free[:count]), False
 
     def _fractional_candidates(self, core: int, hbm: int) -> Iterator[Coord]:
-        for ch in sorted(self.chips.values(), key=lambda c: self.topo.index(c.coord)):
-            if ch.can_fit(core, hbm):
-                yield ch.coord
+        for i, coord in enumerate(self._coords):
+            if self._core_avail[i] >= core and self._hbm_avail[i] >= hbm:
+                yield coord
 
     # -- the search ----------------------------------------------------------
 
@@ -299,20 +525,40 @@ class ChipSet:
     # -- state transitions ---------------------------------------------------
 
     def _apply(self, alloc: ContainerAlloc) -> None:
+        slot = self._slot
         if alloc.whole:
             for c in alloc.coords:
-                self.chips[c].take_whole()
+                i = slot[c]
+                if not (self._free_bits >> i & 1):
+                    raise ValueError(f"chip {c}: not free for whole-chip take")
+                self._set_slot(i, 0, 0)
         else:
+            core, hbm = alloc.core, alloc.hbm
             for c in alloc.coords:
-                self.chips[c].take(alloc.core, alloc.hbm)
+                i = slot[c]
+                ca, ha = self._core_avail[i], self._hbm_avail[i]
+                if ca < core or ha < hbm:
+                    raise ValueError(
+                        f"chip {c}: cannot take core={core} hbm={hbm} "
+                        f"(avail core={ca} hbm={ha})"
+                    )
+                self._set_slot(i, ca - core, ha - hbm)
 
     def _revert(self, alloc: ContainerAlloc) -> None:
+        slot = self._slot
         if alloc.whole:
             for c in alloc.coords:
-                self.chips[c].give_whole()
+                i = slot[c]
+                self._set_slot(i, self._core_total[i], self._hbm_total[i])
         else:
+            core, hbm = alloc.core, alloc.hbm
             for c in alloc.coords:
-                self.chips[c].give(alloc.core, alloc.hbm)
+                i = slot[c]
+                self._set_slot(
+                    i,
+                    min(self._core_total[i], self._core_avail[i] + core),
+                    min(self._hbm_total[i], self._hbm_avail[i] + hbm),
+                )
 
     def _tally(
         self, option: Option
@@ -329,7 +575,7 @@ class ChipSet:
             if not a.needs_tpu:
                 continue
             for c in a.coords:
-                if c not in self.chips:
+                if c not in self._slot:
                     return None
                 if a.whole:
                     if c in whole:
@@ -347,11 +593,11 @@ class ChipSet:
             return False
         whole_need, core_need, hbm_need = tally
         for c in whole_need:
-            if not self.chips[c].is_free or c in core_need:
+            if not (self._free_bits >> self._slot[c] & 1) or c in core_need:
                 return False
         for c, need in core_need.items():
-            ch = self.chips[c]
-            if ch.core_avail < need or ch.hbm_avail < hbm_need.get(c, 0):
+            i = self._slot[c]
+            if self._core_avail[i] < need or self._hbm_avail[i] < hbm_need.get(c, 0):
                 return False
         return True
 
@@ -377,15 +623,15 @@ class ChipSet:
             return False
         whole_free, core_free, hbm_free = tally
         for c in whole_free:
-            ch = self.chips[c]
+            i = self._slot[c]
             # a whole-chip holder has the chip exclusively and fully taken
-            if ch.core_avail != 0 or ch.hbm_avail != 0 or c in core_free:
+            if self._core_avail[i] != 0 or self._hbm_avail[i] != 0 or c in core_free:
                 return False
         for c, freed in core_free.items():
-            ch = self.chips[c]
-            if (ch.core_total - ch.core_avail) < freed:
+            i = self._slot[c]
+            if (self._core_total[i] - self._core_avail[i]) < freed:
                 return False
-            if (ch.hbm_total - ch.hbm_avail) < hbm_free.get(c, 0):
+            if (self._hbm_total[i] - self._hbm_avail[i]) < hbm_free.get(c, 0):
                 return False
         return True
 
@@ -394,3 +640,80 @@ class ChipSet:
         for a in option.allocs:
             if a.needs_tpu:
                 self._revert(a)
+
+
+# -- gang-plan kernel (Python fallback of native plan_gang) -------------------
+
+
+def whole_box_bonus(coords: tuple[Coord, ...]) -> float:
+    """Locality bonus of ONE contiguous whole-chip box: fill of the
+    bounding box, penalized by elongation.  The single Python copy of this
+    formula — rater._locality_bonus calls it per alloc, the gang-plan
+    kernels use it for candidate argmax, and native/placement.cc replicates
+    it bit-for-bit in C++ (including the single-chip literal shortcut:
+    1.0 - 0.3 is one ulp away from the 0.7 literal in IEEE doubles)."""
+    if len(coords) == 1:
+        # bb=(1,..), fill=1, elong=1 → 1·(1-0.3) exactly; skipping
+        # bounding_box here halves gang-plan rating cost
+        return 0.7
+    bb = bounding_box(coords)
+    vol = 1
+    for d in bb:
+        vol *= d
+    fill = len(coords) / vol if vol else 0.0
+    elong = max(bb) / max(1, len(coords))
+    return max(0.0, min(1.0, fill * (1.0 - 0.3 * elong)))
+
+
+def plan_gang_fallback(
+    topo: Topology,
+    free_lists: list[tuple[int, ...]],
+    count: int,
+    members: int,
+    max_candidates: int = 64,
+) -> list[tuple[int, tuple[int, ...], bool]]:
+    """Pure-Python gang-plan kernel: greedily place up to ``members``
+    identical ``count``-whole-chip members onto per-node free sets.
+
+    ``free_lists[n]`` holds node n's free cells as row-major mesh indices
+    (ascending).  Nodes are consumed with a forward-only cursor (members are
+    identical: a node full for one is full for all).  Per member the node's
+    candidate stream is the canonical compact-first enumeration of
+    ``ChipSet._whole_chip_candidates`` and the winner is the highest
+    ``whole_box_bonus`` with first-wins ties — exactly the choice
+    ``ChipSet.trade`` makes for a single whole-chip container under any
+    rater whose non-locality terms are candidate-invariant (Binpack /
+    Spread / ICILocality; see Rater.whole_chip_compact_first).
+
+    Returns ``[(node_idx, sorted_mesh_indices, contiguous), ...]`` — one
+    entry per placed member, possibly fewer than ``members`` when capacity
+    runs out.  The native kernel (native/placement.cc plan_gang) is
+    bit-identical; tests/test_native.py asserts it.
+    """
+    out: list[tuple[int, tuple[int, ...], bool]] = []
+    remaining: list[list[int]] = [sorted(f) for f in free_lists]
+    cursor = 0
+    while len(out) < members and cursor < len(remaining):
+        free_idx = remaining[cursor]
+        if len(free_idx) < count:
+            cursor += 1
+            continue
+        free_coords = [topo.coord_of(i) for i in free_idx]
+        free_set = set(free_coords)
+        best: Optional[tuple[tuple[Coord, ...], bool]] = None
+        best_bonus = -1.0
+        for box in iter_contiguous_boxes(
+            topo, free_coords, free_set, count, max_candidates
+        ):
+            bonus = whole_box_bonus(box)
+            if bonus > best_bonus:
+                best_bonus = bonus
+                best = (box, True)
+        if best is None:  # no contiguous box: non-contiguous fallback
+            best = (tuple(free_coords[:count]), False)
+        box, contiguous = best
+        idxs = tuple(sorted(topo.index(c) for c in box))
+        taken = set(idxs)
+        remaining[cursor] = [i for i in free_idx if i not in taken]
+        out.append((cursor, idxs, contiguous))
+    return out

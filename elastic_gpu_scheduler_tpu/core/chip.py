@@ -70,3 +70,113 @@ class Chip:
         return Chip(
             self.coord, self.core_total, self.hbm_total, self.core_avail, self.hbm_avail
         )
+
+
+class ChipRef:
+    """Live view of one chip inside a ``ChipSet``'s packed arrays.
+
+    The ChipSet keeps chip state in parallel arrays plus free/partial
+    bitsets (so ``clone()`` is O(words), not O(chips) Python objects);
+    this ref exposes the classic per-chip surface — ``core_avail``,
+    ``take()``, ``take_whole()`` and friends — reading and writing
+    through to the owning set so external mutation (tests, capacity
+    refresh) keeps the bitsets coherent.  API-compatible with ``Chip``.
+    """
+
+    __slots__ = ("_cs", "_i")
+
+    def __init__(self, cs, i: int):
+        self._cs = cs
+        self._i = i
+
+    @property
+    def coord(self) -> Coord:
+        return self._cs._coords[self._i]
+
+    @property
+    def core_total(self) -> int:
+        return self._cs._core_total[self._i]
+
+    @core_total.setter
+    def core_total(self, v: int) -> None:
+        self._cs._set_total(self._i, core_total=v)
+
+    @property
+    def hbm_total(self) -> int:
+        return self._cs._hbm_total[self._i]
+
+    @hbm_total.setter
+    def hbm_total(self, v: int) -> None:
+        self._cs._set_total(self._i, hbm_total=v)
+
+    @property
+    def core_avail(self) -> int:
+        return self._cs._core_avail[self._i]
+
+    @core_avail.setter
+    def core_avail(self, v: int) -> None:
+        cs = self._cs
+        cs._set_slot(self._i, v, cs._hbm_avail[self._i])
+
+    @property
+    def hbm_avail(self) -> int:
+        return self._cs._hbm_avail[self._i]
+
+    @hbm_avail.setter
+    def hbm_avail(self, v: int) -> None:
+        cs = self._cs
+        cs._set_slot(self._i, cs._core_avail[self._i], v)
+
+    @property
+    def is_free(self) -> bool:
+        return bool(self._cs._free_bits >> self._i & 1)
+
+    @property
+    def is_untouched(self) -> bool:
+        return self.is_free
+
+    def can_fit(self, core: int, hbm: int) -> bool:
+        cs = self._cs
+        return cs._core_avail[self._i] >= core and cs._hbm_avail[self._i] >= hbm
+
+    def take(self, core: int, hbm: int) -> None:
+        cs = self._cs
+        if not self.can_fit(core, hbm):
+            raise ValueError(
+                f"chip {self.coord}: cannot take core={core} hbm={hbm} "
+                f"(avail core={self.core_avail} hbm={self.hbm_avail})"
+            )
+        cs._set_slot(
+            self._i, cs._core_avail[self._i] - core, cs._hbm_avail[self._i] - hbm
+        )
+
+    def give(self, core: int, hbm: int) -> None:
+        cs = self._cs
+        cs._set_slot(
+            self._i,
+            min(cs._core_total[self._i], cs._core_avail[self._i] + core),
+            min(cs._hbm_total[self._i], cs._hbm_avail[self._i] + hbm),
+        )
+
+    def take_whole(self) -> None:
+        if not self.is_free:
+            raise ValueError(f"chip {self.coord}: not free for whole-chip take")
+        self._cs._set_slot(self._i, 0, 0)
+
+    def give_whole(self) -> None:
+        cs = self._cs
+        cs._set_slot(self._i, cs._core_total[self._i], cs._hbm_total[self._i])
+
+    def clone(self) -> Chip:
+        """Detached value copy (a plain ``Chip``)."""
+        return Chip(
+            self.coord, self.core_total, self.hbm_total,
+            self.core_avail, self.hbm_avail,
+        )
+
+    def __repr__(self) -> str:  # mirrors the Chip dataclass repr fields
+        return (
+            f"ChipRef(coord={self.coord}, core_total={self.core_total}, "
+            f"hbm_total={self.hbm_total}, core_avail={self.core_avail}, "
+            f"hbm_avail={self.hbm_avail})"
+        )

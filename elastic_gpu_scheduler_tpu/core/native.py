@@ -5,6 +5,12 @@ the image; pybind11/grpcio-tools are not, so the module uses the raw CPython
 API and is compiled with a single g++ invocation).  Every caller must treat
 ``get_placement() is None`` as "use the Python fallback" — results of the two
 paths are bit-identical (tests/test_native.py asserts it).
+
+Two kernels: ``enumerate_free_boxes`` (contiguous sub-box candidates for one
+container) and ``plan_gang`` (whole-gang greedy placement over per-node free
+sets — the 1024-member hot loop).  A rebuilt source gains functions lazily:
+callers probe with ``hasattr(mod, "plan_gang")`` so a stale in-process module
+degrades to the Python fallback instead of crashing.
 """
 
 from __future__ import annotations

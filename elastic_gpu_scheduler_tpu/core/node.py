@@ -23,9 +23,9 @@ so plain "N chips" nodes work with zero topology configuration.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
+from ..metrics import TimedLock
 from ..utils import consts
 from .allocator import ChipSet, Option, Rater
 from .chip import CORE_PER_CHIP, Chip
@@ -88,7 +88,12 @@ class NodeAllocator:
         self.chips = ChipSet(topo, chips)
         self.allocated: dict[str, Option] = {}  # request hash → assumed option
         self._allocated_at: dict[str, float] = {}  # request hash → monotonic
-        self.lock = threading.Lock()
+        # the mutation shard of the scheduler's lock hierarchy: gang
+        # coordinator (10) → engine registry lock (20) → per-node allocator
+        # locks (30).  Ranked so an inversion raises instead of deadlocking,
+        # and wait-time-instrumented under one shared LOCK_WAIT label
+        # ("node") so /metrics shows how long binds queue on node state.
+        self.lock = TimedLock("node", rank=30)
 
     def _evict_stale_locked(self) -> None:
         import time

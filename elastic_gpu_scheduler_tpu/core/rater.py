@@ -21,8 +21,7 @@ from __future__ import annotations
 import hashlib
 
 from ..utils import consts
-from .allocator import ChipSet, ContainerAlloc, Option, Rater
-from .topology import bounding_box
+from .allocator import ChipSet, ContainerAlloc, Option, Rater, whole_box_bonus
 
 
 def _consumed_view(chips: ChipSet, alloc: ContainerAlloc):
@@ -34,26 +33,16 @@ def _consumed_view(chips: ChipSet, alloc: ContainerAlloc):
 
 
 def _locality_bonus(chips: ChipSet, option: Option) -> float:
-    """0..1: how compact the whole-chip placements are."""
+    """0..1: how compact the whole-chip placements are.
+
+    The per-box math lives in ``allocator.whole_box_bonus`` — the ONE copy
+    the gang-plan kernels (native + fallback) replicate bit-for-bit, so the
+    kernels' argmax can never drift from what trade would have rated."""
     scores = []
     for a in option.allocs:
         if not a.whole or not a.coords:
             continue
-        if not a.contiguous:
-            scores.append(0.0)
-            continue
-        if len(a.coords) == 1:
-            # single chip: bb=(1,..), fill=1, elong=1 → 1·(1-0.3) exactly;
-            # skipping bounding_box here halves gang-plan rating cost
-            scores.append(0.7)
-            continue
-        bb = bounding_box(a.coords)
-        vol = 1
-        for d in bb:
-            vol *= d
-        fill = len(a.coords) / vol if vol else 0.0
-        elong = max(bb) / max(1, len(a.coords))  # 1.0 for a line, small for cubes
-        scores.append(max(0.0, min(1.0, fill * (1.0 - 0.3 * elong))))
+        scores.append(whole_box_bonus(a.coords) if a.contiguous else 0.0)
     if not scores:
         return 1.0
     return sum(scores) / len(scores)
@@ -97,10 +86,12 @@ class Binpack(Rater):
     rater.go:15-51, with a bounded formula and a working cross-node term)."""
 
     name = consts.PRIORITY_BINPACK
+    translation_invariant = True
+    whole_chip_compact_first = True
 
     def rate(self, chips: ChipSet, option: Option) -> float:
         total = max(1, chips.num_chips)
-        untouched = sum(1 for c in chips.chips.values() if c.is_free)
+        untouched = chips.free_count()  # O(1) popcount of the free bitset
         preserve = untouched / total  # after assignment: free chips kept whole
         return (
             35.0 * _node_used_before(chips, option)
@@ -115,6 +106,8 @@ class Spread(Rater):
     reference's Spread is a TODO stub, rater.go:56-59; this is a real one)."""
 
     name = consts.PRIORITY_SPREAD
+    translation_invariant = True
+    whole_chip_compact_first = True
 
     def rate(self, chips: ChipSet, option: Option) -> float:
         # NOTE: no post-assignment variance term — per-node variance rewards
@@ -133,15 +126,23 @@ class ICILocality(Rater):
     binpack-like otherwise.  This is the default for multi-chip SPMD jobs."""
 
     name = consts.PRIORITY_ICI
+    translation_invariant = True
+    whole_chip_compact_first = True
 
     def rate(self, chips: ChipSet, option: Option) -> float:
         total = max(1, chips.num_chips)
-        untouched = sum(1 for c in chips.chips.values() if c.is_free)
+        untouched = chips.free_count()  # O(1) popcount of the free bitset
         return 70.0 * _locality_bonus(chips, option) + 30.0 * (untouched / total)
 
 
 class Random(Rater):
-    """Deterministic pseudo-random per option (seeded by the option's coords)."""
+    """Deterministic pseudo-random per option (seeded by the option's coords).
+
+    Scores hash ABSOLUTE coordinates, so neither planner shortcut applies:
+    a memoized placement translated to another node would get a different
+    score there (translation_invariant stays False), and the best candidate
+    is not the most compact one (whole_chip_compact_first stays False).
+    """
 
     name = consts.PRIORITY_RANDOM
 

@@ -308,6 +308,16 @@ GANG_COMMIT = REGISTRY.register(
         "(allocate + annotation write + binding; excludes barrier wait)",
     )
 )
+PLAN_CACHE = REGISTRY.register(
+    Counter(
+        "tpu_scheduler_plan_events_total",
+        "Gang-plan fast-path events: native_kernel/python_kernel count "
+        "plan_gang invocations, hit/miss count the memoized per-member "
+        "trade cache (hit = a congruent node state replayed a placement "
+        "instead of re-running the DFS)",
+        ("event",),
+    )
+)
 class _LockWaitHistogram(Histogram):
     """LOCK_WAIT with lazy ingestion: every read API drains the
     TimedLock wait buffers first.

@@ -8,9 +8,16 @@ Two cooperating mechanisms (SURVEY §7 hard part (b)):
 
 1. **Plan at filter time.**  When the first gang member hits the filter verb,
    the coordinator *plans the whole gang*: it clones the current chip state of
-   every candidate node (in ICI mesh order — slice, then host offset) and
-   greedily places all N member shapes onto the clones.  If the gang cannot
-   fully fit, every member is rejected — nothing is ever partially admitted.
+   every candidate node (in ICI mesh order — slice, then host offset; clones
+   are O(words) bitset snapshots, core/allocator.ChipSet) and greedily places
+   all N member shapes onto the clones.  Homogeneous whole-chip gangs — the
+   SPMD shape — go through the ``plan_gang`` kernel (native C++ when built,
+   bit-identical Python fallback otherwise): per-node free bitsets in, every
+   member's box out of one call, no per-member DFS.  Everything else runs the
+   per-member trade search with results memoized by (shape, node-state) so
+   congruent hosts replay one placement instead of re-searching
+   (``_trade_cached``).  If the gang cannot fully fit, every member is
+   rejected — nothing is ever partially admitted.
    If it fits, the plan yields N node slots, and each arriving member's
    filter returns exactly its claimed slot.  Mesh-ordered planning makes the
    gang occupy contiguous hosts, so the slice's ICI links stay inside the
@@ -63,14 +70,24 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..core.allocator import (
+    ContainerAlloc,
+    Option,
+    iter_bits,
+    plan_gang_fallback,
+)
 from ..core.request import TPURequest, request_from_pod
 from ..k8s.objects import Pod
-from ..metrics import GANG_COMMIT, GANG_EVENTS, TimedLock
+from ..metrics import GANG_COMMIT, GANG_EVENTS, PLAN_CACHE, TimedLock
 from ..tracing import AUDIT, NOOP_SPAN, TRACER
 from ..utils import consts
 from .scheduler import ResourceScheduler, TPUUnitScheduler
 
 log = logging.getLogger("tpu-scheduler")
+
+# sentinels for the whole-gang fast path / trade memo (None is a valid value)
+_FAST_INELIGIBLE = object()
+_MISS = object()
 
 
 def _trap(fn, item):
@@ -339,19 +356,25 @@ class GangCoordinator:
         if len(candidates) > 1:
             candidates.append([n for _, n in ordered])  # spanning fallback
         demand = req.total_chips_equiv * req.gang_size * 100  # core units
+        # ONE registry fetch + ONE pass of per-node locks for the whole plan
+        # (the old prefilter re-took sched.lock then na.lock per node per
+        # candidate group — 2×nodes×groups acquisitions of the hottest lock)
+        allocators = sched.get_allocators([n for _, n in ordered])
+        free_core: dict[str, int] = {}
+        for name, na in allocators.items():
+            if na is not None:
+                with na.lock:
+                    free_core[name] = na.chips.avail_core()
+        # memoized trade results, shared across candidate groups — keyed by
+        # full node state, so clones from different groups can only hit when
+        # the states genuinely match
+        memo: dict = {}
         for group in candidates:
             # cheap prefilter: skip groups whose total free core can't hold
             # the gang (saves the clone+replay work on hopeless slices)
-            free = 0
-            for name in group:
-                with sched.lock:
-                    na = sched._get_allocator(name)
-                if na is not None:
-                    with na.lock:
-                        free += na.chips.avail_core()
-            if free < demand:
+            if sum(free_core.get(n, 0) for n in group) < demand:
                 continue
-            planned = self._plan_on(sched, req, group)
+            planned = self._plan_on(sched, req, group, allocators, memo)
             if planned is not None:
                 slots, options = planned
                 return _Plan(
@@ -361,8 +384,31 @@ class GangCoordinator:
                 )
         return None
 
+    def _trade_cached(self, cs, req: TPURequest, rater, memo: Optional[dict]):
+        """``cs.trade`` with per-plan memoization: results are keyed by
+        (request shape, full node state incl. relative geometry), so the
+        placement found for one gang member replays onto every congruent
+        node state — identical hosts of an SPMD slice hit after one DFS per
+        distinct fill level instead of re-searching per member.  Only valid
+        for translation-invariant raters (the template stores slot indices,
+        not absolute coords); others go straight to trade."""
+        if memo is None or not getattr(rater, "translation_invariant", False):
+            return cs.trade(req, rater)
+        key = (req.units, req.container_names, cs.plan_key())
+        hit = memo.get(key, _MISS)
+        if hit is not _MISS:
+            PLAN_CACHE.inc("hit")
+            return (
+                None if hit is None else cs.option_from_template(hit, req.hash())
+            )
+        opt = cs.trade(req, rater)
+        memo[key] = None if opt is None else cs.option_template(opt)
+        PLAN_CACHE.inc("miss")
+        return opt
+
     def _reserve_other_plans(
-        self, sched, clones: dict, get_clone, skip_key: Optional[str] = None
+        self, sched, clones: dict, get_clone, skip_key: Optional[str] = None,
+        memo: Optional[dict] = None,
     ) -> None:
         """Replay other ACTIVE plans' placements into the clones so
         concurrent gangs don't double-count the same free chips (caller holds
@@ -410,21 +456,29 @@ class GangCoordinator:
                         else other.member_containers
                     ),
                 )
-                opt = cs.trade(member_req, sched.rater)
+                opt = self._trade_cached(cs, member_req, sched.rater, memo)
                 if opt is not None:
                     cs.transact(opt)
 
     @staticmethod
-    def _clone_ctx(sched: TPUUnitScheduler):
+    def _clone_ctx(sched: TPUUnitScheduler, allocators: Optional[dict] = None):
         """(clones, get_clone): lazily clone per-node chip state for
-        plan simulation — plans never touch real allocators until bind."""
+        plan simulation — plans never touch real allocators until bind.
+
+        ``allocators`` is the batch prefetched by the caller (one sched.lock
+        acquisition for the whole plan); nodes outside it — e.g. another
+        plan's slots during reservation replay — fall back to a one-off
+        batch fetch.  Cloning itself takes only the node's own lock, and is
+        O(words) with the packed ChipSet representation."""
         clones: dict = {}
 
         def get_clone(name):
             cs = clones.get(name)
             if cs is None:
-                with sched.lock:
-                    na = sched._get_allocator(name)
+                if allocators is not None and name in allocators:
+                    na = allocators[name]
+                else:
+                    na = sched.get_allocators([name]).get(name)
                 if na is None:
                     return None
                 with na.lock:
@@ -456,8 +510,14 @@ class GangCoordinator:
         filter with a named error.  Full scan per member (no forward-only
         cursor — a node full for one shape may fit another); heterogeneous
         gangs are expected to be small."""
-        clones, get_clone = self._clone_ctx(sched)
-        self._reserve_other_plans(sched, clones, get_clone, skip_key=gkey)
+        allocators = sched.get_allocators(
+            list(dict.fromkeys(list(node_names) + list(plan.slots)))
+        )
+        clones, get_clone = self._clone_ctx(sched, allocators)
+        memo: dict = {}
+        self._reserve_other_plans(
+            sched, clones, get_clone, skip_key=gkey, memo=memo
+        )
         n_claimed = len(plan.claims)
         new_slots = list(plan.slots)
         new_options = list(plan.options)
@@ -477,7 +537,7 @@ class GangCoordinator:
                 units=new_units[idx],
                 container_names=new_containers[idx],
             )
-            opt = cs.trade(member_req, sched.rater)
+            opt = self._trade_cached(cs, member_req, sched.rater, memo)
             if opt is None:
                 return False
             cs.transact(opt)
@@ -503,7 +563,7 @@ class GangCoordinator:
                 cs = get_clone(name)
                 if cs is None:
                     continue
-                opt = cs.trade(member_req, sched.rater)
+                opt = self._trade_cached(cs, member_req, sched.rater, memo)
                 if opt is not None:
                     cs.transact(opt)
                     new_slots[idx] = name
@@ -521,18 +581,148 @@ class GangCoordinator:
         plan.slot_containers = new_containers
         return True
 
+    @staticmethod
+    def _whole_gang_shape(req: TPURequest, rater) -> Optional[int]:
+        """chip_count when this request is the homogeneous single
+        whole-chip-unit SPMD shape the plan_gang kernel handles (and the
+        rater guarantees compact-first selection matches its argmax), else
+        None."""
+        if not getattr(rater, "whole_chip_compact_first", False):
+            return None
+        tpu = [u for u in req.units if u.needs_tpu]
+        if len(tpu) != 1 or not tpu[0].wants_whole_chips:
+            return None
+        return tpu[0].chip_count
+
+    def _plan_whole_fast(
+        self,
+        sched: TPUUnitScheduler,
+        req: TPURequest,
+        ordered: list[str],
+        get_clone,
+        count: int,
+    ):
+        """Whole-gang placement through the plan_gang kernel (native C++
+        when built, bit-identical Python fallback otherwise): per-node free
+        bitsets go in, every member's box comes out of ONE kernel call per
+        topology run — no per-member DFS, no per-candidate Python rating.
+
+        Returns (slots, options), None (gang cannot fit — same verdict the
+        per-member search would reach, it walks the same candidate streams
+        with the same forward-only cursor), or _FAST_INELIGIBLE (state the
+        kernel's selection shortcut doesn't cover: fall back to trade)."""
+        from ..core.native import get_placement
+
+        nodes: list[tuple[str, object]] = []
+        for name in ordered:
+            cs = get_clone(name)
+            if cs is None:
+                continue
+            if len(set(cs._core_total)) > 1 or len(set(cs._hbm_total)) > 1:
+                # heterogeneous chip totals: candidate boxes no longer
+                # consume identical capacity, so non-locality rate terms
+                # stop being candidate-invariant — exact trade required
+                return _FAST_INELIGIBLE
+            nodes.append((name, cs))
+        if not nodes:
+            return None
+        native = get_placement()
+        use_native = native is not None and hasattr(native, "plan_gang")
+        # nodes of different slices carry different Topologies (the
+        # spanning-fallback group mixes slices); run the kernel once per
+        # consecutive same-topology run, preserving the forward-only cursor
+        placements: list[tuple[int, tuple[int, ...], bool]] = []
+        remaining = req.gang_size
+        pos = 0
+        while pos < len(nodes) and remaining > 0:
+            topo = nodes[pos][1].topo
+            end = pos
+            while end < len(nodes) and nodes[end][1].topo == topo:
+                end += 1
+            free_lists = [
+                tuple(cs._mesh_idx[i] for i in iter_bits(cs._free_bits))
+                for _, cs in nodes[pos:end]
+            ]
+            if use_native:
+                placed = native.plan_gang(
+                    topo.dims, topo.wrap, free_lists, count, remaining, 64
+                )
+            else:
+                placed = plan_gang_fallback(
+                    topo, free_lists, count, remaining, 64
+                )
+            # one count per kernel INVOCATION (the metric's documented
+            # meaning) — a spanning group runs it once per topology chunk,
+            # and an infeasible gang still shows the kernel was tried
+            PLAN_CACHE.inc("native_kernel" if use_native else "python_kernel")
+            placements.extend(
+                (pos + node_i, idxs, contig) for node_i, idxs, contig in placed
+            )
+            remaining -= len(placed)
+            pos = end
+        if remaining > 0:
+            return None
+        slots: list[str] = []
+        options: list = []
+        for member, (node_pos, idxs, contiguous) in enumerate(placements):
+            name, cs = nodes[node_pos]
+            coords = tuple(cs.topo.coord_of(i) for i in idxs)
+            allocs = tuple(
+                ContainerAlloc(
+                    container=cname, coords=coords, whole=True,
+                    contiguous=bool(contiguous),
+                )
+                if unit.needs_tpu
+                else ContainerAlloc(container=cname, coords=(), whole=False)
+                for cname, unit in zip(req.container_names, req.units)
+            )
+            member_req = TPURequest(
+                pod_uid=f"plan-{member}",
+                pod_key=f"plan/{member}",
+                units=req.units,
+                container_names=req.container_names,
+            )
+            opt = Option(member_req.hash(), allocs)
+            # direct apply, not transact: the kernel owns the free masks it
+            # just placed against, so re-validating 1024 members is pure
+            # overhead (_apply still raises if a chip is somehow taken)
+            for a in allocs:
+                if a.needs_tpu:
+                    cs._apply(a)
+            # rate AFTER apply, like trade does — cheap now (bitset counts)
+            opt.score = sched.rater.rate(cs, opt)
+            slots.append(name)
+            options.append(opt)
+        return slots, options
+
     def _plan_on(
-        self, sched: TPUUnitScheduler, req: TPURequest, ordered: list[str]
-    ) -> Optional[list[str]]:
+        self,
+        sched: TPUUnitScheduler,
+        req: TPURequest,
+        ordered: list[str],
+        allocators: Optional[dict] = None,
+        memo: Optional[dict] = None,
+    ):
         """Greedy member placement over one candidate node group (cloned).
 
         Members are homogeneous (same shape), so a node that cannot fit
         member k cannot fit member k+1 either — the scan cursor only moves
         forward, making planning O(members + nodes) instead of O(m·n)
-        (a v5p-2048 gang plans in one pass over 256 hosts)."""
-        clones, get_clone = self._clone_ctx(sched)
+        (a v5p-2048 gang plans in one pass over 256 hosts).
 
-        self._reserve_other_plans(sched, clones, get_clone)
+        Whole-chip SPMD gangs take the plan_gang kernel fast path; anything
+        else (fractional shapes, multi-container pods, custom raters) runs
+        the per-member trade DFS with memoized results."""
+        if allocators is None:
+            allocators = sched.get_allocators(ordered)
+        clones, get_clone = self._clone_ctx(sched, allocators)
+
+        self._reserve_other_plans(sched, clones, get_clone, memo=memo)
+        count = self._whole_gang_shape(req, sched.rater)
+        if count is not None:
+            fast = self._plan_whole_fast(sched, req, ordered, get_clone, count)
+            if fast is not _FAST_INELIGIBLE:
+                return fast
         slots: list[str] = []
         options: list = []
         cursor = 0
@@ -550,7 +740,7 @@ class GangCoordinator:
                 if cs is None:
                     cursor += 1
                     continue
-                opt = cs.trade(member_req, sched.rater)
+                opt = self._trade_cached(cs, member_req, sched.rater, memo)
                 if opt is None:
                     cursor += 1  # full for this shape → full for all members
                     continue

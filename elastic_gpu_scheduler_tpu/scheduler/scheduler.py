@@ -98,8 +98,14 @@ class TPUUnitScheduler(ResourceScheduler):
         self.clientset = config.clientset
         self.rater = config.rater
         self.assume_workers = max(1, config.assume_workers)
-        # wait-time-instrumented (metrics.LOCK_WAIT): the single coarse
-        # lock is the scaling cliff; /metrics shows how long binds queue
+        # Sharded locking (wait-time-instrumented via metrics.LOCK_WAIT):
+        # this lock guards ONLY the registry maps (allocators / pod_maps /
+        # released_pods) — chip state lives behind each NodeAllocator's own
+        # ranked lock.  Read verbs (assume/score/planning) take it once per
+        # verb to snapshot allocators; the placement DFS and the cold
+        # allocator build (network fetch + replay list) run OFF it.  Rank
+        # discipline: gang coordinator (10) → this registry lock (20) →
+        # per-node allocator locks (30).
         self.lock = TimedLock("scheduler", reentrant=True, rank=20)
         self.allocators: dict[str, NodeAllocator] = {}
         # pod key → (node, committed Option); the at-most-once ledger
@@ -139,26 +145,59 @@ class TPUUnitScheduler(ResourceScheduler):
         (reference: getNodeInfo, scheduler.go:62-84)."""
         with self.lock:
             na = self.allocators.get(node_name)
-            if na is not None:
-                return na
-            try:
-                node = self.clientset.get_node(node_name)
-            except Exception as e:
-                log.debug("get node %s: %s", node_name, e)
-                return None
-            na = NodeAllocator(node)
-            if na.chips.num_chips == 0:
-                return None
+        if na is not None:
+            return na
+        return self._create_allocator(node_name)
+
+    def get_allocators(
+        self, node_names: list[str]
+    ) -> dict[str, Optional[NodeAllocator]]:
+        """Batch allocator fetch: ONE registry-lock acquisition for every
+        cached node (the common case after warm-up), cold builds off-lock.
+        assume/score/gang-planning call this instead of re-entering the
+        global lock per candidate node."""
+        out: dict[str, Optional[NodeAllocator]] = {}
+        missing: list[str] = []
+        with self.lock:
+            for n in node_names:
+                na = self.allocators.get(n)
+                if na is not None:
+                    out[n] = na
+                else:
+                    missing.append(n)
+        for n in missing:
+            out[n] = self._create_allocator(n)
+        return out
+
+    def _create_allocator(self, node_name: str) -> Optional[NodeAllocator]:
+        """Cold path: fetch the node and its assumed-pod list OUTSIDE the
+        registry lock (these are network calls — under the old coarse lock
+        a cold fetch stalled every verb in the process), then insert and
+        replay under it.  A concurrent creator may win the insert race; the
+        loser defers to the winner's instance."""
+        try:
+            node = self.clientset.get_node(node_name)
+        except Exception as e:
+            log.debug("get node %s: %s", node_name, e)
+            return None
+        na = NodeAllocator(node)
+        if na.chips.num_chips == 0:
+            return None
+        # replay pods already assumed onto this node
+        try:
+            pods = self.clientset.list_pods(
+                label_selector={consts.ANNOTATION_ASSUMED: "true"},
+                field_selector=lambda p: assigned_node(p) == node_name
+                and not p.is_completed(),
+            )
+        except Exception:
+            pods = []
+        replayed: list[Pod] = []
+        with self.lock:
+            cur = self.allocators.get(node_name)
+            if cur is not None:
+                return cur  # lost the creation race; ours was never visible
             self.allocators[node_name] = na
-            # replay pods already assumed onto this node
-            try:
-                pods = self.clientset.list_pods(
-                    label_selector={consts.ANNOTATION_ASSUMED: "true"},
-                    field_selector=lambda p: assigned_node(p) == node_name
-                    and not p.is_completed(),
-                )
-            except Exception:
-                pods = []
             for pod in pods:
                 if pod.key in self.pod_maps:
                     continue
@@ -168,9 +207,34 @@ class TPUUnitScheduler(ResourceScheduler):
                 try:
                     na.add(opt)
                     self.pod_maps[pod.key] = (node_name, opt)
+                    replayed.append(pod)
                 except ValueError as e:
                     log.warning("replay %s on %s: %s", pod.key, node_name, e)
-            return na
+        # Close the fetch-window race: a pod that completed or was deleted
+        # while we were listing got its forget_pod as a no-op (no ledger
+        # entry existed yet) and, if its delete event is already consumed,
+        # nothing would ever free the capacity we just replayed.  Re-check
+        # each replayed pod now that the entry exists — a deletion AFTER
+        # this check finds the entry via the normal watch/resync path.
+        for pod in replayed:
+            stale = False
+            try:
+                cur_pod = self.clientset.get_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+                stale = (
+                    cur_pod.metadata.uid != pod.metadata.uid
+                    or cur_pod.is_completed()
+                )
+            except Exception as e:
+                stale = is_not_found(e)
+            if stale:
+                log.info(
+                    "replay %s on %s: pod ended during allocator build; "
+                    "releasing", pod.key, node_name,
+                )
+                self.forget_pod(pod)
+        return na
 
     # -- verbs ---------------------------------------------------------------
 
@@ -192,10 +256,8 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.assume", pod=pod.key, nodes=len(node_names),
         ) as sp:
-            with self.lock:
-                allocators = [
-                    (n, self._get_allocator(n)) for n in node_names
-                ]
+            by_name = self.get_allocators(node_names)
+            allocators = [(n, by_name[n]) for n in node_names]
 
             ok: list[str] = []
             failed: dict[str, str] = {}
@@ -226,10 +288,13 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.score", pod=pod.key, nodes=len(node_names),
         ):
+            # ONE registry-lock acquisition for all candidates, like
+            # assume() — the old loop re-entered the global lock per node,
+            # serializing priorities against every in-flight bind
+            by_name = self.get_allocators(node_names)
             scores = []
             for n in node_names:
-                with self.lock:
-                    na = self._get_allocator(n)
+                na = by_name[n]
                 if na is None:
                     scores.append(consts.SCORE_MIN)
                     continue
@@ -252,13 +317,17 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.bind", pod=pod.key, node=node_name,
         ) as sp:
+            na = self._get_allocator(node_name)
+            if na is None:
+                raise RuntimeError(
+                    f"bind: node {node_name} has no TPU allocator"
+                )
+            # the placement search runs under the NODE's lock only — binds
+            # to different nodes no longer serialize on the registry lock
+            # (a pod mid-bind carries no assumed label yet, so no
+            # controller callback can race a forget in this window)
+            opt = na.allocate(request, self.rater)
             with self.lock:
-                na = self._get_allocator(node_name)
-                if na is None:
-                    raise RuntimeError(
-                        f"bind: node {node_name} has no TPU allocator"
-                    )
-                opt = na.allocate(request, self.rater)
                 self.pod_maps[pod.key] = (node_name, opt)
                 self.released_pods.pop(pod.key, None)
             sp.event("allocated")
@@ -342,8 +411,7 @@ class TPUUnitScheduler(ResourceScheduler):
             # mode policy (tpuwhole): this preemptor could never bind even
             # with every victim gone — don't kill workloads for nothing
             return None
-        with self.lock:
-            na = self._get_allocator(node_name)
+        na = self._get_allocator(node_name)
         if na is None:
             return None
         preemptor_prio = pod.spec.priority or 0
@@ -684,11 +752,17 @@ class TPUUnitScheduler(ResourceScheduler):
             return self.released_pods.get(pod.key) == pod.metadata.uid
 
     def status(self) -> dict:
-        """Per-node chip availability dump (reference: scheduler.go:283-290)."""
+        """Per-node chip availability dump (reference: scheduler.go:283-290).
+
+        Registry snapshot under the global lock, per-node dumps under each
+        node's own lock — a debug scrape no longer freezes every verb for
+        the duration of the full-state walk."""
         with self.lock:
-            return {
-                "scheduler": self.name,
-                "rater": self.rater.name,
-                "nodes": {n: na.status() for n, na in self.allocators.items()},
-                "pods": sorted(self.pod_maps),
-            }
+            allocators = dict(self.allocators)
+            pods = sorted(self.pod_maps)
+        return {
+            "scheduler": self.name,
+            "rater": self.rater.name,
+            "nodes": {n: na.status() for n, na in allocators.items()},
+            "pods": pods,
+        }

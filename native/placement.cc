@@ -11,12 +11,27 @@
 //
 // CPython C API only (no pybind11 in this environment).
 //
-// Exposed function:
+// Exposed functions:
 //   enumerate_free_boxes(dims: tuple[int], wrap: tuple[bool], free: bytes,
 //                        count: int, max_out: int) -> list[tuple[int, ...]]
 // `free` is one byte per row-major chip index (0/1).  Returns up to max_out
 // boxes as tuples of row-major indices, most-compact shapes first — the
 // exact contract of Topology.box_shapes + placements.
+//
+//   plan_gang(dims: tuple[int], wrap: tuple[bool],
+//             free_lists: sequence[sequence[int]], count: int,
+//             members: int, max_candidates: int)
+//       -> list[(node_idx, tuple[int, ...], bool)]
+// The whole-gang greedy planner: place up to `members` identical
+// `count`-whole-chip members onto per-node free sets (row-major mesh
+// indices), forward-only node cursor, per member choosing the candidate box
+// with the highest locality bonus (fill * (1 - 0.3 * elong) of the bounding
+// box; first-wins ties) from the same compact-first canonical enumeration
+// as enumerate_free_boxes — anchored at free cells, so a 4-chip host inside
+// a 1024-chip mesh costs O(free), not O(mesh).  One entry per placed member
+// (mesh indices sorted ascending, contiguous flag); may return fewer than
+// `members` when capacity runs out.  Bit-identical to the Python fallback
+// core/allocator.plan_gang_fallback (tests/test_native.py asserts it).
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
@@ -32,6 +47,11 @@ struct Shape {
   long surface;  // compactness key (proportional surface area)
   long maxdim;
 };
+
+// Python's Topology.box_shapes keeps only the 64 most-compact shapes
+// (max_shapes=64); both kernels must truncate identically or a mesh whose
+// member count factors into >64 shapes diverges from the Python fallback.
+constexpr size_t kMaxShapes = 64;
 
 void shapes_rec(const std::vector<long>& mesh, long remaining, size_t axis,
                 std::vector<long>& prefix, std::vector<Shape>* out) {
@@ -169,6 +189,7 @@ PyObject* enumerate_free_boxes(PyObject*, PyObject* args) {
     if (a.maxdim != b.maxdim) return a.maxdim < b.maxdim;
     return a.dims < b.dims;
   });
+  if (shapes.size() > kMaxShapes) shapes.resize(kMaxShapes);
 
   std::vector<std::vector<long>> found;
   const uint8_t* mask = static_cast<const uint8_t*>(free_buf.buf);
@@ -204,9 +225,272 @@ PyObject* enumerate_free_boxes(PyObject*, PyObject* args) {
   return result;
 }
 
+// Locality bonus of one whole-chip box — the EXACT float expression of
+// rater._locality_bonus / allocator.whole_box_bonus, including the
+// single-chip literal shortcut (1.0 - 0.3 in IEEE doubles is one ulp away
+// from the 0.7 literal, so the shortcut is load-bearing for bit-identity).
+double box_bonus(const std::vector<long>& mins, const std::vector<long>& maxs,
+                 long count) {
+  if (count == 1) return 0.7;
+  long vol = 1;
+  long maxbb = 0;
+  for (size_t a = 0; a < mins.size(); ++a) {
+    long d = maxs[a] - mins[a] + 1;
+    vol *= d;
+    maxbb = std::max(maxbb, d);
+  }
+  double fill = vol ? (double)count / (double)vol : 0.0;
+  double elong = (double)maxbb / (double)std::max(1L, count);
+  double b = fill * (1.0 - 0.3 * elong);
+  return std::max(0.0, std::min(1.0, b));
+}
+
+PyObject* plan_gang(PyObject*, PyObject* args) {
+  PyObject* dims_obj;
+  PyObject* wrap_obj;
+  PyObject* free_obj;
+  long count, members, max_candidates;
+  if (!PyArg_ParseTuple(args, "O!O!Olll", &PyTuple_Type, &dims_obj,
+                        &PyTuple_Type, &wrap_obj, &free_obj, &count, &members,
+                        &max_candidates)) {
+    return nullptr;
+  }
+  size_t nd = PyTuple_GET_SIZE(dims_obj);
+  std::vector<long> mesh(nd);
+  std::vector<bool> wrap(nd, false);
+  long total = 1;
+  for (size_t i = 0; i < nd; ++i) {
+    mesh[i] = PyLong_AsLong(PyTuple_GET_ITEM(dims_obj, i));
+    if (mesh[i] <= 0) {
+      PyErr_SetString(PyExc_ValueError, "non-positive mesh dim");
+      return nullptr;
+    }
+    total *= mesh[i];
+  }
+  if ((size_t)PyTuple_GET_SIZE(wrap_obj) == nd) {
+    for (size_t i = 0; i < nd; ++i) {
+      wrap[i] = PyObject_IsTrue(PyTuple_GET_ITEM(wrap_obj, i));
+    }
+  }
+  if (count <= 0 || members <= 0 || max_candidates <= 0) {
+    return PyList_New(0);
+  }
+
+  // per-node free cells (sorted ascending, like the Python fallback)
+  std::vector<std::vector<long>> free_cells;
+  {
+    PyObject* seq = PySequence_Fast(free_obj, "free_lists must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    free_cells.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* inner =
+          PySequence_Fast(PySequence_Fast_GET_ITEM(seq, i),
+                          "free_lists items must be sequences");
+      if (!inner) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      Py_ssize_t m = PySequence_Fast_GET_SIZE(inner);
+      free_cells[i].reserve(m);
+      for (Py_ssize_t j = 0; j < m; ++j) {
+        long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(inner, j));
+        if ((v == -1 && PyErr_Occurred()) || v < 0 || v >= total) {
+          Py_DECREF(inner);
+          Py_DECREF(seq);
+          if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "free index out of mesh range");
+          return nullptr;
+        }
+        free_cells[i].push_back(v);
+      }
+      std::sort(free_cells[i].begin(), free_cells[i].end());
+      Py_DECREF(inner);
+    }
+    Py_DECREF(seq);
+  }
+
+  std::vector<long> strides(nd, 1);
+  for (size_t i = nd; i-- > 1;) strides[i - 1] = strides[i] * mesh[i];
+
+  std::vector<Shape> shapes;
+  std::vector<long> prefix;
+  shapes_rec(mesh, count, 0, prefix, &shapes);
+  std::sort(shapes.begin(), shapes.end(), [](const Shape& a, const Shape& b) {
+    if (a.surface != b.surface) return a.surface < b.surface;
+    if (a.maxdim != b.maxdim) return a.maxdim < b.maxdim;
+    return a.dims < b.dims;
+  });
+  if (shapes.size() > kMaxShapes) shapes.resize(kMaxShapes);
+
+  std::vector<uint8_t> mask(total, 0);
+  auto decode = [&](long idx, std::vector<long>* coord) {
+    for (size_t a = nd; a-- > 0;) {
+      (*coord)[a] = idx % mesh[a];
+      idx /= mesh[a];
+    }
+  };
+
+  struct Placed {
+    long node;
+    std::vector<long> box;  // sorted mesh indices
+    bool contiguous;
+  };
+  std::vector<Placed> placed;
+  placed.reserve(members);
+
+  size_t cursor = 0;
+  bool mask_set = false;
+  std::vector<long> origin(nd), off(nd), box, best_box, coord(nd);
+  std::vector<long> mins(nd), maxs(nd);
+  while ((long)placed.size() < members && cursor < free_cells.size()) {
+    std::vector<long>& cells = free_cells[cursor];
+    if ((long)cells.size() < count) {
+      if (mask_set) {
+        for (long c : cells) mask[c] = 0;
+        mask_set = false;
+      }
+      ++cursor;
+      continue;
+    }
+    if (!mask_set) {
+      for (long c : cells) mask[c] = 1;
+      mask_set = true;
+    }
+    // candidate stream: compact-first shapes × free-anchored origins,
+    // deduped — choose argmax bonus, first-wins on ties
+    long emitted = 0;
+    double best_bonus = -1.0;
+    bool have_best = false, best_contig = false;
+    std::vector<std::vector<long>> seen;
+    for (const Shape& s : shapes) {
+      if (emitted >= max_candidates) break;
+      // per-axis origin limit: wrapped axes with s < d take any origin,
+      // otherwise origin + s must fit inside the mesh (placements_at)
+      std::vector<long> lims(nd);
+      bool shape_fits = true;
+      for (size_t a = 0; a < nd; ++a) {
+        if (s.dims[a] > mesh[a]) {
+          shape_fits = false;
+          break;
+        }
+        lims[a] = (wrap[a] && s.dims[a] < mesh[a]) ? mesh[a]
+                                                   : mesh[a] - s.dims[a] + 1;
+      }
+      if (!shape_fits) continue;
+      for (long origin_idx : cells) {
+        if (emitted >= max_candidates) break;
+        decode(origin_idx, &origin);
+        bool ok = true;
+        for (size_t a = 0; a < nd; ++a) {
+          if (origin[a] >= lims[a]) {
+            ok = false;
+            break;
+          }
+        }
+        if (!ok) continue;
+        // walk the box (shape odometer), checking freeness and collecting
+        // the bounding box of the POST-WRAP coordinates (bounding_box in
+        // topology.py ignores wrap the same way)
+        box.clear();
+        std::fill(off.begin(), off.end(), 0);
+        for (size_t a = 0; a < nd; ++a) {
+          mins[a] = mesh[a];
+          maxs[a] = -1;
+        }
+        while (true) {
+          long idx = 0;
+          for (size_t a = 0; a < nd; ++a) {
+            long v = origin[a] + off[a];
+            if (wrap[a]) v %= mesh[a];
+            idx += v * strides[a];
+            coord[a] = v;
+          }
+          if (!mask[idx]) {
+            ok = false;
+            break;
+          }
+          box.push_back(idx);
+          for (size_t a = 0; a < nd; ++a) {
+            mins[a] = std::min(mins[a], coord[a]);
+            maxs[a] = std::max(maxs[a], coord[a]);
+          }
+          size_t a = nd;
+          bool done = true;
+          while (a > 0) {
+            --a;
+            if (++off[a] < s.dims[a]) {
+              done = false;
+              break;
+            }
+            off[a] = 0;
+          }
+          if (done) break;
+        }
+        if (!ok) continue;
+        std::sort(box.begin(), box.end());
+        bool dup = false;
+        for (const auto& f : seen) {
+          if (f == box) {
+            dup = true;
+            break;
+          }
+        }
+        if (dup) continue;
+        seen.push_back(box);
+        ++emitted;
+        double b = box_bonus(mins, maxs, count);
+        if (b > best_bonus) {
+          best_bonus = b;
+          best_box = box;
+          best_contig = true;
+          have_best = true;
+        }
+      }
+    }
+    if (!have_best) {
+      // no contiguous box fits: non-contiguous fallback, first `count`
+      // free cells in canonical order (locality bonus 0 — the rater's
+      // penalty — so it only ever wins by being the only candidate)
+      best_box.assign(cells.begin(), cells.begin() + count);
+      best_contig = false;
+    }
+    for (long c : best_box) mask[c] = 0;
+    std::vector<long> left;
+    left.reserve(cells.size() - best_box.size());
+    for (long c : cells) {
+      if (!std::binary_search(best_box.begin(), best_box.end(), c))
+        left.push_back(c);
+    }
+    cells.swap(left);
+    placed.push_back(Placed{(long)cursor, best_box, best_contig});
+    // cursor stays: the node may fit further members
+  }
+
+  PyObject* result = PyList_New(placed.size());
+  if (!result) return nullptr;
+  for (size_t i = 0; i < placed.size(); ++i) {
+    const Placed& p = placed[i];
+    PyObject* tup = PyTuple_New(p.box.size());
+    for (size_t j = 0; j < p.box.size(); ++j) {
+      PyTuple_SET_ITEM(tup, j, PyLong_FromLong(p.box[j]));
+    }
+    PyObject* entry = Py_BuildValue("(lNO)", p.node, tup,
+                                    p.contiguous ? Py_True : Py_False);
+    if (!entry) {
+      Py_DECREF(result);
+      return nullptr;
+    }
+    PyList_SET_ITEM(result, i, entry);
+  }
+  return result;
+}
+
 PyMethodDef methods[] = {
     {"enumerate_free_boxes", enumerate_free_boxes, METH_VARARGS,
      "enumerate contiguous free sub-boxes, compact-first"},
+    {"plan_gang", plan_gang, METH_VARARGS,
+     "greedy whole-gang placement over per-node free sets"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -201,3 +201,49 @@ def test_bind_records_events():
         sched.bind("n0", pod2)
     warn = [e for e in cluster.events if e["reason"] == "FailedScheduling"]
     assert warn and warn[0]["type"] == "Warning"
+
+
+def test_cold_allocator_replay_releases_pod_deleted_mid_build():
+    """A pod that is deleted while _create_allocator is listing assumed pods
+    (its forget event arrives before the ledger entry exists, so it no-ops)
+    must still be released by the post-replay recheck — before that recheck
+    the replayed capacity leaked until process restart."""
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    clientset = FakeClientset(cluster)
+    sched = TPUUnitScheduler(SchedulerConfig(clientset=clientset, rater=Binpack()))
+    pod = tpu_pod("victim", core=200)
+    cluster.create_pod(pod)
+    bound = sched.bind("n0", pod)  # writes the assumed annotations
+    assert sched.allocators["n0"].chips.avail_core() == 200
+
+    # fresh scheduler = restart with no state; its clientset lists the
+    # assumed pod but the pod vanishes before the replay recheck reads it
+    class RacingClientset(FakeClientset):
+        def __init__(self, cluster, ghost):
+            super().__init__(cluster)
+            self.ghost = ghost
+            self.armed = False  # armed only for the cold allocator build
+
+        def list_pods(self, label_selector=None, field_selector=None):
+            if not self.armed:
+                return []
+            pods = [self.ghost]
+            if field_selector is not None:
+                pods = [p for p in pods if field_selector(p)]
+            return pods
+
+        def get_pod(self, namespace, name):
+            raise ApiError("NotFound", f"{namespace}/{name} deleted", 404)
+
+    cluster2 = FakeCluster()
+    cluster2.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    racing = RacingClientset(cluster2, bound)
+    sched2 = TPUUnitScheduler(SchedulerConfig(clientset=racing, rater=Binpack()))
+    racing.armed = True
+    na = sched2._get_allocator("n0")
+    assert na is not None
+    # the replayed-then-vanished pod's chips are free and the ledger clean
+    assert na.chips.avail_core() == na.chips.total_core()
+    assert not sched2.known_pod(bound)
+    assert sched2.released_pod(bound)

@@ -829,3 +829,202 @@ def test_single_slice_gang_has_no_dcn_annotations():
         ann = cluster.get_pod("default", p.metadata.name).metadata.annotations
         assert consts.ANNOTATION_GANG_SLICES not in ann
         assert consts.ANNOTATION_SLICE not in ann
+
+
+# -- fast-path planner: kernel vs per-member trade DFS ------------------------
+
+
+def _fresh_v5p_stack(priority="ici-locality"):
+    cluster = FakeCluster()
+    nodes = make_v5p_slice(cluster)
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
+        clientset, cluster=cluster, priority=priority, gang_timeout=10.0
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    return cluster, sched, gang, nodes
+
+
+def _plan_via(gangc, sched, pod, nodes, force_slow=False):
+    """Run _plan_inner, optionally forcing the per-member trade path by
+    masking the rater's fast-path opt-in."""
+    from elastic_gpu_scheduler_tpu.core.request import request_from_pod
+
+    req = request_from_pod(pod)
+    rater = sched.rater
+    if force_slow:
+        class _Slow(type(rater)):
+            whole_chip_compact_first = False
+
+        sched.rater = _Slow()
+    try:
+        with gangc._lock:
+            return gangc._plan_inner(sched, req, list(nodes))
+    finally:
+        sched.rater = rater
+
+
+@pytest.mark.parametrize("members,core", [(8, 100), (4, 400), (32, 100)])
+def test_fast_path_plan_matches_trade_path(members, core):
+    """The plan_gang kernel must place a homogeneous whole-chip gang exactly
+    where the per-member trade DFS would: same slot list, same chip sets."""
+    cluster, sched, gangc, nodes = _fresh_v5p_stack()
+    pod = gang_pod("probe", "g", members, core=core)
+    cluster.create_pod(pod)
+    fast = _plan_via(gangc, sched, pod, nodes)
+    cluster2, sched2, gangc2, nodes2 = _fresh_v5p_stack()
+    pod2 = gang_pod("probe", "g", members, core=core)
+    cluster2.create_pod(pod2)
+    slow = _plan_via(gangc2, sched2, pod2, nodes2, force_slow=True)
+    assert fast is not None and slow is not None
+    assert fast.slots == slow.slots
+    for fo, so in zip(fast.options, slow.options):
+        fast_coords = {a.container: frozenset(a.coords) for a in fo.allocs}
+        slow_coords = {a.container: frozenset(a.coords) for a in so.allocs}
+        assert fast_coords == slow_coords
+        assert fo.score == so.score
+
+
+def test_fast_path_python_fallback_matches_native(monkeypatch):
+    """With the native extension masked, the Python plan_gang fallback must
+    produce the identical plan (the get_placement() is None contract)."""
+    cluster, sched, gangc, nodes = _fresh_v5p_stack()
+    pod = gang_pod("probe", "g", 16, core=100)
+    cluster.create_pod(pod)
+    native_plan = _plan_via(gangc, sched, pod, nodes)
+
+    from elastic_gpu_scheduler_tpu.scheduler import gang as gang_mod
+    from elastic_gpu_scheduler_tpu.core import native as native_mod
+
+    cluster2, sched2, gangc2, nodes2 = _fresh_v5p_stack()
+    pod2 = gang_pod("probe", "g", 16, core=100)
+    cluster2.create_pod(pod2)
+    monkeypatch.setattr(native_mod, "_module", None)
+    monkeypatch.setattr(native_mod, "_loaded", True)
+    py_plan = _plan_via(gangc2, sched2, pod2, nodes2)
+    assert native_plan is not None and py_plan is not None
+    assert native_plan.slots == py_plan.slots
+    for no, po in zip(native_plan.options, py_plan.options):
+        assert [a.coords for a in no.allocs] == [a.coords for a in po.allocs]
+
+
+def test_memoized_trade_reuses_searches_for_fractional_gang():
+    """Fractional gangs take the trade path; congruent host states must hit
+    the memo instead of re-running the DFS per member."""
+    from elastic_gpu_scheduler_tpu.metrics import PLAN_CACHE
+
+    cluster, sched, gangc, nodes = _fresh_v5p_stack()
+    pod = gang_pod("probe", "g", 64, core=50, hbm=2)
+    cluster.create_pod(pod)
+    PLAN_CACHE.reset()
+    plan = _plan_via(gangc, sched, pod, nodes)
+    assert plan is not None and len(plan.slots) == 64
+    with PLAN_CACHE._lock:
+        hits = PLAN_CACHE._values.get(("hit",), 0)
+        misses = PLAN_CACHE._values.get(("miss",), 0)
+    # 32 identical hosts, 8 members per host → ~8 distinct fill states;
+    # everything else replays from the memo
+    assert hits > 0 and misses < 16, (hits, misses)
+    # and the memoized plan still reserves real capacity: replaying every
+    # option onto fresh clones must fit (no double-counted chips)
+    clones = {}
+    for node, opt in zip(plan.slots, plan.options):
+        cs = clones.get(node)
+        if cs is None:
+            with sched.allocators[node].lock:
+                cs = clones[node] = sched.allocators[node].chips.clone()
+        cs.transact(opt)  # raises if the memo replayed onto taken capacity
+
+
+def test_random_rater_skips_fast_path_and_memo():
+    """Random scores absolute coords: neither kernel selection nor memo
+    translation is valid — the planner must fall back to exact trade."""
+    from elastic_gpu_scheduler_tpu.metrics import PLAN_CACHE
+
+    cluster, sched, gangc, nodes = _fresh_v5p_stack(priority="random")
+    pod = gang_pod("probe", "g", 8, core=100)
+    cluster.create_pod(pod)
+    PLAN_CACHE.reset()
+    plan = _plan_via(gangc, sched, pod, nodes)
+    assert plan is not None and len(plan.slots) == 8
+    with PLAN_CACHE._lock:
+        assert not PLAN_CACHE._values, PLAN_CACHE._values
+
+
+# -- concurrency: plans racing binds under the sharded locks -----------------
+
+
+def test_concurrent_plans_and_binds_sharded_locking():
+    """Two gangs plan while non-gang binds and forgets mutate allocators:
+    no deadlock (ranked locks raise on inversion), no lost capacity, and
+    both plans come out feasible against what remains."""
+    cluster, sched, gangc, nodes = _fresh_v5p_stack()
+    stop = threading.Event()
+    errors: list = []
+
+    def churn(idx):
+        """bind/forget a 1-chip pod in a loop on a dedicated node."""
+        node = nodes[idx]
+        i = 0
+        while not stop.is_set() and i < 40:
+            p = make_pod(
+                f"churn-{idx}-{i}",
+                containers=[
+                    Container(
+                        name="main",
+                        resources=ResourceRequirements(
+                            limits={consts.RESOURCE_TPU_CORE: 100}
+                        ),
+                    )
+                ],
+            )
+            cluster.create_pod(p)
+            try:
+                sched.bind(node, p)
+                sched.forget_pod(p)
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append(e)
+                return
+            finally:
+                try:
+                    cluster.delete_pod("default", p.metadata.name)
+                except Exception:
+                    pass
+            i += 1
+
+    def plan_gangs(gname, size):
+        from elastic_gpu_scheduler_tpu.core.request import request_from_pod
+
+        pod = gang_pod(f"{gname}-probe", gname, size, core=100)
+        cluster.create_pod(pod)
+        req = request_from_pod(pod)
+        try:
+            for _ in range(10):
+                with gangc._lock:
+                    plan = gangc._plan_inner(sched, req, list(nodes))
+                if plan is None:
+                    errors.append(AssertionError(f"{gname}: plan infeasible"))
+                    return
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    churners = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+    planners = [
+        threading.Thread(target=plan_gangs, args=(f"gang{j}", 16))
+        for j in range(2)
+    ]
+    for t in churners + planners:
+        t.start()
+    for t in planners:
+        t.join(timeout=60)
+    stop.set()
+    for t in churners:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in churners + planners), "deadlock"
+    assert not errors, errors[:3]
+    # all churn pods were forgotten: every chip is whole again
+    for n in nodes:
+        na = sched.allocators.get(n)
+        if na is not None:
+            with na.lock:
+                assert na.chips.avail_core() == na.chips.total_core(), n

@@ -97,3 +97,102 @@ def test_native_empty_and_bad_inputs():
     assert native.enumerate_free_boxes(topo.dims, topo.wrap, bytes([1]) * 16, 0, 8) == []
     with pytest.raises(ValueError):
         native.enumerate_free_boxes(topo.dims, topo.wrap, b"\x01", 4, 8)
+
+
+# -- plan_gang: the whole-gang kernel vs its Python fallback ------------------
+
+from elastic_gpu_scheduler_tpu.core.allocator import plan_gang_fallback
+
+
+def _random_nodes(topo, rng, free_p=0.8):
+    """Partition the mesh into 2-8 cell 'hosts', each keeping a random free
+    subset — the shape of per-node free lists the planner exports."""
+    cells = list(range(topo.num_chips))
+    rng.shuffle(cells)
+    nodes, i = [], 0
+    while i < len(cells):
+        k = rng.randint(2, 8)
+        nodes.append(
+            tuple(sorted(c for c in cells[i : i + k] if rng.random() < free_p))
+        )
+        i += k
+    return nodes
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "dims,wrap",
+    [
+        ((4, 4), (False, False)),
+        ((4, 4, 8), (True, True, True)),
+        ((8, 16, 8), (True, True, True)),
+        ((16,), (False,)),
+        ((4, 8), (True, False)),
+    ],
+)
+def test_plan_gang_native_matches_python(dims, wrap):
+    """Bit-identical: same members, same nodes, same boxes (order included),
+    same contiguity flags — the acceptance contract of the native kernel."""
+    topo = Topology(dims, wrap)
+    rng = random.Random(7)
+    for trial in range(6):
+        nodes = _random_nodes(topo, rng)
+        for count in (1, 2, 4, 8):
+            members = rng.randint(1, topo.num_chips // count + 2)
+            nat = native.plan_gang(topo.dims, topo.wrap, nodes, count, members, 64)
+            py = plan_gang_fallback(topo, nodes, count, members, 64)
+            assert nat == py, (dims, count, members, trial)
+
+
+@needs_native
+def test_plan_gang_compact_first_and_forward_cursor():
+    topo = Topology((4, 4, 8), (True, True, True))
+    # two hosts owning 2x2x1 boxes: mesh cells 0..3 map to coords
+    host0 = tuple(topo.index(c) for c in [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)])
+    host1 = tuple(topo.index(c) for c in [(2, 2, 0), (2, 3, 0), (3, 2, 0), (3, 3, 0)])
+    res = native.plan_gang(topo.dims, topo.wrap, [host0, host1], 4, 2, 64)
+    assert res == plan_gang_fallback(topo, [host0, host1], 4, 2, 64)
+    assert len(res) == 2
+    # each member gets its host's full 2x2x1 box, contiguous, in node order
+    assert res[0] == (0, tuple(sorted(host0)), True)
+    assert res[1] == (1, tuple(sorted(host1)), True)
+
+
+@needs_native
+def test_plan_gang_shape_cap_matches_box_shapes():
+    """A count whose factorizations exceed box_shapes' max_shapes=64 (240 on
+    a 16x20x28 mesh has 67) must stay bit-identical: both sides truncate to
+    the same 64 most-compact shapes.  The free set is EXACTLY one box of the
+    65th shape — an uncapped native kernel would find it contiguous while
+    the Python fallback (capped) reports the non-contiguous fallback."""
+    topo = Topology((16, 20, 28), (False, False, False))
+    all_shapes = topo.box_shapes(240, max_shapes=10_000)
+    assert len(all_shapes) > 64, len(all_shapes)
+    beyond = all_shapes[64]  # first shape the cap drops
+    free = tuple(
+        sorted(
+            topo.index((x, y, z))
+            for x in range(beyond[0])
+            for y in range(beyond[1])
+            for z in range(beyond[2])
+        )
+    )
+    assert len(free) == 240
+    nat = native.plan_gang(topo.dims, topo.wrap, [free], 240, 1, 64)
+    py = plan_gang_fallback(topo, [free], 240, 1, 64)
+    assert nat == py
+    # both must agree it is NON-contiguous: the only existing box is of a
+    # shape beyond the cap, invisible to the canonical stream
+    assert py == [(0, free, False)]
+
+
+@needs_native
+def test_plan_gang_noncontiguous_fallback_and_shortfall():
+    topo = Topology((4, 4), (False, False))
+    # a node whose 3 free cells form no contiguous 3-box shape of the mesh
+    scattered = (topo.index((0, 0)), topo.index((1, 2)), topo.index((3, 3)))
+    res = native.plan_gang(topo.dims, topo.wrap, [scattered], 3, 2, 64)
+    assert res == plan_gang_fallback(topo, [scattered], 3, 2, 64)
+    # one member placed non-contiguously; capacity is then exhausted, so
+    # the second member is simply not in the result (caller sees shortfall)
+    assert res == [(0, tuple(sorted(scattered)), False)]

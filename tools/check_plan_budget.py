@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""CI tripwire for the gang-plan hot path.
+
+Runs the same 1024-member / v5p-2048 plan microbench as bench.py
+(bench.plan_microbench — one source of truth) and exits non-zero when the
+min-of-trials wall exceeds the budget.  The r02→r03 27% plan regression and
+the r05 false alarm both happened because nothing FAILED when the number
+moved; the bench only warns.  This fails.
+
+Usage:
+    python tools/check_plan_budget.py [--trials N]
+
+Environment:
+    BENCH_PLAN_BUDGET_MS   budget in ms (default 135, same as bench.py)
+
+Wired into the Makefile as `make check-plan-budget`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import plan_microbench  # noqa: E402
+
+
+def main() -> int:
+    trials = 5
+    args = sys.argv[1:]
+    i = 0
+    while i < len(args):
+        if args[i].startswith("--trials="):
+            trials = int(args[i].split("=", 1)[1])
+        elif args[i] == "--trials" and i + 1 < len(args):
+            i += 1
+            trials = int(args[i])
+        else:
+            print(f"unknown argument {args[i]!r}", file=sys.stderr)
+            return 2
+        i += 1
+    try:
+        budget_ms = float(os.environ.get("BENCH_PLAN_BUDGET_MS", "135"))
+    except ValueError:
+        print("bad BENCH_PLAN_BUDGET_MS; using 135", file=sys.stderr)
+        budget_ms = 135.0
+    trials_ms = plan_microbench(trials=trials)
+    best = min(trials_ms)
+    result = {
+        "metric": "v5p2048_gang1024_plan_ms",
+        "value": round(best, 3),
+        "median_ms": round(sorted(trials_ms)[len(trials_ms) // 2], 3),
+        "trials": [round(t, 3) for t in trials_ms],
+        "budget_ms": budget_ms,
+        "over_budget": best > budget_ms,
+    }
+    print(json.dumps(result))
+    if best > budget_ms:
+        print(
+            f"FAIL: 1024-member plan min-of-{trials} {best:.1f}ms exceeds "
+            f"{budget_ms}ms budget (BENCH_PLAN_BUDGET_MS)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
