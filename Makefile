@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -36,6 +36,14 @@ bench:
 # native/placement.cc, or scheduler/gang.
 check-plan-budget:
 	python tools/check_plan_budget.py
+
+# Flight-recorder gate: randomized schedule/unschedule soak with the
+# journal on; hard-fails if replay diverges from the live snapshot, any
+# invariant trips (double-book / capacity conservation / gang
+# all-or-nothing), crash recovery misbehaves, or journaled bind p99
+# regresses past JOURNAL_OVERHEAD_BUDGET_PCT (default 5%).
+check-journal:
+	python tools/check_journal.py
 
 # Probe the TPU relay all round; capture + commit a green on-chip artifact
 # (BENCH_TPU_validation.json) the moment it comes up (VERDICT r3 Next #1).

@@ -350,6 +350,115 @@ def plan_microbench(trials: int = 5) -> list:
     return plan_trials_ms
 
 
+def journal_overhead_bench(chunks: int = 40, chunk_n: int = 40) -> dict:
+    """Per-bind latency with the scheduling flight recorder off vs on.
+
+    Direct in-process bind+forget cycles through ONE engine (no HTTP —
+    the journal's cost is one buffer append on the bind path, and socket
+    jitter would bury it), with the journal toggled every ``chunk_n``
+    binds and the per-bind samples POOLED per mode.  Why interleave at
+    ~100ms granularity instead of whole trials: the dev/CI container is
+    cgroup-CPU-throttled — multi-second freeze storms land multi-ms
+    stalls on whole runs, swinging any per-trial p99 ±100% — but a storm
+    spanning adjacent chunks hits BOTH modes equally, so the pooled
+    comparison cancels it.  Binds are paced ~2ms apart (kube-scheduler
+    runs one scheduling cycle at a time with API round trips between
+    binds; a zero-gap loop measures 2-core GIL contention against the
+    background writer at an arrival rate no real extender sees).
+
+    The comparison isolates the CODE's cost from the box's storage:
+    fsync OFF and the journal on memory-backed storage (/dev/shm when
+    available) — the container's overlayfs writes a 100-record batch in
+    ~50ms and fsyncs in ~100ms, three orders off a real disk, so at
+    bench rates any file IO there reads as storage saturation.  The
+    environment's actual device tax is reported separately
+    (journal_write_probe_ms / journal_fsync_probe_ms, measured on the
+    REAL filesystem) so an operator can price `--journal-fsync
+    always|interval` on their box."""
+    import shutil
+    import tempfile
+
+    from elastic_gpu_scheduler_tpu.journal import JOURNAL
+
+    shm = "/dev/shm"
+    base = shm if os.path.isdir(shm) and os.access(shm, os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="tpu-journal-bench-", dir=base)
+    lats_off: list[float] = []
+    lats_on: list[float] = []
+    try:
+        JOURNAL.configure(os.path.join(tmp, "j"), fsync="off")
+        cluster = FakeCluster()
+        v5e_pool(cluster, n=2)
+        clientset = FakeClientset(cluster)
+        registry, *_ = build_stack(clientset, cluster=None,
+                                   priority="binpack")
+        sched = registry[consts.RESOURCE_TPU_CORE]
+        JOURNAL.record("bench_warmup")
+        JOURNAL.flush()  # first-write cost stays out of the timed loop
+        serial = 0
+        for chunk in range(chunks):
+            on = bool(chunk % 2)
+            # toggling .enabled pauses/resumes recording without tearing
+            # the writer down (a GIL-atomic bool store; record() re-checks
+            # it under the journal lock)
+            JOURNAL.enabled = on
+            sink = lats_on if on else lats_off
+            for _ in range(chunk_n):
+                serial += 1
+                pod = tpu_pod(f"jb-{serial}", core=50, hbm=2)
+                cluster.create_pod(pod)
+                t0 = time.perf_counter()
+                sched.bind("node-0", pod)
+                sink.append(time.perf_counter() - t0)
+                sched.forget_pod(pod)
+                time.sleep(0.002)
+    finally:
+        JOURNAL.enabled = True
+        JOURNAL.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    # pooled p99 per mode, plus a storm-trimmed variant (p99 of the best
+    # 90% ≈ p89 — drops the throttling outliers that survive even
+    # interleaving; more sensitive to the journal's small systematic
+    # cost, so it reads a few % high by construction)
+    off_ms = p99(lats_off) * 1000
+    on_ms = p99(lats_on) * 1000
+    trim_off = sorted(lats_off)[: int(len(lats_off) * 0.9)]
+    trim_on = sorted(lats_on)[: int(len(lats_on) * 0.9)]
+    off_best = p99(trim_off) * 1000
+    on_best = p99(trim_on) * 1000
+
+    # the environment's device tax, measured on the REAL filesystem:
+    # a segment-sized buffered write+flush, and an fsync (median of 3) —
+    # what `--journal-fsync always|interval` would add on THIS box
+    fsync_ms, write_ms = [], []
+    fd, probe = tempfile.mkstemp(prefix="tpu-journal-fsync-")
+    os.close(fd)
+    try:
+        with open(probe, "ab") as f:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                f.write(b"x" * 32768)
+                f.flush()
+                write_ms.append((time.perf_counter() - t0) * 1000)
+                t0 = time.perf_counter()
+                os.fsync(f.fileno())
+                fsync_ms.append((time.perf_counter() - t0) * 1000)
+    finally:
+        os.unlink(probe)
+    return {
+        "bind_p99_journal_off_ms": round(off_ms, 3),
+        "bind_p99_journal_on_ms": round(on_ms, 3),
+        "journal_overhead_pct": round(
+            (on_ms / off_ms - 1.0) * 100, 2
+        ) if off_ms > 0 else 0.0,
+        "journal_overhead_trimmed_pct": round(
+            (on_best / off_best - 1.0) * 100, 2
+        ) if off_best > 0 else 0.0,
+        "journal_write_probe_ms": round(sorted(write_ms)[1], 2),
+        "journal_fsync_probe_ms": round(sorted(fsync_ms)[1], 2),
+    }
+
+
 def chip_peak_tflops_bf16() -> float:
     """Detected chip's bf16 peak (TFLOPS) for MFU accounting."""
     import jax
@@ -1341,6 +1450,24 @@ def main():
             f"# WARNING: 1024-member plan {plan_ms}ms exceeds "
             f"{budget_ms}ms budget", file=sys.stderr,
         )
+
+    # flight-recorder cost: bind p99 with the journal on vs off (<5% is
+    # the acceptance budget — the journal's hot-path cost is one buffer
+    # append; encoding, file IO and fsync live on the background writer).
+    # Guarded like the TPU sections: a crash here must not take down the
+    # headline metrics already in `results`.
+    try:
+        results.update(journal_overhead_bench())
+        if results["journal_overhead_pct"] > 5.0:
+            print(
+                f"# WARNING: journaled bind p99 "
+                f"{results['bind_p99_journal_on_ms']}ms is "
+                f"{results['journal_overhead_pct']}% over journal-off "
+                f"{results['bind_p99_journal_off_ms']}ms (budget 5%)",
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["journal_overhead_error"] = str(e)[:300]
 
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`

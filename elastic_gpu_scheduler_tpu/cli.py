@@ -123,6 +123,23 @@ def main(argv=None) -> int:
         "off; default from TPU_TRACE_SAMPLE, else 1.0).  /traces and "
         "/debug/schedule/<pod> serve the result",
     )
+    p.add_argument(
+        "--journal-dir", default=os.environ.get("TPU_JOURNAL_DIR", ""),
+        help="enable the scheduling flight recorder: append every "
+        "allocator state mutation to crash-safe journal segments in this "
+        "directory (default from TPU_JOURNAL_DIR; empty = off).  Replay "
+        "offline with `python -m elastic_gpu_scheduler_tpu.journal`",
+    )
+    p.add_argument(
+        "--journal-fsync", default="interval",
+        choices=["always", "interval", "off"],
+        help="journal durability: fsync per record batch (always), every "
+        "~200ms (interval, default), or leave it to the OS (off)",
+    )
+    p.add_argument(
+        "--journal-max-bytes", type=int, default=64 << 20,
+        help="journal segment size before rotation (bytes, default 64MiB)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -130,6 +147,17 @@ def main(argv=None) -> int:
         from .tracing import TRACER
 
         TRACER.configure(args.trace_sample)
+
+    if args.journal_dir:
+        # before build_stack, so the startup rebuild's node_add/replay
+        # records land in the journal too
+        from .journal import JOURNAL
+
+        JOURNAL.configure(
+            args.journal_dir,
+            fsync=args.journal_fsync,
+            max_segment_bytes=args.journal_max_bytes,
+        )
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -240,6 +268,12 @@ def main(argv=None) -> int:
     finally:
         if controller is not None:
             controller.stop()
+        if args.journal_dir:
+            # drain the writer's buffer before exit (atexit also covers
+            # this, but a prompt close keeps the tail off the 100ms poll)
+            from .journal import JOURNAL
+
+            JOURNAL.close()
     return 0
 
 

@@ -236,7 +236,10 @@ class Controller:
             return
         if sched.released_pod(pod):
             return
-        sched.forget_pod(pod)
+        # source tags the flight-recorder record: a controller release
+        # (pod completed/deleted) reads differently from a bind rollback
+        # when auditing a journal offline
+        sched.forget_pod(pod, source="controller_release")
 
     def _assign(self, pod: Pod) -> None:
         """Reference: assignPod bridge (controller.go:325-331)."""
@@ -245,4 +248,4 @@ class Controller:
             return
         if sched.known_pod(pod):
             return
-        sched.add_pod(pod)
+        sched.add_pod(pod, source="controller_assign")

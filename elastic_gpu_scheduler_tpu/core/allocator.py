@@ -286,6 +286,46 @@ class ChipSet:
         new._total_hbm_sum = self._total_hbm_sum
         return new
 
+    def inventory(self) -> dict:
+        """Journal wire form of the set's capacity: topology + per-chip
+        totals (``journal`` node_add/node_resync records; availability is
+        derived by replaying the mutation stream, never snapshotted)."""
+        return {
+            "dims": list(self.topo.dims),
+            "wrap": [bool(w) for w in self.topo.wrap],
+            "chips": [
+                [list(co), self._core_total[i], self._hbm_total[i]]
+                for i, co in enumerate(self._coords)
+            ],
+        }
+
+    def largest_free_box(self, max_candidates: int = 16) -> int:
+        """Chip count of the largest fully-free contiguous axis-aligned
+        sub-box.  Scans candidate volumes descending, first hit wins —
+        O(free²·shapes) worst case, intended for HOST-sized views (4-8
+        chips); slice-wide sets should not call this per mutation."""
+        free_n = self._free_bits.bit_count()
+        if free_n == 0:
+            return 0
+        sorted_free = [self._coords[i] for i in iter_bits(self._free_bits)]
+        free_set = set(sorted_free)
+        for k in range(free_n, 1, -1):
+            for _box in iter_contiguous_boxes(
+                self.topo, sorted_free, free_set, k, max_candidates
+            ):
+                return k
+        return 1  # any free chip is a 1-box
+
+    def fragmentation(self) -> tuple[float, int, int]:
+        """(fragmentation_index, largest_free_box, free_chips) for the
+        scrape-time mesh gauges: index = 1 - largest/free (0 = the free
+        set IS one contiguous sub-box or the set is fully busy)."""
+        free_n = self._free_bits.bit_count()
+        if free_n == 0:
+            return 0.0, 0, 0
+        largest = self.largest_free_box()
+        return round(1.0 - largest / free_n, 4), largest, free_n
+
     def status(self) -> dict:
         return {
             "topology": self.topo.spec(),

@@ -71,6 +71,19 @@ class Chip:
             self.coord, self.core_total, self.hbm_total, self.core_avail, self.hbm_avail
         )
 
+    def record(self) -> list:
+        """Journal wire form of the chip's CAPACITY (totals only —
+        availability is derived by replaying the mutation stream)."""
+        return [list(self.coord), self.core_total, self.hbm_total]
+
+    @classmethod
+    def from_record(cls, rec) -> "Chip":
+        coord, core_total, hbm_total = rec
+        return cls(
+            coord=tuple(coord), core_total=int(core_total),
+            hbm_total=int(hbm_total),
+        )
+
 
 class ChipRef:
     """Live view of one chip inside a ``ChipSet``'s packed arrays.

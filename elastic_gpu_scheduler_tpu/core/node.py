@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..journal import JOURNAL
 from ..metrics import TimedLock
 from ..utils import consts
 from .allocator import ChipSet, Option, Rater
@@ -181,19 +182,35 @@ class NodeAllocator:
                 self.chips = ChipSet(topo, chips)
                 self.allocated.clear()
                 self._allocated_at.clear()
+                if JOURNAL.enabled:
+                    # reset=True: the rebuild WIPED chip usage (unlike the
+                    # same-shape branch below, which preserves it) — replay
+                    # must not re-charge live pods onto the fresh set
+                    JOURNAL.record(
+                        "node_resync", node=self.node_name, reset=True,
+                        **self.chips.inventory(),
+                    )
                 return
             # Same chip layout: apply per-chip total changes (e.g. HBM resize)
             # while preserving live usage.
+            changed = False
             for fresh in chips:
                 live = self.chips.chips[fresh.coord]
                 if fresh.hbm_total != live.hbm_total:
                     used = live.hbm_total - live.hbm_avail
                     live.hbm_total = fresh.hbm_total
                     live.hbm_avail = max(0, fresh.hbm_total - used)
+                    changed = True
                 if fresh.core_total != live.core_total:
                     used = live.core_total - live.core_avail
                     live.core_total = fresh.core_total
                     live.core_avail = max(0, fresh.core_total - used)
+                    changed = True
+            if changed and JOURNAL.enabled:
+                JOURNAL.record(
+                    "node_resync", node=self.node_name,
+                    **self.chips.inventory(),
+                )
 
     def status(self) -> dict:
         with self.lock:

@@ -1,0 +1,596 @@
+"""Scheduling flight recorder: a durable, append-only decision journal.
+
+The tracer (tracing/__init__.py) answers "why did pod X land on node Y"
+only while the span ring still holds the trace; once the pod is gone
+there is no durable record of how the cluster reached its current
+allocation state, no offline way to prove the allocator never
+double-booked a chip, and no way to evaluate a different rater against
+real recorded workload.  Gavel and Tesserae (PAPERS.md) both build
+policy comparison on exactly this substrate: replayable scheduling
+traces.  This module is the persistence layer of the observability
+stack:
+
+- **Records.**  Every allocator state mutation lands here, emitted from
+  the commit boundaries above ``ChipSet._set_slot`` (the scheduler's
+  bind commit / ledger write, ``forget_pod``, ``add_pod``/startup
+  replay, allocator creation and capacity resync, gang admit and
+  rollback).  Each record carries the pod's ``trace_id`` so journal
+  entries cross-link to ``/traces``, plus the node's fragmentation
+  snapshot at the checkpoint (the gauges' source of truth).
+
+- **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
+
+      <crc32 hex8> <payload length> <compact json>\\n
+
+  A reader validates both the length and the CRC before trusting a
+  line, so a torn tail (crash mid-write) is detected, not parsed into
+  garbage.  Records carry a dense ``seq``; recovery yields everything
+  up to the first torn record.
+
+- **Segments.**  Size-based rotation (``journal-NNNNNN.log`` in the
+  journal directory); the oldest segments are pruned past
+  ``max_segments`` so a long-lived scheduler's disk use is bounded.
+
+- **Writer.**  ``record()`` is one buffer append under a small lock —
+  never file IO on the scheduling hot path.  A background thread
+  drains the buffer, writes, rotates, and fsyncs per the configured
+  policy (``always`` | ``interval`` | ``off``).
+
+- **Replay.**  ``journal.replay`` (separate module — this one is
+  stdlib-only so core/ may import it without cycles) rebuilds
+  ChipSet/allocator state from a journal, verifies invariants (no
+  double-booked chip, per-node capacity conservation, gang
+  all-or-nothing), diffs against a live ``/scheduler/status`` snapshot,
+  and supports what-if replay under a different rater.  CLI:
+  ``python -m elastic_gpu_scheduler_tpu.journal replay``.
+
+Disabled by default (``JOURNAL.enabled`` is False and every emission
+site checks it first — one attribute load); enable with
+``--journal-dir`` / ``TPU_JOURNAL_DIR`` or ``JOURNAL.configure()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import zlib
+from collections import OrderedDict, deque
+from typing import Iterator, Optional
+
+__all__ = [
+    "Journal",
+    "JOURNAL",
+    "option_record",
+    "read_journal",
+    "read_segment",
+    "segment_paths",
+]
+
+_SEGMENT_RE = re.compile(r"^journal-(\d{6})\.log$")
+
+FSYNC_POLICIES = ("always", "interval", "off")
+
+
+def option_record(opt) -> dict:
+    """Encode an Option as plain JSON data (pure attribute access — no
+    core imports, so this module stays import-cycle-free).  Decoded by
+    ``journal.replay.option_from_record``."""
+    return {
+        "hash": opt.request_hash,
+        "score": round(opt.score, 4),
+        "allocs": [
+            [
+                a.container,
+                [list(c) for c in a.coords],
+                bool(a.whole),
+                a.core,
+                a.hbm,
+                bool(a.contiguous),
+            ]
+            for a in opt.allocs
+        ],
+    }
+
+
+def _encode(rec: dict) -> bytes:
+    # compact; default=str so an unexpected field type can never crash
+    # the writer.  No sort_keys: it costs ~20% of the encode on the bind
+    # hot path and the CRC covers whatever byte order was written.
+    payload = json.dumps(rec, separators=(",", ":"), default=str).encode()
+    return b"%08x %d " % (zlib.crc32(payload), len(payload)) + payload + b"\n"
+
+
+def segment_paths(dirpath: str) -> list[str]:
+    """Journal segment files in rotation order."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    segs = sorted(n for n in names if _SEGMENT_RE.match(n))
+    return [os.path.join(dirpath, n) for n in segs]
+
+
+def read_segment(path: str) -> tuple[list[dict], bool, int]:
+    """Parse one segment.  Returns (records, torn, good_bytes): ``torn``
+    is True when the segment ends in a record that fails the length/CRC
+    check (crash mid-write) — everything before is trusted, nothing
+    after; ``good_bytes`` is the offset of the first bad byte (what
+    ``configure`` truncates to when repairing a crashed tail).
+
+    JSON payloads never contain a raw newline (json.dumps escapes), so
+    line-splitting cannot cut a valid record."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], True, 0
+    out: list[dict] = []
+    pos = 0
+    for line in data.split(b"\n"):
+        if not line:
+            pos += 1  # a bare newline (or the empty post-final split)
+            continue
+        try:
+            crc_s, len_s, payload = line.split(b" ", 2)
+            crc = int(crc_s, 16)
+            ln = int(len_s)
+        except ValueError:
+            return out, True, pos
+        if len(payload) != ln or zlib.crc32(payload) != crc:
+            return out, True, pos
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            return out, True, pos
+        out.append(rec)
+        pos += len(line) + 1
+    return out, False, len(data)
+
+
+def read_journal(dirpath: str) -> list[dict]:
+    """All recoverable records, in sequence order, stopping at the first
+    torn record (records after a tear have no continuity guarantee —
+    replay must not leap a hole in the mutation stream)."""
+    out: list[dict] = []
+    for path in segment_paths(dirpath):
+        recs, torn, _good = read_segment(path)
+        out.extend(recs)
+        if torn:
+            break
+    return out
+
+
+class Journal:
+    """Append-only journal with a buffered background writer.
+
+    Concurrency model: ``record()`` assigns the sequence number and
+    appends to an in-memory buffer under one condition lock (no IO);
+    the writer thread swaps the buffer out, encodes, writes, rotates
+    and fsyncs.  ``flush()`` blocks until every record appended before
+    the call has reached the OS (file flushed) — the test/CLI barrier
+    before reading the files back."""
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self.enabled = False
+        # callable returning {"nodes": {name: inventory}, "pods": [...]}
+        # (or None) — written as a "checkpoint" record at the head of every
+        # rotated segment, so a journal whose oldest segments were PRUNED
+        # still replays: any segment suffix starts with a full state
+        # snapshot (snapshot+log).  The engine registers itself here.
+        self.checkpoint_provider = None
+        self._atexit_registered = False
+        self._pending_checkpoint = False
+        self.dir: Optional[str] = None
+        self.fsync_policy = "interval"
+        self.fsync_interval_s = 0.2
+        self.max_segment_bytes = 64 << 20
+        self.max_segments = 64
+        self.max_pending = 100_000  # records buffered before drops
+        self._seq = 0
+        self._buf: list[dict] = []  # records pending the writer
+        self._appended = 0
+        self._written = 0
+        self._dropped = 0
+        self._io_errors = 0
+        self._io_lost = 0  # records lost to write failures (writer-only)
+        self._rotations = 0
+        self._pruned = 0
+        self._tail: deque = deque(maxlen=256)
+        # pod key → recent journal seqs (bounded both ways) for the
+        # /debug/schedule cross-link
+        self._pod_seqs: "OrderedDict[str, list[int]]" = OrderedDict()
+        self._pod_seqs_cap = 2048
+        self._pod_seqs_each = 32
+        self._fh = None
+        self._segment_index = 0
+        self._segment_bytes = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(
+        self,
+        dirpath: str,
+        fsync: str = "interval",
+        fsync_interval_s: float = 0.2,
+        max_segment_bytes: int = 64 << 20,
+        max_segments: int = 64,
+    ) -> None:
+        """Open (or re-open) the journal at ``dirpath`` and start the
+        writer.  A torn tail from a crash is REPAIRED (the last segment
+        is truncated back to its last valid record — the torn record was
+        never acknowledged, so dropping it restores a clean stream);
+        sequence numbering resumes after the last recoverable record and
+        writing starts a fresh segment."""
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync policy {fsync!r} not in {FSYNC_POLICIES}"
+            )
+        self.close()
+        os.makedirs(dirpath, exist_ok=True)
+        existing = segment_paths(dirpath)
+        next_seq = 0
+        if existing:
+            last_path = existing[-1]
+            last_recs, torn, good = read_segment(last_path)
+            if torn:
+                with open(last_path, "r+b") as f:
+                    f.truncate(good)
+            last = os.path.basename(last_path)
+            self._segment_index = int(_SEGMENT_RE.match(last).group(1)) + 1
+            # resume numbering from the last valid SEQ-BEARING record
+            # (checkpoints carry none and can be a segment's only line),
+            # scanning segments BACKWARDS — never parse the whole journal
+            # here (64 segments × 64MiB would stall scheduler startup)
+            seqd = [r for r in last_recs if "seq" in r]
+            if seqd:
+                next_seq = seqd[-1]["seq"] + 1
+            else:
+                for path in reversed(existing[:-1]):
+                    recs, _torn, _g = read_segment(path)
+                    seqd = [r for r in recs if "seq" in r]
+                    if seqd:
+                        next_seq = seqd[-1]["seq"] + 1
+                        break
+        else:
+            self._segment_index = 1
+        with self._cond:
+            # a fresh journal has NO checkpoint provider until an engine
+            # registers: carrying one over from an earlier engine in the
+            # same process would write segment-head snapshots of a stale,
+            # unrelated registry into this journal
+            self.checkpoint_provider = None
+            self.dir = dirpath
+            self.fsync_policy = fsync
+            self.fsync_interval_s = max(0.01, float(fsync_interval_s))
+            self.max_segment_bytes = max(1024, int(max_segment_bytes))
+            self.max_segments = max(2, int(max_segments))
+            self._seq = next_seq
+            # a RESUMED journal's fresh segment needs a head checkpoint
+            # too (rotation-written ones only cover rotations): once
+            # pruning crosses a restart boundary, replay must still find
+            # a boot snapshot.  Written by the writer with the first
+            # batch, once a provider is registered.
+            self._pending_checkpoint = next_seq > 0
+            self._buf = []
+            self._appended = self._written = 0
+            self._dropped = self._io_errors = self._io_lost = 0
+            self._rotations = self._pruned = 0
+            self._tail.clear()
+            self._pod_seqs.clear()
+            self._stop = False
+            self.enabled = True
+        self._open_segment()
+        if not self._atexit_registered:
+            # a clean process exit must not strand the tail of the buffer
+            # (the writer is a daemon polling at 100ms); close() is
+            # idempotent so registering once covers every reconfigure
+            import atexit
+
+            atexit.register(self.close)
+            self._atexit_registered = True
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="journal-writer", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        """Flush, fsync (policy permitting), stop the writer, disable."""
+        t = self._thread
+        with self._cond:
+            if not self.enabled and t is None:
+                return
+            self.enabled = False
+            self._stop = True
+            self._cond.notify_all()
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, type_: str, **fields) -> Optional[int]:
+        """Append one record; returns its sequence number, or None when
+        disabled or the pending buffer is full (drop-new: the seq space
+        stays dense, so replay can treat a seq gap as corruption).
+        ``None``-valued fields are elided."""
+        if not self.enabled:
+            return None
+        rec = {"type": type_}
+        rec.update({k: v for k, v in fields.items() if v is not None})
+        with self._cond:
+            if not self.enabled:
+                return None
+            if len(self._buf) >= self.max_pending:
+                self._dropped += 1
+                return None
+            seq = self._seq
+            self._seq += 1
+            rec["seq"] = seq
+            rec["t"] = round(time.time(), 6)
+            # the raw dict: encoding happens on the WRITER thread.  The
+            # bind path pays one dict append — moving json+CRC here was
+            # measured at ~+10% bind latency on a 2-core box
+            self._buf.append(rec)
+            self._appended += 1
+            self._tail.append(rec)
+            pk = fields.get("pod")
+            if pk:
+                seqs = self._pod_seqs.get(pk)
+                if seqs is None:
+                    seqs = self._pod_seqs[pk] = []
+                    if len(self._pod_seqs) > self._pod_seqs_cap:
+                        self._pod_seqs.popitem(last=False)
+                else:
+                    self._pod_seqs.move_to_end(pk)
+                seqs.append(seq)
+                if len(seqs) > self._pod_seqs_each:
+                    del seqs[: -self._pod_seqs_each]
+            # NO notify on the hot path (except under the always-fsync
+            # durability contract): waking the writer per record costs a
+            # GIL round-trip per bind — measured 2x on bind p99.  The
+            # writer polls at 100ms and drains the whole buffer in one
+            # batch; flush()/close() kick it explicitly.
+            if self.fsync_policy == "always":
+                self._cond.notify()
+        return seq
+
+    def pod_seqs(self, pod_key: str) -> list[int]:
+        with self._cond:
+            return list(self._pod_seqs.get(pod_key, ()))
+
+    def last_seq(self) -> int:
+        """Highest assigned sequence number (-1 before the first record).
+        A checkpoint provider reads this under ITS OWN mutation lock to
+        produce an exact as_of_seq for its snapshot."""
+        with self._cond:
+            return self._seq - 1
+
+    # -- writer --------------------------------------------------------------
+
+    def _segment_name(self) -> str:
+        return f"journal-{self._segment_index:06d}.log"
+
+    def _open_segment(self) -> None:
+        path = os.path.join(self.dir, self._segment_name())
+        self._fh = open(path, "ab")
+        self._segment_bytes = self._fh.tell()
+
+    def _rotate(self) -> None:
+        try:
+            self._fsync()
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        self._segment_index += 1
+        self._rotations += 1
+        self._open_segment()  # may raise: the writer's batch handler recovers
+        self._write_checkpoint()
+        segs = segment_paths(self.dir)
+        while len(segs) > self.max_segments:
+            victim = segs.pop(0)
+            try:
+                os.unlink(victim)
+                self._pruned += 1
+            except OSError:
+                break
+
+    def _write_checkpoint(self) -> None:
+        """Write a state snapshot at the head of a fresh segment (writer
+        thread).  Checkpoints carry NO seq: they sit outside the mutation
+        stream (replay skips them mid-stream and boots from one when the
+        stream's prefix was pruned).  The provider runs on the writer
+        thread holding no journal locks, so it may take engine/node locks
+        freely; a snapshot slightly AHEAD of still-buffered records is
+        fine — replay treats later binds it already contains as idempotent
+        re-assertions."""
+        provider = self.checkpoint_provider
+        if provider is None:
+            return
+        # as_of_seq: every record with seq <= it is REFLECTED in the
+        # snapshot; replay booting from the checkpoint skips them instead
+        # of double-applying.  Read BEFORE the provider runs: the safe
+        # error direction is snapshot-AHEAD-of-as_of (a later record the
+        # snapshot already contains replays as an idempotent
+        # re-assertion), never a mutation claimed-covered but absent.
+        # A provider that reads the seq under its own engine lock supplies
+        # an exact value instead.
+        with self._cond:
+            fallback_as_of = self._seq - 1
+        try:
+            state = provider()
+        except Exception:
+            return  # a failed snapshot must not kill the rotation
+        if not state:
+            return
+        as_of = state.pop("as_of_seq", None)
+        if as_of is None:
+            as_of = fallback_as_of
+        rec = {
+            "type": "checkpoint", "t": round(time.time(), 6),
+            "as_of_seq": as_of, **state,
+        }
+        line = _encode(rec)
+        self._fh.write(line)
+        self._segment_bytes += len(line)
+
+    def _fsync(self) -> None:
+        if self._fh is None:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError:
+            self._io_errors += 1
+
+    def _writer_loop(self) -> None:
+        dirty = False
+        last_sync = time.monotonic()
+        while True:
+            with self._cond:
+                while not self._buf and not self._stop:
+                    self._cond.wait(timeout=0.1)
+                    if (
+                        dirty
+                        and self.fsync_policy == "interval"
+                        and time.monotonic() - last_sync
+                        >= self.fsync_interval_s
+                    ):
+                        break
+                batch = self._buf
+                self._buf = []
+                stopping = self._stop
+            if batch:
+                written_lines = 0
+                try:
+                    if self._fh is None:  # recover from an earlier failure
+                        self._open_segment()
+                    if (
+                        self._pending_checkpoint
+                        and self.checkpoint_provider is not None
+                    ):
+                        # resumed journal: boot snapshot at (near) the
+                        # head of the fresh segment, before any batch
+                        self._pending_checkpoint = False
+                        self._write_checkpoint()
+                    for rec in batch:
+                        line = _encode(rec)
+                        self._fh.write(line)
+                        written_lines += 1
+                        if written_lines % 16 == 0:
+                            # cap the encode burst's GIL hold: a large
+                            # batch drained in one go would stall a
+                            # concurrent bind for the whole burst on a
+                            # small-core box
+                            time.sleep(0)
+                        self._segment_bytes += len(line)
+                        if self._segment_bytes >= self.max_segment_bytes:
+                            self._fh.flush()
+                            self._rotate()
+                    self._fh.flush()  # readers see bytes after flush()
+                    dirty = True
+                except Exception:
+                    # disk full / dir removed / handle poisoned: count the
+                    # loss (replay will flag the seq gap), drop the handle
+                    # so the next batch re-opens, and keep the writer ALIVE
+                    # — a dead writer thread with record() still buffering
+                    # is an unbounded-memory failure mode
+                    self._io_errors += 1
+                    self._io_lost += len(batch) - written_lines
+                    try:
+                        if self._fh is not None:
+                            self._fh.close()
+                    except OSError:
+                        pass
+                    self._fh = None
+                    dirty = False
+            now = time.monotonic()
+            if dirty and (
+                self.fsync_policy == "always"
+                or stopping
+                or (
+                    self.fsync_policy == "interval"
+                    and now - last_sync >= self.fsync_interval_s
+                )
+            ):
+                if self.fsync_policy != "off":
+                    self._fsync()
+                dirty = False
+                last_sync = now
+            with self._cond:
+                self._written += len(batch)
+                self._cond.notify_all()
+                if stopping and not self._buf:
+                    return
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every record appended before this call has been
+        processed by the writer and flushed to the OS.  Returns False on
+        timeout, when the journal is disabled, or when any record was
+        LOST to a write failure while waiting — callers using this as a
+        durability barrier must not read success out of a failed disk."""
+        with self._cond:
+            if not self.enabled:
+                return False
+            target = self._appended
+            lost0 = self._io_lost
+            self._cond.notify_all()  # kick the writer out of its poll
+            deadline = time.monotonic() + timeout
+            while self._written < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return self._io_lost == lost0
+
+    # -- introspection (/debug/journal) --------------------------------------
+
+    def debug_state(self, tail_n: int = 50) -> dict:
+        with self._cond:
+            state = {
+                "enabled": self.enabled,
+                "dir": self.dir,
+                "fsync": self.fsync_policy,
+                "fsync_interval_s": self.fsync_interval_s,
+                "max_segment_bytes": self.max_segment_bytes,
+                "max_segments": self.max_segments,
+                "next_seq": self._seq,
+                "appended": self._appended,
+                "written": self._written,
+                "pending": len(self._buf),
+                "dropped": self._dropped,
+                "io_errors": self._io_errors,
+                "io_lost_records": self._io_lost,
+                "rotations": self._rotations,
+                "pruned_segments": self._pruned,
+                "tail": list(self._tail)[-tail_n:] if tail_n > 0 else [],
+            }
+        if state["dir"]:
+            segs = []
+            for p in segment_paths(state["dir"]):
+                try:
+                    segs.append(
+                        {"file": os.path.basename(p),
+                         "bytes": os.path.getsize(p)}
+                    )
+                except OSError:
+                    continue
+            state["segments"] = segs
+        return state
+
+
+# Process-global instance, same pattern as tracing.TRACER / metrics
+# REGISTRY: emission sites import this and check .enabled first.
+JOURNAL = Journal()

@@ -1,0 +1,541 @@
+"""Deterministic journal replay: rebuild allocator state, audit invariants.
+
+Consumes the record stream written by ``journal.JOURNAL`` (see the
+package docstring for the record taxonomy) and rebuilds per-node
+``ChipSet`` state through the SAME transact/cancel commit machinery the
+live scheduler uses — so a journal that replays cleanly is a proof that
+the recorded mutation sequence never double-booked a chip and never
+freed capacity that was not charged.
+
+Three consumers:
+
+- ``replay(events)`` → ``ReplayResult``: the reconstructed state plus
+  every invariant violation found while streaming (double-book,
+  capacity inflation on free, gang admit without all members bound)
+  and the post-conditions checked at the end (per-node capacity
+  conservation: chips charged by live pods must equal total - avail).
+
+- ``diff_live(result, status)``: field-by-field diff of the replayed
+  state against a live ``/scheduler/status`` snapshot (accepts either
+  the endpoint's ``{"schedulers": [...]}`` wrapper or one engine's
+  status dict).  Empty diff = the journal and the live allocator agree.
+
+- ``what_if(events, rater)``: replay the recorded workload but let a
+  DIFFERENT rater choose each placement — offline placement-policy
+  scoring against real recorded demand (the Gavel/Tesserae use case).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.allocator import ChipSet, ContainerAlloc, Option, Rater
+from ..core.chip import Chip
+from ..core.request import NOT_NEEDED, TPURequest, TPUUnit
+from ..core.topology import Topology
+
+
+def option_from_record(rec: dict) -> Option:
+    """Inverse of ``journal.option_record``."""
+    return Option(
+        request_hash=rec.get("hash", ""),
+        allocs=tuple(
+            ContainerAlloc(
+                container=name,
+                coords=tuple(tuple(c) for c in coords),
+                whole=bool(whole),
+                core=int(core),
+                hbm=int(hbm),
+                contiguous=bool(contiguous),
+            )
+            for name, coords, whole, core, hbm, contiguous in rec["allocs"]
+        ),
+        score=float(rec.get("score", 0.0)),
+    )
+
+
+def request_from_option(opt: Option, pod_key: str, pod_uid: str) -> TPURequest:
+    """Reconstruct the demand a recorded placement satisfied, so what-if
+    replay can re-run the placement search for the same request shape."""
+    units = []
+    names = []
+    for a in opt.allocs:
+        names.append(a.container)
+        if not a.needs_tpu:
+            units.append(TPUUnit(core=NOT_NEEDED))
+        elif a.whole:
+            units.append(TPUUnit(core=0, hbm=0, chip_count=len(a.coords)))
+        else:
+            units.append(TPUUnit(core=a.core, hbm=a.hbm))
+    return TPURequest(
+        pod_uid=pod_uid or f"replay-{pod_key}",
+        pod_key=pod_key,
+        units=tuple(units),
+        container_names=tuple(names),
+    )
+
+
+@dataclass
+class _LivePod:
+    node: str
+    option: Option
+    uid: str = ""
+    gang: str = ""
+    seq: int = -1
+    # False after a reset-resync wiped the node's chip usage while the
+    # scheduler ledger kept the pod: the pod is live but charges nothing
+    charged: bool = True
+
+
+@dataclass
+class ReplayResult:
+    records: int = 0
+    last_seq: int = -1
+    nodes: dict = field(default_factory=dict)  # node → ChipSet
+    pods: dict = field(default_factory=dict)  # pod key → _LivePod
+    gangs: dict = field(default_factory=dict)  # gang → {"admits","rollbacks"}
+    violations: list = field(default_factory=list)
+    warnings: list = field(default_factory=list)
+
+    def summary(self) -> dict:
+        # fragmentation derived from the REPLAYED chip state — the same
+        # numbers /metrics computes live, available offline at whatever
+        # seq the replay stopped at
+        frag = {}
+        for node, cs in sorted(self.nodes.items()):
+            fi, largest, free_n = cs.fragmentation()
+            frag[node] = {
+                "index": fi, "largest_free_box": largest,
+                "free_chips": free_n,
+            }
+        return {
+            "records": self.records,
+            "last_seq": self.last_seq,
+            "nodes": len(self.nodes),
+            "live_pods": len(self.pods),
+            "fragmentation": frag,
+            "gangs": {
+                g: dict(v) for g, v in sorted(self.gangs.items())
+            },
+            "violations": list(self.violations),
+            "warnings": list(self.warnings),
+        }
+
+
+def _chipset_from_record(rec: dict) -> ChipSet:
+    topo = Topology(tuple(rec["dims"]), tuple(bool(w) for w in rec["wrap"]))
+    return ChipSet(topo, [Chip.from_record(c) for c in rec["chips"]])
+
+
+def _boot_from_checkpoint(rec: dict, res: ReplayResult) -> None:
+    """Initialize replay state from a segment-head snapshot (the journal's
+    prefix was pruned; this snapshot stands in for it)."""
+    for name, inv in (rec.get("nodes") or {}).items():
+        try:
+            res.nodes[name] = _chipset_from_record(inv)
+        except Exception as e:
+            res.violations.append(f"checkpoint: bad node {name}: {e}")
+    for p in rec.get("pods") or []:
+        try:
+            opt = option_from_record(p["option"])
+        except Exception as e:
+            res.violations.append(
+                f"checkpoint: bad pod option {p.get('pod')}: {e}"
+            )
+            continue
+        cs = res.nodes.get(p.get("node"))
+        if cs is None or not cs.can_transact(opt):
+            res.violations.append(
+                f"checkpoint: pod {p.get('pod')} does not fit its node "
+                f"{p.get('node')} — snapshot is internally inconsistent"
+            )
+            continue
+        cs.transact(opt)
+        res.pods[p["pod"]] = _LivePod(
+            node=p["node"], option=opt, uid=p.get("uid", ""),
+            gang=p.get("gang", "") or "",
+        )
+
+
+def replay(events: list[dict]) -> ReplayResult:
+    """Rebuild state from a record stream; every anomaly is collected,
+    never raised — a corrupt journal must yield a report, not a
+    traceback."""
+    res = ReplayResult()
+    expected_seq: Optional[int] = None
+    booted_from_checkpoint = False
+    boot_as_of = -1
+    for rec in events:
+        res.records += 1
+        t = rec.get("type")
+        if t == "checkpoint":
+            # segment-head state snapshot (no seq — outside the mutation
+            # stream).  Mid-stream copies are redundant re-assertions;
+            # the FIRST record being one means the prefix was pruned and
+            # this snapshot is the boot state.
+            if expected_seq is None and not res.nodes and not res.pods:
+                _boot_from_checkpoint(rec, res)
+                booted_from_checkpoint = True
+                boot_as_of = rec.get("as_of_seq", -1)
+                if boot_as_of >= 0:
+                    # the dense-seq audit must hold ACROSS the boot
+                    # boundary too: the first applied record is as_of+1
+                    # unless something was lost
+                    expected_seq = boot_as_of + 1
+            continue
+        seq = rec.get("seq", -1)
+        if booted_from_checkpoint and seq <= boot_as_of:
+            # appended before the boot snapshot → its mutation is already
+            # inside the checkpoint; re-applying would double-book (bind)
+            # or double-free (forget)
+            continue
+        if expected_seq is None:
+            if seq > 0 and not booted_from_checkpoint:
+                res.violations.append(
+                    f"journal starts mid-stream at seq {seq} with no "
+                    "checkpoint — prefix pruned/lost; state cannot be "
+                    "reconstructed"
+                )
+        elif seq != expected_seq:
+            res.violations.append(
+                f"seq gap: expected {expected_seq}, found {seq} — records "
+                "lost (writer drops or a pruned/torn segment mid-stream)"
+            )
+        expected_seq = seq + 1
+        res.last_seq = seq
+        where = f"seq {seq}"
+        if t in ("node_add", "node_resync"):
+            node = rec["node"]
+            try:
+                cs = _chipset_from_record(rec)
+            except Exception as e:
+                res.violations.append(f"{where}: bad {t} record: {e}")
+                continue
+            if rec.get("reset"):
+                # layout-change resync: the live allocator rebuilt the
+                # ChipSet and WIPED usage while the scheduler ledger kept
+                # its pod entries — mirror that: fresh chips, pods stay
+                # live but uncharged
+                for lp in res.pods.values():
+                    if lp.node == node:
+                        lp.charged = False
+            else:
+                # re-charge charged pods still live on this node: a
+                # same-shape resync (and a restart's node_add) preserves
+                # usage in the live allocator, so replay must too
+                for pk, lp in res.pods.items():
+                    if lp.node != node or not lp.charged:
+                        continue
+                    if cs.can_transact(lp.option):
+                        cs.transact(lp.option)
+                    else:
+                        res.violations.append(
+                            f"{where}: {t} of {node} cannot re-charge live "
+                            f"pod {pk} (capacity shrank under a live "
+                            "allocation)"
+                        )
+            res.nodes[node] = cs
+        elif t == "bind":
+            pod, node = rec.get("pod"), rec.get("node")
+            cs = res.nodes.get(node)
+            if cs is None:
+                res.violations.append(
+                    f"{where}: bind {pod} on unknown node {node}"
+                )
+                continue
+            try:
+                opt = option_from_record(rec["option"])
+            except Exception as e:
+                res.violations.append(f"{where}: bad bind option: {e}")
+                continue
+            if pod in res.pods:
+                lp = res.pods[pod]
+                if lp.node == node and lp.option.allocs == opt.allocs:
+                    # idempotent re-assertion: a restart re-journals every
+                    # surviving pod (source=replay/add) after its node_add
+                    # re-charged it — same node, same placement, no new
+                    # state.  (Scores may differ: annotation recovery
+                    # rebuilds options with score 0.)
+                    lp.seq = seq
+                    continue
+                res.violations.append(
+                    f"{where}: double bind of {pod} (already live on "
+                    f"{res.pods[pod].node} since seq {res.pods[pod].seq} "
+                    "with a different placement)"
+                )
+                continue
+            if not cs.can_transact(opt):
+                res.violations.append(
+                    f"{where}: bind {pod} on {node} double-books a chip "
+                    f"(placement no longer fits the replayed state)"
+                )
+                continue
+            cs.transact(opt)
+            res.pods[pod] = _LivePod(
+                node=node, option=opt, uid=rec.get("uid", ""),
+                gang=rec.get("gang", "") or "", seq=seq,
+            )
+        elif t == "forget":
+            pod = rec.get("pod")
+            lp = res.pods.pop(pod, None)
+            if lp is None:
+                # legitimate race: a pod deleted mid-gang-commit journals
+                # a forget before its bind was ever journaled
+                res.warnings.append(f"{where}: forget of unbound pod {pod}")
+                continue
+            if not lp.charged:
+                continue  # reset-resync wiped its charge; nothing to free
+            cs = res.nodes.get(lp.node)
+            if cs is None:
+                res.violations.append(
+                    f"{where}: forget {pod} on unknown node {lp.node}"
+                )
+                continue
+            if not cs.can_cancel(lp.option):
+                res.violations.append(
+                    f"{where}: forget {pod} would free capacity not "
+                    f"charged on {lp.node} (double free / inflation)"
+                )
+                continue
+            cs.cancel(lp.option)
+        elif t == "gang_admit":
+            gang = rec.get("gang", "?")
+            g = res.gangs.setdefault(gang, {"admits": 0, "rollbacks": 0})
+            g["admits"] += 1
+            members = rec.get("members", [])
+            missing = [
+                m
+                for m in members
+                if m not in res.pods or res.pods[m].gang != gang
+            ]
+            if missing:
+                res.violations.append(
+                    f"{where}: gang {gang} admitted with {len(missing)}/"
+                    f"{len(members)} member(s) not bound at admit time: "
+                    f"{missing[:4]} — all-or-nothing violated"
+                )
+        elif t == "gang_rollback":
+            gang = rec.get("gang", "?")
+            g = res.gangs.setdefault(gang, {"admits": 0, "rollbacks": 0})
+            g["rollbacks"] += 1
+            # a rolled-back gang must have left nothing bound
+            bound = [
+                pk for pk, lp in res.pods.items() if lp.gang == gang
+            ]
+            if bound:
+                res.violations.append(
+                    f"{where}: gang {gang} rolled back but {len(bound)} "
+                    f"member(s) still journaled as bound: {bound[:4]}"
+                )
+        elif t == "node_remove":
+            node = rec.get("node")
+            res.nodes.pop(node, None)
+        else:
+            res.warnings.append(f"{where}: unknown record type {t!r}")
+
+    # post-conditions: per-node capacity conservation — the chips charged
+    # by live pods must account exactly for total - avail
+    for node, cs in sorted(res.nodes.items()):
+        exp_core = exp_hbm = 0
+        for lp in res.pods.values():
+            if lp.node != node or not lp.charged:
+                continue
+            for a in lp.option.allocs:
+                if not a.needs_tpu:
+                    continue
+                for c in a.coords:
+                    i = cs._slot.get(c)
+                    if i is None:
+                        continue
+                    if a.whole:
+                        exp_core += cs._core_total[i]
+                        exp_hbm += cs._hbm_total[i]
+                    else:
+                        exp_core += a.core
+                        exp_hbm += a.hbm
+        used_core = cs.total_core() - cs.avail_core()
+        used_hbm = cs.total_hbm() - cs.avail_hbm()
+        if used_core != exp_core or used_hbm != exp_hbm:
+            res.violations.append(
+                f"node {node}: capacity not conserved — chips show "
+                f"core={used_core}/hbm={used_hbm} in use but live pods "
+                f"charge core={exp_core}/hbm={exp_hbm}"
+            )
+    return res
+
+
+def diff_live(res: ReplayResult, status: dict) -> list[str]:
+    """Replayed state vs a live ``/scheduler/status`` snapshot.  Returns
+    human-readable mismatch lines; empty = identical."""
+    scheds = status.get("schedulers")
+    if scheds is None:
+        scheds = [status]
+    diffs: list[str] = []
+    live_nodes: dict[str, dict] = {}
+    live_pods: set[str] = set()
+    for s in scheds:
+        live_nodes.update(s.get("nodes", {}))
+        live_pods.update(s.get("pods", []))
+
+    for node in sorted(set(live_nodes) | set(res.nodes)):
+        ns = live_nodes.get(node)
+        cs = res.nodes.get(node)
+        if ns is None:
+            # the engine's allocator registry is a lazy cache of cluster
+            # state: after a restart an idle node exists in the journal
+            # but is not materialized live until something schedules on
+            # it — identical states, not a divergence.  A replayed node
+            # with USAGE missing live is a real one.
+            if (
+                cs.avail_core() == cs.total_core()
+                and cs.avail_hbm() == cs.total_hbm()
+                and not any(lp.node == node for lp in res.pods.values())
+            ):
+                continue
+            diffs.append(
+                f"node {node}: in journal replay with usage but not live"
+            )
+            continue
+        if cs is None:
+            diffs.append(f"node {node}: live but never journaled")
+            continue
+        live_chips = ns.get("chips", {})
+        replayed = cs.status()["chips"]
+        for coord in sorted(set(live_chips) | set(replayed)):
+            lc, rc = live_chips.get(coord), replayed.get(coord)
+            if lc is None or rc is None:
+                diffs.append(
+                    f"node {node} chip {coord}: present only "
+                    f"{'live' if rc is None else 'in replay'}"
+                )
+                continue
+            for k in ("core_avail", "core_total", "hbm_avail", "hbm_total"):
+                if lc.get(k) != rc.get(k):
+                    diffs.append(
+                        f"node {node} chip {coord}: {k} live={lc.get(k)} "
+                        f"replayed={rc.get(k)}"
+                    )
+    for pod in sorted(live_pods - set(res.pods)):
+        diffs.append(f"pod {pod}: live in ledger but not in replayed state")
+    for pod in sorted(set(res.pods) - live_pods):
+        diffs.append(f"pod {pod}: replayed as live but absent from ledger")
+    return diffs
+
+
+def what_if(events: list[dict], rater: Rater) -> dict:
+    """Replay the recorded workload, re-placing every bind with ``rater``
+    instead of the recorded decision.  Forgotten pods release whatever
+    the what-if run placed for them, so the alternative policy faces the
+    same arrival/departure sequence the real one did.
+
+    Returns aggregate placement-quality stats for the alternative policy
+    next to the recorded one: mean score, contiguous fraction, and how
+    many binds the alternative could not place at all (it then falls
+    back to the recorded placement so the stream stays consistent).
+
+    MAINTENANCE NOTE: the checkpoint-boot / as_of seq-skip / node
+    add+resync handling below deliberately mirrors ``replay()`` (which
+    owns the authoritative versions with the invariant checks) — a new
+    record field or flag handled there must be handled here too."""
+    nodes: dict[str, ChipSet] = {}
+    placed: dict[str, tuple[str, Option]] = {}
+    binds = unplaced = contiguous = rec_contiguous = 0
+    scores: list[float] = []
+    rec_scores: list[float] = []
+    booted = False
+    boot_as_of = -1
+    for rec in events:
+        t = rec.get("type")
+        if t == "checkpoint":
+            if booted or nodes or placed:
+                continue  # mid-stream re-assertion
+            booted = True
+            boot_as_of = rec.get("as_of_seq", -1)
+            for name, inv in (rec.get("nodes") or {}).items():
+                try:
+                    nodes[name] = _chipset_from_record(inv)
+                except Exception:
+                    continue
+            for p in rec.get("pods") or []:
+                try:
+                    opt = option_from_record(p["option"])
+                except Exception:
+                    continue
+                cs = nodes.get(p.get("node"))
+                if cs is not None and cs.can_transact(opt):
+                    # boot-state pods keep their RECORDED placement (the
+                    # what-if policy only re-places binds it witnesses)
+                    cs.transact(opt)
+                    placed[p["pod"]] = (p["node"], opt)
+            continue
+        if booted and rec.get("seq", -1) <= boot_as_of:
+            continue  # already reflected in the boot snapshot
+        if t in ("node_add", "node_resync"):
+            try:
+                cs = _chipset_from_record(rec)
+            except Exception:
+                continue
+            node = rec["node"]
+            if rec.get("reset"):
+                for pk in [p for p, (n, _o) in placed.items() if n == node]:
+                    placed.pop(pk)
+            else:
+                for pk, (n, opt) in placed.items():
+                    if n == node and cs.can_transact(opt):
+                        cs.transact(opt)
+            nodes[node] = cs
+        elif t == "bind":
+            node = rec.get("node")
+            cs = nodes.get(node)
+            if cs is None or rec.get("pod") in placed:
+                continue  # unknown node, or a restart's re-assertion
+            try:
+                recorded = option_from_record(rec["option"])
+            except Exception:
+                continue
+            binds += 1
+            rec_scores.append(recorded.score)
+            if all(
+                a.contiguous for a in recorded.allocs if a.needs_tpu
+            ):
+                rec_contiguous += 1
+            req = request_from_option(
+                recorded, rec.get("pod", "?"), rec.get("uid", "")
+            )
+            opt = cs.trade(req, rater)
+            if opt is None:
+                # alternative policy cannot place what the recorded one
+                # did (should not happen on the same node state; count it
+                # loudly) — apply the recorded option to stay consistent
+                unplaced += 1
+                opt = recorded
+                if not cs.can_transact(opt):
+                    continue
+            else:
+                scores.append(opt.score)
+                if all(a.contiguous for a in opt.allocs if a.needs_tpu):
+                    contiguous += 1
+            cs.transact(opt)
+            placed[rec.get("pod")] = (node, opt)
+        elif t == "forget":
+            entry = placed.pop(rec.get("pod"), None)
+            if entry is not None:
+                node, opt = entry
+                cs = nodes.get(node)
+                if cs is not None and cs.can_cancel(opt):
+                    cs.cancel(opt)
+    return {
+        "rater": rater.name,
+        "binds": binds,
+        "placed": binds - unplaced,
+        "unplaced": unplaced,
+        "mean_score": round(sum(scores) / len(scores), 3) if scores else 0.0,
+        "contiguous_frac": round(contiguous / binds, 4) if binds else 0.0,
+        "recorded_mean_score": (
+            round(sum(rec_scores) / len(rec_scores), 3) if rec_scores else 0.0
+        ),
+        "recorded_contiguous_frac": (
+            round(rec_contiguous / binds, 4) if binds else 0.0
+        ),
+    }

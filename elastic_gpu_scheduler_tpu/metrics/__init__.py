@@ -38,6 +38,12 @@ _HELD_RANKS = threading.local()
 # Appends/dels are GIL-atomic list ops; the scrape-path _drain folds the
 # parked batches into LOCK_WAIT.
 _ORPHAN_WAITS: list[tuple[str, list]] = []
+# Sample counts _flush_orphan DROPPED at the parking-list cap.  The
+# finalizer may run on a thread already inside any metric lock, so it
+# cannot call Counter.inc (non-reentrant _lock → self-deadlock); it
+# appends here (GIL-atomic) and the scrape-path _drain folds the counts
+# into METRICS_DROPPED.
+_ORPHAN_DROPPED: list[int] = []
 
 
 def _flush_orphan(name: str, waits: list) -> None:
@@ -57,9 +63,14 @@ def _flush_orphan(name: str, waits: list) -> None:
         del waits[:n]
         if len(_ORPHAN_WAITS) < 4096:
             _ORPHAN_WAITS.append((name, vals))
-        # else drop: when nothing ever scrapes, losing dying locks' tail
-        # samples beats unbounded growth (same stance as _WAITS_CAP); a
-        # bound-and-trim here would race the scrape-path slice/del pair
+        else:
+            # drop: when nothing ever scrapes, losing dying locks' tail
+            # samples beats unbounded growth (same stance as _WAITS_CAP); a
+            # bound-and-trim here would race the scrape-path slice/del pair.
+            # The drop itself is COUNTED (satellite: never discard samples
+            # silently) — via the parking list, not Counter.inc, because
+            # this is a GC callback (see _ORPHAN_DROPPED)
+            _ORPHAN_DROPPED.append(n)
 
 
 class Counter:
@@ -318,6 +329,58 @@ PLAN_CACHE = REGISTRY.register(
         ("event",),
     )
 )
+METRICS_DROPPED = REGISTRY.register(
+    Counter(
+        "tpu_metrics_dropped_samples_total",
+        "Lock-wait samples discarded by bounded buffers, by reason: "
+        "waits_cap = a TimedLock's wait buffer trimmed with nothing "
+        "scraping LOCK_WAIT; orphan_cap = a dying lock's parked waits "
+        "dropped at the 4096-entry orphan-list cap.  Non-zero values "
+        "mean lock-wait counts/sums UNDERSTATE reality by that many "
+        "samples",
+        ("reason",),
+    )
+)
+class LazyGauge(Gauge):
+    """Gauge recomputed by a registered ``refresher`` at collect() time —
+    for scrape-time values whose computation (e.g. the contiguous-box
+    scan behind the fragmentation gauges) must stay OFF the bind path:
+    the scraper pays it, never the scheduler."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.refresher = None
+
+    def collect(self):
+        r = self.refresher
+        if r is not None:
+            try:
+                r()
+            except Exception:  # a broken refresher must not kill /metrics
+                pass
+        yield from super().collect()
+
+
+FRAG_INDEX = REGISTRY.register(
+    LazyGauge(
+        "tpu_scheduler_mesh_fragmentation_index",
+        "Per-node ICI-mesh fragmentation, computed at scrape time: "
+        "1 - largest_free_contiguous_submesh / free_chips (0 = the free "
+        "set is one contiguous box or the node is full)",
+        ("node",),
+    )
+)
+FREE_SUBMESH = REGISTRY.register(
+    LazyGauge(
+        "tpu_scheduler_largest_free_submesh_chips",
+        "Largest fully-free contiguous axis-aligned submesh on the node "
+        "(chips), computed at scrape time — the biggest whole-chip "
+        "container that can still land with full ICI locality",
+        ("node",),
+    )
+)
+
+
 class _LockWaitHistogram(Histogram):
     """LOCK_WAIT with lazy ingestion: every read API drains the
     TimedLock wait buffers first.
@@ -343,6 +406,11 @@ class _LockWaitHistogram(Histogram):
                 del _ORPHAN_WAITS[:n]
                 for name, vals in parked:
                     self.observe_batch(name, values=vals)
+            nd = len(_ORPHAN_DROPPED)
+            if nd:
+                counts = _ORPHAN_DROPPED[:nd]
+                del _ORPHAN_DROPPED[:nd]
+                METRICS_DROPPED.inc("orphan_cap", value=float(sum(counts)))
 
     def samples(self, *labels: str) -> list:
         self._drain()
@@ -457,6 +525,12 @@ class TimedLock:
                     del self._waits[: _WAITS_CAP // 2]
                 finally:
                     _DRAIN_LOCK.release()
+                # count what was just discarded (satellite: no silent
+                # drops).  One Counter.inc per ~10k acquisitions — off
+                # the per-acquire path by construction
+                METRICS_DROPPED.inc(
+                    "waits_cap", value=float(_WAITS_CAP // 2)
+                )
         return ok
 
     def _drain_locked(self, hist: Histogram) -> None:

@@ -77,6 +77,7 @@ from ..core.allocator import (
     plan_gang_fallback,
 )
 from ..core.request import TPURequest, request_from_pod
+from ..journal import JOURNAL
 from ..k8s.objects import Pod
 from ..metrics import GANG_COMMIT, GANG_EVENTS, PLAN_CACHE, TimedLock
 from ..tracing import AUDIT, NOOP_SPAN, TRACER
@@ -896,6 +897,23 @@ class GangCoordinator:
                         if opt is None:
                             opt = sched.gang_allocate(node, pod)
                         allocated.append((pod, node, opt))
+                    if JOURNAL.enabled:
+                        # the all-or-nothing seal, INSIDE the same engine-
+                        # lock hold as the members' bind records: no
+                        # concurrent forget (it needs sched.lock) can
+                        # interleave between a member bind and the admit,
+                        # so replay's membership check can never trip on a
+                        # legal mid-commit deletion.  Phase-2/3 failures
+                        # journal balancing forgets + a gang_rollback.
+                        JOURNAL.record(
+                            "gang_admit",
+                            gang=gkey,
+                            size=g.size,
+                            members=[k for k, _ in members],
+                            nodes=sorted(
+                                {node for _, (node, _p) in members}
+                            ),
+                        )
             except Exception as e:
                 with sched.lock:
                     for pod, node, opt in allocated:
@@ -1030,9 +1048,20 @@ class GangCoordinator:
                     self.commit_secs[key] = dt
                     GANG_COMMIT.observe(value=dt)
                 self._plans.pop(gkey, None)
-        except Exception:
+        except Exception as e:
             with self._lock:
                 self._plans.pop(gkey, None)  # stale either way
+            if JOURNAL.enabled:
+                # phase rollbacks freed every allocation before any bind
+                # record was journaled, so this is informational: a gang
+                # that reached commit and left NOTHING bound
+                JOURNAL.record(
+                    "gang_rollback",
+                    gang=gkey,
+                    size=g.size,
+                    members=[k for k, _ in members],
+                    reason=(str(e) or repr(e))[:200],
+                )
             raise
 
     def _rollback(self, sched, allocated, strip_keys: set[str]) -> None:

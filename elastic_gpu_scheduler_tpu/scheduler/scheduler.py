@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
@@ -38,10 +39,11 @@ from ..core.annotations import (
 from ..core.node import NodeAllocator
 from ..core.rater import Rater
 from ..core.request import TPURequest, pod_gang_key, request_from_pod
+from ..journal import JOURNAL, option_record
 from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
-from ..metrics import CHIPS_ALLOCATED, TimedLock
+from ..metrics import CHIPS_ALLOCATED, FRAG_INDEX, FREE_SUBMESH, TimedLock
 from ..tracing import AUDIT, TRACER
 from ..utils import consts
 
@@ -71,10 +73,10 @@ class ResourceScheduler:
     def bind(self, node_name: str, pod: Pod) -> Pod:
         raise NotImplementedError
 
-    def add_pod(self, pod: Pod) -> None:
+    def add_pod(self, pod: Pod, source: str = "add") -> None:
         raise NotImplementedError
 
-    def forget_pod(self, pod: Pod) -> None:
+    def forget_pod(self, pod: Pod, source: str = "forget") -> None:
         raise NotImplementedError
 
     def preempt(
@@ -117,6 +119,20 @@ class TPUUnitScheduler(ResourceScheduler):
         self._pool = ThreadPoolExecutor(
             max_workers=self.assume_workers, thread_name_prefix="assume"
         )
+        # this engine snapshots full state into every rotated journal
+        # segment, so pruned journals stay replayable; the fragmentation
+        # gauges recompute from live chip state when /metrics is scraped
+        # (LazyGauge) — never on the bind path.  weakref: tests build
+        # many engines; a dead one must not be pinned or probed.
+        ref = weakref.ref(self)
+        JOURNAL.checkpoint_provider = lambda: (
+            lambda s: s._journal_checkpoint() if s is not None else None
+        )(ref())
+        refresher = lambda: (  # noqa: E731 — tiny weakref trampoline
+            lambda s: s._refresh_frag_gauges() if s is not None else None
+        )(ref())
+        FRAG_INDEX.refresher = refresher
+        FREE_SUBMESH.refresher = refresher
         self._rebuild_state()
 
     # -- startup rebuild (reference: scheduler.go:86-106) --------------------
@@ -198,6 +214,12 @@ class TPUUnitScheduler(ResourceScheduler):
             if cur is not None:
                 return cur  # lost the creation race; ours was never visible
             self.allocators[node_name] = na
+            if JOURNAL.enabled:
+                # capacity inventory first, so every later bind/forget on
+                # this node replays against a known chip set
+                JOURNAL.record(
+                    "node_add", node=node_name, **na.chips.inventory()
+                )
             for pod in pods:
                 if pod.key in self.pod_maps:
                     continue
@@ -208,6 +230,9 @@ class TPUUnitScheduler(ResourceScheduler):
                     na.add(opt)
                     self.pod_maps[pod.key] = (node_name, opt)
                     replayed.append(pod)
+                    self._journal_event(
+                        "bind", pod, node_name, opt=opt, source="replay"
+                    )
                 except ValueError as e:
                     log.warning("replay %s on %s: %s", pod.key, node_name, e)
         # Close the fetch-window race: a pod that completed or was deleted
@@ -330,6 +355,14 @@ class TPUUnitScheduler(ResourceScheduler):
             with self.lock:
                 self.pod_maps[pod.key] = (node_name, opt)
                 self.released_pods.pop(pod.key, None)
+                # journal at the COMMIT point, not after the API writes:
+                # a concurrent forget (pod deleted mid-bind) must never
+                # reach the journal before the bind it undoes
+                self._update_node_gauge(node_name)
+                self._journal_event(
+                    "bind", pod, node_name, opt=opt, source="bind",
+                    trace_id=sp.trace_id or None,
+                )
             sp.event("allocated")
 
             try:
@@ -344,7 +377,6 @@ class TPUUnitScheduler(ResourceScheduler):
                     )
                 )
                 sp.event("binding_posted")
-                self._update_node_gauge(node_name)
                 chips = [a.coords for a in opt.allocs if a.needs_tpu]
                 sp.set_attr("chips", [str(c) for c in chips])
                 AUDIT.record(
@@ -358,8 +390,18 @@ class TPUUnitScheduler(ResourceScheduler):
                 return updated
             except Exception as e:
                 with self.lock:
-                    self.pod_maps.pop(pod.key, None)
-                    na.forget(opt)
+                    entry = self.pod_maps.pop(pod.key, None)
+                    if entry is not None:
+                        # an absent entry means a racing forget_pod (pod
+                        # deleted mid-bind) already freed the chips AND
+                        # journaled the forget — freeing again here would
+                        # credit back capacity charged to OTHER pods
+                        # (Chip.give clamps, silently inflating avail)
+                        na.forget(opt)
+                        self._update_node_gauge(node_name)
+                        self._journal_event(
+                            "forget", pod, node_name, source="bind_rollback"
+                        )
                 self._record_event(
                     pod, "Warning", "FailedScheduling",
                     f"bind to {node_name}: {e}",
@@ -520,6 +562,16 @@ class TPUUnitScheduler(ResourceScheduler):
             opt = na.allocate(request, self.rater)
             self.pod_maps[pod.key] = (node_name, opt)
             self.released_pods.pop(pod.key, None)
+            # journal at the phase-1 commit (the mutation), not at
+            # post-commit bookkeeping: a racing mid-commit forget must
+            # order AFTER this record, and a rolled-back gang balances
+            # with gang_unallocate's forget records.  NO gauge refresh
+            # here — phase 1 runs the whole gang under the engine lock,
+            # and a per-member fragmentation scan inside that hold would
+            # serialize every concurrent verb (gang_note_bound refreshes
+            # per node after commit; the frag field may be one step stale)
+            self._journal_event("bind", pod, node_name, opt=opt,
+                                source="gang")
             return opt
 
     def gang_apply_option(self, node_name: str, pod: Pod, opt: Option) -> None:
@@ -535,6 +587,8 @@ class TPUUnitScheduler(ResourceScheduler):
             na.add(opt)
             self.pod_maps[pod.key] = (node_name, opt)
             self.released_pods.pop(pod.key, None)
+            self._journal_event("bind", pod, node_name, opt=opt,
+                                source="gang")
 
     def gang_unallocate(self, node_name: str, pod: Pod, opt: Option) -> None:
         with self.lock:
@@ -548,6 +602,8 @@ class TPUUnitScheduler(ResourceScheduler):
             if na is not None:
                 na.forget(opt)
             self._update_node_gauge(node_name)
+            self._journal_event("forget", pod, node_name,
+                                source="gang_rollback")
 
     def gang_annotate(
         self, pod: Pod, opt: Option, node_name: str, extra=None
@@ -610,7 +666,9 @@ class TPUUnitScheduler(ResourceScheduler):
         )
 
     def gang_note_bound(self, pod: Pod, opt: Option, node_name: str) -> None:
-        """Post-commit bookkeeping (gauge + event + audit), one member."""
+        """Post-commit bookkeeping (gauge + event + audit), one member —
+        the journal's bind record was already emitted at the phase-1
+        allocation commit."""
         with self.lock:
             self._update_node_gauge(node_name)
         chips = [a.coords for a in opt.allocs if a.needs_tpu]
@@ -631,6 +689,75 @@ class TPUUnitScheduler(ResourceScheduler):
                 node_name,
                 value=na.chips.total_core() - na.chips.avail_core(),
             )
+
+    def _refresh_frag_gauges(self) -> None:
+        """Scrape-time fragmentation refresh (LazyGauge.refresher): the
+        contiguous-box scan runs on the scraper's request, never on the
+        bind path.  Offline, the same numbers are derivable at ANY
+        journal sequence number from the replayed chip state."""
+        with self.lock:
+            allocators = dict(self.allocators)
+        for name, na in allocators.items():
+            with na.lock:
+                frag, largest, _free = na.chips.fragmentation()
+            FRAG_INDEX.set(name, value=frag)
+            FREE_SUBMESH.set(name, value=float(largest))
+
+    def _journal_checkpoint(self) -> Optional[dict]:
+        """Full-state snapshot for the journal's segment-head checkpoint
+        (runs on the journal writer thread: registry under self.lock,
+        per-node inventory under each node's own lock)."""
+        if not JOURNAL.enabled:
+            return None
+        with self.lock:
+            # exact as_of: every engine mutation journals INSIDE this
+            # lock, so the seq read here covers precisely the mutations
+            # in the ledger copy below — no claimed-covered-but-absent
+            # window (the journal's own fallback reads it pre-provider,
+            # which is safe but coarser)
+            as_of = JOURNAL.last_seq()
+            allocators = dict(self.allocators)
+            pods = [
+                {"pod": k, "node": n, "option": option_record(o)}
+                for k, (n, o) in self.pod_maps.items()
+            ]
+        nodes = {}
+        for name, na in allocators.items():
+            with na.lock:
+                nodes[name] = na.chips.inventory()
+        return {"as_of_seq": as_of, "nodes": nodes, "pods": pods}
+
+    def _journal_event(
+        self,
+        type_: str,
+        pod: Pod,
+        node_name: str,
+        opt: Optional[Option] = None,
+        source: Optional[str] = None,
+        trace_id: Optional[str] = None,
+    ):
+        """Emit one flight-recorder record for a committed allocator
+        mutation (no-op unless the journal is enabled).  Carries the
+        pod's trace id (cross-link to /traces) and the node's
+        fragmentation snapshot from the last gauge refresh."""
+        if not JOURNAL.enabled:
+            return None
+        if trace_id is None:
+            ctx = TRACER.pod_context(pod.key)
+            trace_id = ctx.trace_id if ctx is not None else None
+        # no fragmentation fields: the replayed chip state derives them
+        # exactly at any seq (ReplayResult.summary), and attaching them
+        # here would put the contiguous-box scan on the bind path
+        return JOURNAL.record(
+            type_,
+            pod=pod.key,
+            uid=pod.metadata.uid,
+            node=node_name,
+            option=option_record(opt) if opt is not None else None,
+            gang=pod_gang_key(pod),
+            source=source,
+            trace_id=trace_id or None,
+        )
 
     def _record_event(self, pod: Pod, etype: str, reason: str, message: str):
         """Record a k8s Event for a scheduling outcome.  The reference wires
@@ -700,7 +827,7 @@ class TPUUnitScheduler(ResourceScheduler):
 
     # -- reconciliation hooks (reference: scheduler.go:229-281) --------------
 
-    def add_pod(self, pod: Pod) -> None:
+    def add_pod(self, pod: Pod, source: str = "add") -> None:
         """Learn an allocation committed elsewhere (controller/startup)."""
         node_name = assigned_node(pod)
         if not node_name:
@@ -724,8 +851,9 @@ class TPUUnitScheduler(ResourceScheduler):
                 return
             self.pod_maps[pod.key] = (node_name, opt)
             self.released_pods.pop(pod.key, None)
+            self._journal_event("bind", pod, node_name, opt=opt, source=source)
 
-    def forget_pod(self, pod: Pod) -> None:
+    def forget_pod(self, pod: Pod, source: str = "forget") -> None:
         """Free a completed/deleted pod's chips, at most once
         (reference: scheduler.go:247-267)."""
         with self.lock:
@@ -739,6 +867,7 @@ class TPUUnitScheduler(ResourceScheduler):
             if na is not None:
                 na.forget(opt)
             self._update_node_gauge(node_name)
+            self._journal_event("forget", pod, node_name, source=source)
             self.released_pods[pod.key] = pod.metadata.uid
             while len(self.released_pods) > self.released_pods_max:
                 self.released_pods.pop(next(iter(self.released_pods)))

@@ -38,6 +38,7 @@ from http.server import ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .. import __version__
+from ..journal import JOURNAL
 from ..k8s.extender import (
     ExtenderArgs,
     ExtenderBindingArgs,
@@ -246,7 +247,11 @@ the Python analogues):</p>
 <li><a href="/traces">/traces</a> — recent scheduling traces
  (?trace=ID for one trace, ?format=chrome for Perfetto export)</li>
 <li>/debug/schedule/&lt;namespace&gt;/&lt;pod&gt;
- — per-node filter verdicts, scores and the bind decision for one pod</li>
+ — per-node filter verdicts, scores and the bind decision for one pod
+ (?format=json adds the pod's journal sequence numbers)</li>
+<li><a href="/debug/journal">/debug/journal</a>
+ — flight-recorder state: rotation/fsync stats and the record tail
+ (?n=N); offline replay via python -m elastic_gpu_scheduler_tpu.journal</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/scheduler/status">/scheduler/status</a>
  — per-node chip state dump</li>
@@ -468,7 +473,43 @@ class ExtenderServer:
             pod_key = path[len("/debug/schedule/"):]
             if "/" not in pod_key:
                 pod_key = f"default/{pod_key}"
-            return 200, AUDIT.explain(pod_key).encode(), "text/plain"
+            params = _parse_query(query)
+            if params.get("format") == "json":
+                # machine-readable verdicts alongside the human text, with
+                # the pod's flight-recorder sequence numbers when the
+                # journal is on (cross-link to /debug/journal + offline
+                # replay)
+                entry = AUDIT.get(pod_key) or {
+                    "pod": pod_key, "trace_id": "", "records": [],
+                }
+                entry["journal"] = {
+                    "enabled": JOURNAL.enabled,
+                    "seqs": JOURNAL.pod_seqs(pod_key),
+                }
+                return (
+                    200, json.dumps(entry, indent=1).encode(),
+                    "application/json",
+                )
+            text = AUDIT.explain(pod_key)
+            if JOURNAL.enabled:
+                seqs = JOURNAL.pod_seqs(pod_key)
+                if seqs:
+                    text += (
+                        f"journal seqs: {seqs}  (see /debug/journal and "
+                        "python -m elastic_gpu_scheduler_tpu.journal)\n"
+                    )
+            return 200, text.encode(), "text/plain"
+        if path == "/debug/journal":
+            params = _parse_query(query)
+            try:
+                n = int(params.get("n", "50"))
+            except ValueError:
+                n = 50
+            return (
+                200,
+                json.dumps(JOURNAL.debug_state(n), indent=1).encode(),
+                "application/json",
+            )
         if path in ("/debug", "/debug/", "/debug/pprof", "/debug/pprof/"):
             return 200, _DEBUG_INDEX.encode(), "text/html"
         if path == "/debug/pprof/block":

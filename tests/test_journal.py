@@ -1,0 +1,688 @@
+"""Flight-recorder tests: wire format + crash recovery, replay invariants,
+live-state equivalence under concurrency, the /debug/journal surface, and
+the fragmentation gauges computed at journal checkpoints."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.core.allocator import ChipSet
+from elastic_gpu_scheduler_tpu.core.chip import Chip
+from elastic_gpu_scheduler_tpu.core.topology import Topology
+from elastic_gpu_scheduler_tpu.journal import (
+    JOURNAL,
+    Journal,
+    read_journal,
+    read_segment,
+    segment_paths,
+)
+from elastic_gpu_scheduler_tpu.journal.replay import (
+    diff_live,
+    replay,
+    what_if,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.extender import (
+    ExtenderArgs,
+    ExtenderBindingArgs,
+)
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+@pytest.fixture()
+def journal_dir(tmp_path):
+    """Configure the global JOURNAL into a temp dir; always close after."""
+    d = str(tmp_path / "journal")
+    JOURNAL.configure(d, fsync="off")
+    yield d
+    JOURNAL.close()
+
+
+def fresh_stack(n_nodes=2, priority="binpack", gang_timeout=5.0):
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority=priority,
+                    gang_timeout=gang_timeout)
+    )
+    return cluster, registry, predicate, bind, status
+
+
+# -- wire format & crash recovery -------------------------------------------
+
+
+def test_roundtrip_and_seq_order(journal_dir):
+    for i in range(5):
+        JOURNAL.record("bind", pod=f"ns/p{i}", node="n0")
+    assert JOURNAL.flush()
+    recs = read_journal(journal_dir)
+    assert [r["seq"] for r in recs] == list(range(5))
+    assert all(r["type"] == "bind" for r in recs)
+    assert JOURNAL.pod_seqs("ns/p3") == [3]
+
+
+def test_torn_tail_recovers_prefix(journal_dir):
+    for i in range(10):
+        JOURNAL.record("bind", pod=f"ns/p{i}", node="n0")
+    assert JOURNAL.flush()
+    JOURNAL.close()
+    segs = segment_paths(journal_dir)
+    assert len(segs) == 1
+    size = os.path.getsize(segs[0])
+    with open(segs[0], "r+b") as f:
+        f.truncate(size - 5)  # cut into the last record's payload
+    recs, torn, good = read_segment(segs[0])
+    assert torn and len(recs) == 9
+    assert [r["seq"] for r in recs] == list(range(9))
+    # good_bytes points at the start of the torn record
+    with open(segs[0], "rb") as f:
+        assert f.read(good).count(b"\n") == 9
+
+
+def test_torn_record_across_rotation_boundary(tmp_path):
+    """Rotation mid-stream, then a tear in the later segment: replay must
+    recover every record of the earlier segments plus the good prefix of
+    the torn one."""
+    d = str(tmp_path / "j")
+    JOURNAL.configure(d, fsync="off", max_segment_bytes=1024)
+    try:
+        for i in range(40):
+            JOURNAL.record("bind", pod=f"ns/p{i}", node="n0", filler="x" * 64)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    segs = segment_paths(d)
+    assert len(segs) >= 3  # rotation actually happened
+    assert len(read_journal(d)) == 40
+    # tear the last record-bearing segment mid-record (a fresh-rotated
+    # final segment may be empty)
+    last = [p for p in segs if os.path.getsize(p) > 0][-1]
+    with open(last, "r+b") as f:
+        f.truncate(os.path.getsize(last) - 3)
+    recovered = read_journal(d)
+    assert len(recovered) == 39
+    assert [r["seq"] for r in recovered] == list(range(39))
+
+
+def test_configure_repairs_torn_tail_and_resumes_seq(tmp_path):
+    d = str(tmp_path / "j")
+    JOURNAL.configure(d, fsync="off")
+    JOURNAL.record("bind", pod="ns/a", node="n0")
+    JOURNAL.record("bind", pod="ns/b", node="n0")
+    assert JOURNAL.flush()
+    JOURNAL.close()
+    seg = segment_paths(d)[0]
+    with open(seg, "r+b") as f:
+        f.truncate(os.path.getsize(seg) - 4)  # crash-torn tail
+    # reopen: tail repaired, numbering resumes after the last GOOD record
+    JOURNAL.configure(d, fsync="off")
+    try:
+        seq = JOURNAL.record("bind", pod="ns/c", node="n0")
+        assert seq == 1  # record for ns/b was torn → its seq is reused
+        assert JOURNAL.flush()
+        recs = read_journal(d)
+        assert [r["seq"] for r in recs] == [0, 1]
+        assert recs[1]["pod"] == "ns/c"
+        res = replay(
+            [
+                {"seq": r["seq"], "type": "noop_unknown", **{}}
+                for r in recs
+            ]
+        )
+        assert not res.violations  # dense seqs post-repair
+    finally:
+        JOURNAL.close()
+
+
+def test_crc_corruption_detected(journal_dir):
+    JOURNAL.record("bind", pod="ns/a", node="n0")
+    JOURNAL.record("bind", pod="ns/b", node="n0")
+    assert JOURNAL.flush()
+    JOURNAL.close()
+    seg = segment_paths(journal_dir)[0]
+    data = open(seg, "rb").read()
+    # flip one payload byte of the LAST record without changing length
+    idx = data.rstrip(b"\n").rfind(b'"ns/b"')
+    corrupted = data[:idx + 1] + b"X" + data[idx + 2:]
+    open(seg, "wb").write(corrupted)
+    recs, torn, _ = read_segment(seg)
+    assert torn and len(recs) == 1 and recs[0]["pod"] == "ns/a"
+
+
+# -- replay: live-state equivalence + invariants ----------------------------
+
+
+def test_replay_matches_live_status(journal_dir):
+    cluster, registry, predicate, bind, status = fresh_stack()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    pods = [tpu_pod(f"p{i}", core=100) for i in range(3)]
+    pods.append(tpu_pod("frac", core=30, hbm=2))
+    for p in pods:
+        cluster.create_pod(p)
+        filt = predicate.handle(
+            ExtenderArgs(pod=p, node_names=["node-0", "node-1"])
+        )
+        assert filt.node_names, filt.failed_nodes
+        res = bind.handle(
+            ExtenderBindingArgs(
+                pod_name=p.metadata.name,
+                pod_namespace=p.metadata.namespace,
+                pod_uid=p.metadata.uid,
+                node=filt.node_names[0],
+            )
+        )
+        assert not res.error
+    sched.forget_pod(pods[1])
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert not res.warnings, res.warnings
+    assert diff_live(res, status()) == []
+    # journal records carry the trace cross-link and the frag checkpoint
+    binds = [e for e in events if e["type"] == "bind"]
+    assert all(e.get("trace_id") for e in binds)
+    # fragmentation is derivable offline at the replayed checkpoint
+    assert res.summary()["fragmentation"]
+
+
+def test_replay_detects_forged_double_book():
+    node_add = {
+        "seq": 0, "type": "node_add", "node": "n0",
+        "dims": [4], "wrap": [False],
+        "chips": [[[i], 100, 16] for i in range(4)],
+    }
+
+    def bind_rec(seq, pod, coords):
+        return {
+            "seq": seq, "type": "bind", "pod": pod, "node": "n0",
+            "option": {
+                "hash": pod, "score": 0.0,
+                "allocs": [["main", [[c] for c in coords], True, 0, 0, True]],
+            },
+        }
+
+    res = replay([node_add, bind_rec(1, "ns/a", [0, 1]), bind_rec(2, "ns/b", [1, 2])])
+    assert any("double-books" in v for v in res.violations), res.violations
+
+
+def test_replay_detects_partial_gang_admit():
+    node_add = {
+        "seq": 0, "type": "node_add", "node": "n0",
+        "dims": [4], "wrap": [False],
+        "chips": [[[i], 100, 16] for i in range(4)],
+    }
+    bind_a = {
+        "seq": 1, "type": "bind", "pod": "ns/a", "node": "n0",
+        "gang": "ns/g",
+        "option": {
+            "hash": "a", "score": 0.0,
+            "allocs": [["main", [[0]], True, 0, 0, True]],
+        },
+    }
+    admit = {
+        "seq": 2, "type": "gang_admit", "gang": "ns/g", "size": 2,
+        "members": ["ns/a", "ns/b"],  # ns/b never bound
+    }
+    res = replay([node_add, bind_a, admit])
+    assert any("all-or-nothing" in v for v in res.violations), res.violations
+
+
+def test_unmatched_forget_is_warning_not_violation():
+    res = replay([
+        {"seq": 0, "type": "forget", "pod": "ns/ghost", "node": "n0"},
+    ])
+    assert not res.violations
+    assert any("ghost" in w for w in res.warnings)
+
+
+def test_gang_commit_journals_binds_then_admit(journal_dir):
+    cluster, registry, predicate, bind, status = fresh_stack(n_nodes=3)
+    nodes = [f"node-{i}" for i in range(3)]
+    pods = [
+        tpu_pod(f"g{i}", core=400, gang="jgang", gang_size=3)
+        for i in range(3)
+    ]
+    results = [None] * 3
+
+    def member(i, p):
+        cluster.create_pod(p)
+        filt = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+        if filt.error or not filt.node_names:
+            results[i] = f"filter: {filt.error or filt.failed_nodes}"
+            return
+        res = bind.handle(
+            ExtenderBindingArgs(
+                pod_name=p.metadata.name,
+                pod_namespace=p.metadata.namespace,
+                pod_uid=p.metadata.uid,
+                node=filt.node_names[0],
+            )
+        )
+        results[i] = res.error or "ok"
+
+    threads = [
+        threading.Thread(target=member, args=(i, p))
+        for i, p in enumerate(pods)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert results == ["ok"] * 3, results
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    admits = [e for e in events if e["type"] == "gang_admit"]
+    assert len(admits) == 1 and sorted(admits[0]["members"]) == [
+        "default/g0", "default/g1", "default/g2",
+    ]
+    gang_binds = [e for e in events if e["type"] == "bind"
+                  and e.get("gang") == "default/jgang"]
+    assert len(gang_binds) == 3
+    # every member bind precedes the admit seal
+    assert max(e["seq"] for e in gang_binds) < admits[0]["seq"]
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert diff_live(res, status()) == []
+
+
+def test_concurrent_binds_journal_writer_stress(journal_dir):
+    """8 client threads churning bind/forget against 4 nodes while the
+    background writer drains: the recovered journal must replay to the
+    exact live state, no torn records, no invariant trips."""
+    cluster, registry, predicate, bind, status = fresh_stack(n_nodes=4)
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    nodes = [f"node-{i}" for i in range(4)]
+    errs = []
+
+    def churn(t):
+        for i in range(25):
+            pod = tpu_pod(f"s{t}-{i}", core=40, hbm=1)
+            cluster.create_pod(pod)
+            try:
+                filt = predicate.handle(
+                    ExtenderArgs(pod=pod, node_names=nodes)
+                )
+                if filt.error or not filt.node_names:
+                    continue
+                res = bind.handle(
+                    ExtenderBindingArgs(
+                        pod_name=pod.metadata.name,
+                        pod_namespace=pod.metadata.namespace,
+                        pod_uid=pod.metadata.uid,
+                        node=filt.node_names[0],
+                    )
+                )
+                if res.error:
+                    continue
+                if i % 2 == 0:
+                    sched.forget_pod(pod)
+            except Exception as e:  # pragma: no cover
+                errs.append(str(e))
+
+    threads = [threading.Thread(target=churn, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    assert events, "stress journaled nothing"
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert diff_live(res, status()) == []
+
+
+def test_what_if_replay_scores_alternative_rater(journal_dir):
+    from elastic_gpu_scheduler_tpu.core.rater import get_rater
+
+    cluster, registry, predicate, bind, status = fresh_stack()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    for i in range(4):
+        p = tpu_pod(f"w{i}", core=100)
+        cluster.create_pod(p)
+        sched.bind("node-0" if i < 2 else "node-1", p)
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    out = what_if(events, get_rater("spread"))
+    assert out["binds"] == 4 and out["unplaced"] == 0
+    assert out["placed"] == 4
+    assert out["mean_score"] > 0
+
+
+# -- HTTP surface + gauges ---------------------------------------------------
+
+
+def test_debug_journal_endpoint_and_audit_json(journal_dir):
+    cluster, registry, predicate, bind, status = fresh_stack()
+    p = tpu_pod("webpod", core=100)
+    cluster.create_pod(p)
+    filt = predicate.handle(
+        ExtenderArgs(pod=p, node_names=["node-0", "node-1"])
+    )
+    res = bind.handle(
+        ExtenderBindingArgs(
+            pod_name="webpod", pod_namespace="default",
+            pod_uid=p.metadata.uid, node=filt.node_names[0],
+        )
+    )
+    assert not res.error
+    assert JOURNAL.flush()
+    server = ExtenderServer(
+        predicate, None, bind, status, host="127.0.0.1", port=0
+    )
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/journal?n=10", timeout=10
+        ) as r:
+            st = json.loads(r.read())
+        assert st["enabled"] and st["appended"] >= 2
+        assert st["written"] == st["appended"]
+        assert st["segments"] and st["tail"]
+        assert any(rec["type"] == "bind" for rec in st["tail"])
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/schedule/default/webpod"
+            "?format=json",
+            timeout=10,
+        ) as r:
+            audit = json.loads(r.read())
+        assert audit["pod"] == "default/webpod"
+        assert audit["journal"]["enabled"]
+        assert audit["journal"]["seqs"], "bind seq missing from audit json"
+        stages = [rec["stage"] for rec in audit["records"]]
+        assert "filter" in stages and "bind" in stages
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/schedule/default/webpod",
+            timeout=10,
+        ) as r:
+            text = r.read().decode()
+        assert "journal seqs" in text
+    finally:
+        server.stop()
+
+
+def test_fragmentation_math_and_gauges(journal_dir):
+    # pure math first: 2x2 mesh, 3 free chips in an L → largest box is 2
+    topo = Topology((2, 2))
+    cs = ChipSet(topo, [Chip(coord=c, hbm_total=16) for c in topo.coords()])
+    frag, largest, free_n = cs.fragmentation()
+    assert (frag, largest, free_n) == (0.0, 4, 4)
+    cs.chips[(0, 0)].take_whole()
+    frag, largest, free_n = cs.fragmentation()
+    assert free_n == 3 and largest == 2
+    assert frag == pytest.approx(1 - 2 / 3, abs=1e-3)
+    # full node → defined as 0
+    for c in topo.coords():
+        if cs.chips[c].is_free:
+            cs.chips[c].take_whole()
+    assert cs.fragmentation() == (0.0, 0, 0)
+
+    # gauges refresh at SCRAPE time (LazyGauge), never on the bind path
+    from elastic_gpu_scheduler_tpu.metrics import (
+        FRAG_INDEX,
+        FREE_SUBMESH,
+        REGISTRY,
+    )
+
+    cluster, registry, predicate, bind, status = fresh_stack(n_nodes=1)
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    p = tpu_pod("fragpod", core=100)
+    cluster.create_pod(p)
+    sched.bind("node-0", p)
+    REGISTRY.expose()  # the scrape runs the registered refresher
+    assert ("node-0",) in FRAG_INDEX._values
+    assert FREE_SUBMESH._values[("node-0",)] == 3.0
+    # and the same numbers come out of offline replay at this checkpoint
+    assert JOURNAL.flush()
+    res = replay(read_journal(journal_dir))
+    assert res.summary()["fragmentation"]["node-0"]["free_chips"] == 3
+
+
+def test_restart_replay_binds_are_idempotent(tmp_path):
+    """A scheduler restart re-journals node_add + every surviving pod as a
+    source=replay bind; offline replay must treat those as re-assertions,
+    not double-bind violations (the node_add already re-charged them)."""
+    d = str(tmp_path / "j")
+    cluster, registry, predicate, bind, status = fresh_stack()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    JOURNAL.configure(d, fsync="off")
+    try:
+        p = tpu_pod("survivor", core=100)
+        cluster.create_pod(p)
+        sched.bind("node-0", p)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    # "restart": a fresh engine rebuilds from the annotation ledger with
+    # the SAME journal dir (seq numbering resumes)
+    JOURNAL.configure(d, fsync="off")
+    try:
+        config_cs = sched.clientset
+        from elastic_gpu_scheduler_tpu.scheduler.scheduler import (
+            SchedulerConfig,
+            TPUUnitScheduler,
+        )
+
+        sched2 = TPUUnitScheduler(
+            SchedulerConfig(clientset=config_cs, rater=sched.rater)
+        )
+        assert sched2.known_pod(p)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    events = read_journal(d)
+    sources = [e.get("source") for e in events if e["type"] == "bind"]
+    assert "replay" in sources  # the restart really re-journaled the pod
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert list(res.pods) == ["default/survivor"]
+    assert diff_live(res, sched2.status()) == []
+    # but a DIFFERENT placement for an already-live pod is still flagged
+    forged = dict(events[-1])
+    forged["seq"] = events[-1]["seq"] + 1
+    forged["option"] = json.loads(json.dumps(forged["option"]))
+    forged["option"]["allocs"][0][1] = [[3]]  # moved to another chip
+    res2 = replay(events + [forged])
+    assert any("different placement" in v for v in res2.violations)
+
+
+def test_reset_resync_replays_without_recharge():
+    """A layout-change resync wipes chip usage live while the scheduler
+    ledger keeps the pod — replay must mirror both halves."""
+    node_add = {
+        "seq": 0, "type": "node_add", "node": "n0",
+        "dims": [4], "wrap": [False],
+        "chips": [[[i], 100, 16] for i in range(4)],
+    }
+    bind_rec = {
+        "seq": 1, "type": "bind", "pod": "ns/a", "node": "n0",
+        "option": {
+            "hash": "a", "score": 0.0,
+            "allocs": [["main", [[0], [1]], True, 0, 0, True]],
+        },
+    }
+    resync = {
+        "seq": 2, "type": "node_resync", "node": "n0", "reset": True,
+        "dims": [8], "wrap": [False],
+        "chips": [[[i], 100, 16] for i in range(8)],
+    }
+    forget = {"seq": 3, "type": "forget", "pod": "ns/a", "node": "n0"}
+    res = replay([node_add, bind_rec, resync])
+    assert not res.violations, res.violations
+    assert "ns/a" in res.pods  # still in the ledger...
+    cs = res.nodes["n0"]
+    assert cs.avail_core() == cs.total_core()  # ...but charging nothing
+    # a later forget of the uncharged pod frees nothing and trips nothing
+    res2 = replay([node_add, bind_rec, resync, forget])
+    assert not res2.violations, res2.violations
+    assert not res2.pods
+
+
+def test_writer_survives_io_failure_and_counts_loss(tmp_path):
+    """A poisoned file handle (disk full / dir gone) must not kill the
+    writer thread: the batch is counted as lost, the handle re-opens, and
+    later records still land."""
+    d = str(tmp_path / "j")
+    JOURNAL.configure(d, fsync="off")
+    try:
+        JOURNAL.record("bind", pod="ns/a", node="n0")
+        assert JOURNAL.flush()
+        JOURNAL._fh.close()  # poison: next write raises ValueError
+        JOURNAL.record("bind", pod="ns/b", node="n0")
+        # the writer stays alive, but flush must SURFACE the loss — it is
+        # the durability barrier callers trust before reading files back
+        assert JOURNAL.flush() is False
+        JOURNAL.record("bind", pod="ns/c", node="n0")
+        assert JOURNAL.flush()  # recovered: no loss in this window
+        state = JOURNAL.debug_state()
+        assert state["io_errors"] >= 1
+        assert state["io_lost_records"] >= 1
+    finally:
+        JOURNAL.close()
+    pods = [r["pod"] for r in read_journal(d)]
+    assert "ns/a" in pods and "ns/c" in pods  # recovered after the failure
+
+
+def test_pruned_prefix_boots_from_segment_checkpoint(tmp_path):
+    """Rotated segments carry a head checkpoint: dropping the oldest
+    segments (pruning) must leave a journal that still replays to the
+    exact live state."""
+    d = str(tmp_path / "j")
+    JOURNAL.configure(d, fsync="off", max_segment_bytes=2048)
+    try:
+        cluster, registry, predicate, bind, status = fresh_stack(n_nodes=4)
+        sched = registry[consts.RESOURCE_TPU_CORE]
+        for i in range(30):
+            p = tpu_pod(f"cp-{i}", core=40, hbm=1)
+            cluster.create_pod(p)
+            filt = predicate.handle(
+                ExtenderArgs(pod=p, node_names=[f"node-{j}" for j in range(4)])
+            )
+            if not filt.node_names:
+                continue
+            sched.bind(filt.node_names[0], p)
+            if i % 3 == 0:
+                sched.forget_pod(p)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    segs = segment_paths(d)
+    assert len(segs) >= 3
+    os.unlink(segs[0])  # prune the oldest segment
+    events = read_journal(d)
+    assert events[0]["type"] == "checkpoint"
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert diff_live(res, status()) == []
+    # without the checkpoint a pruned prefix is a LOUD failure, not
+    # garbage state: strip checkpoints and expect the named violation
+    res2 = replay([e for e in events if e["type"] != "checkpoint"])
+    assert any("no checkpoint" in v for v in res2.violations)
+
+
+def test_configure_survives_checkpoint_only_tail_segment(tmp_path):
+    """A rotation can leave a trailing segment whose only line is the
+    (seq-less) head checkpoint; reopening the journal must resume seq
+    numbering from the last SEQ-BEARING record, not crash."""
+    from elastic_gpu_scheduler_tpu.journal import _encode
+
+    d = str(tmp_path / "j")
+    JOURNAL.configure(d, fsync="off")
+    JOURNAL.record("bind", pod="ns/a", node="n0")
+    assert JOURNAL.flush()
+    JOURNAL.close()
+    with open(os.path.join(d, "journal-000002.log"), "wb") as f:
+        f.write(_encode(
+            {"type": "checkpoint", "as_of_seq": 0, "nodes": {}, "pods": []}
+        ))
+    JOURNAL.configure(d, fsync="off")  # must not KeyError
+    try:
+        assert JOURNAL.record("bind", pod="ns/b", node="n0") == 1
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+
+
+def test_restart_segment_gets_boot_checkpoint(tmp_path):
+    """The fresh segment a RESUMED journal opens carries a boot checkpoint
+    (written with the first batch), so pruning across a restart boundary
+    keeps the journal replayable."""
+    d = str(tmp_path / "j")
+    cluster, registry, predicate, bind, status = fresh_stack()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    JOURNAL.configure(d, fsync="off")
+    try:
+        p = tpu_pod("cpod", core=100)
+        cluster.create_pod(p)
+        sched.bind("node-0", p)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    JOURNAL.configure(d, fsync="off")  # restart: resumes seq > 0
+    try:
+        from elastic_gpu_scheduler_tpu.scheduler.scheduler import (
+            SchedulerConfig,
+            TPUUnitScheduler,
+        )
+
+        sched2 = TPUUnitScheduler(
+            SchedulerConfig(clientset=sched.clientset, rater=sched.rater)
+        )
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    segs = segment_paths(d)
+    assert len(segs) >= 2
+    os.unlink(segs[0])  # prune the pre-restart history
+    events = read_journal(d)
+    assert events and events[0]["type"] == "checkpoint"
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert diff_live(res, sched2.status()) == []
+
+
+def test_journal_disabled_is_noop():
+    j = Journal()
+    assert j.record("bind", pod="x") is None
+    assert not j.enabled
+    assert j.pod_seqs("x") == []
+    assert j.debug_state()["enabled"] is False

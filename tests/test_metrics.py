@@ -1,13 +1,22 @@
-"""Metrics primitives: TimedLock wait accounting and Histogram summary
-exactness — the properties the /metrics and /debug/pprof/mutex surfaces
-depend on, pinned directly.
+"""Metrics primitives: TimedLock wait accounting, Histogram summary
+exactness, Prometheus text-exposition conformance (promtool-style lint
+over a full live /metrics scrape), and the dropped-sample counters —
+the properties the /metrics and /debug/pprof/mutex surfaces depend on,
+pinned directly.
 """
 
+import re
 import threading
 import time
+import urllib.request
 
 from elastic_gpu_scheduler_tpu.metrics import (
     LOCK_WAIT,
+    METRICS_DROPPED,
+    _ORPHAN_DROPPED,
+    _ORPHAN_WAITS,
+    _WAITS_CAP,
+    _flush_orphan,
     Histogram,
     TimedLock,
 )
@@ -55,6 +64,313 @@ def test_timedlock_measures_contended_wait():
     lock.release()
     t.join()
     assert max(LOCK_WAIT.samples("t-contend")) >= 0.04
+
+
+# -- Prometheus text-format conformance -------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$"
+)
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n])*)"')
+_VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def lint_prometheus_text(text):
+    """promtool-style strict lint of a text exposition.  Returns a list
+    of problems (empty = conformant) plus the parsed samples, so tests
+    can make semantic assertions on top."""
+    problems = []
+    families = {}  # name -> type
+    current = None  # family name whose sample block we are inside
+    samples = []  # (family, sample_name, labels dict, value)
+    helps = set()
+    for ln, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) != 4 or not _NAME_RE.match(parts[2]) or not parts[3]:
+                problems.append(f"line {ln}: malformed HELP: {line!r}")
+                continue
+            if parts[2] in helps:
+                problems.append(f"line {ln}: duplicate HELP for {parts[2]}")
+            helps.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or not _NAME_RE.match(parts[2]) or parts[
+                3
+            ] not in _VALID_TYPES:
+                problems.append(f"line {ln}: malformed TYPE: {line!r}")
+                continue
+            if parts[2] in families:
+                problems.append(f"line {ln}: duplicate TYPE for {parts[2]}")
+            families[parts[2]] = parts[3]
+            current = parts[2]
+            continue
+        if line.startswith("#"):
+            continue  # free comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {ln}: unparseable sample: {line!r}")
+            continue
+        name, rawlabels, rawvalue = m.groups()
+        labels = {}
+        if rawlabels is not None:
+            pairs = _LABEL_PAIR_RE.findall(rawlabels)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in pairs)
+            if rebuilt != rawlabels:
+                problems.append(
+                    f"line {ln}: malformed label block {{{rawlabels}}}"
+                )
+                continue
+            for k, v in pairs:
+                if not _LABEL_RE.match(k):
+                    problems.append(f"line {ln}: bad label name {k!r}")
+                if k in labels:
+                    problems.append(f"line {ln}: duplicate label {k!r}")
+                labels[k] = v
+        try:
+            value = float(rawvalue)
+        except ValueError:
+            problems.append(f"line {ln}: bad sample value {rawvalue!r}")
+            continue
+        if current is None:
+            problems.append(f"line {ln}: sample before any TYPE: {line!r}")
+            continue
+        fam_type = families[current]
+        allowed = {current}
+        if fam_type == "histogram":
+            allowed = {current + "_bucket", current + "_sum",
+                       current + "_count"}
+        elif fam_type == "summary":
+            allowed = {current, current + "_sum", current + "_count"}
+        if name not in allowed:
+            problems.append(
+                f"line {ln}: sample {name!r} outside its family block "
+                f"({current!r}, type {fam_type})"
+            )
+            continue
+        samples.append((current, name, labels, value))
+
+    # histogram semantics: per label set (minus le) — ascending-le buckets
+    # with non-decreasing counts, a +Inf bucket, _sum and _count present,
+    # and _count == the +Inf bucket value
+    for fam, ftype in families.items():
+        if ftype == "counter":
+            for f, _name, labels, value in samples:
+                if f == fam and value < 0:
+                    problems.append(f"{fam}{labels}: negative counter")
+        if ftype != "histogram":
+            continue
+        series = {}
+        for f, name, labels, value in samples:
+            if f != fam:
+                continue
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if name == fam + "_bucket":
+                if "le" not in labels:
+                    problems.append(f"{fam}{key}: bucket without le")
+                    continue
+                le = (
+                    float("inf") if labels["le"] == "+Inf"
+                    else float(labels["le"])
+                )
+                entry["buckets"].append((le, value))
+            elif name == fam + "_sum":
+                entry["sum"] = value
+            elif name == fam + "_count":
+                entry["count"] = value
+        for key, entry in series.items():
+            buckets = entry["buckets"]
+            if not buckets or buckets[-1][0] != float("inf"):
+                problems.append(f"{fam}{dict(key)}: missing +Inf bucket")
+                continue
+            les = [b[0] for b in buckets]
+            if les != sorted(les):
+                problems.append(f"{fam}{dict(key)}: le values not ascending")
+            counts = [b[1] for b in buckets]
+            if any(b > a for a, b in zip(counts[1:], counts)):
+                problems.append(
+                    f"{fam}{dict(key)}: bucket counts decrease: {counts}"
+                )
+            if entry["sum"] is None:
+                problems.append(f"{fam}{dict(key)}: missing _sum")
+            if entry["count"] is None:
+                problems.append(f"{fam}{dict(key)}: missing _count")
+            elif entry["count"] != buckets[-1][1]:
+                problems.append(
+                    f"{fam}{dict(key)}: _count {entry['count']} != +Inf "
+                    f"bucket {buckets[-1][1]}"
+                )
+    return problems, samples, families
+
+
+def test_histogram_collect_emits_inf_sum_count_per_label_set():
+    h = Histogram("conf_h", "help text", ("verb",), buckets=(0.1, 1.0))
+    h.observe("a", value=0.05)
+    h.observe("a", value=5.0)
+    h.observe("b", value=0.5)
+    text = "\n".join(h.collect()) + "\n"
+    problems, samples, families = lint_prometheus_text(text)
+    assert not problems, problems
+    for label in ("a", "b"):
+        names = {
+            name for _f, name, labels, _v in samples
+            if labels.get("verb") == label
+        }
+        assert names == {"conf_h_bucket", "conf_h_sum", "conf_h_count"}
+        infs = [
+            v for _f, name, labels, v in samples
+            if name == "conf_h_bucket" and labels.get("verb") == label
+            and labels.get("le") == "+Inf"
+        ]
+        assert len(infs) == 1
+
+
+def test_metrics_exposition_conformance_live_scrape():
+    """Strict lint over a FULL live /metrics scrape, with the verb
+    histograms populated through the real HTTP stack first."""
+    import json
+
+    from elastic_gpu_scheduler_tpu.cli import build_stack
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+    from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+    from elastic_gpu_scheduler_tpu.k8s.objects import (
+        Container,
+        ResourceRequirements,
+        make_pod,
+        make_tpu_node,
+    )
+    from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+    from elastic_gpu_scheduler_tpu.utils import consts
+
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="binpack")
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0
+    )
+    port = server.start()
+    try:
+        pod = make_pod(
+            "mpod",
+            containers=[
+                Container(
+                    name="main",
+                    resources=ResourceRequirements(
+                        limits={consts.RESOURCE_TPU_CORE: 100}
+                    ),
+                )
+            ],
+        )
+        cluster.create_pod(pod)
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        filt = post(
+            "/scheduler/filter",
+            {"Pod": pod.to_dict(), "NodeNames": ["node-0", "node-1"]},
+        )
+        assert filt.get("NodeNames"), filt
+        post(
+            "/scheduler/priorities",
+            {"Pod": pod.to_dict(), "NodeNames": filt["NodeNames"]},
+        )
+        res = post(
+            "/scheduler/bind",
+            {
+                "PodName": "mpod", "PodNamespace": "default",
+                "PodUID": pod.metadata.uid, "Node": filt["NodeNames"][0],
+            },
+        )
+        assert not res.get("Error"), res
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+    finally:
+        server.stop()
+
+    problems, samples, families = lint_prometheus_text(text)
+    assert not problems, problems
+    # the verb histogram really was exercised through the live stack
+    verb_counts = {
+        labels.get("verb"): v
+        for _f, name, labels, v in samples
+        if name == "tpu_scheduler_verb_duration_seconds_count"
+    }
+    for verb in ("filter", "priorities", "bind"):
+        assert verb_counts.get(verb, 0) >= 1, verb_counts
+    assert families["tpu_scheduler_verb_duration_seconds"] == "histogram"
+    assert families["tpu_scheduler_verb_total"] == "counter"
+    assert families["tpu_scheduler_chips_core_allocated"] == "gauge"
+    assert "tpu_metrics_dropped_samples_total" in families
+
+
+# -- dropped-sample accounting ----------------------------------------------
+
+
+def _dropped_value(reason):
+    with METRICS_DROPPED._lock:
+        return METRICS_DROPPED._values.get((reason,), 0.0)
+
+
+def test_waits_cap_trim_counts_dropped_samples():
+    """The over-cap trim of an unscraped TimedLock's wait buffer must be
+    COUNTED, not silent."""
+    lock = TimedLock("t-trimcount")
+    before = _dropped_value("waits_cap")
+    # pre-fill the buffer to just under the cap (appends are exactly what
+    # acquire does), then push it over with real acquires
+    lock._waits.extend(0.0 for _ in range(_WAITS_CAP))
+    with lock:
+        pass
+    assert _dropped_value("waits_cap") == before + _WAITS_CAP // 2
+    assert len(lock._waits) <= _WAITS_CAP // 2 + 2
+
+
+def test_orphan_cap_drop_counts_dropped_samples():
+    """_flush_orphan past the 4096-entry parking cap must count the loss
+    (folded in on the next drain — the finalizer itself may take no
+    locks)."""
+    filler = ("x-filler", [0.0])
+    added = 0
+    while len(_ORPHAN_WAITS) < 4096:
+        _ORPHAN_WAITS.append(filler)
+        added += 1
+    try:
+        before_list = len(_ORPHAN_DROPPED)
+        _flush_orphan("t-orphan-drop", [0.001, 0.002, 0.003])
+        assert len(_ORPHAN_DROPPED) == before_list + 1
+        before = _dropped_value("orphan_cap")
+        LOCK_WAIT.summary()  # any read API drains → folds the drop count
+        assert _dropped_value("orphan_cap") >= before + 3
+        assert not _ORPHAN_DROPPED
+    finally:
+        # drain whatever filler is left so later tests see a clean list
+        LOCK_WAIT.summary()
 
 
 def test_histogram_summary_exact_counts_after_sample_trim():
