@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-serve-overlap proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -44,6 +44,15 @@ check-plan-budget:
 # regresses past JOURNAL_OVERHEAD_BUDGET_PCT (default 5%).
 check-journal:
 	python tools/check_journal.py
+
+# Overlapped-decode gate: randomized request soak through the serving
+# engine with overlap off then on; hard-fails on any token/logprob parity
+# break, on steady-state decode steps that re-upload unchanged batch
+# state, or when the host gap between chunk dispatches doesn't shrink
+# with overlap on.  Run after any change near models/serving.py's step
+# loop or server/inference.py's stream path.
+check-serve-overlap:
+	JAX_PLATFORMS=cpu python tools/check_serve_overlap.py
 
 # Probe the TPU relay all round; capture + commit a green on-chip artifact
 # (BENCH_TPU_validation.json) the moment it comes up (VERDICT r3 Next #1).

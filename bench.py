@@ -350,6 +350,51 @@ def plan_microbench(trials: int = 5) -> list:
     return plan_trials_ms
 
 
+# Per-box plan-budget calibration: BENCH_r05 tripped the 135ms budget at
+# 170ms on a cgroup-throttled CI box while the SAME tree planned in 58-62ms
+# on the dev box — the budget was dev-box-tuned, the box was just slow.
+# The reference loop below is a fixed pure-CPU workload (dict churn +
+# sorted + small numpy passes — the plan path's work profile in
+# miniature); its min-of-trials on a healthy dev-class box is
+# PLAN_REF_BASELINE_MS.  A box whose reference min comes out N× slower
+# gets its plan budget scaled by N (never below the base), so throttled
+# CI boxes stop tripping a threshold tuned for faster hardware.  The
+# trials trick mirrors check_journal: callers interleave reference and
+# plan trials so a throttling storm spanning adjacent trials hits both
+# measurements equally, and min-of-trials drops the storms entirely.
+PLAN_REF_BASELINE_MS = float(os.environ.get("PLAN_REF_BASELINE_MS", "20"))
+
+
+def plan_reference_trial_ms() -> float:
+    """ONE trial of the fixed CPU reference loop (~20ms on a healthy
+    box).  Deterministic: no RNG, no IO, no allocator-dependent sizes."""
+    import numpy as np
+
+    t0 = time.perf_counter()
+    acc = 0
+    for it in range(240):
+        d = {}
+        for j in range(256):
+            d[(j, it & 7)] = (j * 2654435761) & 0xFFFF
+        acc += sum(sorted(d.values())[:8])
+        a = np.arange(4096, dtype=np.int64)
+        a = (a * 1103515245 + 12345 + it) & 0xFFFF
+        acc += int(a.argmax()) + int(a[::7].sum())
+    assert acc >= 0  # keep the loop un-elidable
+    return (time.perf_counter() - t0) * 1000
+
+
+def calibrated_plan_budget(
+    base_budget_ms: float, ref_trials_ms: list
+) -> tuple:
+    """(budget_ms, ref_min_ms, scale): the plan budget scaled by this
+    box's measured slowdown vs the dev-class baseline, floored at the
+    base (a faster box must not TIGHTEN the budget into noise)."""
+    ref_min = min(ref_trials_ms)
+    scale = max(1.0, ref_min / PLAN_REF_BASELINE_MS)
+    return base_budget_ms * scale, ref_min, scale
+
+
 def journal_overhead_bench(chunks: int = 40, chunk_n: int = 40) -> dict:
     """Per-bind latency with the scheduling flight recorder off vs on.
 
@@ -498,6 +543,9 @@ def tpu_section_table():
     return {
         "model": int(os.environ.get("BENCH_SECTION_TIMEOUT_MODEL", "900")),
         "serve": int(os.environ.get("BENCH_SECTION_TIMEOUT_SERVE", "900")),
+        "serveoverlap": int(
+            os.environ.get("BENCH_SECTION_TIMEOUT_SERVEOVERLAP", "900")
+        ),
         "model1b": int(os.environ.get("BENCH_SECTION_TIMEOUT_1B", "1800")),
         "flash32k": int(os.environ.get("BENCH_SECTION_TIMEOUT_32K", "600")),
         "pagedattn": int(os.environ.get("BENCH_SECTION_TIMEOUT_PAGED", "600")),
@@ -607,6 +655,17 @@ def model_bench_on_tpu():
         err = detail
         if "NOT_TPU:" in detail:
             return {"tpu_model_bench_error": err}
+        if "timed out" in detail:
+            # relay-down fail-fast (BENCH_r05 burned ~12 min on
+            # 4×(120s probe timeout + 60s sleep)): a TIMED-OUT probe
+            # means the relay is down, not flaky — a refused/errored
+            # connection fails in seconds and is worth retrying, but
+            # retrying a 120s hang just multiplies the hang
+            print(
+                f"# tpu probe timed out ({detail}); relay down — "
+                "skipping remaining probe attempts", file=_sys.stderr,
+            )
+            return {"tpu_model_bench_error": err, "tpu_relay_down": True}
         if i < attempts - 1:
             print(
                 f"# tpu probe attempt {i + 1}/{attempts} failed ({err}); "
@@ -621,7 +680,14 @@ def model_bench_on_tpu():
     if chosen:
         sections = {k: v for k, v in sections.items() if k in chosen.split(",")}
     out = {}
+    relay_down = False
     for name, timeout in sections.items():
+        if relay_down:
+            # the relay dropped mid-run: every remaining section would
+            # burn its full subprocess timeout reaching the same dead
+            # relay — carry the down state instead of rediscovering it
+            out[f"tpu_{name}_error"] = "skipped: relay went down mid-run"
+            continue
         res = run_tpu_section(name, timeout)
         if f"tpu_{name}_error" in res and not res.get(
             f"tpu_{name}_timed_out"
@@ -630,6 +696,18 @@ def model_bench_on_tpu():
             # deterministically slow — rerunning doubles the wasted wall
             res = run_tpu_section(name, timeout)
         out.update(res)
+        if res.get(f"tpu_{name}_timed_out"):
+            # a section timeout is ambiguous (slow section vs dead
+            # relay): disambiguate with ONE cheap re-probe before
+            # spending the remaining sections' timeouts
+            up, _detail = probe_tpu(timeout=30)
+            if not up:
+                relay_down = True
+                out["tpu_relay_down"] = True
+                print(
+                    f"# relay unreachable after section {name!r}; "
+                    "skipping remaining sections", file=_sys.stderr,
+                )
     return out
 
 
@@ -939,6 +1017,116 @@ def _tpu_section_serve():
     kern_s = _time.perf_counter() - t0
     out["tpu_serve_kernel_tokens_per_s"] = round(n_tok3 / kern_s, 1)
     return out
+
+
+def _tpu_section_serveoverlap():
+    """Overlapped decode pipeline: the engine's double-buffered chunk
+    dispatch (device-resident batch state + async drain) vs the exact
+    sequential loop, same workload — reports the host gap between
+    consecutive chunk dispatches for both modes and the throughput
+    ratio.  Also runs on CPU (BENCH_ALLOW_CPU=1): main() invokes it that
+    way so serve_host_gap_ms lands in every BENCH artifact, relay up or
+    down."""
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+
+    cfg = _bench_cfg(allow_cpu)
+    V = cfg.vocab_size
+    params = init_params(jax.random.key(0), cfg)
+    import numpy as _np
+
+    lens = [16, 24, 40, 12] if allow_cpu else [64, 128, 256, 512] * 2
+    rng = jax.random.key(23)
+    prompt_sets = [
+        _np.asarray(
+            jax.random.randint(jax.random.fold_in(rng, i), (L,), 0, V)
+        ).tolist()
+        for i, L in enumerate(lens)
+    ]
+    new_toks = 24 if allow_cpu else 64
+
+    def run(overlap):
+        eng = InferenceEngine(
+            cfg=cfg, params=params, max_batch=8, max_len=640,
+            page_size=64, fused_steps=8 if allow_cpu else 32,
+            overlap=overlap,
+        )
+
+        def batch():
+            reqs = [
+                eng.submit(Request(prompt=list(p), max_new_tokens=new_toks))
+                for p in prompt_sets
+            ]
+            eng.run_until_idle(max_steps=100_000)
+            bad = [r.error for r in reqs if not r.done.is_set() or r.error]
+            assert not bad, f"serveoverlap requests failed: {bad[:3]}"
+            return sum(len(r.output) for r in reqs), [r.output for r in reqs]
+
+        batch()  # warm-up: compiles
+        # reset gap counters so only the steady-state batch is measured
+        eng.host_gap_ns = 0
+        eng.host_gap_chunks = 0
+        t0 = _time.perf_counter()
+        n_tok, outs = batch()
+        wall = _time.perf_counter() - t0
+        gap = eng.host_gap_stats()
+        del eng
+        return n_tok / wall, gap["mean_ms"], outs
+
+    off_tps, off_gap, off_outs = run(False)
+    on_tps, on_gap, on_outs = run(True)
+    assert on_outs == off_outs, "overlap parity violated in bench workload"
+    out = {
+        # the acceptance-criteria keys: unprefixed from the CPU run
+        # (which lands in every artifact), tpu_-namespaced on-chip like
+        # every other TPU section — otherwise a relay-up run would
+        # silently clobber the CPU numbers with hardware-different ones
+        # and key provenance would depend on relay state
+        "serve_host_gap_ms": round(on_gap, 3),
+        "serve_host_gap_off_ms": round(off_gap, 3),
+        "serve_overlap_speedup": round(on_tps / max(off_tps, 1e-9), 3),
+        "serve_overlap_tokens_per_s": round(on_tps, 1),
+        "serve_overlap_off_tokens_per_s": round(off_tps, 1),
+    }
+    if allow_cpu:
+        return out
+    return {f"tpu_{k}": v for k, v in out.items()}
+
+
+def serve_overlap_bench_cpu(timeout: int = 900) -> dict:
+    """Run the serveoverlap section in a CPU subprocess so the BENCH
+    artifact always carries serve_host_gap_ms / serve_overlap_speedup,
+    TPU relay up or down (the section itself also runs on-chip via the
+    normal --tpu-section orchestration)."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["BENCH_ALLOW_CPU"] = "1"
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, "--tpu-section=serveoverlap"],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"serve_overlap_error": f"timed out after {timeout}s"}
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        return {"serve_overlap_error": str(e)[:300]}
+    if p.returncode != 0:
+        return {
+            "serve_overlap_error": p.stderr.decode(errors="replace")[-300:]
+        }
+    try:
+        return json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"serve_overlap_error": f"unparseable output: {e}"}
 
 
 def _tpu_section_longserve():
@@ -1311,6 +1499,7 @@ def _tpu_section_pagedattn():
 _TPU_SECTIONS = {
     "model": _tpu_section_model,
     "serve": _tpu_section_serve,
+    "serveoverlap": _tpu_section_serveoverlap,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
     "pagedattn": _tpu_section_pagedattn,
@@ -1426,7 +1615,14 @@ def main():
     # (a reused coordinator would answer later filters from the cached
     # plan); min is the metric, median+trials record the spread so
     # artifact readers can see the noise without bench.py archaeology.
-    plan_trials_ms = plan_microbench(trials=5)
+    # reference and plan trials INTERLEAVED (the check_journal pooling
+    # trick): a cgroup-throttling storm spanning adjacent trials slows
+    # both measurements, so the calibration ratio cancels it
+    plan_trials_ms = []
+    ref_trials_ms = []
+    for _trial in range(5):
+        ref_trials_ms.append(plan_reference_trial_ms())
+        plan_trials_ms.extend(plan_microbench(trials=1))
     plan_ms = round(min(plan_trials_ms), 3)
     results["v5p2048_gang1024_plan_ms"] = plan_ms
     results["v5p2048_gang1024_plan_median_ms"] = round(
@@ -1440,15 +1636,25 @@ def main():
     # budget applies to the BEST-OF value — the code's cost, not the
     # noisiest schedule (the r05 false alarm).
     try:
-        budget_ms = float(os.environ.get("BENCH_PLAN_BUDGET_MS", "135"))
+        base_budget_ms = float(os.environ.get("BENCH_PLAN_BUDGET_MS", "135"))
     except ValueError:
-        budget_ms = 135.0  # loud-but-not-fatal: a bad override must not
-        # kill the bench after the expensive configs already ran
+        base_budget_ms = 135.0  # loud-but-not-fatal: a bad override must
+        # not kill the bench after the expensive configs already ran
+    # per-box self-calibration (BENCH_r05 false alarm: a throttled CI box
+    # tripping a dev-box-tuned threshold) — the budget scales with the
+    # measured CPU reference loop, never below the base
+    budget_ms, ref_min_ms, scale = calibrated_plan_budget(
+        base_budget_ms, ref_trials_ms
+    )
+    results["plan_budget_ms"] = round(budget_ms, 3)
+    results["plan_budget_ref_ms"] = round(ref_min_ms, 3)
+    results["plan_budget_scale"] = round(scale, 3)
     if plan_ms > budget_ms:
         results["v5p2048_gang1024_plan_over_budget"] = True
         print(
             f"# WARNING: 1024-member plan {plan_ms}ms exceeds "
-            f"{budget_ms}ms budget", file=sys.stderr,
+            f"{budget_ms:.0f}ms budget (base {base_budget_ms:.0f}ms × "
+            f"box scale {scale:.2f})", file=sys.stderr,
         )
 
     # flight-recorder cost: bind p99 with the journal on vs off (<5% is
@@ -1468,6 +1674,16 @@ def main():
             )
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["journal_overhead_error"] = str(e)[:300]
+
+    # overlapped decode pipeline: host gap + speedup vs the sequential
+    # loop, measured on CPU so the keys land in EVERY artifact (the same
+    # section also runs on-chip via the TPU orchestration below).
+    # Guarded like the journal bench: a crash must not take down the
+    # headline metrics.
+    try:
+        results.update(serve_overlap_bench_cpu())
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["serve_overlap_error"] = str(e)[:300]
 
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`

@@ -40,10 +40,12 @@ capability slot of a complete framework.
 from __future__ import annotations
 
 import functools
+import hashlib
 import itertools
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -819,11 +821,14 @@ def _fused_serve_chunk(
     logprobs_k=0, use_pen=False, use_seed=False, use_min=False,
 ):
     """``n_steps`` decode iterations in one scan; sampling AND prompt
-    feeding happen on-device.  Returns (sampled (B, n_steps), new caches);
-    with ``logprobs_k`` > 0 (a separately-compiled variant, chosen only
-    when some active request asked) the first element becomes
-    (sampled, chosen_lp (B, n_steps), top_ids (B, n_steps, k),
-    top_lps (B, n_steps, k)).
+    feeding happen on-device.  Returns (sampled (B, n_steps), new caches,
+    next_tokens (B,), new_lengths (B,)) — the final carry rides out so
+    the NEXT chunk can be dispatched straight off device state without
+    a host round trip (the overlapped pipeline threads these futures
+    from chunk to chunk); with ``logprobs_k`` > 0 (a separately-compiled
+    variant, chosen only when some active request asked) the first
+    element becomes (sampled, chosen_lp (B, n_steps), top_ids
+    (B, n_steps, k), top_lps (B, n_steps, k)).
 
     Step s feeds the token at position lengths+s and samples from its
     logits; the host decides afterwards which sampled entries are real
@@ -918,8 +923,8 @@ def _fused_serve_chunk(
         return (
             sampled.T, chosen_lp.T,
             jnp.moveaxis(top_ids, 0, 1), jnp.moveaxis(top_lps, 0, 1),
-        ), kv
-    return outs.T, kv  # (B, n_steps)
+        ), kv, carry[0], carry[1]
+    return outs.T, kv, carry[0], carry[1]  # (B, n_steps), kv, feed, len
 
 
 def _cached_attention_rows(q, cache_k, cache_v, starts, window=0):
@@ -1167,6 +1172,115 @@ def _draft_ingest_propose(dparams, dkv, feed, starts, counts, *, dcfg, k):
     return jnp.moveaxis(toks, 0, 1), dkv  # (B, k)
 
 
+class _DeviceBatchState:
+    """Per-field device mirrors of the host batch-state arrays.
+
+    The fused chunks consume ~10 per-slot arrays (temps, top_ks, tables
+    view, active mask, ...) that change only when admission, release, or
+    page growth actually touches the batch — yet the seed engine rebuilt
+    every one of them with ``jnp.asarray`` on EVERY dispatch.  This cache
+    keeps one persistent device array per field and refreshes it only
+    when the host copy has actually changed.
+
+    Dirtiness is detected by content (``np.array_equal`` against the
+    snapshot the device copy was built from) rather than by flags at
+    every mutation site: a missed flag would silently serve stale state,
+    while a comparison is self-correcting and costs nanoseconds on the
+    (B,)-sized arrays involved.  Big arrays (``prompts``) use an explicit
+    version counter instead (``get_versioned``), bumped at their single
+    mutation site.  ``uploads`` counts actual host→device refreshes —
+    the transfer probe tests/test_serve_overlap.py asserts it stays flat
+    across steady-state decode steps."""
+
+    def __init__(self):
+        self._dev: dict = {}
+        self._src: dict = {}
+        self._ver: dict = {}
+        self.uploads = 0  # host→device refreshes (transfer-count probe)
+
+    def get(self, name: str, host_arr: np.ndarray):
+        """Device array for ``host_arr``, re-uploaded only on change."""
+        src = self._src.get(name)
+        if (
+            src is None
+            or src.shape != host_arr.shape
+            or not np.array_equal(src, host_arr)
+        ):
+            self._dev[name] = jnp.asarray(host_arr)
+            self._src[name] = host_arr.copy()
+            self.uploads += 1
+        return self._dev[name]
+
+    def get_versioned(self, name: str, host_arr: np.ndarray, version: int):
+        """Like ``get`` but keyed by an explicit version counter — for
+        arrays too big to compare per dispatch (the (B, max_len) prompt
+        buffer, mutated only at admission)."""
+        if self._ver.get(name) != version:
+            self._dev[name] = jnp.asarray(host_arr)
+            self._ver[name] = version
+            self.uploads += 1
+        return self._dev[name]
+
+
+def _prefix_page_key(prev: bytes, toks: np.ndarray) -> bytes:
+    """One link of the prefix-cache key chain: a 16-byte BLAKE2b digest
+    over (previous link, this page's token bytes).  Replaces the seed's
+    nested-tuple hash chain — that built and hashed an O(page) tuple per
+    page per ADMISSION (O(prompt) total, on the host path the overlapped
+    pipeline is trying to empty); this is one incremental digest over the
+    raw int32 bytes.  Content-addressing is preserved exactly: equal
+    token prefixes (under the same adapter seed) produce equal digests,
+    and 128-bit digests make accidental collisions (which would alias
+    cached K/V) negligible."""
+    return hashlib.blake2b(
+        prev + toks.tobytes(), digest_size=16
+    ).digest()
+
+
+def _prefix_seed(adapter_id: int) -> bytes:
+    """Chain seed: K/V content depends on the adapter (wk/wv deltas), so
+    pages cached under one adapter must never match another's prompts."""
+    return b"lora:" + int(adapter_id).to_bytes(4, "little")
+
+
+def _bias_row_cached(req: "Request", vocab_size: int) -> np.ndarray:
+    """``_bias_row`` memoized on the request: admission needs the row
+    twice (device-resident slot row + the host-side prefill add) and a
+    spilled request re-admits with identical bias — one O(vocab) build
+    instead of up to four."""
+    row = getattr(req, "_bias_row_memo", None)
+    if row is None or row.shape[0] != vocab_size:
+        row = _bias_row(req, vocab_size)
+        req._bias_row_memo = row
+    return row
+
+
+def _stop_row_cached(req: "Request", vocab_size: int) -> np.ndarray:
+    """``_stop_row`` memoized on the request (same double-use as the
+    bias row)."""
+    row = getattr(req, "_stop_row_memo", None)
+    if row is None or row.shape[0] != vocab_size:
+        row = _stop_row(req, vocab_size)
+        req._stop_row_memo = row
+    return row
+
+
+@dataclass
+class _PendingChunk:
+    """An in-flight fused decode chunk: the device output futures plus
+    the host-side snapshot needed to drain it later.  ``pairs`` pins the
+    (slot, request) identity at dispatch time — a slot released or
+    re-tenanted before the drain (stop discovered late, spill, cancel)
+    is skipped, which is what makes the overlapped pipeline's bounded
+    one-chunk overshoot safe to discard."""
+
+    out: object  # device arrays: sampled (+ logprob triplet when want_lp)
+    want_lp: bool
+    n_steps: int
+    pos0: np.ndarray  # per-slot lengths BEFORE the chunk ran
+    pairs: list  # [(slot index, Request at dispatch time), ...]
+
+
 class InferenceEngine:
     """Paged-cache continuous batching with fused K-step decode chunks."""
 
@@ -1190,6 +1304,7 @@ class InferenceEngine:
         logprobs_k: int = 5,
         prefill_chunk: int = 0,
         max_queue: int = 0,
+        overlap: bool = True,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -1240,6 +1355,20 @@ class InferenceEngine:
         there, exactly as in training.  Host-side state (tables, lengths,
         prompts, prefix cache) is unsharded — the engine logic is
         identical single-chip and multi-chip.
+
+        ``overlap``: double-buffered chunk dispatch — chunk N+1 is
+        dispatched off device-resident state immediately after chunk N,
+        and N's sampled tokens drain (device→host) while N+1 runs, so
+        the accelerator never idles on host bookkeeping.  Host-side
+        stop/cancel/max-token detection lags one chunk; the engine
+        over-runs a finishing slot by at most ONE chunk and discards
+        those tokens at drain time.  Greedy and seeded-sampled outputs
+        are bit-identical to ``overlap=False`` (the correctness mode:
+        ``--serve-overlap=off``); unseeded sampled requests may diverge
+        after another request's completion because overshoot chunks
+        advance the engine RNG stream.  Batches with frequency/presence
+        penalties fall back to the non-overlapped loop automatically
+        (their cross-chunk counts are host-rebuilt).
         """
         self.mesh = mesh
         self.params = (
@@ -1353,6 +1482,37 @@ class InferenceEngine:
         # front end turns this into 503s + a not-ready /healthz so the
         # Service stops routing here before the pod exits
         self.draining = False
+        # work signal: set whenever a request is enqueued so an idle
+        # EngineLoop can park on it instead of busy-polling every 2 ms
+        # (server/inference.py; stop/drain set it too, to wake the loop)
+        self._work = threading.Event()
+        # -- overlapped decode pipeline state --------------------------------
+        self.overlap = overlap
+        # device-resident batch state: persistent device mirrors of the
+        # per-slot host arrays, refreshed only when the batch changes
+        self._ds = _DeviceBatchState()
+        self._prompts_version = 0  # bumped by _admit (prompts row writes)
+        # in-flight carry: (next_tokens, lengths) device futures returned
+        # by the last fused chunk — the next chunk dispatches straight
+        # off them (no host round trip).  ``_carry_dirty`` lists slots
+        # whose host lengths/next_token were mutated outside the chunk
+        # (admission, prefill); those rows are patched device-side at the
+        # next dispatch.  None → rebuild from host (mode switch, verify).
+        self._carry = None
+        self._carry_dirty: set[int] = set()
+        self._pending: Optional[_PendingChunk] = None  # undrained chunk
+        # host-gap telemetry: the host-imposed device-idle window between
+        # consecutive decode chunks — from the previous chunk's results
+        # landing on the host (drain transfer done) to the next dispatch
+        # call.  When the next chunk was dispatched BEFORE the previous
+        # one drained (the overlapped pipeline's steady state) the device
+        # had queued work the whole time and the gap is zero by
+        # construction.  Reset by prefill/verify dispatches so only
+        # back-to-back decode chunks are measured.
+        self.host_gap_ns = 0
+        self.host_gap_chunks = 0
+        self.last_host_gap_ms = 0.0
+        self._last_drain_done: Optional[int] = None
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
@@ -1617,6 +1777,7 @@ class InferenceEngine:
                 priority=req.priority, resumed=bool(req.output),
             )
         self.queue.put((-req.priority, next(self._submit_seq), req))
+        self._work.set()  # wake a parked EngineLoop
 
     def queue_depths(self) -> dict[int, int]:
         """Queued requests per priority class (metrics/stats)."""
@@ -1626,6 +1787,27 @@ class InferenceEngine:
         for r in snapshot:
             out[r.priority] = out.get(r.priority, 0) + 1
         return out
+
+    @property
+    def device_uploads(self) -> int:
+        """Total host→device refreshes of batch state (mirror uploads +
+        carry rebuilds/patches) — the transfer-count probe.  Flat across
+        steady-state decode steps: unchanged state is never re-sent."""
+        return self._ds.uploads
+
+    def host_gap_stats(self) -> dict:
+        """Host-gap telemetry: wall time between consecutive fused decode
+        chunk dispatches (dispatch-return → next dispatch-call).  That
+        window is when the device can starve on host bookkeeping; the
+        overlapped pipeline exists to shrink it.  ``mean_ms`` is the
+        running mean since engine start."""
+        n = self.host_gap_chunks
+        return {
+            "chunks": n,
+            "mean_ms": (self.host_gap_ns / 1e6 / n) if n else 0.0,
+            "last_ms": self.last_host_gap_ms,
+            "overlap": self.overlap,
+        }
 
     def run_until_idle(self, max_steps: int = 10_000) -> None:
         """Drive fused chunks until no request is active or queued."""
@@ -1709,9 +1891,17 @@ class InferenceEngine:
                     prefill_tokens=len(fed),
                 )
             self.slots[i] = req
+            # gap metric: only back-to-back decode chunks count.  Most
+            # admissions reset via _prefill_dispatch, but a plen-1 or
+            # fully-prefix-matched prompt skips the prefill dispatch —
+            # without this reset, engine idle time before the admission
+            # (minutes on a quiet pod) would land in host_gap_ns
+            self._last_drain_done = None
             self.prompts[i, : len(fed)] = fed
+            self._prompts_version += 1  # device prompt mirror refresh
             self.prompt_lens[i] = len(fed)
             self.next_token[i] = fed[0]
+            self._carry_dirty.add(i)  # host rewrote this slot's feed row
             self.gen_before[i] = len(req.output)
             self.priorities[i] = req.priority
             self.temps[i] = req.temperature
@@ -1727,7 +1917,7 @@ class InferenceEngine:
                 self._seeded[i] = True
             if req.logit_bias or req.allowed_tokens:
                 self._bias_dev = self._bias_dev.at[i].set(
-                    _bias_row(req, self.cfg.vocab_size)
+                    _bias_row_cached(req, self.cfg.vocab_size)
                 )
                 self._bias_set[i] = True
             # remaining floor: tokens generated before a spill count
@@ -1739,7 +1929,7 @@ class InferenceEngine:
                         (self.max_batch, self.cfg.vocab_size), jnp.float32
                     )
                 self._stop_dev = self._stop_dev.at[i].set(
-                    _stop_row(req, self.cfg.vocab_size)
+                    _stop_row_cached(req, self.cfg.vocab_size)
                 )
                 self._stop_set[i] = True
             self.emitted[i] = int(self.gen_before[i])
@@ -1761,14 +1951,17 @@ class InferenceEngine:
         # output for a spilled request — cached pages match by content)
         # K/V content depends on the adapter (wk/wv deltas): pages cached
         # under one adapter must never match a request using another, so
-        # the hash chain is seeded with the adapter id
-        key = ("lora", int(self.adapter_ids[i]))
+        # the digest chain is seeded with the adapter id (the rolling
+        # BLAKE2b chain replaced the seed's O(prompt) nested-tuple hash;
+        # same content-addressing, one incremental digest per page)
+        key = _prefix_seed(int(self.adapter_ids[i]))
+        row = self.prompts[i]
         matched_pages = 0
         for j in range(self.max_pages_per_slot):
             end = (j + 1) * ps
             if end > plen - 1:
                 break
-            key = (key, tuple(int(t) for t in self.prompts[i, j * ps:end]))
+            key = _prefix_page_key(key, row[j * ps:end])
             pg = self.prefix_entries.get(key)
             if pg is None:
                 break
@@ -1798,12 +1991,15 @@ class InferenceEngine:
         sharing the prefix."""
         ps = self.page_size
         plen = min(len(req.prompt), int(self.lengths[i]))
-        key = ("lora", int(self.adapter_ids[i]))  # same seed as _match_prefix
+        key = _prefix_seed(int(self.adapter_ids[i]))  # as in _match_prefix
+        # digest over the SAME int32 byte layout _match_prefix hashes (the
+        # prompts buffer is int32), so registration and match keys agree
+        ptoks = np.asarray(req.prompt[:plen], np.int32)
         for j, pg in enumerate(self.slot_pages[i]):
             end = (j + 1) * ps
             if end > plen:
                 break
-            key = (key, tuple(req.prompt[j * ps:end]))
+            key = _prefix_page_key(key, ptoks[j * ps:end])
             existing = self.prefix_entries.get(key)
             if existing is None:
                 self.prefix_entries[key] = pg
@@ -1850,6 +2046,7 @@ class InferenceEngine:
                 self.lora_bank, aid,
             )
         self.prefills_run += 1
+        self._last_drain_done = None  # gap metric: decode chunks only
         return logits
 
     def _try_prefill(self, i: int, req: Request) -> None:
@@ -1873,6 +2070,7 @@ class InferenceEngine:
                 return  # pool pressure: retried next loop iteration
             self._prefill_dispatch(i, req, t0, C)  # logits discarded
             self.lengths[i] = t0 + C
+            self._carry_dirty.add(i)
             return
         if rem < 2 or not self._ensure_pages(i, plen):
             return
@@ -1882,7 +2080,7 @@ class InferenceEngine:
             # the SAME row the fused chunks add, applied host-side
             logits = jnp.asarray(
                 np.asarray(logits, np.float32)
-                + _bias_row(req, self.cfg.vocab_size)
+                + _bias_row_cached(req, self.cfg.vocab_size)
             )
         if self.min_toks[i] > 0 and req.stop_tokens:
             # this emission's index is gen_before < the remaining floor,
@@ -1891,7 +2089,7 @@ class InferenceEngine:
             # for a resumed request that passed it before its spill)
             logits = jnp.asarray(
                 np.asarray(logits, np.float32)
-                + _stop_row(req, self.cfg.vocab_size)
+                + _stop_row_cached(req, self.cfg.vocab_size)
             )
         # penalties: counts cover GENERATED tokens only — none exist at a
         # fresh admission, but a spilled-and-resumed request re-enters
@@ -1946,6 +2144,7 @@ class InferenceEngine:
         self.emitted[i] = int(self.gen_before[i]) + 1
         self.lengths[i] = plen
         self.next_token[i] = tok
+        self._carry_dirty.add(i)
         if (
             self._stops(i, req, tok)
             or self.emitted[i] >= req.max_new_tokens
@@ -2269,11 +2468,62 @@ class InferenceEngine:
         """One engine step: pending chunked-prefill slots each ingest one
         chunk, then a fused decode chunk (or, speculative mode, a fused
         verify pass) runs for everyone else; page allocation, admission,
-        and completion happen between steps on the host."""
+        and completion happen between steps on the host.
+
+        With ``overlap`` on, the decode-chunk flavor is double-buffered:
+        this call dispatches chunk N+1 off device-resident state FIRST
+        and only then drains chunk N's tokens — host bookkeeping runs
+        while the device computes.  The verify flavor and penalized
+        batches drain first (their host state must be current before the
+        next dispatch)."""
         self._continue_prefills()
         if self.spec_k > 0 and self._spec_useful():
-            return self._step_verify()
+            self._drain_pending()
+            self._step_verify()
+            # verify recomputes lengths/next_token host-side (acceptance
+            # is data-dependent): the chunk carry is stale — rebuild from
+            # host at the next decode dispatch
+            self._carry = None
+            return
+        if self.overlap and not self._overlap_blocked():
+            return self._step_chunk_overlapped()
+        self._drain_pending()
         return self._step_chunk()
+
+    def _overlap_blocked(self) -> bool:
+        """Penalized requests need cross-chunk token counts rebuilt from
+        host output lists (``_host_counts``) — with a chunk in flight
+        those counts lag, so such batches take the exact sequential
+        loop."""
+        return any(
+            req is not None
+            and (req.frequency_penalty or req.presence_penalty)
+            for req in self.slots
+        )
+
+    def _drain_pending(self) -> None:
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._drain_chunk(pending)
+
+    def _step_chunk_overlapped(self) -> None:
+        """Double-buffered decode step: dispatch the next chunk off the
+        in-flight device carry, THEN drain the previous chunk's tokens
+        while the new one runs.  A page-pool-exhaustion raise during
+        dispatch first drains the pending chunk (its completions may
+        free pages) and retries once before surfacing overload."""
+        pending, self._pending = self._pending, None
+        try:
+            new = self._dispatch_chunk(pipelined=pending is not None)
+        except RuntimeError:
+            if pending is None:
+                raise
+            self._drain_chunk(pending)
+            pending = None
+            new = self._dispatch_chunk()  # a second raise is real overload
+        if pending is not None:
+            self._drain_chunk(pending)
+        self._pending = new
 
     def _continue_prefills(self) -> bool:
         """Advance every mid-chunked-prefill slot by one chunk.  Returns
@@ -2352,31 +2602,33 @@ class InferenceEngine:
         use_pen = self._pens_requested(active)
         use_seed = self._seeds_requested(active)
         use_min = self._min_requested(active)
+        ds = self._ds
+        self._last_drain_done = None  # gap metric: decode chunks only
         out, self.kv = self._verify_chunks[
             (use_filters, want_lp, use_pen, use_seed, use_min)
         ](
             self.params,
             self.kv,
-            jnp.asarray(view),
+            ds.get("view", view),
             jnp.asarray(feed),
-            jnp.asarray(self.lengths),
-            jnp.asarray(active),
-            jnp.asarray(self.temps),
-            jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps),
+            ds.get("lengths", self.lengths),
+            ds.get("active", active),
+            ds.get("temps", self.temps),
+            ds.get("top_ks", self.top_ks),
+            ds.get("top_ps", self.top_ps),
             sub,
             self.lora_bank,
-            jnp.asarray(self.adapter_ids),
+            ds.get("adapter_ids", self.adapter_ids),
             self._bias_dev,
-            jnp.asarray(self.freq_pens) if use_pen else None,
-            jnp.asarray(self.pres_pens) if use_pen else None,
+            ds.get("freq_pens", self.freq_pens) if use_pen else None,
+            ds.get("pres_pens", self.pres_pens) if use_pen else None,
             jnp.asarray(self._host_counts()) if use_pen else None,
-            jnp.asarray(self.prompt_lens)
+            ds.get("prompt_lens", self.prompt_lens)
             if (use_pen or use_min) else None,
             self._seed_keys if use_seed else None,
-            jnp.asarray(self._seeded) if use_seed else None,
+            ds.get("seeded", self._seeded) if use_seed else None,
             self._stop_dev if use_min else None,
-            jnp.asarray(self.min_toks) if use_min else None,
+            ds.get("min_toks", self.min_toks) if use_min else None,
         )
         if want_lp:
             picked, chosen_lp, top_ids, top_lps = (
@@ -2550,11 +2802,54 @@ class InferenceEngine:
 
     def _step_chunk(self) -> None:
         """One fused chunk (``fused_steps`` decode iterations) across all
-        slots."""
+        slots — dispatch then immediately drain (the exact sequential
+        loop; the overlapped pipeline splits the two across steps)."""
+        pending = self._dispatch_chunk()
+        if pending is not None:
+            self._drain_chunk(pending)
+
+    def _carry_feed(self):
+        """(next_tokens, lengths) device arrays for the next chunk: the
+        previous chunk's carry futures when available (zero host→device
+        transfer), with host-mutated slots patched in; a full host
+        upload only after a mode switch (engine start, verify pass)."""
+        if self._carry is None:
+            self._carry_dirty.clear()
+            self._ds.uploads += 2
+            self._carry = (
+                jnp.asarray(self.next_token), jnp.asarray(self.lengths)
+            )
+            return self._carry
+        if self._carry_dirty:
+            sl = sorted(self._carry_dirty)
+            self._carry_dirty.clear()
+            idx = jnp.asarray(np.asarray(sl, np.int32))
+            tok, ln = self._carry
+            tok = tok.at[idx].set(jnp.asarray(self.next_token[sl]))
+            ln = ln.at[idx].set(jnp.asarray(self.lengths[sl]))
+            self._ds.uploads += 1
+            self._carry = (tok, ln)
+        return self._carry
+
+    def _dispatch_chunk(
+        self, pipelined: bool = False
+    ) -> Optional[_PendingChunk]:
+        """Prepare and dispatch one fused decode chunk; returns the
+        pending record to drain (or None when nothing is runnable).  All
+        batch state rides device-resident mirrors (``_ds``) and the
+        chunk-to-chunk carry, so a steady-state dispatch performs ZERO
+        host→device uploads of unchanged state.  Host ``lengths`` is
+        advanced eagerly (+K for active slots — data-independent), so
+        page growth and admission logic stay accurate while the sampled
+        tokens are still in flight.
+
+        ``pipelined``: this dispatch happened while the previous chunk
+        was still undrained — the device had queued work the whole time,
+        so the host-gap sample is zero."""
         K = self.fused_steps
         prepared = self._prepare_step(K)
         if prepared is None:
-            return
+            return None
         self.steps_run += 1  # a real dispatch follows (bench: ms/step)
         active, view = prepared
         self._key, sub = jax.random.split(self._key)
@@ -2563,42 +2858,78 @@ class InferenceEngine:
         use_pen = self._pens_requested(active)
         use_seed = self._seeds_requested(active)
         use_min = self._min_requested(active)
-        out, self.kv = self._chunks[
+        ds = self._ds
+        counts = (
+            jnp.asarray(self._host_counts()) if use_pen else None
+        )  # before the eager lengths advance below
+        tok_dev, len_dev = self._carry_feed()
+        if pipelined:
+            # previous chunk still in flight when this one queued: the
+            # device never idled between them
+            self.host_gap_chunks += 1
+            self.last_host_gap_ms = 0.0
+        elif self._last_drain_done is not None:
+            gap = time.perf_counter_ns() - self._last_drain_done
+            self.host_gap_ns += gap
+            self.host_gap_chunks += 1
+            self.last_host_gap_ms = gap / 1e6
+        out, self.kv, new_toks, new_lens = self._chunks[
             (use_filters, want_lp, use_pen, use_seed, use_min)
         ](
             self.params,
             self.kv,
-            jnp.asarray(view),
-            jnp.asarray(self.next_token),
-            jnp.asarray(self.lengths),
-            jnp.asarray(active),
-            jnp.asarray(self.prompts),
-            jnp.asarray(self.prompt_lens),
-            jnp.asarray(self.temps),
-            jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps),
+            ds.get("view", view),
+            tok_dev,
+            len_dev,
+            ds.get("active", active),
+            ds.get_versioned("prompts", self.prompts, self._prompts_version),
+            ds.get("prompt_lens", self.prompt_lens),
+            ds.get("temps", self.temps),
+            ds.get("top_ks", self.top_ks),
+            ds.get("top_ps", self.top_ps),
             sub,
             self.lora_bank,
-            jnp.asarray(self.adapter_ids),
+            ds.get("adapter_ids", self.adapter_ids),
             self._bias_dev,
-            jnp.asarray(self.freq_pens) if use_pen else None,
-            jnp.asarray(self.pres_pens) if use_pen else None,
-            jnp.asarray(self._host_counts()) if use_pen else None,
+            ds.get("freq_pens", self.freq_pens) if use_pen else None,
+            ds.get("pres_pens", self.pres_pens) if use_pen else None,
+            counts,
             self._seed_keys if use_seed else None,
-            jnp.asarray(self._seeded) if use_seed else None,
+            ds.get("seeded", self._seeded) if use_seed else None,
             self._stop_dev if use_min else None,
-            jnp.asarray(self.min_toks) if use_min else None,
+            ds.get("min_toks", self.min_toks) if use_min else None,
         )
+        # adopt the carry futures: the next dispatch chains off them
+        self._carry = (new_toks, new_lens)
+        pos0 = self.lengths.copy()
+        idx = np.nonzero(active)[0]
+        self.lengths[idx] += K  # eager, data-independent advance
+        pairs = [(int(i), self.slots[int(i)]) for i in idx]
+        return _PendingChunk(
+            out=out, want_lp=want_lp, n_steps=K, pos0=pos0, pairs=pairs
+        )
+
+    def _drain_chunk(self, pending: _PendingChunk) -> None:
+        """Transfer a dispatched chunk's sampled tokens to the host and
+        emit them.  Slots released or re-tenanted since the dispatch
+        (stop/cancel discovered late under overlap, spill, engine-failure
+        cleanup) are skipped — their in-flight tokens are the bounded
+        overshoot and are discarded."""
+        out, want_lp, K = pending.out, pending.want_lp, pending.n_steps
         if want_lp:
             sampled, chosen_lp, top_ids, top_lps = (
                 np.asarray(a) for a in out
             )
         else:
             sampled = np.asarray(out)  # (B, K)
-        for i, req in enumerate(self.slots):
-            if req is None or not active[i]:
-                continue
-            pos = int(self.lengths[i])
+        # results are on host: from here until the next dispatch the
+        # device is idle (unless a later chunk is already queued) — the
+        # window the host-gap metric measures
+        self._last_drain_done = time.perf_counter_ns()
+        for i, req in pending.pairs:
+            if self.slots[i] is not req or req.done.is_set():
+                continue  # released/re-tenanted since dispatch: discard
+            pos = int(pending.pos0[i])
             plen = int(self.prompt_lens[i])
             stopped = False
             for s in range(K):
@@ -2621,10 +2952,12 @@ class InferenceEngine:
                         # the device sampled past it this chunk are dropped
                         stopped = True
                         break
-            self.lengths[i] = pos + K
+            # host next_token mirror: identical to the device carry (same
+            # in-prompt/sampled selection), so this does NOT dirty the
+            # carry — the host copy only feeds verify windows and debug
             self.next_token[i] = (
-                self.prompts[i, self.lengths[i]]
-                if self.lengths[i] < plen
+                self.prompts[i, pos + K]
+                if pos + K < plen
                 else sampled[i, K - 1]
             )
             if (

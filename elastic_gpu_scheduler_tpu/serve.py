@@ -74,6 +74,14 @@ def build_args(argv=None):
                    help="graceful-drain window on SIGTERM/SIGINT: stop "
                         "admitting (healthz 503), let in-flight requests "
                         "finish, then exit; a second signal hard-stops")
+    p.add_argument("--serve-overlap", choices=["on", "off"], default="on",
+                   help="double-buffered decode dispatch: the next fused "
+                        "chunk is dispatched off device-resident state "
+                        "before the previous one's tokens drain, so the "
+                        "accelerator never waits on host bookkeeping.  "
+                        "'off' = the exact sequential loop (correctness "
+                        "mode; greedy/seeded outputs are bit-identical "
+                        "either way)")
     p.add_argument("--paged-kernel", action="store_true",
                    help="decode attention reads the page pool in place "
                         "via the Pallas kernel (long-context HBM win); "
@@ -206,6 +214,7 @@ def main(argv=None) -> int:
         mesh=mesh, paged_kernel=args.paged_kernel,
         prefill_chunk=args.prefill_chunk,
         max_queue=args.max_queue, logprobs_k=args.logprobs_k,
+        overlap=args.serve_overlap == "on",
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

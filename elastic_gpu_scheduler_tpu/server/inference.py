@@ -90,6 +90,15 @@ SERVE_LATENCY = REGISTRY.register(
                  60.0, 120.0),
     )
 )
+SERVE_HOST_GAP = REGISTRY.register(
+    Gauge(
+        "tpu_serve_host_gap_ms",
+        "Mean wall time between consecutive fused decode chunk dispatches "
+        "(the window where the accelerator can starve on host "
+        "bookkeeping; the overlapped pipeline keeps it near zero) — set "
+        "at scrape time from engine telemetry",
+    )
+)
 
 
 class EngineLoop:
@@ -98,7 +107,11 @@ class EngineLoop:
 
     def __init__(self, engine: InferenceEngine, idle_sleep: float = 0.002):
         self.engine = engine
+        # retained for API compatibility; the idle path now parks on the
+        # engine's work event (submit/stop/drain set it) instead of
+        # polling every idle_sleep seconds — an idle pod costs no wakeups
         self.idle_sleep = idle_sleep
+        self.idle_parks = 0  # times the loop parked (observability/tests)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # drain support: the LOOP thread (sole mutator of queue/slot
@@ -127,6 +140,7 @@ class EngineLoop:
 
     def stop(self) -> None:
         self._stop.set()
+        self.engine._work.set()  # wake a parked loop so it can exit
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -159,8 +173,17 @@ class EngineLoop:
                             slots=sum(
                                 1 for s in eng.slots if s is not None
                             ),
-                        ):
+                        ) as sp:
                             eng.step()
+                            if sp is not None:
+                                # per-step host-gap telemetry rides the
+                                # paced span: the dispatch-to-dispatch
+                                # wall this step left the device idle
+                                sp.set_attr(
+                                    "host_gap_ms",
+                                    round(eng.last_host_gap_ms, 3),
+                                )
+                                sp.set_attr("overlap", eng.overlap)
                     else:
                         eng.step()
                     step_seq = step_seq + 1 if traced is not None else 0
@@ -169,7 +192,18 @@ class EngineLoop:
                         # consistent snapshot: this thread just ran
                         # _admit and owns every queue→slot transition
                         self.drained.set()
-                    self._stop.wait(self.idle_sleep)
+                    # idle: park on the work event (submit/stop/drain set
+                    # it).  clear → re-check → wait is lost-wakeup-safe: a
+                    # submit landing after the clear re-sets the event and
+                    # the wait returns immediately.
+                    eng._work.clear()
+                    if (
+                        eng.queue.empty()
+                        and not any(s is not None for s in eng.slots)
+                        and not self._stop.is_set()
+                    ):
+                        self.idle_parks += 1
+                        eng._work.wait()
                 failures = 0
             except RuntimeError as e:
                 if "page pool exhausted" in str(e):
@@ -335,6 +369,21 @@ def _logprobs_payload(req: Request) -> dict:
     }
 
 
+def _drain_burst(q: "queue.Queue", first, cap: int = 512) -> list:
+    """Burst-drain an SSE token queue: ``first`` plus everything already
+    queued, in queue order, bounded by ``cap`` so a pathological backlog
+    cannot build an unbounded buffer for one socket write.  The stream
+    loop turns the result into ONE HTTP chunk and ONE flush — syscalls
+    scale with bursts, not tokens, when the engine outruns the socket."""
+    events = [first]
+    while len(events) < cap:
+        try:
+            events.append(q.get_nowait())
+        except queue.Empty:
+            break
+    return events
+
+
 def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
     engine = loop.engine
 
@@ -374,6 +423,9 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     for pri, depth in engine.queue_depths().items():
                         SERVE_QUEUE_DEPTH.set(str(pri), value=float(depth))
                     SERVE_SPILLS.set(value=float(engine.spills))
+                    SERVE_HOST_GAP.set(
+                        value=round(engine.host_gap_stats()["mean_ms"], 4)
+                    )
                     data = REGISTRY.expose().encode()
                 self.send_response(200, "OK")
                 self.send_header(
@@ -424,6 +476,15 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "prefill_chunk": eng.prefill_chunk,
                     "paged_kernel": eng.paged_kernel,
                     "vocab_size": eng.cfg.vocab_size,
+                    # overlapped decode pipeline: mode + the host-gap
+                    # telemetry it exists to shrink (see OPERATIONS.md
+                    # "Serving performance")
+                    "overlap": eng.overlap,
+                    "host_gap": {
+                        k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in eng.host_gap_stats().items()
+                    },
+                    "device_uploads": int(eng.device_uploads),
                 })
             return self._json(404, {"error": f"no route {self.path}"})
 
@@ -633,36 +694,55 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             # _do_post with-block); flush markers land in the same trace
             sp = TRACER.current() or None
             first_flush = [True]
+            flushes = [0]  # socket write+flush count (burst coalescing)
 
-            def chunk(payload: str) -> None:
-                data = f"data: {payload}\n\n".encode()
-                self.wfile.write(f"{len(data):x}\r\n".encode())
-                self.wfile.write(data + b"\r\n")
+            def chunk_many(payloads: list) -> None:
+                # burst drain: every queued event rides ONE HTTP chunk and
+                # ONE flush — chunked encoding is transport framing and
+                # SSE parses by blank lines, so coalescing is invisible to
+                # clients while cutting syscalls from one-per-token to
+                # one-per-burst when the engine outruns the socket
+                data = b"".join(
+                    f"data: {p}\n\n".encode() for p in payloads
+                )
+                self.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                )
                 self.wfile.flush()
+                flushes[0] += 1
                 if first_flush[0]:
                     first_flush[0] = False
                     if sp is not None:
                         sp.event("sse_first_flush")
+
+            def chunk(payload: str) -> None:
+                chunk_many([payload])
+
+            def event_json(item) -> str:
+                k, tok, lp, top = item
+                ev = {"token": tok}
+                if n > 1:
+                    ev["index"] = k
+                if lp is not None:
+                    ev["logprob"] = lp
+                    ev["top_logprobs"] = [
+                        {"id": t, "logprob": l} for t, l in top
+                    ]
+                return json.dumps(ev)
 
             sent = 0
             deadline = time.monotonic() + request_timeout
             try:
                 while time.monotonic() < deadline:
                     try:
-                        k, tok, lp, top = q.get(timeout=0.1)
-                        ev = {"token": tok}
-                        if n > 1:
-                            ev["index"] = k
-                        if lp is not None:
-                            ev["logprob"] = lp
-                            ev["top_logprobs"] = [
-                                {"id": t, "logprob": l} for t, l in top
-                            ]
-                        chunk(json.dumps(ev))
-                        sent += 1
+                        first = q.get(timeout=0.1)
                     except queue.Empty:
                         if all(r.done.is_set() for r in reqs) and q.empty():
                             break
+                        continue
+                    events = _drain_burst(q, first)
+                    chunk_many([event_json(e) for e in events])
+                    sent += len(events)
                 timed_out = not all(r.done.is_set() for r in reqs)
                 if timed_out:
                     # timed out mid-generation: tell the client the truth
@@ -697,6 +777,7 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 SERVE_TOKENS.inc(value=sent)
                 if sp is not None:
                     sp.set_attr("sse_chunks", sent)
+                    sp.set_attr("sse_flushes", flushes[0])
 
     return InferenceHandler
 
@@ -726,6 +807,7 @@ def drain(
     while draining."""
     engine = loop.engine
     engine.draining = True
+    engine._work.set()  # wake a parked loop so it observes the drain
     deadline = time.monotonic() + timeout
     engine_idle = loop.drained.wait(max(0.0, deadline - time.monotonic()))
     while time.monotonic() < deadline and loop.http_inflight > 0:

@@ -1,0 +1,422 @@
+"""Overlapped decode pipeline (models/serving.py): double-buffered chunk
+dispatch off device-resident batch state.
+
+Correctness bar: ``overlap=True`` (the default) produces BIT-IDENTICAL
+token streams to the exact sequential loop (``overlap=False``) for greedy
+and seeded-sampled requests — across stop tokens discovered mid-chunk,
+cancels mid-stream, and spill-and-resume.  Efficiency bar: steady-state
+decode steps perform ZERO per-step host→device uploads of unchanged batch
+state (the transfer-count probe), and the rolling-hash prefix-cache keys
+preserve the tuple-chain's exact match semantics (adapter-id seeding, the
+plen-1 cap).
+"""
+
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+import jax
+
+from elastic_gpu_scheduler_tpu.models.serving import (
+    InferenceEngine,
+    Request,
+    _prefix_page_key,
+    _prefix_seed,
+)
+from elastic_gpu_scheduler_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    dtype="float32",
+)
+PARAMS = init_params(jax.random.key(0), CFG)
+
+
+def make_engine(overlap, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("fused_steps", 4)
+    return InferenceEngine(PARAMS, CFG, overlap=overlap, **kw)
+
+
+def run_batch(overlap, reqs_fn, **kw):
+    """Build an engine, submit ``reqs_fn()``'s requests, run to idle, and
+    return their outputs (plus the request objects for extra asserts)."""
+    eng = make_engine(overlap, **kw)
+    reqs = [eng.submit(r) for r in reqs_fn()]
+    eng.run_until_idle(max_steps=100_000)
+    for r in reqs:
+        assert not r.error, r.error
+    return [list(r.output) for r in reqs], reqs, eng
+
+
+# -- token parity: overlap on vs off ---------------------------------------
+
+
+def test_greedy_parity_multi_request():
+    def reqs():
+        return [
+            Request(prompt=[3, 9, 14], max_new_tokens=12),
+            Request(prompt=[2, 4, 6, 8, 10], max_new_tokens=9),
+            Request(prompt=[60, 2, 33], max_new_tokens=15),
+            Request(prompt=[1] * 12, max_new_tokens=7),
+        ]
+
+    off, _, _ = run_batch(False, reqs)
+    on, _, eng = run_batch(True, reqs)
+    assert on == off
+    # the overlapped engine actually pipelined: zero-gap samples dominate
+    assert eng.host_gap_stats()["chunks"] > 0
+
+
+def test_seeded_sampled_parity():
+    def reqs():
+        return [
+            Request(prompt=[5, 17, 3], max_new_tokens=10,
+                    temperature=0.9, seed=1234),
+            Request(prompt=[8, 8, 1], max_new_tokens=10,
+                    temperature=0.7, top_k=8, top_p=0.9, seed=77),
+            Request(prompt=[30, 31], max_new_tokens=6),  # greedy companion
+        ]
+
+    off, _, _ = run_batch(False, reqs)
+    on, _, _ = run_batch(True, reqs)
+    assert on == off
+
+
+def test_logprobs_parity():
+    def reqs():
+        return [
+            Request(prompt=[3, 9, 14], max_new_tokens=8, logprobs=3),
+            Request(prompt=[2, 4, 6], max_new_tokens=8),
+        ]
+
+    off, off_reqs, _ = run_batch(False, reqs)
+    on, on_reqs, _ = run_batch(True, reqs)
+    assert on == off
+    assert on_reqs[0].token_logprobs == off_reqs[0].token_logprobs
+    assert on_reqs[0].top_logprobs == off_reqs[0].top_logprobs
+
+
+def test_stop_tokens_mid_chunk_parity():
+    """A stop token landing mid-chunk is discovered one chunk late under
+    overlap (the overshoot chunk is discarded); the emitted stream must
+    still cut at exactly the same token as the sequential loop."""
+    full, _, _ = run_batch(False, lambda: [
+        Request(prompt=[3, 9, 14], max_new_tokens=12),
+    ])
+    stop = full[0][5]  # index 5: middle of the second 4-step chunk
+    want = full[0][: full[0].index(stop) + 1]
+
+    def reqs():
+        return [
+            Request(prompt=[3, 9, 14], max_new_tokens=12,
+                    stop_tokens=(stop,)),
+            # a companion that keeps generating across the stop — its
+            # stream must be unaffected by the neighbor's late release
+            Request(prompt=[2, 4, 6, 8], max_new_tokens=14),
+        ]
+
+    off, _, _ = run_batch(False, reqs)
+    on, _, _ = run_batch(True, reqs)
+    assert on == off
+    assert on[0] == want
+
+
+def test_cancel_mid_stream():
+    """Cancel with a chunk in flight: the request finishes (done set), its
+    emitted tokens are a prefix of the uncancelled greedy stream (the
+    in-flight overshoot is discarded, never emitted), and a companion
+    request's stream is untouched."""
+    full, _, _ = run_batch(False, lambda: [
+        Request(prompt=[3, 9, 14], max_new_tokens=30),
+        Request(prompt=[2, 4, 6], max_new_tokens=12),
+    ])
+
+    eng = make_engine(True)
+    victim = eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=30))
+    other = eng.submit(Request(prompt=[2, 4, 6], max_new_tokens=12))
+    eng._admit()
+    for _ in range(3):  # a few chunks: victim mid-stream, chunk in flight
+        eng.step()
+    assert not victim.done.is_set()
+    victim.cancel()
+    eng.run_until_idle(max_steps=100_000)
+    assert victim.done.is_set()
+    assert not other.error and list(other.output) == full[1]
+    n = len(victim.output)
+    assert 0 < n < 30
+    assert list(victim.output) == full[0][:n]
+    assert all(s is None for s in eng.slots)
+
+
+def test_spill_and_resume_parity():
+    """Page-pressure spill with a chunk in flight: the victim's undrained
+    tokens are discarded, it requeues, and the resumed stream is
+    bit-identical to the sequential engine's (and to an uncontended
+    run)."""
+    victim_prompt = [3, 9, 14, 27, 5, 1, 2, 6]
+    high_prompt = [2, 4, 6, 8, 10, 12, 1, 7]
+
+    def contended(overlap):
+        eng = InferenceEngine(
+            PARAMS, CFG, max_batch=2, max_len=64, page_size=8, n_pages=6,
+            fused_steps=2, overlap=overlap,
+        )
+        victim = eng.submit(Request(prompt=list(victim_prompt),
+                                    max_new_tokens=30, priority=0))
+        for _ in range(40):  # drive into page pressure mid-flight
+            eng._admit()
+            eng.step()
+            if len(eng.free_pages) == 0:
+                break
+        assert not victim.done.is_set()
+        high = eng.submit(Request(prompt=list(high_prompt),
+                                  max_new_tokens=8, priority=5))
+        eng.run_until_idle(max_steps=100_000)
+        assert not victim.error and not high.error
+        assert eng.spills >= 1
+        return list(victim.output), list(high.output)
+
+    off_v, off_h = contended(False)
+    on_v, on_h = contended(True)
+    assert (on_v, on_h) == (off_v, off_h)
+    # both match the uncontended reference
+    ref, _, _ = run_batch(
+        True,
+        lambda: [Request(prompt=list(victim_prompt), max_new_tokens=30)],
+        max_batch=2, n_pages=9, fused_steps=4,
+    )
+    assert on_v == ref[0]
+
+
+def test_penalized_batch_takes_sequential_path_with_parity():
+    """Frequency/presence penalties need host-rebuilt cross-chunk counts:
+    such batches fall back to the exact sequential loop (no pending chunk
+    ever outstanding) and outputs match overlap-off exactly."""
+    def reqs():
+        return [
+            Request(prompt=[5, 17, 3], max_new_tokens=10, temperature=0.8,
+                    seed=3, frequency_penalty=0.6, presence_penalty=0.2),
+            Request(prompt=[2, 4, 6], max_new_tokens=10),
+        ]
+
+    off, _, _ = run_batch(False, reqs)
+    eng = make_engine(True)
+    rs = [eng.submit(r) for r in reqs()]
+    saw_pending = False
+    for _ in range(100_000):
+        eng._admit()
+        if not any(s is not None for s in eng.slots):
+            if eng.queue.empty():
+                break
+            continue
+        eng.step()
+        saw_pending = saw_pending or eng._pending is not None
+    assert not saw_pending  # the fallback really engaged
+    assert [list(r.output) for r in rs] == off
+
+
+def test_overlap_composes_with_speculation():
+    """spec_k engines interleave verify passes (which drain and invalidate
+    the carry) with overlapped decode chunks; greedy streams stay exact."""
+    def reqs():
+        return [
+            Request(prompt=[3, 9, 14], max_new_tokens=12),
+            Request(prompt=[2, 4, 6, 8], max_new_tokens=10),
+        ]
+
+    off, _, _ = run_batch(False, reqs, spec_k=3)
+    on, _, _ = run_batch(True, reqs, spec_k=3)
+    assert on == off
+
+
+# -- transfer-count probe ---------------------------------------------------
+
+
+def test_steady_state_decode_uploads_nothing():
+    """Acceptance criterion: once the batch composition settles, decode
+    steps re-upload NO batch state — dispatch rides the device-resident
+    mirrors and the chunk-to-chunk carry.  One page per slot (page_size ==
+    max_len) so no page-table growth perturbs the view mid-run."""
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=64, fused_steps=4,
+        overlap=True,
+    )
+    reqs = [
+        eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=40)),
+        eng.submit(Request(prompt=[2, 4, 6, 8], max_new_tokens=40)),
+    ]
+    eng._admit()
+    eng.step()  # first decode chunk: pays the mirror uploads
+    eng.step()  # second: carry adopted, mirrors warm
+    flat = eng.device_uploads
+    for _ in range(5):  # steady state: nothing admitted, nothing released
+        eng.step()
+        assert eng.device_uploads == flat, (
+            f"steady-state decode step uploaded batch state "
+            f"({eng.device_uploads - flat} refreshes)"
+        )
+    eng.run_until_idle(max_steps=100_000)
+    for r in reqs:
+        assert not r.error and len(r.output) == 40
+
+
+def test_admission_refreshes_only_changed_state():
+    """A new admission must dirty the mirrors (fresh uploads), and the
+    batch must settle flat again afterwards."""
+    eng = InferenceEngine(
+        PARAMS, CFG, max_batch=2, max_len=64, page_size=64, fused_steps=4,
+        overlap=True,
+    )
+    eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=60))
+    eng._admit()
+    eng.step()
+    eng.step()
+    flat = eng.device_uploads
+    eng.step()
+    assert eng.device_uploads == flat
+    eng.submit(Request(prompt=[7, 7, 7], max_new_tokens=8))
+    eng._admit()  # batch changed: the next dispatch re-uploads deltas
+    eng.step()
+    assert eng.device_uploads > flat
+    eng.step()
+    settled = eng.device_uploads
+    eng.step()
+    assert eng.device_uploads == settled
+
+
+def test_host_gap_shrinks_with_overlap():
+    """The host-gap telemetry the pipeline exists to shrink: overlap-off
+    samples a positive dispatch-to-dispatch gap (the host emits tokens
+    between chunks); overlap-on dispatches before draining, so its
+    samples are zero by construction."""
+    def gap(overlap):
+        eng = make_engine(overlap)
+        eng.submit(Request(prompt=[3, 9, 14], max_new_tokens=24))
+        eng.run_until_idle(max_steps=100_000)
+        stats = eng.host_gap_stats()
+        assert stats["chunks"] > 0
+        return stats["mean_ms"]
+
+    assert gap(True) < gap(False)
+
+
+# -- rolling-hash prefix-cache keys ----------------------------------------
+
+
+def test_prefix_key_content_addressing():
+    """Equal (adapter, token-prefix) chains produce equal digests; any
+    token or adapter difference diverges the chain — the tuple-chain's
+    semantics, one incremental digest per page."""
+    a = np.asarray([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+    b = np.asarray([1, 2, 3, 4, 5, 6, 7, 9], np.int32)
+    k0 = _prefix_page_key(_prefix_seed(0), a)
+    assert k0 == _prefix_page_key(_prefix_seed(0), a.copy())
+    assert k0 != _prefix_page_key(_prefix_seed(0), b)
+    # adapter-id seeding: same tokens under another adapter never match
+    assert k0 != _prefix_page_key(_prefix_seed(1), a)
+    # chains diverge permanently after a differing page
+    nxt = np.asarray([9, 9, 9, 9, 9, 9, 9, 9], np.int32)
+    assert (
+        _prefix_page_key(k0, nxt)
+        != _prefix_page_key(_prefix_page_key(_prefix_seed(0), b), nxt)
+    )
+
+
+def test_prefix_match_caps_at_plen_minus_one():
+    """The last prompt token must be prefilled (its logits seed the first
+    sampled token), so a page ending exactly at plen is registered but
+    never MATCHED — the tuple-chain's plen-1 cap, preserved by the
+    rolling hash."""
+    prompt = list(range(1, 17))  # exactly 2 full pages of 8
+    eng = make_engine(True, max_batch=2, prefix_cache=True)
+    r1 = eng.submit(Request(prompt=list(prompt), max_new_tokens=6))
+    eng.run_until_idle()
+    assert not r1.error
+    assert eng.prefix_hit_tokens == 0
+    r2 = eng.submit(Request(prompt=list(prompt), max_new_tokens=6))
+    eng.run_until_idle()
+    assert not r2.error
+    # only page 1 (end 8 <= plen-1 = 15) matches; page 2 ends AT plen
+    assert eng.prefix_hit_tokens == 8
+    assert list(r2.output) == list(r1.output)
+
+
+def test_prefix_cache_outputs_identical_under_overlap():
+    """Cache-hit resumes under the overlapped engine are token-identical
+    to a cold engine (the existing prefix-cache bar, now with the rolling
+    hash and double-buffered dispatch)."""
+    prompt = list(range(1, 21))
+    cold, _, _ = run_batch(
+        True, lambda: [Request(prompt=list(prompt), max_new_tokens=10)],
+    )
+    eng = make_engine(True, prefix_cache=True)
+    first = eng.submit(Request(prompt=list(prompt), max_new_tokens=10))
+    eng.run_until_idle()
+    second = eng.submit(Request(prompt=list(prompt), max_new_tokens=10))
+    eng.run_until_idle()
+    assert eng.prefix_hit_tokens == 16  # 2 of the 2.5 pages, end <= 19
+    assert list(first.output) == cold[0]
+    assert list(second.output) == cold[0]
+
+
+# -- SSE burst drain + idle park -------------------------------------------
+
+
+def test_sse_burst_drain_ordering():
+    """The stream loop's burst coalescer: everything already queued rides
+    one write, queue order preserved, bounded by the cap."""
+    from elastic_gpu_scheduler_tpu.server.inference import _drain_burst
+
+    q = queue_mod.Queue()
+    for i in range(5):
+        q.put(("ev", i))
+    first = q.get()
+    got = _drain_burst(q, first)
+    assert got == [("ev", i) for i in range(5)]
+    assert q.empty()
+
+    # cap honored: the 513th event waits for the next write
+    for i in range(600):
+        q.put(i)
+    got = _drain_burst(q, q.get(), cap=512)
+    assert got == list(range(512))
+    assert q.qsize() == 600 - 512
+    # and the remainder drains next round, still in order
+    assert _drain_burst(q, q.get(), cap=512) == list(range(512, 600))
+
+
+def test_engine_loop_parks_when_idle():
+    """EngineLoop must not busy-poll an idle engine: it parks on the
+    engine's work event, submit wakes it, stop wakes it for exit."""
+    from elastic_gpu_scheduler_tpu.server.inference import EngineLoop
+
+    eng = make_engine(True)
+    loop = EngineLoop(eng)
+    loop.start()
+    try:
+        r1 = Request(prompt=[3, 9, 14], max_new_tokens=6)
+        eng.submit(r1)
+        assert r1.done.wait(120) and not r1.error
+        deadline = time.monotonic() + 10
+        while loop.idle_parks == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        parks = loop.idle_parks
+        assert parks >= 1  # it parked after the work dried up
+        time.sleep(0.4)  # an idle pod costs no wakeups: parked, not spinning
+        assert loop.idle_parks - parks <= 1
+        # submit wakes the parked loop
+        r2 = Request(prompt=[2, 4, 6], max_new_tokens=6)
+        eng.submit(r2)
+        assert r2.done.wait(120) and not r2.error
+    finally:
+        t0 = time.monotonic()
+        loop.stop()  # wakes the park for a prompt exit
+        assert time.monotonic() - t0 < 5
+        assert not loop._thread.is_alive()

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""CI gate for the overlapped decode pipeline (`make check-serve-overlap`).
+
+Runs a randomized request soak — mixed prompt lengths, greedy and
+seeded-sampled requests, stop tokens, top-k/top-p filters, logprobs,
+cancels, staggered arrivals — through the SAME engine twice (overlap off,
+then on) and HARD-FAILS when:
+
+- any request's token stream (or its logprobs) differs between modes
+  (the bit-identical parity bar that makes overlap shippable),
+- steady-state decode dispatches re-upload batch state (the
+  transfer-count probe: `engine.device_uploads` must stay flat while the
+  batch composition is unchanged), or
+- the measured host gap between consecutive chunk dispatches does not
+  shrink with overlap on (pooled over interleaved off/on rounds, the
+  check_journal trick, so a cgroup-throttling storm hits both modes).
+
+Runs on CPU (JAX_PLATFORMS=cpu recommended); on-chip numbers come from
+`bench.py --tpu-section=serveoverlap`.
+
+Usage:
+    python tools/check_serve_overlap.py [--requests N] [--rounds N]
+
+Environment:
+    CHECK_OVERLAP_SEED   soak RNG seed (default 20260803)
+
+Wired into the Makefile as `make check-serve-overlap`, next to
+`check-plan-budget` and `check-journal`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(overlap, params, cfg):
+    from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine
+
+    return InferenceEngine(
+        params, cfg, max_batch=4, max_len=96, page_size=16,
+        fused_steps=4, overlap=overlap, prefix_cache=True,
+    )
+
+
+def _requests(rng, n, vocab):
+    from elastic_gpu_scheduler_tpu.models.serving import Request
+
+    out = []
+    for i in range(n):
+        plen = rng.randint(2, 24)
+        prompt = [rng.randrange(1, vocab) for _ in range(plen)]
+        kw = dict(prompt=prompt, max_new_tokens=rng.randint(4, 24))
+        style = rng.random()
+        if style < 0.35:
+            pass  # greedy
+        elif style < 0.7:
+            kw.update(temperature=0.5 + rng.random(),
+                      seed=rng.randrange(1 << 16))
+            if rng.random() < 0.5:
+                kw.update(top_k=rng.randint(4, 16),
+                          top_p=0.85 + 0.1 * rng.random())
+        else:
+            kw.update(stop_tokens=(rng.randrange(1, vocab),))
+        if rng.random() < 0.2:
+            kw.update(logprobs=2)
+        if rng.random() < 0.25:
+            kw.update(priority=rng.choice([-1, 0, 5]))
+        out.append(Request(**kw))
+    return out
+
+
+def _soak(overlap, seed, n_requests, params, cfg):
+    """One soak round: returns (streams, mean host-gap ms, upload audit).
+
+    The request mix, arrival order, and cancel points are all derived
+    from ``seed`` so the off and on rounds see an identical workload."""
+    rng = random.Random(seed)
+    eng = _build(overlap, params, cfg)
+    reqs = _requests(rng, n_requests, cfg.vocab_size)
+    cancel_at = {
+        i: rng.randint(2, 6) for i in range(n_requests) if rng.random() < 0.1
+    }
+    pending = list(enumerate(reqs))
+    rng.shuffle(pending)
+    submitted = []
+    steps = 0
+    upload_violations = 0
+    prev_sig = None
+    while pending or any(s is not None for s in eng.slots) or not eng.queue.empty():
+        for _ in range(rng.randint(1, 3)):  # staggered arrivals
+            if pending:
+                k, r = pending.pop()
+                eng.submit(r)
+                submitted.append((k, r))
+        eng._admit()
+        # transfer-count probe: the device mirrors reflect the PREVIOUS
+        # dispatch's inputs, so an upload at this step is legitimate iff
+        # anything the dispatch consumes changed since then — tenants
+        # (admission/release/spill), page tables (growth/scratch reset),
+        # the stall/prefilling sets (the active mask), or host-dirtied
+        # carry rows.  Two consecutive dispatches with identical
+        # signatures and a climbing upload counter = a real regression.
+        sig = (
+            tuple(id(s) for s in eng.slots),
+            eng.tables.tobytes(),
+            eng.stalled.tobytes(),
+            eng.prefilling.tobytes(),
+            not eng._carry_dirty,
+        )
+        uploads_before = eng.device_uploads
+        if any(s is not None for s in eng.slots):
+            eng.step()
+            steps += 1
+            # ...and unchanged ACROSS the step too: _prepare_step grows
+            # page tables (and releases/spills slots) inside step(), and
+            # those mutations legitimately refresh the view at the very
+            # dispatch they happen in
+            post_sig = (
+                tuple(id(s) for s in eng.slots),
+                eng.tables.tobytes(),
+                eng.stalled.tobytes(),
+                eng.prefilling.tobytes(),
+            )
+            if (
+                sig == prev_sig
+                and sig[4]
+                and post_sig == sig[:4]
+                and steps > 2
+                and eng.device_uploads != uploads_before
+            ):
+                upload_violations += 1
+            prev_sig = sig
+        for k, r in submitted:
+            if k in cancel_at and len(r.output) >= cancel_at[k]:
+                r.cancel()
+                del cancel_at[k]
+        if steps > 50_000:
+            raise RuntimeError("soak did not converge")
+    streams = []
+    for k, r in sorted((k, r) for k, r in submitted):
+        if r.error:
+            raise RuntimeError(f"request {k} failed: {r.error}")
+        streams.append(
+            (k, list(r.output), list(r.token_logprobs), bool(r.cancelled))
+        )
+    gap = eng.host_gap_stats()
+    return streams, gap["mean_ms"], gap["chunks"], upload_violations
+
+
+def main() -> int:
+    n_requests = 24
+    rounds = 3
+    args = sys.argv[1:]
+    i = 0
+    while i < len(args):
+        if args[i].startswith("--requests="):
+            n_requests = int(args[i].split("=", 1)[1])
+        elif args[i].startswith("--rounds="):
+            rounds = int(args[i].split("=", 1)[1])
+        else:
+            print(f"unknown argument {args[i]!r}", file=sys.stderr)
+            return 2
+        i += 1
+    seed = int(os.environ.get("CHECK_OVERLAP_SEED", "20260803"))
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    failures = []
+    off_gaps, on_gaps = [], []
+    chunks = 0
+    for r in range(rounds):
+        # interleaved off/on rounds on the same workload: a throttling
+        # storm spanning a round hits both modes' gap measurements
+        off_streams, off_gap, off_chunks, _ = _soak(
+            False, seed + r, n_requests, params, cfg
+        )
+        on_streams, on_gap, on_chunks, violations = _soak(
+            True, seed + r, n_requests, params, cfg
+        )
+        off_gaps.append(off_gap)
+        on_gaps.append(on_gap)
+        chunks += off_chunks + on_chunks
+        for (k, toks_off, lps_off, c_off), (k2, toks_on, lps_on, c_on) in zip(
+            off_streams, on_streams
+        ):
+            assert k == k2
+            if c_off or c_on:
+                # a cancelled request's stream is timing-dependent in BOTH
+                # modes (the cancel lands at a host-chosen step boundary);
+                # parity bar: what WAS emitted agrees up to the shorter
+                n = min(len(toks_off), len(toks_on))
+                if toks_off[:n] != toks_on[:n]:
+                    failures.append(
+                        f"round {r} req {k}: cancelled-stream prefix "
+                        f"mismatch {toks_off[:n]} vs {toks_on[:n]}"
+                    )
+                continue
+            if toks_off != toks_on:
+                failures.append(
+                    f"round {r} req {k}: token stream mismatch "
+                    f"{toks_off} vs {toks_on}"
+                )
+            elif lps_off != lps_on:
+                failures.append(f"round {r} req {k}: logprob mismatch")
+        if violations:
+            failures.append(
+                f"round {r}: {violations} steady-state decode steps "
+                "re-uploaded batch state (transfer-count probe)"
+            )
+    # pooled gap comparison: min-of-rounds each side drops storms
+    off_best, on_best = min(off_gaps), min(on_gaps)
+    gap_ok = on_best < off_best
+    if not gap_ok:
+        failures.append(
+            f"host gap did not shrink: overlap-on {on_best:.4f}ms vs "
+            f"overlap-off {off_best:.4f}ms (min of {rounds} rounds)"
+        )
+    result = {
+        "requests": n_requests * rounds,
+        "decode_chunks": chunks,
+        "serve_host_gap_ms": round(on_best, 4),
+        "serve_host_gap_off_ms": round(off_best, 4),
+        "gap_trials_on_ms": [round(g, 4) for g in on_gaps],
+        "gap_trials_off_ms": [round(g, 4) for g in off_gaps],
+        "parity": not any("mismatch" in f for f in failures),
+        "ok": not failures,
+    }
+    print(json.dumps(result))
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
