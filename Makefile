@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-serve-overlap proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -44,6 +44,15 @@ check-plan-budget:
 # regresses past JOURNAL_OVERHEAD_BUDGET_PCT (default 5%).
 check-journal:
 	python tools/check_journal.py
+
+# Defragmentation gate: randomized bind/forget soak until the mesh
+# fragments (every node below the gang member size), then hard-fails
+# unless an `auto` defrag round makes the previously-unplaceable gang
+# bindable, the fragmentation index drops, every migration is journaled
+# and replay-verified (incl. the chip-conservation invariant), and bind
+# p99 with --defrag=off shows no regression.
+check-defrag:
+	python tools/check_defrag.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

@@ -504,6 +504,77 @@ def journal_overhead_bench(chunks: int = 40, chunk_n: int = 40) -> dict:
     }
 
 
+def defrag_bench() -> dict:
+    """Defragmentation planner cost + recovery on two canonical shapes.
+
+    (1) Unblock: three 2x4 nodes each left with 3 scattered free chips —
+    a 2-member gang of 4-chip members is unplaceable (no node holds 4
+    free) until a round consolidates; the round wall (plan on clones +
+    journal-less live migrations) is ``defrag_round_ms``.
+    (2) Compaction: a 4x4 node fully churned down to ONE mid-grid tenant
+    splitting a 15-chip free region; one intra-node move re-grows the
+    largest free contiguous box — the gain is
+    ``defrag_recovered_submesh_chips``.
+
+    Pure scheduler plane (no jax, no HTTP): the costs being priced are
+    the planner's clone/scan work and the migrate transactions."""
+    # (1) unblock round wall
+    cluster = FakeCluster()
+    for i in range(3):
+        cluster.add_node(
+            make_tpu_node(
+                f"node-{i}", chips=8, hbm_gib=128, accelerator="v5e",
+                slice_topology="2x4", host_topology="2x4",
+                slice_name=f"s{i}",
+            )
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="ici-locality",
+                    defrag_mode="auto", defrag_min_interval=0.0)
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    for n in range(3):
+        for j in range(5):
+            pod = tpu_pod(f"df-{n}-{j}", core=100)
+            cluster.create_pod(pod)
+            sched.bind(f"node-{n}", pod)
+    result = gang.defrag.run_round(sched=sched, want=(4, 2))
+    out = {
+        "defrag_round_ms": result["round_ms"],
+        "defrag_moves": result["executed"],
+        "defrag_unblocked": bool(result["feasible_after"]),
+    }
+
+    # (2) compaction recovery
+    cluster2 = FakeCluster()
+    cluster2.add_node(
+        make_tpu_node(
+            "big-0", chips=16, hbm_gib=256, accelerator="v5e",
+            slice_topology="4x4", host_topology="4x4", slice_name="big",
+        )
+    )
+    clientset2 = FakeClientset(cluster2)
+    registry2, *_rest, gang2 = build_stack(
+        clientset2, cluster=None, priority="ici-locality",
+        defrag_mode="auto", defrag_threshold=0.05, defrag_min_interval=0.0,
+    )
+    sched2 = registry2[consts.RESOURCE_TPU_CORE]
+    pods = []
+    for j in range(16):
+        pod = tpu_pod(f"cb-{j}", core=100)
+        cluster2.create_pod(pod)
+        sched2.bind("big-0", pod)
+        pods.append(pod)
+    for pod in pods:
+        _, opt = sched2.pod_maps[pod.key]
+        if opt.allocs[0].coords[0] != (1, 1):
+            sched2.forget_pod(pod)
+    res2 = gang2.defrag.run_round(sched=sched2)
+    out["defrag_recovered_submesh_chips"] = res2["recovered_submesh_chips"]
+    return out
+
+
 def chip_peak_tflops_bf16() -> float:
     """Detected chip's bf16 peak (TFLOPS) for MFU accounting."""
     import jax
@@ -1674,6 +1745,15 @@ def main():
             )
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["journal_overhead_error"] = str(e)[:300]
+
+    # defrag planner: round wall + recovered contiguous capacity on the
+    # canonical unblock/compaction shapes (tools/check_defrag.py gates
+    # the full soak; these keys track the cost/benefit over time).
+    # Guarded like the journal bench: a crash keeps the artifact.
+    try:
+        results.update(defrag_bench())
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["defrag_bench_error"] = str(e)[:300]
 
     # overlapped decode pipeline: host gap + speedup vs the sequential
     # loop, measured on CPU so the keys land in EVERY artifact (the same

@@ -42,6 +42,12 @@ def build_stack(
     modes: tuple[str, ...] = ("tpushare",),
     workers: int = 1,
     gang_timeout: float = 30.0,
+    defrag_mode: str = "off",
+    defrag_threshold: float = 0.5,
+    defrag_max_moves: int = 8,
+    defrag_priority_ceiling: int = 0,
+    defrag_interval: float = 30.0,
+    defrag_min_interval: float = 5.0,
 ):
     """Wire registry + handlers + controller (reference: main.go:56-96)."""
     # warm the native placement extension at startup so the first large-mesh
@@ -53,6 +59,21 @@ def build_stack(
     config = SchedulerConfig(clientset=clientset, rater=rater)
     registry = build_resource_schedulers(list(modes), config)
     gang = GangCoordinator(clientset, timeout=gang_timeout)
+    # defrag planner: always constructed (the /debug/defrag preview and
+    # manual POST /defrag/run work in every mode); 'off' costs one
+    # attribute check on the gang filter's infeasible path and nothing
+    # anywhere near bind
+    from .defrag import DefragPlanner
+
+    gang.defrag = DefragPlanner(
+        registry.values(), clientset,
+        mode=defrag_mode,
+        threshold=defrag_threshold,
+        max_moves=defrag_max_moves,
+        priority_ceiling=defrag_priority_ceiling,
+        interval_s=defrag_interval,
+        min_interval_s=defrag_min_interval,
+    )
     predicate = Predicate(registry, gang=gang)
     prioritize = Prioritize(registry)
     bind = Bind(registry, clientset, gang=gang)
@@ -140,6 +161,37 @@ def main(argv=None) -> int:
         "--journal-max-bytes", type=int, default=64 << 20,
         help="journal segment size before rotation (bytes, default 64MiB)",
     )
+    p.add_argument(
+        "--defrag", default="off", choices=["off", "observe", "auto"],
+        help="mesh defragmentation: off (default; zero bind-path cost), "
+        "observe (plans served at /debug/defrag, POST /defrag/run may "
+        "execute), auto (gang filters retry after an unblocking round + "
+        "a background tick compacts over-threshold nodes)",
+    )
+    p.add_argument(
+        "--defrag-threshold", type=float, default=0.5,
+        help="per-node fragmentation index (1 - largest_free_box/"
+        "free_chips) above which auto mode compacts the node",
+    )
+    p.add_argument(
+        "--defrag-max-moves", type=int, default=8,
+        help="migration budget per defrag round",
+    )
+    p.add_argument(
+        "--defrag-priority-ceiling", type=int, default=0,
+        help="never migrate a pod (or any member of a gang) whose "
+        "priority exceeds this",
+    )
+    p.add_argument(
+        "--defrag-interval", type=float, default=30.0,
+        help="auto-mode background tick period (seconds)",
+    )
+    p.add_argument(
+        "--defrag-min-interval", type=float, default=5.0,
+        help="minimum seconds between gang-filter unblock rounds (rate "
+        "limit: a stream of infeasible gangs must not thrash the "
+        "cluster with migrations)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -210,13 +262,19 @@ def main(argv=None) -> int:
         )
         return 2
 
-    registry, predicate, prioritize, bind, controller, status, _ = build_stack(
+    registry, predicate, prioritize, bind, controller, status, gang = build_stack(
         clientset,
         cluster=cluster,
         priority=args.priority,
         modes=tuple(m for m in args.mode.split(",") if m),
         workers=args.threadness,
         gang_timeout=args.gang_timeout,
+        defrag_mode=args.defrag,
+        defrag_threshold=args.defrag_threshold,
+        defrag_max_moves=args.defrag_max_moves,
+        defrag_priority_ceiling=args.defrag_priority_ceiling,
+        defrag_interval=args.defrag_interval,
+        defrag_min_interval=args.defrag_min_interval,
     )
     if controller is not None:
         controller.start()
@@ -235,6 +293,14 @@ def main(argv=None) -> int:
         )
         elector.start()
 
+    defrag = gang.defrag
+    if elector is not None:
+        # standbys must not migrate: the auto tick and the gang filter's
+        # try_unblock consult the same leader predicate the HTTP layer
+        # gates verbs with
+        defrag.leader_check = elector.is_leader
+    defrag.start()  # auto-mode background tick (no-op in off/observe)
+
     from .server.handlers import Preemption
 
     server = ExtenderServer(
@@ -244,6 +310,7 @@ def main(argv=None) -> int:
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         workers=max(0, args.http_workers),
         leader_check=elector.is_leader if elector is not None else None,
+        defrag=defrag,
     )
 
     stop = threading.Event()
@@ -266,6 +333,7 @@ def main(argv=None) -> int:
         while not stop.wait(0.5):
             pass
     finally:
+        defrag.stop()
         if controller is not None:
             controller.stop()
         if args.journal_dir:

@@ -158,6 +158,22 @@ class Controller:
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_period):
             self._enqueue_all()
+            self._prune_cordons()
+
+    def _prune_cordons(self) -> None:
+        """Expire stale defrag cordons (the safety net for a planner
+        that crashed mid-round holding nodes cordoned: every cordon
+        carries a TTL, and this resync tick is what enforces it when
+        nothing else touches the node)."""
+        seen: list[int] = []
+        for sched in self.registry.values():
+            if id(sched) in seen:
+                continue
+            seen.append(id(sched))
+            try:
+                sched.prune_cordons()
+            except Exception:
+                pass
 
     def _enqueue_all(self) -> None:
         try:

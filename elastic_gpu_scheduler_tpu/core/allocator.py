@@ -67,6 +67,19 @@ class Option:
         return {a.container: a.coords for a in self.allocs}
 
 
+def option_demand(option: Option) -> tuple:
+    """Per-container demand signature — what a placement CONSUMES,
+    independent of WHERE it lands: (container, chip count, whole, core,
+    hbm) per alloc.  A live migration must preserve this exactly; the
+    journal replay's chip-conservation invariant and the scheduler's
+    ``migrate_pod`` guard both compare through this one function so the
+    accounting can never diverge."""
+    return tuple(
+        (a.container, len(a.coords), bool(a.whole), a.core, a.hbm)
+        for a in option.allocs
+    )
+
+
 class Rater:
     """Placement policy: rate a complete assignment (reference: rater.go:8-10).
 

@@ -1,0 +1,931 @@
+"""Mesh defragmentation & live-migration planner.
+
+The scheduler only ever ADDS placements: after enough pod churn the ICI
+mesh fragments — free chips scatter across nodes, large gangs stop
+fitting (every node's free count drops below the member size even though
+the cluster-wide total is ample), and the fragmentation gauges
+(``tpu_scheduler_mesh_fragmentation_index``,
+``largest_free_submesh_chips``) climb with nothing acting on them.
+Tesserae (arxiv 2508.04953) shows migration-aware placement recovers
+most of that lost capacity; Gavel (arxiv 2008.09213) shows round-based
+re-placement composes cleanly with an existing scheduler.  This module
+is that capability for the TPU mesh:
+
+- **Detect.**  The planner consumes the SAME per-node chip state the
+  LazyGauge refresher scans (``ChipSet.fragmentation()`` /
+  ``largest_free_box()`` on clones — never live state): a round triggers
+  when a pending gang's shape cannot fit any node (``try_unblock``, the
+  gang filter's admission-retry hook) or when a node's fragmentation
+  index exceeds the configured threshold (the auto loop / POST
+  /defrag/run).
+
+- **Plan.**  ``plan()`` computes a migration plan — which victims move
+  where — as a list of ROUNDS.  Within one round every destination uses
+  only chips that were free at round start (placements accumulate into
+  the simulation immediately; evictions apply at round END), which makes
+  rounds structurally acyclic (no A→B→A in a round: chips freed by a
+  round's evictions only become destinations in the NEXT round) and
+  makes every move executable in any order.  Victim re-placements are
+  scored with the existing machinery: whole-chip shapes through the
+  ``plan_gang`` kernel (native C++ when built, bit-identical Python
+  fallback), everything else through ``ChipSet.trade`` under the
+  engine's own rater.  Victim selection is a documented greedy
+  (largest-that-fits first per deficit, smallest-overshoot fallback) —
+  a min-cost heuristic, not an ILP.  Plans are chip-conserving by
+  construction (the new Option carries the same per-container demand as
+  the old; ``option_demand`` guards it again at execution and replay)
+  and never touch a pod — or any member of a gang — whose priority
+  exceeds ``priority_ceiling``.
+
+- **Execute.**  Each move is a journaled evict→rebind transaction
+  (``TPUUnitScheduler.migrate_pod``: destination is charged BEFORE the
+  source is freed, so the unsafe direction — double-booking others —
+  cannot occur; the journal's new ``migrate`` record captures both
+  placements and replay verifies the per-pod chip-count conservation
+  invariant).  A round is all-or-nothing: a mid-round failure reverses
+  every executed move with compensating migrations.  Nodes involved in
+  a round are CORDONED on the engine (filter rejects them; the
+  reconciliation controller expires stale cordons) for the duration.
+  Migration hooks (``defrag.hooks``) bracket each move with the serving
+  plane's drain/elastic-resume path so a migrated serving pod loses at
+  most one in-flight chunk.
+
+Modes: ``off`` (default — the only cost anywhere near the bind path is
+one attribute check in the gang filter), ``observe`` (plans are computed
+and served at /debug/defrag, never executed automatically; POST
+/defrag/run may still execute), ``auto`` (the gang filter retries
+admission after an unblocking round, and a background tick compacts
+nodes over the threshold).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from ..core.allocator import (
+    ChipSet,
+    ContainerAlloc,
+    Option,
+    iter_bits,
+    option_demand,
+    plan_gang_fallback,
+)
+from ..core.request import pod_gang_key
+from ..journal.replay import request_from_option
+from ..metrics import (
+    DEFRAG_EVENTS,
+    DEFRAG_RECOVERED,
+    DEFRAG_ROUND,
+    TimedLock,
+)
+from .hooks import MigrationHook
+
+log = logging.getLogger("tpu-scheduler")
+
+MODES = ("off", "observe", "auto")
+
+
+@dataclass(frozen=True)
+class Move:
+    """One planned migration: a live pod re-homed from one placement to
+    another.  ``old``/``new`` carry identical per-container demand
+    (chip-conserving by construction)."""
+
+    pod_key: str
+    uid: str
+    from_node: str
+    to_node: str
+    old: Option
+    new: Option
+    chips: int  # whole-chip count moved (fractional moves count their chips)
+    priority: int = 0
+    gang: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "pod": self.pod_key,
+            "from": self.from_node,
+            "to": self.to_node,
+            "chips": self.chips,
+            "priority": self.priority,
+            "gang": self.gang or None,
+            "coords_from": [
+                [list(c) for c in a.coords]
+                for a in self.old.allocs if a.needs_tpu
+            ],
+            "coords_to": [
+                [list(c) for c in a.coords]
+                for a in self.new.allocs if a.needs_tpu
+            ],
+        }
+
+
+@dataclass
+class DefragPlan:
+    """Rounds of moves plus the predicted effect.  ``rounds[k]``'s
+    destinations only use chips free before round k executed."""
+
+    rounds: list = field(default_factory=list)  # list[list[Move]]
+    reason: str = ""
+    want: Optional[tuple] = None  # (chips_per_member, members) when unblocking
+    frag_before: dict = field(default_factory=dict)  # node → (index, largest)
+    frag_after: dict = field(default_factory=dict)
+    feasible_before: Optional[bool] = None
+    feasible_after: Optional[bool] = None
+
+    def moves(self) -> list:
+        return [m for rnd in self.rounds for m in rnd]
+
+    @property
+    def chips_moved(self) -> int:
+        return sum(m.chips for m in self.moves())
+
+    def recovered_submesh_chips(self) -> int:
+        """Largest gain in any node's largest-free-contiguous-box — the
+        headline 'capacity recovered' number."""
+        gain = 0
+        for node, (_, after) in self.frag_after.items():
+            before = self.frag_before.get(node, (0.0, after))[1]
+            gain = max(gain, after - before)
+        return gain
+
+    def to_dict(self) -> dict:
+        return {
+            "reason": self.reason,
+            "want": list(self.want) if self.want else None,
+            "rounds": [[m.to_dict() for m in rnd] for rnd in self.rounds],
+            "moves": len(self.moves()),
+            "chips_moved": self.chips_moved,
+            "feasible_before": self.feasible_before,
+            "feasible_after": self.feasible_after,
+            "recovered_submesh_chips": self.recovered_submesh_chips(),
+            "frag_before": {
+                n: {"index": i, "largest_free_box": l}
+                for n, (i, l) in sorted(self.frag_before.items())
+            },
+            "frag_after": {
+                n: {"index": i, "largest_free_box": l}
+                for n, (i, l) in sorted(self.frag_after.items())
+            },
+        }
+
+
+@dataclass
+class _Victim:
+    """A movable live pod in the planning snapshot."""
+
+    pod_key: str
+    uid: str
+    node: str
+    option: Option
+    priority: int
+    gang: str
+    whole: bool  # single whole-chip alloc (plan_gang-placeable)
+    chips: int  # chips freed on the source node if moved
+
+
+def best_whole_box(
+    cs: ChipSet, count: int, max_candidates: int = 64,
+    force_fallback: bool = False,
+):
+    """Best ``count``-chip contiguous box on ``cs``'s free chips — THE
+    defrag scoring entry point into the gang-plan kernel: native
+    ``plan_gang`` with members=1 when built, the bit-identical Python
+    fallback otherwise (tests/test_defrag.py asserts parity directly on
+    this function).  Returns (coords, contiguous) or None when fewer
+    than ``count`` chips are free."""
+    if cs.free_count() < count:
+        return None
+    free_list = tuple(cs._mesh_idx[i] for i in iter_bits(cs._free_bits))
+    native = None
+    if not force_fallback:
+        from ..core.native import get_placement
+
+        native = get_placement()
+    if native is not None and hasattr(native, "plan_gang"):
+        placed = native.plan_gang(
+            cs.topo.dims, cs.topo.wrap, [free_list], count, 1, max_candidates
+        )
+    else:
+        placed = plan_gang_fallback(
+            cs.topo, [free_list], count, 1, max_candidates
+        )
+    if not placed:
+        return None
+    _, idxs, contiguous = placed[0]
+    return tuple(cs.topo.coord_of(i) for i in idxs), bool(contiguous)
+
+
+def _rebuild_option(old: Option, coords, contiguous: bool) -> Option:
+    """New Option with the SAME per-container demand as ``old``, its one
+    TPU alloc re-targeted at ``coords`` (chip-conserving by construction)."""
+    allocs = []
+    for a in old.allocs:
+        if not a.needs_tpu:
+            allocs.append(a)
+            continue
+        allocs.append(
+            ContainerAlloc(
+                container=a.container, coords=tuple(coords), whole=a.whole,
+                core=a.core, hbm=a.hbm,
+                contiguous=bool(contiguous) if a.whole else True,
+            )
+        )
+    return Option(old.request_hash, tuple(allocs), old.score)
+
+
+class DefragPlanner:
+    """Round-based migration planner over one scheduler's engines.
+
+    Thread model: ``_lock`` (TimedLock rank 15 — between the gang
+    coordinator (10) and the engine registry lock (20); a round takes
+    engine + node locks, and the gang filter calls ``try_unblock``
+    AFTER releasing its own lock) serializes planning and execution, so
+    at most one round mutates live state at a time.  All planning runs
+    on O(words) ChipSet clones; live allocators are only touched by
+    ``migrate_pod`` during execution.
+    """
+
+    def __init__(
+        self,
+        engines: Iterable,
+        clientset,
+        mode: str = "off",
+        threshold: float = 0.5,
+        max_moves: int = 8,
+        max_rounds: int = 4,
+        priority_ceiling: int = 0,
+        min_interval_s: float = 5.0,
+        cordon_ttl_s: float = 120.0,
+        interval_s: float = 30.0,
+        hooks: Optional[list] = None,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"defrag mode {mode!r} not in {MODES}")
+        # unique engines (the registry maps several resource names to one)
+        seen: list = []
+        for e in engines:
+            if all(e is not s for s in seen):
+                seen.append(e)
+        self.engines = seen
+        self.clientset = clientset
+        self.mode = mode
+        self.threshold = threshold
+        self.max_moves = max(1, max_moves)
+        self.max_rounds = max(1, max_rounds)
+        self.priority_ceiling = priority_ceiling
+        self.min_interval_s = min_interval_s
+        self.cordon_ttl_s = cordon_ttl_s
+        self.interval_s = max(1.0, interval_s)
+        self.hooks: list[MigrationHook] = list(hooks or [])
+        # HA: callable → bool; standbys must not migrate (the HTTP layer
+        # gates verbs the same way).  None = always the leader.
+        self.leader_check = None
+        self._lock = TimedLock("defrag", rank=15)
+        self._last_round = 0.0  # monotonic; rate-limits try_unblock
+        self._rounds_run = 0
+        self._moves_executed = 0
+        self._last_result: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle (auto mode) -----------------------------------------------
+
+    def start(self) -> "DefragPlanner":
+        """Start the auto-mode background tick (no-op otherwise)."""
+        if self.mode != "auto" or self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._auto_loop, name="defrag-auto", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _is_leader(self) -> bool:
+        if self.leader_check is None:
+            return True
+        try:
+            return bool(self.leader_check())
+        except Exception:
+            return False
+
+    def _auto_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not self._is_leader():
+                continue  # standby: migrating would split-brain the leader
+            try:
+                for sched in self.engines:
+                    snap = sched.frag_snapshot()
+                    if any(
+                        idx > self.threshold for idx, _ in snap.values()
+                    ):
+                        self.run_round(sched=sched)
+            except Exception:
+                log.exception("defrag auto tick failed")
+
+    # -- snapshot -------------------------------------------------------------
+
+    @staticmethod
+    def _chip_clones(sched) -> dict:
+        """Per-node ChipSet clones only — O(words) each, NO clientset
+        round-trips.  The feasibility probe uses this; full planning
+        needs ``_snapshot`` (which adds the movable-pod scan)."""
+        with sched.lock:
+            allocators = dict(sched.allocators)
+        clones: dict[str, ChipSet] = {}
+        for name, na in allocators.items():
+            with na.lock:
+                clones[name] = na.chips.clone()
+        return clones
+
+    def _snapshot(self, sched):
+        """(clones, victims_by_node): per-node ChipSet clones plus the
+        MOVABLE live pods.  Ledger under the engine lock, pod objects
+        from the clientset (priority/uid/gang), chip state under each
+        node's own lock — never the whole registry frozen at once."""
+        with sched.lock:
+            ledger = dict(sched.pod_maps)
+        clones = self._chip_clones(sched)
+        # gang priority ceiling: a gang moves as a unit of risk — if ANY
+        # member outranks the ceiling, no member is movable
+        gang_max_prio: dict[str, int] = {}
+        pods: dict[str, object] = {}
+        for key, (node, opt) in ledger.items():
+            ns, _, name = key.partition("/")
+            try:
+                pod = self.clientset.get_pod(ns, name)
+            except Exception:
+                continue
+            if pod.is_completed():
+                continue
+            pods[key] = pod
+            g = pod_gang_key(pod)
+            if g:
+                prio = pod.spec.priority or 0
+                gang_max_prio[g] = max(gang_max_prio.get(g, prio), prio)
+        victims: dict[str, list[_Victim]] = {}
+        for key, (node, opt) in ledger.items():
+            pod = pods.get(key)
+            if pod is None or node not in clones:
+                continue
+            prio = pod.spec.priority or 0
+            gang = pod_gang_key(pod) or ""
+            if prio > self.priority_ceiling:
+                continue
+            if gang and gang_max_prio.get(gang, 0) > self.priority_ceiling:
+                continue
+            tpu = [a for a in opt.allocs if a.needs_tpu]
+            if len(tpu) != 1:
+                continue  # multi-alloc pods: not movable (rare; skip)
+            a = tpu[0]
+            cs = clones[node]
+            if a.whole:
+                chips_freed = len(a.coords)
+            else:
+                # a fractional tenant only returns a WHOLE chip if it is
+                # the sole tenant; co-tenanted chips gain nothing whole
+                i = cs._slot.get(a.coords[0]) if a.coords else None
+                if i is None:
+                    continue
+                sole = (
+                    cs._core_avail[i] + a.core == cs._core_total[i]
+                    and cs._hbm_avail[i] + a.hbm == cs._hbm_total[i]
+                )
+                if not sole:
+                    continue
+                chips_freed = len(a.coords)
+            victims.setdefault(node, []).append(
+                _Victim(
+                    pod_key=key, uid=pod.metadata.uid, node=node,
+                    option=opt, priority=prio, gang=gang,
+                    whole=a.whole, chips=chips_freed,
+                )
+            )
+        return clones, victims
+
+    @staticmethod
+    def _frag_of(clones: dict) -> dict:
+        out = {}
+        for n, cs in clones.items():
+            idx, largest, _free = cs.fragmentation()  # ONE box scan/node
+            out[n] = (idx, largest)
+        return out
+
+    @staticmethod
+    def _feasible(clones: dict, count: int, members: int) -> bool:
+        """Would the gang-plan kernel place all ``members`` now?  Walks
+        the SAME per-topology-run stream the gang planner walks."""
+        nodes = sorted(clones.items())
+        remaining = members
+        pos = 0
+        while pos < len(nodes) and remaining > 0:
+            topo = nodes[pos][1].topo
+            end = pos
+            while end < len(nodes) and nodes[end][1].topo == topo:
+                end += 1
+            free_lists = [
+                tuple(cs._mesh_idx[i] for i in iter_bits(cs._free_bits))
+                for _, cs in nodes[pos:end]
+            ]
+            placed = plan_gang_fallback(topo, free_lists, count, remaining)
+            remaining -= len(placed)
+            pos = end
+        return remaining <= 0
+
+    # -- planning -------------------------------------------------------------
+
+    def _place_victim(self, sched, v: _Victim, dest: ChipSet):
+        """Re-place one victim on ``dest`` (a round clone: placements
+        already applied, evictions NOT — so only round-start-free chips
+        are eligible, which is what keeps rounds acyclic).  Returns the
+        new Option or None."""
+        if v.whole:
+            found = best_whole_box(dest, v.chips)
+            if found is None:
+                return None
+            coords, contiguous = found
+            return _rebuild_option(v.option, coords, contiguous)
+        # fractional: the engine's own rater picks the chip (binpack
+        # prefers shared chips, preserving whole-free ones)
+        req = request_from_option(v.option, v.pod_key, v.uid)
+        opt = dest.trade(req, sched.rater)
+        if opt is None:
+            return None
+        a = next(x for x in opt.allocs if x.needs_tpu)
+        return _rebuild_option(v.option, a.coords, a.contiguous)
+
+    def _plan_unblock_round(
+        self, sched, clones, victims, count: int, budget: int
+    ) -> list:
+        """One round of cross-node consolidation toward fitting a
+        ``count``-chip member: top up the node with the SMALLEST deficit
+        by moving its cheapest victims onto nodes that can absorb them
+        without creating a new deficit.  Returns the round's moves
+        (possibly empty = stuck)."""
+        free = {n: cs.free_count() for n, cs in clones.items()}
+        targets = sorted(
+            (n for n in clones if 0 < count - free[n]),
+            key=lambda n: (count - free[n], n),
+        )
+        moves: list[Move] = []
+        evictions: list[tuple[str, Option]] = []
+        for target in targets:
+            if budget - len(moves) <= 0:
+                break
+            deficit = count - free[target]
+            pool = sorted(
+                victims.get(target, []), key=lambda v: -v.chips
+            )
+            chosen: list[_Victim] = []
+            for v in pool:
+                if deficit <= 0:
+                    break
+                if v.chips <= deficit:
+                    chosen.append(v)
+                    deficit -= v.chips
+            if deficit > 0:
+                # overshoot fallback: smallest victim that closes it alone
+                rest = [v for v in pool if v not in chosen]
+                closer = sorted(
+                    (v for v in rest if v.chips >= deficit),
+                    key=lambda v: v.chips,
+                )
+                if closer:
+                    chosen.append(closer[0])
+                    deficit = 0
+            if deficit > 0:
+                continue  # this node cannot be topped up; try the next
+            placed_all = True
+            round_moves: list[Move] = []
+            for v in chosen:
+                if budget - len(moves) - len(round_moves) <= 0:
+                    placed_all = False
+                    break
+                # destination: smallest-free node that fits (keeps the
+                # big free pools intact for members), never the target —
+                # and never a node that is itself a viable member host
+                # which this placement would drop below the member size
+                # (destroying a viable host is how consolidation
+                # ping-pongs: the next round would target that node and
+                # push the victim straight back)
+                dests = sorted(
+                    (
+                        n for n in clones
+                        if n != target and not (
+                            clones[n].free_count() >= count
+                            and clones[n].free_count() - v.chips < count
+                        )
+                    ),
+                    key=lambda n: (clones[n].free_count(), n),
+                )
+                new_opt = None
+                for d in dests:
+                    new_opt = self._place_victim(sched, v, clones[d])
+                    if new_opt is not None:
+                        dest_name = d
+                        break
+                if new_opt is None:
+                    placed_all = False
+                    break
+                clones[dest_name].transact(new_opt)  # placement: immediate
+                round_moves.append(
+                    Move(
+                        pod_key=v.pod_key, uid=v.uid, from_node=target,
+                        to_node=dest_name, old=v.option, new=new_opt,
+                        chips=v.chips, priority=v.priority, gang=v.gang,
+                    )
+                )
+            if not placed_all:
+                # roll the simulation back for this target's partial set
+                for m in reversed(round_moves):
+                    clones[m.to_node].cancel(m.new)
+                continue
+            moves.extend(round_moves)
+            for v in chosen:
+                evictions.append((target, v.option))
+                victims[target] = [
+                    x for x in victims[target] if x.pod_key != v.pod_key
+                ]
+            break  # one target per round: its eviction lands at round end
+        # evictions apply at round END — freed chips become destinations
+        # only in the NEXT round (the acyclicity rule)
+        for node, opt in evictions:
+            if clones[node].can_cancel(opt):
+                clones[node].cancel(opt)
+        return moves
+
+    def _plan_compact_round(
+        self, sched, clones, victims, budget: int
+    ) -> list:
+        """Intra-node compaction: re-place whole-chip victims into spots
+        that strictly grow the node's largest free contiguous box.  Only
+        round-start-free chips are eligible destinations (the victim's
+        own chips stay charged in the simulation until round end), so
+        the move is executable with the add-before-forget transaction."""
+        moves: list[Move] = []
+        evictions: list[tuple[str, Option]] = []
+        for node in sorted(clones):
+            cs = clones[node]
+            idx, largest, _free = cs.fragmentation()
+            if idx <= self.threshold:
+                continue
+            for v in sorted(victims.get(node, []), key=lambda v: v.chips):
+                if len(moves) >= budget:
+                    return self._apply_evictions(clones, evictions, moves)
+                if not v.whole:
+                    continue
+                found = best_whole_box(cs, v.chips)
+                if found is None:
+                    continue
+                coords, contiguous = found
+                if set(coords) & set(
+                    c for a in v.option.allocs for c in a.coords
+                ):
+                    continue  # self-overlap cannot happen (own chips busy)
+                sim = cs.clone()
+                new_opt = _rebuild_option(v.option, coords, contiguous)
+                sim.transact(new_opt)
+                sim.cancel(v.option)
+                if sim.largest_free_box() <= largest:
+                    continue  # not an improvement; skip
+                cs.transact(new_opt)
+                evictions.append((node, v.option))
+                victims[node] = [
+                    x for x in victims[node] if x.pod_key != v.pod_key
+                ]
+                moves.append(
+                    Move(
+                        pod_key=v.pod_key, uid=v.uid, from_node=node,
+                        to_node=node, old=v.option, new=new_opt,
+                        chips=v.chips, priority=v.priority, gang=v.gang,
+                    )
+                )
+                break  # one move per node per round; re-evaluate next round
+        return self._apply_evictions(clones, evictions, moves)
+
+    @staticmethod
+    def _apply_evictions(clones, evictions, moves):
+        for node, opt in evictions:
+            if clones[node].can_cancel(opt):
+                clones[node].cancel(opt)
+        return moves
+
+    def plan(self, sched, want: Optional[tuple] = None) -> DefragPlan:
+        """Compute a migration plan on clones (no live state touched).
+
+        ``want=(chips_per_member, members)`` plans cross-node
+        consolidation until that gang shape fits, then spends any
+        remaining move budget compacting over-threshold nodes; without
+        ``want`` it is compaction-only."""
+        clones, victims = self._snapshot(sched)
+        plan = DefragPlan(
+            want=want,
+            reason="unblock" if want else "threshold",
+            frag_before=self._frag_of(clones),
+        )
+        budget = self.max_moves
+        if want is not None:
+            count, members = want
+            plan.feasible_before = self._feasible(clones, count, members)
+            total_free = sum(cs.free_count() for cs in clones.values())
+            if plan.feasible_before or total_free < count * members:
+                # already fits (nothing to do) or CANNOT fit no matter
+                # how chips are shuffled (migration conserves free
+                # chips) — planning consolidation would only churn
+                plan.feasible_after = plan.feasible_before
+            else:
+                rounds = 0
+                while (
+                    budget > 0
+                    and rounds < self.max_rounds
+                    and not self._feasible(clones, count, members)
+                ):
+                    moves = self._plan_unblock_round(
+                        sched, clones, victims, count, budget
+                    )
+                    if not moves:
+                        break  # stuck: no victim/destination combo left
+                    plan.rounds.append(moves)
+                    budget -= len(moves)
+                    rounds += 1
+                plan.feasible_after = self._feasible(clones, count, members)
+                if plan.rounds and not plan.feasible_after:
+                    # partial consolidation that does NOT unblock the
+                    # gang is pure disruption (each executed move drains
+                    # a live workload) — discard it and let the trailing
+                    # compaction pass work on an untouched snapshot
+                    DEFRAG_EVENTS.inc("unblock_plan_discarded")
+                    plan.rounds = []
+                    budget = self.max_moves
+                    clones, victims = self._snapshot(sched)
+        # compaction pass (threshold mode, or leftover budget after an
+        # unblock): strictly-improving intra-node moves only
+        rounds = 0
+        while budget > 0 and rounds < self.max_rounds:
+            moves = self._plan_compact_round(sched, clones, victims, budget)
+            if not moves:
+                break
+            plan.rounds.append(moves)
+            budget -= len(moves)
+            rounds += 1
+        plan.frag_after = self._frag_of(clones)
+        return plan
+
+    # -- execution ------------------------------------------------------------
+
+    def _hook_drain(self, mv: Move) -> None:
+        for h in self.hooks:
+            try:
+                h.drain(mv.pod_key, mv.from_node)
+            except Exception:
+                log.exception("defrag drain hook failed for %s", mv.pod_key)
+
+    def _hook_resume(self, mv: Move) -> None:
+        for h in self.hooks:
+            try:
+                h.resume(mv.pod_key, mv.to_node)
+            except Exception:
+                log.exception("defrag resume hook failed for %s", mv.pod_key)
+
+    def _execute(self, sched, plan: DefragPlan) -> dict:
+        """Run a plan's moves round-by-round as journaled evict→rebind
+        transactions.  All-or-nothing: any failure reverses every
+        executed move with a compensating migration before raising."""
+        nodes = sorted(
+            {m.from_node for m in plan.moves()}
+            | {m.to_node for m in plan.moves()}
+        )
+        for n in nodes:
+            sched.cordon(n, ttl_s=self.cordon_ttl_s)
+        executed: list[Move] = []
+        try:
+            for rnd in plan.rounds:
+                for mv in rnd:
+                    ns, _, name = mv.pod_key.partition("/")
+                    pod = self.clientset.get_pod(ns, name)
+                    if pod.metadata.uid != mv.uid or pod.is_completed():
+                        raise RuntimeError(
+                            f"plan stale: pod {mv.pod_key} changed"
+                        )
+                    self._hook_drain(mv)
+                    try:
+                        sched.migrate_pod(
+                            pod, mv.from_node, mv.to_node, mv.old, mv.new,
+                            source="defrag",
+                        )
+                    finally:
+                        self._hook_resume(mv)
+                    executed.append(mv)
+                    DEFRAG_EVENTS.inc("move_executed")
+        except Exception as e:
+            DEFRAG_EVENTS.inc("round_failed")
+            for mv in reversed(executed):
+                # compensating move, with the SAME drain/resume hook
+                # bracketing as the forward path — the one-chunk loss
+                # bound holds for rollbacks too
+                rb = Move(
+                    pod_key=mv.pod_key, uid=mv.uid,
+                    from_node=mv.to_node, to_node=mv.from_node,
+                    old=mv.new, new=mv.old, chips=mv.chips,
+                    priority=mv.priority, gang=mv.gang,
+                )
+                try:
+                    ns, _, name = mv.pod_key.partition("/")
+                    pod = self.clientset.get_pod(ns, name)
+                    self._hook_drain(rb)
+                    try:
+                        sched.migrate_pod(
+                            pod, rb.from_node, rb.to_node, rb.old, rb.new,
+                            source="defrag_rollback",
+                        )
+                    finally:
+                        self._hook_resume(rb)
+                    DEFRAG_EVENTS.inc("move_rolled_back")
+                except Exception:
+                    DEFRAG_EVENTS.inc("rollback_failed")
+                    log.exception(
+                        "defrag rollback of %s failed — state may need a "
+                        "journal replay audit", mv.pod_key,
+                    )
+            raise RuntimeError(f"defrag round failed (rolled back): {e}") from e
+        finally:
+            for n in nodes:
+                sched.uncordon(n)
+        self._moves_executed += len(executed)
+        return {"executed": len(executed)}
+
+    def preview(self, sched=None, want: Optional[tuple] = None) -> dict:
+        """Non-blocking dry plan for ``/debug/defrag``: never parks
+        behind an executing round (whose per-move drains can take
+        seconds each — the observability endpoint must stay responsive
+        exactly then), and touches no telemetry or ``last_result``."""
+        sched = sched if sched is not None else self.engines[0]
+        if not self._lock.acquire(blocking=False):
+            return {"in_flight": True, "dry_run": True, "moves": 0}
+        try:
+            plan = self.plan(sched, want=want)
+        finally:
+            self._lock.release()
+        result = plan.to_dict()
+        result["dry_run"] = True
+        result["executed"] = 0
+        return result
+
+    def run_round(
+        self,
+        sched=None,
+        want: Optional[tuple] = None,
+        dry_run: bool = False,
+        min_interval_guard: bool = False,
+    ) -> dict:
+        """Plan (and unless ``dry_run``, execute) one defrag round.
+        Returns the plan + execution summary as a JSON-ready dict.
+
+        ``min_interval_guard`` re-checks the rate limiter INSIDE the
+        planner lock (try_unblock's pre-check races siblings: two
+        members can both read a stale ``_last_round`` while the first
+        round is still executing) — a guarded call that lost the race
+        returns ``{"rate_limited": True}`` instead of a second round."""
+        sched = sched if sched is not None else self.engines[0]
+        t0 = time.perf_counter()
+        with self._lock:
+            if (
+                min_interval_guard
+                and not dry_run
+                and time.monotonic() - self._last_round < self.min_interval_s
+            ):
+                DEFRAG_EVENTS.inc("unblock_rate_limited")
+                return {"rate_limited": True, "dry_run": False, "executed": 0}
+            plan = self.plan(sched, want=want)
+            result = plan.to_dict()
+            result["dry_run"] = dry_run
+            result["executed"] = 0
+            if dry_run:
+                # simulation only: no telemetry, no last_result — a
+                # polled /defrag/run preview must not clobber the record
+                # of the last REAL round or pollute the round histogram
+                result["round_ms"] = round(
+                    (time.perf_counter() - t0) * 1000, 3
+                )
+                return result
+            DEFRAG_EVENTS.inc("round_planned")
+            # stamp BEFORE executing: failed (rolled-back) and no-op
+            # rounds must count against the rate limiter too, or a
+            # persistently-failing round lets every gang-filter retry
+            # thrash the cluster with full execute+rollback cycles
+            self._last_round = time.monotonic()
+            if plan.moves():
+                result["executed"] = self._execute(sched, plan)["executed"]
+                self._rounds_run += 1
+                DEFRAG_EVENTS.inc("round_executed")
+                DEFRAG_RECOVERED.set(
+                    value=float(plan.recovered_submesh_chips())
+                )
+                # refresh the gauges' snapshot so /scheduler/status and
+                # the next detection pass see post-round reality
+                try:
+                    sched._refresh_frag_gauges()
+                except Exception:
+                    pass
+            else:
+                DEFRAG_EVENTS.inc("round_noop")
+            result["round_ms"] = round((time.perf_counter() - t0) * 1000, 3)
+            DEFRAG_ROUND.observe(value=time.perf_counter() - t0)
+            self._last_result = result
+            return result
+
+    # -- admission-retry hook (gang filter) -----------------------------------
+
+    @staticmethod
+    def _want_from_request(req) -> Optional[tuple]:
+        """(chips_per_member, members) for a homogeneous single
+        whole-chip-unit request (the SPMD gang shape), else None."""
+        tpu = [u for u in req.units if u.needs_tpu]
+        if len(tpu) != 1 or not tpu[0].wants_whole_chips:
+            return None
+        members = req.gang_size if req.gang_size > 1 else 1
+        return tpu[0].chip_count, members
+
+    def try_unblock(self, sched, req) -> bool:
+        """Gang-filter admission retry: in ``auto`` mode, run one
+        unblocking round for the rejected shape.  Returns True iff at
+        least one move executed (the caller then re-filters).  Rate
+        limited by ``min_interval_s`` so a stream of infeasible gangs
+        cannot thrash the cluster with migrations."""
+        if self.mode != "auto":
+            return False
+        if not self._is_leader():
+            return False  # standbys never migrate (HA split-brain)
+        want = self._want_from_request(req)
+        if want is None:
+            return False
+        # probe first: acquiring the planner lock PARKS behind any round
+        # in flight (a sibling member's), so when the shape already fits
+        # — that round just unblocked it, or the filter failure was a
+        # stale-plan/cordon race — the refilter succeeds without a new
+        # round and without tripping the rate limiter.  Chip-only clones:
+        # a permanently-infeasible gang re-filters every scheduling
+        # cycle, and this path must not pay a per-pod clientset scan
+        with self._lock:
+            if self._feasible(self._chip_clones(sched), *want):
+                return True
+        now = time.monotonic()
+        if now - self._last_round < self.min_interval_s:
+            DEFRAG_EVENTS.inc("unblock_rate_limited")
+            return False
+        try:
+            # guarded: the pre-check above races sibling members (both
+            # read _last_round before either round stamps it); the
+            # in-lock re-check makes the loser a no-op
+            result = self.run_round(
+                sched=sched, want=want, min_interval_guard=True
+            )
+        except RuntimeError:
+            return False  # round rolled back; nothing to retry against
+        if result.get("rate_limited"):
+            return False
+        # a refilter can only succeed when the simulated end state fits
+        # the gang; executed compaction moves alone are not that (and a
+        # plan that could not reach feasibility was discarded unexecuted)
+        if result.get("feasible_after"):
+            DEFRAG_EVENTS.inc("unblock_retry")
+            return True
+        return False
+
+    # -- introspection --------------------------------------------------------
+
+    def status(self) -> dict:
+        frag = {}
+        cordons: dict[str, float] = {}
+        for sched in self.engines:
+            try:
+                for n, (idx, largest) in sched.frag_snapshot().items():
+                    frag[n] = {
+                        "index": idx, "largest_free_submesh_chips": largest,
+                    }
+                cordons.update(sched.prune_cordons())
+            except Exception:
+                continue
+        return {
+            "mode": self.mode,
+            "threshold": self.threshold,
+            "max_moves": self.max_moves,
+            "max_rounds": self.max_rounds,
+            "priority_ceiling": self.priority_ceiling,
+            "rounds_run": self._rounds_run,
+            "moves_executed": self._moves_executed,
+            "cordoned": sorted(cordons),
+            "nodes": dict(sorted(frag.items())),
+            "last_result": self._last_result,
+        }

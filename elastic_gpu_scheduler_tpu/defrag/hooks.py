@@ -1,0 +1,101 @@
+"""Migration hooks: bracket each defrag move with the serving plane's
+drain / elastic-resume path.
+
+A live migration re-homes a pod's chips while its workload may be
+mid-decode.  The serving engine already owns the two halves of the
+story: graceful drain (stop admitting, let the in-flight fused chunk
+finish — ``server.inference.drain``) and elastic resume (a spilled or
+re-admitted request resumes token-identically; under the overlapped
+pipeline a released slot discards AT MOST the one in-flight chunk).
+The planner calls ``drain(pod, node)`` before each move and
+``resume(pod, node)`` after (including on the failure path), so a
+migrated serving pod loses at most one in-flight chunk and re-admits
+exactly where it stopped.
+
+This module is deliberately jax-free (duck-typed against the
+``EngineLoop`` surface) so the scheduler plane — and its smoke-tier
+tests — never import the model stack.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("tpu-scheduler")
+
+
+class MigrationHook:
+    """No-op base: a hook may veto nothing — migration proceeds either
+    way (the chip-state transaction is safe regardless); hooks only
+    bound how much in-flight work the move costs."""
+
+    def drain(self, pod_key: str, node: str) -> bool:
+        """Called BEFORE the pod's allocation moves.  Return True when
+        the workload is quiesced (best-effort; False = proceed anyway,
+        the overlap pipeline bounds the loss to one chunk)."""
+        return True
+
+    def resume(self, pod_key: str, node: str) -> None:
+        """Called AFTER the move (success or rollback): re-open
+        admissions / resume the workload."""
+
+
+class CallbackHook(MigrationHook):
+    """Adapter for tests and external agents: plain callables."""
+
+    def __init__(self, drain_fn=None, resume_fn=None):
+        self._drain = drain_fn
+        self._resume = resume_fn
+
+    def drain(self, pod_key: str, node: str) -> bool:
+        if self._drain is not None:
+            return bool(self._drain(pod_key, node))
+        return True
+
+    def resume(self, pod_key: str, node: str) -> None:
+        if self._resume is not None:
+            self._resume(pod_key, node)
+
+
+class ServingEngineHook(MigrationHook):
+    """Drain/resume a colocated serving ``EngineLoop`` (duck-typed:
+    needs ``loop.engine`` with ``draining``/``_work`` and
+    ``loop.drained``/``http_inflight`` — the exact surface
+    ``server.inference.drain`` drives).
+
+    drain: flips the engine into draining (new submits 503), wakes the
+    parked loop, and waits up to ``timeout`` for the loop thread to
+    observe idle — the in-flight fused chunk finishes, nothing after it
+    dispatches, so the move costs at most that one chunk.
+    resume: the elastic-resume half — re-opens admissions and clears the
+    drained latch; queued/re-admitted requests continue token-identically
+    (the engine's spill/resume machinery owns exactness).
+    """
+
+    def __init__(self, loop, timeout: float = 10.0):
+        self.loop = loop
+        self.timeout = timeout
+
+    def drain(self, pod_key: str, node: str) -> bool:
+        loop = self.loop
+        engine = loop.engine
+        deadline = time.monotonic() + self.timeout  # ONE budget for both waits
+        engine.draining = True
+        engine._work.set()  # wake a parked loop so it observes the drain
+        ok = loop.drained.wait(self.timeout)
+        while time.monotonic() < deadline and loop.http_inflight > 0:
+            time.sleep(0.01)
+        if not ok:
+            log.warning(
+                "defrag drain of %s timed out after %.1fs; migrating "
+                "anyway (at most one in-flight chunk is lost)",
+                pod_key, self.timeout,
+            )
+        return ok
+
+    def resume(self, pod_key: str, node: str) -> None:
+        loop = self.loop
+        loop.engine.draining = False
+        loop.drained.clear()
+        loop.engine._work.set()  # wake the loop to resume admissions

@@ -14,9 +14,12 @@ stack:
   the commit boundaries above ``ChipSet._set_slot`` (the scheduler's
   bind commit / ledger write, ``forget_pod``, ``add_pod``/startup
   replay, allocator creation and capacity resync, gang admit and
-  rollback).  Each record carries the pod's ``trace_id`` so journal
-  entries cross-link to ``/traces``, plus the node's fragmentation
-  snapshot at the checkpoint (the gauges' source of truth).
+  rollback, and the defrag planner's ``migrate`` evict→rebind
+  transactions — replay verifies a migration conserves the pod's
+  per-container chip demand).  Each record carries the pod's
+  ``trace_id`` so journal entries cross-link to ``/traces``, plus the
+  node's fragmentation snapshot at the checkpoint (the gauges' source
+  of truth).
 
 - **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
 

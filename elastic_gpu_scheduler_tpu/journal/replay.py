@@ -30,7 +30,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..core.allocator import ChipSet, ContainerAlloc, Option, Rater
+from ..core.allocator import (
+    ChipSet,
+    ContainerAlloc,
+    Option,
+    Rater,
+    option_demand,
+)
 from ..core.chip import Chip
 from ..core.request import NOT_NEEDED, TPURequest, TPUUnit
 from ..core.topology import Topology
@@ -299,6 +305,66 @@ def replay(events: list[dict]) -> ReplayResult:
                 )
                 continue
             cs.cancel(lp.option)
+        elif t == "migrate":
+            # defrag live migration: one atomic evict→rebind.  Invariant:
+            # a migration CONSERVES the pod's per-container chip demand
+            # (same chips, same core/hbm — only WHERE changes); the live
+            # transaction charges the destination before freeing the
+            # source, so replay mirrors that order.
+            pod = rec.get("pod")
+            frm, to = rec.get("source_node"), rec.get("node")
+            lp = res.pods.get(pod)
+            if lp is None:
+                res.violations.append(
+                    f"{where}: migrate of unbound pod {pod}"
+                )
+                continue
+            try:
+                new = option_from_record(rec["option"])
+                old = option_from_record(rec["option_old"])
+            except Exception as e:
+                res.violations.append(f"{where}: bad migrate option: {e}")
+                continue
+            if option_demand(old) != option_demand(new):
+                res.violations.append(
+                    f"{where}: migrate {pod} does not conserve per-pod "
+                    "chip demand (chips created or destroyed in flight)"
+                )
+                continue
+            if lp.node != frm or lp.option.allocs != old.allocs:
+                res.violations.append(
+                    f"{where}: migrate {pod} from {frm} does not match "
+                    f"its live placement (on {lp.node} since seq {lp.seq})"
+                )
+                continue
+            cs_to = res.nodes.get(to)
+            cs_from = res.nodes.get(frm)
+            if cs_to is None or cs_from is None:
+                res.violations.append(
+                    f"{where}: migrate {pod} touches unknown node "
+                    f"{frm if cs_from is None else to}"
+                )
+                continue
+            if not cs_to.can_transact(new):
+                res.violations.append(
+                    f"{where}: migrate {pod} onto {to} double-books a "
+                    "chip (destination no longer fits the replayed state)"
+                )
+                continue
+            cs_to.transact(new)
+            if lp.charged:
+                if cs_from.can_cancel(old):
+                    cs_from.cancel(old)
+                else:
+                    res.violations.append(
+                        f"{where}: migrate {pod} frees capacity not "
+                        f"charged on {frm} (double free / inflation)"
+                    )
+            res.pods[pod] = _LivePod(
+                node=to, option=new, uid=rec.get("uid", lp.uid),
+                gang=rec.get("gang", "") or lp.gang, seq=seq,
+                charged=True,  # the destination IS charged either way
+            )
         elif t == "gang_admit":
             gang = rec.get("gang", "?")
             g = res.gangs.setdefault(gang, {"admits": 0, "rollbacks": 0})
@@ -518,6 +584,43 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                     contiguous += 1
             cs.transact(opt)
             placed[rec.get("pod")] = (node, opt)
+        elif t == "migrate":
+            # defrag relocation (mirrors replay()'s handling — see the
+            # MAINTENANCE NOTE above): free the what-if placement, then
+            # let the ALTERNATIVE rater re-place the same demand on the
+            # recorded destination node; fall back to the recorded new
+            # placement so the stream stays consistent.  Not counted as
+            # a bind — the demand was already scored at its bind record.
+            pod = rec.get("pod")
+            entry = placed.pop(pod, None)
+            if entry is None:
+                # the what-if stream never placed this pod (its bind
+                # fell through under the alternative rater) — placing
+                # it here would charge chips for a pod the comparison
+                # counts as unplaced
+                continue
+            node, opt = entry
+            cs = nodes.get(node)
+            if cs is not None and cs.can_cancel(opt):
+                cs.cancel(opt)
+            to = rec.get("node")
+            cs = nodes.get(to)
+            if cs is None:
+                continue
+            try:
+                recorded_new = option_from_record(rec["option"])
+            except Exception:
+                continue
+            req = request_from_option(
+                recorded_new, pod or "?", rec.get("uid", "")
+            )
+            opt = cs.trade(req, rater)
+            if opt is None:
+                if not cs.can_transact(recorded_new):
+                    continue
+                opt = recorded_new
+            cs.transact(opt)
+            placed[pod] = (to, opt)
         elif t == "forget":
             entry = placed.pop(rec.get("pod"), None)
             if entry is not None:

@@ -25,10 +25,12 @@ _WAITS_CAP = 20000
 # Lock-ordering enforcement (VERDICT r4 #9): each ranked TimedLock may
 # only be acquired while every lock this thread already holds has a
 # STRICTLY LOWER rank.  The codebase's documented hierarchy:
-#     gang coordinator (10)  →  scheduler engine (20)
-# (per-gang condition vars sit below 10 and per-node allocator locks
-# above 20; they are plain locks today — the two big ranked locks are
-# where an inversion would deadlock the whole control plane.)  An
+#     gang coordinator (10)  →  defrag planner (15)  →
+#     scheduler engine (20)  →  per-node allocator locks (30)
+# (per-gang condition vars sit below 10; the defrag planner lock
+# serializes migration rounds and may be held while taking engine/node
+# locks — the gang filter only calls the planner AFTER releasing its
+# own lock.)  An
 # inversion raises immediately: it is a deadlock that hasn't happened
 # yet, and the GIL hides it from every stress test.
 _HELD_RANKS = threading.local()
@@ -377,6 +379,30 @@ FREE_SUBMESH = REGISTRY.register(
         "(chips), computed at scrape time — the biggest whole-chip "
         "container that can still land with full ICI locality",
         ("node",),
+    )
+)
+DEFRAG_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_scheduler_defrag_events_total",
+        "Defragmentation planner lifecycle events: round_planned/"
+        "round_executed/round_noop/round_failed, move_executed/"
+        "move_rolled_back/rollback_failed, unblock_retry (a gang filter "
+        "re-admitted after a round), unblock_rate_limited",
+        ("event",),
+    )
+)
+DEFRAG_ROUND = REGISTRY.register(
+    Histogram(
+        "tpu_scheduler_defrag_round_seconds",
+        "Wall time of one defrag round (plan + journaled migrations)",
+    )
+)
+DEFRAG_RECOVERED = REGISTRY.register(
+    Gauge(
+        "tpu_scheduler_defrag_recovered_chips",
+        "Largest-free-contiguous-submesh gain (chips) of the most recent "
+        "executed defrag round — capacity the round recovered for big "
+        "whole-chip placements",
     )
 )
 

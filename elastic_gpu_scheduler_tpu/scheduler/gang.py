@@ -178,6 +178,11 @@ class GangCoordinator:
         )
         # pod key → last commit duration (post-barrier); benchmark telemetry
         self.commit_secs: dict[str, float] = {}
+        # optional DefragPlanner (defrag/): when set and in auto mode, an
+        # infeasible gang plan triggers one defrag round and ONE filter
+        # retry (the admission-retry path).  None = a single attribute
+        # check on the infeasible path, nothing anywhere else.
+        self.defrag = None
 
     # -- helpers -------------------------------------------------------------
 
@@ -222,11 +227,67 @@ class GangCoordinator:
     def filter(
         self, sched: TPUUnitScheduler, pod: Pod, node_names: list[str]
     ) -> tuple[list[str], dict[str, str]]:
-        """Plan-once, steer-each-member filter for gang pods."""
+        """Plan-once, steer-each-member filter for gang pods — with one
+        defrag-and-retry when the plan is infeasible and the planner runs
+        in auto mode (fragmentation blocking a gang is exactly the signal
+        the defrag subsystem exists for)."""
+        ok, failed = self._filter_once(sched, pod, node_names)
+        defrag = self.defrag
+        if (
+            not ok
+            and defrag is not None
+            and failed
+            # two retryable rejections: the plan is infeasible
+            # (fragmentation blocks the gang — exactly what a round
+            # fixes), or every candidate is cordoned (a round is IN
+            # FLIGHT for a sibling member — try_unblock then parks on
+            # the planner lock until it finishes and re-checks)
+            and any(
+                "cannot fit" in m or "cordoned" in m
+                for m in failed.values()
+            )
+        ):
+            req = request_from_pod(pod)
+            # try_unblock is a no-op outside auto mode and rate-limited
+            # inside it; the gang lock is NOT held here, so the planner
+            # may freely take engine/node locks for the round
+            if defrag.try_unblock(sched, req):
+                GANG_EVENTS.inc("defrag_retry")
+                ok, failed = self._filter_once(sched, pod, node_names)
+        return ok, failed
+
+    def _filter_once(
+        self, sched: TPUUnitScheduler, pod: Pod, node_names: list[str]
+    ) -> tuple[list[str], dict[str, str]]:
         req = request_from_pod(pod)
         reason = sched.admits(req)
         if reason is not None:  # mode policy (tpuwhole) covers gangs too
             return [], {n: reason for n in node_names}
+        failed0: dict[str, str] = {}
+        if getattr(sched, "cordoned", None):
+            # defrag round in flight: its nodes are off-limits to new
+            # plans (the gang path bypasses sched.assume's own check)
+            cordoned = sched._cordoned_set()
+            if cordoned:
+                failed0 = {
+                    n: "cordoned for defragmentation"
+                    for n in node_names if n in cordoned
+                }
+                node_names = [n for n in node_names if n not in cordoned]
+                if not node_names:
+                    return [], failed0
+        ok, failed = self._filter_plan(sched, pod, req, node_names)
+        if failed0:  # cordoned nodes keep their verdict in the response
+            failed = {**failed0, **failed}
+        return ok, failed
+
+    def _filter_plan(
+        self,
+        sched: TPUUnitScheduler,
+        pod: Pod,
+        req,
+        node_names: list[str],
+    ) -> tuple[list[str], dict[str, str]]:
         gkey = self.gang_key(pod, req)
         with self._lock:
             plan = self._plans.get(gkey)

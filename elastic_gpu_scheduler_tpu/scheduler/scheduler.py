@@ -24,12 +24,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..core.allocator import Option
+from ..core.allocator import Option, option_demand
 from ..core.annotations import (
     annotations_for_option,
     assigned_node,
@@ -116,6 +117,20 @@ class TPUUnitScheduler(ResourceScheduler):
         # without limit (the reference's releasedPodMap grows forever)
         self.released_pods: dict[str, str] = {}
         self.released_pods_max = 10000
+        # defrag cordons: node → monotonic expiry.  A cordoned node fails
+        # filter (new placements must not race a migration vacating it);
+        # cordons carry a TTL and the reconciliation controller prunes
+        # expired ones, so a crashed defrag round cannot strand a node.
+        # Empty dict when defrag never runs — the filter pays one truthy
+        # check.
+        self.cordoned: dict[str, float] = {}
+        # last gauge-refresh fragmentation snapshot (node → (index,
+        # largest_free_box)): /scheduler/status and the defrag planner
+        # read mesh health from here instead of re-scanning per request
+        # (or needing a Prometheus scrape — frag_snapshot() refreshes
+        # itself when stale)
+        self._frag_cache: dict[str, tuple[float, int]] = {}
+        self._frag_cache_at = 0.0  # monotonic of the last refresh
         self._pool = ThreadPoolExecutor(
             max_workers=self.assume_workers, thread_name_prefix="assume"
         )
@@ -278,6 +293,17 @@ class TPUUnitScheduler(ResourceScheduler):
         reason = self.admits(request)
         if reason is not None:
             return [], {n: reason for n in node_names}
+        cordoned = self._cordoned_set() if self.cordoned else ()
+        if cordoned:
+            failed0 = {
+                n: "cordoned for defragmentation"
+                for n in node_names if n in cordoned
+            }
+            node_names = [n for n in node_names if n not in cordoned]
+            if not node_names:
+                return [], failed0
+        else:
+            failed0 = {}
         with TRACER.span(
             "sched.assume", pod=pod.key, nodes=len(node_names),
         ) as sp:
@@ -285,7 +311,7 @@ class TPUUnitScheduler(ResourceScheduler):
             allocators = [(n, by_name[n]) for n in node_names]
 
             ok: list[str] = []
-            failed: dict[str, str] = {}
+            failed: dict[str, str] = dict(failed0)
 
             def try_node(item):
                 name, na = item
@@ -543,6 +569,166 @@ class TPUUnitScheduler(ResourceScheduler):
             needed.extend(v for v, _ in group)
         return needed + passthrough
 
+    # -- defrag primitives (defrag/DefragPlanner drives these) ---------------
+
+    def cordon(self, node_name: str, ttl_s: float = 120.0) -> None:
+        """Mark a node unschedulable for new placements (filter rejects
+        it) while a defrag round vacates/fills it.  TTL-bounded: a
+        crashed round cannot strand the node — the controller's resync
+        prunes expired cordons."""
+        with self.lock:
+            self.cordoned[node_name] = time.monotonic() + ttl_s
+
+    def uncordon(self, node_name: str) -> None:
+        with self.lock:
+            self.cordoned.pop(node_name, None)
+
+    def prune_cordons(self) -> dict[str, float]:
+        """Drop expired cordons; returns the live ones (node →
+        seconds remaining)."""
+        now = time.monotonic()
+        with self.lock:
+            expired = [n for n, t in self.cordoned.items() if t <= now]
+            for n in expired:
+                del self.cordoned[n]
+            return {
+                n: round(t - now, 3) for n, t in self.cordoned.items()
+            }
+
+    def _cordoned_set(self) -> set:
+        return set(self.prune_cordons())
+
+    def frag_snapshot(self, max_age_s: float = 10.0) -> dict:
+        """node → (fragmentation_index, largest_free_submesh_chips),
+        reusing the last gauge refresh; refreshes itself when the
+        snapshot is older than ``max_age_s`` (so /scheduler/status and
+        the defrag planner see mesh health without a Prometheus
+        scrape).  The contiguous-box scan still never rides the bind
+        path — only status/scrape/planner callers pay it."""
+        if time.monotonic() - self._frag_cache_at > max_age_s:
+            self._refresh_frag_gauges()
+        return dict(self._frag_cache)
+
+    def migrate_pod(
+        self,
+        pod: Pod,
+        from_node: str,
+        to_node: str,
+        old_opt: Option,
+        new_opt: Option,
+        source: str = "defrag",
+    ) -> Pod:
+        """Atomically re-home a live pod's allocation (the defrag
+        planner's evict→rebind transaction).
+
+        Order matters: the DESTINATION is charged first (validating
+        transact — raises if the planned chips were taken), then the
+        source is freed; the transient double-charge is the safe error
+        direction (no other pod can ever be double-booked).  The journal
+        ``migrate`` record is emitted at the commit point under the
+        engine lock; replay verifies the move conserved the pod's chip
+        demand.  The annotation-ledger rewrite runs OFF the engine lock
+        (like bind); on failure the in-memory move is reversed with a
+        compensating journaled migration so ledger and memory re-agree.
+        """
+        if option_demand(old_opt) != option_demand(new_opt):
+            raise RuntimeError(
+                f"migrate {pod.key}: plan does not conserve chip demand"
+            )
+        with TRACER.span(
+            "sched.migrate", pod=pod.key, src=from_node, dst=to_node,
+        ) as sp:
+            with self.lock:
+                entry = self.pod_maps.get(pod.key)
+                if (
+                    entry is None
+                    or entry[0] != from_node
+                    or entry[1].allocs != old_opt.allocs
+                ):
+                    raise RuntimeError(
+                        f"migrate {pod.key}: plan stale (live placement "
+                        "changed since planning)"
+                    )
+                na_to = self._get_allocator(to_node)
+                na_from = self.allocators.get(from_node)
+                if na_to is None or na_from is None:
+                    raise RuntimeError(
+                        f"migrate {pod.key}: allocator missing for "
+                        f"{from_node if na_from is None else to_node}"
+                    )
+                na_to.add(new_opt)  # validating transact: raises if taken
+                na_from.forget(old_opt)
+                self.pod_maps[pod.key] = (to_node, new_opt)
+                self._update_node_gauge(from_node)
+                self._update_node_gauge(to_node)
+                self._journal_migrate(
+                    pod, from_node, to_node, old_opt, new_opt, source,
+                    trace_id=sp.trace_id or None,
+                )
+            try:
+                updated = self._write_annotations(pod, new_opt, to_node)
+            except Exception:
+                # reverse in memory + journal the compensation, so the
+                # durable ledger (still from_node/old) and memory agree
+                with self.lock:
+                    entry = self.pod_maps.get(pod.key)
+                    if entry is not None and entry[0] == to_node:
+                        try:
+                            na_from.add(old_opt)
+                        except ValueError:
+                            # old chips stolen mid-rollback (possible only
+                            # via a filterless bind racing the cordon):
+                            # keep the new placement in memory and flag it
+                            # LOUDLY — the ledger now disagrees until the
+                            # next annotation write succeeds
+                            self._record_event(
+                                pod, "Warning", "MigrationLedgerSkew",
+                                f"migration {from_node}->{to_node} could "
+                                "not roll back (source chips taken); "
+                                "annotations are stale",
+                            )
+                        else:
+                            na_to.forget(new_opt)
+                            self.pod_maps[pod.key] = (from_node, old_opt)
+                            self._update_node_gauge(from_node)
+                            self._update_node_gauge(to_node)
+                            self._journal_migrate(
+                                pod, to_node, from_node, new_opt, old_opt,
+                                source="migrate_rollback",
+                            )
+                raise
+            AUDIT.record(
+                pod.key, "migrate", trace_id=sp.trace_id,
+                src=from_node, dst=to_node, source=source,
+            )
+            self._record_event(
+                pod, "Normal", "Migrated",
+                f"defrag: relocated from {from_node} to {to_node}",
+            )
+            return updated
+
+    def _journal_migrate(
+        self, pod, from_node, to_node, old_opt, new_opt, source,
+        trace_id=None,
+    ):
+        if not JOURNAL.enabled:
+            return None
+        if trace_id is None:
+            ctx = TRACER.pod_context(pod.key)
+            trace_id = ctx.trace_id if ctx is not None else None
+        return JOURNAL.record(
+            "migrate",
+            pod=pod.key,
+            uid=pod.metadata.uid,
+            node=to_node,
+            source_node=from_node,
+            option=option_record(new_opt),
+            option_old=option_record(old_opt),
+            gang=pod_gang_key(pod),
+            source=source,
+            trace_id=trace_id or None,
+        )
+
     # -- gang split-phase primitives (scheduler/gang.py's commit protocol) ----
     #
     # The gang coordinator needs bind's three effects (allocate, annotate,
@@ -697,11 +883,17 @@ class TPUUnitScheduler(ResourceScheduler):
         journal sequence number from the replayed chip state."""
         with self.lock:
             allocators = dict(self.allocators)
+        cache: dict[str, tuple[float, int]] = {}
         for name, na in allocators.items():
             with na.lock:
                 frag, largest, _free = na.chips.fragmentation()
             FRAG_INDEX.set(name, value=frag)
             FREE_SUBMESH.set(name, value=float(largest))
+            cache[name] = (frag, largest)
+        # snapshot reused by /scheduler/status and the defrag planner
+        # (frag_snapshot) — whole-dict swap, GIL-atomic for readers
+        self._frag_cache = cache
+        self._frag_cache_at = time.monotonic()
 
     def _journal_checkpoint(self) -> Optional[dict]:
         """Full-state snapshot for the journal's segment-head checkpoint
@@ -889,9 +1081,23 @@ class TPUUnitScheduler(ResourceScheduler):
         with self.lock:
             allocators = dict(self.allocators)
             pods = sorted(self.pod_maps)
-        return {
+        nodes = {n: na.status() for n, na in allocators.items()}
+        # mesh health from the last gauge-refresh snapshot (self-refreshing
+        # when stale) — operators and the defrag planner read fragmentation
+        # here without a Prometheus scrape, and the contiguous-box scan
+        # still never rides the bind path
+        frag = self.frag_snapshot()
+        for n, d in nodes.items():
+            if n in frag:
+                d["fragmentation_index"] = frag[n][0]
+                d["largest_free_submesh_chips"] = frag[n][1]
+        out = {
             "scheduler": self.name,
             "rater": self.rater.name,
-            "nodes": {n: na.status() for n, na in allocators.items()},
+            "nodes": nodes,
             "pods": pods,
         }
+        cordons = self.prune_cordons()
+        if cordons:
+            out["cordoned"] = sorted(cordons)
+        return out

@@ -252,6 +252,10 @@ the Python analogues):</p>
 <li><a href="/debug/journal">/debug/journal</a>
  — flight-recorder state: rotation/fsync stats and the record tail
  (?n=N); offline replay via python -m elastic_gpu_scheduler_tpu.journal</li>
+<li><a href="/debug/defrag">/debug/defrag</a>
+ — defrag planner state + plan preview (?chips=N&amp;members=M simulates
+ unblocking that gang shape); POST /defrag/run executes a round
+ ({"dry_run": true} to simulate)</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/scheduler/status">/scheduler/status</a>
  — per-node chip state dump</li>
@@ -408,12 +412,14 @@ class ExtenderServer:
         tls_key: str = "",
         workers: int = 0,  # >0: pre-spawned pool sized for gang concurrency
         leader_check=None,  # callable → bool; None = always the leader
+        defrag=None,  # optional defrag.DefragPlanner (plan preview + run)
     ):
         self.predicate = predicate
         self.prioritize = prioritize
         self.bind = bind
         self.status_fn = status_fn
         self.preemption = preemption
+        self.defrag = defrag
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -499,6 +505,31 @@ class ExtenderServer:
                         "python -m elastic_gpu_scheduler_tpu.journal)\n"
                     )
             return 200, text.encode(), "text/plain"
+        if path == "/debug/defrag":
+            if self.defrag is None:
+                return (
+                    404,
+                    json.dumps({"error": "defrag planner not configured"}).encode(),
+                    "application/json",
+                )
+            params = _parse_query(query)
+            out = self.defrag.status()
+            # optional plan preview: ?chips=N[&members=M] simulates an
+            # unblocking plan for that gang shape; bare GET previews a
+            # threshold-compaction plan.  Pure simulation on clones —
+            # live state is never touched, and the try-lock preview never
+            # parks behind an executing round (in_flight:true instead).
+            try:
+                want = None
+                if "chips" in params:
+                    want = (
+                        int(params["chips"]),
+                        int(params.get("members", "1")),
+                    )
+                out["preview"] = self.defrag.preview(want=want)
+            except Exception as e:
+                out["preview_error"] = str(e)
+            return 200, json.dumps(out, indent=1).encode(), "application/json"
         if path == "/debug/journal":
             params = _parse_query(query)
             try:
@@ -572,6 +603,8 @@ class ExtenderServer:
             # caches); kube-scheduler retries against the leader
             VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "not_leader")
             return 503, b'{"Error": "not the leader"}', "application/json"
+        if path == "/defrag/run":
+            return self._route_defrag_run(raw)
         # route existence FIRST: unknown paths are 404s regardless of
         # body, and metric labels only ever come from this fixed verb
         # set (an attacker cycling random paths must not grow /metrics)
@@ -656,6 +689,56 @@ class ExtenderServer:
         return self._verb(
             "preemption", lambda: self.preemption.handle(args).to_dict()
         )
+
+    def _route_defrag_run(self, raw: bytes) -> tuple[int, bytes, str]:
+        """POST /defrag/run — run one defrag round.  Body (all optional):
+        {"dry_run": bool, "chips": N, "members": M}.  ``dry_run`` plans
+        on clones and returns the plan without executing; execution is
+        refused in ``off`` mode (409) so a misfired curl cannot migrate
+        workloads the operator declared immovable."""
+        if self.defrag is None:
+            return (
+                404,
+                json.dumps({"error": "defrag planner not configured"}).encode(),
+                "application/json",
+            )
+        try:
+            body = json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return 400, b'{"Error": "malformed JSON body"}', "application/json"
+        if not isinstance(body, dict):
+            return (
+                400, b'{"Error": "body must be a JSON object"}',
+                "application/json",
+            )
+        dry_run = bool(body.get("dry_run", False))
+        want = None
+        if body.get("chips"):
+            try:
+                want = (int(body["chips"]), int(body.get("members", 1)))
+            except (TypeError, ValueError):
+                return (
+                    400, b'{"Error": "chips/members must be integers"}',
+                    "application/json",
+                )
+        if not dry_run and self.defrag.mode == "off":
+            return (
+                409,
+                json.dumps({
+                    "Error": "defrag mode is off; rerun with dry_run or "
+                    "start the scheduler with --defrag=observe|auto",
+                }).encode(),
+                "application/json",
+            )
+        try:
+            result = self.defrag.run_round(want=want, dry_run=dry_run)
+            return 200, json.dumps(result, indent=1).encode(), "application/json"
+        except Exception as e:
+            log.exception("defrag run failed")
+            return (
+                500, json.dumps({"Error": f"defrag: {e}"}).encode(),
+                "application/json",
+            )
 
     def _parse(self, verb: str, parser: Callable, body: dict):
         """Wire-type parsing as a structured 400 (malformed client input

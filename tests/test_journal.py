@@ -525,6 +525,75 @@ def test_restart_replay_binds_are_idempotent(tmp_path):
     assert any("different placement" in v for v in res2.violations)
 
 
+def test_preempt_restore_mid_shutdown_rejournal_idempotent(tmp_path):
+    """Preempt-rollback × journal ordering (the gap next to
+    test_restart_replay_binds_are_idempotent, which covers only
+    bind/forget): a victim is preemption-evicted (forget) and then
+    RESTORED from its still-live annotation ledger (add_pod — the
+    reprieve/controller-reassign path) with the shutdown racing the
+    restore.  The restart's re-journal (node_add + source=replay binds)
+    must read as idempotent re-assertions on top of the
+    bind→forget→bind sequence — not double binds — and replay must
+    land on the exact live state."""
+    d = str(tmp_path / "j")
+    cluster, registry, predicate, bind, status = fresh_stack()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    JOURNAL.configure(d, fsync="off")
+    try:
+        victim = tpu_pod("victim", core=200)
+        cluster.create_pod(victim)
+        sched.bind("node-0", victim)
+        # preemption evicts the victim's allocation...
+        annotated = cluster.get_pod("default", "victim")
+        sched.forget_pod(annotated, source="preempt_evict")
+        # ...and the reprieve restores it from the annotation ledger
+        # (same placement — the annotations were never stripped), with
+        # the journal close racing right behind (mid-shutdown restore)
+        sched.add_pod(annotated, source="preempt_restore")
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    # restart: seq numbering resumes, the fresh engine re-journals
+    # node_add + a source=replay bind for the surviving victim
+    JOURNAL.configure(d, fsync="off")
+    try:
+        from elastic_gpu_scheduler_tpu.scheduler.scheduler import (
+            SchedulerConfig,
+            TPUUnitScheduler,
+        )
+
+        sched2 = TPUUnitScheduler(
+            SchedulerConfig(clientset=sched.clientset, rater=sched.rater)
+        )
+        assert sched2.known_pod(victim)
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    events = read_journal(d)
+    binds = [e for e in events if e["type"] == "bind"
+             and e.get("pod") == "default/victim"]
+    sources = [e.get("source") for e in binds]
+    # the full ordering is present: original bind, restore, restart replay
+    assert "bind" in sources and "preempt_restore" in sources
+    assert "replay" in sources
+    forgets = [e for e in events if e["type"] == "forget"
+               and e.get("pod") == "default/victim"]
+    assert [e.get("source") for e in forgets] == ["preempt_evict"]
+    # restore ordered AFTER the evict, restart re-assert after both
+    assert forgets[0]["seq"] > binds[0]["seq"]
+    restore_seq = next(
+        e["seq"] for e in binds if e.get("source") == "preempt_restore"
+    )
+    replay_seq = next(
+        e["seq"] for e in binds if e.get("source") == "replay"
+    )
+    assert forgets[0]["seq"] < restore_seq < replay_seq
+    res = replay(events)
+    assert not res.violations, res.violations
+    assert list(res.pods) == ["default/victim"]
+    assert diff_live(res, sched2.status()) == []
+
+
 def test_reset_resync_replays_without_recharge():
     """A layout-change resync wipes chip usage live while the scheduler
     ledger keeps the pod — replay must mirror both halves."""
