@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -53,6 +53,16 @@ check-journal:
 # p99 with --defrag=off shows no regression.
 check-defrag:
 	python tools/check_defrag.py
+
+# Profiling-observatory gate: randomized class-annotated bind soak with
+# synthetic step samples; hard-fails unless profiles converge to the
+# injected throughput, the interference matrix detects a co-located
+# slowdown, journal replay accepts `profile` records cleanly, what-if
+# under the profile-aware rater re-scores recorded workload differently
+# from its geometry base, and both overhead budgets hold (bind p99 and
+# decode throughput with profiling on; zero extra device uploads).
+check-profile:
+	JAX_PLATFORMS=cpu python tools/check_profile.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

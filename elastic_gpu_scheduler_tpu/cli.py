@@ -145,6 +145,22 @@ def main(argv=None) -> int:
         "/debug/schedule/<pod> serve the result",
     )
     p.add_argument(
+        "--profile-sample", type=float, default=None,
+        help="workload-profile sampling rate (1.0 = every sample, 0 = "
+        "off; default from TPU_PROFILE_SAMPLE, else 1.0).  Enables the "
+        "co-tenancy map + per-class profiles at /debug/profiles, the "
+        "tpu_workload_*/tpu_interference_* metrics, and periodic "
+        "`profile` journal records (when the journal is on)",
+    )
+    p.add_argument(
+        "--relay-probe-interval", type=float,
+        default=float(os.environ.get("TPU_RELAY_PROBE_INTERVAL", "0")),
+        help="probe the TPU relay every this many seconds and publish "
+        "tpu_relay_up on /metrics + /debug/relay (0 = off, default; the "
+        "probe runs a bounded jax subprocess on its own thread, never "
+        "on the scrape path)",
+    )
+    p.add_argument(
         "--journal-dir", default=os.environ.get("TPU_JOURNAL_DIR", ""),
         help="enable the scheduling flight recorder: append every "
         "allocator state mutation to crash-safe journal segments in this "
@@ -199,6 +215,20 @@ def main(argv=None) -> int:
         from .tracing import TRACER
 
         TRACER.configure(args.trace_sample)
+
+    if args.profile_sample is not None:
+        # before build_stack, so the startup rebuild's bind replays
+        # already populate the co-tenancy map
+        from .profile import PROFILER
+
+        PROFILER.configure(sample=args.profile_sample)
+
+    relay_monitor = None
+    if args.relay_probe_interval > 0:
+        from .utils.tpuprobe import RELAY_MONITOR
+
+        RELAY_MONITOR.interval_s = max(5.0, args.relay_probe_interval)
+        relay_monitor = RELAY_MONITOR.start()
 
     if args.journal_dir:
         # before build_stack, so the startup rebuild's node_add/replay
@@ -334,6 +364,8 @@ def main(argv=None) -> int:
             pass
     finally:
         defrag.stop()
+        if relay_monitor is not None:
+            relay_monitor.stop()
         if controller is not None:
             controller.stop()
         if args.journal_dir:

@@ -53,6 +53,17 @@ def is_assumed(pod) -> bool:
     return ann.get(consts.ANNOTATION_ASSUMED) == "true"
 
 
+def workload_class(pod) -> str:
+    """The pod's profiling class (``elasticgpu.io/workload-class``
+    annotation; profile/ aggregates measured behavior under it).  Pods
+    without the annotation share the default class."""
+    ann = pod.metadata.annotations or {}
+    return (
+        ann.get(consts.ANNOTATION_WORKLOAD_CLASS)
+        or consts.DEFAULT_WORKLOAD_CLASS
+    )
+
+
 def assigned_node(pod) -> Optional[str]:
     ann = pod.metadata.annotations or {}
     return ann.get(consts.ANNOTATION_NODE) or (pod.spec.node_name or None)

@@ -85,6 +85,11 @@ class NodeAllocator:
 
     def __init__(self, node):
         self.node_name = node.metadata.name
+        # TPU generation (v4|v5e|v5p|v6e) from the accelerator label —
+        # the key the profile observatory's per-generation throughput
+        # tables (Gavel-style) aggregate under
+        labels = node.metadata.labels or {}
+        self.generation = labels.get(consts.LABEL_TPU_ACCELERATOR, "v5e")
         topo, chips = chips_from_node(node)
         self.chips = ChipSet(topo, chips)
         self.allocated: dict[str, Option] = {}  # request hash → assumed option
@@ -174,6 +179,10 @@ class NodeAllocator:
         """Re-derive capacity if the node's allocatable changed (the reference
         never does this; SURVEY §5 'node allocator cached forever')."""
         with self.lock:
+            labels = node.metadata.labels or {}
+            self.generation = labels.get(
+                consts.LABEL_TPU_ACCELERATOR, self.generation
+            )
             topo, chips = chips_from_node(node)
             same_shape = topo.dims == self.chips.topo.dims and set(
                 c.coord for c in chips
@@ -188,6 +197,7 @@ class NodeAllocator:
                     # must not re-charge live pods onto the fresh set
                     JOURNAL.record(
                         "node_resync", node=self.node_name, reset=True,
+                        generation=self.generation,
                         **self.chips.inventory(),
                     )
                 return
@@ -209,6 +219,7 @@ class NodeAllocator:
             if changed and JOURNAL.enabled:
                 JOURNAL.record(
                     "node_resync", node=self.node_name,
+                    generation=self.generation,
                     **self.chips.inventory(),
                 )
 

@@ -75,6 +75,7 @@ import grpc
 
 from . import deviceplugin_pb2 as pb
 from ..core.topology import Topology, parse_coord, parse_topology
+from ..profile import PROFILER
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..utils import consts
 
@@ -251,6 +252,20 @@ class TPUDevicePlugin:
         ) as sp:
             return self._allocate(request, sp)
 
+    def _profile_chips(self, by_chip: dict[str, int], tenant: str) -> None:
+        """Emit per-chip occupancy samples into the profile observatory
+        (the node-path half of the behavioral telemetry: which chips
+        carry how many core units, keyed by the tenant when the caller's
+        trace context identifies one).  One ring append per chip; no-op
+        unless profiling is enabled."""
+        if not PROFILER.enabled:
+            return
+        node = os.environ.get("NODE_NAME", "") or "local"
+        for coord, units in by_chip.items():
+            PROFILER.record_chip(
+                node, coord, units, self.core_units, tenant=tenant
+            )
+
     def _allocate(self, request, sp):
         by_path = dict(self.chips)
         resp = pb.AllocateResponse()
@@ -279,6 +294,12 @@ class TPUDevicePlugin:
                 by_chip[c] = by_chip.get(c, 0) + 1
             cresp.envs["TPU_CHIP_SHARES"] = ",".join(
                 f"{c}={u}" for c, u in sorted(by_chip.items())
+            )
+            # behavioral telemetry: per-chip occupancy samples keyed by
+            # the caller's trace id (the pod's scheduling trace, when
+            # the traceparent metadata carried one)
+            self._profile_chips(
+                by_chip, sp.trace_id if sp is not None else ""
             )
             min_units = min(by_chip.values()) if by_chip else 0
             # the conservative contract: the MINIMUM per-chip share (the

@@ -19,7 +19,11 @@ stack:
   per-container chip demand).  Each record carries the pod's
   ``trace_id`` so journal entries cross-link to ``/traces``, plus the
   node's fragmentation snapshot at the checkpoint (the gauges' source
-  of truth).
+  of truth).  The profile observatory (``profile/``) additionally lands
+  periodic ``profile`` records — per-class throughput/latency/
+  interference snapshots; these are ANNOTATIONS in the stream (replay
+  never mutates allocator state from them) that let ``what_if`` replay
+  re-score recorded workload under a profile-aware rater.
 
 - **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
 

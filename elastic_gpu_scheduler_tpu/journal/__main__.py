@@ -51,7 +51,9 @@ def main(argv=None) -> int:
         "--rater",
         default="",
         help="what-if replay: re-place the recorded workload under this "
-        "placement policy (binpack|spread|random|ici-locality)",
+        "placement policy (binpack|spread|random|ici-locality, or "
+        "profile-aware[:BASE] — geometry BASE scaled by the journal's "
+        "recorded `profile` records; default base ici-locality)",
     )
     rp.add_argument(
         "--json", action="store_true", help="machine-readable output"
@@ -94,7 +96,16 @@ def main(argv=None) -> int:
         from ..core.rater import get_rater
 
         try:
-            rater = get_rater(args.rater)
+            if args.rater.split(":", 1)[0] == "profile-aware":
+                # measured-behavior scoring from the journal's own
+                # recorded `profile` records (profile/rater.py); an
+                # optional :BASE names the geometry rater it scales
+                from ..profile.rater import ProfileAwareRater
+
+                _, _, base = args.rater.partition(":")
+                rater = ProfileAwareRater(get_rater(base) if base else None)
+            else:
+                rater = get_rater(args.rater)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2

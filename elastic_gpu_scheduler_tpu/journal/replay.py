@@ -103,6 +103,10 @@ class ReplayResult:
     gangs: dict = field(default_factory=dict)  # gang → {"admits","rollbacks"}
     violations: list = field(default_factory=list)
     warnings: list = field(default_factory=list)
+    # workload-profile annotations (profile/ observatory): counted and
+    # the latest kept — they never mutate allocator state
+    profiles: int = 0
+    last_profile: Optional[dict] = None
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -124,6 +128,7 @@ class ReplayResult:
             "gangs": {
                 g: dict(v) for g, v in sorted(self.gangs.items())
             },
+            "profile_records": self.profiles,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -397,6 +402,19 @@ def replay(events: list[dict]) -> ReplayResult:
         elif t == "node_remove":
             node = rec.get("node")
             res.nodes.pop(node, None)
+        elif t == "profile":
+            # workload-profile snapshot (profile/ observatory): an
+            # ANNOTATION in the mutation stream — it participates in the
+            # dense-seq audit above but never touches allocator state.
+            # The latest one is kept so offline consumers (what_if
+            # raters, the replay CLI) can read the profiles as recorded.
+            res.profiles += 1
+            res.last_profile = {
+                "seq": seq,
+                "t": rec.get("t"),
+                "profiles": rec.get("profiles") or {},
+                "interference": rec.get("interference") or {},
+            }
         else:
             res.warnings.append(f"{where}: unknown record type {t!r}")
 
@@ -505,10 +523,17 @@ def what_if(events: list[dict], rater: Rater) -> dict:
     owns the authoritative versions with the invariant checks) — a new
     record field or flag handled there must be handled here too."""
     nodes: dict[str, ChipSet] = {}
+    gens: dict[str, str] = {}  # node → TPU generation (node_add records)
     placed: dict[str, tuple[str, Option]] = {}
     binds = unplaced = contiguous = rec_contiguous = 0
+    profiles_seen = 0
     scores: list[float] = []
     rec_scores: list[float] = []
+    # profile-aware raters consume the recorded profile stream and each
+    # bind's workload class/target generation; both hooks are duck-typed
+    # so geometry raters replay exactly as before
+    observe_profile = getattr(rater, "observe_profile", None)
+    set_workload = getattr(rater, "set_workload", None)
     booted = False
     boot_as_of = -1
     for rec in events:
@@ -537,12 +562,21 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             continue
         if booted and rec.get("seq", -1) <= boot_as_of:
             continue  # already reflected in the boot snapshot
+        if t == "profile":
+            # recorded workload profiles, in stream order — scores from
+            # here on use them, exactly as a live promotion would
+            profiles_seen += 1
+            if observe_profile is not None:
+                observe_profile(rec)
+            continue
         if t in ("node_add", "node_resync"):
             try:
                 cs = _chipset_from_record(rec)
             except Exception:
                 continue
             node = rec["node"]
+            if rec.get("generation"):
+                gens[node] = rec["generation"]
             if rec.get("reset"):
                 for pk in [p for p, (n, _o) in placed.items() if n == node]:
                     placed.pop(pk)
@@ -569,6 +603,11 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             req = request_from_option(
                 recorded, rec.get("pod", "?"), rec.get("uid", "")
             )
+            if set_workload is not None:
+                set_workload(
+                    rec.get("wclass"), node=node,
+                    generation=gens.get(node),
+                )
             opt = cs.trade(req, rater)
             if opt is None:
                 # alternative policy cannot place what the recorded one
@@ -614,6 +653,10 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             req = request_from_option(
                 recorded_new, pod or "?", rec.get("uid", "")
             )
+            if set_workload is not None:
+                set_workload(
+                    rec.get("wclass"), node=to, generation=gens.get(to),
+                )
             opt = cs.trade(req, rater)
             if opt is None:
                 if not cs.can_transact(recorded_new):
@@ -633,6 +676,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
         "binds": binds,
         "placed": binds - unplaced,
         "unplaced": unplaced,
+        "profile_records": profiles_seen,
         "mean_score": round(sum(scores) / len(scores), 3) if scores else 0.0,
         "contiguous_frac": round(contiguous / binds, 4) if binds else 0.0,
         "recorded_mean_score": (

@@ -106,6 +106,14 @@ class Gauge(Counter):
         with self._lock:
             self._values[labels] = value
 
+    def replace(self, values: dict[tuple[str, ...], float]) -> None:
+        """Swap the whole series set in ONE lock acquisition — for
+        scrape-time gauges rebuilt per refresh: a racing collect sees
+        either the old set or the new one, never a cleared-but-unfilled
+        intermediate (the torn-scrape hazard of reset()+set() loops)."""
+        with self._lock:
+            self._values = dict(values)
+
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
@@ -347,19 +355,37 @@ class LazyGauge(Gauge):
     """Gauge recomputed by a registered ``refresher`` at collect() time —
     for scrape-time values whose computation (e.g. the contiguous-box
     scan behind the fragmentation gauges) must stay OFF the bind path:
-    the scraper pays it, never the scheduler."""
+    the scraper pays it, never the scheduler.
+
+    Refreshes are SINGLE-FLIGHT: two scrapes racing collect() must not
+    both pay the scan (a slow refresher would double its cost exactly
+    when scrapers pile up), and the late scraper must not export a value
+    set the early one is still mid-computing.  A scraper that arrives
+    while a refresh is running parks on the refresh lock and, once the
+    winner finishes, exports the winner's fresh values WITHOUT re-running
+    the refresher (the generation counter tells it a refresh completed
+    while it waited)."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.refresher = None
+        self._refresh_lock = threading.Lock()
+        self._refresh_gen = 0
 
     def collect(self):
         r = self.refresher
         if r is not None:
-            try:
-                r()
-            except Exception:  # a broken refresher must not kill /metrics
-                pass
+            gen0 = self._refresh_gen
+            with self._refresh_lock:
+                if self._refresh_gen == gen0:
+                    # nobody refreshed while we waited for the lock —
+                    # this scrape is the flight that pays the scan
+                    try:
+                        r()
+                    except Exception:
+                        # a broken refresher must not kill /metrics
+                        pass
+                    self._refresh_gen = gen0 + 1
         yield from super().collect()
 
 

@@ -1513,6 +1513,18 @@ class InferenceEngine:
         self.host_gap_chunks = 0
         self.last_host_gap_ms = 0.0
         self._last_drain_done: Optional[int] = None
+        # per-chunk host-gap samples (ms), buffered for the scrape path:
+        # the ENGINE thread appends (GIL-atomic), /metrics drains into
+        # the tpu_serve_host_gap_ms histogram so operators get p50/p99
+        # instead of whichever chunk scraped last.  Bounded: with nothing
+        # scraping, keep the newest half (same stance as the TimedLock
+        # wait buffers).
+        self._gap_buf: list[float] = []
+        self._gap_buf_cap = 8192
+        # monotonic count of tokens delivered to clients (the profile
+        # observatory's throughput numerator — a host-side int add per
+        # token, read by the engine loop off the device path)
+        self.tokens_emitted = 0
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
@@ -1795,6 +1807,26 @@ class InferenceEngine:
         steady-state decode steps: unchanged state is never re-sent."""
         return self._ds.uploads
 
+    def _gap_sample(self, gap_ms: float) -> None:
+        """Buffer one per-chunk host-gap sample for the scrape path (one
+        append on the engine thread; trim keeps the NEWEST samples when
+        nothing scrapes)."""
+        buf = self._gap_buf
+        buf.append(gap_ms)
+        if len(buf) > self._gap_buf_cap:
+            del buf[: self._gap_buf_cap // 2]
+
+    def drain_host_gaps(self) -> list[float]:
+        """Move the buffered per-chunk host-gap samples out (scrape path:
+        /metrics folds them into the tpu_serve_host_gap_ms histogram).
+        Slice-then-del is safe against the engine thread's concurrent
+        appends landing at the tail."""
+        buf = self._gap_buf
+        n = len(buf)
+        vals = buf[:n]
+        del buf[:n]
+        return vals
+
     def host_gap_stats(self) -> dict:
         """Host-gap telemetry: wall time between consecutive fused decode
         chunk dispatches (dispatch-return → next dispatch-call).  That
@@ -1827,8 +1859,7 @@ class InferenceEngine:
         includes ``tok`` at every call site)."""
         return tok in req.stop_tokens and self.emitted[i] >= req.min_tokens
 
-    @staticmethod
-    def _emit(req: Request, tok: int, lp=None, top=None) -> None:
+    def _emit(self, req: Request, tok: int, lp=None, top=None) -> None:
         """Deliver one streamed token.  A raising user callback must never
         unwind into the engine loop — the donated KV pool has already
         advanced when emissions run, so an escaping exception would leave
@@ -1838,6 +1869,7 @@ class InferenceEngine:
         ``lp``/``top``: the token's logprob and [(id, logprob), ...]
         alternatives — appended in lockstep with ``output`` so the three
         lists always align."""
+        self.tokens_emitted += 1
         req.output.append(tok)
         if req.logprobs > 0:
             req.token_logprobs.append(None if lp is None else float(lp))
@@ -2868,11 +2900,13 @@ class InferenceEngine:
             # device never idled between them
             self.host_gap_chunks += 1
             self.last_host_gap_ms = 0.0
+            self._gap_sample(0.0)
         elif self._last_drain_done is not None:
             gap = time.perf_counter_ns() - self._last_drain_done
             self.host_gap_ns += gap
             self.host_gap_chunks += 1
             self.last_host_gap_ms = gap / 1e6
+            self._gap_sample(self.last_host_gap_ms)
         out, self.kv, new_toks, new_lens = self._chunks[
             (use_filters, want_lp, use_pen, use_seed, use_min)
         ](

@@ -1,0 +1,738 @@
+"""Workload profiling & interference observatory.
+
+The observability plane so far sees *decisions* (spans in ``tracing/``,
+the durable journal in ``journal/``) but not *behavior*: nothing
+measures what a workload actually achieves on its chips.  ROADMAP item 2
+(contention- and heterogeneity-aware dispatch) needs exactly that signal
+— BandPilot-style contention-aware dispatch consumes measured per-class
+throughput and co-location slowdown, and Gavel's heterogeneity-aware
+policies are built on per-workload throughput-per-accelerator-type
+tables (PAPERS.md).  This module is the telemetry layer that produces
+both:
+
+- **Sample collection** (hot path = one list append, like the
+  TimedLock wait buffers): the serving engine's step loop emits
+  per-step samples (tokens, step wall, batch slot occupancy, host gap,
+  queue depth, KV-page footprint) via :meth:`WorkloadProfiler.record_step`;
+  the device plugin emits per-chip occupancy samples at Allocate via
+  :meth:`WorkloadProfiler.record_chip`.  Collection is sampling-knob
+  gated (``--profile-sample`` / ``TPU_PROFILE_SAMPLE``, same stance as
+  ``--trace-sample``) and NOTHING here ever touches the device or the
+  bind path: aggregation happens lazily when a reader (scrape,
+  ``/debug/profiles``, the journal flush) folds the raw buffers.
+
+- **Profile aggregation**: samples roll up into per-workload-class
+  profiles — EWMA tokens/s/chip keyed by TPU generation (the Gavel
+  table), reservoir-sampled step-latency quantiles, occupancy/host-gap/
+  queue-depth means — keyed by the ``elasticgpu.io/workload-class`` pod
+  annotation (default class ``default``).  For fractional ``tpushare``
+  tenants sharing a chip, solo-vs-co-located throughput lands in an
+  interference matrix keyed by (class, neighbor-class) pairs: the
+  contention surface ROADMAP item 2 names.  Co-tenancy is learned from
+  the scheduler's bind/forget commits (:meth:`note_bind` /
+  :meth:`note_unbind`) — O(chips) dict ops under the commit lock.
+
+- **Export + replay**: profiles surface at ``GET /debug/profiles`` (both
+  servers), as Prometheus series (``tpu_workload_tokens_per_sec``,
+  ``tpu_interference_slowdown_ratio``, ``tpu_workload_step_seconds``),
+  and as periodic ``profile`` records in the decision journal — replay
+  treats them as annotations (never allocator mutations), and
+  ``what_if`` feeds them to profile-aware raters
+  (:mod:`elastic_gpu_scheduler_tpu.profile.rater`), turning the flight
+  recorder into the offline promotion harness ROADMAP items 2 and 4
+  call for.
+
+Process-global instance ``PROFILER``, same pattern as ``tracing.TRACER``
+and ``journal.JOURNAL``: emission sites check ``.enabled`` first (one
+attribute load when profiling is off).
+
+Deployment note: per-class profiles aggregate within one process.  The
+scheduler process owns the cluster-wide co-tenancy map and binds'
+class/generation tags; a serving pod profiles its own steps.  The
+journal is the cross-process join: every enabled process' ``profile``
+records land in the same replayable stream.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from ..metrics import (
+    REGISTRY,
+    Counter,
+    Histogram,
+    LazyGauge,
+    _exact_quantile,
+)
+from ..utils.consts import DEFAULT_WORKLOAD_CLASS
+
+__all__ = [
+    "DEFAULT_WORKLOAD_CLASS",
+    "PROFILER",
+    "WorkloadProfiler",
+]
+
+PROFILE_TOKENS = REGISTRY.register(
+    LazyGauge(
+        "tpu_workload_tokens_per_sec",
+        "Measured per-class decode throughput in tokens/s per chip, EWMA "
+        "over profiled engine steps, keyed by workload class (the "
+        "elasticgpu.io/workload-class pod annotation) and TPU generation "
+        "— the Gavel-style throughput-per-accelerator-type table, "
+        "refreshed at scrape time from the profile buffers",
+        ("wclass", "generation"),
+    )
+)
+INTERFERENCE_RATIO = REGISTRY.register(
+    LazyGauge(
+        "tpu_interference_slowdown_ratio",
+        "Co-located vs solo throughput ratio per (class, neighbor-class) "
+        "pair for fractional tenants sharing a chip (1.0 = no measured "
+        "contention, 0.5 = this class runs at half speed next to that "
+        "neighbor) — the contention matrix a profile-aware rater "
+        "consumes",
+        ("wclass", "neighbor"),
+    )
+)
+PROFILE_STEP_SECONDS = REGISTRY.register(
+    Histogram(
+        "tpu_workload_step_seconds",
+        "Profiled engine step wall time per workload class (folded from "
+        "the sample ring at scrape time)",
+        ("wclass",),
+    )
+)
+PROFILE_SAMPLES = REGISTRY.register(
+    Counter(
+        "tpu_profile_samples_total",
+        "Profile samples folded into aggregates, by kind (step = engine "
+        "step samples, chip = device-plugin occupancy samples)",
+        ("kind",),
+    )
+)
+PROFILE_DROPPED = REGISTRY.register(
+    Counter(
+        "tpu_profile_dropped_samples_total",
+        "Profile samples discarded because the raw ring buffer hit its "
+        "cap with no reader folding it — non-zero means profiles "
+        "UNDERSTATE activity by that many samples",
+        ("kind",),
+    )
+)
+
+
+class _Ewma:
+    """Exponentially-weighted moving average; first observation seeds."""
+
+    __slots__ = ("value", "n")
+
+    def __init__(self):
+        self.value = 0.0
+        self.n = 0
+
+    def update(self, x: float, alpha: float) -> None:
+        self.n += 1
+        if self.n == 1:
+            self.value = float(x)
+        else:
+            self.value += alpha * (float(x) - self.value)
+
+
+class _Reservoir:
+    """Algorithm-R reservoir: a bounded uniform sample of an unbounded
+    stream, so latency quantiles stay exact-ish without unbounded
+    memory.  Deterministic RNG — profiles must be reproducible in CI."""
+
+    __slots__ = ("k", "n", "samples", "_rng")
+
+    def __init__(self, k: int, seed: int = 0xC0FFEE):
+        self.k = k
+        self.n = 0
+        self.samples: list[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, x: float) -> None:
+        self.n += 1
+        if len(self.samples) < self.k:
+            self.samples.append(float(x))
+            return
+        j = self._rng.randrange(self.n)
+        if j < self.k:
+            self.samples[j] = float(x)
+
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
+        s = sorted(self.samples)
+        return [_exact_quantile(s, q) for q in qs]
+
+
+class _ClassProfile:
+    """Aggregated behavior of one workload class (fold-path only: every
+    mutation happens under the profiler's fold lock)."""
+
+    __slots__ = (
+        "tput", "latency", "occupancy", "host_gap_ms", "queue_depth",
+        "hbm_pages", "samples", "tokens",
+    )
+
+    def __init__(self, reservoir_k: int):
+        self.tput: dict[str, _Ewma] = {}  # generation → tokens/s/chip
+        self.latency = _Reservoir(reservoir_k)
+        self.occupancy = _Ewma()  # active slots / max_batch
+        self.host_gap_ms = _Ewma()
+        self.queue_depth = _Ewma()
+        self.hbm_pages = _Ewma()  # estimated KV-page footprint
+        self.samples = 0
+        self.tokens = 0
+
+    def as_dict(self) -> dict:
+        p50, p95, p99 = self.latency.quantiles()
+        return {
+            "tokens_per_sec_per_chip": {
+                gen: round(e.value, 3) for gen, e in sorted(self.tput.items())
+            },
+            "step_ms": {
+                "p50": round(p50 * 1e3, 3),
+                "p95": round(p95 * 1e3, 3),
+                "p99": round(p99 * 1e3, 3),
+            },
+            "slot_occupancy": round(self.occupancy.value, 4),
+            "host_gap_ms": round(self.host_gap_ms.value, 4),
+            "queue_depth": round(self.queue_depth.value, 3),
+            "hbm_pages": round(self.hbm_pages.value, 2),
+            "samples": self.samples,
+            "tokens": self.tokens,
+        }
+
+
+class WorkloadProfiler:
+    """Per-class performance profiles + co-tenant contention telemetry.
+
+    Concurrency model (mirrors metrics.LOCK_WAIT): the HOT path —
+    ``record_step`` / ``record_chip`` — is a stride check plus one
+    GIL-atomic list append; all bucketing, EWMA folding and neighbor
+    resolution happen under ``_fold_lock`` on the READER's thread
+    (scrape, /debug/profiles, journal flush).  ``note_bind`` /
+    ``note_unbind`` run at the scheduler's commit points under its
+    engine lock, so they are O(chips) dict ops behind a plain internal
+    lock that is never held while calling out."""
+
+    def __init__(self):
+        self.enabled = False
+        self.sample = 0.0
+        self.stride = 1
+        self.ewma_alpha = 0.2
+        self.reservoir_k = 512
+        self.journal_interval_s = 30.0
+        self._cap = 20000  # raw-buffer bound, same stance as _WAITS_CAP
+        # identity of THIS process' serving workload (serve.py sets it);
+        # record_step falls back to it when no explicit identity rides
+        # the sample
+        self._id_pod = ""
+        self._id_class = DEFAULT_WORKLOAD_CLASS
+        self._id_generation = "unknown"
+        self._id_chips = 1
+        self._id_neighbors: tuple[str, ...] = ()
+        # raw sample rings (appends are GIL-atomic; trimmed via try-lock)
+        self._step_buf: list[tuple] = []
+        self._chip_buf: list[tuple] = []
+        self._step_n = 0  # stride counter (sampling without RNG cost)
+        self.dropped_steps = 0
+        self.dropped_chips = 0
+        # fold-path state
+        self._fold_lock = threading.Lock()
+        self._profiles: dict[str, _ClassProfile] = {}
+        self._solo: dict[str, _Ewma] = {}  # class → solo tokens/s/chip
+        self._pairs: dict[tuple[str, str], _Ewma] = {}  # (cls, ncls) → co
+        self._chip_occ: dict[tuple[str, str], dict] = {}  # (node, coord)
+        self._folded = {"step": 0, "chip": 0}
+        self._journal_at = 0.0
+        self._journal_seqs = 0
+        # co-tenancy (scheduler commit path).  _tenancy_gen bumps on
+        # every bind/unbind: step samples stamp it at record time, and
+        # the fold attributes interference ONLY when the sample's gen
+        # still matches — a sample buffered before a neighbor arrived
+        # must not feed that (class, neighbor) pair (fold-time-only
+        # resolution would misattribute whole solo windows).
+        self._tenancy_lock = threading.Lock()
+        self._tenancy_gen = 0
+        self._pod_tenancy: dict[str, tuple] = {}
+        self._chip_tenants: dict[tuple[str, str], dict[str, str]] = {}
+        # ONE gauge carries the refresher: a single run rebuilds both
+        # series sets (replace()), and the registry collects the gauges
+        # in registration order within a scrape — registering it twice
+        # would double-pay the fold per scrape
+        PROFILE_TOKENS.refresher = self._refresh_gauges
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(
+        self,
+        sample: float = 1.0,
+        ewma_alpha: float = 0.2,
+        reservoir_k: int = 512,
+        journal_interval_s: float = 30.0,
+    ) -> None:
+        """Enable (sample > 0) or disable (sample <= 0) profiling.
+        ``sample`` is a step-sampling rate like ``--trace-sample``:
+        1.0 profiles every engine step, 0.25 every 4th — implemented as
+        a deterministic stride so the hot path never draws randomness."""
+        self.sample = max(0.0, min(1.0, float(sample)))
+        self.stride = max(1, round(1.0 / self.sample)) if self.sample else 1
+        self.ewma_alpha = min(1.0, max(0.001, float(ewma_alpha)))
+        self.reservoir_k = max(16, int(reservoir_k))
+        self.journal_interval_s = max(0.1, float(journal_interval_s))
+        self.enabled = self.sample > 0.0
+
+    def set_identity(
+        self,
+        pod: str = "",
+        wclass: str = DEFAULT_WORKLOAD_CLASS,
+        generation: str = "unknown",
+        chips: int = 1,
+        neighbors: tuple[str, ...] = (),
+    ) -> None:
+        """Who THIS process' serving engine is (serve.py wires it from
+        flags/env): pod key, workload class, TPU generation, chip count,
+        and — when the pod knows its fractional co-tenants (the
+        ``TPU_COTENANT_CLASSES`` env the node agent can set) — their
+        classes, so even a lone serving pod can contribute interference
+        samples without the scheduler's tenancy map."""
+        self._id_pod = pod
+        self._id_class = wclass or DEFAULT_WORKLOAD_CLASS
+        self._id_generation = generation or "unknown"
+        self._id_chips = max(1, int(chips))
+        self._id_neighbors = tuple(neighbors)
+
+    def reset(self) -> None:
+        """Drop every buffer/aggregate (tests, CI soaks)."""
+        with self._fold_lock, self._tenancy_lock:
+            del self._step_buf[:]
+            del self._chip_buf[:]
+            self._step_n = 0
+            self.dropped_steps = self.dropped_chips = 0
+            self._profiles.clear()
+            self._solo.clear()
+            self._pairs.clear()
+            self._chip_occ.clear()
+            self._folded = {"step": 0, "chip": 0}
+            self._journal_at = 0.0
+            self._journal_seqs = 0
+            self._tenancy_gen = 0
+            self._pod_tenancy.clear()
+            self._chip_tenants.clear()
+
+    # -- hot path ------------------------------------------------------------
+
+    def record_step(
+        self,
+        tokens: int,
+        wall_s: float,
+        slots_active: int = 0,
+        slots_total: int = 1,
+        host_gap_ms: float = 0.0,
+        queue_depth: int = 0,
+        hbm_pages: int = 0,
+        pod: Optional[str] = None,
+        wclass: Optional[str] = None,
+        generation: Optional[str] = None,
+        chips: Optional[int] = None,
+        neighbors: Optional[tuple] = None,
+    ) -> bool:
+        """One engine-step sample.  Returns True when the sample was
+        captured (stride-sampled otherwise).  Cost when profiling is on:
+        a counter increment + one tuple append; identity defaults to
+        :meth:`set_identity`.  NEVER touches device state — callers pass
+        host-side counters only, so steady-state decode stays at zero
+        additional host→device uploads."""
+        if not self.enabled:
+            return False
+        self._step_n += 1
+        if self._step_n % self.stride:
+            return False
+        # neighbors: an EXPLICIT tuple (even empty = "known solo") wins;
+        # None = unknown — the fold resolves via the co-tenancy map,
+        # gated on the stamped tenancy generation so only samples taken
+        # under the CURRENT tenancy feed the interference EWMAs
+        if neighbors is None:
+            neighbors = self._id_neighbors if self._id_neighbors else None
+        buf = self._step_buf
+        buf.append((
+            pod if pod is not None else self._id_pod,
+            wclass if wclass is not None else self._id_class,
+            generation if generation is not None else self._id_generation,
+            chips if chips is not None else self._id_chips,
+            neighbors,
+            self._tenancy_gen,
+            int(tokens), float(wall_s), int(slots_active),
+            max(1, int(slots_total)), float(host_gap_ms),
+            int(queue_depth), int(hbm_pages),
+        ))
+        if len(buf) > self._cap and self._fold_lock.acquire(blocking=False):
+            # nothing is folding: trim like the TimedLock wait buffers —
+            # try-acquire keeps this path non-blocking, and the drop is
+            # COUNTED (never silently discard samples)
+            try:
+                n = self._cap // 2
+                del buf[:n]
+                self.dropped_steps += n
+            finally:
+                self._fold_lock.release()
+        return True
+
+    def record_chip(
+        self,
+        node: str,
+        coord: str,
+        core_units: int,
+        core_total: int,
+        tenant: str = "",
+    ) -> None:
+        """Per-chip occupancy sample from the device-plugin path (one
+        append; folded into per-chip utilization for /debug/profiles)."""
+        if not self.enabled:
+            return
+        buf = self._chip_buf
+        buf.append((
+            node, coord, int(core_units), max(1, int(core_total)),
+            tenant or "",
+        ))
+        if len(buf) > self._cap and self._fold_lock.acquire(blocking=False):
+            try:
+                n = self._cap // 2
+                del buf[:n]
+                self.dropped_chips += n
+            finally:
+                self._fold_lock.release()
+
+    # -- co-tenancy (scheduler commit path) ----------------------------------
+
+    def note_bind(
+        self,
+        pod_key: str,
+        node: str,
+        wclass: str,
+        generation: str,
+        coords: tuple,
+        fractional: bool,
+    ) -> None:
+        """Learn a committed placement (called at the scheduler's bind/
+        migrate commit, possibly under its engine lock — O(chips) dict
+        ops only; the internal lock is never held while calling out)."""
+        if not self.enabled:
+            return
+        coords = tuple(str(c) for c in coords)
+        with self._tenancy_lock:
+            self._tenancy_gen += 1
+            old = self._pod_tenancy.get(pod_key)
+            if old is not None:
+                self._evict_tenancy_locked(pod_key, old)
+            self._pod_tenancy[pod_key] = (
+                node, wclass, generation, coords, bool(fractional)
+            )
+            for c in coords:
+                self._chip_tenants.setdefault((node, c), {})[pod_key] = wclass
+
+    def note_unbind(self, pod_key: str) -> None:
+        if not self.enabled:
+            return
+        with self._tenancy_lock:
+            old = self._pod_tenancy.pop(pod_key, None)
+            if old is not None:
+                self._tenancy_gen += 1
+                self._evict_tenancy_locked(pod_key, old)
+
+    def _evict_tenancy_locked(self, pod_key: str, entry: tuple) -> None:
+        node, _cls, _gen, coords, _frac = entry
+        for c in coords:
+            tenants = self._chip_tenants.get((node, c))
+            if tenants is not None:
+                tenants.pop(pod_key, None)
+                if not tenants:
+                    del self._chip_tenants[(node, c)]
+
+    def neighbors_of(self, pod_key: str) -> tuple[str, ...]:
+        """Distinct co-tenant classes sharing any of the pod's chips
+        (empty = solo).  Used by the fold path and by tests."""
+        return self._neighbors_and_gen(pod_key)[1]
+
+    def _neighbors_and_gen(self, pod_key: str) -> tuple[int, tuple]:
+        """(tenancy generation, neighbor classes) in ONE lock hold, so
+        the fold can match a sample's stamped generation against exactly
+        the map it resolves neighbors from."""
+        with self._tenancy_lock:
+            gen = self._tenancy_gen
+            entry = self._pod_tenancy.get(pod_key)
+            if entry is None:
+                return gen, ()
+            node, _cls, _gen, coords, _frac = entry
+            out: set[str] = set()
+            for c in coords:
+                for pk, cls in self._chip_tenants.get((node, c), {}).items():
+                    if pk != pod_key:
+                        out.add(cls)
+            return gen, tuple(sorted(out))
+
+    # -- fold path (reader threads) ------------------------------------------
+
+    def _fold(self) -> None:
+        """Drain the raw rings into the aggregates.  Slice-then-del is
+        safe against concurrent hot-path appends landing at the tail
+        (the TimedLock drain pattern); runs under the fold lock so two
+        racing readers never double-apply a sample."""
+        with self._fold_lock:
+            n = len(self._step_buf)
+            steps = self._step_buf[:n]
+            del self._step_buf[:n]
+            m = len(self._chip_buf)
+            chips = self._chip_buf[:m]
+            del self._chip_buf[:m]
+            alpha = self.ewma_alpha
+            lat_batches: dict[str, list[float]] = {}
+            for (
+                pod, wclass, gen, nchips, neighbors, tgen, tokens, wall_s,
+                active, total, gap_ms, qdepth, pages,
+            ) in steps:
+                prof = self._profiles.get(wclass)
+                if prof is None:
+                    prof = self._profiles[wclass] = _ClassProfile(
+                        self.reservoir_k
+                    )
+                tps = (tokens / wall_s / max(1, nchips)) if wall_s > 0 else 0.0
+                prof.tput.setdefault(gen, _Ewma()).update(tps, alpha)
+                prof.latency.add(wall_s)
+                prof.occupancy.update(active / total, alpha)
+                prof.host_gap_ms.update(gap_ms, alpha)
+                prof.queue_depth.update(qdepth, alpha)
+                prof.hbm_pages.update(pages, alpha)
+                prof.samples += 1
+                prof.tokens += tokens
+                lat_batches.setdefault(wclass, []).append(wall_s)
+                # interference: an EXPLICIT neighbor tuple on the sample
+                # wins; otherwise resolve via the co-tenancy map — but
+                # ONLY when the sample's stamped tenancy generation still
+                # matches the map's (a sample buffered before a neighbor
+                # arrived/left must not be attributed to the new regime;
+                # such stale samples still feed throughput/latency, just
+                # not the interference EWMAs)
+                if neighbors is not None:
+                    ncls: Optional[tuple] = tuple(neighbors)
+                elif pod:
+                    cur_gen, resolved = self._neighbors_and_gen(pod)
+                    ncls = resolved if tgen == cur_gen else None
+                else:
+                    ncls = ()
+                if ncls is not None and (tokens or wall_s):
+                    if not ncls:
+                        self._solo.setdefault(wclass, _Ewma()).update(
+                            tps, alpha
+                        )
+                    else:
+                        for nc in ncls:
+                            self._pairs.setdefault(
+                                (wclass, nc), _Ewma()
+                            ).update(tps, alpha)
+            for (node, coord, units, total, tenant) in chips:
+                occ = self._chip_occ.setdefault(
+                    (node, coord),
+                    {"util": _Ewma(), "samples": 0, "tenants": set()},
+                )
+                occ["util"].update(units / total, alpha)
+                occ["samples"] += 1
+                if tenant:
+                    occ["tenants"].add(tenant)
+                    if len(occ["tenants"]) > 16:
+                        occ["tenants"].pop()
+            self._folded["step"] += n
+            self._folded["chip"] += m
+        # metric counters + histograms OUTSIDE the fold lock (their own
+        # locks suffice; a scrape mid-update reads a consistent snapshot)
+        if n:
+            PROFILE_SAMPLES.inc("step", value=float(n))
+        if m:
+            PROFILE_SAMPLES.inc("chip", value=float(m))
+        for wclass, vals in lat_batches.items():
+            PROFILE_STEP_SECONDS.observe_batch(wclass, values=vals)
+        if self.dropped_steps or self.dropped_chips:
+            with self._fold_lock:
+                ds, self.dropped_steps = self.dropped_steps, 0
+                dc, self.dropped_chips = self.dropped_chips, 0
+            if ds:
+                PROFILE_DROPPED.inc("step", value=float(ds))
+            if dc:
+                PROFILE_DROPPED.inc("chip", value=float(dc))
+
+    # -- read APIs -----------------------------------------------------------
+
+    def profiles(self) -> dict:
+        """Per-class profiles (folds first)."""
+        self._fold()
+        with self._fold_lock:
+            return self._profiles_locked()
+
+    def _profiles_locked(self) -> dict:
+        return {
+            cls: prof.as_dict()
+            for cls, prof in sorted(self._profiles.items())
+        }
+
+    def interference_matrix(self) -> dict:
+        """{class: {neighbor: ratio}} — co-located tokens/s/chip divided
+        by the class' solo tokens/s/chip.  A pair appears only once both
+        regimes were observed; ratio < 1 means measured slowdown."""
+        self._fold()
+        with self._fold_lock:
+            return self._matrix_locked()
+
+    def _matrix_locked(self) -> dict:
+        out: dict[str, dict[str, float]] = {}
+        for (cls, ncls), co in sorted(self._pairs.items()):
+            solo = self._solo.get(cls)
+            if solo is None or solo.value <= 0 or co.n == 0:
+                continue
+            out.setdefault(cls, {})[ncls] = round(
+                co.value / solo.value, 4
+            )
+        return out
+
+    def debug_state(self) -> dict:
+        """The /debug/profiles payload."""
+        self._fold()
+        with self._fold_lock:
+            profiles = self._profiles_locked()
+            matrix = self._matrix_locked()
+            chip_occ = {
+                f"{node}/{coord}": {
+                    "core_util": round(occ["util"].value, 4),
+                    "samples": occ["samples"],
+                    "tenants": sorted(occ["tenants"]),
+                }
+                for (node, coord), occ in sorted(self._chip_occ.items())
+            }
+            folded = dict(self._folded)
+            pending = len(self._step_buf) + len(self._chip_buf)
+            solo = {
+                cls: round(e.value, 3) for cls, e in sorted(self._solo.items())
+            }
+        with self._tenancy_lock:
+            tenancy = {
+                pk: {
+                    "node": node, "class": cls, "generation": gen,
+                    "chips": list(coords), "fractional": frac,
+                }
+                for pk, (node, cls, gen, coords, frac) in sorted(
+                    self._pod_tenancy.items()
+                )
+            }
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "identity": {
+                "pod": self._id_pod,
+                "class": self._id_class,
+                "generation": self._id_generation,
+                "chips": self._id_chips,
+            },
+            "folded": folded,
+            "pending": pending,
+            "journal_records": self._journal_seqs,
+            "profiles": profiles,
+            "solo_tokens_per_sec_per_chip": solo,
+            "interference": matrix,
+            "chip_occupancy": chip_occ,
+            "tenancy": tenancy,
+        }
+
+    # -- journal integration -------------------------------------------------
+
+    def snapshot_for_journal(self) -> dict:
+        """Compact profile snapshot for a journal ``profile`` record —
+        everything a profile-aware rater needs to re-score recorded
+        workload offline (folds first)."""
+        self._fold()
+        with self._fold_lock:
+            profiles = self._profiles_locked()
+            matrix = self._matrix_locked()
+        return {
+            "profiles": {
+                cls: {
+                    "tput": p["tokens_per_sec_per_chip"],
+                    "p50_ms": p["step_ms"]["p50"],
+                    "p99_ms": p["step_ms"]["p99"],
+                    "occupancy": p["slot_occupancy"],
+                    "samples": p["samples"],
+                }
+                for cls, p in profiles.items()
+            },
+            "interference": matrix,
+        }
+
+    def maybe_journal(self, force: bool = False) -> Optional[int]:
+        """Land a ``profile`` record in the decision journal when the
+        interval elapsed (or ``force``).  Cheap when not due: one time
+        compare.  The record is an ANNOTATION — replay never mutates
+        allocator state from it (journal/replay.py)."""
+        from ..journal import JOURNAL
+
+        if not self.enabled or not JOURNAL.enabled:
+            return None
+        now = time.monotonic()
+        if not force and now - self._journal_at < self.journal_interval_s:
+            return None
+        self._journal_at = now
+        snap = self.snapshot_for_journal()
+        if not snap["profiles"]:
+            return None
+        from ..tracing import TRACER
+
+        with TRACER.span(
+            "profile.flush",
+            classes=len(snap["profiles"]),
+            pairs=sum(len(v) for v in snap["interference"].values()),
+        ):
+            seq = JOURNAL.record("profile", **snap)
+        if seq is not None:
+            self._journal_seqs += 1
+        return seq
+
+    # -- metrics export (LazyGauge refresher; scrape-time only) --------------
+
+    def _refresh_gauges(self) -> None:
+        # ONE fold serves both gauges (the refresher is registered on
+        # PROFILE_TOKENS only; the registry collects in registration
+        # order, so INTERFERENCE_RATIO exports the same refresh)
+        self._fold()
+        with self._fold_lock:
+            profiles = self._profiles_locked()
+            matrix = self._matrix_locked()
+        tokens: dict[tuple[str, ...], float] = {}
+        for cls, p in profiles.items():
+            for gen, tps in p["tokens_per_sec_per_chip"].items():
+                tokens[(cls, gen)] = tps
+        ratios: dict[tuple[str, ...], float] = {}
+        for cls, row in matrix.items():
+            for ncls, ratio in row.items():
+                ratios[(cls, ncls)] = ratio
+        # whole-dict swap per gauge: one lock acquisition, so a racing
+        # scrape can never observe a cleared-but-unfilled series set
+        PROFILE_TOKENS.replace(tokens)
+        INTERFERENCE_RATIO.replace(ratios)
+
+
+def configure_from_env() -> None:
+    """Apply ``TPU_PROFILE_SAMPLE`` — same contract (and same default-ON
+    stance) as ``TPU_TRACE_SAMPLE``: unset means 1.0, the per-sample
+    cost is one ring append and the budgets are CI-enforced; 0
+    disables."""
+    raw = os.environ.get("TPU_PROFILE_SAMPLE", "1")
+    try:
+        PROFILER.configure(sample=float(raw))
+    except ValueError:
+        PROFILER.configure(sample=1.0)
+
+
+PROFILER = WorkloadProfiler()
+configure_from_env()

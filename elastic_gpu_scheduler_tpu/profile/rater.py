@@ -1,0 +1,140 @@
+"""Profile-aware placement scoring: measured behavior over pure geometry.
+
+The in-tree raters (core/rater.py) score geometry — ICI locality,
+packing, spread.  ROADMAP item 2 wants dispatch that also weighs
+*measured* workload behavior: place each class on the TPU generation
+where its tokens/s/chip is highest (Gavel's heterogeneity-aware tables)
+and keep classes that measurably slow each other down off shared chips
+(BandPilot's contention signal).  :class:`ProfileAwareRater` is the
+reference consumer of the profile observatory's output — and, run
+through ``journal.replay.what_if``, the proof that the flight recorder
+doubles as the offline promotion harness: recorded workload re-scored
+under recorded profiles, no live cluster touched.
+
+``what_if`` drives the two extension hooks:
+
+- ``observe_profile(rec)`` — called for every ``profile`` journal record
+  in stream order, so scores use the profiles as they stood at that
+  point of the recording;
+- ``set_workload(wclass, node=, generation=)`` — called before each
+  re-placed bind with the recorded pod's workload class and the target
+  node's TPU generation (from the ``node_add`` record).
+
+Both hooks are duck-typed: raters without them replay exactly as before.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.allocator import ChipSet, Option, Rater
+from ..core.rater import ICILocality
+from ..utils.consts import DEFAULT_WORKLOAD_CLASS
+
+
+class ProfileAwareRater(Rater):
+    """Wrap a geometry rater; scale its score by measured per-class
+    throughput on the target generation and by the class' worst measured
+    interference ratio when the placement shares chips.
+
+    Scoring stays bounded in the base rater's [0, 100] range:
+
+        score = base * (0.5 + 0.5 * tput_factor * interference_factor)
+
+    - ``tput_factor``: this class' EWMA tokens/s/chip on the target
+      node's generation, normalized by its best generation (1.0 on the
+      best-measured hardware, lower elsewhere; 1.0 when unprofiled).
+    - ``interference_factor``: when any fractional alloc lands on a
+      chip that already has tenants, the class' WORST measured
+      co-location ratio (floored at 0.1); 1.0 for exclusive placements
+      or unprofiled classes.
+
+    Neither planner shortcut applies (scores depend on per-node
+    generation and live chip occupancy), so both opt-out flags stay
+    False — same stance as the Random rater.
+    """
+
+    name = "profile-aware"
+    translation_invariant = False
+    whole_chip_compact_first = False
+
+    def __init__(self, base: Optional[Rater] = None):
+        self.base = base or ICILocality()
+        # class → {generation: tokens/s/chip}
+        self.tput: dict[str, dict[str, float]] = {}
+        # class → {neighbor class: co/solo ratio}
+        self.interference: dict[str, dict[str, float]] = {}
+        self._wclass = DEFAULT_WORKLOAD_CLASS
+        self._generation = "unknown"
+        self.profiles_seen = 0
+
+    # -- what_if hooks -------------------------------------------------------
+
+    def observe_profile(self, rec: dict) -> None:
+        """Ingest one journal ``profile`` record (latest wins per key —
+        the stream is time-ordered)."""
+        for cls, p in (rec.get("profiles") or {}).items():
+            row = self.tput.setdefault(cls, {})
+            for gen, tps in (p.get("tput") or {}).items():
+                row[gen] = float(tps)
+        for cls, pairs in (rec.get("interference") or {}).items():
+            row = self.interference.setdefault(cls, {})
+            for ncls, ratio in pairs.items():
+                row[ncls] = float(ratio)
+        self.profiles_seen += 1
+
+    def set_workload(
+        self,
+        wclass: Optional[str],
+        node: Optional[str] = None,
+        generation: Optional[str] = None,
+    ) -> None:
+        self._wclass = wclass or DEFAULT_WORKLOAD_CLASS
+        self._generation = generation or "unknown"
+
+    # -- scoring -------------------------------------------------------------
+
+    def _tput_factor(self) -> float:
+        row = self.tput.get(self._wclass)
+        if not row:
+            return 1.0
+        best = max(row.values())
+        if best <= 0:
+            return 1.0
+        here = row.get(self._generation)
+        if here is None:
+            # unmeasured generation: mildly below the best-known one, so
+            # measured-good hardware wins ties without zeroing the rest
+            return 0.75
+        return max(0.0, min(1.0, here / best))
+
+    def _interference_factor(self, chips: ChipSet, option: Option) -> float:
+        row = self.interference.get(self._wclass)
+        if not row:
+            return 1.0
+        shares = False
+        for a in option.allocs:
+            if a.whole or not a.needs_tpu:
+                continue
+            for c in a.coords:
+                ch = chips.chips[c]
+                # rate() sees post-assignment state: the chip had other
+                # tenants iff its pre-assignment usage was non-zero
+                before_avail = ch.core_avail + a.core
+                if before_avail < ch.core_total:
+                    shares = True
+                    break
+            if shares:
+                break
+        if not shares:
+            return 1.0
+        # the ChipSet does not expose NEIGHBOR classes, so be
+        # conservative: assume the worst measured pairing for this class
+        return max(0.1, min(1.0, min(row.values())))
+
+    def rate(self, chips: ChipSet, option: Option) -> float:
+        base = self.base.rate(chips, option)
+        factor = self._tput_factor() * self._interference_factor(
+            chips, option
+        )
+        return base * (0.5 + 0.5 * factor)

@@ -36,6 +36,7 @@ from ..core.annotations import (
     assigned_node,
     is_assumed,
     option_from_pod,
+    workload_class,
 )
 from ..core.node import NodeAllocator
 from ..core.rater import Rater
@@ -45,6 +46,7 @@ from ..k8s.client import Clientset
 from ..k8s.fake import is_conflict, is_not_found
 from ..k8s.objects import Binding, Pod
 from ..metrics import CHIPS_ALLOCATED, FRAG_INDEX, FREE_SUBMESH, TimedLock
+from ..profile import PROFILER
 from ..tracing import AUDIT, TRACER
 from ..utils import consts
 
@@ -231,9 +233,12 @@ class TPUUnitScheduler(ResourceScheduler):
             self.allocators[node_name] = na
             if JOURNAL.enabled:
                 # capacity inventory first, so every later bind/forget on
-                # this node replays against a known chip set
+                # this node replays against a known chip set; generation
+                # rides along so offline what-if replay can key
+                # profile-aware scores by TPU type
                 JOURNAL.record(
-                    "node_add", node=node_name, **na.chips.inventory()
+                    "node_add", node=node_name, generation=na.generation,
+                    **na.chips.inventory(),
                 )
             for pod in pods:
                 if pod.key in self.pod_maps:
@@ -711,6 +716,7 @@ class TPUUnitScheduler(ResourceScheduler):
         self, pod, from_node, to_node, old_opt, new_opt, source,
         trace_id=None,
     ):
+        self._profile_note("bind", pod, to_node, new_opt)
         if not JOURNAL.enabled:
             return None
         if trace_id is None:
@@ -727,6 +733,7 @@ class TPUUnitScheduler(ResourceScheduler):
             gang=pod_gang_key(pod),
             source=source,
             trace_id=trace_id or None,
+            wclass=workload_class(pod),
         )
 
     # -- gang split-phase primitives (scheduler/gang.py's commit protocol) ----
@@ -930,8 +937,11 @@ class TPUUnitScheduler(ResourceScheduler):
     ):
         """Emit one flight-recorder record for a committed allocator
         mutation (no-op unless the journal is enabled).  Carries the
-        pod's trace id (cross-link to /traces) and the node's
-        fragmentation snapshot from the last gauge refresh."""
+        pod's trace id (cross-link to /traces) and, for binds, the pod's
+        workload class so offline what-if replay can drive profile-aware
+        raters.  Also the profile observatory's co-tenancy choke point:
+        every committed bind/forget passes through here."""
+        self._profile_note(type_, pod, node_name, opt)
         if not JOURNAL.enabled:
             return None
         if trace_id is None:
@@ -949,6 +959,36 @@ class TPUUnitScheduler(ResourceScheduler):
             gang=pod_gang_key(pod),
             source=source,
             trace_id=trace_id or None,
+            wclass=workload_class(pod) if type_ == "bind" else None,
+        )
+
+    def _profile_note(self, type_: str, pod: Pod, node_name: str, opt):
+        """Keep the profile observatory's co-tenancy map current (one
+        attribute check when profiling is off; O(chips) dict ops when
+        on — never a scan, safe under the engine lock)."""
+        if not PROFILER.enabled:
+            return
+        if type_ == "forget":
+            PROFILER.note_unbind(pod.key)
+            return
+        if type_ != "bind" or opt is None:
+            return
+        coords: list = []
+        fractional = False
+        for a in opt.allocs:
+            if not a.needs_tpu:
+                continue
+            coords.extend(a.coords)
+            if not a.whole:
+                fractional = True
+        na = self.allocators.get(node_name)
+        PROFILER.note_bind(
+            pod.key,
+            node_name,
+            workload_class(pod),
+            getattr(na, "generation", "unknown") if na else "unknown",
+            tuple(coords),
+            fractional,
         )
 
     def _record_event(self, pod: Pod, etype: str, reason: str, message: str):

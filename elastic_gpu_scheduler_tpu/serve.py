@@ -94,6 +94,20 @@ def build_args(argv=None):
                    help="request-trace sampling rate (1.0 = every request, "
                         "0 = off; default from TPU_TRACE_SAMPLE, else 1.0); "
                         "GET /traces serves the result")
+    p.add_argument("--profile-sample", type=float, default=None,
+                   help="workload-profile sampling rate (1.0 = every "
+                        "engine step, 0.25 = every 4th, 0 = off; default "
+                        "from TPU_PROFILE_SAMPLE, else 1.0).  GET "
+                        "/debug/profiles and the tpu_workload_* metrics "
+                        "serve the result; cost per sampled step is one "
+                        "ring-buffer append off the device path")
+    p.add_argument("--workload-class", default="",
+                   help="profile class this pod's measured behavior "
+                        "aggregates under (default from "
+                        "TPU_WORKLOAD_CLASS, else the "
+                        "elasticgpu.io/workload-class annotation's "
+                        "default class).  The scheduler keys interference "
+                        "and throughput tables by it")
     return p.parse_args(argv)
 
 
@@ -204,6 +218,45 @@ def main(argv=None) -> int:
 
     if host_ctx is not None:
         host_ctx.close()  # params are host-resident; sharded placement next
+
+    # workload profiling (profile/): identity before the engine starts
+    # stepping, so the first samples already aggregate under the right
+    # class/generation key.  Generation = the real chip kind on TPU, the
+    # backend name elsewhere (a CPU dev box profiles under "cpu").
+    import os as _os
+
+    from .profile import PROFILER
+    from .utils.consts import DEFAULT_WORKLOAD_CLASS
+
+    if args.profile_sample is not None:
+        PROFILER.configure(sample=args.profile_sample)
+    devs0 = jax.devices()
+    generation = (
+        devs0[0].device_kind.lower().replace(" ", "-")
+        if jax.default_backend() == "tpu" and devs0
+        else jax.default_backend()
+    )
+    pod_key = "/".join(
+        p for p in (
+            _os.environ.get("POD_NAMESPACE", ""),
+            _os.environ.get("POD_NAME", ""),
+        ) if p
+    )
+    neighbors = tuple(
+        c for c in _os.environ.get("TPU_COTENANT_CLASSES", "").split(",")
+        if c
+    )
+    PROFILER.set_identity(
+        pod=pod_key,
+        wclass=(
+            args.workload_class
+            or _os.environ.get("TPU_WORKLOAD_CLASS", "")
+            or DEFAULT_WORKLOAD_CLASS
+        ),
+        generation=generation,
+        chips=max(1, args.tensor),
+        neighbors=neighbors,
+    )
 
     engine = InferenceEngine(
         params, cfg,

@@ -42,6 +42,7 @@ from typing import Optional
 
 from .. import __version__
 from ..metrics import REGISTRY, Counter, Gauge, Histogram
+from ..profile import PROFILER
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..models.serving import (
     DRAINING_ERROR,
@@ -91,12 +92,16 @@ SERVE_LATENCY = REGISTRY.register(
     )
 )
 SERVE_HOST_GAP = REGISTRY.register(
-    Gauge(
+    Histogram(
         "tpu_serve_host_gap_ms",
-        "Mean wall time between consecutive fused decode chunk dispatches "
-        "(the window where the accelerator can starve on host "
-        "bookkeeping; the overlapped pipeline keeps it near zero) — set "
-        "at scrape time from engine telemetry",
+        "Wall time between consecutive fused decode chunk dispatches, in "
+        "ms (the window where the accelerator can starve on host "
+        "bookkeeping; the overlapped pipeline keeps it near zero).  A "
+        "HISTOGRAM of per-chunk samples folded at scrape time — p50/p99 "
+        "are real distribution tails, not whichever chunk scraped last "
+        "(the old last-value gauge's failure mode)",
+        buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                 100.0, 500.0),
     )
 )
 
@@ -148,6 +153,7 @@ class EngineLoop:
         eng = self.engine
         failures = 0  # consecutive _fail_all rounds, reset on any success
         step_seq = 0  # steps since a traced batch started (span pacing)
+        prof_seq = 0  # total steps (journal-flush pacing; never resets)
         while not self._stop.is_set():
             try:
                 eng._admit()
@@ -166,6 +172,16 @@ class EngineLoop:
                         ),
                         None,
                     )
+                    # workload profiling (profile/): bracket the step with
+                    # HOST-side counters only — a perf_counter read and
+                    # the engine's token count.  Never touches device
+                    # state, so steady-state decode stays at zero
+                    # additional host→device uploads (the
+                    # engine.device_uploads probe pins this).
+                    prof = PROFILER.enabled
+                    if prof:
+                        prof_t0 = time.perf_counter()
+                        prof_tok0 = eng.tokens_emitted
                     if traced is not None and step_seq % 32 == 0:
                         with TRACER.span(
                             "engine.step", parent=traced,
@@ -184,8 +200,41 @@ class EngineLoop:
                                     round(eng.last_host_gap_ms, 3),
                                 )
                                 sp.set_attr("overlap", eng.overlap)
+                                if prof:
+                                    # profile sample rides the paced span
+                                    # too — /traces cross-links behavior
+                                    # to the decision trail
+                                    wall = time.perf_counter() - prof_t0
+                                    toks = eng.tokens_emitted - prof_tok0
+                                    sp.set_attr(
+                                        "tokens_per_sec",
+                                        round(toks / wall, 1)
+                                        if wall > 0 else 0.0,
+                                    )
                     else:
                         eng.step()
+                    if prof:
+                        PROFILER.record_step(
+                            tokens=eng.tokens_emitted - prof_tok0,
+                            wall_s=time.perf_counter() - prof_t0,
+                            slots_active=sum(
+                                1 for s in eng.slots if s is not None
+                            ),
+                            slots_total=eng.max_batch,
+                            host_gap_ms=eng.last_host_gap_ms,
+                            queue_depth=eng.queue.qsize(),
+                            hbm_pages=(
+                                eng.n_pages - 1 - len(eng.free_pages)
+                            ),
+                        )
+                        prof_seq += 1
+                        if prof_seq % 256 == 0:
+                            # periodic profile records into the flight
+                            # recorder, paced by a counter that never
+                            # resets (step_seq zeroes on untraced
+                            # batches; cheap when not due: one time
+                            # compare inside)
+                            PROFILER.maybe_journal()
                     step_seq = step_seq + 1 if traced is not None else 0
                 else:
                     if eng.draining and eng.queue.empty():
@@ -423,8 +472,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     for pri, depth in engine.queue_depths().items():
                         SERVE_QUEUE_DEPTH.set(str(pri), value=float(depth))
                     SERVE_SPILLS.set(value=float(engine.spills))
-                    SERVE_HOST_GAP.set(
-                        value=round(engine.host_gap_stats()["mean_ms"], 4)
+                    # fold the engine's buffered per-chunk gap samples
+                    # (the scraper pays the bucketing, never the engine)
+                    SERVE_HOST_GAP.observe_batch(
+                        values=engine.drain_host_gaps()
                     )
                     data = REGISTRY.expose().encode()
                 self.send_response(200, "OK")
@@ -435,6 +486,10 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 self.end_headers()
                 self.wfile.write(data)
                 return
+            if self.path == "/debug/profiles":
+                # the profile observatory's serving-plane surface: this
+                # pod's per-class behavior + whatever co-tenancy it knows
+                return self._json(200, PROFILER.debug_state())
             if self.path.split("?", 1)[0] == "/traces":
                 # serving-plane traces (request → engine step → SSE flush);
                 # one response shape shared with the scheduler's /traces

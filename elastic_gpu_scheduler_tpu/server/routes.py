@@ -45,7 +45,9 @@ from ..k8s.extender import (
     ExtenderPreemptionArgs,
 )
 from ..metrics import LOCK_WAIT, REGISTRY, VERB_LATENCY, VERB_TOTAL
+from ..profile import PROFILER
 from ..tracing import AUDIT, TRACER
+from ..utils.tpuprobe import RELAY_MONITOR
 from .handlers import Bind, Predicate, Preemption, Prioritize
 
 log = logging.getLogger("tpu-scheduler")
@@ -256,6 +258,13 @@ the Python analogues):</p>
  — defrag planner state + plan preview (?chips=N&amp;members=M simulates
  unblocking that gang shape); POST /defrag/run executes a round
  ({"dry_run": true} to simulate)</li>
+<li><a href="/debug/profiles">/debug/profiles</a>
+ — workload profiling observatory: per-class throughput/latency
+ profiles, the (class, class) interference matrix, chip occupancy and
+ the co-tenancy map (--profile-sample gates collection)</li>
+<li><a href="/debug/relay">/debug/relay</a>
+ — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
+ state, latency, failure detail; --relay-probe-interval starts it)</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/scheduler/status">/scheduler/status</a>
  — per-node chip state dump</li>
@@ -530,6 +539,22 @@ class ExtenderServer:
             except Exception as e:
                 out["preview_error"] = str(e)
             return 200, json.dumps(out, indent=1).encode(), "application/json"
+        if path == "/debug/profiles":
+            # the workload-profiling observatory (profile/): per-class
+            # profiles, interference matrix, co-tenancy.  Folding the
+            # sample rings happens HERE, on the reader's thread — same
+            # stance as the LazyGauge fragmentation scan.
+            return (
+                200,
+                json.dumps(PROFILER.debug_state(), indent=1).encode(),
+                "application/json",
+            )
+        if path == "/debug/relay":
+            return (
+                200,
+                json.dumps(RELAY_MONITOR.debug_state(), indent=1).encode(),
+                "application/json",
+            )
         if path == "/debug/journal":
             params = _parse_query(query)
             try:

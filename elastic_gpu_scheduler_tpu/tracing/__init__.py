@@ -37,6 +37,14 @@ outcomes were previously visible only as aggregate histograms
   no ids, no clock reads, no locks — the hot path pays one attribute
   load and one comparison.
 
+- **Profile sample spans.**  The workload-profiling observatory
+  (``profile/``) cross-links measured behavior into the decision trail:
+  paced ``engine.step`` spans carry a ``tokens_per_sec`` attribute when
+  profiling is on, and each periodic journal flush of the per-class
+  profiles emits a ``profile.flush`` span (class/pair counts) so
+  ``/traces`` shows WHEN each recorded profile snapshot was taken
+  relative to the placements it will re-score.
+
 The reference has none of this (its pprof mount is aggregate-only);
 contention-aware schedulers (BandPilot, Gavel — PAPERS.md) rely on
 exactly this per-decision provenance to debug placement quality.

@@ -37,6 +37,12 @@ ANNOTATION_GANG_SLICES = "elasticgpu.io/gang-slices"  # "sliceA,sliceB,..."
 # can continue the pod's scheduling trace.  W3C traceparent format.
 ANNOTATION_TRACEPARENT = "elasticgpu.io/traceparent"
 
+# Workload profiling (profile/): the class key under which this pod's
+# measured behavior (throughput, latency, interference) aggregates.
+# Pods without the annotation profile under DEFAULT_WORKLOAD_CLASS.
+ANNOTATION_WORKLOAD_CLASS = "elasticgpu.io/workload-class"
+DEFAULT_WORKLOAD_CLASS = "default"
+
 # Node labels describing TPU topology (mirrors GKE's
 # cloud.google.com/gke-tpu-topology convention).
 LABEL_TPU_ACCELERATOR = "elasticgpu.io/tpu-accelerator"  # v4|v5e|v5p|v6e

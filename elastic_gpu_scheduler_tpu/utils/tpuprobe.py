@@ -1,0 +1,151 @@
+"""Live TPU-relay health signal: the ``tpu_relay_up`` gauge.
+
+The bench/validation tooling has probed the TPU relay since BENCH_r02
+(and fail-fasts when it is down), but *live* ``/metrics`` carried no
+signal an operator could alert on — a down relay was only discoverable
+by running the bench.  :class:`RelayMonitor` closes that gap: a daemon
+thread probes the relay on a slow interval (subprocess ``jax.devices()``
+with a hard timeout — a downed relay hangs an in-process probe forever,
+same reason bench.probe_tpu subprocesses) and publishes:
+
+    tpu_relay_up  1 = the last probe reached a TPU backend
+                  0 = probe failed / timed out / non-TPU backend
+
+``GET /debug/relay`` (scheduler server) serves the full state: last
+probe time, latency, and the failure detail.  The monitor is OFF by
+default (zero scrape cost, zero subprocesses in tests); the scheduler
+CLI starts it via ``--relay-probe-interval`` and operators can alert on
+``tpu_relay_up == 0``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from ..metrics import REGISTRY, Gauge
+
+__all__ = ["RELAY_UP", "RELAY_MONITOR", "RelayMonitor", "probe_relay"]
+
+RELAY_UP = REGISTRY.register(
+    Gauge(
+        "tpu_relay_up",
+        "TPU probe-relay reachability from this process: 1 = the last "
+        "periodic probe reached a TPU backend, 0 = it failed or timed "
+        "out (bench on-chip sections will fail-fast; alert on 0).  "
+        "Absent until a RelayMonitor runs (--relay-probe-interval)",
+    )
+)
+
+
+def probe_relay(timeout: float = 20.0) -> tuple[bool, str]:
+    """(up, detail): probe the TPU relay in a SUBPROCESS — a downed relay
+    makes in-process ``jax.devices()`` hang indefinitely, so the timeout
+    must bound a child we can kill.  ``detail`` is the chip kind when
+    up, the failure reason otherwise."""
+    try:
+        p = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import jax; d = jax.devices(); "
+                "assert jax.default_backend() == 'tpu', "
+                "'NOT_TPU:' + jax.default_backend(); "
+                "print(d[0].device_kind)",
+            ],
+            timeout=timeout, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout:.0f}s (relay down?)"
+    except OSError as e:
+        return False, f"probe spawn failed: {e}"
+    if p.returncode == 0:
+        return True, p.stdout.decode().strip()
+    return False, p.stderr.decode(errors="replace")[-200:]
+
+
+class RelayMonitor:
+    """Background relay prober feeding ``tpu_relay_up``.
+
+    Probes on its OWN daemon thread at ``interval_s`` — never on the
+    scrape path (a scrape-time probe would add seconds to /metrics and
+    fan out one jax subprocess per scraper).  ``probe`` is injectable
+    for tests."""
+
+    def __init__(
+        self,
+        interval_s: float = 300.0,
+        timeout_s: Optional[float] = None,
+        probe: Callable[[float], tuple[bool, str]] = probe_relay,
+    ):
+        self.interval_s = max(5.0, float(interval_s))
+        self.timeout_s = (
+            float(timeout_s)
+            if timeout_s is not None
+            else float(os.environ.get("TPU_RELAY_PROBE_TIMEOUT", "20"))
+        )
+        self.probe = probe
+        self.up: Optional[bool] = None  # None = never probed
+        self.detail = ""
+        self.probes = 0
+        self.last_probe_at = 0.0  # time.time of the last completed probe
+        self.last_probe_ms = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def probe_once(self) -> bool:
+        t0 = time.perf_counter()
+        up, detail = self.probe(self.timeout_s)
+        self.last_probe_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        self.up, self.detail = up, detail
+        self.probes += 1
+        self.last_probe_at = time.time()
+        RELAY_UP.set(value=1.0 if up else 0.0)
+        return up
+
+    def start(self) -> "RelayMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.probe_once()
+                except Exception:  # the monitor must outlive any probe bug
+                    RELAY_UP.set(value=0.0)
+                if self._stop.wait(self.interval_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="tpu-relay-probe", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    def debug_state(self) -> dict:
+        """The /debug/relay payload."""
+        return {
+            "running": self._thread is not None,
+            "up": self.up,
+            "detail": self.detail,
+            "probes": self.probes,
+            "interval_s": self.interval_s,
+            "timeout_s": self.timeout_s,
+            "last_probe_at": round(self.last_probe_at, 3),
+            "last_probe_ms": self.last_probe_ms,
+        }
+
+
+# Process-global instance (same pattern as TRACER/JOURNAL/PROFILER): the
+# CLI starts it; /debug/relay reads it whether or not it ever ran.
+RELAY_MONITOR = RelayMonitor()

@@ -1,0 +1,425 @@
+#!/usr/bin/env python
+"""CI gate for the workload-profiling observatory (`make check-profile`).
+
+Four phases, all HARD-FAIL:
+
+1. **Convergence** — a randomized bind soak over a mixed v5e/v5p fleet
+   with class-annotated pods, plus synthetic step samples injected at
+   known per-(class, generation) rates: the EWMA profiles must converge
+   to the injected throughput within tolerance.
+2. **Interference** — a fractional co-location (two classes sharing a
+   chip, the co-located rate injected at half the solo rate): the
+   (class, neighbor) interference ratio must detect the slowdown.
+3. **Journal round trip** — the soak runs with the flight recorder on
+   and periodic `profile` records: replay must accept them as
+   annotations (zero violations, zero warnings), and `what_if` under the
+   profile-aware rater must consume the recorded profiles and produce a
+   different placement score than its geometry base (the offline
+   promotion-harness demonstration).
+4. **Overhead budgets** — (a) bind p99 with profiling on stays within
+   PROFILE_OVERHEAD_BUDGET_PCT (default 5%) of profiling-off, via
+   bench.profile_bench's interleaved-chunk + storm-trimmed estimator,
+   retried 3x like check-journal; (b) decode throughput through a real
+   (CPU) engine with profiling on stays within
+   PROFILE_SERVE_BUDGET_PCT (default 10%) of profiling-off, min-of-
+   rounds each side, AND the engine's device-upload counter matches
+   exactly (profiling must add ZERO host→device uploads).
+
+Usage:
+    python tools/check_profile.py [--ops N] [--skip-serve] [--skip-overhead]
+
+Environment:
+    CHECK_PROFILE_SEED            soak RNG seed (default 20260803)
+    PROFILE_OVERHEAD_BUDGET_PCT   bind p99 budget (default 5)
+    PROFILE_SERVE_BUDGET_PCT      decode-throughput budget (default 10)
+
+Wired into the Makefile as `make check-profile`, next to `check-defrag`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elastic_gpu_scheduler_tpu.cli import build_stack  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import replay, what_if  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.extender import (  # noqa: E402
+    ExtenderArgs,
+    ExtenderBindingArgs,
+)
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.objects import (  # noqa: E402
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.profile import PROFILER  # noqa: E402
+from elastic_gpu_scheduler_tpu.profile.rater import ProfileAwareRater  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+
+# injected synthetic rates (tokens/s/chip) per (class, generation):
+# "serve" measured 3x faster on v5p; "train" flat
+INJECTED = {
+    ("serve", "v5e"): 1000.0,
+    ("serve", "v5p"): 3000.0,
+    ("train", "v5e"): 400.0,
+    ("train", "v5p"): 400.0,
+}
+COLOCATED_FACTOR = 0.5  # co-located "serve" runs at half its solo rate
+
+
+def _pod(name, core, wclass):
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: core}
+                ),
+            )
+        ],
+        annotations={consts.ANNOTATION_WORKLOAD_CLASS: wclass},
+    )
+
+
+def _inject_samples(pod_key, wclass, gen, rng, n=40, colocated=False):
+    """Synthetic engine-step samples at the injected rate (exact rate,
+    jittered wall so the reservoir sees variety)."""
+    rate = INJECTED[(wclass, gen)]
+    if colocated:
+        rate *= COLOCATED_FACTOR
+    for _ in range(n):
+        wall = 0.008 + rng.random() * 0.004
+        PROFILER.record_step(
+            tokens=max(1, round(rate * wall)),
+            wall_s=max(1e-4, round(rate * wall)) / rate,  # exact rate
+            slots_active=rng.randint(1, 4), slots_total=4,
+            host_gap_ms=rng.random(), queue_depth=rng.randint(0, 3),
+            hbm_pages=rng.randint(4, 40),
+            pod=pod_key, wclass=wclass, generation=gen, chips=1,
+        )
+
+
+def _soak(ops, rng, journal_dir):
+    """Randomized class-annotated bind/forget churn over a v5e+v5p fleet
+    with synthetic step samples per live pod; ends with a forced
+    fractional co-location for the interference phase."""
+    JOURNAL.configure(journal_dir, fsync="off", max_segment_bytes=64 << 20)
+    cluster = FakeCluster()
+    gens = {}
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"v5e-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+        gens[f"v5e-{i}"] = "v5e"
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"v5p-{i}", chips=4, hbm_gib=96, accelerator="v5p")
+        )
+        gens[f"v5p-{i}"] = "v5p"
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="ici-locality")
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    nodes = list(gens)
+
+    live = {}
+    serial = 0
+    for _op in range(ops):
+        if live and rng.random() < 0.4:
+            key = rng.choice(sorted(live))
+            sched.forget_pod(live.pop(key), source="soak_delete")
+            continue
+        serial += 1
+        wclass = rng.choice(["serve", "train"])
+        core = rng.choice([50, 100, 200])
+        pod = _pod(f"soak-{serial}", core, wclass)
+        cluster.create_pod(pod)
+        filt = predicate.handle(ExtenderArgs(pod=pod, node_names=nodes))
+        if filt.error or not filt.node_names:
+            continue
+        target = rng.choice(filt.node_names)
+        res = bind.handle(
+            ExtenderBindingArgs(
+                pod_name=pod.metadata.name,
+                pod_namespace=pod.metadata.namespace,
+                pod_uid=pod.metadata.uid,
+                node=target,
+            )
+        )
+        if res.error:
+            continue
+        live[pod.key] = pod
+        # a solo batch of samples for this pod — fold first so the
+        # neighbor resolution below sees tenancy as of THIS bind
+        PROFILER._fold()
+        if rng.random() < 0.8:
+            _inject_samples(
+                pod.key, wclass, gens[target], rng,
+                colocated=bool(PROFILER.neighbors_of(pod.key)),
+            )
+        if rng.random() < 0.2:
+            PROFILER.maybe_journal(force=True)
+
+    # drain, then force the interference scenario: solo fractional serve
+    # on one chip, then a train tenant sharing it, rates halving
+    for key in sorted(live):
+        sched.forget_pod(live.pop(key), source="soak_drain")
+    PROFILER._fold()
+    p_serve = _pod("ifx-serve", 50, "serve")
+    cluster.create_pod(p_serve)
+    sched.bind("v5e-0", p_serve)
+    _inject_samples(p_serve.key, "serve", "v5e", rng, n=60)
+    PROFILER._fold()  # solo regime folded before the co-tenant lands
+    p_train = _pod("ifx-train", 50, "train")
+    cluster.create_pod(p_train)
+    sched.bind("v5e-0", p_train)
+    _inject_samples(p_serve.key, "serve", "v5e", rng, n=60, colocated=True)
+    _inject_samples(p_train.key, "train", "v5e", rng, n=30)
+    PROFILER.maybe_journal(force=True)
+    return status()
+
+
+def _serve_overhead(budget_pct, failures, result):
+    """Decode throughput + upload parity with profiling off vs on,
+    through a real CPU engine (min-of-rounds each side; 3 attempts)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    def run(profiling_on):
+        PROFILER.configure(sample=1.0 if profiling_on else 0.0)
+        eng = InferenceEngine(
+            params, cfg, max_batch=4, max_len=96, page_size=16,
+            fused_steps=4,
+        )
+        reqs = [
+            Request(prompt=[3 + i, 9, 14], max_new_tokens=24)
+            for i in range(8)
+        ]
+        for r in reqs:
+            eng.submit(r)
+        t0 = time.perf_counter()
+        eng.run_until_idle(max_steps=100_000)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        for r in reqs:
+            assert not r.error, r.error
+        return toks / wall, eng.device_uploads
+
+    attempts = []
+    ok = False
+    for _attempt in range(3):
+        tput_off, up_off = run(False)
+        tput_on, up_on = run(True)
+        if up_on != up_off:
+            failures.append(
+                f"profiling changed device uploads: {up_on} vs {up_off} "
+                "(must be ZERO additional host→device uploads)"
+            )
+            break
+        overhead = (tput_off / tput_on - 1.0) * 100 if tput_on > 0 else 1e9
+        attempts.append(round(overhead, 2))
+        if overhead <= budget_pct:
+            ok = True
+            break
+    result["serve_overhead_attempts_pct"] = attempts
+    result["serve_tokens_per_sec_on"] = round(tput_on, 1)
+    result["serve_tokens_per_sec_off"] = round(tput_off, 1)
+    result["serve_device_uploads"] = up_on
+    if attempts and not ok:
+        failures.append(
+            f"decode throughput with profiling on over budget on every "
+            f"attempt ({attempts}% vs {budget_pct}%)"
+        )
+    PROFILER.configure(sample=0.0)
+
+
+def main() -> int:
+    ops = 120
+    skip_serve = skip_overhead = False
+    for a in sys.argv[1:]:
+        if a.startswith("--ops="):
+            ops = int(a.split("=", 1)[1])
+        elif a == "--skip-serve":
+            skip_serve = True
+        elif a == "--skip-overhead":
+            skip_overhead = True
+        else:
+            print(f"unknown argument {a!r}", file=sys.stderr)
+            return 2
+
+    seed = int(os.environ.get("CHECK_PROFILE_SEED", "20260803"))
+    rng = random.Random(seed)
+    tmp = tempfile.mkdtemp(prefix="tpu-profile-check-")
+    journal_dir = os.path.join(tmp, "journal")
+    failures: list[str] = []
+    result: dict = {"metric": "check_profile", "seed": seed, "ops": ops}
+    PROFILER.configure(sample=1.0)
+    PROFILER.reset()
+    try:
+        status = _soak(ops, rng, journal_dir)
+
+        # phase 1: convergence to the injected rates
+        profiles = PROFILER.profiles()
+        result["classes"] = sorted(profiles)
+        for (wclass, gen), rate in INJECTED.items():
+            got = profiles.get(wclass, {}).get(
+                "tokens_per_sec_per_chip", {}
+            ).get(gen)
+            if wclass == "serve" and gen == "v5e":
+                # mixed solo/co-located regimes: the EWMA must land
+                # BETWEEN the co-located and solo injected rates
+                lo, hi = rate * COLOCATED_FACTOR * 0.9, rate * 1.1
+            else:
+                lo, hi = rate * 0.85, rate * 1.15
+            if got is None:
+                failures.append(f"no profile for ({wclass}, {gen})")
+            elif not lo <= got <= hi:
+                failures.append(
+                    f"({wclass}, {gen}) did not converge: {got} tok/s/chip "
+                    f"vs injected {rate} (accepting [{lo:.0f}, {hi:.0f}])"
+                )
+
+        # phase 2: interference detection
+        matrix = PROFILER.interference_matrix()
+        result["interference"] = matrix
+        ratio = matrix.get("serve", {}).get("train")
+        if ratio is None:
+            failures.append("no (serve, train) interference pair observed")
+        elif not 0.3 <= ratio <= 0.75:
+            failures.append(
+                f"interference ratio {ratio} missed the injected "
+                f"{COLOCATED_FACTOR} slowdown (accepting [0.3, 0.75])"
+            )
+
+        # phase 3: journal round trip + profile-aware what-if
+        JOURNAL.flush()
+        JOURNAL.close()
+        events = read_journal(journal_dir)
+        result["records"] = len(events)
+        res = replay(events)
+        result["profile_records"] = res.profiles
+        if res.violations:
+            failures.append(f"replay violations: {res.violations[:5]}")
+        if res.warnings:
+            failures.append(
+                f"replay warnings (profile records must not warn): "
+                f"{res.warnings[:5]}"
+            )
+        if res.profiles < 1:
+            failures.append("no profile record reached the journal")
+
+        from elastic_gpu_scheduler_tpu.core.rater import ICILocality
+
+        base = what_if(events, ICILocality())
+        aware = what_if(events, ProfileAwareRater(ICILocality()))
+        result["what_if_base_score"] = base["mean_score"]
+        result["what_if_aware_score"] = aware["mean_score"]
+        result["what_if_profiles_seen"] = aware["profile_records"]
+        if aware["profile_records"] < 1:
+            failures.append("what_if fed no profile records to the rater")
+        if aware["binds"] != base["binds"]:
+            failures.append(
+                f"what-if bind counts diverged: {aware['binds']} vs "
+                f"{base['binds']}"
+            )
+        # a different policy legitimately diverges the chip state, so a
+        # few later binds may no longer fit where the recording put them
+        # (what_if falls back to the recorded placement) — but wholesale
+        # placement failure means the rater broke the search
+        if aware["placed"] < 0.9 * base["binds"]:
+            failures.append(
+                f"profile-aware what-if placed only {aware['placed']}/"
+                f"{aware['binds']} binds"
+            )
+        if aware["mean_score"] == base["mean_score"]:
+            failures.append(
+                "profile-aware rater produced the same mean score as its "
+                "geometry base — recorded profiles were not applied"
+            )
+    finally:
+        JOURNAL.close()
+        PROFILER.reset()
+        PROFILER.configure(sample=0.0)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # phase 4a: bind-path overhead (bench estimator, 3 attempts)
+    if not skip_overhead:
+        from bench import profile_bench
+
+        try:
+            budget = float(
+                os.environ.get("PROFILE_OVERHEAD_BUDGET_PCT", "5")
+            )
+        except ValueError:
+            budget = 5.0
+        attempts = []
+        ok = False
+        for _attempt in range(3):
+            overhead = profile_bench()
+            attempts.append(overhead["profile_overhead_pct"])
+            ok = (
+                overhead["profile_overhead_pct"] <= budget
+                or overhead["profile_overhead_trimmed_pct"] <= budget
+            )
+            if ok:
+                break
+        result.update(overhead)
+        result["overhead_budget_pct"] = budget
+        result["overhead_attempts_pct"] = attempts
+        if not ok:
+            failures.append(
+                f"profiled bind p99 over budget on every attempt "
+                f"({attempts}% vs {budget}%; trimmed "
+                f"{overhead['profile_overhead_trimmed_pct']}%)"
+            )
+
+    # phase 4b: decode-throughput overhead + zero-upload parity
+    if not skip_serve:
+        try:
+            serve_budget = float(
+                os.environ.get("PROFILE_SERVE_BUDGET_PCT", "10")
+            )
+        except ValueError:
+            serve_budget = 10.0
+        result["serve_budget_pct"] = serve_budget
+        _serve_overhead(serve_budget, failures, result)
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
