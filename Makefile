@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -63,6 +63,17 @@ check-defrag:
 # decode throughput with profiling on; zero extra device uploads).
 check-profile:
 	JAX_PLATFORMS=cpu python tools/check_profile.py
+
+# Elastic-serving-fleet gate: a 3-replica CPU soak (real engines behind
+# the real inference server, the real scheduler stack) — hard-fails
+# unless prefix-affinity routing beats the random baseline, an injected
+# queue-depth spike triggers a journaled EXECUTED scale-up that restores
+# the queue SLO, scale-down drains with zero dropped streams, a live
+# gang resize loses at most one in-flight chunk per moved pod with
+# token-identical greedy output, journal replay is clean (fleet records
+# + resize invariants), and the router's hop p99 is within budget.
+check-fleet:
+	JAX_PLATFORMS=cpu python tools/check_fleet.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

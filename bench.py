@@ -1675,10 +1675,218 @@ def _tpu_section_pagedattn():
     }
 
 
+def _make_cpu_replica(name, params, cfg, port=0, **engine_kw):
+    """One in-process serving replica for the fleet section / check-fleet
+    soak: a real engine behind the real inference HTTP server, returned
+    with its router-facing Replica.  Shared ``params`` keep greedy
+    outputs identical across replicas (prefix-affinity correctness is
+    then observable as routing, not luck)."""
+    from elastic_gpu_scheduler_tpu.fleet import Replica
+    from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine
+    from elastic_gpu_scheduler_tpu.server.inference import serve_inference
+
+    engine_kw.setdefault("max_batch", 4)
+    engine_kw.setdefault("max_len", 256)
+    engine_kw.setdefault("page_size", 16)
+    engine_kw.setdefault("fused_steps", 4)
+    engine_kw.setdefault("prefix_cache", True)
+    eng = InferenceEngine(params, cfg, **engine_kw)
+    eng.replica_name = name
+    server, loop = serve_inference(eng, port=port, host="127.0.0.1")
+    replica = Replica(name, "127.0.0.1", server.server_address[1])
+    return {
+        "name": name, "engine": eng, "server": server, "loop": loop,
+        "replica": replica,
+    }
+
+
+def _fleet_post(port, body, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _tpu_section_fleet():
+    """Elastic serving fleet: router overhead over a direct backend hit,
+    prefix-affinity hit rate on a sessioned workload, scale-up wall
+    (spawn + HTTP admission → routable), and in-flight chunks lost per
+    moved pod across a resize-style eviction (the ≤1 contract's
+    measured value).  CPU-capable (BENCH_ALLOW_CPU=1) like the
+    serveoverlap section; main() invokes it that way so the fleet keys
+    land in every artifact."""
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.fleet import FleetRouter, ReplicaSet
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+
+    cfg = _bench_cfg(allow_cpu)
+    V = cfg.vocab_size
+    params = init_params(jax.random.key(0), cfg)
+
+    class _NoRelay:
+        up = None
+        detail = ""
+
+    reps = [
+        _make_cpu_replica(f"bench-rep-{i}", params, cfg) for i in range(3)
+    ]
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=_NoRelay())
+    for r in reps:
+        rs.add(r["replica"])
+    rs.refresh()
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=16)
+    out = {}
+    try:
+        rport = router.start()
+
+        # -- router overhead: direct vs routed, small completions -------
+        def walls(port, n=30):
+            ws = []
+            for i in range(n):
+                body = {"prompt": [(7 * i) % V, 3, 9], "max_tokens": 2}
+                t0 = _time.perf_counter()
+                st, _ = _fleet_post(port, body)
+                assert st == 200, st
+                ws.append(_time.perf_counter() - t0)
+            return ws
+
+        # warm EVERY replica's jit caches directly (a cold replica's
+        # first compile would otherwise masquerade as router overhead),
+        # then the router path itself
+        for r in reps:
+            walls(r["server"].server_address[1], n=3)
+        walls(rport, n=5)
+        direct = walls(reps[0]["server"].server_address[1])
+        routed = walls(rport)
+        # headline = the router's own hop measure (selection + connect +
+        # request forward; backend generation excluded) at p99 — stable
+        # across box noise.  The end-to-end median delta rides along as a
+        # sanity check that the hop number isn't hiding pass-through cost.
+        out["fleet_router_overhead_ms"] = round(
+            p99(list(router.overhead_samples)) * 1000, 3
+        )
+        direct.sort()
+        routed.sort()
+        out["fleet_e2e_overhead_ms"] = round(
+            max(
+                0.0,
+                (routed[len(routed) // 2] - direct[len(direct) // 2]) * 1000,
+            ),
+            3,
+        )
+
+        # -- prefix affinity on a sessioned mix -------------------------
+        rng = jax.random.key(7)
+        sessions = [
+            _np_tokens(jax, rng, i, 32, V) for i in range(6)
+        ]
+        for turn in range(4):
+            for s, prefix in enumerate(sessions):
+                body = {
+                    "prompt": prefix + [int(t) % V for t in range(turn + 1)],
+                    "max_tokens": 2,
+                }
+                st, _ = _fleet_post(rport, body)
+                assert st == 200, st
+        dbg = router.debug_state()["affinity"]
+        out["fleet_affinity_hit_pct"] = dbg["hit_pct"]
+        out["fleet_affinity_random_pct"] = round(100.0 / 3, 2)
+
+        # -- scale-up wall: spawn + routable -----------------------------
+        t0 = _time.perf_counter()
+        extra = _make_cpu_replica("bench-rep-3", params, cfg)
+        reps.append(extra)
+        rs.add(extra["replica"])
+        rs.refresh_one(extra["replica"])
+        assert extra["replica"].state == "up"
+        out["fleet_scale_up_latency_ms"] = round(
+            (_time.perf_counter() - t0) * 1000, 3
+        )
+
+        # -- resize-style eviction: in-flight chunks lost per moved pod --
+        eng = InferenceEngine(
+            params, cfg, max_batch=4, max_len=256, page_size=16,
+            fused_steps=4, overlap=True,
+        )
+        reqs = [
+            Request(prompt=[(3 * i) % V, 9, 14], max_new_tokens=12)
+            for i in range(3)
+        ]
+        for r in reqs:
+            eng.submit(r)
+        eng._admit()
+        eng.step()
+        eng.step()
+        before = eng.chunks_discarded
+        moved = 0
+        for i, req in enumerate(eng.slots):
+            if req is not None and not req.done.is_set():
+                eng.evict_slot(i)
+                moved += 1
+        eng.run_until_idle(max_steps=100_000)
+        lost = eng.chunks_discarded - before
+        assert all(not r.error for r in reqs)
+        out["fleet_resize_lost_chunks"] = (
+            round(lost / moved, 3) if moved else 0.0
+        )
+        out["fleet_resize_moved_slots"] = moved
+    finally:
+        router.stop()
+        for r in reps:
+            r["server"].shutdown()
+            r["loop"].stop()
+    return out
+
+
+def _np_tokens(jax, rng, i, n, V):
+    import numpy as _np
+
+    return _np.asarray(
+        jax.random.randint(jax.random.fold_in(rng, i), (n,), 0, V)
+    ).tolist()
+
+
+def fleet_bench_cpu(timeout: int = 900) -> dict:
+    """Run the fleet section in a CPU subprocess (serveoverlap's
+    pattern) so the BENCH artifact always carries the fleet keys."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["BENCH_ALLOW_CPU"] = "1"
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, "--tpu-section=fleet"],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"fleet_bench_error": f"timed out after {timeout}s"}
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        return {"fleet_bench_error": str(e)[:300]}
+    if p.returncode != 0:
+        return {"fleet_bench_error": p.stderr.decode(errors="replace")[-300:]}
+    try:
+        return json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"fleet_bench_error": f"unparseable output: {e}"}
+
+
 _TPU_SECTIONS = {
     "model": _tpu_section_model,
     "serve": _tpu_section_serve,
     "serveoverlap": _tpu_section_serveoverlap,
+    "fleet": _tpu_section_fleet,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
     "pagedattn": _tpu_section_pagedattn,
@@ -1889,6 +2097,15 @@ def main():
         results.update(serve_overlap_bench_cpu())
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["serve_overlap_error"] = str(e)[:300]
+
+    # elastic serving fleet: router overhead / affinity hit rate /
+    # scale-up wall / resize chunk loss on a 3-replica CPU fleet
+    # (tools/check_fleet.py gates the behavior; these keys track the
+    # trend).  Guarded like the journal bench.
+    try:
+        results.update(fleet_bench_cpu())
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["fleet_bench_error"] = str(e)[:300]
 
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`

@@ -208,6 +208,61 @@ def main(argv=None) -> int:
         "limit: a stream of infeasible gangs must not thrash the "
         "cluster with migrations)",
     )
+    p.add_argument(
+        "--fleet", default="off", choices=["off", "router", "auto"],
+        help="elastic serving fleet: off (default), router (start the "
+        "prefix-affinity front door over --fleet-replicas on "
+        "--fleet-port), auto (router + the signal-driven autoscaler: "
+        "scale decisions journaled as `fleet` records and executed as "
+        "admissions/releases through this scheduler's own verbs)",
+    )
+    p.add_argument(
+        "--fleet-port", type=int, default=8100,
+        help="front-door router port (/v1/* fan-out, /debug/fleet, "
+        "/metrics)",
+    )
+    p.add_argument(
+        "--fleet-replicas", default="",
+        help="seed replica list: comma-separated name@host:port entries "
+        "(append !relay for replicas serving through the TPU probe "
+        "relay — their health follows tpu_relay_up instead of burning "
+        "HTTP timeouts when the relay drops)",
+    )
+    p.add_argument(
+        "--fleet-page-size", type=int, default=16,
+        help="prefix-affinity page size; must match the replicas' "
+        "engine --page-size for affinity hits to be real cache hits "
+        "(the router adopts a replica's advertised value when stats "
+        "disagree)",
+    )
+    p.add_argument("--fleet-min-replicas", type=int, default=1)
+    p.add_argument("--fleet-max-replicas", type=int, default=8)
+    p.add_argument(
+        "--fleet-queue-high", type=float, default=4.0,
+        help="scale up when mean queued requests per replica reaches "
+        "this (hysteresis + cooldown apply; see OPERATIONS.md)",
+    )
+    p.add_argument(
+        "--fleet-queue-low", type=float, default=0.25,
+        help="scale down only when queue/replica AND occupancy sit "
+        "below the low watermarks",
+    )
+    p.add_argument("--fleet-cooldown-up", type=float, default=10.0)
+    p.add_argument("--fleet-cooldown-down", type=float, default=60.0)
+    p.add_argument(
+        "--fleet-interval", type=float, default=5.0,
+        help="autoscaler evaluation period (every evaluation is "
+        "journaled as a `fleet` record when the journal is on)",
+    )
+    p.add_argument(
+        "--fleet-health-interval", type=float, default=2.0,
+        help="router health/stats poll period per replica",
+    )
+    p.add_argument(
+        "--fleet-wclass", default="serve",
+        help="workload class the autoscaler reads generation "
+        "throughput preferences for (profile observatory)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -331,6 +386,68 @@ def main(argv=None) -> int:
         defrag.leader_check = elector.is_leader
     defrag.start()  # auto-mode background tick (no-op in off/observe)
 
+    # elastic serving fleet (fleet/): router in router|auto, autoscaler
+    # in auto.  The autoscaler is ADVISORY unless an executor is wired
+    # (replica processes are deployment-controller territory; the
+    # check-fleet tool demonstrates full execution with in-process
+    # engines) — decisions are still journaled as `fleet` records and
+    # served at /debug/fleet.
+    fleet_state = None
+    if args.fleet != "off":
+        from .fleet import (
+            Autoscaler,
+            FleetRouter,
+            FleetState,
+            Replica,
+            ReplicaSet,
+            ScalingPolicy,
+        )
+
+        replica_set = ReplicaSet(interval_s=args.fleet_health_interval)
+        for i, entry in enumerate(
+            e.strip() for e in args.fleet_replicas.split(",") if e.strip()
+        ):
+            relay = entry.endswith("!relay")
+            if relay:
+                entry = entry[: -len("!relay")]
+            name, _, addr = entry.rpartition("@")
+            host_part, _, port_part = addr.rpartition(":")
+            try:
+                replica_set.add(
+                    Replica(
+                        name or f"replica-{i}", host_part or "127.0.0.1",
+                        int(port_part), relay=relay,
+                    )
+                )
+            except ValueError:
+                print(
+                    f"error: --fleet-replicas entry {entry!r} is not "
+                    "name@host:port", file=sys.stderr,
+                )
+                return 2
+        router = FleetRouter(
+            replica_set, host=args.host, port=args.fleet_port,
+            page_size=args.fleet_page_size,
+        )
+        autoscaler = None
+        if args.fleet == "auto":
+            autoscaler = Autoscaler(
+                replica_set, executor=None,
+                policy=ScalingPolicy(
+                    min_replicas=args.fleet_min_replicas,
+                    max_replicas=args.fleet_max_replicas,
+                    queue_high=args.fleet_queue_high,
+                    queue_low=args.fleet_queue_low,
+                    up_cooldown_s=args.fleet_cooldown_up,
+                    down_cooldown_s=args.fleet_cooldown_down,
+                ),
+                interval_s=args.fleet_interval,
+                wclass=args.fleet_wclass,
+            )
+        fleet_state = FleetState(router=router, autoscaler=autoscaler)
+        # both ports answer /debug/fleet with the SAME combined payload
+        router.state_provider = fleet_state.debug_state
+
     from .server.handlers import Preemption
 
     server = ExtenderServer(
@@ -341,6 +458,7 @@ def main(argv=None) -> int:
         workers=max(0, args.http_workers),
         leader_check=elector.is_leader if elector is not None else None,
         defrag=defrag,
+        fleet=fleet_state,
     )
 
     stop = threading.Event()
@@ -359,10 +477,17 @@ def main(argv=None) -> int:
 
     port = server.start()
     print(f"tpu-elastic-scheduler serving on {args.host}:{port}")
+    if fleet_state is not None:
+        fleet_port = fleet_state.router.start()
+        if fleet_state.autoscaler is not None:
+            fleet_state.autoscaler.start()
+        print(f"fleet router serving on {args.host}:{fleet_port}")
     try:
         while not stop.wait(0.5):
             pass
     finally:
+        if fleet_state is not None:
+            fleet_state.stop()
         defrag.stop()
         if relay_monitor is not None:
             relay_monitor.stop()

@@ -12,6 +12,13 @@ The planner calls ``drain(pod, node)`` before each move and
 migrated serving pod loses at most one in-flight chunk and re-admits
 exactly where it stopped.
 
+The SAME contract brackets gang RESIZES (fleet/resize.py): a membership
+change reshards the SPMD gang, so every member is drained at a chunk
+boundary before the membership transaction and resumed after — the
+per-moved-pod bound extends member-wise to resharding (each paused
+member loses at most its one in-flight chunk; greedy streams continue
+token-identically, which tests/test_serve_overlap.py pins).
+
 This module is deliberately jax-free (duck-typed against the
 ``EngineLoop`` surface) so the scheduler plane — and its smoke-tier
 tests — never import the model stack.
@@ -99,3 +106,28 @@ class ServingEngineHook(MigrationHook):
         loop.engine.draining = False
         loop.drained.clear()
         loop.engine._work.set()  # wake the loop to resume admissions
+
+
+class RouterDrainHook(MigrationHook):
+    """Fleet-router bracketing for a move/resize: flip the pod's replica
+    to draining in the router's ReplicaSet before the move (new sessions
+    route elsewhere the moment the engine pauses) and restore it after.
+    ``pod_to_replica`` maps pod keys to replica names (identity mapping
+    when omitted — replicas named after their pods).  Duck-typed against
+    ``fleet.router.ReplicaSet``; jax-free like the rest of this
+    module."""
+
+    def __init__(self, replicas, pod_to_replica=None):
+        self.replicas = replicas
+        self.pod_to_replica = pod_to_replica or (lambda pod_key: pod_key)
+
+    def drain(self, pod_key: str, node: str) -> bool:
+        name = self.pod_to_replica(pod_key)
+        if name:
+            self.replicas.drain(name, reason=f"migration/resize on {node}")
+        return True
+
+    def resume(self, pod_key: str, node: str) -> None:
+        name = self.pod_to_replica(pod_key)
+        if name:
+            self.replicas.undrain(name, reason="migration/resize complete")

@@ -1,0 +1,84 @@
+"""Elastic serving fleet: front-door router, autoscaler, live gang resize.
+
+The serving plane (serve.py / server/inference.py) is one engine per
+pod; this package turns N of those pods into ONE elastic service —
+ROADMAP item 3, built on three earlier subsystems:
+
+- **Router** (:mod:`fleet.router`): an HTTP front door that spreads
+  ``/v1/*`` streams across replicas with prefix-cache-aware affinity —
+  the rolling BLAKE2b digest chain PR 4 gave the engine's prefix cache
+  (shared definition: utils/prefixdigest) routes a session to the
+  replica already holding its longest prefix; least-loaded fallback
+  from ``/v1/stats`` signals, health-checked replica set with a
+  draining state, per-replica circuit breakers, relay-aware health
+  (utils/tpuprobe), SSE byte-pump pass-through that preserves the
+  engine's burst coalescing, and a ``fleet.route`` span joining the
+  W3C traceparent chain.
+
+- **Autoscaler** (:mod:`fleet.autoscaler`): folds per-replica engine
+  signals (queue depth, slot occupancy, KV-page footprint, host gap)
+  plus the profile observatory's per-class throughput into scale
+  decisions with hysteresis, cooldowns and min/max bounds; executes
+  them as admissions/releases through the scheduler's HTTP verbs
+  (placement prefers the TPU generation with the highest measured
+  throughput-per-chip for the fleet's class), and journals EVERY
+  evaluation as a ``fleet`` record so ``score_policy`` can replay a
+  candidate policy against recorded traffic before promotion.
+
+- **Resize** (:mod:`fleet.resize`): grow/shrink a running SPMD serving
+  gang without a cold restart — journaled all-or-nothing membership
+  transactions bracketed by the defrag drain/elastic-resume hooks
+  (≤1 lost in-flight chunk per paused member), with a ``resize``
+  journal record whose replay invariant checks chip conservation and
+  exact membership.
+
+CLI: ``--fleet=off|router|auto`` on the scheduler entry point (cli.py);
+CI gate: ``make check-fleet``; runbook: OPERATIONS.md "Elastic serving
+fleet".
+"""
+
+from typing import Optional
+
+from .autoscaler import (  # noqa: F401
+    Autoscaler,
+    PolicyEngine,
+    ScalingPolicy,
+    SchedulerGangExecutor,
+    fold_signals,
+    generation_preference,
+    score_policy,
+)
+from .resize import GangResizer, member_chips  # noqa: F401
+from .router import FleetRouter, Replica, ReplicaSet  # noqa: F401
+
+
+class FleetState:
+    """The pieces one ``--fleet`` deployment wires together, as a single
+    stoppable handle with one combined ``/debug/fleet`` payload (served
+    by both the scheduler server and the router's own port)."""
+
+    def __init__(
+        self,
+        router: Optional[FleetRouter] = None,
+        autoscaler: Optional[Autoscaler] = None,
+        resizer: Optional[GangResizer] = None,
+    ):
+        self.router = router
+        self.autoscaler = autoscaler
+        self.resizer = resizer
+
+    def debug_state(self) -> dict:
+        out: dict = {}
+        if self.router is not None:
+            out["router"] = self.router.debug_state()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.debug_state()
+        if self.resizer is not None:
+            out["resize"] = self.resizer.debug_state()
+        return out
+
+    def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        if self.router is not None:
+            self.router.stop()

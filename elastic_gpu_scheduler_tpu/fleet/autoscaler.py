@@ -1,0 +1,624 @@
+"""Signal-driven autoscaler: engine signals in, gang admissions/releases out.
+
+The control loop folds per-replica engine signals — queue depth, slot
+occupancy, KV-page footprint, host-gap — from the router's health polls
+(``/v1/stats``) with the profile observatory's per-class throughput, and
+turns them into scale decisions executed through the scheduler's own
+admission surface.  Three layers, deliberately separable:
+
+- **ScalingPolicy** — the knobs (watermarks, hysteresis depth, cooldowns,
+  min/max bounds).  Plain data.
+- **PolicyEngine** — the decision state machine: ``evaluate(signals, n,
+  now)`` → up | down | hold.  PURE given its inputs and its own state
+  (an explicit ``now`` instead of wall-clock reads), which is what makes
+  offline scoring honest: ``score_policy`` replays the journal's
+  recorded ``fleet`` records through a fresh PolicyEngine and reports
+  what the candidate WOULD have done against what the incumbent did —
+  the same replay-gated promotion story the what-if rater path uses.
+- **Autoscaler** — the loop: poll, evaluate, journal EVERY evaluation as
+  a ``fleet`` record (annotations in the flight recorder's stream, like
+  ``profile`` records — dense-seq audited, never allocator mutations),
+  and drive the executor on up/down.
+
+Executors are duck-typed (``scale_up(reason, generation_pref)`` →
+replica name or None; ``scale_down(name, reason)`` → bool).
+:class:`SchedulerGangExecutor` is the production shape: a new replica is
+a pod admitted through the extender's HTTP filter → bind verbs (so the
+scale-up IS a journaled gang admission, visible to replay and every
+scheduling invariant), placed onto the feasible node whose TPU
+generation ranks highest in the profile observatory's measured
+throughput-per-chip for the fleet's workload class (the
+heterogeneity-aware Gavel policy); a release drains the replica at the
+router first, then deletes the pod so the reconciliation path journals
+the forget.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+from ..journal import JOURNAL
+from ..metrics import FLEET_EVENTS, FLEET_SCALE_LATENCY
+from ..profile import PROFILER, generation_preference
+from ..tracing import TRACER
+
+__all__ = [
+    "Autoscaler",
+    "PolicyEngine",
+    "ScalingPolicy",
+    "SchedulerGangExecutor",
+    "fold_signals",
+    "generation_preference",  # canonical definition lives in profile/
+    "score_policy",
+]
+
+log = logging.getLogger("tpu-scheduler")
+
+
+@dataclass
+class ScalingPolicy:
+    """Watermarks + pacing for the decision state machine.  ``name``
+    labels journal records so offline scoring can tell policies apart."""
+
+    name: str = "default"
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # scale up when ANY of these breach...
+    queue_high: float = 4.0  # mean queued requests per replica
+    occupancy_high: float = 0.85  # active slots / total slots
+    page_high: float = 0.9  # KV pages in use / total
+    # ...scale down only when ALL of these clear
+    queue_low: float = 0.25
+    occupancy_low: float = 0.25
+    # consecutive breaching evaluations required before acting (one noisy
+    # poll must not flap the fleet)
+    hysteresis_rounds: int = 2
+    up_cooldown_s: float = 10.0
+    down_cooldown_s: float = 60.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def fold_signals(per_replica: list[dict]) -> dict:
+    """Aggregate per-replica ``/v1/stats`` payloads into the scalar
+    signals the policy thresholds read.  Missing fields fold as zero —
+    a replica that never answered stats must not block scaling math."""
+    n = max(1, len(per_replica))
+    queued = sum(int(s.get("queued", 0)) for s in per_replica)
+    active = sum(int(s.get("active_slots", 0)) for s in per_replica)
+    batch = sum(int(s.get("max_batch", 0)) for s in per_replica)
+    pages_total = sum(int(s.get("total_pages", 0)) for s in per_replica)
+    pages_free = sum(int(s.get("free_pages", 0)) for s in per_replica)
+    # /v1/stats' host_gap payload carries mean_ms/last_ms (the p50/p99
+    # live only in the /metrics histogram, drained at scrape time)
+    gaps = [
+        float(s["host_gap"]["mean_ms"])
+        for s in per_replica
+        if isinstance(s.get("host_gap"), dict)
+        and "mean_ms" in s["host_gap"]
+    ]
+    return {
+        "replicas": len(per_replica),
+        "queued": queued,
+        "queue_per_replica": round(queued / n, 3),
+        "occupancy": round(active / batch, 4) if batch else 0.0,
+        "page_util": (
+            round(1.0 - pages_free / pages_total, 4) if pages_total else 0.0
+        ),
+        "host_gap_ms": round(sum(gaps) / len(gaps), 3) if gaps else 0.0,
+    }
+
+
+class PolicyEngine:
+    """The hysteresis/cooldown/bounds state machine over a policy.  One
+    instance per (policy, stream): the live Autoscaler owns one, and
+    ``score_policy`` builds a fresh one per offline run."""
+
+    def __init__(self, policy: ScalingPolicy):
+        self.policy = policy
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_up = float("-inf")
+        self.last_down = float("-inf")
+        # why the last evaluation held: "bounds" | "cooldown" | None.
+        # The LIVE Autoscaler turns this into metrics; the engine itself
+        # is side-effect-free so offline score_policy replays cannot
+        # pollute the real process's counters.
+        self.suppressed = None
+
+    def evaluate(
+        self, signals: dict, n_replicas: int, now: float,
+        total_replicas: Optional[int] = None,
+    ):
+        """(action, reason) with action ∈ up | down | hold.
+        ``n_replicas`` counts ROUTABLE ('up') replicas; ``total_replicas``
+        counts every registered one (incl. draining/down) — the floor
+        restore below caps on the TOTAL, or a fleet whose replicas are
+        all draining (relay outage) would admit a new pod every tick
+        until the cluster is full."""
+        p = self.policy
+        self.suppressed = None
+        total = n_replicas if total_replicas is None else total_replicas
+        if n_replicas < p.min_replicas:
+            # the floor is not a watermark decision — but it still
+            # respects the up-cooldown (one restore per cooldown window,
+            # not one per tick while a replica boots) and the total cap
+            self.up_streak = self.down_streak = 0
+            if total >= p.max_replicas:
+                self.suppressed = "bounds"
+                return "hold", (
+                    f"below min_replicas but {total} total replicas at "
+                    f"max_replicas ({p.max_replicas})"
+                )
+            if now - self.last_up < p.up_cooldown_s:
+                self.suppressed = "cooldown"
+                return "hold", "below min_replicas (up cooldown)"
+            self.last_up = now
+            return "up", f"below min_replicas ({n_replicas}<{p.min_replicas})"
+        breach_up = (
+            signals.get("queue_per_replica", 0.0) >= p.queue_high
+            or signals.get("occupancy", 0.0) >= p.occupancy_high
+            or signals.get("page_util", 0.0) >= p.page_high
+        )
+        breach_down = (
+            signals.get("queue_per_replica", 0.0) <= p.queue_low
+            and signals.get("occupancy", 0.0) <= p.occupancy_low
+        )
+        self.up_streak = self.up_streak + 1 if breach_up else 0
+        self.down_streak = self.down_streak + 1 if breach_down else 0
+        if breach_up:
+            if self.up_streak < p.hysteresis_rounds:
+                return "hold", f"up hysteresis {self.up_streak}/{p.hysteresis_rounds}"
+            if total >= p.max_replicas:
+                # cap on TOTAL registered replicas, same as the floor
+                # branch: counting only routable ones would let the
+                # fleet grow past the bound whenever one is draining
+                self.suppressed = "bounds"
+                return "hold", f"at max_replicas ({p.max_replicas})"
+            if now - self.last_up < p.up_cooldown_s:
+                self.suppressed = "cooldown"
+                return "hold", "up cooldown"
+            self.up_streak = 0
+            self.last_up = now
+            return "up", self._breach_reason(signals)
+        if breach_down:
+            if self.down_streak < p.hysteresis_rounds:
+                return "hold", f"down hysteresis {self.down_streak}/{p.hysteresis_rounds}"
+            if n_replicas <= p.min_replicas:
+                self.suppressed = "bounds"
+                return "hold", f"at min_replicas ({p.min_replicas})"
+            if now - self.last_down < p.down_cooldown_s:
+                self.suppressed = "cooldown"
+                return "hold", "down cooldown"
+            self.down_streak = 0
+            self.last_down = now
+            return "down", "idle (queue and occupancy below low watermarks)"
+        return "hold", "within watermarks"
+
+    def _breach_reason(self, signals: dict) -> str:
+        p = self.policy
+        parts = []
+        if signals.get("queue_per_replica", 0.0) >= p.queue_high:
+            parts.append(
+                f"queue/replica {signals['queue_per_replica']}"
+                f">={p.queue_high}"
+            )
+        if signals.get("occupancy", 0.0) >= p.occupancy_high:
+            parts.append(f"occupancy {signals['occupancy']}>={p.occupancy_high}")
+        if signals.get("page_util", 0.0) >= p.page_high:
+            parts.append(f"page_util {signals['page_util']}>={p.page_high}")
+        return "; ".join(parts) or "breach"
+
+
+class Autoscaler:
+    """The control loop.  ``replicas`` is the router's ReplicaSet (the
+    signal source AND the unit of draining); ``executor`` owns the
+    mechanics of adding/removing a replica."""
+
+    def __init__(
+        self,
+        replicas,
+        executor,
+        policy: Optional[ScalingPolicy] = None,
+        interval_s: float = 5.0,
+        wclass: str = "serve",
+        profiler=None,
+    ):
+        self.replicas = replicas
+        self.executor = executor
+        self.policy = policy or ScalingPolicy()
+        self.engine = PolicyEngine(self.policy)
+        self.interval_s = max(0.05, float(interval_s))
+        self.wclass = wclass
+        self.profiler = profiler if profiler is not None else PROFILER
+        self.evaluations = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.last_decision: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one evaluation ------------------------------------------------------
+
+    def signals(self) -> dict:
+        # 'up' replicas only: a draining replica's stats FREEZE at its
+        # last poll (the health loop stops refreshing it), so folding
+        # them would scale on dead data — and its queued work reroutes
+        # to the up set as it drains anyway
+        reps = [r for r in self.replicas.all() if r.state == "up"]
+        return fold_signals([r.stats for r in reps])
+
+    def _victim(self) -> Optional[str]:
+        """Scale-down victim: the least-loaded routable replica (its
+        in-flight streams finish during the drain; new sessions go
+        elsewhere the moment it flips to draining)."""
+        candidates = self.replicas.routable()
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.load_key()).name
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """Evaluate once; journal the evaluation; execute a decision.
+        Returns the decision record (also kept as ``last_decision``)."""
+        now = time.monotonic() if now is None else now
+        self.evaluations += 1
+        sig = self.signals()
+        all_reps = self.replicas.all()
+        n = len([r for r in all_reps if r.state == "up"])
+        total = len(all_reps)
+        action, reason = self.engine.evaluate(
+            sig, n, now, total_replicas=total
+        )
+        if self.engine.suppressed == "bounds":
+            FLEET_EVENTS.inc("bounds_suppressed")
+        elif self.engine.suppressed == "cooldown":
+            FLEET_EVENTS.inc("cooldown_suppressed")
+        gen_pref = (
+            self.profiler.generation_preference(self.wclass)
+            if self.profiler.enabled
+            else []
+        )
+        rec = {
+            "action": action,
+            "reason": reason,
+            "signals": sig,
+            "replicas": n,
+            "replicas_total": total,
+            "policy": self.policy.name,
+            "wclass": self.wclass,
+            "generation_pref": gen_pref or None,
+            "executed": False,
+            "target": None,
+        }
+        if self.executor is None and action in ("up", "down"):
+            # advisory mode (no executor wired — e.g. a real cluster
+            # where replica processes are an operator's deployment
+            # controller's job): the decision is journaled and surfaced,
+            # never executed
+            rec["reason"] = f"{reason} (advisory: no executor)"
+            FLEET_EVENTS.inc("advisory")
+        elif action == "up":
+            t0 = time.perf_counter()
+            with TRACER.span(
+                "fleet.scale_up", reason=reason, replicas=n,
+            ) as sp:
+                try:
+                    name = self.executor.scale_up(reason, gen_pref)
+                except Exception:
+                    log.exception("fleet scale-up failed")
+                    name = None
+                if name:
+                    rec["executed"] = True
+                    rec["target"] = name
+                    self.scale_ups += 1
+                    FLEET_EVENTS.inc("scale_up")
+                    FLEET_SCALE_LATENCY.observe(
+                        value=time.perf_counter() - t0
+                    )
+                    sp.set_attr("replica", name)
+                else:
+                    FLEET_EVENTS.inc("scale_up_failed")
+                    sp.end(status="error")
+        elif action == "down":
+            victim = self._victim()
+            rec["target"] = victim
+            if victim is None:
+                FLEET_EVENTS.inc("scale_down_failed")
+            else:
+                t0 = time.perf_counter()
+                with TRACER.span(
+                    "fleet.scale_down", reason=reason, replica=victim,
+                ) as sp:
+                    self.replicas.drain(victim, reason="scale-down")
+                    try:
+                        ok = self.executor.scale_down(victim, reason)
+                    except Exception:
+                        log.exception("fleet scale-down failed")
+                        ok = False
+                    if ok:
+                        rec["executed"] = True
+                        self.scale_downs += 1
+                        FLEET_EVENTS.inc("scale_down")
+                        FLEET_SCALE_LATENCY.observe(
+                            value=time.perf_counter() - t0
+                        )
+                    else:
+                        # failed release: the replica must come back
+                        # (a pinned drain forever leaks capacity)
+                        self.replicas.undrain(
+                            victim, reason="scale-down failed; restored"
+                        )
+                        FLEET_EVENTS.inc("scale_down_failed")
+                        sp.end(status="error")
+        else:
+            FLEET_EVENTS.inc("hold")
+        if JOURNAL.enabled:
+            JOURNAL.record("fleet", **rec)
+        self.last_decision = rec
+        return rec
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    log.exception("fleet autoscaler tick failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    def debug_state(self) -> dict:
+        return {
+            "policy": self.policy.to_dict(),
+            "wclass": self.wclass,
+            "evaluations": self.evaluations,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "last_decision": self.last_decision,
+        }
+
+
+def score_policy(events: list[dict], policy: ScalingPolicy) -> dict:
+    """Offline policy scoring over a recorded journal: feed every
+    recorded ``fleet`` evaluation's signals through a FRESH PolicyEngine
+    for ``policy`` and compare its decisions with the incumbent's.  The
+    candidate sees the same signal stream at the same (recorded)
+    timestamps — cooldowns and hysteresis replay faithfully — so an
+    operator can score a watermark change against yesterday's traffic
+    before promoting it (the same journal-first promotion bar the
+    what-if rater path set)."""
+    engine = PolicyEngine(policy)
+    t0: Optional[float] = None
+    evaluations = agreements = 0
+    would = {"up": 0, "down": 0, "hold": 0}
+    recorded = {"up": 0, "down": 0, "hold": 0}
+    disagreements: list[dict] = []
+    for rec in events:
+        if rec.get("type") != "fleet":
+            continue
+        evaluations += 1
+        t = float(rec.get("t", 0.0))
+        if t0 is None:
+            t0 = t
+        n_up = int(rec.get("replicas", 0))
+        action, reason = engine.evaluate(
+            rec.get("signals") or {}, n_up, t - t0,
+            total_replicas=int(rec.get("replicas_total", n_up)),
+        )
+        rec_action = rec.get("action", "hold")
+        would[action] = would.get(action, 0) + 1
+        recorded[rec_action] = recorded.get(rec_action, 0) + 1
+        if action == rec_action:
+            agreements += 1
+        elif len(disagreements) < 16:
+            disagreements.append({
+                "seq": rec.get("seq"),
+                "recorded": rec_action,
+                "candidate": action,
+                "candidate_reason": reason,
+                "signals": rec.get("signals"),
+            })
+    return {
+        "policy": policy.name,
+        "evaluations": evaluations,
+        "agreements": agreements,
+        "agreement_pct": round(100.0 * agreements / evaluations, 2)
+        if evaluations else 0.0,
+        "candidate_decisions": would,
+        "recorded_decisions": recorded,
+        "disagreements": disagreements,
+    }
+
+
+class SchedulerGangExecutor:
+    """Scale through the scheduler's HTTP surface (see the module
+    docstring).  Pluggable mechanics:
+
+    - ``pod_factory(serial) -> Pod``: the replica pod template (workload
+      class annotated, TPU demand sized for one replica);
+    - ``spawner(pod, node) -> Replica``: actually start the serving
+      process and return its router-facing Replica (in-process engines
+      in tests/tools; a StatefulSet/operator in a real cluster);
+    - ``releaser(replica_name, pod) -> None``: stop the serving process.
+
+    The admission round-trips go over HTTP (``/scheduler/filter`` →
+    ``/scheduler/bind``) so a scale-up exercises exactly the verbs — and
+    lands exactly the journal records — a kube-scheduler-admitted pod
+    would."""
+
+    def __init__(
+        self,
+        cluster,
+        scheduler_addr: tuple,
+        replicas,
+        pod_factory,
+        spawner,
+        releaser=None,
+        drain_timeout_s: float = 30.0,
+        http_timeout_s: float = 10.0,
+    ):
+        # ``cluster``: pod/node store with create_pod/delete_pod/list_nodes
+        # (FakeCluster in tests/tools; the REST cluster view in-cluster)
+        self.cluster = cluster
+        self.scheduler_addr = scheduler_addr
+        self.replicas = replicas
+        self.pod_factory = pod_factory
+        self.spawner = spawner
+        self.releaser = releaser
+        self.drain_timeout_s = drain_timeout_s
+        self.http_timeout_s = http_timeout_s
+        self.serial = 0
+        self.pods: dict[str, object] = {}  # replica name → Pod
+
+    def _post(self, path: str, body: dict) -> dict:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            *self.scheduler_addr, timeout=self.http_timeout_s
+        )
+        try:
+            conn.request(
+                "POST", path, json.dumps(body),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path} -> {resp.status}: {data[:200]}")
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    def _node_generations(self) -> dict[str, str]:
+        from ..utils import consts
+
+        out = {}
+        try:
+            for node in self.cluster.list_nodes():
+                out[node.metadata.name] = (
+                    node.metadata.labels or {}
+                ).get(consts.LABEL_TPU_ACCELERATOR, "")
+        except Exception:
+            pass
+        return out
+
+    def scale_up(self, reason: str, generation_pref: list) -> Optional[str]:
+        self.serial += 1
+        pod = self.pod_factory(self.serial)
+        self.cluster.create_pod(pod)
+        gens = self._node_generations()
+        node_names = sorted(gens)
+        filt = self._post(
+            "/scheduler/filter",
+            {"Pod": pod.to_dict(), "NodeNames": node_names},
+        )
+        feasible = filt.get("NodeNames") or []
+        if filt.get("Error") or not feasible:
+            log.warning(
+                "fleet scale-up: no feasible node (%s)",
+                filt.get("Error") or "all filtered",
+            )
+            try:
+                self.cluster.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except Exception:
+                pass
+            return None
+        # heterogeneity-aware target: among feasible nodes, prefer the
+        # generation with the highest measured tokens/s/chip for this
+        # class; scheduler feasibility order breaks ties
+        rank = {g: i for i, g in enumerate(generation_pref)}
+        target = min(
+            feasible,
+            key=lambda n: (rank.get(gens.get(n, ""), len(rank)),
+                           feasible.index(n)),
+        )
+        bind = self._post(
+            "/scheduler/bind",
+            {
+                "PodName": pod.metadata.name,
+                "PodNamespace": pod.metadata.namespace,
+                "PodUID": pod.metadata.uid,
+                "Node": target,
+            },
+        )
+        if bind.get("Error"):
+            log.warning("fleet scale-up bind failed: %s", bind["Error"])
+            try:
+                self.cluster.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except Exception:
+                pass
+            return None
+        try:
+            replica = self.spawner(pod, target)
+        except Exception:
+            # the pod is BOUND (chips charged, bind journaled) but no
+            # serving process exists: delete it so reconciliation frees
+            # the chips — otherwise every failed spawn leaks a bound
+            # ghost replica and the still-breaching signals bind another
+            # one next tick
+            log.exception("fleet spawner failed; releasing bound pod")
+            try:
+                self.cluster.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except Exception:
+                log.exception("fleet spawner-rollback pod delete failed")
+            return None
+        self.replicas.add(replica)
+        self.pods[replica.name] = pod
+        return replica.name
+
+    def scale_down(self, name: str, reason: str) -> bool:
+        r = self.replicas.get(name)
+        if r is None:
+            return False
+        # wait for the router's in-flight streams to the replica to end
+        # (it is already draining — no new sessions arrive)
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline and r.inflight > 0:
+            time.sleep(0.02)
+        if r.inflight > 0:
+            return False  # still streaming: refuse, autoscaler restores
+        pod = self.pods.pop(name, None)
+        if self.releaser is not None:
+            try:
+                self.releaser(name, pod)
+            except Exception:
+                log.exception("fleet releaser failed for %s", name)
+        if pod is not None:
+            try:
+                # the delete flows through watch/reconcile → forget_pod →
+                # a journaled release, the same path any dead pod takes
+                self.cluster.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+            except Exception:
+                log.exception("fleet scale-down pod delete failed")
+        self.replicas.remove(name)
+        return True

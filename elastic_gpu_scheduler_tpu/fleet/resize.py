@@ -1,0 +1,344 @@
+"""Live gang resize: grow/shrink a running SPMD serving gang without a
+cold restart.
+
+The defrag subsystem already proved the primitive: ``migrate_pod``'s
+journaled evict→rebind transaction moves a live pod with at most one
+in-flight chunk lost (the ``defrag/hooks.py`` drain/elastic-resume
+contract).  Resize extends that transaction shape to MEMBERSHIP change:
+
+- **grow(gang, new_pods)** — admit new members into a live gang: filter
+  → per-member allocation through the gang split-phase primitives
+  (``gang_allocate``: validating commit + journaled ``bind``
+  ``source="resize"``) → annotation-ledger write, all bracketed by the
+  drain/elastic-resume hooks over the EXISTING members (an SPMD gang
+  reshards when membership changes; every member pauses at a chunk
+  boundary, so the whole resize costs each member at most one in-flight
+  chunk — the migration contract, extended to resharding).
+- **shrink(gang, victims)** — release members: journaled ``forget``
+  (``source="resize"``) + annotation strip, same hook bracketing.
+
+Both are ALL-OR-NOTHING: any failure reverses the executed members with
+compensating journaled operations (the defrag round's reverse-order
+rollback discipline), so the gang is never left part-resized.  Targets
+are NOT cordoned (a cordon would reject the next member of a multi-pod
+grow sharing the node; the validating per-member commit already turns a
+placement race into a clean rollback).  When
+a grow target does not fit anywhere, one defrag unblocking round is
+tried first (``planner.run_round(want=...)``) — membership change and
+migration compose through the same journal.
+
+Every completed resize emits ONE ``resize`` journal record summarizing
+the gang's new membership; replay verifies two invariants against the
+rebuilt state (journal/replay.py):
+
+- **chip conservation** — every member charges exactly the recorded
+  per-member demand (chips can be added or released only WITH a member,
+  never created or destroyed in flight), and
+- **gang all-or-nothing** — the recorded membership matches the live
+  member set exactly: no surviving evictee, no half-admitted joiner.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..core.request import pod_gang_key
+from ..journal import JOURNAL
+from ..metrics import FLEET_EVENTS, TimedLock
+from ..tracing import TRACER
+
+log = logging.getLogger("tpu-scheduler")
+
+
+def member_chips(opt) -> int:
+    """Whole-chip count a member's option charges (fractional allocs
+    count their chip footprint — one shared chip is one chip)."""
+    return sum(len(a.coords) for a in opt.allocs if a.needs_tpu)
+
+
+class GangResizer:
+    """Membership-change transactions over one scheduler engine.
+
+    ``hooks``: ``defrag.hooks.MigrationHook`` list — ``drain(pod, node)``
+    before the membership change, ``resume(pod, node)`` after (success
+    AND rollback), applied to every member whose engine must pause for
+    the reshard.  ``defrag``: optional DefragPlanner consulted when a
+    grow target fits nowhere.  Rank 14 lock: below defrag (15) — the two
+    never nest, but both sit under the engine registry lock (20) they
+    acquire inside."""
+
+    def __init__(
+        self,
+        sched,
+        clientset,
+        hooks: Optional[list] = None,
+        defrag=None,
+    ):
+        self.sched = sched
+        self.clientset = clientset
+        self.hooks = list(hooks or [])
+        self.defrag = defrag
+        self._lock = TimedLock("resize", rank=14)
+        self.resizes = 0
+        self.last_result: Optional[dict] = None
+
+    # -- membership view -----------------------------------------------------
+
+    def members(self, gang: str) -> dict:
+        """pod key → (node, Option) for the gang's LIVE members (the
+        scheduler ledger filtered through the pods' gang annotation)."""
+        with self.sched.lock:
+            ledger = dict(self.sched.pod_maps)
+        out = {}
+        for key, (node, opt) in ledger.items():
+            ns, _, name = key.partition("/")
+            try:
+                pod = self.clientset.get_pod(ns, name)
+            except Exception:
+                continue
+            if pod_gang_key(pod) == gang and not pod.is_completed():
+                out[key] = (node, opt, pod)
+        return out
+
+    # -- hook bracketing -----------------------------------------------------
+
+    def _drain_all(self, members: dict) -> None:
+        for key, (node, _opt, _pod) in sorted(members.items()):
+            for h in self.hooks:
+                try:
+                    h.drain(key, node)
+                except Exception:
+                    log.exception("resize drain hook failed for %s", key)
+
+    def _resume_all(self, members: dict) -> None:
+        for key, (node, _opt, _pod) in sorted(members.items()):
+            for h in self.hooks:
+                try:
+                    h.resume(key, node)
+                except Exception:
+                    log.exception("resize resume hook failed for %s", key)
+
+    def _journal_resize(
+        self, gang: str, members: dict, added, removed, chips_each: int,
+        source: str, trace_id=None,
+    ):
+        """One ``resize`` record at the transaction's commit point —
+        emitted under the ENGINE lock so it orders after every member
+        bind/forget the transaction journaled and before any racing
+        mutation (the same ordering rule every allocator record obeys)."""
+        if not JOURNAL.enabled:
+            return None
+        with self.sched.lock:
+            return JOURNAL.record(
+                "resize",
+                gang=gang,
+                members=sorted(members),
+                chips_per_member=chips_each,
+                added=sorted(added) or None,
+                removed=sorted(removed) or None,
+                source=source,
+                trace_id=trace_id,
+            )
+
+    # -- grow ----------------------------------------------------------------
+
+    def grow(
+        self,
+        gang: str,
+        new_pods: list,
+        node_names: Optional[list] = None,
+        generation_pref: Optional[list] = None,
+    ) -> dict:
+        """Admit ``new_pods`` (already created in the cluster, gang
+        annotations in place) into the live gang.  ``node_names``
+        defaults to every known node; ``generation_pref`` is a TPU
+        generation ranking (``generation_preference(...)``'s output —
+        the same list the autoscaler's executor consumes): feasible
+        nodes are ordered by their allocator's generation against it,
+        scheduler feasibility order breaking ties."""
+        sched = self.sched
+        if node_names is None:
+            node_names = sorted(
+                n.metadata.name for n in self.clientset.list_nodes()
+            )
+        with self._lock, TRACER.span(
+            "fleet.resize", gang=gang, grow=len(new_pods),
+        ) as sp:
+            existing = self.members(gang)
+            chips_each = (
+                member_chips(next(iter(existing.values()))[1])
+                if existing else 0
+            )
+            executed: list[tuple] = []  # (node, pod, opt)
+            self._drain_all(existing)
+            try:
+                for pod in new_pods:
+                    ok, _failed = sched.assume(list(node_names), pod)
+                    if not ok and self.defrag is not None:
+                        # one defrag unblocking round, then refilter —
+                        # membership change composes with migration
+                        # through the same journal.  Want = the member's
+                        # own whole-chip demand (existing members when
+                        # the gang is live, the pod's request otherwise)
+                        from ..core.request import request_from_pod
+
+                        tpu = [
+                            u for u in request_from_pod(pod).units
+                            if u.needs_tpu
+                        ]
+                        want = (
+                            chips_each
+                            or (tpu[0].chip_count if tpu else 0)
+                            or 1,
+                            1,
+                        )
+                        try:
+                            self.defrag.run_round(sched=sched, want=want)
+                        except RuntimeError:
+                            pass
+                        ok, _failed = sched.assume(list(node_names), pod)
+                    if not ok:
+                        raise RuntimeError(
+                            f"resize grow: no feasible node for {pod.key}"
+                        )
+                    rank = {
+                        g: i for i, g in enumerate(generation_pref or [])
+                    }
+                    def node_gen(n):
+                        na = sched.allocators.get(n)
+                        return getattr(na, "generation", "") if na else ""
+                    target = min(
+                        ok,
+                        key=lambda n: (
+                            rank.get(node_gen(n), len(rank)), n,
+                        ),
+                    )
+                    # NO cordon here: cordoning the target would make the
+                    # NEXT member's filter reject it (a multi-pod grow
+                    # whose members share a node would spuriously fail),
+                    # and gang_allocate is a validating commit anyway — a
+                    # racing bind stealing the chips raises cleanly into
+                    # the all-or-nothing rollback below
+                    opt = sched.gang_allocate(target, pod, source="resize")
+                    executed.append((target, pod, opt))
+                    if chips_each == 0:
+                        chips_each = member_chips(opt)
+                    elif member_chips(opt) != chips_each:
+                        raise RuntimeError(
+                            f"resize grow: {pod.key} got "
+                            f"{member_chips(opt)} chips, gang members "
+                            f"hold {chips_each} (demand skew)"
+                        )
+                    sched.gang_annotate(pod, opt, target)
+                after = dict(existing)
+                for node, pod, opt in executed:
+                    after[pod.key] = (node, opt, pod)
+                seq = self._journal_resize(
+                    gang, after, added=[p.key for _n, p, _o in executed],
+                    removed=[], chips_each=chips_each, source="grow",
+                    trace_id=sp.trace_id or None,
+                )
+                self.resizes += 1
+                FLEET_EVENTS.inc("resize_executed")
+                result = {
+                    "gang": gang,
+                    "action": "grow",
+                    "added": [p.key for _n, p, _o in executed],
+                    "members": sorted(after),
+                    "chips_per_member": chips_each,
+                    "journal_seq": seq,
+                }
+                self.last_result = result
+                return result
+            except Exception as e:
+                FLEET_EVENTS.inc("resize_failed")
+                # all-or-nothing: reverse executed members (journaled
+                # forgets) + strip their ledger entries, reverse order
+                for node, pod, opt in reversed(executed):
+                    try:
+                        sched.gang_unallocate(
+                            node, pod, opt, source="resize_rollback"
+                        )
+                        sched.gang_strip_annotations(pod)
+                    except Exception:
+                        log.exception(
+                            "resize rollback of %s failed — run a journal "
+                            "replay audit", pod.key,
+                        )
+                raise RuntimeError(f"resize grow failed (rolled back): {e}") from e
+            finally:
+                self._resume_all(existing)
+
+    # -- shrink --------------------------------------------------------------
+
+    def shrink(self, gang: str, victim_keys: list) -> dict:
+        """Release ``victim_keys`` from the live gang (journaled forgets
+        + ledger strip), all-or-nothing with re-admission rollback."""
+        sched = self.sched
+        with self._lock, TRACER.span(
+            "fleet.resize", gang=gang, shrink=len(victim_keys),
+        ) as sp:
+            existing = self.members(gang)
+            missing = [k for k in victim_keys if k not in existing]
+            if missing:
+                raise RuntimeError(
+                    f"resize shrink: {missing} not live members of {gang}"
+                )
+            remaining = {
+                k: v for k, v in existing.items() if k not in victim_keys
+            }
+            chips_each = (
+                member_chips(next(iter(remaining.values()))[1])
+                if remaining
+                else member_chips(existing[victim_keys[0]][1])
+            )
+            executed: list[tuple] = []
+            self._drain_all(existing)
+            try:
+                for key in sorted(victim_keys):
+                    node, opt, pod = existing[key]
+                    sched.forget_pod(pod, source="resize")
+                    executed.append((node, pod, opt))
+                    sched.gang_strip_annotations(pod)
+                seq = self._journal_resize(
+                    gang, remaining, added=[],
+                    removed=sorted(victim_keys), chips_each=chips_each,
+                    source="shrink", trace_id=sp.trace_id or None,
+                )
+                self.resizes += 1
+                FLEET_EVENTS.inc("resize_executed")
+                result = {
+                    "gang": gang,
+                    "action": "shrink",
+                    "removed": sorted(victim_keys),
+                    "members": sorted(remaining),
+                    "chips_per_member": chips_each,
+                    "journal_seq": seq,
+                }
+                self.last_result = result
+                return result
+            except Exception as e:
+                FLEET_EVENTS.inc("resize_failed")
+                for node, pod, opt in reversed(executed):
+                    try:
+                        # re-admission: validating transact back onto the
+                        # SAME chips (just freed; a racing bind would
+                        # raise → the audit-loudly path)
+                        sched.gang_apply_option(
+                            node, pod, opt, source="resize_rollback"
+                        )
+                        sched.gang_annotate(pod, opt, node)
+                    except Exception:
+                        log.exception(
+                            "resize shrink rollback of %s failed — run a "
+                            "journal replay audit", pod.key,
+                        )
+                raise RuntimeError(
+                    f"resize shrink failed (rolled back): {e}"
+                ) from e
+            finally:
+                self._resume_all(existing)
+
+    def debug_state(self) -> dict:
+        return {"resizes": self.resizes, "last_result": self.last_result}

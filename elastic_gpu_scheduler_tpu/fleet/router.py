@@ -1,0 +1,841 @@
+"""Front-door router: spread /v1/* streams across serving replicas with
+prefix-cache-aware affinity.
+
+One serving engine per pod caps the fleet at one pod's throughput; the
+router is the fan-out point.  Routing policy, in precedence order:
+
+1. **Prefix affinity.**  The incoming prompt's rolling BLAKE2b digest
+   chain (utils/prefixdigest — the SAME chain the engine's prefix cache
+   keys pages by) is matched longest-first against the chains of prompts
+   this router previously sent to each replica: a hit routes the session
+   to the replica whose KV cache already holds that prefix, so the
+   engine's ``_match_prefix`` turns the route into real skipped prefill
+   work.  The affinity map is a bounded LRU — cold digests age out at
+   roughly the rate replica caches recycle pages.
+2. **Least loaded.**  No affinity match (or the matched replica is not
+   routable): pick the replica with the smallest (queued + router
+   in-flight, active slot fraction) from the health loop's last
+   ``/v1/stats`` poll plus the router's own in-flight counter (fresher
+   than any poll).
+3. **Failover.**  Connect failure or a 5xx status line from the chosen
+   replica (detected BEFORE any byte is forwarded to the client) falls
+   through to the next candidate; each failure feeds that replica's
+   circuit breaker.
+
+Replica health: a background loop polls ``/healthz`` + ``/v1/stats``.
+States: ``up`` (routable), ``draining`` (healthz 503 / relay down —
+finishes in-flight streams, gets no new sessions), ``down`` (breaker
+open or consecutive probe failures).  Replicas marked ``relay=True``
+serve through the TPU probe relay: when ``utils.tpuprobe``'s
+RelayMonitor last saw the relay down they are marked draining
+IMMEDIATELY, without burning a per-replica HTTP timeout first — the
+relay has one health signal and the router must reuse it, not
+rediscover it as a timeout storm (BENCH_r02's down relay).
+
+SSE pass-through: after the backend's status line is parsed, the relay
+loop is a raw byte pump (recv → send per burst), so the engine's
+burst-coalesced SSE chunks reach the client with their framing — and
+their syscall economy — intact.  The router hop opens a ``fleet.route``
+span whose context is forwarded as the backend ``traceparent`` header:
+client → router → replica → engine step forms ONE W3C trace chain.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from collections import OrderedDict
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+from ..metrics import (
+    FLEET_REPLICAS,
+    FLEET_ROUTE_OVERHEAD,
+    FLEET_ROUTED,
+    REGISTRY,
+)
+from ..tracing import TRACEPARENT_HEADER, TRACER
+from ..utils import prefixdigest
+from ..utils.tpuprobe import RELAY_MONITOR
+
+log = logging.getLogger("tpu-scheduler")
+
+REPLICA_STATES = ("up", "draining", "down")
+
+
+class _RelayAborted(Exception):
+    """The response relay broke AFTER bytes reached the client (client
+    disconnect, or a backend drop mid-stream).  NOT failover-eligible —
+    retrying would duplicate a partially-delivered generation — and a
+    client hangup must never feed the replica's circuit breaker."""
+
+    def __init__(self, reason: str, client_side: bool):
+        super().__init__(reason)
+        self.client_side = client_side
+
+
+class Replica:
+    """One serving backend.  Mutable health/load state is written by the
+    health loop and the relay path; reads are GIL-atomic attribute loads
+    (same stance as the engine's ``cancelled`` flag)."""
+
+    def __init__(
+        self, name: str, host: str, port: int, relay: bool = False
+    ):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        # True = this replica serves through the TPU probe relay; its
+        # health follows the RelayMonitor's signal without an HTTP probe
+        self.relay = relay
+        self.state = "up"  # optimistic: first health pass corrects it
+        self.state_reason = "new"
+        # router-imposed drain (scale-down victim, migration/resize
+        # bracket): while True the health loop must NOT promote the
+        # replica back to 'up' on a healthy probe — the backend engine
+        # is healthy by design during a router-level drain
+        self.pinned_draining = False
+        # guards the (state, pinned_draining) pair: drain()/undrain()
+        # and the health loop's promotion race on different threads, and
+        # LOAD-check-STORE on two attributes is not atomic
+        self._state_lock = threading.Lock()
+        self.consecutive_failures = 0
+        self.breaker_open_until = 0.0  # monotonic; 0 = closed
+        # requests this router is relaying right now.  '+= 1' on an
+        # attribute is LOAD/ADD/STORE — not atomic across handler
+        # threads, and a lost decrement would block scale-down forever
+        # (it waits for inflight == 0) — so mutations go through
+        # inflight_enter/exit under a lock
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.stats: dict = {}  # last /v1/stats payload
+        self.stats_at = 0.0
+        self.routed = 0  # total requests sent here
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def inflight_enter(self) -> None:
+        with self._inflight_lock:
+            self.inflight += 1
+
+    def inflight_exit(self) -> None:
+        with self._inflight_lock:
+            self.inflight -= 1
+
+    def load_key(self) -> tuple:
+        """Least-loaded ordering: queued work first (the thing a new
+        request actually waits behind), then slot occupancy, then name
+        for determinism."""
+        queued = int(self.stats.get("queued", 0)) + self.inflight
+        slots = int(self.stats.get("active_slots", 0))
+        max_batch = max(1, int(self.stats.get("max_batch", 1)))
+        return (queued, slots / max_batch, self.name)
+
+    def routable(self, now: float) -> bool:
+        return self.state == "up" and now >= self.breaker_open_until
+
+    def note_failure(self, threshold: int, cooldown_s: float) -> None:
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= threshold:
+            self.breaker_open_until = time.monotonic() + cooldown_s
+            self.state = "down"
+            self.state_reason = (
+                f"circuit breaker open ({self.consecutive_failures} "
+                "consecutive failures)"
+            )
+
+    def note_success(self) -> None:
+        self.consecutive_failures = 0
+        self.breaker_open_until = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "addr": f"{self.host}:{self.port}",
+            "state": self.state,
+            "reason": self.state_reason,
+            "relay": self.relay,
+            "inflight": self.inflight,
+            "routed": self.routed,
+            "consecutive_failures": self.consecutive_failures,
+            "breaker_open": time.monotonic() < self.breaker_open_until,
+            "queued": self.stats.get("queued"),
+            "active_slots": self.stats.get("active_slots"),
+            "max_batch": self.stats.get("max_batch"),
+        }
+
+
+class ReplicaSet:
+    """The router's replica registry + health loop.
+
+    ``relay_monitor`` is injectable for tests; it defaults to the
+    process-global RELAY_MONITOR the scheduler CLI starts.  The health
+    loop is the ONLY writer of ``state`` for live replicas (the relay
+    path may open a breaker, which the next health pass reconciles)."""
+
+    def __init__(
+        self,
+        interval_s: float = 2.0,
+        probe_timeout_s: float = 1.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 5.0,
+        relay_monitor=None,
+    ):
+        self.interval_s = max(0.05, float(interval_s))
+        self.probe_timeout_s = probe_timeout_s
+        self.breaker_threshold = max(1, breaker_threshold)
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.relay_monitor = (
+            relay_monitor if relay_monitor is not None else RELAY_MONITOR
+        )
+        self._lock = threading.Lock()  # guards the dict, not replica fields
+        self._replicas: dict[str, Replica] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership ----------------------------------------------------------
+
+    def add(self, replica: Replica) -> Replica:
+        with self._lock:
+            self._replicas[replica.name] = replica
+        return replica
+
+    def remove(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.pop(name, None)
+
+    def get(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def all(self) -> list[Replica]:
+        with self._lock:
+            return sorted(self._replicas.values(), key=lambda r: r.name)
+
+    def routable(self) -> list[Replica]:
+        now = time.monotonic()
+        return [r for r in self.all() if r.routable(now)]
+
+    def drain(self, name: str, reason: str = "requested") -> bool:
+        """Mark a replica draining (no new sessions; in-flight streams
+        finish) — the scale-down path's first step.  PINNED: the health
+        loop will not promote it back to 'up' on a healthy probe (the
+        backend IS healthy during a router-level drain); ``undrain``
+        releases it."""
+        r = self.get(name)
+        if r is None:
+            return False
+        with r._state_lock:
+            r.state = "draining"
+            r.state_reason = reason
+            r.pinned_draining = True
+        return True
+
+    def undrain(self, name: str, reason: str = "restored") -> bool:
+        """Release a router-imposed drain (scale-down refused, move
+        complete): the replica is routable again and the health loop
+        resumes normal state management."""
+        r = self.get(name)
+        if r is None:
+            return False
+        with r._state_lock:
+            r.pinned_draining = False
+            if r.state == "draining":
+                r.state = "up"
+                r.state_reason = reason
+        return True
+
+    # -- health --------------------------------------------------------------
+
+    def _http_get(self, replica: Replica, path: str) -> tuple[int, bytes]:
+        """Tiny one-shot GET (no http.client: its default parsing is
+        fine, but a 3-line raw exchange keeps the probe dependency-free
+        and its timeout semantics obvious)."""
+        with socket.create_connection(
+            replica.addr, timeout=self.probe_timeout_s
+        ) as s:
+            s.settimeout(self.probe_timeout_s)
+            s.sendall(
+                f"GET {path} HTTP/1.1\r\nHost: {replica.host}\r\n"
+                "Connection: close\r\n\r\n".encode()
+            )
+            buf = b""
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                buf += b
+        head, _, body = buf.partition(b"\r\n\r\n")
+        try:
+            status = int(head.split(b" ", 2)[1])
+        except (IndexError, ValueError):
+            raise ConnectionError("malformed status line")
+        return status, body
+
+    def refresh_one(self, r: Replica) -> None:
+        """One health pass for one replica.  Relay-backed replicas are
+        resolved from the RelayMonitor's last probe FIRST: a down relay
+        means every replica behind it is draining NOW — reusing the
+        monitor's state instead of discovering the outage one HTTP
+        timeout at a time (the timeout-storm failure mode)."""
+        if r.pinned_draining:
+            # router-imposed drain (scale-down / move in progress): the
+            # backend probing healthy is expected and must NOT flip the
+            # replica routable mid-drain
+            r.state = "draining"
+            return
+        if r.relay and self.relay_monitor.up is False:
+            r.state = "draining"
+            r.state_reason = (
+                f"TPU relay down (RelayMonitor: {self.relay_monitor.detail})"
+            )
+            return
+        try:
+            status, _ = self._http_get(r, "/healthz")
+        except (OSError, ConnectionError) as e:
+            r.note_failure(self.breaker_threshold, self.breaker_cooldown_s)
+            if r.consecutive_failures < self.breaker_threshold:
+                # transient: stay in the current state one more round
+                r.state_reason = f"healthz failed: {e}"
+            return
+        if status == 503:
+            r.state = "draining"
+            r.state_reason = "healthz 503 (replica draining)"
+            r.note_success()
+            return
+        if status != 200:
+            r.note_failure(self.breaker_threshold, self.breaker_cooldown_s)
+            r.state_reason = f"healthz {status}"
+            return
+        r.note_success()
+        with r._state_lock:
+            # re-check UNDER the state lock: a drain() that landed while
+            # the probe was in flight must not be overwritten by this
+            # healthy result (check-then-set on two attributes races
+            # without the lock)
+            if r.pinned_draining:
+                r.state = "draining"
+                return
+            r.state = "up"
+            r.state_reason = "healthy"
+        try:
+            sstat, body = self._http_get(r, "/v1/stats")
+            if sstat == 200:
+                r.stats = json.loads(body)
+                r.stats_at = time.monotonic()
+        except (OSError, ConnectionError, ValueError):
+            pass  # load data is advisory; health already answered
+
+    def refresh(self) -> None:
+        for r in self.all():
+            self.refresh_one(r)
+        counts = {s: 0 for s in REPLICA_STATES}
+        for r in self.all():
+            counts[r.state] = counts.get(r.state, 0) + 1
+        for s, n in counts.items():
+            FLEET_REPLICAS.set(s, value=float(n))
+
+    def start(self) -> "ReplicaSet":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.refresh()
+                except Exception:
+                    log.exception("fleet health pass failed")
+                if self._stop.wait(self.interval_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-health", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+
+class FleetRouter:
+    """The /v1/* front door over a ReplicaSet (see the module docstring
+    for policy).  ``page_size`` must match the replicas' engine page
+    size for affinity hits to be REAL cache hits; the health loop adopts
+    the first replica's advertised value when they disagree."""
+
+    def __init__(
+        self,
+        replicas: ReplicaSet,
+        host: str = "0.0.0.0",
+        port: int = 8100,
+        page_size: int = 16,
+        prefix_cap: int = 65536,
+        max_affinity_pages: int = 64,
+        backend_timeout_s: float = 300.0,
+    ):
+        self.replicas = replicas
+        self.host = host
+        self.port = port
+        self.page_size = max(1, int(page_size))
+        self.max_affinity_pages = max(1, int(max_affinity_pages))
+        self.backend_timeout_s = backend_timeout_s
+        # optional callable → dict serving the COMBINED fleet payload
+        # (router + autoscaler + resize) at this port's /debug/fleet —
+        # the CLI wires FleetState.debug_state here so both servers
+        # answer with the same shape; unset (library use) falls back to
+        # the router-only view
+        self.state_provider = None
+        # digest → replica name, newest-matched last (LRU).  One map for
+        # the whole fleet: lookups walk the request's chain longest-first
+        # and stop at the first known link.
+        self._prefix_map: "OrderedDict[bytes, str]" = OrderedDict()
+        self._prefix_cap = max(1024, int(prefix_cap))
+        self._prefix_lock = threading.Lock()
+        self._page_size_resolved = False  # one-shot adoption latch
+        self.affinity_hits = 0
+        self.affinity_requests = 0
+        self.matched_pages = 0
+        self.requests = 0
+        # per-request router overhead samples (seconds) — the
+        # FLEET_ROUTE_OVERHEAD histogram's raw tail for tools that need
+        # an exact p99 (bench fleet section, check-fleet); bounded like
+        # the engine's gap buffer
+        self.overhead_samples: list[float] = []
+        self._overhead_cap = 8192
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing -------------------------------------------------------------
+
+    def _adopt_page_size(self) -> None:
+        """Reconcile the affinity page size with what replicas actually
+        advertise on /v1/stats: a mismatched configuration would keep
+        'hitting' digests that no engine's cache keys by, silently
+        degrading affinity to sticky-random routing.  First advertised
+        value wins; adoption clears the map (its digests were chained at
+        the wrong page boundaries).  One-shot: after any replica has
+        answered, the latch keeps this off the per-request path."""
+        if self._page_size_resolved:
+            return
+        for r in self.replicas.all():
+            ps = r.stats.get("page_size")
+            if not ps:
+                continue
+            ps = int(ps)
+            if ps != self.page_size:
+                log.warning(
+                    "fleet router adopting replica-advertised page_size "
+                    "%d (configured %d); affinity map reset",
+                    ps, self.page_size,
+                )
+                with self._prefix_lock:
+                    self._prefix_map.clear()
+                self.page_size = ps
+            self._page_size_resolved = True
+            return
+
+    def _digests(self, body: dict) -> list[bytes]:
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not all(
+            isinstance(t, int) and not isinstance(t, bool)
+            # int32 range: the chain hashes native int32 bytes; an
+            # out-of-range id would raise OverflowError from the hasher
+            # and kill the handler thread — the BACKEND owns rejecting
+            # it with a proper 400, the router just declines to hash
+            and -(2 ** 31) <= t < 2 ** 31
+            for t in prompt
+        ):
+            return []
+        self._adopt_page_size()
+        adapter = str(body.get("adapter", ""))
+        # adapter NAME seeds the router's chain (the engine seeds by bank
+        # index, which the router never sees; equality semantics — same
+        # adapter ⇔ same seed — are what affinity needs)
+        seed = (
+            prefixdigest.prefix_seed(0)
+            if not adapter
+            else b"adapter:" + adapter.encode()
+        )
+        return prefixdigest.page_digests(
+            prompt, self.page_size, max_pages=self.max_affinity_pages,
+            seed=seed,
+        )
+
+    def _affinity_lookup(self, digests: list[bytes]) -> tuple[Optional[str], int]:
+        """(replica name, matched page count) for the LONGEST known link
+        of the chain, or (None, 0)."""
+        with self._prefix_lock:
+            for k in range(len(digests) - 1, -1, -1):
+                name = self._prefix_map.get(digests[k])
+                if name is not None:
+                    self._prefix_map.move_to_end(digests[k])
+                    return name, k + 1
+        return None, 0
+
+    def _affinity_record(self, digests: list[bytes], name: str) -> None:
+        with self._prefix_lock:
+            for d in digests:
+                self._prefix_map[d] = name
+                self._prefix_map.move_to_end(d)
+            while len(self._prefix_map) > self._prefix_cap:
+                self._prefix_map.popitem(last=False)
+
+    def select(self, body: dict) -> tuple[Optional[Replica], str, list[bytes]]:
+        """(replica, kind, digests): the routing decision, before any
+        network IO.  kind ∈ affinity | least_loaded | no_replica."""
+        candidates = self.replicas.routable()
+        digests = self._digests(body)
+        if digests:
+            self.affinity_requests += 1
+        if not candidates:
+            return None, "no_replica", digests
+        by_name = {r.name: r for r in candidates}
+        name, pages = self._affinity_lookup(digests)
+        if name is not None and name in by_name:
+            self.affinity_hits += 1
+            self.matched_pages += pages
+            return by_name[name], "affinity", digests
+        return (
+            min(candidates, key=lambda r: r.load_key()),
+            "least_loaded",
+            digests,
+        )
+
+    def failover_order(self, first: Replica) -> list[Replica]:
+        rest = sorted(
+            (r for r in self.replicas.routable() if r.name != first.name),
+            key=lambda r: r.load_key(),
+        )
+        return [first] + rest
+
+    # -- relay ---------------------------------------------------------------
+
+    def _forward(
+        self,
+        replica: Replica,
+        method: str,
+        path: str,
+        body: bytes,
+        traceparent: str,
+        client_sock: socket.socket,
+    ) -> tuple[int, float]:
+        """Send the request to ``replica`` and pump the response back to
+        the client verbatim.  Returns (backend status, router overhead
+        seconds — connect + request forward; the wait for the backend's
+        first byte is GENERATION time for non-streamed completions and
+        deliberately excluded).  Raises before any client byte is
+        written if the backend is unreachable or answers 5xx, so the
+        caller can fail over cleanly."""
+        t0 = time.perf_counter()
+        bs = socket.create_connection(replica.addr, timeout=5.0)
+        try:
+            bs.settimeout(self.backend_timeout_s)
+            headers = (
+                f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {replica.host}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Content-Type: application/json\r\n"
+                "Connection: close\r\n"
+            )
+            if traceparent:
+                headers += f"{TRACEPARENT_HEADER}: {traceparent}\r\n"
+            bs.sendall(headers.encode("latin1") + b"\r\n" + body)
+            overhead = time.perf_counter() - t0
+            # read until the backend's header block is complete: the
+            # status decides failover vs relay, and nothing is forwarded
+            # to the client until that decision is made
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                b = bs.recv(65536)
+                if not b:
+                    raise ConnectionError("backend closed before headers")
+                buf += b
+            try:
+                status = int(buf.split(b" ", 2)[1])
+            except (IndexError, ValueError):
+                raise ConnectionError("malformed backend status line")
+            if status >= 500:
+                raise ConnectionError(f"backend answered {status}")
+            # byte pump: each backend burst (the engine coalesces SSE
+            # events into one chunk per burst) is one send to the client
+            # — framing and syscall economy pass through unchanged.
+            # From the first client write on, failures are _RelayAborted
+            # (see class docstring), never failover
+            try:
+                client_sock.sendall(buf)
+            except OSError as e:
+                raise _RelayAborted(f"client write failed: {e}", True)
+            while True:
+                try:
+                    b = bs.recv(65536)
+                except OSError as e:
+                    raise _RelayAborted(
+                        f"backend dropped mid-stream: {e}", False
+                    )
+                if not b:
+                    break
+                try:
+                    client_sock.sendall(b)
+                except OSError as e:
+                    raise _RelayAborted(f"client write failed: {e}", True)
+            return status, overhead
+        finally:
+            try:
+                bs.close()
+            except OSError:
+                pass
+
+    def handle_completion(
+        self,
+        method: str,
+        path: str,
+        raw: bytes,
+        traceparent: str,
+        client_sock: socket.socket,
+    ) -> Optional[tuple[int, bytes]]:
+        """Route one /v1/* request.  Returns (status, json body) when
+        the router must answer itself (no replica / bad body); None when
+        the response was already relayed to the client."""
+        self.requests += 1
+        try:
+            body = json.loads(raw or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            return 400, json.dumps({"error": f"router: {e}"}).encode()
+        with TRACER.span(
+            "fleet.route", parent=traceparent or None, path=path,
+            stream=bool(body.get("stream")),
+        ) as sp:
+            replica, kind, digests = self.select(body)
+            if replica is None:
+                FLEET_ROUTED.inc("no_replica")
+                sp.set_attr("kind", "no_replica")
+                return 503, json.dumps(
+                    {"error": "no serving replica available"}
+                ).encode()
+            # the router hop joins the W3C chain: the backend request
+            # carries THIS span's context, so the replica's serve.request
+            # span becomes its child
+            backend_tp = sp.traceparent() if sp else traceparent
+            attempt_kind = kind
+            last_err: Optional[str] = None
+            for target in self.failover_order(replica):
+                target.inflight_enter()
+                try:
+                    status, overhead = self._forward(
+                        target, method, path, raw, backend_tp, client_sock
+                    )
+                except _RelayAborted as e:
+                    # bytes already reached the client: no failover (a
+                    # retry would duplicate a partial generation), and a
+                    # client hangup never feeds the replica's breaker
+                    if not e.client_side:
+                        target.note_failure(
+                            self.replicas.breaker_threshold,
+                            self.replicas.breaker_cooldown_s,
+                        )
+                    FLEET_ROUTED.inc("aborted")
+                    sp.set_attr("kind", "aborted")
+                    sp.set_attr("replica", target.name)
+                    sp.end(status="error")
+                    return None
+                except (OSError, ConnectionError) as e:
+                    last_err = str(e)
+                    target.note_failure(
+                        self.replicas.breaker_threshold,
+                        self.replicas.breaker_cooldown_s,
+                    )
+                    attempt_kind = "failover"
+                    continue
+                finally:
+                    target.inflight_exit()
+                target.note_success()
+                target.routed += 1
+                self._affinity_record(digests, target.name)
+                FLEET_ROUTED.inc(attempt_kind)
+                FLEET_ROUTE_OVERHEAD.observe(value=overhead)
+                self.overhead_samples.append(overhead)
+                if len(self.overhead_samples) > self._overhead_cap:
+                    del self.overhead_samples[: self._overhead_cap // 2]
+                sp.set_attr("replica", target.name)
+                sp.set_attr("kind", attempt_kind)
+                sp.set_attr("overhead_ms", round(overhead * 1e3, 3))
+                sp.set_attr("status", status)
+                return None
+            # distinct from no_replica (nothing routable → 503): here
+            # replicas LOOKED routable but every connect/forward failed
+            FLEET_ROUTED.inc("exhausted")
+            sp.set_attr("kind", "exhausted")
+            return 502, json.dumps(
+                {"error": f"every replica failed (last: {last_err})"}
+            ).encode()
+
+    # -- introspection -------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        with self._prefix_lock:
+            prefix_entries = len(self._prefix_map)
+        return {
+            "replicas": [r.to_dict() for r in self.replicas.all()],
+            "requests": self.requests,
+            "affinity": {
+                "requests": self.affinity_requests,
+                "hits": self.affinity_hits,
+                "hit_pct": round(
+                    100.0 * self.affinity_hits
+                    / max(1, self.affinity_requests), 2,
+                ),
+                "matched_pages": self.matched_pages,
+                "map_entries": prefix_entries,
+                "page_size": self.page_size,
+            },
+        }
+
+    def aggregate_stats(self) -> dict:
+        """Fleet-wide /v1/stats: per-replica payloads plus sums a client
+        can capacity-plan on."""
+        reps = self.replicas.all()
+        agg = {
+            "queued": sum(int(r.stats.get("queued", 0)) for r in reps),
+            "active_slots": sum(
+                int(r.stats.get("active_slots", 0)) for r in reps
+            ),
+            "max_batch": sum(int(r.stats.get("max_batch", 0)) for r in reps),
+            "replicas_up": sum(1 for r in reps if r.state == "up"),
+            "replicas": {r.name: r.stats for r in reps},
+        }
+        return agg
+
+    # -- HTTP lifecycle ------------------------------------------------------
+
+    def _make_handler(router):
+        import socketserver
+
+        class Handler(socketserver.StreamRequestHandler):
+            disable_nagle_algorithm = True
+            rbufsize = 1 << 16
+
+            def handle(self):
+                try:
+                    self._one_request()
+                except (ConnectionError, BrokenPipeError, TimeoutError):
+                    pass
+
+            def _respond(self, code: int, payload: bytes,
+                         ctype: str = "application/json") -> None:
+                reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                           502: "Bad Gateway", 503: "Service Unavailable"}
+                head = (
+                    f"HTTP/1.1 {code} {reasons.get(code, 'OK')}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode("latin1")
+                self.wfile.write(head + payload)
+                self.wfile.flush()
+
+            def _one_request(self) -> None:
+                line = self.rfile.readline(8192)
+                if not line:
+                    return
+                try:
+                    method, target, _version = (
+                        line.decode("latin1").split()
+                    )
+                except ValueError:
+                    return
+                clen = 0
+                traceparent = ""
+                while True:
+                    h = self.rfile.readline(8192)
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.partition(b":")
+                    k = k.strip().lower()
+                    if k == b"content-length":
+                        try:
+                            clen = int(v.strip())
+                        except ValueError:
+                            return
+                    elif k == b"traceparent":
+                        traceparent = v.strip().decode("latin1")
+                raw = self.rfile.read(clen) if clen > 0 else b""
+                path = target.partition("?")[0]
+                if method == "GET":
+                    if path == "/healthz":
+                        up = len(router.replicas.routable())
+                        code = 200 if up else 503
+                        return self._respond(code, json.dumps(
+                            {"ok": up > 0, "replicas_up": up}
+                        ).encode())
+                    if path == "/v1/stats":
+                        return self._respond(
+                            200, json.dumps(router.aggregate_stats()).encode()
+                        )
+                    if path in ("/debug/fleet", "/fleet"):
+                        provider = router.state_provider
+                        payload = (
+                            provider() if provider is not None
+                            else router.debug_state()
+                        )
+                        return self._respond(
+                            200, json.dumps(payload, indent=1).encode(),
+                        )
+                    if path == "/metrics":
+                        return self._respond(
+                            200, REGISTRY.expose().encode(), "text/plain"
+                        )
+                    return self._respond(
+                        404, json.dumps({"error": f"no route {path}"}).encode()
+                    )
+                if method == "POST" and path.startswith("/v1/"):
+                    # flush our buffered writer before the relay writes to
+                    # the raw socket (it is empty here, but the invariant
+                    # must hold if a header is ever written first)
+                    self.wfile.flush()
+                    answered = router.handle_completion(
+                        method, path, raw, traceparent, self.connection
+                    )
+                    if answered is not None:
+                        code, payload = answered
+                        self._respond(code, payload)
+                    return
+                return self._respond(
+                    404, json.dumps({"error": f"no route {path}"}).encode()
+                )
+
+        return Handler
+
+    def start(self) -> int:
+        self.replicas.start()
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("fleet router serving on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self.replicas.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

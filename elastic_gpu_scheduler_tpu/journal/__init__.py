@@ -23,7 +23,14 @@ stack:
   periodic ``profile`` records — per-class throughput/latency/
   interference snapshots; these are ANNOTATIONS in the stream (replay
   never mutates allocator state from them) that let ``what_if`` replay
-  re-score recorded workload under a profile-aware rater.
+  re-score recorded workload under a profile-aware rater.  The fleet
+  subsystem (``fleet/``) adds two more types: ``fleet`` (autoscaler
+  evaluations — signals + decision, the stream
+  ``fleet.autoscaler.score_policy`` replays a candidate scaling policy
+  against offline; annotations like ``profile``) and ``resize`` (a gang
+  membership-change commit summary; replay VERIFIES it — chip
+  conservation per member and exact all-or-nothing membership — against
+  the state the surrounding bind/forget/migrate records rebuilt).
 
 - **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
 

@@ -107,6 +107,14 @@ class ReplayResult:
     # the latest kept — they never mutate allocator state
     profiles: int = 0
     last_profile: Optional[dict] = None
+    # fleet-autoscaler evaluations (fleet/ subsystem): annotations like
+    # profiles — counted, dense-seq audited, zero allocator mutation.
+    # The stream is what fleet.autoscaler.score_policy replays offline.
+    fleet_records: int = 0
+    last_fleet: Optional[dict] = None
+    # gang resize transactions verified (each checked against the chip-
+    # conservation and membership all-or-nothing invariants)
+    resizes: int = 0
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -129,6 +137,8 @@ class ReplayResult:
                 g: dict(v) for g, v in sorted(self.gangs.items())
             },
             "profile_records": self.profiles,
+            "fleet_records": self.fleet_records,
+            "resizes": self.resizes,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -415,6 +425,74 @@ def replay(events: list[dict]) -> ReplayResult:
                 "profiles": rec.get("profiles") or {},
                 "interference": rec.get("interference") or {},
             }
+        elif t == "fleet":
+            # autoscaler evaluation (fleet/ subsystem): an annotation
+            # like `profile` — the signals + decision stream that
+            # fleet.autoscaler.score_policy replays a candidate scaling
+            # policy against.  Never mutates allocator state.
+            res.fleet_records += 1
+            res.last_fleet = {
+                "seq": seq,
+                "t": rec.get("t"),
+                "action": rec.get("action"),
+                "signals": rec.get("signals") or {},
+                "replicas": rec.get("replicas"),
+            }
+        elif t == "resize":
+            # gang-resize commit summary (fleet/resize.py).  The member
+            # binds/forgets/migrates that changed state were journaled
+            # individually by the transaction; THIS record declares the
+            # intended end state, and replay verifies the stream reached
+            # exactly it:
+            #   all-or-nothing — the recorded membership matches the live
+            #   member set for the gang (no half-admitted joiner, no
+            #   surviving evictee);
+            #   chip conservation — every member charges exactly the
+            #   recorded per-member chip count (chips move only WITH a
+            #   member, never appear or vanish in flight).
+            res.resizes += 1
+            gang = rec.get("gang", "?")
+            members = rec.get("members") or []
+            chips_each = rec.get("chips_per_member")
+            live = {
+                pk for pk, lp in res.pods.items() if lp.gang == gang
+            }
+            missing = [m for m in members if m not in live]
+            extra = sorted(live - set(members))
+            if missing:
+                res.violations.append(
+                    f"{where}: resize of gang {gang} records "
+                    f"{len(missing)} member(s) not bound: {missing[:4]} "
+                    "— all-or-nothing violated"
+                )
+            if extra:
+                res.violations.append(
+                    f"{where}: resize of gang {gang} left "
+                    f"{len(extra)} non-member(s) still bound: {extra[:4]} "
+                    "— all-or-nothing violated"
+                )
+            if chips_each is not None:
+                for m in members:
+                    lp = res.pods.get(m)
+                    if lp is None:
+                        continue  # already flagged as missing
+                    got = sum(
+                        len(a.coords)
+                        for a in lp.option.allocs
+                        if a.needs_tpu
+                    )
+                    if got != chips_each:
+                        res.violations.append(
+                            f"{where}: resize of gang {gang}: member {m} "
+                            f"charges {got} chips, record declares "
+                            f"{chips_each} — chips not conserved"
+                        )
+            for r in rec.get("removed") or []:
+                if r in res.pods:
+                    res.violations.append(
+                        f"{where}: resize of gang {gang}: removed member "
+                        f"{r} is still bound"
+                    )
         else:
             res.warnings.append(f"{where}: unknown record type {t!r}")
 
@@ -568,6 +646,12 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             profiles_seen += 1
             if observe_profile is not None:
                 observe_profile(rec)
+            continue
+        if t in ("fleet", "resize"):
+            # annotations (autoscaler evaluations / resize summaries):
+            # the member binds/forgets/migrates around a resize carry the
+            # state changes; scoring a scaling POLICY offline is
+            # fleet.autoscaler.score_policy's job, not the rater's
             continue
         if t in ("node_add", "node_resync"):
             try:

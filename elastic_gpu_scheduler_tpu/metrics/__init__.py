@@ -431,6 +431,56 @@ DEFRAG_RECOVERED = REGISTRY.register(
         "whole-chip placements",
     )
 )
+FLEET_ROUTED = REGISTRY.register(
+    Counter(
+        "tpu_fleet_routed_total",
+        "Front-door routing decisions by kind: affinity (prefix-digest "
+        "match), least_loaded (fallback), failover (first choice "
+        "unreachable, rerouted), aborted (relay broke after first "
+        "client byte — never retried), no_replica (every replica "
+        "down/draining → 503), exhausted (replicas looked routable but "
+        "every connect/forward failed → 502)",
+        ("kind",),
+    )
+)
+FLEET_ROUTE_OVERHEAD = REGISTRY.register(
+    Histogram(
+        "tpu_fleet_route_overhead_seconds",
+        "Router-added latency per request: route selection + backend "
+        "connect + request forward, EXCLUDING the backend's own "
+        "generation time (the relay loop is a byte pump; its cost is "
+        "per-burst, not per-token)",
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0),
+    )
+)
+FLEET_REPLICAS = REGISTRY.register(
+    Gauge(
+        "tpu_fleet_replicas",
+        "Replica-set size by health state (up/draining/down), refreshed "
+        "by the router's health loop",
+        ("state",),
+    )
+)
+FLEET_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_fleet_autoscaler_events_total",
+        "Autoscaler lifecycle events: scale_up/scale_down (executed), "
+        "scale_up_failed/scale_down_failed, hold (evaluation with no "
+        "action), cooldown_suppressed, bounds_suppressed, "
+        "resize_executed/resize_failed",
+        ("event",),
+    )
+)
+FLEET_SCALE_LATENCY = REGISTRY.register(
+    Histogram(
+        "tpu_fleet_scale_seconds",
+        "Wall time of one executed scale action (decision → gang "
+        "admission/release through the scheduler surface → replica "
+        "routable/drained)",
+        buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+    )
+)
 
 
 class _LockWaitHistogram(Histogram):

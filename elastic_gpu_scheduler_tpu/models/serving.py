@@ -40,7 +40,6 @@ capability slot of a complete framework.
 from __future__ import annotations
 
 import functools
-import hashlib
 import itertools
 import logging
 import queue
@@ -55,6 +54,7 @@ import numpy as np
 
 from ..ops.attention import NEG_INF
 from ..tracing import TRACER
+from ..utils import prefixdigest
 from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
@@ -1231,16 +1231,20 @@ def _prefix_page_key(prev: bytes, toks: np.ndarray) -> bytes:
     raw int32 bytes.  Content-addressing is preserved exactly: equal
     token prefixes (under the same adapter seed) produce equal digests,
     and 128-bit digests make accidental collisions (which would alias
-    cached K/V) negligible."""
-    return hashlib.blake2b(
-        prev + toks.tobytes(), digest_size=16
-    ).digest()
+    cached K/V) negligible.
+
+    The chain definition is SHARED with the fleet router
+    (utils/prefixdigest.py): the router computes the same digests over
+    incoming prompts to route a session to the replica already holding
+    its prefix — a drift between the two would silently turn affinity
+    routing into noise."""
+    return prefixdigest.prefix_page_key(prev, toks.tobytes())
 
 
 def _prefix_seed(adapter_id: int) -> bytes:
     """Chain seed: K/V content depends on the adapter (wk/wv deltas), so
     pages cached under one adapter must never match another's prompts."""
-    return b"lora:" + int(adapter_id).to_bytes(4, "little")
+    return prefixdigest.prefix_seed(adapter_id)
 
 
 def _bias_row_cached(req: "Request", vocab_size: int) -> np.ndarray:
@@ -1525,6 +1529,12 @@ class InferenceEngine:
         # observatory's throughput numerator — a host-side int add per
         # token, read by the engine loop off the device path)
         self.tokens_emitted = 0
+        # in-flight chunks discarded because their slot was released or
+        # re-tenanted between dispatch and drain (stop/cancel discovered
+        # late under overlap, spill, drain-for-migration).  THE observable
+        # behind the fleet/defrag "at most one lost in-flight chunk per
+        # moved pod" contract — tests and bench assert on its delta.
+        self.chunks_discarded = 0
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
@@ -2265,6 +2275,30 @@ class InferenceEngine:
         if self.draft is not None:
             self.draft_len[i] = 0  # rows rewrite lazily; no device work
 
+    def evict_slot(self, i: int, requeue: bool = True) -> None:
+        """Evict a live slot for a migration/resize pause (defrag hooks,
+        fleet/resize.py): free its pages and requeue the request for an
+        exact resume.  Unlike the in-step spill (``_maybe_spill``, which
+        runs between a chunk's dispatch and drain), an EXTERNAL eviction
+        can race an overlapped in-flight chunk — so this slot's stake in
+        the pending chunk is discarded FIRST.  Without that, a resumed
+        request re-admitted into the same slot index would receive the
+        stale chunk's tokens on top of its re-prefilled stream (the
+        (slot, request) identity pin cannot tell the two tenancies
+        apart).  The discarded chunk is the contract's bounded loss: at
+        most one per evicted slot, counted in ``chunks_discarded``."""
+        req = self.slots[i]
+        if req is None:
+            return
+        if self._pending is not None:
+            kept = [(s, r) for (s, r) in self._pending.pairs if s != i]
+            if len(kept) != len(self._pending.pairs):
+                self.chunks_discarded += 1
+                self._pending.pairs = kept
+        self._release_slot(i)
+        if requeue and not req.done.is_set():
+            self._enqueue(req)
+
     def _prepare_step(self, lookahead: int):
         """Host-side slot scan shared by BOTH step flavors (sequential
         chunk and speculative verify): release cancelled slots (before the
@@ -2962,6 +2996,7 @@ class InferenceEngine:
         self._last_drain_done = time.perf_counter_ns()
         for i, req in pending.pairs:
             if self.slots[i] is not req or req.done.is_set():
+                self.chunks_discarded += 1
                 continue  # released/re-tenanted since dispatch: discard
             pos = int(pending.pos0[i])
             plen = int(self.prompt_lens[i])

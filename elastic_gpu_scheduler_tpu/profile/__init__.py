@@ -74,7 +74,20 @@ __all__ = [
     "DEFAULT_WORKLOAD_CLASS",
     "PROFILER",
     "WorkloadProfiler",
+    "generation_preference",
 ]
+
+
+def generation_preference(profiles: dict, wclass: str) -> list:
+    """TPU generations ordered by ``wclass``'s measured tokens/s/chip,
+    best first, from a profiles dict (``Profiler.profiles()`` live, or a
+    journal-recorded snapshot offline) — THE ranking the fleet
+    autoscaler places scale-outs by; one definition so live and offline
+    scoring can never drift."""
+    tps = (profiles.get(wclass) or {}).get("tokens_per_sec_per_chip") or {}
+    return [
+        g for g, _ in sorted(tps.items(), key=lambda kv: (-kv[1], kv[0]))
+    ]
 
 PROFILE_TOKENS = REGISTRY.register(
     LazyGauge(
@@ -578,6 +591,14 @@ class WorkloadProfiler:
             cls: prof.as_dict()
             for cls, prof in sorted(self._profiles.items())
         }
+
+    def generation_preference(self, wclass: str) -> list:
+        """TPU generations ordered by this class's measured tokens/s/chip,
+        best first — the fleet autoscaler's scale-out placement signal
+        (Gavel's heterogeneity policy on live numbers).  Empty when the
+        class was never profiled (callers then keep the scheduler's own
+        score order)."""
+        return generation_preference(self.profiles(), wclass)
 
     def interference_matrix(self) -> dict:
         """{class: {neighbor: ratio}} — co-located tokens/s/chip divided

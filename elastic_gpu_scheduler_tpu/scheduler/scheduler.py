@@ -743,8 +743,12 @@ class TPUUnitScheduler(ResourceScheduler):
     # roll the WHOLE gang back to zero chips allocated / zero pods annotated
     # (SURVEY §7 hard part (b): assume-all-or-release).
 
-    def gang_allocate(self, node_name: str, pod: Pod) -> Option:
-        """In-memory allocation commit; reversed by ``gang_unallocate``."""
+    def gang_allocate(
+        self, node_name: str, pod: Pod, source: str = "gang"
+    ) -> Option:
+        """In-memory allocation commit; reversed by ``gang_unallocate``.
+        ``source`` labels the journal record (``gang`` for coordinator
+        commits, ``resize`` for live gang-membership grows)."""
         request = request_from_pod(pod)
         with self.lock:
             na = self._get_allocator(node_name)
@@ -764,10 +768,12 @@ class TPUUnitScheduler(ResourceScheduler):
             # serialize every concurrent verb (gang_note_bound refreshes
             # per node after commit; the frag field may be one step stale)
             self._journal_event("bind", pod, node_name, opt=opt,
-                                source="gang")
+                                source=source)
             return opt
 
-    def gang_apply_option(self, node_name: str, pod: Pod, opt: Option) -> None:
+    def gang_apply_option(
+        self, node_name: str, pod: Pod, opt: Option, source: str = "gang"
+    ) -> None:
         """Apply a PRE-PLANNED option (validating transact — raises
         ValueError if the placement was taken since planning).  Lets a gang
         commit skip the per-member trade DFS."""
@@ -781,9 +787,12 @@ class TPUUnitScheduler(ResourceScheduler):
             self.pod_maps[pod.key] = (node_name, opt)
             self.released_pods.pop(pod.key, None)
             self._journal_event("bind", pod, node_name, opt=opt,
-                                source="gang")
+                                source=source)
 
-    def gang_unallocate(self, node_name: str, pod: Pod, opt: Option) -> None:
+    def gang_unallocate(
+        self, node_name: str, pod: Pod, opt: Option,
+        source: str = "gang_rollback",
+    ) -> None:
         with self.lock:
             entry = self.pod_maps.pop(pod.key, None)
             if entry is None:
@@ -795,8 +804,7 @@ class TPUUnitScheduler(ResourceScheduler):
             if na is not None:
                 na.forget(opt)
             self._update_node_gauge(node_name)
-            self._journal_event("forget", pod, node_name,
-                                source="gang_rollback")
+            self._journal_event("forget", pod, node_name, source=source)
 
     def gang_annotate(
         self, pod: Pod, opt: Option, node_name: str, extra=None

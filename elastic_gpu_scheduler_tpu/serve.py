@@ -101,6 +101,11 @@ def build_args(argv=None):
                         "/debug/profiles and the tpu_workload_* metrics "
                         "serve the result; cost per sampled step is one "
                         "ring-buffer append off the device path")
+    p.add_argument("--replica-name", default="",
+                   help="fleet identity this replica reports in /v1/stats "
+                        "(default from POD_NAME; the front-door router "
+                        "keys its replica set and prefix-affinity map "
+                        "by it)")
     p.add_argument("--workload-class", default="",
                    help="profile class this pod's measured behavior "
                         "aggregates under (default from "
@@ -268,6 +273,11 @@ def main(argv=None) -> int:
         prefill_chunk=args.prefill_chunk,
         max_queue=args.max_queue, logprobs_k=args.logprobs_k,
         overlap=args.serve_overlap == "on",
+    )
+    # fleet identity (/v1/stats "replica"): the front-door router keys
+    # its replica set by this
+    engine.replica_name = (
+        args.replica_name or _os.environ.get("POD_NAME", "")
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     log.info(

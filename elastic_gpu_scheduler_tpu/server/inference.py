@@ -35,6 +35,8 @@ import json
 import logging
 import math
 import queue
+import select
+import socket as socket_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -540,6 +542,13 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                         for k, v in eng.host_gap_stats().items()
                     },
                     "device_uploads": int(eng.device_uploads),
+                    # fleet-facing fields: the router aligns its
+                    # prefix-affinity digest chain to page_size, and the
+                    # autoscaler/resize tooling watches discarded
+                    # in-flight chunks (the ≤1-per-moved-pod contract)
+                    "page_size": eng.page_size,
+                    "chunks_discarded": int(eng.chunks_discarded),
+                    "replica": getattr(eng, "replica_name", ""),
                 })
             return self._json(404, {"error": f"no route {self.path}"})
 
@@ -701,6 +710,28 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 out["error"] = "generation timed out"
             return self._json(code, out)
 
+        def _client_gone(self) -> bool:
+            """True when the client socket is closed or half-closed (EOF
+            or error on a zero-timeout peek).  Completion clients never
+            send bytes mid-stream, so readable-with-EOF IS the
+            disconnect signal; readable-with-data is left alone.  This
+            is how a stream whose engine is between tokens notices the
+            disconnect — the write path only surfaces a broken pipe
+            when there is a token to write.  ``poll`` (not ``select``):
+            select raises ValueError for fds >= FD_SETSIZE, which on a
+            busy server (>1024 open fds) would read as a phantom
+            disconnect and cancel healthy streams."""
+            try:
+                p = select.poll()
+                p.register(
+                    self.connection, select.POLLIN | select.POLLHUP
+                )
+                if not p.poll(0):
+                    return False
+                return self.connection.recv(1, socket_mod.MSG_PEEK) == b""
+            except OSError:
+                return True
+
         def _stream(self, reqs: list) -> None:
             # SSE: tokens are pushed from the ENGINE thread into a bounded
             # shared queue; this handler thread drains it to the socket,
@@ -773,6 +804,17 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             def chunk(payload: str) -> None:
                 chunk_many([payload])
 
+            half_closed = [False]  # client did shutdown(SHUT_WR); legal
+
+            def sse_ping() -> None:
+                # SSE comment (": ..." line) — spec-ignored by clients;
+                # used only to probe socket liveness after a read EOF
+                data = b": ping\n\n"
+                self.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                )
+                self.wfile.flush()
+
             def event_json(item) -> str:
                 k, tok, lp, top = item
                 ev = {"token": tok}
@@ -794,6 +836,27 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     except queue.Empty:
                         if all(r.done.is_set() for r in reqs) and q.empty():
                             break
+                        if not half_closed[0] and self._client_gone():
+                            # read-side EOF while IDLE (no token to write
+                            # would ever surface a broken pipe).  EOF is
+                            # ambiguous: a full close (dead client) or a
+                            # LEGAL half-close (shutdown(SHUT_WR), still
+                            # reading).  Disambiguate with an SSE comment
+                            # probe — invisible to clients, but a fully
+                            # closed socket raises by the second write
+                            # (the first may land in the send buffer
+                            # before the RST comes back).
+                            try:
+                                sse_ping()
+                                time.sleep(0.05)
+                                sse_ping()
+                                # half-closed but reading: keep streaming
+                                # and stop peeking (EOF is permanent)
+                                half_closed[0] = True
+                            except OSError:
+                                raise BrokenPipeError(
+                                    "client disconnected"
+                                ) from None
                         continue
                     events = _drain_burst(q, first)
                     chunk_many([event_json(e) for e in events])

@@ -262,6 +262,11 @@ the Python analogues):</p>
  — workload profiling observatory: per-class throughput/latency
  profiles, the (class, class) interference matrix, chip occupancy and
  the co-tenancy map (--profile-sample gates collection)</li>
+<li><a href="/debug/fleet">/debug/fleet</a>
+ — elastic serving fleet: replica set health/load, prefix-affinity hit
+ rate, autoscaler policy + last decision, resize history
+ (--fleet=router|auto starts it; the router's own port serves the same
+ payload at /debug/fleet)</li>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
@@ -422,6 +427,7 @@ class ExtenderServer:
         workers: int = 0,  # >0: pre-spawned pool sized for gang concurrency
         leader_check=None,  # callable → bool; None = always the leader
         defrag=None,  # optional defrag.DefragPlanner (plan preview + run)
+        fleet=None,  # optional fleet state provider (debug_state() dict)
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -429,6 +435,7 @@ class ExtenderServer:
         self.status_fn = status_fn
         self.preemption = preemption
         self.defrag = defrag
+        self.fleet = fleet
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -538,6 +545,22 @@ class ExtenderServer:
                 out["preview"] = self.defrag.preview(want=want)
             except Exception as e:
                 out["preview_error"] = str(e)
+            return 200, json.dumps(out, indent=1).encode(), "application/json"
+        if path == "/debug/fleet":
+            if self.fleet is None:
+                return (
+                    404,
+                    json.dumps({"error": "fleet not configured "
+                                         "(--fleet=router|auto)"}).encode(),
+                    "application/json",
+                )
+            try:
+                out = self.fleet.debug_state()
+            except Exception as e:
+                return (
+                    500, json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
             return 200, json.dumps(out, indent=1).encode(), "application/json"
         if path == "/debug/profiles":
             # the workload-profiling observatory (profile/): per-class

@@ -45,6 +45,15 @@ outcomes were previously visible only as aggregate histograms
   ``/traces`` shows WHEN each recorded profile snapshot was taken
   relative to the placements it will re-score.
 
+- **Fleet spans.**  The serving fleet (``fleet/``) extends the chain to
+  the front door: every routed request opens a ``fleet.route`` span
+  (replica, routing kind, hop overhead) as a child of the client's
+  traceparent, and ITS context becomes the backend request's header —
+  client → router → replica ``serve.request`` → ``engine.step`` is one
+  W3C trace.  Autoscaler actions trace as ``fleet.scale_up`` /
+  ``fleet.scale_down``; resize transactions as ``fleet.resize`` (the
+  ``resize`` journal record carries the trace id).
+
 The reference has none of this (its pprof mount is aggregate-only);
 contention-aware schedulers (BandPilot, Gavel — PAPERS.md) rely on
 exactly this per-decision provenance to debug placement quality.

@@ -1,0 +1,74 @@
+"""Rolling BLAKE2b prefix-digest chain — the prefix-cache content address.
+
+One definition, two consumers that MUST agree byte-for-byte:
+
+- the serving engine's automatic prefix cache (models/serving.py) keys
+  cached K/V pages by this chain (PR 4 replaced the nested-tuple hash
+  with it), and
+- the fleet front-door router (fleet/router.py) computes the same chain
+  over an incoming prompt to find the replica whose cache already holds
+  the longest matching prefix.
+
+The router lives in the scheduler plane (smoke tier — it must never
+import jax or numpy), while the engine hashes numpy int32 page slices;
+both reduce to the same raw little-int32 native byte layout, so
+``page_digests`` here and ``_match_prefix``/``_record_prefix`` in the
+engine produce identical digests for identical (adapter, token-prefix)
+pairs.  That identity is what makes router affinity an actual cache hit
+rather than a heuristic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from array import array
+from typing import Iterable
+
+__all__ = ["prefix_seed", "prefix_page_key", "page_digests"]
+
+
+def prefix_seed(adapter_id: int) -> bytes:
+    """Chain seed: K/V content depends on the adapter (wk/wv deltas), so
+    pages cached under one adapter must never match another's prompts."""
+    return b"lora:" + int(adapter_id).to_bytes(4, "little")
+
+
+def prefix_page_key(prev: bytes, toks_bytes: bytes) -> bytes:
+    """One link of the chain: a 16-byte BLAKE2b digest over (previous
+    link, this page's raw int32 token bytes).  128-bit digests make
+    accidental collisions (which would alias cached K/V — or misroute a
+    session) negligible."""
+    return hashlib.blake2b(prev + toks_bytes, digest_size=16).digest()
+
+
+def token_bytes(tokens: Iterable[int]) -> bytes:
+    """Native int32 byte layout — identical to ``np.int32 row.tobytes()``
+    on the engine side (both are the platform's native 32-bit ints)."""
+    return array("i", tokens).tobytes()
+
+
+def page_digests(
+    tokens, page_size: int, adapter_id: int = 0, max_pages: int = 0,
+    seed: bytes = b"",
+) -> list[bytes]:
+    """The digest chain for a token sequence: one digest per FULL page
+    (partial trailing pages are never cacheable, so they get no digest —
+    same rule as the engine's ``_record_prefix`` plen-1 cap caller).
+    ``max_pages`` > 0 bounds the work for very long prompts (the router
+    needs only enough links to discriminate replicas).  ``seed``
+    overrides the adapter-id seed — the router keys by adapter NAME
+    (it never sees bank indices); only equality semantics matter on its
+    side of the chain."""
+    ps = int(page_size)
+    if ps <= 0:
+        return []
+    toks = list(tokens)
+    n_pages = len(toks) // ps
+    if max_pages > 0:
+        n_pages = min(n_pages, max_pages)
+    key = seed or prefix_seed(adapter_id)
+    out: list[bytes] = []
+    for j in range(n_pages):
+        key = prefix_page_key(key, token_bytes(toks[j * ps:(j + 1) * ps]))
+        out.append(key)
+    return out

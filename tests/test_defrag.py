@@ -646,3 +646,147 @@ def test_never_fitting_gang_causes_zero_migrations():
     assert dict(sched.pod_maps) == ledger_before, (
         "futile unblock attempts moved live pods"
     )
+
+
+# -- live gang resize (fleet/resize.py) × drain/elastic-resume hooks --------
+#
+# The resize transaction rides this subsystem's primitives (journaled
+# binds/forgets through the gang split-phase methods, the migrate
+# machinery when a grow needs an unblocking round, and the
+# drain/elastic-resume hook contract extended to resharding), so its
+# invariants are pinned here with the planner's: randomized membership
+# churn must keep the journal replayable with zero violations, every
+# resize must bracket EVERY existing member with drain-before /
+# resume-after, and chips must move only WITH a member.
+
+
+def test_resize_churn_property_replay_clean(tmp_path):
+    """Property: a random grow/shrink/filler-churn sequence keeps (a)
+    the ledger's gang membership equal to the resizer's view, (b) every
+    member at the same whole-chip demand, and (c) journal replay clean
+    — every resize record's all-or-nothing + chip-conservation
+    invariants verified against the rebuilt state."""
+    from elastic_gpu_scheduler_tpu.fleet import GangResizer, member_chips
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    rng = random.Random(20260803)
+    events_log = []
+    try:
+        cluster, registry, predicate, bind, status, gang = fresh_stack(
+            n_nodes=4, chips=4, topo="2x2", defrag_mode="auto",
+        )
+        sched = registry[consts.RESOURCE_TPU_CORE]
+        clientset = FakeClientset(cluster)
+        hook_events = []
+        resizer = GangResizer(
+            sched, clientset,
+            hooks=[CallbackHook(
+                lambda k, n: hook_events.append(("drain", k)) or True,
+                lambda k, n: hook_events.append(("resume", k)),
+            )],
+            defrag=gang.defrag,
+        )
+        gkey = "default/rz"
+        serial = 0
+        # seed one member
+        p = tpu_pod("rz-0", core=100, gang="rz", gang_size=1)
+        cluster.create_pod(p)
+        sched.bind("node-0", p)
+        members = {"default/rz-0"}
+        fillers = []
+        resizes = 0
+        for _op in range(24):
+            roll = rng.random()
+            if roll < 0.35 and len(members) < 6:
+                serial += 1
+                np_ = tpu_pod(f"rz-{serial}", core=100, gang="rz",
+                              gang_size=1)
+                cluster.create_pod(np_)
+                hook_events.clear()
+                before = set(members)
+                out = resizer.grow(gkey, [np_])
+                resizes += 1
+                members.add(np_.key)
+                assert set(out["members"]) == members
+                # every PRE-EXISTING member drained before any resume
+                drains = [k for t, k in hook_events if t == "drain"]
+                resumes = [k for t, k in hook_events if t == "resume"]
+                assert set(drains) == before == set(resumes)
+                first_resume = next(
+                    (i for i, (t, _) in enumerate(hook_events)
+                     if t == "resume"), len(hook_events),
+                )
+                assert all(
+                    t != "drain" for t, _ in hook_events[first_resume:]
+                ), "a drain landed after a resume within one resize"
+            elif roll < 0.55 and len(members) > 1:
+                victim = rng.choice(sorted(members))
+                out = resizer.shrink(gkey, [victim])
+                resizes += 1
+                members.discard(victim)
+                assert set(out["members"]) == members
+            elif roll < 0.8:
+                serial += 1
+                f = tpu_pod(f"fill-{serial}", core=rng.choice([50, 100]))
+                cluster.create_pod(f)
+                ok, _ = sched.assume(
+                    [f"node-{i}" for i in range(4)], f
+                )
+                if ok:
+                    sched.bind(rng.choice(ok), f)
+                    fillers.append(f)
+            elif fillers:
+                f = fillers.pop(rng.randrange(len(fillers)))
+                sched.forget_pod(f, source="churn")
+            # ledger membership == resizer view, demand uniform
+            view = resizer.members(gkey)
+            assert set(view) == members
+            demands = {member_chips(opt) for _n, opt, _p in view.values()}
+            assert demands == {1}
+        assert JOURNAL.flush()
+        events_log = read_journal(str(tmp_path / "journal"))
+    finally:
+        JOURNAL.close()
+    res = replay(events_log)
+    assert res.resizes == resizes
+    assert not res.violations, res.violations[:5]
+    # the live state and the replayed state agree
+    assert not diff_live(res, status()), diff_live(res, status())
+
+
+def test_resize_grow_uses_defrag_unblock_round(tmp_path):
+    """A grow whose member fits nowhere triggers ONE defrag unblocking
+    round (journaled migrate records) and then succeeds — membership
+    change and migration compose through the same journal."""
+    from elastic_gpu_scheduler_tpu.fleet import GangResizer
+    from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    events = []
+    try:
+        cluster, registry, predicate, bind, status, gang = fresh_stack(
+            n_nodes=3, chips=4, topo="2x2", defrag_mode="auto",
+        )
+        sched = registry[consts.RESOURCE_TPU_CORE]
+        clientset = FakeClientset(cluster)
+        # fragment: 2 singles on every node → no node has 4 free chips
+        for i in range(3):
+            fill_singles(cluster, sched, f"node-{i}", 2, f"frag-{i}")
+        resizer = GangResizer(
+            sched, clientset, defrag=gang.defrag,
+        )
+        p0 = tpu_pod("big-0", core=400, gang="big", gang_size=1)
+        cluster.create_pod(p0)
+        out = resizer.grow("default/big", [p0])
+        assert out["members"] == ["default/big-0"]
+        assert out["chips_per_member"] == 4
+        assert JOURNAL.flush()
+        events = read_journal(str(tmp_path / "journal"))
+    finally:
+        JOURNAL.close()
+    migrates = [e for e in events if e["type"] == "migrate"]
+    assert migrates, "the unblocking round journaled no migrations"
+    res = replay(events)
+    assert res.resizes == 1
+    assert not res.violations, res.violations[:5]

@@ -1,0 +1,814 @@
+"""Elastic serving fleet (fleet/): router prefix-affinity + health +
+circuit breaker + relay reuse, autoscaler decisions + journaled `fleet`
+records + offline policy scoring, live gang resize transactions + the
+replay invariants (chip conservation, membership all-or-nothing).
+
+Smoke tier: no jax — replicas are tiny stdlib HTTP fakes speaking the
+/healthz + /v1/stats + /v1/completions (SSE) surface the real inference
+server exposes; the resize tests run the real scheduler plane over a
+FakeCluster."""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.fleet import (
+    Autoscaler,
+    FleetRouter,
+    GangResizer,
+    PolicyEngine,
+    Replica,
+    ReplicaSet,
+    ScalingPolicy,
+    SchedulerGangExecutor,
+    fold_signals,
+    generation_preference,
+    score_policy,
+)
+from elastic_gpu_scheduler_tpu.defrag.hooks import CallbackHook, RouterDrainHook
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+from elastic_gpu_scheduler_tpu.journal.replay import replay
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts, prefixdigest
+
+
+# -- fake serving replica ---------------------------------------------------
+
+
+class FakeReplicaServer:
+    """Stdlib stand-in for server/inference.py: answers /healthz and
+    /v1/stats, streams a completion as SSE (tokens echo the prompt),
+    and records every request body it saw."""
+
+    def __init__(self, name, queued=0, active_slots=0, max_batch=8,
+                 draining=False, fail_completions=False, slow_stream=0.0):
+        self.name = name
+        self.queued = queued
+        self.active_slots = active_slots
+        self.max_batch = max_batch
+        self.draining = draining
+        self.fail_completions = fail_completions
+        self.slow_stream = slow_stream  # s between SSE chunks
+        self.requests: list[dict] = []
+        self.stats_polls = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer.draining:
+                        return self._json(503, {"ok": False,
+                                                "draining": True})
+                    return self._json(200, {"ok": True})
+                if self.path == "/v1/stats":
+                    outer.stats_polls += 1
+                    return self._json(200, {
+                        "queued": outer.queued,
+                        "active_slots": outer.active_slots,
+                        "max_batch": outer.max_batch,
+                        "free_pages": 10, "total_pages": 16,
+                        "page_size": 4,
+                        "replica": outer.name,
+                    })
+                return self._json(404, {"error": "no route"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                body["_traceparent"] = self.headers.get("traceparent", "")
+                outer.requests.append(body)
+                if outer.fail_completions:
+                    return self._json(500, {"error": "boom"})
+                toks = body.get("prompt", [])[:4]
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if outer.slow_stream:
+                    # one chunk per token with a delay — lets a test
+                    # disconnect the client mid-stream
+                    try:
+                        for t in toks:
+                            ev = b"data: %b\n\n" % json.dumps(
+                                {"token": t}
+                            ).encode()
+                            self.wfile.write(
+                                b"%x\r\n%b\r\n" % (len(ev), ev)
+                            )
+                            self.wfile.flush()
+                            time.sleep(outer.slow_stream)
+                        self.wfile.write(
+                            b"10\r\ndata: [DONE]\n\n\r\n0\r\n\r\n"
+                        )
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                    return
+                payload = b"".join(
+                    b"data: %b\n\n" % json.dumps({"token": t}).encode()
+                    for t in toks
+                ) + b"data: [DONE]\n\n"
+                self.wfile.write(
+                    b"%x\r\n%b\r\n0\r\n\r\n" % (len(payload), payload)
+                )
+                self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def replica(self, relay=False):
+        return Replica(self.name, "127.0.0.1", self.port, relay=relay)
+
+
+class FakeRelayMonitor:
+    def __init__(self, up=True):
+        self.up = up
+        self.detail = "fake"
+
+
+def make_fleet(n=2, **replica_kw):
+    servers = [FakeReplicaServer(f"rep-{i}", **replica_kw) for i in range(n)]
+    rs = ReplicaSet(
+        interval_s=60.0,  # tests refresh() explicitly
+        probe_timeout_s=1.0,
+        breaker_threshold=2,
+        breaker_cooldown_s=0.2,
+        relay_monitor=FakeRelayMonitor(),
+    )
+    for s in servers:
+        rs.add(s.replica())
+    rs.refresh()
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=4)
+    return servers, rs, router
+
+
+def post_completion(port, body, traceparent=""):
+    """One POST /v1/completions through a raw socket; returns
+    (status, raw response bytes)."""
+    raw = json.dumps(body).encode()
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        req = (
+            f"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(raw)}\r\n"
+            + (f"traceparent: {traceparent}\r\n" if traceparent else "")
+            + "Connection: close\r\n\r\n"
+        ).encode() + raw
+        s.sendall(req)
+        buf = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+    status = int(buf.split(b" ", 2)[1])
+    return status, buf
+
+
+# -- router: affinity, fallback, pass-through -------------------------------
+
+
+def test_router_prefix_affinity_routes_to_same_replica():
+    servers, rs, router = make_fleet(3)
+    try:
+        port = router.start()
+        prompt = [7, 3, 9, 1, 4, 4, 2, 8]  # two full pages at page_size=4
+        st, _ = post_completion(port, {"prompt": prompt})
+        assert st == 200
+        first = next(s for s in servers if s.requests)
+        # same prefix, longer prompt → must land on the SAME replica
+        # regardless of load ordering
+        for other in servers:
+            if other is not first:
+                other.queued = 0
+        first.queued = 5  # least-loaded would pick someone else
+        rs.refresh()
+        st, _ = post_completion(port, {"prompt": prompt + [9, 9, 9]})
+        assert st == 200
+        assert len(first.requests) == 2
+        dbg = router.debug_state()
+        assert dbg["affinity"]["hits"] == 1
+        assert dbg["affinity"]["requests"] == 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_least_loaded_fallback_and_sse_passthrough():
+    servers, rs, router = make_fleet(2)
+    try:
+        servers[0].queued = 7
+        servers[1].queued = 0
+        rs.refresh()
+        port = router.start()
+        st, raw = post_completion(port, {"prompt": [1, 2]})  # no full page
+        assert st == 200
+        # SSE framing passed through verbatim
+        assert b"data: {\"token\": 1}" in raw and b"data: [DONE]" in raw
+        assert servers[1].requests and not servers[0].requests
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_affinity_chain_matches_engine_definition():
+    """The router's digest chain must equal the engine's page digests
+    (utils/prefixdigest is the shared definition)."""
+    _servers, _rs, router = make_fleet(1)
+    try:
+        digests = router._digests({"prompt": [5, 1, 9, 2, 7, 7, 7, 3]})
+        assert digests == prefixdigest.page_digests([5, 1, 9, 2, 7, 7, 7, 3], 4)
+        assert len(digests) == 2
+        # adapter-seeded chains never collide with the base chain
+        with_adapter = router._digests(
+            {"prompt": [5, 1, 9, 2, 7, 7, 7, 3], "adapter": "fr"}
+        )
+        assert with_adapter != digests
+    finally:
+        router.stop()
+        _rs.stop()
+        for s in _servers:
+            s.stop()
+
+
+def test_router_traceparent_hop_joins_chain():
+    servers, _rs, router = make_fleet(1)
+    try:
+        port = router.start()
+        client_tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        st, _ = post_completion(port, {"prompt": [1]}, traceparent=client_tp)
+        assert st == 200
+        seen = servers[0].requests[0]["_traceparent"]
+        # same trace id, NEW span id: the router hop is a span in the
+        # client's chain, not a blind header copy
+        assert seen.split("-")[1] == "ab" * 16
+        assert seen.split("-")[2] != "cd" * 8
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- router: health, draining, breaker, relay -------------------------------
+
+
+def test_draining_replica_gets_no_new_sessions():
+    servers, rs, router = make_fleet(2)
+    try:
+        servers[0].draining = True
+        rs.refresh()
+        assert rs.get("rep-0").state == "draining"
+        port = router.start()
+        for _ in range(3):
+            st, _ = post_completion(port, {"prompt": [1, 2, 3]})
+            assert st == 200
+        assert not servers[0].requests
+        assert len(servers[1].requests) == 3
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_relay_down_marks_relay_replicas_draining_without_probe():
+    """Satellite: router-visible health reuses RelayMonitor state — a
+    replica on a down relay drains IMMEDIATELY (no HTTP probe, no
+    timeout storm)."""
+    server = FakeReplicaServer("tpu-rep")
+    monitor = FakeRelayMonitor(up=False)
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=monitor)
+    rs.add(server.replica(relay=True))
+    try:
+        polls_before = server.stats_polls
+        t0 = time.perf_counter()
+        rs.refresh()
+        elapsed = time.perf_counter() - t0
+        r = rs.get("tpu-rep")
+        assert r.state == "draining"
+        assert "relay down" in r.state_reason
+        # resolved from monitor state: no HTTP round-trip, no timeout
+        assert server.stats_polls == polls_before
+        assert elapsed < 0.5
+        # relay back up → the normal probe path resumes
+        monitor.up = True
+        rs.refresh()
+        assert rs.get("tpu-rep").state == "up"
+    finally:
+        server.stop()
+
+
+def test_health_pass_does_not_clobber_pinned_drain():
+    """A scale-down/move drain is ROUTER-imposed: the backend stays
+    healthy by design, so a healthz-200 probe must not flip the victim
+    back to 'up' mid-drain (new sessions would race the release)."""
+    servers, rs, router = make_fleet(2)
+    try:
+        rs.drain("rep-0", reason="scale-down")
+        rs.refresh()  # backend answers healthz 200
+        r = rs.get("rep-0")
+        assert r.state == "draining"
+        assert r.pinned_draining
+        assert [x.name for x in router.replicas.routable()] == ["rep-1"]
+        rs.undrain("rep-0")
+        rs.refresh()
+        assert rs.get("rep-0").state == "up"
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_floor_restore_honors_total_cap_and_cooldown():
+    """All replicas draining (relay outage) must NOT admit a new pod
+    every tick: the floor restore caps on TOTAL replicas and respects
+    the up-cooldown."""
+    eng = PolicyEngine(ScalingPolicy(
+        min_replicas=2, max_replicas=3, up_cooldown_s=50.0,
+    ))
+    # 0 up, but 3 total (all draining) and at max → hold, not up
+    a, r = eng.evaluate(sig(), 0, now=0.0, total_replicas=3)
+    assert a == "hold" and "max_replicas" in r
+    # under the cap: the first restore fires...
+    a, _ = eng.evaluate(sig(), 0, now=1.0, total_replicas=1)
+    assert a == "up"
+    # ...but the next tick is cooldown-suppressed (no 1-pod-per-tick)
+    a, r = eng.evaluate(sig(), 0, now=2.0, total_replicas=2)
+    assert a == "hold" and "cooldown" in r
+    a, _ = eng.evaluate(sig(), 0, now=60.0, total_replicas=2)
+    assert a == "up"
+
+
+def test_circuit_breaker_opens_and_recovers():
+    servers, rs, router = make_fleet(2)
+    try:
+        servers[0].fail_completions = True
+        servers[0].queued = 0
+        servers[1].queued = 5  # breaker target is the preferred replica
+        rs.refresh()
+        port = router.start()
+        # each 5xx fails over to the healthy replica; two failures open
+        # the breaker (threshold=2)
+        for _ in range(2):
+            st, _ = post_completion(port, {"prompt": [1, 2]})
+            assert st == 200
+        assert rs.get("rep-0").state == "down"
+        assert len(servers[1].requests) == 2
+        # cooldown elapses + a healthy health pass closes the breaker
+        servers[0].fail_completions = False
+        time.sleep(0.25)
+        rs.refresh()
+        assert rs.get("rep-0").state == "up"
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_client_disconnect_mid_relay_never_fails_over():
+    """A client hanging up mid-SSE must not be retried on another
+    replica (duplicate generation) and must not feed the serving
+    replica's circuit breaker."""
+    servers, rs, router = make_fleet(2, slow_stream=0.15)
+    try:
+        servers[0].queued = 0
+        servers[1].queued = 9  # rep-0 is the deterministic first choice
+        rs.refresh()
+        port = router.start()
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        raw = json.dumps(
+            {"prompt": [1, 2, 3, 4], "max_tokens": 4, "stream": True}
+        ).encode()
+        s.sendall((
+            f"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n"
+        ).encode() + raw)
+        buf = b""
+        while b"data:" not in buf:
+            buf += s.recv(4096)
+        s.close()  # vanish mid-stream
+        time.sleep(0.8)  # the relay hits the dead socket and aborts
+        assert len(servers[0].requests) == 1
+        assert not servers[1].requests, "aborted relay was retried"
+        r0 = rs.get("rep-0")
+        assert r0.consecutive_failures == 0
+        assert r0.state == "up"
+        assert r0.inflight == 0
+    finally:
+        router.stop()
+        for sv in servers:
+            sv.stop()
+
+
+def test_all_replicas_down_is_503():
+    # one replica at a dead address, breaker threshold 1: the first
+    # health pass opens the breaker and routing answers 503 itself
+    rs = ReplicaSet(
+        interval_s=60.0, probe_timeout_s=0.2, breaker_threshold=1,
+        breaker_cooldown_s=30.0, relay_monitor=FakeRelayMonitor(),
+    )
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()  # nothing listens here anymore
+    rs.add(Replica("rep-0", "127.0.0.1", dead_port))
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=4)
+    try:
+        port = router.start()
+        rs.refresh()
+        assert rs.get("rep-0").state == "down"
+        st, _ = post_completion(port, {"prompt": [1]})
+        assert st == 503
+    finally:
+        router.stop()
+
+
+# -- autoscaler: policy engine ---------------------------------------------
+
+
+def sig(queue_per_replica=0.0, occupancy=0.0, page_util=0.0):
+    return {
+        "queue_per_replica": queue_per_replica,
+        "occupancy": occupancy,
+        "page_util": page_util,
+    }
+
+
+def test_policy_hysteresis_and_cooldown():
+    eng = PolicyEngine(ScalingPolicy(
+        queue_high=4.0, hysteresis_rounds=2, up_cooldown_s=100.0,
+        max_replicas=4,
+    ))
+    a1, _ = eng.evaluate(sig(queue_per_replica=9), 2, now=0.0)
+    assert a1 == "hold"  # first breach: hysteresis
+    a2, _ = eng.evaluate(sig(queue_per_replica=9), 2, now=1.0)
+    assert a2 == "up"
+    # cooldown suppresses the next breach pair
+    eng.evaluate(sig(queue_per_replica=9), 3, now=2.0)
+    a3, r3 = eng.evaluate(sig(queue_per_replica=9), 3, now=3.0)
+    assert a3 == "hold" and "cooldown" in r3
+    # past the cooldown the accumulated streak fires immediately
+    a4, _ = eng.evaluate(sig(queue_per_replica=9), 3, now=200.0)
+    assert a4 == "up"
+
+
+def test_policy_bounds_and_scale_down():
+    eng = PolicyEngine(ScalingPolicy(
+        min_replicas=1, max_replicas=2, hysteresis_rounds=1,
+        down_cooldown_s=0.0,
+    ))
+    a, r = eng.evaluate(sig(queue_per_replica=9), 2, now=0.0)
+    assert a == "hold" and "max_replicas" in r
+    a, _ = eng.evaluate(sig(), 2, now=1.0)
+    assert a == "down"
+    a, r = eng.evaluate(sig(), 1, now=2.0)
+    assert a == "hold" and "min_replicas" in r
+    # below the floor: restore immediately, no hysteresis
+    a, r = eng.evaluate(sig(), 0, now=3.0)
+    assert a == "up" and "below min_replicas" in r
+
+
+def test_fold_signals_and_generation_preference():
+    agg = fold_signals([
+        {"queued": 3, "active_slots": 2, "max_batch": 4,
+         "free_pages": 2, "total_pages": 8},
+        {"queued": 1, "active_slots": 4, "max_batch": 4,
+         "free_pages": 0, "total_pages": 8},
+    ])
+    assert agg["queued"] == 4 and agg["queue_per_replica"] == 2.0
+    assert agg["occupancy"] == 0.75
+    assert agg["page_util"] == 0.875
+    profiles = {"serve": {"tokens_per_sec_per_chip": {
+        "v5e": 1000.0, "v5p": 3000.0, "cpu": 10.0,
+    }}}
+    assert generation_preference(profiles, "serve") == ["v5p", "v5e", "cpu"]
+    assert generation_preference({}, "serve") == []
+
+
+# -- autoscaler: journaled decisions + offline scoring ----------------------
+
+
+class ListExecutor:
+    """Records decisions; scale_up registers a fake down replica."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+        self.ups = []
+        self.downs = []
+
+    def scale_up(self, reason, generation_pref):
+        name = f"scaled-{len(self.ups)}"
+        self.ups.append((reason, list(generation_pref)))
+        r = Replica(name, "127.0.0.1", 1)
+        self.replicas.add(r)
+        return name
+
+    def scale_down(self, name, reason):
+        self.downs.append(name)
+        self.replicas.remove(name)
+        return True
+
+
+def test_autoscaler_journals_fleet_records_and_scores_offline(tmp_path):
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    try:
+        rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+        r0 = rs.add(Replica("rep-0", "127.0.0.1", 1))
+        r0.stats = {"queued": 40, "active_slots": 4, "max_batch": 4,
+                    "free_pages": 0, "total_pages": 8}
+        ex = ListExecutor(rs)
+        a = Autoscaler(
+            rs, ex,
+            policy=ScalingPolicy(
+                queue_high=4.0, hysteresis_rounds=2, up_cooldown_s=0.0,
+                max_replicas=4, min_replicas=1,
+            ),
+            interval_s=60.0,
+        )
+        d1 = a.tick(now=0.0)
+        assert d1["action"] == "hold"  # hysteresis round 1
+        d2 = a.tick(now=1.0)
+        assert d2["action"] == "up" and d2["executed"]
+        assert ex.ups and rs.get("scaled-0") is not None
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    events = read_journal(str(tmp_path / "journal"))
+    fleet_recs = [e for e in events if e["type"] == "fleet"]
+    assert len(fleet_recs) == 2
+    assert fleet_recs[1]["action"] == "up"
+    assert fleet_recs[1]["executed"] is True
+    assert fleet_recs[1]["signals"]["queue_per_replica"] == 40.0
+
+    # replay counts them as annotations, zero violations/warnings
+    res = replay(events)
+    assert res.fleet_records == 2
+    assert not res.violations and not res.warnings
+
+    # offline scoring: the incumbent agrees with itself; a laxer
+    # candidate (higher watermark) would have held where it scaled
+    same = score_policy(events, ScalingPolicy(
+        queue_high=4.0, hysteresis_rounds=2, up_cooldown_s=0.0,
+        max_replicas=4, min_replicas=1,
+    ))
+    assert same["evaluations"] == 2
+    assert same["agreement_pct"] == 100.0
+    lax = score_policy(events, ScalingPolicy(
+        name="lax", queue_high=100.0, occupancy_high=2.0, page_high=2.0,
+        hysteresis_rounds=2,
+    ))
+    assert lax["candidate_decisions"]["up"] == 0
+    assert lax["agreement_pct"] < 100.0
+    assert lax["disagreements"]
+
+
+def test_autoscaler_scale_down_drains_first():
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    for i in range(2):
+        r = rs.add(Replica(f"rep-{i}", "127.0.0.1", 1))
+        r.stats = {"queued": 0, "active_slots": 0, "max_batch": 4}
+    states_at_scale_down = {}
+
+    class Ex(ListExecutor):
+        def scale_down(self, name, reason):
+            states_at_scale_down[name] = self.replicas.get(name).state
+            return super().scale_down(name, reason)
+
+    a = Autoscaler(
+        rs, Ex(rs),
+        policy=ScalingPolicy(
+            min_replicas=1, hysteresis_rounds=1, down_cooldown_s=0.0,
+        ),
+        interval_s=60.0,
+    )
+    d = a.tick(now=0.0)
+    assert d["action"] == "down" and d["executed"]
+    # the victim was draining BEFORE the executor released it
+    assert list(states_at_scale_down.values()) == ["draining"]
+    assert len(rs.all()) == 1
+
+
+# -- scheduler-surface executor + resize ------------------------------------
+
+
+def fleet_pod(name, core=400, gang=None, gang_size=0):
+    ann = {consts.ANNOTATION_WORKLOAD_CLASS: "serve"}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    return make_pod(
+        name,
+        containers=[Container(
+            name="main",
+            resources=ResourceRequirements(
+                limits={consts.RESOURCE_TPU_CORE: core}
+            ),
+        )],
+        annotations=ann,
+    )
+
+
+def scheduler_stack(generations=("v5e", "v5p")):
+    cluster = FakeCluster()
+    for i, gen in enumerate(generations):
+        cluster.add_node(make_tpu_node(
+            f"node-{gen}-{i}", chips=4, hbm_gib=64, accelerator=gen,
+        ))
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="binpack")
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+    )
+    port = server.start()
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    return cluster, clientset, sched, server, port
+
+
+def test_scheduler_executor_admits_via_http_and_prefers_generation():
+    cluster, clientset, sched, server, port = scheduler_stack()
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    try:
+        ex = SchedulerGangExecutor(
+            cluster, ("127.0.0.1", port), rs,
+            pod_factory=lambda serial: fleet_pod(f"fleet-{serial}"),
+            spawner=lambda pod, node: Replica(pod.metadata.name, "127.0.0.1", 1),
+        )
+        name = ex.scale_up("test", ["v5p", "v5e"])
+        assert name == "fleet-1"
+        node, _opt = sched.pod_maps["default/fleet-1"]
+        assert "v5p" in node  # generation preference honored
+        assert rs.get("fleet-1") is not None
+        # release: pod deleted + replica deregistered
+        assert ex.scale_down("fleet-1", "test")
+        assert rs.get("fleet-1") is None
+        with pytest.raises(Exception):
+            cluster.get_pod("default", "fleet-1")
+    finally:
+        server.stop()
+        rs.stop()
+
+
+def test_gang_resize_grow_shrink_journaled_with_clean_replay(tmp_path):
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    try:
+        cluster, clientset, sched, server, port = scheduler_stack(
+            generations=("v5e", "v5e")
+        )
+        try:
+            # seed gang: 2 members × 1 whole chip each (100 units/chip)
+            members = []
+            for i in range(2):
+                p = fleet_pod(f"g-{i}", core=100, gang="serve-gang",
+                              gang_size=2)
+                cluster.create_pod(p)
+                sched.bind(f"node-v5e-{i}", p)
+                members.append(p)
+            drains, resumes = [], []
+            resizer = GangResizer(
+                sched, clientset,
+                hooks=[CallbackHook(
+                    lambda k, n: drains.append(k) or True,
+                    lambda k, n: resumes.append(k),
+                )],
+            )
+            # grow by one
+            p2 = fleet_pod("g-2", core=100, gang="serve-gang", gang_size=2)
+            cluster.create_pod(p2)
+            out = resizer.grow("default/serve-gang", [p2])
+            assert out["members"] == [
+                "default/g-0", "default/g-1", "default/g-2",
+            ]
+            assert out["chips_per_member"] == 1
+            assert "default/g-2" in sched.pod_maps
+            # existing members were drained and resumed around the grow
+            assert set(drains) == {"default/g-0", "default/g-1"}
+            assert set(resumes) == {"default/g-0", "default/g-1"}
+            # shrink the one we grew
+            out = resizer.shrink("default/serve-gang", ["default/g-2"])
+            assert out["members"] == ["default/g-0", "default/g-1"]
+            assert "default/g-2" not in sched.pod_maps
+            assert JOURNAL.flush()
+        finally:
+            server.stop()
+    finally:
+        JOURNAL.close()
+    events = read_journal(str(tmp_path / "journal"))
+    resizes = [e for e in events if e["type"] == "resize"]
+    assert len(resizes) == 2
+    assert resizes[0]["source"] == "grow"
+    assert resizes[1]["source"] == "shrink"
+    res = replay(events)
+    assert res.resizes == 2
+    assert not res.violations, res.violations
+
+
+def test_resize_grow_all_or_nothing_rollback(tmp_path):
+    """A grow that cannot place its second member must leave NO trace of
+    its first (journaled rollback; replay stays clean)."""
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    try:
+        cluster, clientset, sched, server, port = scheduler_stack(
+            generations=("v5e",)
+        )
+        try:
+            p0 = fleet_pod("g-0", core=100, gang="g", gang_size=1)
+            cluster.create_pod(p0)
+            sched.bind("node-v5e-0", p0)
+            resizer = GangResizer(sched, clientset)
+            # node has 4 chips, 1 used: first new member (3 chips) fits,
+            # second (3 chips) cannot → whole grow must roll back
+            n1 = fleet_pod("g-1", core=300, gang="g", gang_size=1)
+            n2 = fleet_pod("g-2", core=300, gang="g", gang_size=1)
+            cluster.create_pod(n1)
+            cluster.create_pod(n2)
+            with pytest.raises(RuntimeError, match="rolled back"):
+                resizer.grow("default/g", [n1, n2])
+            assert "default/g-1" not in sched.pod_maps
+            assert "default/g-2" not in sched.pod_maps
+            assert JOURNAL.flush()
+        finally:
+            server.stop()
+    finally:
+        JOURNAL.close()
+    events = read_journal(str(tmp_path / "journal"))
+    # no resize record was committed, and the bind/forget pair balances
+    assert not [e for e in events if e["type"] == "resize"]
+    res = replay(events)
+    assert not res.violations, res.violations
+
+
+def test_resize_record_invariant_catches_tampering(tmp_path):
+    """A resize record whose declared membership does not match the
+    stream's state must trip the replay invariant."""
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    try:
+        cluster, clientset, sched, server, port = scheduler_stack(
+            generations=("v5e",)
+        )
+        try:
+            p0 = fleet_pod("g-0", core=100, gang="g", gang_size=1)
+            cluster.create_pod(p0)
+            sched.bind("node-v5e-0", p0)
+            # a resize record claiming a phantom member and wrong chips
+            JOURNAL.record(
+                "resize", gang="default/g",
+                members=["default/g-0", "default/phantom"],
+                chips_per_member=2, source="grow",
+            )
+            assert JOURNAL.flush()
+        finally:
+            server.stop()
+    finally:
+        JOURNAL.close()
+    events = read_journal(str(tmp_path / "journal"))
+    res = replay(events)
+    joined = "\n".join(res.violations)
+    assert "all-or-nothing" in joined
+    assert "chips not conserved" in joined
+
+
+def test_router_drain_hook_brackets_moves():
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    rs.add(Replica("default/pod-a", "127.0.0.1", 1))
+    hook = RouterDrainHook(rs)
+    hook.drain("default/pod-a", "node-0")
+    assert rs.get("default/pod-a").state == "draining"
+    hook.resume("default/pod-a", "node-0")
+    assert rs.get("default/pod-a").state == "up"

@@ -201,3 +201,147 @@ def test_min_tokens_suppression_under_speculation():
         assert stop not in r.output[:6]
         outs.append(list(r.output))
     assert outs[0] == outs[1]
+
+
+# -- client disconnect mid-SSE-stream (server/inference.py) -----------------
+#
+# A dead client must release its engine slot promptly (cancel at the
+# next chunk boundary → pages freed, slot re-tenantable) instead of
+# decoding to completion into a closed socket.  Two detection paths:
+# the write path surfaces a broken pipe once a token burst hits the
+# RST, and the idle path (no token to write — request still queued or
+# engine between chunks) peeks the socket for EOF.
+
+
+def _stream_socket(port, body):
+    import json as _json
+    import socket as _socket
+
+    raw = _json.dumps(body).encode()
+    s = _socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(
+        (
+            f"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(raw)}\r\n\r\n"
+        ).encode()
+        + raw
+    )
+    return s
+
+
+def test_client_disconnect_mid_stream_releases_slot_promptly():
+    import time
+
+    from elastic_gpu_scheduler_tpu.server.inference import serve_inference
+    from tests.conftest import poll
+
+    eng = InferenceEngine(
+        init_params(jax.random.key(0), CFG), CFG, max_batch=2,
+        max_len=1024, page_size=16,
+    )
+    server, loop = serve_inference(eng, port=0, host="127.0.0.1")
+    try:
+        s = _stream_socket(
+            server.server_address[1],
+            {"prompt": [3, 9, 14], "max_tokens": 900, "stream": True},
+        )
+        # read until tokens are actually flowing, then vanish abruptly
+        buf = b""
+        while buf.count(b"data:") < 3:
+            buf += s.recv(4096)
+        s.close()
+        assert poll(
+            lambda: all(sl is None for sl in eng.slots), timeout=20
+        ), "slot not released after client disconnect"
+        emitted = eng.tokens_emitted
+        assert emitted < 900, (
+            f"engine decoded {emitted} tokens for a dead client"
+        )
+        # the slot is immediately re-tenantable: a fresh request runs
+        r = eng.submit(Request(prompt=[2, 4, 6], max_new_tokens=5))
+        assert r.done.wait(60) and not r.error
+    finally:
+        server.shutdown()
+        loop.stop()
+
+
+def test_queued_request_disconnect_detected_without_any_token():
+    """The idle-path peek: a stream whose request is still QUEUED (slot
+    pool full) has no token traffic to surface a broken pipe — the
+    handler must notice the EOF on its own and cancel before the
+    request ever occupies a slot."""
+    import time
+
+    from elastic_gpu_scheduler_tpu.server.inference import serve_inference
+    from tests.conftest import poll
+
+    eng = InferenceEngine(
+        init_params(jax.random.key(0), CFG), CFG, max_batch=1,
+        max_len=1024, page_size=16,
+    )
+    server, loop = serve_inference(eng, port=0, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        s1 = _stream_socket(
+            port, {"prompt": [3, 9, 14], "max_tokens": 700, "stream": True},
+        )
+        buf = b""
+        while buf.count(b"data:") < 2:  # slot 0 is busy streaming
+            buf += s1.recv(4096)
+        s2 = _stream_socket(
+            port, {"prompt": [2, 4, 6], "max_tokens": 700, "stream": True},
+        )
+        time.sleep(0.3)  # s2's request reaches the queue (no slot free)
+        assert eng.queue.qsize() >= 1
+        baseline2 = eng.tokens_emitted
+        s2.close()  # disconnect while QUEUED: zero tokens ever written
+        # the handler's idle peek cancels it; the queued entry purges
+        # without ever decoding
+        assert poll(
+            lambda: eng.queue.qsize() == 0, timeout=20
+        ), "cancelled queued request never purged"
+        s1.close()
+        assert poll(
+            lambda: all(sl is None for sl in eng.slots), timeout=20
+        )
+        assert eng.tokens_emitted < baseline2 + 700, (
+            "queued request decoded for a dead client"
+        )
+    finally:
+        server.shutdown()
+        loop.stop()
+
+
+def test_half_closed_client_still_receives_full_stream():
+    """A client that legally half-closes (shutdown(SHUT_WR)) after
+    sending its request but keeps reading must receive the FULL stream:
+    read-side EOF alone is not a disconnect (the SSE comment probe
+    disambiguates it from a dead socket)."""
+    import socket as _socket
+
+    from elastic_gpu_scheduler_tpu.server.inference import serve_inference
+
+    eng = InferenceEngine(
+        init_params(jax.random.key(0), CFG), CFG, max_batch=2,
+        max_len=256, page_size=16,
+    )
+    server, loop = serve_inference(eng, port=0, host="127.0.0.1")
+    try:
+        s = _stream_socket(
+            server.server_address[1],
+            {"prompt": [3, 9, 14], "max_tokens": 24, "stream": True},
+        )
+        s.shutdown(_socket.SHUT_WR)  # half-close: done sending, still reading
+        buf = b""
+        s.settimeout(120)
+        while b"data: [DONE]" not in buf:
+            b = s.recv(4096)
+            if not b:
+                break
+            buf += b
+        s.close()
+        assert b"data: [DONE]" in buf, "half-closed client lost its stream"
+        assert buf.count(b'"token"') == 24
+    finally:
+        server.shutdown()
+        loop.stop()
