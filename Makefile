@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -74,6 +74,15 @@ check-profile:
 # + resize invariants), and the router's hop p99 is within budget.
 check-fleet:
 	JAX_PLATFORMS=cpu python tools/check_fleet.py
+
+# Cluster-scale gate: seeded 10k-node fleet soak (capacity index + batch
+# admission sweep + journal on); hard-fails on any index/oracle
+# divergence (entry audit, sampled filter/score verb parity, batch sweep
+# vs per-gang plan equality), a journal replay that trips violations or
+# rebuilds a different index, a bind-p99 budget breach (storm-trimmed,
+# ×3 attempts), or a batch sweep slower than the per-gang loop.
+check-cluster-scale:
+	python tools/check_cluster_scale.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

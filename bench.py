@@ -683,6 +683,218 @@ def profile_bench(chunks: int = 30, chunk_n: int = 40) -> dict:
     }
 
 
+def cluster_bench(
+    nodes_n: int | None = None,
+    seed: int | None = None,
+    cycles: int | None = None,
+) -> dict:
+    """Cluster-scale section: the placement path at O(10k) synthetic nodes
+    (ROADMAP item 1).  Direct engine verbs, not HTTP — the wire/parse cost
+    is covered by the cfg sections; at 10k candidates a JSON body per verb
+    would measure the serializer, and the algorithmic margin is what this
+    section gates.
+
+    Emits:
+      cluster_bind_p99_ms        p99 of a full filter→score→bind cycle with
+                                 the 10k-node candidate list (index on)
+      cluster_gang_sweep_ms      batch admission sweep planning the pending
+                                 gang queue in one pass
+      cluster_gang_pergang_ms    the per-gang loop it replaces (same gangs,
+                                 same order, sequential plans)
+      cluster_gang256_plan_ms    one 256-member whole-chip gang planned at
+                                 fleet scale
+      cluster_index_hit_pct      candidate evaluations answered by the
+                                 index without a per-node search
+      cluster_index_speedup      full-rescan oracle score verb wall ÷
+                                 index-backed wall (acceptance: ≥5×)
+    plus budgets (env-overridable, per-box calibrated like the plan
+    budget).  Seeded + deterministic; tools/check_cluster_scale.py runs
+    the same fleet with divergence audits and hard-fails."""
+    import random as _random
+
+    from tools.fleetgen import make_fleet
+    from elastic_gpu_scheduler_tpu.core.request import TPURequest, TPUUnit
+
+    nodes_n = nodes_n or int(os.environ.get("BENCH_CLUSTER_NODES", "10000"))
+    seed = seed or int(os.environ.get("BENCH_CLUSTER_SEED", "20260804"))
+    cycles = cycles or int(os.environ.get("BENCH_CLUSTER_CYCLES", "150"))
+    rng = _random.Random(seed)
+    out: dict = {}
+
+    cluster = FakeCluster()
+    names = make_fleet(cluster, nodes=nodes_n, seed=seed)
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="binpack",
+                    gang_timeout=300.0)
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    out["cluster_nodes"] = len(names)
+
+    t0 = time.perf_counter()
+    sched.get_allocators(names)  # one cold allocator build per node
+    sched.index.fold()
+    out["cluster_prewarm_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+    # -- load phase: fill ~60% of hosts (whole-host pods) + a fractional
+    # tail, so prefilter/score work against a realistically mixed fleet
+    pod_serial = [0]
+
+    def _mkpod(core):
+        pod_serial[0] += 1
+        p = tpu_pod(f"cl-{pod_serial[0]}", core=core)
+        cluster.create_pod(p)
+        return p
+
+    filled = rng.sample(names, int(len(names) * 0.55))
+    for n in filled:
+        na = sched.allocators.get(n)
+        chips = na.chips.num_chips if na is not None else 4
+        try:
+            sched.bind(n, _mkpod(chips * 100))
+        except Exception:
+            pass
+    for n in rng.sample(names, max(1, len(names) // 10)):
+        try:
+            sched.bind(n, _mkpod(50))
+        except Exception:
+            pass
+
+    # -- index vs full-rescan oracle on the score path (same pods, fresh
+    # request hashes per trial; interleaved so throttling storms hit both)
+    idx_ms: list = []
+    oracle_ms: list = []
+    for trial in range(3):
+        p = tpu_pod(f"probe-idx-{trial}", core=100)
+        t0 = time.perf_counter()
+        sched.score(names, p)
+        idx_ms.append((time.perf_counter() - t0) * 1000)
+        p = tpu_pod(f"probe-orc-{trial}", core=100)
+        saved, sched.index = sched.index, None
+        try:
+            t0 = time.perf_counter()
+            sched.score(names, p)
+            oracle_ms.append((time.perf_counter() - t0) * 1000)
+        finally:
+            sched.index = saved
+    out["cluster_prefilter_index_ms"] = round(min(idx_ms), 3)
+    out["cluster_prefilter_oracle_ms"] = round(min(oracle_ms), 3)
+    out["cluster_index_speedup"] = round(
+        min(oracle_ms) / max(min(idx_ms), 1e-6), 1
+    )
+
+    # -- bind p99: full filter→score→bind cycles against the full
+    # candidate list, with churn (forgets) mixed in
+    cycle_ms: list = []
+    ref_ms: list = []
+    bound: list = []
+    for i in range(cycles):
+        if i % 50 == 0:
+            ref_ms.append(plan_reference_trial_ms())
+        if bound and rng.random() < 0.3:
+            sched.forget_pod(bound.pop(rng.randrange(len(bound))))
+        p = _mkpod(100)
+        t0 = time.perf_counter()
+        ok, _failed = sched.assume(names, p)
+        if not ok:
+            continue
+        scores = sched.score(ok[:256], p)
+        best = ok[max(range(len(scores)), key=scores.__getitem__)]
+        sched.bind(best, p)
+        cycle_ms.append((time.perf_counter() - t0) * 1000)
+        bound.append(p)
+    out["cluster_bind_p99_ms"] = round(p99(cycle_ms), 3)
+    out["cluster_bind_p50_ms"] = round(
+        sorted(cycle_ms)[len(cycle_ms) // 2], 3
+    ) if cycle_ms else 0.0
+    out["cluster_cycles"] = len(cycle_ms)
+
+    # -- gang admission: one 256-member gang, then the batch sweep vs the
+    # per-gang loop over a pending queue
+    def gang_req(tag, members, chips):
+        return TPURequest(
+            pod_uid=f"bench-{tag}", pod_key=f"bench/{tag}",
+            units=(TPUUnit(core=0, hbm=0, chip_count=chips),),
+            container_names=("main",),
+            gang_name=tag, gang_size=members,
+        )
+
+    t0 = time.perf_counter()
+    plan256 = gang._plan(sched, gang_req("g256", 256, 4), list(names))
+    out["cluster_gang256_plan_ms"] = round(
+        (time.perf_counter() - t0) * 1000, 3
+    )
+    out["cluster_gang256_planned"] = plan256 is not None
+    with gang._lock:
+        gang._plans.clear()
+
+    queue = [("bench/q%d" % i, gang_req("q%d" % i, 32, 4), list(names))
+             for i in range(8)]
+    t0 = time.perf_counter()
+    for gkey, req, cand in queue:  # the per-gang loop the sweep replaces
+        planned = gang._plan(sched, req, cand)
+        if planned is not None:
+            planned.created = time.monotonic()
+            planned.member_units = req.units
+            planned.member_containers = req.container_names
+            planned.slot_units = [req.units] * len(planned.slots)
+            planned.slot_containers = (
+                [req.container_names] * len(planned.slots)
+            )
+            with gang._lock:
+                gang._plans[gkey] = planned
+    pergang_ms = (time.perf_counter() - t0) * 1000
+    with gang._lock:
+        pergang_slots = {
+            k: list(p.slots) for k, p in gang._plans.items()
+        }
+        gang._plans.clear()
+    t0 = time.perf_counter()
+    swept = gang.plan_batch(sched, queue)
+    sweep_ms = (time.perf_counter() - t0) * 1000
+    sweep_slots = {
+        k: list(p.slots) for k, p in swept.items() if p is not None
+    }
+    with gang._lock:
+        gang._plans.clear()
+    out["cluster_gang_pergang_ms"] = round(pergang_ms, 3)
+    out["cluster_gang_sweep_ms"] = round(sweep_ms, 3)
+    out["cluster_gang_sweep_parity"] = pergang_slots == sweep_slots
+    out["cluster_index_hit_pct"] = sched.index.stats()["hit_pct"]
+
+    # -- budgets: env-overridable, scaled by the per-box CPU reference
+    # like the plan budget (a throttled box must not false-alarm)
+    ref_ms.append(plan_reference_trial_ms())
+    bind_base = float(os.environ.get("BENCH_CLUSTER_BIND_BUDGET_MS", "50"))
+    sweep_base = float(
+        os.environ.get("BENCH_CLUSTER_SWEEP_BUDGET_MS", "2000")
+    )
+    bind_budget, ref_min, scale = calibrated_plan_budget(bind_base, ref_ms)
+    sweep_budget = sweep_base * max(1.0, scale)
+    out["cluster_bind_budget_ms"] = round(bind_budget, 3)
+    out["cluster_sweep_budget_ms"] = round(sweep_budget, 3)
+    out["cluster_budget_scale"] = round(scale, 3)
+    if out["cluster_bind_p99_ms"] > bind_budget:
+        out["cluster_bind_over_budget"] = True
+        print(
+            f"# WARNING: cluster bind p99 {out['cluster_bind_p99_ms']}ms "
+            f"exceeds {bind_budget:.0f}ms budget", file=sys.stderr,
+        )
+    if out["cluster_gang_sweep_ms"] > sweep_budget:
+        out["cluster_sweep_over_budget"] = True
+        print(
+            f"# WARNING: cluster gang sweep {out['cluster_gang_sweep_ms']}"
+            f"ms exceeds {sweep_budget:.0f}ms budget", file=sys.stderr,
+        )
+    if out["cluster_index_speedup"] < 5.0:
+        out["cluster_speedup_under_target"] = True
+        print(
+            f"# WARNING: index speedup {out['cluster_index_speedup']}x "
+            "under the 5x acceptance floor", file=sys.stderr,
+        )
+    return out
+
+
 def chip_peak_tflops_bf16() -> float:
     """Detected chip's bf16 peak (TFLOPS) for MFU accounting."""
     import jax
@@ -2106,6 +2318,15 @@ def main():
         results.update(fleet_bench_cpu())
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["fleet_bench_error"] = str(e)[:300]
+
+    # cluster-scale placement: 10k synthetic nodes through the capacity
+    # index + batch admission sweep (BENCH_CLUSTER=0 skips; node count via
+    # BENCH_CLUSTER_NODES).  Guarded like the journal bench.
+    if os.environ.get("BENCH_CLUSTER", "1") != "0":
+        try:
+            results.update(cluster_bench())
+        except Exception as e:  # noqa: BLE001 — report, keep the artifact
+            results["cluster_bench_error"] = str(e)[:300]
 
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`

@@ -42,6 +42,9 @@ def build_stack(
     modes: tuple[str, ...] = ("tpushare",),
     workers: int = 1,
     gang_timeout: float = 30.0,
+    gang_batch_window: float = 0.0,
+    gang_batch_min: int = 4,
+    placement_index: bool = True,
     defrag_mode: str = "off",
     defrag_threshold: float = 0.5,
     defrag_max_moves: int = 8,
@@ -56,9 +59,14 @@ def build_stack(
 
     get_placement()
     rater = get_rater(priority)
-    config = SchedulerConfig(clientset=clientset, rater=rater)
+    config = SchedulerConfig(
+        clientset=clientset, rater=rater, placement_index=placement_index,
+    )
     registry = build_resource_schedulers(list(modes), config)
-    gang = GangCoordinator(clientset, timeout=gang_timeout)
+    gang = GangCoordinator(
+        clientset, timeout=gang_timeout,
+        batch_window_s=gang_batch_window, batch_min=gang_batch_min,
+    )
     # defrag planner: always constructed (the /debug/defrag preview and
     # manual POST /defrag/run work in every mode); 'off' costs one
     # attribute check on the gang filter's infeasible path and nothing
@@ -81,14 +89,18 @@ def build_stack(
     if cluster is not None:
         controller = Controller(cluster, registry, workers=workers)
 
-    def status():
+    def status(summary: bool = False, top_k: int = 10,
+               generations: bool = False):
         seen = []
         out = []
         for sched in registry.values():
             if id(sched) in seen:
                 continue
             seen.append(id(sched))
-            out.append(sched.status())
+            out.append(
+                sched.status_summary(top_k=top_k, generations=generations)
+                if summary else sched.status()
+            )
         return {"schedulers": out, "gangs": gang.status()}
 
     return registry, predicate, prioritize, bind, controller, status, gang
@@ -121,6 +133,27 @@ def main(argv=None) -> int:
         help="controller worker threads",
     )
     p.add_argument("--gang-timeout", type=float, default=30.0)
+    p.add_argument(
+        "--gang-batch-window", type=float, default=0.0,
+        help="batch admission sweep: a gang's first member parks up to "
+        "this many seconds collecting other pending gangs, then ONE "
+        "sweep plans the whole queue (shared clones, one reservation "
+        "replay, multi-gang plan_gang_batch kernel calls).  0 (default) "
+        "= plan each gang on arrival",
+    )
+    p.add_argument(
+        "--gang-batch-min", type=int, default=4,
+        help="end the batch window early once this many gangs are "
+        "pending",
+    )
+    p.add_argument(
+        "--placement-index", default="on", choices=["on", "off"],
+        help="incremental free-capacity index: O(1) candidate rejection "
+        "+ one placement probe per congruent node class on filter/score, "
+        "index-fed gang-plan prefilter, dirty-node-only fragmentation "
+        "refresh.  off = the full-rescan path everywhere (parity "
+        "baseline; see OPERATIONS.md 'Cluster scale')",
+    )
     p.add_argument("--tls-cert", default="", help="serve HTTPS with this cert")
     p.add_argument("--tls-key", default="")
     p.add_argument(
@@ -354,6 +387,9 @@ def main(argv=None) -> int:
         modes=tuple(m for m in args.mode.split(",") if m),
         workers=args.threadness,
         gang_timeout=args.gang_timeout,
+        gang_batch_window=args.gang_batch_window,
+        gang_batch_min=args.gang_batch_min,
+        placement_index=args.placement_index != "off",
         defrag_mode=args.defrag,
         defrag_threshold=args.defrag_threshold,
         defrag_max_moves=args.defrag_max_moves,

@@ -34,6 +34,15 @@ from .topology import Coord, Topology, bounding_box
 # we keep best-so-far semantics under a budget so worst-case latency is capped.
 DEFAULT_SEARCH_BUDGET = 4096
 
+# Globally-unique, monotone mutation stamps for ChipSet.version: every
+# committed mutation (and every fresh ChipSet) draws a new value, so equal
+# versions mean "the very same object, untouched since" — even across a
+# refresh_from_node that swapped the ChipSet out wholesale.  next() on an
+# itertools.count is a single GIL-atomic C call.
+import itertools as _itertools
+
+_VERSIONS = _itertools.count(1)
+
 
 @dataclass(frozen=True)
 class ContainerAlloc:
@@ -209,10 +218,22 @@ class ChipSet:
         self._core_avail: list[int] = [c.core_avail for c in ordered]
         self._hbm_avail: list[int] = [c.hbm_avail for c in ordered]
         self._geom = None  # lazy relative-geometry token (plan_key)
+        # mutation stamp: refreshed (from the global counter) by every
+        # _set_slot/_set_total and at construction, copied by clone() (a
+        # clone's mutations never touch the parent).  The capacity index
+        # (core/index.py) records it per entry and skips re-deriving a
+        # node whose stamp hasn't moved — a GIL-atomic int read replaces
+        # a lock + box scan for spuriously-dirtied nodes.
+        self._version = 0
         self._resync()
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     def _resync(self) -> None:
         """Rebuild bitsets + sums from the arrays (construction / refresh)."""
+        self._version = next(_VERSIONS)
         free = 0
         for i in range(len(self._coords)):
             if (
@@ -229,6 +250,7 @@ class ChipSet:
     def _set_slot(self, i: int, core_avail: int, hbm_avail: int) -> None:
         """THE mutation choke point: every chip-state change lands here so
         the bitsets and sums can never drift from the arrays."""
+        self._version = next(_VERSIONS)
         self._avail_core_sum += core_avail - self._core_avail[i]
         self._avail_hbm_sum += hbm_avail - self._hbm_avail[i]
         self._core_avail[i] = core_avail
@@ -282,6 +304,7 @@ class ChipSet:
     def clone(self) -> "ChipSet":
         new = ChipSet.__new__(ChipSet)
         new.topo = self.topo
+        new._version = self._version
         # immutable identity: shared across the whole clone lineage
         new._coords = self._coords
         new._slot = self._slot
@@ -769,4 +792,48 @@ def plan_gang_fallback(
         taken = set(idxs)
         remaining[cursor] = [i for i in free_idx if i not in taken]
         out.append((cursor, idxs, contiguous))
+    return out
+
+
+def plan_gang_batch_fallback(
+    topo: Topology,
+    free_lists: list[tuple[int, ...]],
+    specs: list[tuple[int, int]],
+    max_candidates: int = 64,
+) -> list[list[tuple[int, tuple[int, ...], bool]]]:
+    """Pure-Python batch gang-plan kernel: plan a QUEUE of gangs — one
+    ``(count, members)`` spec per gang, in arrival order — against one set
+    of per-node free lists, each gang consuming what the previous placed.
+
+    Semantics are EXACTLY sequential ``plan_gang`` calls with the free
+    lists carried forward, all-or-nothing per spec: a spec that cannot
+    place every member consumes NOTHING (its partial placements are
+    discarded), is returned as an empty list, and — because later gangs'
+    placements must not be derived from capacity an earlier failed gang
+    would have consumed in a sequential replan — processing STOPS there:
+    every later spec is returned empty and unconsumed, for the caller to
+    re-plan through the general path.  The native kernel
+    (native/placement.cc plan_gang_batch) is bit-identical;
+    tests/test_cluster_index.py asserts it.
+    """
+    remaining: list[tuple[int, ...]] = [tuple(sorted(f)) for f in free_lists]
+    out: list[list[tuple[int, tuple[int, ...], bool]]] = []
+    failed = False
+    for count, members in specs:
+        if failed:
+            out.append([])
+            continue
+        placed = plan_gang_fallback(
+            topo, list(remaining), count, members, max_candidates
+        )
+        if len(placed) < members:
+            out.append([])
+            failed = True
+            continue
+        for node_i, idxs, _contig in placed:
+            taken = set(idxs)
+            remaining[node_i] = tuple(
+                i for i in remaining[node_i] if i not in taken
+            )
+        out.append(placed)
     return out

@@ -1,0 +1,360 @@
+"""Incremental free-capacity index: cluster-scale placement state.
+
+The extender's verbs re-derive per-node feasibility from scratch on every
+filter/score/plan request — fine at the reference's scale, the structural
+bottleneck at O(10k) nodes (ROADMAP item 1; Tesserae's observation in
+PAPERS.md: placement search can be incremental over cluster state *deltas*
+instead of re-derived per request).  ``CapacityIndex`` keeps one small
+entry per node — (TPU generation, topology class, free core/HBM sums,
+untouched-chip count, largest-free-box band) — maintained at the
+allocator's mutation choke points and consulted by:
+
+- ``TPUUnitScheduler.assume/score``: candidates failing the O(1)
+  *necessary* capacity conditions are rejected without a node lock or a
+  trade DFS, and (for translation-invariant raters) candidates in the same
+  CONGRUENCE CLASS — equal ``ChipSet.plan_key()`` — share one fresh probe
+  per class instead of a DFS per node (PR 2's gang memoization, generalized
+  to the filter/score verbs);
+- ``GangCoordinator._plan_inner``: the plan prefilter reads free-core from
+  the index (one fold, zero per-node locks) and prunes nodes that cannot
+  host even one member before any clone is taken;
+- the fragmentation gauges / ``frag_snapshot``: only nodes dirtied since
+  the last refresh are re-scanned (the index's second dirty set);
+- ``status_summary`` / the batch admission sweep: per-bucket aggregates
+  keyed (generation, topology class, largest-free-box band).
+
+Exactness contract: every chip-state mutation flows through
+``NodeAllocator.allocate/forget/add/refresh_from_node``, each of which
+fires the allocator's ``on_change`` hook → ``mark_dirty`` (a GIL-atomic
+dict write, no lock, safe under the node lock).  Readers call ``fold()``
+first, which recomputes dirty entries under each node's own lock — so a
+query observes exactly the committed state, and index-backed verdicts are
+bit-identical to the full-rescan oracle (tests/test_cluster_index.py).
+Each entry records the ``ChipSet.version`` mutation stamp it was derived
+at; ``fold()`` skips nodes whose stamp hasn't moved, and ``verify()``
+re-derives every entry regardless — the divergence audit the
+check-cluster-scale gate hard-fails on.
+
+Locking: ``mark_dirty`` and entry READS are lock-free (plain-dict GIL
+atomicity); ``_lock`` guards only bucket maps and the probe memo, is never
+held while taking a node lock, and node locks are never taken while
+holding it — no rank interaction with the gang(10)/sched(20)/node(30)
+hierarchy.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from .request import TPURequest
+
+# sentinel: memo entries may legitimately hold None-ish results
+_MISS = object()
+
+
+def band_of(chips: int) -> int:
+    """Largest-free-box band: 0 for 0 chips, else floor(log2)+1 — so band
+    b covers [2^(b-1), 2^b).  A query for a k-chip contiguous box scans
+    buckets with band >= band_of(k) plus the boundary band exactly."""
+    return chips.bit_length()
+
+
+def request_demand(request: TPURequest) -> tuple[int, int, int]:
+    """(core_units, hbm_gib, whole_chips) a request must find on one node —
+    NECESSARY conditions only (pigeonhole sums; whole chips additionally
+    need that many UNTOUCHED chips), so an index rejection is always a
+    rejection the trade DFS would also reach: whole-chip containers fail
+    when free chips run short (the non-contiguous fallback still needs
+    ``count`` free chips), fractional containers fail when the core/HBM
+    sums cannot cover the total.  Nodes PASSING these checks still run the
+    full search — the index never admits, it only refuses."""
+    from ..utils import consts
+
+    core = hbm = whole = 0
+    for u in request.units:
+        if not u.needs_tpu:
+            continue
+        if u.wants_whole_chips:
+            whole += u.chip_count
+            core += u.chip_count * consts.CORE_PER_CHIP
+        else:
+            core += max(u.core, 0)
+            hbm += u.hbm
+    return core, hbm, whole
+
+
+@dataclass
+class IndexEntry:
+    """One node's slot in the index.  ``plan_key`` is the congruence token
+    (relative geometry + full chip state, ``ChipSet.plan_key()``): equal
+    keys → a placement probed on one node is valid on the other."""
+
+    __slots__ = (
+        "name", "generation", "topo_key", "free_core", "free_hbm",
+        "free_chips", "total_core", "total_hbm", "largest", "band",
+        "frag", "plan_key", "version",
+    )
+
+    name: str
+    generation: str
+    topo_key: tuple
+    free_core: int
+    free_hbm: int
+    free_chips: int
+    total_core: int
+    total_hbm: int
+    largest: int
+    band: int
+    frag: float
+    plan_key: tuple
+    version: int
+
+    def bucket(self) -> tuple:
+        return (self.generation, self.topo_key, self.band)
+
+    def snapshot(self) -> dict:
+        """Comparable wire form (parity tests / journal-replay rebuild).
+        ``version`` is process-local (excluded); ``plan_key`` is derived
+        from the same state as the rest, so the scalar fields suffice."""
+        return {
+            "generation": self.generation,
+            "topo": list(self.topo_key[0]),
+            "free_core": self.free_core,
+            "free_hbm": self.free_hbm,
+            "free_chips": self.free_chips,
+            "total_core": self.total_core,
+            "total_hbm": self.total_hbm,
+            "largest": self.largest,
+            "band": self.band,
+            "frag": self.frag,
+        }
+
+
+def entry_from_chips(name: str, generation: str, cs) -> IndexEntry:
+    """Derive a node's entry from its (locked) ChipSet — THE one
+    derivation, shared by the live fold, ``verify()``, and the journal
+    replay's offline rebuild so the three can never drift."""
+    free_n = cs.free_count()
+    largest = cs.largest_free_box() if free_n else 0
+    frag = round(1.0 - largest / free_n, 4) if free_n else 0.0
+    return IndexEntry(
+        name=name,
+        generation=generation,
+        topo_key=(cs.topo.dims, cs.topo.wrap),
+        free_core=cs.avail_core(),
+        free_hbm=cs.avail_hbm(),
+        free_chips=free_n,
+        total_core=cs.total_core(),
+        total_hbm=cs.total_hbm(),
+        largest=largest,
+        band=band_of(largest),
+        frag=frag,
+        plan_key=cs.plan_key(),
+        version=getattr(cs, "version", 0),
+    )
+
+
+class CapacityIndex:
+    """The cluster-wide incremental index (one per scheduler engine)."""
+
+    MEMO_MAX = 8192  # probe-memo entries; state changes rotate keys out
+
+    def __init__(self):
+        # plain dicts: writes are GIL-atomic, mark_dirty takes NO lock
+        # (it runs under node locks via the on_change hook)
+        self.entries: dict[str, IndexEntry] = {}
+        self._allocs: dict[str, object] = {}  # name → NodeAllocator
+        self._dirty: dict[str, bool] = {}  # fold consumer
+        self._frag_dirty: dict[str, bool] = {}  # gauge-refresh consumer
+        self._lock = threading.Lock()  # buckets + memo only
+        self._buckets: dict[tuple, set] = {}
+        # (units, containers, plan_key) → (feasible, score) — one fresh
+        # probe per congruence class per state, shared across candidates
+        self._memo: dict[tuple, tuple] = {}
+        # telemetry: candidate evaluations answered by the index (reject
+        # or memo) vs sent to the full per-node search
+        self.hits = 0
+        self.misses = 0
+        self.folds = 0
+
+    # -- maintenance ---------------------------------------------------------
+
+    def note_node(self, name: str, na) -> None:
+        """Register (or re-register) a node; lock-free."""
+        self._allocs[name] = na
+        self.mark_dirty(name)
+
+    def drop_node(self, name: str) -> None:
+        self._allocs.pop(name, None)
+        self.mark_dirty(name)
+
+    def mark_dirty(self, name: str) -> None:
+        """O(1), lock-free, safe under any lock — the allocator mutation
+        hook.  Feeds BOTH consumers (fold + frag-gauge refresh)."""
+        self._dirty[name] = True
+        self._frag_dirty[name] = True
+
+    def fold(self) -> None:
+        """Recompute entries for every dirty node (reader-side; the
+        mutation path pays one dict write).  Entry computation takes the
+        node's own lock; bucket installation takes the index lock; the
+        two are never held together."""
+        if not self._dirty:
+            return
+        self.folds += 1
+        for name in list(self._dirty.keys()):
+            self._dirty.pop(name, None)
+            na = self._allocs.get(name)
+            if na is None:
+                old = self.entries.pop(name, None)
+                if old is not None:
+                    with self._lock:
+                        self._buckets.get(old.bucket(), set()).discard(name)
+                continue
+            old = self.entries.get(name)
+            if old is not None and na.chips.version == old.version:
+                # spuriously-marked node: the mutation stamp hasn't moved
+                # (stamps are globally unique, so this also can't be a
+                # swapped-out ChipSet) — skip the lock + box scan.  An
+                # in-flight mutation stamped BEFORE mutating under the
+                # node lock, so equality can never mask one.
+                continue
+            with na.lock:
+                entry = entry_from_chips(name, na.generation, na.chips)
+            old = self.entries.get(name)
+            self.entries[name] = entry
+            with self._lock:
+                if old is not None and old.bucket() != entry.bucket():
+                    self._buckets.get(old.bucket(), set()).discard(name)
+                self._buckets.setdefault(entry.bucket(), set()).add(name)
+
+    def take_frag_dirty(self) -> list:
+        """Drain the fragmentation consumer's dirty set (gauge refresh /
+        frag_snapshot): nodes whose mesh-health numbers may have moved
+        since the last drain.  Callers fold() first so entries are
+        fresh."""
+        names = list(self._frag_dirty.keys())
+        for n in names:
+            self._frag_dirty.pop(n, None)
+        return names
+
+    # -- queries (callers fold() first) --------------------------------------
+
+    def entry(self, name: str) -> Optional[IndexEntry]:
+        return self.entries.get(name)
+
+    def can_fit(self, e: IndexEntry, demand: tuple[int, int, int]) -> bool:
+        core, hbm, whole = demand
+        return (
+            e.free_core >= core
+            and e.free_hbm >= hbm
+            and e.free_chips >= whole
+        )
+
+    def free_core_map(self, names: Iterable[str]) -> dict:
+        """name → free core units, exact as of the last committed
+        mutation (the gang-plan prefilter's input; replaces one lock
+        acquisition + sum read per node)."""
+        entries = self.entries
+        out = {}
+        for n in names:
+            e = entries.get(n)
+            if e is not None:
+                out[n] = e.free_core
+        return out
+
+    def memo_get(self, key: tuple):
+        with self._lock:
+            return self._memo.get(key, _MISS)
+
+    def memo_put(self, key: tuple, value: tuple) -> None:
+        with self._lock:
+            if len(self._memo) >= self.MEMO_MAX:
+                # state churn rotated the live keys out from under the
+                # old ones; dropping the oldest half keeps this O(1)/put
+                for k in list(self._memo.keys())[: self.MEMO_MAX // 2]:
+                    self._memo.pop(k, None)
+            self._memo[key] = value
+
+    def top_fragmented(self, k: int = 10) -> list[dict]:
+        """The k most fragmented nodes that still hold free chips —
+        the status summary's 'where is defrag owed' view."""
+        ranked = sorted(
+            (e for e in self.entries.values() if e.free_chips),
+            key=lambda e: (-e.frag, -e.free_chips, e.name),
+        )[:k]
+        return [
+            {
+                "node": e.name,
+                "fragmentation_index": e.frag,
+                "largest_free_submesh_chips": e.largest,
+                "free_chips": e.free_chips,
+            }
+            for e in ranked
+        ]
+
+    def bucket_stats(self) -> list[dict]:
+        """Aggregate view per (generation, topology class, largest-free-
+        box band) bucket — O(buckets), the /scheduler/status?summary=1
+        capacity panorama."""
+        with self._lock:
+            buckets = {k: set(v) for k, v in self._buckets.items() if v}
+        out = []
+        for (gen, topo_key, band), names in sorted(
+            buckets.items(), key=lambda kv: (kv[0][0], str(kv[0][1]), kv[0][2])
+        ):
+            free_core = sum(
+                self.entries[n].free_core for n in names if n in self.entries
+            )
+            out.append(
+                {
+                    "generation": gen,
+                    "topology": "x".join(map(str, topo_key[0])),
+                    "largest_free_band": band,
+                    "nodes": len(names),
+                    "free_core": free_core,
+                }
+            )
+        return out
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "nodes": len(self.entries),
+            "buckets": len(self._buckets),
+            "dirty": len(self._dirty),
+            "folds": self.folds,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_pct": round(100.0 * self.hits / total, 2) if total else 0.0,
+        }
+
+    def snapshot(self) -> dict[str, dict]:
+        """Full comparable dump (parity suite / replay rebuild diff)."""
+        self.fold()
+        return {n: e.snapshot() for n, e in sorted(self.entries.items())}
+
+    def verify(self) -> list[str]:
+        """Recompute every entry from live chip state and diff against
+        the maintained one — the index/oracle divergence audit the
+        check-cluster-scale gate hard-fails on.  Empty list = clean."""
+        self.fold()
+        problems: list[str] = []
+        for name, na in list(self._allocs.items()):
+            with na.lock:
+                fresh = entry_from_chips(name, na.generation, na.chips)
+            cur = self.entries.get(name)
+            if cur is None:
+                problems.append(f"{name}: no index entry for live node")
+                continue
+            if cur.snapshot() != fresh.snapshot():
+                problems.append(
+                    f"{name}: index entry diverged: "
+                    f"indexed={cur.snapshot()} live={fresh.snapshot()}"
+                )
+        for name in self.entries:
+            if name not in self._allocs:
+                problems.append(f"{name}: index entry for unknown node")
+        return problems

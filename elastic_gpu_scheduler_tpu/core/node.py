@@ -100,6 +100,20 @@ class NodeAllocator:
         # and wait-time-instrumented under one shared LOCK_WAIT label
         # ("node") so /metrics shows how long binds queue on node state.
         self.lock = TimedLock("node", rank=30)
+        # fired after EVERY committed chip-state mutation (allocate /
+        # forget / add / refresh_from_node), while the node lock is still
+        # held — the capacity index's dirty-mark hook.  Must be lock-free
+        # and O(1) (CapacityIndex.mark_dirty is a GIL-atomic dict write);
+        # None costs one truthiness check per mutation.
+        self.on_change = None
+
+    def _notify_change(self) -> None:
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb(self.node_name)
+            except Exception:  # a broken hook must never fail a commit
+                pass
 
     def _evict_stale_locked(self) -> None:
         import time
@@ -156,18 +170,30 @@ class NodeAllocator:
                     f"node {self.node_name}: cannot find option for {request.pod_key}"
                 )
             self.chips.transact(opt)
+            self._notify_change()
             return opt
+
+    def probe(self, request: TPURequest, rater: Rater) -> Optional[Option]:
+        """Fresh placement search against CURRENT state — no per-request
+        cache read or write.  The capacity index's class-representative
+        probe: its result is memoized by (shape, plan_key) and must be a
+        pure function of the node's state, which the assume() cache (keyed
+        by pod, possibly stale across state changes) is not."""
+        with self.lock:
+            return self.chips.trade(request, rater)
 
     def forget(self, option: Option) -> None:
         """Free a committed allocation (reference: node.go:129-140)."""
         with self.lock:
             self.chips.cancel(option)
+            self._notify_change()
 
     def add(self, option: Option) -> None:
         """Learn an externally-committed allocation (restart rebuild or a bind
         by another replica; reference: node.go:148-160)."""
         with self.lock:
             self.chips.transact(option)
+            self._notify_change()
 
     def drop_assumed(self, request_hash: str) -> None:
         """Evict a cached (not committed) option — e.g. gang rollback."""
@@ -191,6 +217,7 @@ class NodeAllocator:
                 self.chips = ChipSet(topo, chips)
                 self.allocated.clear()
                 self._allocated_at.clear()
+                self._notify_change()
                 if JOURNAL.enabled:
                     # reset=True: the rebuild WIPED chip usage (unlike the
                     # same-shape branch below, which preserves it) — replay
@@ -216,6 +243,8 @@ class NodeAllocator:
                     live.core_total = fresh.core_total
                     live.core_avail = max(0, fresh.core_total - used)
                     changed = True
+            if changed:
+                self._notify_change()
             if changed and JOURNAL.enabled:
                 JOURNAL.record(
                     "node_resync", node=self.node_name,

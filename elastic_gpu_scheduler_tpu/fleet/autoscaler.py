@@ -511,7 +511,35 @@ class SchedulerGangExecutor:
         finally:
             conn.close()
 
+    def _get(self, path: str) -> dict:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            *self.scheduler_addr, timeout=self.http_timeout_s
+        )
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"{path} -> {resp.status}: {data[:200]}")
+            return json.loads(data)
+        finally:
+            conn.close()
+
     def _node_generations(self) -> dict[str, str]:
+        # summary mode: one aggregate poll instead of a node-list walk
+        # with a label read per node (and never the full per-node chip
+        # dict a classic /scheduler/status at fleet scale would ship)
+        try:
+            st = self._get("/scheduler/status?summary=1&generations=1")
+            out: dict[str, str] = {}
+            for sched in st.get("schedulers", []):
+                out.update(sched.get("node_generations") or {})
+            if out:
+                return out
+        except Exception:
+            pass
         from ..utils import consts
 
         out = {}

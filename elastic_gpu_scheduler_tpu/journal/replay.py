@@ -99,6 +99,9 @@ class ReplayResult:
     records: int = 0
     last_seq: int = -1
     nodes: dict = field(default_factory=dict)  # node → ChipSet
+    # node → TPU generation (node_add/node_resync records carry it) —
+    # what the offline capacity-index rebuild keys its buckets by
+    generations: dict = field(default_factory=dict)
     pods: dict = field(default_factory=dict)  # pod key → _LivePod
     gangs: dict = field(default_factory=dict)  # gang → {"admits","rollbacks"}
     violations: list = field(default_factory=list)
@@ -143,6 +146,22 @@ class ReplayResult:
             "warnings": list(self.warnings),
         }
 
+    def index_snapshot(self) -> dict:
+        """Rebuild the capacity index's comparable entry set from the
+        REPLAYED chip state — the same derivation the live index uses
+        (core/index.entry_from_chips), so
+        ``replay(events).index_snapshot() == sched.index.snapshot()``
+        whenever the journal captured every mutation.  The
+        check-cluster-scale gate hard-fails on any diff."""
+        from ..core.index import entry_from_chips
+
+        return {
+            node: entry_from_chips(
+                node, self.generations.get(node, "v5e"), cs
+            ).snapshot()
+            for node, cs in sorted(self.nodes.items())
+        }
+
 
 def _chipset_from_record(rec: dict) -> ChipSet:
     topo = Topology(tuple(rec["dims"]), tuple(bool(w) for w in rec["wrap"]))
@@ -155,6 +174,8 @@ def _boot_from_checkpoint(rec: dict, res: ReplayResult) -> None:
     for name, inv in (rec.get("nodes") or {}).items():
         try:
             res.nodes[name] = _chipset_from_record(inv)
+            if inv.get("generation"):
+                res.generations[name] = inv["generation"]
         except Exception as e:
             res.violations.append(f"checkpoint: bad node {name}: {e}")
     for p in rec.get("pods") or []:
@@ -257,6 +278,8 @@ def replay(events: list[dict]) -> ReplayResult:
                             "allocation)"
                         )
             res.nodes[node] = cs
+            if rec.get("generation"):
+                res.generations[node] = rec["generation"]
         elif t == "bind":
             pod, node = rec.get("pod"), rec.get("node")
             cs = res.nodes.get(node)

@@ -333,9 +333,10 @@ PLAN_CACHE = REGISTRY.register(
     Counter(
         "tpu_scheduler_plan_events_total",
         "Gang-plan fast-path events: native_kernel/python_kernel count "
-        "plan_gang invocations, hit/miss count the memoized per-member "
-        "trade cache (hit = a congruent node state replayed a placement "
-        "instead of re-running the DFS)",
+        "plan_gang invocations, native_batch_kernel/python_batch_kernel "
+        "count plan_gang_batch sweep invocations, hit/miss count the "
+        "memoized per-member trade cache (hit = a congruent node state "
+        "replayed a placement instead of re-running the DFS)",
         ("event",),
     )
 )

@@ -74,8 +74,10 @@ from ..core.allocator import (
     ContainerAlloc,
     Option,
     iter_bits,
+    plan_gang_batch_fallback,
     plan_gang_fallback,
 )
+from ..core.index import request_demand
 from ..core.request import TPURequest, request_from_pod
 from ..journal import JOURNAL
 from ..k8s.objects import Pod
@@ -163,9 +165,22 @@ class _Gang:
 
 class GangCoordinator:
     def __init__(self, clientset, timeout: float = 30.0,
-                 commit_workers: int = 16):
+                 commit_workers: int = 16,
+                 batch_window_s: float = 0.0, batch_min: int = 4):
         self.clientset = clientset
         self.timeout = timeout
+        # batch admission sweep: >0 → the FIRST member of a gang parks up
+        # to this long collecting other pending gangs' first members, then
+        # ONE sweep plans the whole queue (shared clones, one reservation
+        # replay, multi-spec plan_gang_batch kernel calls) instead of a
+        # full per-gang rescan each.  0 (default) = plan-on-arrival,
+        # exactly the pre-batch behavior.
+        self.batch_window_s = batch_window_s
+        self.batch_min = max(2, batch_min)
+        self._batch_cond = threading.Condition()
+        self._batch_pending: dict[str, tuple] = {}  # gkey → (req, names)
+        self._batch_failed: dict[str, float] = {}  # gkey → monotonic stamp
+        self._batch_sweeping = False
         self._gangs: dict[str, _Gang] = {}
         self._plans: dict[str, _Plan] = {}
         self._lock = TimedLock("gang", rank=10)  # wait-time →
@@ -230,7 +245,13 @@ class GangCoordinator:
         """Plan-once, steer-each-member filter for gang pods — with one
         defrag-and-retry when the plan is infeasible and the planner runs
         in auto mode (fragmentation blocking a gang is exactly the signal
-        the defrag subsystem exists for)."""
+        the defrag subsystem exists for).  With the batch window on
+        (--gang-batch-window), a gang with no plan yet first rides the
+        batch-admission gate so a deep pending queue plans in one sweep."""
+        if self.batch_window_s > 0:
+            req0 = request_from_pod(pod)
+            if self.is_gang_pod(req0) and sched.admits(req0) is None:
+                self._batch_gate(sched, pod, req0, node_names)
         ok, failed = self._filter_once(sched, pod, node_names)
         defrag = self.defrag
         if (
@@ -253,8 +274,80 @@ class GangCoordinator:
             # may freely take engine/node locks for the round
             if defrag.try_unblock(sched, req):
                 GANG_EVENTS.inc("defrag_retry")
+                # a sweep's cached infeasible verdict predates the round;
+                # the refilter must replan, not re-reject from the marker
+                self._batch_failed.pop(self.gang_key(pod, req), None)
                 ok, failed = self._filter_once(sched, pod, node_names)
         return ok, failed
+
+    def _batch_marker_ttl(self) -> float:
+        return min(self.timeout, max(1.0, self.batch_window_s * 8))
+
+    def _batch_gate(self, sched, pod: Pod, req: TPURequest, node_names) -> None:
+        """Batch-admission gate: the first member of an unplanned gang
+        parks up to ``batch_window_s`` collecting other pending gangs'
+        first members, then ONE sweep (``plan_batch``) plans the whole
+        queue; later members (and gangs arriving mid-sweep) ride the same
+        sweep instead of re-scanning the cluster per gang.  Purely an
+        optimization gate: whatever happens here, ``_filter_once`` still
+        claims from an installed plan or plans solo, so correctness never
+        depends on the gate's timing."""
+        gkey = self.gang_key(pod, req)
+        with self._lock:
+            if self._plans.get(gkey) is not None:
+                # a plan exists (possibly mid-commit): _filter_once claims
+                # from it; joining the sweep would replan over it
+                return
+        cond = self._batch_cond
+        deadline = time.monotonic() + max(self.batch_window_s * 8, 0.5)
+        with cond:
+            if (
+                time.monotonic() - self._batch_failed.get(gkey, -1e9)
+                < self._batch_marker_ttl()
+            ):
+                return  # fresh sweep verdict: _filter_plan rejects from it
+            if gkey not in self._batch_pending:
+                self._batch_pending[gkey] = (req, list(node_names))
+                cond.notify_all()
+            while True:
+                if gkey not in self._batch_pending:
+                    return  # swept: plan or failure marker is installed
+                if not self._batch_sweeping:
+                    break  # nobody sweeping → this thread takes the role
+                if time.monotonic() >= deadline:
+                    # don't wedge the verb on a stuck sweep; solo planning
+                    # in _filter_once takes over
+                    self._batch_pending.pop(gkey, None)
+                    return
+                cond.wait(max(0.01, deadline - time.monotonic()))
+            self._batch_sweeping = True
+            try:
+                window_end = time.monotonic() + self.batch_window_s
+                while (
+                    len(self._batch_pending) < self.batch_min
+                    and time.monotonic() < window_end
+                ):
+                    cond.wait(max(0.005, window_end - time.monotonic()))
+                pending = [
+                    (k, r, names)
+                    for k, (r, names) in self._batch_pending.items()
+                ]
+                self._batch_pending.clear()
+                # plan OUTSIDE the gate condition (plan_batch takes the
+                # gang lock and node locks); joiners park on the condition
+                # until the sweep posts results
+                cond.release()
+                try:
+                    results = self.plan_batch(sched, pending)
+                finally:
+                    cond.acquire()
+                stamp = time.monotonic()
+                for k, planned in results.items():
+                    if planned is None:
+                        self._batch_failed[k] = stamp
+            finally:
+                self._batch_sweeping = False
+                cond.notify_all()
 
     def _filter_once(
         self, sched: TPUUnitScheduler, pod: Pod, node_names: list[str]
@@ -299,6 +392,25 @@ class GangCoordinator:
                 if time.monotonic() - last_activity > self.timeout:
                     self._plans.pop(gkey, None)
                     plan = None
+            if plan is None and self.batch_window_s > 0:
+                # a batch sweep already judged this gang infeasible against
+                # current capacity: answer from the marker instead of
+                # re-scanning per member (the TTL and any defrag unblock
+                # round clear it)
+                stamp = self._batch_failed.get(gkey)
+                if stamp is not None:
+                    if (
+                        time.monotonic() - stamp < self._batch_marker_ttl()
+                    ):
+                        GANG_EVENTS.inc("batch_reject_cached")
+                        return [], {
+                            n: (
+                                f"gang {gkey}: {req.gang_size} members "
+                                "cannot fit"
+                            )
+                            for n in node_names
+                        }
+                    self._batch_failed.pop(gkey, None)
             if plan is None:
                 plan = self._plan(sched, req, node_names)
                 if plan is None:
@@ -411,39 +523,104 @@ class GangCoordinator:
         off ICI onto DCN — the exact cost the placement model exists to
         avoid, SURVEY §5 'Distributed communication backend')."""
         ordered = self._node_mesh_order(node_names)
+        # ONE registry fetch + ONE pass of per-node locks for the whole plan
+        # (the old prefilter re-took sched.lock then na.lock per node per
+        # candidate group — 2×nodes×groups acquisitions of the hottest lock)
+        allocators = sched.get_allocators([n for _, n in ordered])
+        free_core = self._free_core_view(sched, ordered, allocators)
+        idx = getattr(sched, "index", None)
+        if idx is not None:
+            # index prune: drop nodes that cannot host even ONE member
+            # (necessary conditions on committed state; reservations only
+            # shrink capacity, so no viable candidate is ever dropped) —
+            # at fleet scale this is what keeps the clone count
+            # proportional to plausible hosts, not to the cluster
+            ordered = self._prune_ordered(idx, req, ordered)
+        candidates = self._candidate_groups(ordered)
+        # memoized trade results, shared across candidate groups — keyed by
+        # full node state, so clones from different groups can only hit when
+        # the states genuinely match
+        memo: dict = {}
+        clones, get_clone = self._clone_ctx(sched, allocators)
+        self._reserve_other_plans(sched, clones, get_clone, memo=memo)
+        planned = self._plan_groups(
+            sched, req, candidates, free_core, get_clone, memo
+        )
+        if planned is not None:
+            slots, options = planned
+            return _Plan(
+                slots=slots,
+                options=options,
+                node_slices={n: s for s, n in ordered},
+            )
+        return None
+
+    @staticmethod
+    def _candidate_groups(ordered: list[tuple[str, str]]) -> list[list[str]]:
+        """Slice-affine candidate groups: each ICI slice alone (mesh
+        order), then the spanning fallback."""
         slice_groups: dict[str, list[str]] = {}
         for slice_id, name in ordered:
             slice_groups.setdefault(slice_id, []).append(name)
         candidates: list[list[str]] = [g for g in slice_groups.values()]
         if len(candidates) > 1:
             candidates.append([n for _, n in ordered])  # spanning fallback
-        demand = req.total_chips_equiv * req.gang_size * 100  # core units
-        # ONE registry fetch + ONE pass of per-node locks for the whole plan
-        # (the old prefilter re-took sched.lock then na.lock per node per
-        # candidate group — 2×nodes×groups acquisitions of the hottest lock)
-        allocators = sched.get_allocators([n for _, n in ordered])
+        return candidates
+
+    @staticmethod
+    def _free_core_view(sched, ordered, allocators) -> dict:
+        """name → free core units for the group prefilter: read from the
+        capacity index when it is on (one fold, zero node locks — the
+        fleet-scale path), else one pass of per-node locks.  Values are
+        identical either way: the index is exact as of the last committed
+        mutation."""
+        idx = getattr(sched, "index", None)
+        if idx is not None:
+            idx.fold()
+            return idx.free_core_map([n for _, n in ordered])
         free_core: dict[str, int] = {}
         for name, na in allocators.items():
             if na is not None:
                 with na.lock:
                     free_core[name] = na.chips.avail_core()
-        # memoized trade results, shared across candidate groups — keyed by
-        # full node state, so clones from different groups can only hit when
-        # the states genuinely match
-        memo: dict = {}
+        return free_core
+
+    @staticmethod
+    def _prune_ordered(idx, req: TPURequest, ordered):
+        """Keep only nodes satisfying the per-MEMBER necessary capacity
+        conditions (plus nodes the index doesn't know, which the planner
+        resolves the slow way).  A pruned node could never host a member,
+        so the kernel/trade cursor would skip it anyway — placements are
+        bit-identical, only the clones are fewer."""
+        core, hbm, whole = request_demand(req)
+        entries = idx.entries
+        out = []
+        for s, n in ordered:
+            e = entries.get(n)
+            if e is None or (
+                e.free_core >= core
+                and e.free_hbm >= hbm
+                and e.free_chips >= whole
+            ):
+                out.append((s, n))
+        return out
+
+    def _plan_groups(
+        self, sched, req: TPURequest, candidates, free_core, get_clone, memo
+    ):
+        """Try each candidate group in order on SHARED clones; a failed
+        group attempt rolls its partial consumption back (the per-group
+        fresh-clone behavior this replaces discarded the whole context
+        instead).  Returns (slots, options) or None."""
+        demand = req.total_chips_equiv * req.gang_size * 100  # core units
         for group in candidates:
             # cheap prefilter: skip groups whose total free core can't hold
             # the gang (saves the clone+replay work on hopeless slices)
             if sum(free_core.get(n, 0) for n in group) < demand:
                 continue
-            planned = self._plan_on(sched, req, group, allocators, memo)
+            planned = self._plan_on_clones(sched, req, group, get_clone, memo)
             if planned is not None:
-                slots, options = planned
-                return _Plan(
-                    slots=slots,
-                    options=options,
-                    node_slices={n: s for s, n in ordered},
-                )
+                return planned
         return None
 
     def _trade_cached(self, cs, req: TPURequest, rater, memo: Optional[dict]):
@@ -724,6 +901,13 @@ class GangCoordinator:
             pos = end
         if remaining > 0:
             return None
+        return self._materialize_members(sched, req, nodes, placements)
+
+    @staticmethod
+    def _materialize_members(sched, req: TPURequest, nodes, placements):
+        """Kernel placements → (slots, options), applying each member's
+        box to its node clone and rating it — shared by the single-gang
+        fast path and the batch sweep so the two can never drift."""
         slots: list[str] = []
         options: list = []
         for member, (node_pos, idxs, contiguous) in enumerate(placements):
@@ -757,15 +941,16 @@ class GangCoordinator:
             options.append(opt)
         return slots, options
 
-    def _plan_on(
+    def _plan_on_clones(
         self,
         sched: TPUUnitScheduler,
         req: TPURequest,
         ordered: list[str],
-        allocators: Optional[dict] = None,
+        get_clone,
         memo: Optional[dict] = None,
     ):
-        """Greedy member placement over one candidate node group (cloned).
+        """Greedy member placement over one candidate node group, on the
+        caller's (shared) clone context.
 
         Members are homogeneous (same shape), so a node that cannot fit
         member k cannot fit member k+1 either — the scan cursor only moves
@@ -774,12 +959,10 @@ class GangCoordinator:
 
         Whole-chip SPMD gangs take the plan_gang kernel fast path; anything
         else (fractional shapes, multi-container pods, custom raters) runs
-        the per-member trade DFS with memoized results."""
-        if allocators is None:
-            allocators = sched.get_allocators(ordered)
-        clones, get_clone = self._clone_ctx(sched, allocators)
-
-        self._reserve_other_plans(sched, clones, get_clone, memo=memo)
+        the per-member trade DFS with memoized results.  A failed attempt
+        leaves the clones exactly as it found them (the fast path is
+        all-or-nothing by construction; the trade path rolls back), so one
+        clone context serves every group and every gang of a batch sweep."""
         count = self._whole_gang_shape(req, sched.rater)
         if count is not None:
             fast = self._plan_whole_fast(sched, req, ordered, get_clone, count)
@@ -787,6 +970,7 @@ class GangCoordinator:
                 return fast
         slots: list[str] = []
         options: list = []
+        undo: list[tuple] = []  # (clone, option) applied so far
         cursor = 0
         for member in range(req.gang_size):
             member_req = TPURequest(
@@ -807,13 +991,308 @@ class GangCoordinator:
                     cursor += 1  # full for this shape → full for all members
                     continue
                 cs.transact(opt)
+                undo.append((cs, opt))
                 slots.append(name)
                 options.append(opt)
                 placed = True
                 break
             if not placed:
+                for cs, opt in reversed(undo):
+                    cs.cancel(opt)
                 return None
         return slots, options
+
+    # -- batch admission sweep (fleet-scale pending-queue planning) ----------
+
+    def _plan_whole_batch(self, sched, specs, ordered, get_clone):
+        """Plan a SEGMENT of consecutive whole-chip-eligible gangs through
+        ONE plan_gang_batch kernel call (native when built, bit-identical
+        Python fallback): per-node free bitsets go in once, every placed
+        gang's boxes come out, carried state between specs inside the
+        kernel — no per-gang free-list rebuild, no per-gang Python↔C++
+        crossing.
+
+        ``specs`` is ``[(gkey, req, count), ...]`` in arrival order.
+        Returns ``(results, clean, ineligible)``: ``results`` maps gkey →
+        (slots, options) for the contiguous SUCCESS PREFIX (the kernel
+        stops at the first spec that cannot fully place and consumes
+        nothing for it — exactly what sequential per-gang planning would
+        leave behind); ``clean`` is False when a failure cut the batch
+        short; ``ineligible`` True means this group's node states aren't
+        covered by the kernel shortcut (heterogeneous chip totals, or
+        nodes of mixed topologies whose spill semantics need the per-gang
+        path) and NOTHING was attempted."""
+        from ..core.native import get_placement
+
+        nodes: list[tuple[str, object]] = []
+        for name in ordered:
+            cs = get_clone(name)
+            if cs is None:
+                continue
+            if len(set(cs._core_total)) > 1 or len(set(cs._hbm_total)) > 1:
+                return {}, True, True
+            nodes.append((name, cs))
+        if not nodes:
+            return {}, False, False
+        topo0 = nodes[0][1].topo
+        if any(cs.topo != topo0 for _, cs in nodes):
+            # multi-topology group: a gang may have to SPILL across
+            # topology runs, which is per-gang cursor state the batch
+            # kernel doesn't model — the per-gang fast path handles it
+            return {}, True, True
+        free_lists = [
+            tuple(cs._mesh_idx[i] for i in iter_bits(cs._free_bits))
+            for _, cs in nodes
+        ]
+        kspecs = [(count, req.gang_size) for _, req, count in specs]
+        native = get_placement()
+        use_native = native is not None and hasattr(native, "plan_gang_batch")
+        if use_native:
+            out = native.plan_gang_batch(
+                topo0.dims, topo0.wrap, free_lists, kspecs, 64
+            )
+        else:
+            out = plan_gang_batch_fallback(topo0, free_lists, kspecs, 64)
+        PLAN_CACHE.inc(
+            "native_batch_kernel" if use_native else "python_batch_kernel"
+        )
+        results: dict = {}
+        clean = True
+        for (gkey, req, _count), placed in zip(specs, out):
+            if placed and len(placed) >= req.gang_size:
+                results[gkey] = self._materialize_members(
+                    sched, req, nodes, placed
+                )
+            else:
+                clean = False
+                break
+        return results, clean, False
+
+    def _batch_group_pass(self, sched, group, gangs, get_clone, memo):
+        """One candidate-group pass over pending gangs, order preserved:
+        consecutive whole-chip-eligible gangs flow through the batch
+        kernel; others (fractional shapes, custom raters) run the trade
+        path on the same shared clones.  Stops at the FIRST placement
+        failure (returns clean=False): everything after it must re-plan
+        strictly sequentially, or later gangs would see consumption in an
+        order the per-gang oracle never produces."""
+        placed: dict = {}
+        i = 0
+        while i < len(gangs):
+            gkey, req = gangs[i]
+            count = self._whole_gang_shape(req, sched.rater)
+            if count is not None:
+                j = i
+                specs = []
+                while j < len(gangs):
+                    k2, r2 = gangs[j]
+                    c2 = self._whole_gang_shape(r2, sched.rater)
+                    if c2 is None:
+                        break
+                    specs.append((k2, r2, c2))
+                    j += 1
+                results, clean, ineligible = self._plan_whole_batch(
+                    sched, specs, group, get_clone
+                )
+                if ineligible:
+                    # per-gang fast path (handles hetero totals via trade
+                    # and multi-run spill) on the same clones, in order
+                    for k2, r2, _c2 in specs:
+                        got = self._plan_on_clones(
+                            sched, r2, group, get_clone, memo
+                        )
+                        if got is None:
+                            return placed, False
+                        placed[k2] = got
+                    i = j
+                    continue
+                placed.update(results)
+                if not clean:
+                    return placed, False
+                i = j
+            else:
+                got = self._plan_on_clones(sched, req, group, get_clone, memo)
+                if got is None:
+                    return placed, False
+                placed[gkey] = got
+                i += 1
+        return placed, True
+
+    def _plan_chunk(
+        self, sched, chunk, node_names, allocators, get_clone, memo
+    ):
+        """Plan a run of pending gangs sharing one candidate list,
+        bit-identical to planning each gang alone in arrival order.
+
+        Lockstep phase: the SLICE groups only (they are disjoint node
+        sets, so as long as every attempt succeeds, group-major order and
+        gang-major order produce identical placements — consumption in
+        one slice cannot affect another).  The spanning group overlaps
+        every slice, so the moment any gang is left unplaced by the slice
+        phase (placement failure, or prefiltered off every slice) the
+        sequential oracle's ordering starts to matter: that gang would
+        have consumed (possibly spanning) capacity BEFORE any
+        later-arrived gang placed.  To stay exact, every placed gang
+        ordered AFTER the first unplaced one is rolled back off the
+        clones and the tail re-plans strictly sequentially (same shared
+        clones, same group iteration the single-gang planner uses)."""
+        ordered = self._node_mesh_order(list(node_names))
+        free_core = self._free_core_view(sched, ordered, allocators)
+        idx = getattr(sched, "index", None)
+        node_slices = {n: s for s, n in ordered}
+        placed: dict = {}
+        remaining = list(chunk)  # [(gkey, req)] in arrival order
+        bail = False
+        groups_by_req: dict = {}
+
+        def groups_for(req):
+            key = id(req)
+            got = groups_by_req.get(key)
+            if got is None:
+                ords = (
+                    self._prune_ordered(idx, req, ordered)
+                    if idx is not None
+                    else ordered
+                )
+                got = self._candidate_groups(ords)
+                groups_by_req[key] = got
+            return got
+
+        def slice_groups_for(req):
+            groups = groups_for(req)
+            # drop the overlapping spanning fallback from the lockstep
+            # (it exists only when there are ≥2 slice groups)
+            return groups[:-1] if len(groups) > 1 else groups
+
+        n_groups = max(
+            (len(slice_groups_for(r)) for _, r in remaining), default=0
+        )
+        for gi in range(n_groups):
+            if bail or not remaining:
+                break
+            # gangs whose gi-th slice group exists and passes the prefilter
+            attempt = []
+            for gkey, req in remaining:
+                groups = slice_groups_for(req)
+                if gi >= len(groups):
+                    continue
+                group = groups[gi]
+                demand = req.total_chips_equiv * req.gang_size * 100
+                if sum(free_core.get(n, 0) for n in group) < demand:
+                    continue
+                attempt.append((gkey, req, group))
+            if not attempt:
+                continue
+            # group lists are identical across same-shape gangs of a
+            # chunk; segment by concrete group so the kernel sees one
+            seg_start = 0
+            while seg_start < len(attempt) and not bail:
+                seg_group = attempt[seg_start][2]
+                seg = []
+                k = seg_start
+                while k < len(attempt) and attempt[k][2] == seg_group:
+                    seg.append((attempt[k][0], attempt[k][1]))
+                    k += 1
+                got, clean = self._batch_group_pass(
+                    sched, seg_group, seg, get_clone, memo
+                )
+                placed.update(got)
+                if not clean:
+                    bail = True
+                seg_start = k
+            remaining = [g for g in remaining if g[0] not in placed]
+        # order repair: sequential semantics say the first unplaced gang
+        # consumes (maybe spanning every slice) before any later gang
+        # places — so later gangs' lockstep placements are unwound and
+        # re-derived in strict order
+        order = [gkey for gkey, _ in chunk]
+        first_unplaced = next(
+            (i for i, k in enumerate(order) if k not in placed), None
+        )
+        if first_unplaced is not None:
+            for k in order[first_unplaced + 1:]:
+                got = placed.pop(k, None)
+                if got is not None:
+                    slots, options = got
+                    for slot, opt in zip(slots, options):
+                        cs = get_clone(slot)
+                        if cs is not None:
+                            cs.cancel(opt)
+            for gkey, req in chunk[first_unplaced:]:
+                got = self._plan_groups(
+                    sched, req, groups_for(req), free_core, get_clone, memo
+                )
+                placed[gkey] = got  # may be None → infeasible
+        return placed, node_slices
+
+    def plan_batch(
+        self, sched: TPUUnitScheduler,
+        pending: list[tuple[str, TPURequest, list]],
+    ) -> dict:
+        """Batch admission sweep: plan every pending gang in ONE ranked
+        pass — one clone context, one reservation replay, one (or few)
+        multi-spec kernel invocations per congruent host class — instead
+        of a full per-gang rescan each.  ``pending`` is
+        ``[(gkey, request, candidate_node_names), ...]`` in arrival
+        order; returns gkey → _Plan (installed in ``self._plans``, ready
+        for members' filters to claim) or None (infeasible).  Results are
+        bit-identical to planning each gang alone in the same order
+        (tests/test_cluster_index.py asserts it)."""
+        with self._lock:
+            results: dict = {}
+            todo: list[tuple] = []
+            for gkey, req, node_names in pending:
+                plan = self._plans.get(gkey)
+                if plan is not None:
+                    # existing plan — INCLUDING one mid-commit: members
+                    # must claim from it (exactly _filter_plan's rule);
+                    # replanning over a committing plan would split the
+                    # gang between two placements
+                    results[gkey] = plan
+                    continue
+                todo.append((gkey, req, tuple(node_names)))
+            if not todo:
+                return results
+            union: list[str] = list(
+                dict.fromkeys(n for _, _, names in todo for n in names)
+            )
+            allocators = sched.get_allocators(union)
+            clones, get_clone = self._clone_ctx(sched, allocators)
+            memo: dict = {}
+            self._reserve_other_plans(sched, clones, get_clone, memo=memo)
+            GANG_EVENTS.inc("batch_sweep")
+            i = 0
+            while i < len(todo):
+                cand = todo[i][2]
+                j = i
+                while j < len(todo) and todo[j][2] == cand:
+                    j += 1
+                chunk = [(k, r) for k, r, _ in todo[i:j]]
+                placed, node_slices = self._plan_chunk(
+                    sched, chunk, cand, allocators, get_clone, memo
+                )
+                for gkey, req in chunk:
+                    got = placed.get(gkey)
+                    if got is None:
+                        results[gkey] = None
+                        GANG_EVENTS.inc("batch_infeasible")
+                        continue
+                    slots, options = got
+                    plan = _Plan(
+                        slots=slots,
+                        options=options,
+                        node_slices=dict(node_slices),
+                    )
+                    plan.created = time.monotonic()
+                    plan.member_units = req.units
+                    plan.member_containers = req.container_names
+                    plan.slot_units = [req.units] * len(slots)
+                    plan.slot_containers = [req.container_names] * len(slots)
+                    self._plans[gkey] = plan
+                    results[gkey] = plan
+                    GANG_EVENTS.inc("batch_planned")
+                i = j
+            return results
 
     # -- bind-time barrier + single-committer commit -------------------------
 

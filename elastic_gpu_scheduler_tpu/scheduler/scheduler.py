@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..core.allocator import Option, option_demand
+from ..core.index import _MISS, CapacityIndex, request_demand
 from ..core.annotations import (
     annotations_for_option,
     assigned_node,
@@ -60,6 +61,12 @@ class SchedulerConfig:
     clientset: Clientset
     rater: Rater
     assume_workers: int = 4  # reference hardcodes 4 (scheduler.go:135)
+    # incremental free-capacity index (core/index.py): O(1) candidate
+    # rejection + one placement probe per congruence class on the
+    # filter/score path, exact-by-construction via the allocator mutation
+    # hook.  False = the full-rescan oracle path everywhere (the parity
+    # baseline tools/check_cluster_scale.py measures against).
+    placement_index: bool = True
 
 
 class ResourceScheduler:
@@ -112,6 +119,13 @@ class TPUUnitScheduler(ResourceScheduler):
         # discipline: gang coordinator (10) → this registry lock (20) →
         # per-node allocator locks (30).
         self.lock = TimedLock("scheduler", reentrant=True, rank=20)
+        # cluster-scale capacity index: maintained by each NodeAllocator's
+        # on_change hook (one GIL-atomic dict write per committed
+        # mutation), consulted by assume/score/gang-planning/frag-refresh.
+        # None = every verb walks the full-rescan oracle path.
+        self.index: Optional[CapacityIndex] = (
+            CapacityIndex() if config.placement_index else None
+        )
         self.allocators: dict[str, NodeAllocator] = {}
         # pod key → (node, committed Option); the at-most-once ledger
         self.pod_maps: dict[str, tuple[str, Option]] = {}
@@ -231,6 +245,12 @@ class TPUUnitScheduler(ResourceScheduler):
             if cur is not None:
                 return cur  # lost the creation race; ours was never visible
             self.allocators[node_name] = na
+            if self.index is not None:
+                # only the WINNING instance enters the index; hooked before
+                # the assumed-pod replay below so those na.add commits
+                # dirty the entry like any later mutation
+                na.on_change = self.index.mark_dirty
+                self.index.note_node(node_name, na)
             if JOURNAL.enabled:
                 # capacity inventory first, so every later bind/forget on
                 # this node replays against a known chip set; generation
@@ -289,6 +309,74 @@ class TPUUnitScheduler(ResourceScheduler):
         TPUWholeScheduler (tpuwhole) rejects fractional shapes."""
         return None
 
+    def _index_partition(self, request: TPURequest, node_names: list[str]):
+        """Split candidates through the capacity index: ``decided`` holds
+        (feasible, score) verdicts the index answered — O(1) necessary-
+        condition rejections plus congruence-class memo hits — ``groups``
+        holds congruence classes awaiting ONE representative probe each,
+        and ``rest`` falls through to the legacy per-node search (no
+        entry, or a rater that is not translation-invariant).  Index
+        verdicts are bit-identical to what the per-node trade would
+        return (tests/test_cluster_index.py)."""
+        idx = self.index
+        idx.fold()
+        demand = request_demand(request)
+        invariant = getattr(self.rater, "translation_invariant", False)
+        decided: dict[str, tuple] = {}
+        groups: dict[tuple, list[str]] = {}
+        rest: list[str] = []
+        entries = idx.entries
+        for n in node_names:
+            e = entries.get(n)
+            if e is None:
+                rest.append(n)
+                idx.misses += 1
+                continue
+            if not idx.can_fit(e, demand):
+                # a NECESSARY condition failed: the DFS could only reach
+                # the same verdict, so skip the node lock + search
+                decided[n] = (False, None)
+                idx.hits += 1
+                continue
+            if not invariant:
+                rest.append(n)
+                idx.misses += 1
+                continue
+            key = (request.units, request.container_names, e.plan_key)
+            cached = idx.memo_get(key)
+            if cached is not _MISS:
+                decided[n] = cached
+                idx.hits += 1
+            else:
+                groups.setdefault(key, []).append(n)
+        return decided, groups, rest
+
+    def _resolve_classes(
+        self, request: TPURequest, groups: dict
+    ) -> dict[str, tuple]:
+        """One FRESH probe per congruence class (the first member pays
+        it), memoized under the class's state key — every congruent
+        candidate, in this verb and the next, reuses the verdict.  The
+        probe bypasses the per-pod assume cache on purpose: the memo is
+        keyed by node STATE and must never launder a stale pod-cached
+        option into a class-wide answer."""
+        idx = self.index
+        out: dict[str, tuple] = {}
+        for key, members in groups.items():
+            rep = members[0]
+            na = self._get_allocator(rep)
+            if na is None:
+                res = (False, None)
+            else:
+                opt = na.probe(request, self.rater)
+                res = (opt is not None, None if opt is None else opt.score)
+            idx.memo_put(key, res)
+            idx.misses += 1  # the representative's probe
+            idx.hits += len(members) - 1
+            for m in members:
+                out[m] = res
+        return out
+
     def assume(
         self, node_names: list[str], pod: Pod
     ) -> tuple[list[str], dict[str, str]]:
@@ -312,8 +400,15 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.assume", pod=pod.key, nodes=len(node_names),
         ) as sp:
-            by_name = self.get_allocators(node_names)
-            allocators = [(n, by_name[n]) for n in node_names]
+            decided: dict[str, tuple] = {}
+            rest = node_names
+            if self.index is not None and request.needs_tpu:
+                decided, groups, rest = self._index_partition(
+                    request, node_names
+                )
+                decided.update(self._resolve_classes(request, groups))
+            by_name = self.get_allocators(rest)
+            allocators = [(n, by_name[n]) for n in rest]
 
             ok: list[str] = []
             failed: dict[str, str] = dict(failed0)
@@ -327,13 +422,21 @@ class TPUUnitScheduler(ResourceScheduler):
                     return name, "insufficient TPU resources"
                 return name, None
 
-            results = list(self._pool.map(try_node, allocators))
-            for name, err in results:
+            verdicts = dict(self._pool.map(try_node, allocators))
+            for name in node_names:  # preserve candidate order
+                if name in decided:
+                    if decided[name][0]:
+                        ok.append(name)
+                    else:
+                        failed[name] = "insufficient TPU resources"
+                    continue
+                err = verdicts.get(name)
                 if err is None:
                     ok.append(name)
                 else:
                     failed[name] = err
             sp.set_attr("feasible", len(ok))
+            sp.set_attr("index_decided", len(decided))
             return ok, failed
 
     def score(self, node_names: list[str], pod: Pod) -> list[int]:
@@ -344,12 +447,29 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.score", pod=pod.key, nodes=len(node_names),
         ):
+            decided: dict[str, tuple] = {}
+            rest = node_names
+            if self.index is not None and request.needs_tpu:
+                # same index partition as assume(): a filter→score pair
+                # pays the class probes once (the memo is state-keyed)
+                decided, groups, rest = self._index_partition(
+                    request, node_names
+                )
+                decided.update(self._resolve_classes(request, groups))
             # ONE registry-lock acquisition for all candidates, like
             # assume() — the old loop re-entered the global lock per node,
             # serializing priorities against every in-flight bind
-            by_name = self.get_allocators(node_names)
+            by_name = self.get_allocators(rest)
             scores = []
             for n in node_names:
+                if n in decided:
+                    feasible, s = decided[n]
+                    scores.append(
+                        to_extender_score(s)
+                        if feasible
+                        else consts.SCORE_MIN
+                    )
+                    continue
                 na = by_name[n]
                 if na is None:
                     scores.append(consts.SCORE_MIN)
@@ -895,10 +1015,43 @@ class TPUUnitScheduler(ResourceScheduler):
         """Scrape-time fragmentation refresh (LazyGauge.refresher): the
         contiguous-box scan runs on the scraper's request, never on the
         bind path.  Offline, the same numbers are derivable at ANY
-        journal sequence number from the replayed chip state."""
+        journal sequence number from the replayed chip state.
+
+        With the capacity index on, only nodes DIRTIED since the last
+        refresh are re-scanned (the index's second dirty-set consumer):
+        a 10k-node fleet with a dozen binds between scrapes pays a dozen
+        box scans, not ten thousand."""
+        idx = self.index
+        if idx is not None:
+            # drain BEFORE folding: a mutation landing between the two
+            # re-marks both sets, so it is re-read next cycle — draining
+            # after the fold would latch the pre-mutation entry into the
+            # gauges with nothing left to refresh it
+            dirty = idx.take_frag_dirty()
+            idx.fold()  # entries now fresh for every drained node
+            if not dirty and self._frag_cache:
+                self._frag_cache_at = time.monotonic()
+                return
+            cache = dict(self._frag_cache)
+            entries = idx.entries
+            for name in dirty:
+                e = entries.get(name)
+                if e is None:
+                    cache.pop(name, None)
+                else:
+                    cache[name] = (e.frag, e.largest)
+            # whole-series swap: a racing collect sees old or new, never
+            # a cleared-but-unfilled intermediate
+            FRAG_INDEX.replace({(n,): v[0] for n, v in cache.items()})
+            FREE_SUBMESH.replace(
+                {(n,): float(v[1]) for n, v in cache.items()}
+            )
+            self._frag_cache = cache
+            self._frag_cache_at = time.monotonic()
+            return
         with self.lock:
             allocators = dict(self.allocators)
-        cache: dict[str, tuple[float, int]] = {}
+        cache = {}
         for name, na in allocators.items():
             with na.lock:
                 frag, largest, _free = na.chips.fragmentation()
@@ -931,7 +1084,11 @@ class TPUUnitScheduler(ResourceScheduler):
         nodes = {}
         for name, na in allocators.items():
             with na.lock:
-                nodes[name] = na.chips.inventory()
+                inv = na.chips.inventory()
+            # generation rides along so a pruned-prefix replay can rebuild
+            # the capacity index's buckets without the node_add records
+            inv["generation"] = na.generation
+            nodes[name] = inv
         return {"as_of_seq": as_of, "nodes": nodes, "pods": pods}
 
     def _journal_event(
@@ -1148,4 +1305,97 @@ class TPUUnitScheduler(ResourceScheduler):
         cordons = self.prune_cordons()
         if cordons:
             out["cordoned"] = sorted(cordons)
+        return out
+
+    def status_summary(
+        self, top_k: int = 10, generations: bool = False
+    ) -> dict:
+        """Fleet-scale status: aggregate counts + the top-K fragmented
+        nodes instead of the full per-node chip dict.  At 10k nodes the
+        classic dump serializes ~40k chip entries per poll; this answers
+        the questions pollers actually ask (capacity left, per-generation
+        spread, where defrag is owed) in O(nodes) small reads — from the
+        capacity index when it is on, from per-node sums otherwise.
+        ``GET /scheduler/status?summary=1[&top_k=N][&generations=1]`` —
+        the per-node ``node_generations`` map (the one O(nodes) field;
+        small strings, never chip dicts) ships only when asked for, so
+        the default summary stays O(buckets + top_k)."""
+        with self.lock:
+            allocators = dict(self.allocators)
+            n_pods = len(self.pod_maps)
+        idx = self.index
+        gens: dict[str, dict] = {}
+        node_gens: dict[str, str] = {}
+        totals = {
+            "core_total": 0, "core_avail": 0,
+            "hbm_total": 0, "hbm_avail": 0, "free_chips": 0,
+        }
+
+        def fold_node(name, gen, free_core, free_hbm, free_chips,
+                      total_core, total_hbm):
+            node_gens[name] = gen
+            g = gens.setdefault(
+                gen, {"nodes": 0, "free_chips": 0, "free_core": 0}
+            )
+            g["nodes"] += 1
+            g["free_chips"] += free_chips
+            g["free_core"] += free_core
+            totals["core_total"] += total_core
+            totals["core_avail"] += free_core
+            totals["hbm_total"] += total_hbm
+            totals["hbm_avail"] += free_hbm
+            totals["free_chips"] += free_chips
+
+        if idx is not None:
+            idx.fold()
+            entries = idx.entries
+            for name in allocators:
+                e = entries.get(name)
+                if e is None:
+                    continue
+                fold_node(name, e.generation, e.free_core, e.free_hbm,
+                          e.free_chips, e.total_core, e.total_hbm)
+            top = idx.top_fragmented(top_k)
+            index_stats = idx.stats()
+            buckets = idx.bucket_stats()
+        else:
+            for name, na in allocators.items():
+                with na.lock:
+                    cs = na.chips
+                    fold_node(
+                        name, na.generation, cs.avail_core(),
+                        cs.avail_hbm(), cs.free_count(),
+                        cs.total_core(), cs.total_hbm(),
+                    )
+            frag = self.frag_snapshot()
+            top = [
+                {
+                    "node": n,
+                    "fragmentation_index": v[0],
+                    "largest_free_submesh_chips": v[1],
+                }
+                for n, v in sorted(
+                    frag.items(), key=lambda kv: (-kv[1][0], kv[0])
+                )[:top_k]
+            ]
+            index_stats = None
+            buckets = None
+        out = {
+            "scheduler": self.name,
+            "rater": self.rater.name,
+            "summary": True,
+            "nodes": len(allocators),
+            "pods": n_pods,
+            "capacity": totals,
+            "generations": gens,
+            "top_fragmented": top,
+        }
+        if generations:
+            out["node_generations"] = node_gens
+        if index_stats is not None:
+            out["index"] = index_stats
+            out["buckets"] = buckets
+        cordons = self.prune_cordons()
+        if cordons:
+            out["cordoned"] = len(cordons)
         return out

@@ -478,7 +478,27 @@ class ExtenderServer:
             return 200, REGISTRY.expose().encode(), "text/plain"
         if path == "/scheduler/status":
             try:
-                return 200, json.dumps(self.status_fn()).encode(), "application/json"
+                params = _parse_query(query)
+                if params.get("summary") in ("1", "true", "yes"):
+                    # fleet-scale mode: aggregate counts + top-K
+                    # fragmented nodes, never the full per-node chip dict
+                    # (10k nodes × ~4 chips of JSON per poll otherwise).
+                    # Closures that predate the summary signature fall
+                    # back to the classic dump.
+                    try:
+                        top_k = max(1, int(params.get("top_k", "10")))
+                    except ValueError:
+                        top_k = 10
+                    gens = params.get("generations") in ("1", "true", "yes")
+                    try:
+                        payload = self.status_fn(
+                            summary=True, top_k=top_k, generations=gens
+                        )
+                    except TypeError:
+                        payload = self.status_fn()
+                else:
+                    payload = self.status_fn()
+                return 200, json.dumps(payload).encode(), "application/json"
             except Exception as e:
                 return 500, json.dumps({"error": str(e)}).encode(), "application/json"
         if path == "/traces":

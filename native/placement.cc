@@ -22,6 +22,19 @@
 //             free_lists: sequence[sequence[int]], count: int,
 //             members: int, max_candidates: int)
 //       -> list[(node_idx, tuple[int, ...], bool)]
+//
+//   plan_gang_batch(dims: tuple[int], wrap: tuple[bool],
+//                   free_lists: sequence[sequence[int]],
+//                   specs: sequence[(count, members)], max_candidates: int)
+//       -> list[list[(node_idx, tuple[int, ...], bool)]]
+// The batch-admission entry point: a QUEUE of gangs planned in one call
+// against one set of free lists, each spec consuming what the previous
+// placed — exactly sequential plan_gang calls with the free lists carried
+// forward.  All-or-nothing per spec: a spec that cannot place every member
+// consumes nothing, returns [], and STOPS the batch (later specs return []
+// unconsumed, for the caller's sequential re-plan) so ordering semantics
+// stay identical to the per-gang loop.  Bit-identical to
+// core/allocator.plan_gang_batch_fallback (tests/test_cluster_index.py).
 // The whole-gang greedy planner: place up to `members` identical
 // `count`-whole-chip members onto per-node free sets (row-major mesh
 // indices), forward-only node cursor, per member choosing the candidate box
@@ -245,106 +258,34 @@ double box_bonus(const std::vector<long>& mins, const std::vector<long>& maxs,
   return std::max(0.0, std::min(1.0, b));
 }
 
-PyObject* plan_gang(PyObject*, PyObject* args) {
-  PyObject* dims_obj;
-  PyObject* wrap_obj;
-  PyObject* free_obj;
-  long count, members, max_candidates;
-  if (!PyArg_ParseTuple(args, "O!O!Olll", &PyTuple_Type, &dims_obj,
-                        &PyTuple_Type, &wrap_obj, &free_obj, &count, &members,
-                        &max_candidates)) {
-    return nullptr;
-  }
-  size_t nd = PyTuple_GET_SIZE(dims_obj);
-  std::vector<long> mesh(nd);
-  std::vector<bool> wrap(nd, false);
-  long total = 1;
-  for (size_t i = 0; i < nd; ++i) {
-    mesh[i] = PyLong_AsLong(PyTuple_GET_ITEM(dims_obj, i));
-    if (mesh[i] <= 0) {
-      PyErr_SetString(PyExc_ValueError, "non-positive mesh dim");
-      return nullptr;
-    }
-    total *= mesh[i];
-  }
-  if ((size_t)PyTuple_GET_SIZE(wrap_obj) == nd) {
-    for (size_t i = 0; i < nd; ++i) {
-      wrap[i] = PyObject_IsTrue(PyTuple_GET_ITEM(wrap_obj, i));
-    }
-  }
-  if (count <= 0 || members <= 0 || max_candidates <= 0) {
-    return PyList_New(0);
-  }
+struct Placed {
+  long node;
+  std::vector<long> box;  // sorted mesh indices
+  bool contiguous;
+};
 
-  // per-node free cells (sorted ascending, like the Python fallback)
-  std::vector<std::vector<long>> free_cells;
-  {
-    PyObject* seq = PySequence_Fast(free_obj, "free_lists must be a sequence");
-    if (!seq) return nullptr;
-    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-    free_cells.resize(n);
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      PyObject* inner =
-          PySequence_Fast(PySequence_Fast_GET_ITEM(seq, i),
-                          "free_lists items must be sequences");
-      if (!inner) {
-        Py_DECREF(seq);
-        return nullptr;
-      }
-      Py_ssize_t m = PySequence_Fast_GET_SIZE(inner);
-      free_cells[i].reserve(m);
-      for (Py_ssize_t j = 0; j < m; ++j) {
-        long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(inner, j));
-        if ((v == -1 && PyErr_Occurred()) || v < 0 || v >= total) {
-          Py_DECREF(inner);
-          Py_DECREF(seq);
-          if (!PyErr_Occurred())
-            PyErr_SetString(PyExc_ValueError, "free index out of mesh range");
-          return nullptr;
-        }
-        free_cells[i].push_back(v);
-      }
-      std::sort(free_cells[i].begin(), free_cells[i].end());
-      Py_DECREF(inner);
-    }
-    Py_DECREF(seq);
-  }
-
-  std::vector<long> strides(nd, 1);
-  for (size_t i = nd; i-- > 1;) strides[i - 1] = strides[i] * mesh[i];
-
-  std::vector<Shape> shapes;
-  std::vector<long> prefix;
-  shapes_rec(mesh, count, 0, prefix, &shapes);
-  std::sort(shapes.begin(), shapes.end(), [](const Shape& a, const Shape& b) {
-    if (a.surface != b.surface) return a.surface < b.surface;
-    if (a.maxdim != b.maxdim) return a.maxdim < b.maxdim;
-    return a.dims < b.dims;
-  });
-  if (shapes.size() > kMaxShapes) shapes.resize(kMaxShapes);
-
-  std::vector<uint8_t> mask(total, 0);
-  auto decode = [&](long idx, std::vector<long>* coord) {
-    for (size_t a = nd; a-- > 0;) {
-      (*coord)[a] = idx % mesh[a];
-      idx /= mesh[a];
-    }
-  };
-
-  struct Placed {
-    long node;
-    std::vector<long> box;  // sorted mesh indices
-    bool contiguous;
-  };
-  std::vector<Placed> placed;
-  placed.reserve(members);
-
+// The greedy member-placement core shared by plan_gang and
+// plan_gang_batch: place up to `members` identical `count`-chip members
+// onto per-node free cells (forward-only cursor), consuming from
+// `free_cells` in place.  `mask` is a mesh-sized scratch buffer that must
+// be all-zero on entry and is restored to all-zero on exit.
+void greedy_place(const std::vector<long>& mesh, const std::vector<bool>& wrap,
+                  const std::vector<long>& strides,
+                  const std::vector<Shape>& shapes, long count, long members,
+                  long max_candidates,
+                  std::vector<std::vector<long>>* free_cells,
+                  std::vector<uint8_t>* mask_buf,
+                  std::vector<Placed>* placed) {
+  size_t nd = mesh.size();
+  std::vector<uint8_t>& mask = *mask_buf;
   size_t cursor = 0;
   bool mask_set = false;
   std::vector<long> origin(nd), off(nd), box, best_box, coord(nd);
   std::vector<long> mins(nd), maxs(nd);
-  while ((long)placed.size() < members && cursor < free_cells.size()) {
-    std::vector<long>& cells = free_cells[cursor];
+  size_t placed0 = placed->size();
+  while ((long)(placed->size() - placed0) < members &&
+         cursor < free_cells->size()) {
+    std::vector<long>& cells = (*free_cells)[cursor];
     if ((long)cells.size() < count) {
       if (mask_set) {
         for (long c : cells) mask[c] = 0;
@@ -380,7 +321,10 @@ PyObject* plan_gang(PyObject*, PyObject* args) {
       if (!shape_fits) continue;
       for (long origin_idx : cells) {
         if (emitted >= max_candidates) break;
-        decode(origin_idx, &origin);
+        for (size_t a = nd; a-- > 0;) {
+          origin[a] = origin_idx % mesh[a];
+          origin_idx /= mesh[a];
+        }
         bool ok = true;
         for (size_t a = 0; a < nd; ++a) {
           if (origin[a] >= lims[a]) {
@@ -463,15 +407,39 @@ PyObject* plan_gang(PyObject*, PyObject* args) {
         left.push_back(c);
     }
     cells.swap(left);
-    placed.push_back(Placed{(long)cursor, best_box, best_contig});
+    placed->push_back(Placed{(long)cursor, best_box, best_contig});
     // cursor stays: the node may fit further members
   }
+  if (mask_set && cursor < free_cells->size()) {
+    // leave the scratch mask all-zero for the next caller
+    for (long c : (*free_cells)[cursor]) mask[c] = 0;
+  }
+}
 
-  PyObject* result = PyList_New(placed.size());
+std::vector<Shape> shapes_for(const std::vector<long>& mesh, long count) {
+  std::vector<Shape> shapes;
+  std::vector<long> prefix;
+  shapes_rec(mesh, count, 0, prefix, &shapes);
+  std::sort(shapes.begin(), shapes.end(), [](const Shape& a, const Shape& b) {
+    if (a.surface != b.surface) return a.surface < b.surface;
+    if (a.maxdim != b.maxdim) return a.maxdim < b.maxdim;
+    return a.dims < b.dims;
+  });
+  if (shapes.size() > kMaxShapes) shapes.resize(kMaxShapes);
+  return shapes;
+}
+
+PyObject* placed_to_list(const std::vector<Placed>& placed, size_t from,
+                         size_t to) {
+  PyObject* result = PyList_New(to - from);
   if (!result) return nullptr;
-  for (size_t i = 0; i < placed.size(); ++i) {
+  for (size_t i = from; i < to; ++i) {
     const Placed& p = placed[i];
     PyObject* tup = PyTuple_New(p.box.size());
+    if (!tup) {
+      Py_DECREF(result);
+      return nullptr;
+    }
     for (size_t j = 0; j < p.box.size(); ++j) {
       PyTuple_SET_ITEM(tup, j, PyLong_FromLong(p.box[j]));
     }
@@ -481,7 +449,197 @@ PyObject* plan_gang(PyObject*, PyObject* args) {
       Py_DECREF(result);
       return nullptr;
     }
-    PyList_SET_ITEM(result, i, entry);
+    PyList_SET_ITEM(result, i - from, entry);
+  }
+  return result;
+}
+
+// Parse a sequence of sequences of mesh indices into per-node sorted cell
+// vectors; returns false (with a Python error set) on malformed input.
+bool parse_free_lists(PyObject* free_obj, long total,
+                      std::vector<std::vector<long>>* free_cells) {
+  PyObject* seq = PySequence_Fast(free_obj, "free_lists must be a sequence");
+  if (!seq) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  free_cells->resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* inner = PySequence_Fast(PySequence_Fast_GET_ITEM(seq, i),
+                                      "free_lists items must be sequences");
+    if (!inner) {
+      Py_DECREF(seq);
+      return false;
+    }
+    Py_ssize_t m = PySequence_Fast_GET_SIZE(inner);
+    (*free_cells)[i].reserve(m);
+    for (Py_ssize_t j = 0; j < m; ++j) {
+      long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(inner, j));
+      if ((v == -1 && PyErr_Occurred()) || v < 0 || v >= total) {
+        Py_DECREF(inner);
+        Py_DECREF(seq);
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_ValueError, "free index out of mesh range");
+        return false;
+      }
+      (*free_cells)[i].push_back(v);
+    }
+    std::sort((*free_cells)[i].begin(), (*free_cells)[i].end());
+    Py_DECREF(inner);
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+PyObject* plan_gang(PyObject*, PyObject* args) {
+  PyObject* dims_obj;
+  PyObject* wrap_obj;
+  PyObject* free_obj;
+  long count, members, max_candidates;
+  if (!PyArg_ParseTuple(args, "O!O!Olll", &PyTuple_Type, &dims_obj,
+                        &PyTuple_Type, &wrap_obj, &free_obj, &count, &members,
+                        &max_candidates)) {
+    return nullptr;
+  }
+  size_t nd = PyTuple_GET_SIZE(dims_obj);
+  std::vector<long> mesh(nd);
+  std::vector<bool> wrap(nd, false);
+  long total = 1;
+  for (size_t i = 0; i < nd; ++i) {
+    mesh[i] = PyLong_AsLong(PyTuple_GET_ITEM(dims_obj, i));
+    if (mesh[i] <= 0) {
+      PyErr_SetString(PyExc_ValueError, "non-positive mesh dim");
+      return nullptr;
+    }
+    total *= mesh[i];
+  }
+  if ((size_t)PyTuple_GET_SIZE(wrap_obj) == nd) {
+    for (size_t i = 0; i < nd; ++i) {
+      wrap[i] = PyObject_IsTrue(PyTuple_GET_ITEM(wrap_obj, i));
+    }
+  }
+  if (count <= 0 || members <= 0 || max_candidates <= 0) {
+    return PyList_New(0);
+  }
+
+  // per-node free cells (sorted ascending, like the Python fallback)
+  std::vector<std::vector<long>> free_cells;
+  if (!parse_free_lists(free_obj, total, &free_cells)) return nullptr;
+
+  std::vector<long> strides(nd, 1);
+  for (size_t i = nd; i-- > 1;) strides[i - 1] = strides[i] * mesh[i];
+
+  std::vector<Shape> shapes = shapes_for(mesh, count);
+  std::vector<uint8_t> mask(total, 0);
+  std::vector<Placed> placed;
+  placed.reserve(members);
+  greedy_place(mesh, wrap, strides, shapes, count, members, max_candidates,
+               &free_cells, &mask, &placed);
+  return placed_to_list(placed, 0, placed.size());
+}
+
+PyObject* plan_gang_batch(PyObject*, PyObject* args) {
+  PyObject* dims_obj;
+  PyObject* wrap_obj;
+  PyObject* free_obj;
+  PyObject* specs_obj;
+  long max_candidates;
+  if (!PyArg_ParseTuple(args, "O!O!OOl", &PyTuple_Type, &dims_obj,
+                        &PyTuple_Type, &wrap_obj, &free_obj, &specs_obj,
+                        &max_candidates)) {
+    return nullptr;
+  }
+  size_t nd = PyTuple_GET_SIZE(dims_obj);
+  std::vector<long> mesh(nd);
+  std::vector<bool> wrap(nd, false);
+  long total = 1;
+  for (size_t i = 0; i < nd; ++i) {
+    mesh[i] = PyLong_AsLong(PyTuple_GET_ITEM(dims_obj, i));
+    if (mesh[i] <= 0) {
+      PyErr_SetString(PyExc_ValueError, "non-positive mesh dim");
+      return nullptr;
+    }
+    total *= mesh[i];
+  }
+  if ((size_t)PyTuple_GET_SIZE(wrap_obj) == nd) {
+    for (size_t i = 0; i < nd; ++i) {
+      wrap[i] = PyObject_IsTrue(PyTuple_GET_ITEM(wrap_obj, i));
+    }
+  }
+  std::vector<std::pair<long, long>> specs;  // (count, members)
+  {
+    PyObject* seq = PySequence_Fast(specs_obj, "specs must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    specs.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* item = PySequence_Fast(PySequence_Fast_GET_ITEM(seq, i),
+                                       "specs items must be (count, members)");
+      if (!item || PySequence_Fast_GET_SIZE(item) != 2) {
+        Py_XDECREF(item);
+        Py_DECREF(seq);
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_ValueError,
+                          "specs items must be (count, members)");
+        return nullptr;
+      }
+      long c = PyLong_AsLong(PySequence_Fast_GET_ITEM(item, 0));
+      long m = PyLong_AsLong(PySequence_Fast_GET_ITEM(item, 1));
+      Py_DECREF(item);
+      if (PyErr_Occurred()) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      specs.emplace_back(c, m);
+    }
+    Py_DECREF(seq);
+  }
+  if (max_candidates <= 0) {
+    PyObject* result = PyList_New(specs.size());
+    if (!result) return nullptr;
+    for (size_t i = 0; i < specs.size(); ++i)
+      PyList_SET_ITEM(result, i, PyList_New(0));
+    return result;
+  }
+
+  std::vector<std::vector<long>> free_cells;
+  if (!parse_free_lists(free_obj, total, &free_cells)) return nullptr;
+
+  std::vector<long> strides(nd, 1);
+  for (size_t i = nd; i-- > 1;) strides[i - 1] = strides[i] * mesh[i];
+
+  std::vector<uint8_t> mask(total, 0);
+  PyObject* result = PyList_New(specs.size());
+  if (!result) return nullptr;
+  bool failed = false;
+  for (size_t si = 0; si < specs.size(); ++si) {
+    long count = specs[si].first, members = specs[si].second;
+    if (failed || count <= 0 || members <= 0) {
+      // stop-at-first-failure: everything after the first failed spec is
+      // returned empty and UNCONSUMED (the caller re-plans it
+      // sequentially with full ordering semantics)
+      if (count <= 0 || members <= 0) failed = true;
+      PyList_SET_ITEM(result, si, PyList_New(0));
+      continue;
+    }
+    // all-or-nothing per spec: snapshot the free lists, roll back on a
+    // partial placement so a failed gang consumes nothing
+    std::vector<std::vector<long>> snapshot = free_cells;
+    std::vector<Shape> shapes = shapes_for(mesh, count);
+    std::vector<Placed> placed;
+    placed.reserve(members);
+    greedy_place(mesh, wrap, strides, shapes, count, members, max_candidates,
+                 &free_cells, &mask, &placed);
+    if ((long)placed.size() < members) {
+      free_cells.swap(snapshot);
+      failed = true;
+      PyList_SET_ITEM(result, si, PyList_New(0));
+      continue;
+    }
+    PyObject* one = placed_to_list(placed, 0, placed.size());
+    if (!one) {
+      Py_DECREF(result);
+      return nullptr;
+    }
+    PyList_SET_ITEM(result, si, one);
   }
   return result;
 }
@@ -491,6 +649,9 @@ PyMethodDef methods[] = {
      "enumerate contiguous free sub-boxes, compact-first"},
     {"plan_gang", plan_gang, METH_VARARGS,
      "greedy whole-gang placement over per-node free sets"},
+    {"plan_gang_batch", plan_gang_batch, METH_VARARGS,
+     "batch-admission sweep: a queue of gangs planned in one call, "
+     "all-or-nothing per spec, stop at first failure"},
     {nullptr, nullptr, 0, nullptr},
 };
 

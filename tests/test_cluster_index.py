@@ -1,0 +1,563 @@
+"""Cluster-scale placement tests: incremental capacity index parity,
+batch admission sweep vs the sequential oracle, journal-replay index
+rebuild, summary status, and the dirty-node-only fragmentation refresh.
+
+The contract under test everywhere: the index/batch paths are pure
+OPTIMIZATIONS — every verdict, score, and placement is bit-identical to
+the full-rescan oracle (`--placement-index off` / per-gang planning).
+Randomized churn (bind/forget/migrate/resize) drives the comparisons.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.core.allocator import (
+    plan_gang_batch_fallback,
+    plan_gang_fallback,
+)
+from elastic_gpu_scheduler_tpu.core.index import (
+    band_of,
+    entry_from_chips,
+    request_demand,
+)
+from elastic_gpu_scheduler_tpu.core.request import (
+    TPURequest,
+    TPUUnit,
+    request_from_pod,
+)
+from elastic_gpu_scheduler_tpu.core.topology import Topology
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+def mixed_fleet(cluster, v5e_slices=2, v5p=True):
+    """A small mixed fleet: v5e 4x4 slices (4 hosts × 4 chips each) and
+    one v5p 4x4x4 slice (16 hosts × 4 chips)."""
+    names = []
+    for s in range(v5e_slices):
+        i = 0
+        for x in range(0, 4, 2):
+            for y in range(0, 4, 2):
+                name = f"v5e-s{s}-h{i}"
+                cluster.add_node(
+                    make_tpu_node(
+                        name, chips=4, hbm_gib=64, accelerator="v5e",
+                        slice_topology="4x4", host_topology="2x2",
+                        host_offset=f"{x}.{y}", slice_name=f"v5e-s{s}",
+                    )
+                )
+                names.append(name)
+                i += 1
+    if v5p:
+        i = 0
+        for x in range(0, 4, 2):
+            for y in range(0, 4, 2):
+                for z in range(4):
+                    name = f"v5p-h{i}"
+                    cluster.add_node(
+                        make_tpu_node(
+                            name, chips=4, hbm_gib=380, accelerator="v5p",
+                            slice_topology="4x4x4", host_topology="2x2x1",
+                            host_offset=f"{x}.{y}.{z}", slice_name="v5p-64",
+                        )
+                    )
+                    names.append(name)
+                    i += 1
+    return names
+
+
+def build(cluster, **kw):
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, **kw)
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    return sched, gang, status, clientset
+
+
+def churn(sched, cluster, names, rng, ops=60):
+    """Seeded bind/forget/migrate churn through the real engine verbs."""
+    serial = [0]
+    bound = []
+
+    def mkpod(core):
+        serial[0] += 1
+        p = tpu_pod(f"churn-{serial[0]}", core=core)
+        cluster.create_pod(p)
+        return p
+
+    for _ in range(ops):
+        r = rng.random()
+        if bound and r < 0.3:
+            pod, node = bound.pop(rng.randrange(len(bound)))
+            sched.forget_pod(pod)
+        elif bound and r < 0.4:
+            # live migration through the defrag primitive
+            pod, node = bound[rng.randrange(len(bound))]
+            entry = sched.pod_maps.get(pod.key)
+            if entry is None:
+                continue
+            src, opt = entry
+            dst = rng.choice(names)
+            if dst == src:
+                continue
+            na = sched._get_allocator(dst)
+            req = request_from_pod(pod)
+            new_opt = na.probe(req, sched.rater)
+            if new_opt is None:
+                continue
+            try:
+                sched.migrate_pod(pod, src, dst, opt, new_opt)
+                bound[[i for i, (p, _n) in enumerate(bound)
+                       if p.key == pod.key][0]] = (pod, dst)
+            except RuntimeError:
+                pass
+        else:
+            p = mkpod(rng.choice((50, 100, 200, 400)))
+            ok, _failed = sched.assume(list(names), p)
+            if not ok:
+                continue
+            node = rng.choice(ok)
+            try:
+                sched.bind(node, p)
+                bound.append((p, node))
+            except Exception:
+                pass
+    return bound
+
+
+def test_index_exact_after_randomized_churn():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    sched, gang, _status, _cs = build(cluster)
+    rng = random.Random(11)
+    churn(sched, cluster, names, rng, ops=80)
+    assert sched.index.verify() == []
+
+
+def test_index_tracks_node_resync():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster, v5e_slices=1, v5p=False)
+    sched, *_ = build(cluster)
+    sched.get_allocators(names)
+    na = sched.allocators[names[0]]
+    node = cluster.get_node(names[0])
+    # HBM resize (same shape): totals change, usage preserved
+    node.status.allocatable[consts.RESOURCE_TPU_HBM] = 128
+    na.refresh_from_node(node)
+    assert sched.index.verify() == []
+    sched.index.fold()
+    assert sched.index.entries[names[0]].total_hbm == 128
+
+
+def test_filter_score_parity_vs_oracle():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    sched, gang, _status, _cs = build(cluster)
+    rng = random.Random(17)
+    churn(sched, cluster, names, rng, ops=60)
+    for trial in range(12):
+        p = tpu_pod(f"par-{trial}", core=rng.choice((30, 50, 100, 200, 400)))
+        cand = rng.sample(names, rng.randrange(4, len(names)))
+        ok_i, failed_i = sched.assume(cand, p)
+        scores_i = sched.score(cand, p)
+        saved, sched.index = sched.index, None
+        try:
+            ok_o, failed_o = sched.assume(cand, p)
+            scores_o = sched.score(cand, p)
+        finally:
+            sched.index = saved
+        assert ok_i == ok_o, f"trial {trial}"
+        assert failed_i == failed_o, f"trial {trial}"
+        assert scores_i == scores_o, f"trial {trial}"
+
+
+def test_index_rejection_is_a_trade_rejection():
+    """Every index-rejected candidate must be one the DFS would reject:
+    fill a node, then ask for more than it has."""
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster, v5e_slices=1, v5p=False)
+    sched, *_ = build(cluster)
+    p = tpu_pod("big", core=400)
+    cluster.create_pod(p)
+    sched.bind(names[0], p)
+    p2 = tpu_pod("next", core=100)
+    ok, failed = sched.assume([names[0]], p2)
+    assert ok == []
+    assert failed[names[0]] == "insufficient TPU resources"
+    # oracle agrees
+    saved, sched.index = sched.index, None
+    try:
+        ok_o, failed_o = sched.assume([names[0]], p2)
+    finally:
+        sched.index = saved
+    assert (ok, failed) == (ok_o, failed_o)
+
+
+def test_request_demand_necessary_conditions():
+    req = TPURequest(
+        pod_uid="u", pod_key="d/p",
+        units=(TPUUnit(chip_count=2), TPUUnit(core=30, hbm=8)),
+        container_names=("a", "b"),
+    )
+    core, hbm, whole = request_demand(req)
+    assert (core, hbm, whole) == (230, 8, 2)
+    assert band_of(0) == 0 and band_of(1) == 1 and band_of(4) == 3
+
+
+def gang_req(tag, members, chips=4):
+    return TPURequest(
+        pod_uid=f"t-{tag}", pod_key=f"t/{tag}",
+        units=(TPUUnit(core=0, hbm=0, chip_count=chips),),
+        container_names=("main",),
+        gang_name=tag, gang_size=members,
+    )
+
+
+def _install(gang, gkey, req, plan):
+    plan.created = time.monotonic()
+    plan.member_units = req.units
+    plan.member_containers = req.container_names
+    plan.slot_units = [req.units] * len(plan.slots)
+    plan.slot_containers = [req.container_names] * len(plan.slots)
+    with gang._lock:
+        gang._plans[gkey] = plan
+
+
+@pytest.mark.parametrize("seed", [3, 7, 23, 41])
+def test_batch_sweep_matches_sequential_oracle(seed):
+    """plan_batch over a mixed pending queue == planning each gang alone
+    in arrival order (slots AND per-member placements), including queues
+    where a gang must span slices (the order-repair path)."""
+    rng = random.Random(seed)
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    sched, gang, _status, _cs = build(cluster)
+    churn(sched, cluster, names, rng, ops=40)
+    sizes = [rng.choice((2, 3, 4, 6, 10)) for _ in range(5)]
+    queue = [
+        (f"t/q{i}", gang_req(f"q{i}-{seed}", s), list(names))
+        for i, s in enumerate(sizes)
+    ]
+    # sequential oracle: per-gang plans, installed so reservations apply
+    for gkey, req, cand in queue:
+        plan = gang._plan(sched, req, cand)
+        if plan is not None:
+            _install(gang, gkey, req, plan)
+    with gang._lock:
+        oracle = {
+            k: (list(p.slots),
+                [o.coords_by_container() for o in p.options])
+            for k, p in gang._plans.items()
+        }
+        gang._plans.clear()
+    swept = gang.plan_batch(sched, queue)
+    batch = {
+        k: (list(p.slots), [o.coords_by_container() for o in p.options])
+        for k, p in swept.items() if p is not None
+    }
+    with gang._lock:
+        gang._plans.clear()
+    assert batch == oracle
+
+
+def test_batch_sweep_infeasible_gang_marks_and_places_rest():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster, v5e_slices=1, v5p=False)  # 16 chips total
+    sched, gang, _status, _cs = build(cluster)
+    queue = [
+        ("t/fit", gang_req("fit", 2), list(names)),
+        ("t/huge", gang_req("huge", 64), list(names)),  # can never fit
+        ("t/fit2", gang_req("fit2", 2), list(names)),
+    ]
+    res = gang.plan_batch(sched, queue)
+    assert res["t/fit"] is not None
+    assert res["t/huge"] is None
+    assert res["t/fit2"] is not None
+
+
+def test_plan_gang_batch_fallback_is_sequential():
+    """The batch kernel == sequential plan_gang calls with carried free
+    lists, all-or-nothing per spec, stop at first failure."""
+    topo = Topology((4, 4))
+    rng = random.Random(5)
+    for _ in range(50):
+        free_lists = [
+            tuple(i for i in range(16) if rng.random() < 0.7)
+            for _ in range(3)
+        ]
+        specs = [(rng.choice((1, 2, 4)), rng.randrange(1, 4))
+                 for _ in range(3)]
+        batch = plan_gang_batch_fallback(topo, free_lists, specs, 64)
+        # reference: sequential consumption
+        remaining = [tuple(sorted(f)) for f in free_lists]
+        failed = False
+        for si, (count, members) in enumerate(specs):
+            if failed:
+                assert batch[si] == []
+                continue
+            solo = plan_gang_fallback(
+                topo, list(remaining), count, members, 64
+            )
+            if len(solo) < members:
+                assert batch[si] == []
+                failed = True
+                continue
+            assert batch[si] == solo
+            for node_i, idxs, _c in solo:
+                taken = set(idxs)
+                remaining[node_i] = tuple(
+                    i for i in remaining[node_i] if i not in taken
+                )
+
+
+def test_plan_gang_batch_native_parity():
+    from elastic_gpu_scheduler_tpu.core.native import get_placement
+
+    native = get_placement()
+    if native is None or not hasattr(native, "plan_gang_batch"):
+        pytest.skip("native placement extension not built")
+    rng = random.Random(9)
+    for dims in ((4, 4), (4, 4, 4), (8,)):
+        topo = Topology(dims)
+        total = topo.num_chips
+        for _ in range(40):
+            free_lists = [
+                tuple(i for i in range(total) if rng.random() < 0.6)
+                for _ in range(rng.randrange(1, 5))
+            ]
+            specs = [(rng.choice((1, 2, 4)), rng.randrange(1, 5))
+                     for _ in range(rng.randrange(1, 5))]
+            py = plan_gang_batch_fallback(topo, free_lists, specs, 64)
+            nat = native.plan_gang_batch(
+                topo.dims, topo.wrap, free_lists, specs, 64
+            )
+            nat = [
+                [(n, tuple(b), bool(c)) for n, b, c in spec]
+                for spec in nat
+            ]
+            assert py == nat
+
+
+def test_journal_replay_rebuilds_index(tmp_path):
+    from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+    from elastic_gpu_scheduler_tpu.journal.replay import replay
+
+    JOURNAL.configure(str(tmp_path), fsync="off")
+    try:
+        cluster = FakeCluster()
+        names = mixed_fleet(cluster)
+        sched, gang, _status, _cs = build(cluster)
+        rng = random.Random(29)
+        churn(sched, cluster, names, rng, ops=70)
+        JOURNAL.flush()
+        res = replay(read_journal(str(tmp_path)))
+        assert res.violations == []
+        assert res.index_snapshot() == sched.index.snapshot()
+    finally:
+        JOURNAL.close()
+
+
+def test_status_summary_direct_and_http():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster)
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    sched.get_allocators(names)  # allocators build lazily; warm them all
+    p = tpu_pod("s1", core=400)
+    cluster.create_pod(p)
+    sched.bind(names[0], p)
+    s = sched.status_summary(top_k=3)
+    assert s["nodes"] == len(names)
+    assert s["pods"] == 1
+    assert s["capacity"]["core_total"] == sum(
+        (sched.allocators[n].chips.total_core() for n in names)
+    )
+    assert set(s["generations"]) == {"v5e", "v5p"}
+    # the one O(nodes) field is opt-in
+    assert "node_generations" not in s
+    sg = sched.status_summary(top_k=3, generations=True)
+    assert sg["node_generations"][names[0]] == "v5e"
+    assert len(s["top_fragmented"]) <= 3
+    # never the classic per-node chip dump: "nodes" is a COUNT here, and
+    # nothing in the payload keys per-chip state by coordinate
+    assert isinstance(s["nodes"], int)
+    assert '"core_total"' not in json.dumps(s["top_fragmented"])
+    assert s["index"]["nodes"] == len(names)
+
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0
+    )
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/scheduler/status?summary=1&top_k=2",
+            timeout=10,
+        ) as r:
+            body = json.loads(r.read())
+        assert body["schedulers"][0]["summary"] is True
+        assert "nodes" in body["schedulers"][0]
+        assert isinstance(body["schedulers"][0]["nodes"], int)
+        # classic dump unchanged
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/scheduler/status", timeout=10,
+        ) as r:
+            classic = json.loads(r.read())
+        assert isinstance(classic["schedulers"][0]["nodes"], dict)
+    finally:
+        server.stop()
+
+
+def test_frag_refresh_rescans_only_dirty_nodes(monkeypatch):
+    from elastic_gpu_scheduler_tpu.core.allocator import ChipSet
+
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    sched, *_ = build(cluster)
+    sched.get_allocators(names)
+
+    calls = []
+    real = ChipSet.largest_free_box
+
+    def counting(self, *a, **kw):
+        calls.append(1)
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(ChipSet, "largest_free_box", counting)
+    sched._refresh_frag_gauges()
+    first = len(calls)
+    assert first >= len(names)  # first refresh folds every node
+    full_snapshot = dict(sched._frag_cache)
+
+    # oracle values: full scan path must agree
+    for n in names:
+        na = sched.allocators[n]
+        with na.lock:
+            frag, largest, _free = na.chips.fragmentation()
+        assert full_snapshot[n] == (frag, largest)
+
+    calls.clear()
+    sched._refresh_frag_gauges()
+    assert len(calls) == 0  # nothing dirtied → zero box scans
+
+    p = tpu_pod("f1", core=100)  # partial fill: the box scan must rerun
+    cluster.create_pod(p)
+    sched.bind(names[0], p)
+    calls.clear()
+    sched._refresh_frag_gauges()
+    assert 0 < len(calls) <= 2  # only the dirtied node rescanned
+    na = sched.allocators[names[0]]
+    with na.lock:
+        frag, largest, _free = na.chips.fragmentation()
+    assert sched._frag_cache[names[0]] == (frag, largest)
+
+
+def test_batch_window_gate_sweeps_pending_gangs():
+    """Two gangs' first members arriving inside the window plan in ONE
+    sweep; each filter still returns its claimed slot."""
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster)
+    sched, gang, _status, _cs = build(cluster)
+    gang.batch_window_s = 0.15
+    gang.batch_min = 2
+    results = {}
+
+    def member(gname):
+        p = tpu_pod(f"{gname}-m0", core=400, gang=gname, gang_size=2)
+        cluster.create_pod(p)
+        ok, failed = gang.filter(sched, p, list(names))
+        results[gname] = (ok, failed)
+
+    t1 = threading.Thread(target=member, args=("ga",))
+    t2 = threading.Thread(target=member, args=("gb",))
+    t1.start()
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    for gname in ("ga", "gb"):
+        ok, failed = results[gname]
+        assert len(ok) == 1, failed
+    with gang._lock:
+        assert len(gang._plans) == 2
+
+
+def test_batch_window_infeasible_cached_rejection():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster, v5e_slices=1, v5p=False)
+    sched, gang, _status, _cs = build(cluster)
+    gang.batch_window_s = 0.05
+    gang.batch_min = 2
+    p = tpu_pod("hg-m0", core=400, gang="hg", gang_size=400)
+    cluster.create_pod(p)
+    ok, failed = gang.filter(sched, p, list(names))
+    assert ok == []
+    assert any("cannot fit" in m for m in failed.values())
+    # second member answers from the cached sweep verdict (no replan)
+    p2 = tpu_pod("hg-m1", core=400, gang="hg", gang_size=400)
+    cluster.create_pod(p2)
+    ok2, failed2 = gang.filter(sched, p2, list(names))
+    assert ok2 == []
+    assert any("cannot fit" in m for m in failed2.values())
+
+
+def test_entry_from_chips_matches_fragmentation():
+    cluster = FakeCluster()
+    names = mixed_fleet(cluster, v5e_slices=1, v5p=False)
+    sched, *_ = build(cluster)
+    sched.get_allocators(names)
+    na = sched.allocators[names[0]]
+    e = entry_from_chips(names[0], na.generation, na.chips)
+    frag, largest, free_n = na.chips.fragmentation()
+    assert (e.frag, e.largest, e.free_chips) == (frag, largest, free_n)
+    assert e.generation == "v5e"
+    assert e.topo_key == (na.chips.topo.dims, na.chips.topo.wrap)
+
+
+def test_oracle_mode_has_no_index():
+    cluster = FakeCluster()
+    mixed_fleet(cluster, v5e_slices=1, v5p=False)
+    sched, *_ = build(cluster, placement_index=False)
+    assert sched.index is None
+    # verbs still work end-to-end
+    p = tpu_pod("o1", core=100)
+    cluster.create_pod(p)
+    ok, _failed = sched.assume([n for n in sched.allocators] or
+                               [nd.metadata.name
+                                for nd in cluster.list_nodes()], p)
+    assert ok

@@ -1,0 +1,349 @@
+#!/usr/bin/env python
+"""CI gate for the cluster-scale placement path (`make check-cluster-scale`).
+
+Seeded 10k-node fleet soak (capacity index + batch admission sweep +
+journal on).  HARD-FAILS when any of:
+
+- **index/oracle divergence** — after the churn soak, any index entry
+  differs from a fresh recomputation off live chip state
+  (CapacityIndex.verify), any sampled filter/score verb answers
+  differently with the index on vs the full-rescan oracle, or the batch
+  sweep's plans are not placement-for-placement identical to the
+  per-gang loop's;
+- **journal/index drift** — replaying the journal trips a violation,
+  diverges from live /scheduler/status, or the index rebuilt from the
+  REPLAYED chip state (ReplayResult.index_snapshot) differs from the
+  live index's snapshot;
+- **bind-p99 budget breach** — the filter→score→bind cycle p99 over the
+  full candidate list exceeds CLUSTER_BIND_BUDGET_MS (storm-trimmed
+  p99-of-best-90% may save a throttled attempt; 3 attempts like
+  check-defrag — noise passes one, a real regression fails all);
+- **a batch sweep slower than the per-gang loop it replaces** (best of
+  3 interleaved attempts each).
+
+Usage:
+    python tools/check_cluster_scale.py [--nodes N] [--cycles N]
+
+Environment:
+    CLUSTER_SCALE_NODES      fleet size (default 10000)
+    CLUSTER_SCALE_SEED       RNG seed (default 20260804)
+    CLUSTER_SCALE_CYCLES     measured schedule cycles/attempt (default 120)
+    CLUSTER_BIND_BUDGET_MS   cycle-p99 budget (default 50, scaled by the
+                             per-box CPU reference like check-plan-budget)
+
+Wired into the Makefile as `make check-cluster-scale`, next to
+`check-fleet`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (plan_reference_trial_ms / calibrated budget)
+from tools.fleetgen import make_fleet  # noqa: E402
+from elastic_gpu_scheduler_tpu.cli import build_stack  # noqa: E402
+from elastic_gpu_scheduler_tpu.core.request import (  # noqa: E402
+    TPURequest,
+    TPUUnit,
+)
+from elastic_gpu_scheduler_tpu.journal import (  # noqa: E402
+    JOURNAL,
+    read_journal,
+)
+from elastic_gpu_scheduler_tpu.journal.replay import (  # noqa: E402
+    diff_live,
+    replay,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    FAILURES.append(msg)
+
+
+def note(msg: str) -> None:
+    print(f"  {msg}")
+
+
+def p99(xs):
+    xs = sorted(xs)
+    return xs[max(0, int(0.99 * len(xs)) - 1)] if xs else 0.0
+
+
+def trimmed_p99(xs):
+    xs = sorted(xs)
+    return p99(xs[: max(1, int(len(xs) * 0.9))])
+
+
+def gang_req(tag: str, members: int, chips: int) -> TPURequest:
+    return TPURequest(
+        pod_uid=f"chk-{tag}", pod_key=f"chk/{tag}",
+        units=(TPUUnit(core=0, hbm=0, chip_count=chips),),
+        container_names=("main",),
+        gang_name=tag, gang_size=members,
+    )
+
+
+def main() -> int:
+    nodes_n = int(os.environ.get("CLUSTER_SCALE_NODES", "10000"))
+    seed = int(os.environ.get("CLUSTER_SCALE_SEED", "20260804"))
+    cycles = int(os.environ.get("CLUSTER_SCALE_CYCLES", "120"))
+    for a in sys.argv[1:]:
+        if a.startswith("--nodes"):
+            nodes_n = int(a.split("=", 1)[1])
+        elif a.startswith("--cycles"):
+            cycles = int(a.split("=", 1)[1])
+    rng = random.Random(seed)
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    jdir = tempfile.mkdtemp(prefix="check-cluster-", dir=shm)
+    JOURNAL.configure(jdir, fsync="off")
+    try:
+        return run(nodes_n, seed, cycles, rng, jdir)
+    finally:
+        JOURNAL.close()
+        shutil.rmtree(jdir, ignore_errors=True)
+
+
+def run(nodes_n, seed, cycles, rng, jdir) -> int:
+    print(f"== cluster-scale gate: {nodes_n} nodes, seed {seed} ==")
+    cluster = FakeCluster()
+    names = make_fleet(cluster, nodes=nodes_n, seed=seed)
+    clientset = FakeClientset(cluster)
+    registry, _pred, _prio, _bind, _ctl, _status, gang = build_stack(
+        clientset, cluster=None, priority="binpack", gang_timeout=300.0,
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    t0 = time.perf_counter()
+    sched.get_allocators(names)
+    sched.index.fold()
+    note(f"prewarm: {len(names)} allocators in "
+         f"{(time.perf_counter() - t0) * 1000:.0f}ms")
+
+    serial = [0]
+
+    def mkpod(core):
+        serial[0] += 1
+        p = bench.tpu_pod(f"chk-{serial[0]}", core=core)
+        cluster.create_pod(p)
+        return p
+
+    # -- churn soak: binds/forgets through the real verbs ------------------
+    bound = []
+    for n in rng.sample(names, int(len(names) * 0.5)):
+        na = sched.allocators.get(n)
+        chips = na.chips.num_chips if na is not None else 4
+        p = mkpod(chips * 100)
+        try:
+            sched.bind(n, p)
+            bound.append(p)
+        except Exception as e:
+            fail(f"load bind on {n}: {e}")
+            break
+    for _ in range(len(names) // 10):
+        if bound and rng.random() < 0.4:
+            sched.forget_pod(bound.pop(rng.randrange(len(bound))))
+            continue
+        p = mkpod(rng.choice((50, 100, 200)))
+        ok, _failed = sched.assume(rng.sample(names, 512), p)
+        if ok:
+            try:
+                sched.bind(ok[0], p)
+                bound.append(p)
+            except Exception:
+                pass
+    note(f"soak: {serial[0]} pods churned, {len(bound)} live")
+
+    # -- 1. index/oracle divergence ----------------------------------------
+    problems = sched.index.verify()
+    if problems:
+        for pr in problems[:10]:
+            fail(f"index divergence: {pr}")
+    else:
+        note(f"index.verify clean over {len(names)} nodes")
+
+    for trial in range(8):
+        cand = rng.sample(names, 768)
+        p = bench.tpu_pod(f"par-{trial}", core=rng.choice((50, 100, 400)))
+        ok_i, failed_i = sched.assume(cand, p)
+        scores_i = sched.score(cand, p)
+        saved, sched.index = sched.index, None
+        try:
+            ok_o, failed_o = sched.assume(cand, p)
+            scores_o = sched.score(cand, p)
+        finally:
+            sched.index = saved
+        if ok_i != ok_o or set(failed_i) != set(failed_o):
+            fail(
+                f"filter parity: trial {trial}: index ok={len(ok_i)} "
+                f"oracle ok={len(ok_o)} (diff "
+                f"{set(ok_i) ^ set(ok_o) or set(failed_i) ^ set(failed_o)})"
+            )
+        if scores_i != scores_o:
+            bad = [i for i, (a, b) in enumerate(zip(scores_i, scores_o))
+                   if a != b]
+            fail(f"score parity: trial {trial}: {len(bad)} nodes differ "
+                 f"(first: {cand[bad[0]]})")
+    if not FAILURES:
+        note("filter/score parity: 8 sampled verbs identical index vs oracle")
+
+    # -- 2. batch sweep vs per-gang loop -----------------------------------
+    sweep_best = pergang_best = None
+    for attempt in range(3):
+        queue = [
+            (f"chk/sw{attempt}-{i}",
+             gang_req(f"sw{attempt}-{i}", rng.choice((8, 16, 32)), 4),
+             list(names))
+            for i in range(6)
+        ]
+        t0 = time.perf_counter()
+        for gkey, req, cand in queue:
+            planned = gang._plan(sched, req, cand)
+            if planned is not None:
+                planned.created = time.monotonic()
+                planned.member_units = req.units
+                planned.member_containers = req.container_names
+                planned.slot_units = [req.units] * len(planned.slots)
+                planned.slot_containers = (
+                    [req.container_names] * len(planned.slots)
+                )
+                with gang._lock:
+                    gang._plans[gkey] = planned
+        pergang_ms = (time.perf_counter() - t0) * 1000
+        with gang._lock:
+            loop_slots = {k: list(p.slots) for k, p in gang._plans.items()}
+            loop_opts = {
+                k: [o.coords_by_container() for o in p.options]
+                for k, p in gang._plans.items()
+            }
+            gang._plans.clear()
+        t0 = time.perf_counter()
+        swept = gang.plan_batch(sched, queue)
+        sweep_ms = (time.perf_counter() - t0) * 1000
+        sweep_slots = {
+            k: list(p.slots) for k, p in swept.items() if p is not None
+        }
+        sweep_opts = {
+            k: [o.coords_by_container() for o in p.options]
+            for k, p in swept.items() if p is not None
+        }
+        with gang._lock:
+            gang._plans.clear()
+        if loop_slots != sweep_slots or loop_opts != sweep_opts:
+            fail(
+                f"sweep parity: attempt {attempt}: batch plans differ from "
+                f"the per-gang loop (slots equal: "
+                f"{loop_slots == sweep_slots})"
+            )
+        sweep_best = min(sweep_ms, sweep_best or sweep_ms)
+        pergang_best = min(pergang_ms, pergang_best or pergang_ms)
+    note(f"sweep {sweep_best:.0f}ms vs per-gang loop {pergang_best:.0f}ms "
+         f"(best of 3)")
+    if sweep_best > pergang_best:
+        fail(
+            f"batch sweep slower than the per-gang loop it replaces "
+            f"({sweep_best:.0f}ms > {pergang_best:.0f}ms)"
+        )
+
+    # -- 3. bind-p99 budget (storm-trimmed, 3 attempts) --------------------
+    base = float(os.environ.get("CLUSTER_BIND_BUDGET_MS", "50"))
+    attempts = []
+    passed = False
+    for attempt in range(3):
+        ref = [bench.plan_reference_trial_ms()]
+        cycle_ms = []
+        for i in range(cycles):
+            if bound and rng.random() < 0.3:
+                sched.forget_pod(bound.pop(rng.randrange(len(bound))))
+            p = mkpod(100)
+            t0 = time.perf_counter()
+            ok, _failed = sched.assume(names, p)
+            if not ok:
+                continue
+            scores = sched.score(ok[:256], p)
+            best = ok[max(range(len(scores)), key=scores.__getitem__)]
+            sched.bind(best, p)
+            cycle_ms.append((time.perf_counter() - t0) * 1000)
+            bound.append(p)
+        ref.append(bench.plan_reference_trial_ms())
+        budget, _refmin, scale = bench.calibrated_plan_budget(base, ref)
+        raw = p99(cycle_ms)
+        trimmed = trimmed_p99(cycle_ms)
+        attempts.append(round(raw, 2))
+        note(
+            f"attempt {attempt}: bind p99 {raw:.1f}ms "
+            f"(trimmed {trimmed:.1f}ms) vs budget {budget:.0f}ms "
+            f"(scale {scale:.2f})"
+        )
+        if raw <= budget or trimmed <= budget:
+            passed = True
+            break
+    if not passed:
+        fail(
+            f"cluster bind p99 over budget on every attempt "
+            f"({attempts}ms vs {base}ms base)"
+        )
+
+    # -- 4. journal replay rebuilds the index ------------------------------
+    JOURNAL.flush()
+    events = read_journal(jdir)
+    res = replay(events)
+    if res.violations:
+        for v in res.violations[:10]:
+            fail(f"replay violation: {v}")
+    live_status = sched.status()
+    diffs = diff_live(res, live_status)
+    if diffs:
+        for d in diffs[:10]:
+            fail(f"replay/live diff: {d}")
+    sched.index.fold()
+    live_idx = sched.index.snapshot()
+    replayed_idx = res.index_snapshot()
+    if replayed_idx != live_idx:
+        bad = [
+            n for n in set(live_idx) | set(replayed_idx)
+            if live_idx.get(n) != replayed_idx.get(n)
+        ]
+        fail(
+            f"replayed index != live index: {len(bad)} node(s) differ "
+            f"(first: {bad[0]}: live={live_idx.get(bad[0])} "
+            f"replayed={replayed_idx.get(bad[0])})"
+        )
+    else:
+        note(
+            f"journal replay: {res.records} records, index rebuilt "
+            f"identical over {len(replayed_idx)} nodes"
+        )
+
+    print()
+    summary = {
+        "nodes": len(names),
+        "index_stats": sched.index.stats(),
+        "sweep_ms": round(sweep_best, 1),
+        "pergang_ms": round(pergang_best, 1),
+        "bind_p99_attempts_ms": attempts,
+        "failures": len(FAILURES),
+    }
+    print(json.dumps(summary))
+    if FAILURES:
+        print(f"check-cluster-scale: {len(FAILURES)} failure(s)")
+        return 1
+    print("check-cluster-scale: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

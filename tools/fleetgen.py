@@ -1,0 +1,108 @@
+"""Synthetic TPU fleet generator: O(10k)-node clusters for the bench's
+cluster section and the check-cluster-scale gate.
+
+Builds a mixed v5e/v5p/v6e fleet the way GKE would label it — hosts tile
+ICI slices (slice topology + host topology + host offset labels), every
+slice fully populated — plus seeded, deterministic churn and gang-arrival
+traces.  Everything is keyed off one RNG seed so a failure reproduces
+bit-for-bit.
+
+Shared by bench.py (cluster section) and tools/check_cluster_scale.py so
+the CI gate and the artifact can never measure different fleets.
+"""
+
+from __future__ import annotations
+
+import random
+
+# slice templates: (generation, slice topology, host topology, hbm GiB,
+# hosts per slice).  Host = one k8s node (4 chips, the GKE shape).
+SLICE_TEMPLATES = (
+    ("v5e", "4x4", "2x2", 64, 4),
+    ("v5e", "8x8", "2x2", 64, 16),
+    ("v5p", "4x4x4", "2x2x1", 380, 16),
+    ("v6e", "4x4", "2x2", 96, 4),
+)
+# relative weight of each template in the mix
+SLICE_WEIGHTS = (5, 2, 2, 3)
+
+
+def _host_offsets(slice_dims, host_dims):
+    """Row-major origins of host tiles inside the slice."""
+    steps = [range(0, s, h) for s, h in zip(slice_dims, host_dims)]
+    out = [()]
+    for axis in steps:
+        out = [o + (v,) for o in out for v in axis]
+    return out
+
+
+def make_fleet(cluster, nodes: int = 10000, seed: int = 20260804) -> list:
+    """Populate ``cluster`` (FakeCluster) with ~``nodes`` hosts of mixed
+    generations; returns the node names in creation order.  The count is
+    rounded up to whole slices so no slice is ever partially populated
+    (a torn slice would make ICI-locality scores meaningless)."""
+    from elastic_gpu_scheduler_tpu.k8s.objects import make_tpu_node
+
+    rng = random.Random(seed)
+    names: list[str] = []
+    slice_serial = 0
+    while len(names) < nodes:
+        gen, slice_topo, host_topo, hbm, _hosts = rng.choices(
+            SLICE_TEMPLATES, weights=SLICE_WEIGHTS
+        )[0]
+        slice_dims = tuple(int(d) for d in slice_topo.split("x"))
+        host_dims = tuple(int(d) for d in host_topo.split("x"))
+        chips_per_host = 1
+        for d in host_dims:
+            chips_per_host *= d
+        slice_name = f"{gen}-slice-{slice_serial}"
+        slice_serial += 1
+        for hi, offset in enumerate(_host_offsets(slice_dims, host_dims)):
+            name = f"{slice_name}-h{hi}"
+            cluster.add_node(
+                make_tpu_node(
+                    name,
+                    chips=chips_per_host,
+                    hbm_gib=hbm * chips_per_host // 4,
+                    accelerator=gen,
+                    slice_topology=slice_topo,
+                    host_topology=host_topo,
+                    host_offset=".".join(map(str, offset)),
+                    slice_name=slice_name,
+                )
+            )
+            names.append(name)
+    return names
+
+
+def churn_trace(node_names: list, ops: int, seed: int = 1,
+                whole_pct: float = 0.6) -> list:
+    """Seeded bind/forget op stream: ``("bind", pod_serial, core_units)``
+    and ``("forget", bind_serial)`` tuples.  ~60% whole-chip pods (100 or
+    200 core), the rest fractional — the tpushare mix.  Forgets reference
+    earlier binds by serial; the consumer resolves them against whatever
+    actually bound."""
+    rng = random.Random(seed)
+    trace: list = []
+    live: list[int] = []
+    for i in range(ops):
+        if live and rng.random() < 0.35:
+            victim = live.pop(rng.randrange(len(live)))
+            trace.append(("forget", victim))
+            continue
+        if rng.random() < whole_pct:
+            core = rng.choice((100, 100, 200, 400))
+        else:
+            core = rng.choice((30, 50, 60))
+        trace.append(("bind", i, core))
+        live.append(i)
+    return trace
+
+
+def gang_trace(count: int, seed: int = 2,
+               sizes=(8, 16, 32, 64), chips=(4,)) -> list:
+    """Seeded gang arrivals: ``(gang_serial, members, chips_per_member)``."""
+    rng = random.Random(seed)
+    return [
+        (i, rng.choice(sizes), rng.choice(chips)) for i in range(count)
+    ]
