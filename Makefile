@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -83,6 +83,19 @@ check-fleet:
 # ×3 attempts), or a batch sweep slower than the per-gang loop.
 check-cluster-scale:
 	python tools/check_cluster_scale.py
+
+# Policy-plane gate: end-to-end promotion of a hot-loaded scheduling
+# policy — hard-fails unless the replay gate BLOCKS a worse candidate
+# and passes an equivalent one, canary decisions journal on both
+# pod-hash arms with non-zero divergence, promotion swaps the engine
+# rater, a faulting policy falls back to the incumbent without failing
+# a bind, an injected SLO regression auto-rolls the canary back,
+# journal replay reconstructs every canary decision with zero
+# violations, what-if under a policy spelling out binpack is
+# bit-identical to the built-in, and the policy-backed bind p99 stays
+# within POLICY_OVERHEAD_BUDGET_PCT (default 5%).
+check-policy:
+	python tools/check_policy.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

@@ -683,6 +683,122 @@ def profile_bench(chunks: int = 30, chunk_n: int = 40) -> dict:
     }
 
 
+POLICY_BINPACK_EXPR = (
+    "35*node_used + 30*chip_used + 25*preserve + 10*locality"
+)
+POLICY_SPREADY_EXPR = (
+    "50*(1 - node_used) + 35*(1 - chip_used) + 15*locality"
+)
+
+
+def policy_bench(chunks: int = 40, chunk_n: int = 40) -> dict:
+    """Programmable-policy-plane cost (policy/): what a hot-loaded
+    score policy adds to the bind path.
+
+    Three numbers:
+
+    - ``policy_eval_ns``: raw VM cost of one eval of the binpack-
+      equivalent expression (compile once, tight loop) — the sandbox's
+      floor, independent of input-fill cost.
+    - ``policy_overhead_pct``: bind p99 with the engine rater swapped to
+      a policy-backed binpack (incumbent fallback) vs the built-in —
+      the interleaved-chunk + pooled-p99 estimator
+      ``journal_overhead_bench`` documents (throttling storms hit both
+      modes), plus the storm-trimmed variant.  POLICY_OVERHEAD_BUDGET_PCT
+      (default 5) is the check-policy gate's budget.
+    - ``policy_canary_divergence_pct``: a spread-flavored candidate
+      canarying at 50% of binds against a binpack incumbent — the
+      fraction of journaled canary decisions whose cross-scored arms
+      disagree (a binpack-equivalent candidate measures ~0 here; the
+      spread one must measure > 0 or the divergence plumbing is dead).
+
+    Pure scheduler plane (no jax, no HTTP); the full promotion workflow
+    is gated by `make check-policy`."""
+    from elastic_gpu_scheduler_tpu.core.rater import Binpack
+    from elastic_gpu_scheduler_tpu.policy import (
+        VERB_INPUTS,
+        compile_expr,
+        evaluate,
+    )
+    from elastic_gpu_scheduler_tpu.policy.rater import PolicyRater
+    from elastic_gpu_scheduler_tpu.policy.registry import PolicyPlane
+
+    # 1) raw eval rate on the HOT path (the generated closure when the
+    # program fits its budget; interpreter otherwise)
+    prog = compile_expr(POLICY_BINPACK_EXPR, VERB_INPUTS["score"])
+    vals = [0.5, 0.25, 0.8, 1.0][: len(prog.slots)]
+    n_evals = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_evals):
+        evaluate(prog, vals)
+    eval_ns = (time.perf_counter() - t0) / n_evals * 1e9
+
+    # 2) bind p99, built-in vs policy-backed rater, interleaved chunks
+    lats_off: list[float] = []
+    lats_on: list[float] = []
+    cluster = FakeCluster()
+    v5e_pool(cluster, n=2)
+    clientset = FakeClientset(cluster)
+    registry, *_ = build_stack(clientset, cluster=None, priority="binpack")
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    builtin = sched.rater
+    policy_rater = PolicyRater(
+        prog, fallback=Binpack(), name="bench-binpack",
+        translation_invariant=True, whole_chip_compact_first=True,
+    )
+    serial = 0
+    for chunk in range(chunks):
+        on = bool(chunk % 2)
+        sched.rater = policy_rater if on else builtin
+        sink = lats_on if on else lats_off
+        for _ in range(chunk_n):
+            serial += 1
+            pod = tpu_pod(f"pol-{serial}", core=50, hbm=2)
+            cluster.create_pod(pod)
+            t0 = time.perf_counter()
+            sched.bind("node-0", pod)
+            sink.append(time.perf_counter() - t0)
+            sched.forget_pod(pod)
+            time.sleep(0.002)
+    sched.rater = builtin
+
+    # 3) canary divergence through a DEDICATED plane (the process-global
+    # one must not leak bench policies into whoever runs next)
+    plane = PolicyPlane()
+    plane.attach(registry.values())
+    plane.load(
+        "bench-spready", "score", POLICY_SPREADY_EXPR,
+        canary_pct=50.0, skip_gate=True,
+    )
+    for i in range(120):
+        serial += 1
+        pod = tpu_pod(f"cnry-{serial}", core=50, hbm=2)
+        cluster.create_pod(pod)
+        sched.bind("node-1", pod)
+        sched.forget_pod(pod)
+    divergence = plane.divergence_pct("score")
+    plane.reset()
+
+    off_ms = p99(lats_off) * 1000
+    on_ms = p99(lats_on) * 1000
+    trim_off = sorted(lats_off)[: int(len(lats_off) * 0.9)]
+    trim_on = sorted(lats_on)[: int(len(lats_on) * 0.9)]
+    off_best = p99(trim_off) * 1000
+    on_best = p99(trim_on) * 1000
+    return {
+        "policy_eval_ns": round(eval_ns, 1),
+        "bind_p99_policy_off_ms": round(off_ms, 3),
+        "bind_p99_policy_on_ms": round(on_ms, 3),
+        "policy_overhead_pct": round(
+            (on_ms / off_ms - 1.0) * 100, 2
+        ) if off_ms > 0 else 0.0,
+        "policy_overhead_trimmed_pct": round(
+            (on_best / off_best - 1.0) * 100, 2
+        ) if off_best > 0 else 0.0,
+        "policy_canary_divergence_pct": round(divergence, 2),
+    }
+
+
 def cluster_bench(
     nodes_n: int | None = None,
     seed: int | None = None,
@@ -2299,6 +2415,23 @@ def main():
             )
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["profile_bench_error"] = str(e)[:300]
+
+    # programmable policy plane: raw VM eval cost, bind p99 with a
+    # policy-backed rater vs the built-in, and canary divergence
+    # (tools/check_policy.py gates the full promotion workflow; these
+    # keys track the overhead trend).  Guarded like the journal bench.
+    try:
+        results.update(policy_bench())
+        if results["policy_overhead_pct"] > 5.0:
+            print(
+                f"# WARNING: policy-backed bind p99 "
+                f"{results['bind_p99_policy_on_ms']}ms is "
+                f"{results['policy_overhead_pct']}% over built-in "
+                f"{results['bind_p99_policy_off_ms']}ms (budget 5%)",
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["policy_bench_error"] = str(e)[:300]
 
     # overlapped decode pipeline: host gap + speedup vs the sequential
     # loop, measured on CPU so the keys land in EVERY artifact (the same

@@ -17,7 +17,6 @@ import sys
 import threading
 
 from .controller.controller import Controller
-from .core.rater import get_rater
 from .k8s.client import FakeClientset, RestClientset
 from .k8s.fake import FakeCluster
 from .k8s.objects import make_tpu_node
@@ -58,7 +57,12 @@ def build_stack(
     from .core.native import get_placement
 
     get_placement()
-    rater = get_rater(priority)
+    # ONE registry resolves every rater spec (built-ins, profile-aware
+    # wrapping, policy-plane expressions) — the journal CLI's --rater
+    # goes through the same lookup (policy/registry.py)
+    from .policy import POLICIES, default_gate_events, resolve_rater
+
+    rater = resolve_rater(priority)
     config = SchedulerConfig(
         clientset=clientset, rater=rater, placement_index=placement_index,
     )
@@ -82,6 +86,17 @@ def build_stack(
         interval_s=defrag_interval,
         min_interval_s=defrag_min_interval,
     )
+    # programmable policy plane: the process-global plane steers every
+    # engine (score canaries split the bind path, filter policies prune
+    # assume + the gang prefilter, defrag policies re-rank victims).
+    # Zero-cost until a policy is loaded; the replay gate reads the live
+    # journal, SLO frag regression reads the engine's frag snapshot.
+    POLICIES.attach(registry.values())
+    gang.defrag.policies = POLICIES
+    POLICIES.gate_events_fn = default_gate_events
+    first_engine = next(iter(registry.values()), None)
+    if first_engine is not None:
+        POLICIES.frag_provider = first_engine.frag_snapshot
     predicate = Predicate(registry, gang=gang)
     prioritize = Prioritize(registry)
     bind = Bind(registry, clientset, gang=gang)
@@ -111,7 +126,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "--priority",
         default="binpack",
-        help="placement policy: binpack|spread|random|ici-locality",
+        help="placement policy: binpack|spread|random|ici-locality, "
+        "profile-aware[:BASE], or policy:FILE[:BASE] (a policy-plane "
+        "expression file; BASE = fallback rater on fault).  Hot-loaded "
+        "policies are managed at runtime via POST /policy/load",
     )
     p.add_argument(
         "--mode", default="tpushare", help="scheduler mode: tpushare (fractional + whole-chip) or tpuwhole (whole-chip exclusive admission for latency-SLO clusters); exactly one"
@@ -335,7 +353,9 @@ def main(argv=None) -> int:
     )
 
     try:
-        get_rater(args.priority)
+        from .policy import resolve_rater
+
+        resolve_rater(args.priority)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -484,6 +504,7 @@ def main(argv=None) -> int:
         # both ports answer /debug/fleet with the SAME combined payload
         router.state_provider = fleet_state.debug_state
 
+    from .policy import POLICIES
     from .server.handlers import Preemption
 
     server = ExtenderServer(
@@ -495,6 +516,7 @@ def main(argv=None) -> int:
         leader_check=elector.is_leader if elector is not None else None,
         defrag=defrag,
         fleet=fleet_state,
+        policy=POLICIES,
     )
 
     stop = threading.Event()

@@ -285,6 +285,11 @@ class DefragPlanner:
         # HA: callable → bool; standbys must not migrate (the HTTP layer
         # gates verbs the same way).  None = always the leader.
         self.leader_check = None
+        # programmable policy plane: a loaded ``defrag`` verb policy
+        # replaces the built-in victim orderings below (HIGHER score =
+        # move first; a faulting policy falls back per victim).  None /
+        # empty plane = one attribute check per round, zero per bind.
+        self.policies = None
         self._lock = TimedLock("defrag", rank=15)
         self._last_round = 0.0  # monotonic; rate-limits try_unblock
         self._rounds_run = 0
@@ -445,6 +450,32 @@ class DefragPlanner:
 
     # -- planning -------------------------------------------------------------
 
+    def _order_victims(self, pool: list, node_free: int, default) -> list:
+        """Victim ordering for the planning rounds: ``default`` key (the
+        built-in heuristic) unless a ``defrag`` policy is loaded, in
+        which case victims order by DESCENDING policy score — the
+        operator's preference for who moves first.  A policy that
+        faults on ANY victim falls back to the built-in order for the
+        WHOLE pool (journaled as a ``policy_fault`` by the plane) —
+        mixing policy scores with built-in key values in one sort would
+        order faulted victims arbitrarily, not by either rule."""
+        plane = self.policies
+        if plane is None or not plane.wants("defrag"):
+            return sorted(pool, key=default)
+        scores = {}
+        for v in pool:
+            s = plane.defrag_score({
+                "chips": float(v.chips),
+                "priority": float(v.priority),
+                "whole": 1.0 if v.whole else 0.0,
+                "is_gang": 1.0 if v.gang else 0.0,
+                "node_free": float(node_free),
+            })
+            if s is None:
+                return sorted(pool, key=default)
+            scores[v.pod_key] = s
+        return sorted(pool, key=lambda v: (-scores[v.pod_key], default(v)))
+
     def _place_victim(self, sched, v: _Victim, dest: ChipSet):
         """Re-place one victim on ``dest`` (a round clone: placements
         already applied, evictions NOT — so only round-start-free chips
@@ -484,8 +515,8 @@ class DefragPlanner:
             if budget - len(moves) <= 0:
                 break
             deficit = count - free[target]
-            pool = sorted(
-                victims.get(target, []), key=lambda v: -v.chips
+            pool = self._order_victims(
+                victims.get(target, []), free[target], lambda v: -v.chips
             )
             chosen: list[_Victim] = []
             for v in pool:
@@ -580,7 +611,9 @@ class DefragPlanner:
             idx, largest, _free = cs.fragmentation()
             if idx <= self.threshold:
                 continue
-            for v in sorted(victims.get(node, []), key=lambda v: v.chips):
+            for v in self._order_victims(
+                victims.get(node, []), cs.free_count(), lambda v: v.chips
+            ):
                 if len(moves) >= budget:
                     return self._apply_evictions(clones, evictions, moves)
                 if not v.whole:

@@ -51,9 +51,12 @@ def main(argv=None) -> int:
         "--rater",
         default="",
         help="what-if replay: re-place the recorded workload under this "
-        "placement policy (binpack|spread|random|ici-locality, or "
-        "profile-aware[:BASE] — geometry BASE scaled by the journal's "
-        "recorded `profile` records; default base ici-locality)",
+        "placement policy.  One registry serves this flag and the "
+        "scheduler's --priority (policy.registry.resolve_rater): "
+        "binpack|spread|random|ici-locality, profile-aware[:BASE] "
+        "(geometry BASE scaled by the journal's recorded `profile` "
+        "records), or policy:FILE[:BASE] (a policy-plane expression "
+        "file; BASE = fallback rater on fault)",
     )
     rp.add_argument(
         "--json", action="store_true", help="machine-readable output"
@@ -93,19 +96,14 @@ def main(argv=None) -> int:
         out["live_diff"] = diffs
         failed = failed or bool(diffs)
     if args.rater:
-        from ..core.rater import get_rater
+        # ONE registry lookup for built-ins, profile-aware wrapping and
+        # policy-plane expressions — the same resolver the scheduler's
+        # --priority flag uses (policy/registry.py), so the two CLIs can
+        # never drift on spec parsing
+        from ..policy.registry import resolve_rater
 
         try:
-            if args.rater.split(":", 1)[0] == "profile-aware":
-                # measured-behavior scoring from the journal's own
-                # recorded `profile` records (profile/rater.py); an
-                # optional :BASE names the geometry rater it scales
-                from ..profile.rater import ProfileAwareRater
-
-                _, _, base = args.rater.partition(":")
-                rater = ProfileAwareRater(get_rater(base) if base else None)
-            else:
-                rater = get_rater(args.rater)
+            rater = resolve_rater(args.rater)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2

@@ -118,6 +118,15 @@ class ReplayResult:
     # gang resize transactions verified (each checked against the chip-
     # conservation and membership all-or-nothing invariants)
     resizes: int = 0
+    # policy-plane annotations (policy/ subsystem): lifecycle events
+    # (load/gate/canary/promote/rollback) + canary bind decisions, and
+    # runtime faults.  ``policy_decisions`` rebuilds WHICH policy (and
+    # which canary arm) decided every journaled canary bind — the
+    # replay-reconstructs-every-decision guarantee check-policy gates.
+    policy_records: int = 0
+    policy_faults: int = 0
+    last_policy: Optional[dict] = None
+    policy_decisions: dict = field(default_factory=dict)  # pod → decision
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -142,6 +151,9 @@ class ReplayResult:
             "profile_records": self.profiles,
             "fleet_records": self.fleet_records,
             "resizes": self.resizes,
+            "policy_records": self.policy_records,
+            "policy_faults": self.policy_faults,
+            "policy_decisions": len(self.policy_decisions),
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -448,6 +460,32 @@ def replay(events: list[dict]) -> ReplayResult:
                 "profiles": rec.get("profiles") or {},
                 "interference": rec.get("interference") or {},
             }
+        elif t == "policy":
+            # policy-plane annotation (policy/ subsystem): lifecycle
+            # events and canary bind decisions.  Participates in the
+            # dense-seq audit, never mutates allocator state.  Decide
+            # records rebuild the pod → (policy, arm) map so replay can
+            # answer "which policy decided this bind".
+            res.policy_records += 1
+            res.last_policy = {"seq": seq, **{
+                k: rec.get(k)
+                for k in ("action", "verb", "name", "pod", "arm")
+                if rec.get(k) is not None
+            }}
+            if rec.get("action") == "canary_decide" and rec.get("pod"):
+                res.policy_decisions[rec["pod"]] = {
+                    "seq": seq,
+                    "name": rec.get("name"),
+                    "verb": rec.get("verb"),
+                    "arm": rec.get("arm"),
+                    "score": rec.get("score"),
+                    "score_other": rec.get("score_other"),
+                    "divergence": rec.get("divergence"),
+                }
+        elif t == "policy_fault":
+            # a policy runtime fault (budget/deadline/math): the verb
+            # fell back to the incumbent built-in — annotation only
+            res.policy_faults += 1
         elif t == "fleet":
             # autoscaler evaluation (fleet/ subsystem): an annotation
             # like `profile` — the signals + decision stream that
@@ -630,6 +668,45 @@ def what_if(events: list[dict], rater: Rater) -> dict:
     profiles_seen = 0
     scores: list[float] = []
     rec_scores: list[float] = []
+    # rater-NEUTRAL packing quality, sampled after every re-placed bind:
+    # the cluster-wide fraction of fully-free chips.  A policy that
+    # scatters fractional tenants across untouched chips burns whole-free
+    # chips a consolidating one preserves — measured in chips, not in any
+    # rater's own score scale, so the promotion gate can compare two
+    # raters on it.  Maintained incrementally (free counts re-read only
+    # for the node a record touched), so the sweep stays O(records).
+    free_cache: dict[str, int] = {}
+    chips_cache: dict[str, int] = {}
+    free_sum = 0
+    total_chips_sum = 0
+    preserve_samples = 0
+    preserve_acc = 0.0
+
+    def _free_resync(node: str) -> None:
+        nonlocal free_sum
+        cs = nodes.get(node)
+        old_free = free_cache.get(node)
+        if old_free is not None:
+            free_sum -= old_free
+        if cs is None:
+            free_cache.pop(node, None)
+            return
+        new = cs.free_count()
+        free_cache[node] = new
+        free_sum += new
+
+    def _total_resync(node: str) -> None:
+        # per-node delta, like _free_resync — a full re-sum per
+        # node_add record would make the sweep O(nodes²) on a
+        # 10k-node fleet's journal
+        nonlocal total_chips_sum
+        cs = nodes.get(node)
+        total_chips_sum -= chips_cache.get(node, 0)
+        if cs is None:
+            chips_cache.pop(node, None)
+        else:
+            chips_cache[node] = cs.num_chips
+            total_chips_sum += cs.num_chips
     # profile-aware raters consume the recorded profile stream and each
     # bind's workload class/target generation; both hooks are duck-typed
     # so geometry raters replay exactly as before
@@ -660,6 +737,9 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                     # what-if policy only re-places binds it witnesses)
                     cs.transact(opt)
                     placed[p["pod"]] = (p["node"], opt)
+            for name in nodes:
+                _free_resync(name)
+                _total_resync(name)
             continue
         if booted and rec.get("seq", -1) <= boot_as_of:
             continue  # already reflected in the boot snapshot
@@ -670,11 +750,13 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             if observe_profile is not None:
                 observe_profile(rec)
             continue
-        if t in ("fleet", "resize"):
-            # annotations (autoscaler evaluations / resize summaries):
-            # the member binds/forgets/migrates around a resize carry the
-            # state changes; scoring a scaling POLICY offline is
-            # fleet.autoscaler.score_policy's job, not the rater's
+        if t in ("fleet", "resize", "policy", "policy_fault"):
+            # annotations (autoscaler evaluations / resize summaries /
+            # policy-plane events): the member binds/forgets/migrates
+            # around a resize carry the state changes; scoring a scaling
+            # POLICY offline is fleet.autoscaler.score_policy's job, and
+            # the policy plane's own decision trail must not perturb a
+            # what-if re-run that may itself be gating a policy
             continue
         if t in ("node_add", "node_resync"):
             try:
@@ -692,6 +774,8 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                     if n == node and cs.can_transact(opt):
                         cs.transact(opt)
             nodes[node] = cs
+            _free_resync(node)
+            _total_resync(node)
         elif t == "bind":
             node = rec.get("node")
             cs = nodes.get(node)
@@ -730,6 +814,10 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                     contiguous += 1
             cs.transact(opt)
             placed[rec.get("pod")] = (node, opt)
+            _free_resync(node)
+            if total_chips_sum > 0:
+                preserve_acc += free_sum / total_chips_sum
+                preserve_samples += 1
         elif t == "migrate":
             # defrag relocation (mirrors replay()'s handling — see the
             # MAINTENANCE NOTE above): free the what-if placement, then
@@ -749,6 +837,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             cs = nodes.get(node)
             if cs is not None and cs.can_cancel(opt):
                 cs.cancel(opt)
+                _free_resync(node)
             to = rec.get("node")
             cs = nodes.get(to)
             if cs is None:
@@ -771,6 +860,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                 opt = recorded_new
             cs.transact(opt)
             placed[pod] = (to, opt)
+            _free_resync(to)
         elif t == "forget":
             entry = placed.pop(rec.get("pod"), None)
             if entry is not None:
@@ -778,12 +868,26 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                 cs = nodes.get(node)
                 if cs is not None and cs.can_cancel(opt):
                     cs.cancel(opt)
+                    _free_resync(node)
+    # rater-NEUTRAL end-state quality: mean fragmentation index over the
+    # final node states.  The policy plane's replay gate judges a
+    # candidate on this (plus placed/contiguous_frac) rather than on the
+    # raters' OWN scores — two raters' score scales are not comparable,
+    # and a candidate must not be able to gate itself through by
+    # awarding 100 to everything.
+    frag_vals = [cs.fragmentation()[0] for cs in nodes.values()]
     return {
         "rater": rater.name,
         "binds": binds,
         "placed": binds - unplaced,
         "unplaced": unplaced,
         "profile_records": profiles_seen,
+        "final_frag_mean": round(
+            sum(frag_vals) / len(frag_vals), 4
+        ) if frag_vals else 0.0,
+        "mean_free_chip_frac": round(
+            preserve_acc / preserve_samples, 4
+        ) if preserve_samples else 0.0,
         "mean_score": round(sum(scores) / len(scores), 3) if scores else 0.0,
         "contiguous_frac": round(contiguous / binds, 4) if binds else 0.0,
         "recorded_mean_score": (

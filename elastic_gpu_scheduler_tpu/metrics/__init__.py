@@ -482,6 +482,26 @@ FLEET_SCALE_LATENCY = REGISTRY.register(
         buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
     )
 )
+POLICY_EVALS = REGISTRY.register(
+    Counter(
+        "tpu_policy_evals_total",
+        "Hot-loaded policy evaluations by verb (score/filter/preempt/"
+        "defrag/kv) and outcome: ok, fault (budget trip / deadline / "
+        "math fault → fell back to the incumbent built-in), or — for "
+        "canary score decisions — the arm that decided (candidate/"
+        "incumbent)",
+        ("verb", "outcome"),
+    )
+)
+POLICY_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_policy_events_total",
+        "Policy-plane lifecycle events: load, gate_pass, gate_block "
+        "(replay gate refused a worse candidate), promote, rollback "
+        "(operator or automatic SLO rollback), fault",
+        ("event",),
+    )
+)
 
 
 class _LockWaitHistogram(Histogram):

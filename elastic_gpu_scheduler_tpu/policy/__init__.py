@@ -1,0 +1,43 @@
+"""Programmable policy plane (ROADMAP item 4, gpu_ext direction).
+
+Operators hot-load sandboxed scheduling policies — small deterministic
+expressions compiled to stack bytecode (``lang``/``vm``) with strict
+instruction budgets and per-eval wall deadlines — onto five verbs
+(score / filter / preempt / defrag / kv) without a redeploy.  Promotion
+is safe by construction: a candidate must beat the incumbent on journal
+what-if replay of recorded workload, then canaries on a deterministic
+pod-hash fraction of live binds with automatic SLO rollback
+(``promotion``/``registry``).  Every decision and every runtime fault
+is journaled; replay reconstructs which policy decided every bind.
+
+See OPERATIONS.md "Programmable policy plane" for the language
+reference, verb input tables, and the load→gate→canary→promote
+workflow.
+"""
+
+from .lang import CompileError, compile_expr
+from .rater import PolicyRater, VERB_INPUTS
+from .registry import (
+    POLICIES,
+    PolicyPlane,
+    canary_bucket,
+    default_gate_events,
+    resolve_rater,
+)
+from .vm import PolicyFault, Program, evaluate, run
+
+__all__ = [
+    "CompileError",
+    "POLICIES",
+    "PolicyFault",
+    "PolicyPlane",
+    "PolicyRater",
+    "Program",
+    "VERB_INPUTS",
+    "canary_bucket",
+    "compile_expr",
+    "default_gate_events",
+    "evaluate",
+    "resolve_rater",
+    "run",
+]

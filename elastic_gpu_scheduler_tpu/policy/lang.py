@@ -1,0 +1,585 @@
+"""The policy expression language: lexer, parser, compiler.
+
+One expression per policy — no statements, no loops, no assignment.
+Grammar (C-ish precedence, short-circuit logical ops and ternary):
+
+    expr    := or ('?' expr ':' expr)?
+    or      := and (('or' | '||') and)*
+    and     := not (('and' | '&&') not)*
+    not     := ('not' | '!') not | cmp
+    cmp     := sum (('<' '<=' '>' '>=' '==' '!=') sum)?
+    sum     := term (('+' | '-') term)*
+    term    := unary (('*' | '/' | '%') unary)*
+    unary   := '-' unary | atom
+    atom    := NUMBER | NAME | FUNC '(' expr (',' expr)* ')' | '(' expr ')'
+
+Booleans are floats (true = 1.0, false = 0.0; anything non-zero is
+truthy).  ``?:``, ``and`` and ``or`` SHORT-CIRCUIT — the untaken branch
+is never executed, so ``x != 0 ? y / x : 0`` is total even at x == 0.
+Functions: ``min``/``max`` (2+ args), ``abs``, ``floor``, ``ceil``,
+``clamp(x, lo, hi)``.  Constants: ``true``, ``false``.
+
+Every NAME must be one of the verb's declared inputs (``rater.py``
+documents the per-verb tables); an unknown name is a COMPILE error, so
+a typo can never become a silent 0.0 at runtime.  Left-associative
+``+``/``*`` compile in source order, which is what makes a policy
+spelling out the built-in binpack formula score BIT-IDENTICAL to it.
+
+The compiler parses to a small AST and emits it TWICE:
+
+- stack bytecode for the :mod:`.vm` interpreter — the auditable,
+  budget-enforced canonical form (``Program.disasm``, fingerprints,
+  the runtime instruction budget + wall deadline);
+- when the program's STATIC instruction count fits its budget (so the
+  budget could never trip at runtime — the code is loop-free and
+  straight-line, so executed ≤ static), a restricted Python closure
+  over the same input vector, used on the bind hot path.  The closure
+  is generated from the AST (never from operator text), sees no
+  builtins beyond the arithmetic helpers, and preserves fault
+  semantics exactly (division by zero / non-finite results raise
+  :class:`~.vm.PolicyFault`).  Property tests pin closure ≡ VM
+  bit-identical on random programs and inputs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+
+from .vm import (
+    DEFAULT_BUDGET,
+    DEFAULT_DEADLINE_S,
+    MAX_BUDGET,
+    OP_ABS,
+    OP_ADD,
+    OP_CEIL,
+    OP_CLAMP,
+    OP_CONST,
+    OP_DIV,
+    OP_EQ,
+    OP_FLOOR,
+    OP_GE,
+    OP_GT,
+    OP_JMP,
+    OP_JMPF,
+    OP_LE,
+    OP_LOAD,
+    OP_LT,
+    OP_MAX,
+    OP_MIN,
+    OP_MOD,
+    OP_MUL,
+    OP_NE,
+    OP_NEG,
+    OP_NOT,
+    OP_SUB,
+    OP_TRUTH,
+    PolicyFault,
+    Program,
+)
+
+MAX_SOURCE = 4096
+MAX_TOKENS = 1024
+MAX_DEPTH = 32
+
+_FUNCS = {"abs": 1, "floor": 1, "ceil": 1, "min": 2, "max": 2, "clamp": 3}
+_FUNC_MAX_ARGS = {"abs": 1, "floor": 1, "ceil": 1, "min": 16, "max": 16,
+                  "clamp": 3}
+_KEYWORDS = {"and", "or", "not", "true", "false"}
+_CMP_OPS = ("<", "<=", ">", ">=", "==", "!=")
+_PUNCT = (
+    "<=", ">=", "==", "!=", "&&", "||",
+    "+", "-", "*", "/", "%", "(", ")", ",", "?", ":", "<", ">", "!",
+)
+
+
+class CompileError(ValueError):
+    """Source rejected at compile time (syntax, unknown input, size)."""
+
+    def __init__(self, msg: str, pos: int = -1):
+        super().__init__(f"{msg} (at offset {pos})" if pos >= 0 else msg)
+        self.pos = pos
+
+
+def _lex(src: str) -> list[tuple[str, object, int]]:
+    """(kind, value, pos) stream; kind in num|name|punct."""
+    toks: list[tuple[str, object, int]] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if c == "#":  # comment to end of line
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            while j < n and (src[j].isdigit() or src[j] in ".eE" or (
+                src[j] in "+-" and src[j - 1] in "eE"
+            )):
+                j += 1
+            try:
+                val = float(src[i:j])
+            except ValueError:
+                raise CompileError(f"bad number {src[i:j]!r}", i) from None
+            toks.append(("num", val, i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            toks.append(("name", src[i:j], i))
+            i = j
+            continue
+        for p in _PUNCT:
+            if src.startswith(p, i):
+                toks.append(("punct", p, i))
+                i += len(p)
+                break
+        else:
+            raise CompileError(f"unexpected character {c!r}", i)
+        if len(toks) > MAX_TOKENS:
+            raise CompileError(f"expression exceeds {MAX_TOKENS} tokens")
+    return toks
+
+
+# -- parser (tokens → AST) ---------------------------------------------------
+#
+# AST nodes are plain tuples:
+#   ("num", float) ("load", slot) ("neg", a) ("not", a)
+#   ("bin", op_str, a, b)  op_str in + - * / % < <= > >= == !=
+#   ("and", a, b) ("or", a, b) ("ternary", cond, a, b)
+#   ("call", name, [args])
+
+
+class _Parser:
+    def __init__(self, toks, input_names):
+        self.toks = toks
+        self.pos = 0
+        self.input_names = frozenset(input_names)
+        self.slots: list[str] = []  # first-use order
+        self.slot_idx: dict[str, int] = {}
+        self.depth = 0
+
+    def _peek(self):
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def _next(self):
+        t = self._peek()
+        if t is None:
+            raise CompileError("unexpected end of expression")
+        self.pos += 1
+        return t
+
+    def _accept(self, *punct):
+        t = self._peek()
+        if t is not None and t[0] == "punct" and t[1] in punct:
+            self.pos += 1
+            return t[1]
+        return None
+
+    def _accept_name(self, *names):
+        t = self._peek()
+        if t is not None and t[0] == "name" and t[1] in names:
+            self.pos += 1
+            return t[1]
+        return None
+
+    def _expect(self, punct):
+        if self._accept(punct) is None:
+            t = self._peek()
+            raise CompileError(f"expected {punct!r}", t[2] if t else -1)
+
+    def _enter(self):
+        self.depth += 1
+        if self.depth > MAX_DEPTH:
+            raise CompileError(f"expression nests deeper than {MAX_DEPTH}")
+
+    def expr(self):
+        self._enter()
+        node = self._or()
+        if self._accept("?"):
+            then = self.expr()
+            self._expect(":")
+            node = ("ternary", node, then, self.expr())
+        self.depth -= 1
+        return node
+
+    def _or(self):
+        node = self._and()
+        while self._accept("||") or self._accept_name("or"):
+            node = ("or", node, self._and())
+        return node
+
+    def _and(self):
+        node = self._not()
+        while self._accept("&&") or self._accept_name("and"):
+            node = ("and", node, self._not())
+        return node
+
+    def _not(self):
+        self._enter()
+        if self._accept("!") or self._accept_name("not"):
+            node = ("not", self._not())
+        else:
+            node = self._cmp()
+        self.depth -= 1
+        return node
+
+    def _cmp(self):
+        node = self._sum()
+        t = self._peek()
+        if t is not None and t[0] == "punct" and t[1] in _CMP_OPS:
+            self.pos += 1
+            node = ("bin", t[1], node, self._sum())
+        return node
+
+    def _sum(self):
+        node = self._term()
+        while True:
+            op = self._accept("+", "-")
+            if op is None:
+                return node
+            node = ("bin", op, node, self._term())
+
+    def _term(self):
+        node = self._unary()
+        while True:
+            op = self._accept("*", "/", "%")
+            if op is None:
+                return node
+            node = ("bin", op, node, self._unary())
+
+    def _unary(self):
+        self._enter()
+        if self._accept("-"):
+            node = ("neg", self._unary())
+        else:
+            node = self._atom()
+        self.depth -= 1
+        return node
+
+    def _atom(self):
+        t = self._next()
+        kind, val, pos = t
+        if kind == "num":
+            return ("num", float(val))
+        if kind == "punct" and val == "(":
+            node = self.expr()
+            self._expect(")")
+            return node
+        if kind == "name":
+            if val == "true":
+                return ("num", 1.0)
+            if val == "false":
+                return ("num", 0.0)
+            if val in _FUNCS:
+                self._expect("(")
+                args = [self.expr()]
+                while self._accept(","):
+                    args.append(self.expr())
+                self._expect(")")
+                lo, hi = _FUNCS[val], _FUNC_MAX_ARGS[val]
+                if not lo <= len(args) <= hi:
+                    raise CompileError(
+                        f"{val}() takes {lo}..{hi} args, got {len(args)}",
+                        pos,
+                    )
+                return ("call", val, args)
+            if val in _KEYWORDS:
+                raise CompileError(f"misplaced keyword {val!r}", pos)
+            if val not in self.input_names:
+                raise CompileError(
+                    f"unknown input {val!r}; this verb exposes "
+                    f"{sorted(self.input_names)}", pos,
+                )
+            idx = self.slot_idx.get(val)
+            if idx is None:
+                idx = len(self.slots)
+                self.slots.append(val)
+                self.slot_idx[val] = idx
+            return ("load", idx)
+        raise CompileError(f"unexpected token {val!r}", pos)
+
+
+# -- bytecode emitter (AST → VM code) ----------------------------------------
+
+_BIN_OPS = {
+    "+": OP_ADD, "-": OP_SUB, "*": OP_MUL, "/": OP_DIV, "%": OP_MOD,
+    "<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
+    "==": OP_EQ, "!=": OP_NE,
+}
+_CALL_OPS = {"abs": OP_ABS, "floor": OP_FLOOR, "ceil": OP_CEIL,
+             "min": OP_MIN, "max": OP_MAX, "clamp": OP_CLAMP}
+
+
+class _BytecodeEmitter:
+    def __init__(self):
+        self.code: list[list] = []
+        self.consts: list[float] = []
+        self.const_idx: dict[float, int] = {}
+
+    def _emit(self, op, arg=0) -> int:
+        self.code.append([op, arg])
+        return len(self.code) - 1
+
+    def _const(self, val: float):
+        idx = self.const_idx.get(val)
+        if idx is None:
+            idx = len(self.consts)
+            self.consts.append(float(val))
+            self.const_idx[val] = idx
+        self._emit(OP_CONST, idx)
+
+    def emit(self, node) -> None:
+        kind = node[0]
+        if kind == "num":
+            self._const(node[1])
+        elif kind == "load":
+            self._emit(OP_LOAD, node[1])
+        elif kind == "neg":
+            self.emit(node[1])
+            self._emit(OP_NEG)
+        elif kind == "not":
+            self.emit(node[1])
+            self._emit(OP_NOT)
+        elif kind == "bin":
+            self.emit(node[2])
+            self.emit(node[3])
+            self._emit(_BIN_OPS[node[1]])
+        elif kind == "and":
+            # a and b → truthy(a) ? truthy(b) : 0   (short-circuit)
+            self.emit(node[1])
+            jf = self._emit(OP_JMPF)
+            self.emit(node[2])
+            self._emit(OP_TRUTH)
+            je = self._emit(OP_JMP)
+            self.code[jf][1] = len(self.code)
+            self._const(0.0)
+            self.code[je][1] = len(self.code)
+        elif kind == "or":
+            # a or b → truthy(a) ? 1 : truthy(b)   (short-circuit)
+            self.emit(node[1])
+            jf = self._emit(OP_JMPF)
+            self._const(1.0)
+            je = self._emit(OP_JMP)
+            self.code[jf][1] = len(self.code)
+            self.emit(node[2])
+            self._emit(OP_TRUTH)
+            self.code[je][1] = len(self.code)
+        elif kind == "ternary":
+            self.emit(node[1])
+            jf = self._emit(OP_JMPF)
+            self.emit(node[2])
+            je = self._emit(OP_JMP)
+            self.code[jf][1] = len(self.code)
+            self.emit(node[3])
+            self.code[je][1] = len(self.code)
+        elif kind == "call":
+            fn, args = node[1], node[2]
+            for a in args:
+                self.emit(a)
+            op = _CALL_OPS[fn]
+            if fn in ("min", "max"):
+                for _ in range(len(args) - 1):
+                    self._emit(op)  # left fold
+            else:
+                self._emit(op)
+        else:  # pragma: no cover - parser emits no other kinds
+            raise CompileError(f"internal: unknown AST node {kind!r}")
+
+
+# -- Python-closure emitter (AST → restricted source) ------------------------
+#
+# Fault-semantics helpers: the generated source calls ONLY these names
+# (plus min/max/abs, which cannot fault on finite floats); the closure's
+# globals carry nothing else — no builtins, no attribute access, no
+# names the generator didn't put there.
+
+
+def _pf_div(a: float, b: float) -> float:
+    if b == 0.0:
+        raise PolicyFault("math", "division by zero")
+    return a / b
+
+
+def _pf_mod(a: float, b: float) -> float:
+    if b == 0.0:
+        raise PolicyFault("math", "modulo by zero")
+    return math.fmod(a, b)
+
+
+def _pf_min(a: float, b: float) -> float:
+    # EXACTLY the VM's OP_MIN (`a if a <= b else b`) — Python's min()
+    # diverges on NaN intermediates (min(nan, 1) is nan, the VM says 1),
+    # and the closure must stay bit-identical to the interpreter
+    return a if a <= b else b
+
+
+def _pf_max(a: float, b: float) -> float:
+    return a if a >= b else b
+
+
+def _pf_clamp(x: float, lo: float, hi: float) -> float:
+    if x < lo:
+        x = lo
+    if x > hi:
+        x = hi
+    return x
+
+
+def _pf_floor(x: float) -> float:
+    return float(math.floor(x))
+
+
+def _pf_ceil(x: float) -> float:
+    return float(math.ceil(x))
+
+
+_PY_GLOBALS = {
+    "__builtins__": {},
+    "_div": _pf_div,
+    "_mod": _pf_mod,
+    "_clamp": _pf_clamp,
+    "_floor": _pf_floor,
+    "_ceil": _pf_ceil,
+    "_min": _pf_min,
+    "_max": _pf_max,
+    "abs": abs,
+}
+
+
+def _load_vec(i: int) -> str:
+    return f"_i[{i}]"
+
+
+def _py_src(node, load=_load_vec) -> str:
+    kind = node[0]
+    if kind == "num":
+        return repr(node[1])
+    if kind == "load":
+        return load(node[1])
+    if kind == "neg":
+        return f"(-{_py_src(node[1], load)})"
+    if kind == "not":
+        return f"(1.0 if {_py_src(node[1], load)} == 0.0 else 0.0)"
+    if kind == "bin":
+        op = node[1]
+        a, b = _py_src(node[2], load), _py_src(node[3], load)
+        if op == "/":
+            return f"_div({a}, {b})"
+        if op == "%":
+            return f"_mod({a}, {b})"
+        if op in ("+", "-", "*"):
+            return f"({a} {op} {b})"
+        return f"(1.0 if {a} {op} {b} else 0.0)"
+    if kind == "and":
+        a, b = _py_src(node[1], load), _py_src(node[2], load)
+        return f"((0.0 if {b} == 0.0 else 1.0) if {a} != 0.0 else 0.0)"
+    if kind == "or":
+        a, b = _py_src(node[1], load), _py_src(node[2], load)
+        return f"(1.0 if {a} != 0.0 else (0.0 if {b} == 0.0 else 1.0))"
+    if kind == "ternary":
+        c = _py_src(node[1], load)
+        a, b = _py_src(node[2], load), _py_src(node[3], load)
+        return f"({a} if {c} != 0.0 else {b})"
+    if kind == "call":
+        fn = node[1]
+        args = [_py_src(a, load) for a in node[2]]
+        if fn in ("min", "max"):
+            # left fold through the VM-exact pairwise helpers (Python's
+            # own min/max disagree with OP_MIN/OP_MAX on NaN)
+            out = args[0]
+            for a in args[1:]:
+                out = f"_{fn}({out}, {a})"
+            return out
+        if fn == "abs":
+            return f"abs({args[0]})"
+        return f"_{fn}({', '.join(args)})"  # _floor/_ceil/_clamp
+    raise CompileError(f"internal: unknown AST node {kind!r}")
+
+
+def _build_py_fn(ast, n_slots: int):
+    """AST → closure over the input vector, with the SAME fault
+    semantics as the VM (PolicyFault on div/mod-by-zero; the caller
+    checks finiteness).  Returns None if generation fails for any
+    reason — the interpreter is always the safe fallback."""
+    try:
+        src = f"lambda _i: ({_py_src(ast)})"
+        return eval(compile(src, "<policy>", "eval"), dict(_PY_GLOBALS))
+    except Exception:  # pragma: no cover - generator bug → interpret
+        return None
+
+
+def build_filled_fn(program: Program, fills):
+    """Fuse a score program with its input fills into ONE generated
+    function ``f(rater, chips, option) -> float`` — the bind-path form:
+    each referenced input is computed once into a local, then the
+    expression evaluates inline (no input vector, no second dispatch).
+    Same restricted globals and fault semantics as ``py_fn``; only
+    built for programs whose static size fits the budget (the same
+    can-never-trip-at-runtime condition), and the caller still applies
+    the finiteness check + PolicyFault fallback.  Returns None when
+    ineligible — the interpreter path is always correct."""
+    if program.ast is None or program.py_fn is None:
+        return None
+    try:
+        lines = [
+            f"    _v{i} = _f{i}(_r, _ch, _o)" for i in range(len(fills))
+        ]
+        body = _py_src(program.ast, load=lambda i: f"_v{i}")
+        src = (
+            "def _rate(_r, _ch, _o):\n"
+            + ("\n".join(lines) + "\n" if lines else "")
+            + f"    return ({body})\n"
+        )
+        g = dict(_PY_GLOBALS)
+        for i, f in enumerate(fills):
+            g[f"_f{i}"] = f
+        exec(compile(src, "<policy-rate>", "exec"), g)
+        return g["_rate"]
+    except Exception:  # pragma: no cover - generator bug → slow path
+        return None
+
+
+def compile_expr(
+    source: str,
+    input_names,
+    budget: int = DEFAULT_BUDGET,
+    deadline_s: float = DEFAULT_DEADLINE_S,
+) -> Program:
+    """Compile one policy expression against a verb's input table.
+    Raises :class:`CompileError`; never executes anything.
+
+    The returned Program carries a hot-path Python closure (``py_fn``)
+    ONLY when its static instruction count fits ``budget`` — a program
+    that could trip the runtime budget always runs interpreted, so the
+    budget fault stays a real, testable runtime behavior."""
+    if not isinstance(source, str) or not source.strip():
+        raise CompileError("empty expression")
+    if len(source) > MAX_SOURCE:
+        raise CompileError(f"source exceeds {MAX_SOURCE} chars")
+    budget = max(1, min(int(budget), MAX_BUDGET))
+    toks = _lex(source)
+    parser = _Parser(toks, input_names)
+    ast = parser.expr()
+    if parser.pos != len(toks):
+        t = parser.toks[parser.pos]
+        raise CompileError(f"trailing input {t[1]!r}", t[2])
+    em = _BytecodeEmitter()
+    em.emit(ast)
+    code = tuple((op, arg) for op, arg in em.code)
+    consts = tuple(em.consts)
+    slots = tuple(parser.slots)
+    fp = hashlib.sha256(
+        repr((code, consts, slots)).encode()
+    ).hexdigest()[:16]
+    py_fn = _build_py_fn(ast, len(slots)) if len(code) <= budget else None
+    return Program(
+        code=code, consts=consts, slots=slots, source=source,
+        budget=budget, deadline_s=float(deadline_s), fingerprint=fp,
+        py_fn=py_fn, ast=ast,
+    )

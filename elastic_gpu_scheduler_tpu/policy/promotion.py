@@ -1,0 +1,198 @@
+"""Replay-gated promotion and canary SLO monitoring.
+
+Promotion is safe BY CONSTRUCTION, in three stages:
+
+1. **Replay gate** — a staged ``score`` candidate must first beat (or
+   tie within tolerance) the incumbent on a what-if replay of the
+   RECORDED workload (``journal.replay.what_if``), judged on
+   rater-NEUTRAL quality metrics — placements completed, contiguous
+   fraction, final mean fragmentation — never on the raters' own
+   scores (a policy that awards itself 100 for everything must not
+   gate itself through).
+2. **Canary** — the gated candidate decides a deterministic pod-hash
+   fraction of live binds; every decision (both arms) is journaled as a
+   ``policy`` record with the cross-scored divergence.
+3. **Auto-rollback** — :class:`SLOMonitor` watches bind p99 (candidate
+   arm vs incumbent arm), filter-reject rate, and the fleet's mean
+   fragmentation delta since the canary started; a regression rolls
+   the candidate back automatically and journals why.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from ..journal.replay import what_if
+
+
+def replay_gate(
+    events: list,
+    candidate,
+    incumbent,
+    tolerance: float = 0.02,
+) -> dict:
+    """Judge ``candidate`` against ``incumbent`` over the recorded
+    workload.  Pass iff, within ``tolerance`` (an absolute slack on the
+    [0,1] fractions), the candidate places at least as many binds, keeps
+    the contiguous fraction, and does not worsen final fragmentation.
+
+    Returns {"pass", "reasons", "candidate", "incumbent"}; an empty
+    recording cannot validate anything and fails closed."""
+    inc = what_if(events, incumbent)
+    cand = what_if(events, candidate)
+    reasons: list[str] = []
+    if cand["binds"] == 0:
+        reasons.append("no recorded binds to replay — gate cannot validate")
+    if cand["placed"] < inc["placed"]:
+        reasons.append(
+            f"candidate placed {cand['placed']}/{cand['binds']} vs "
+            f"incumbent {inc['placed']}"
+        )
+    if cand["contiguous_frac"] < inc["contiguous_frac"] - tolerance:
+        reasons.append(
+            f"contiguous fraction regressed: {cand['contiguous_frac']} vs "
+            f"{inc['contiguous_frac']} (tolerance {tolerance})"
+        )
+    if cand["final_frag_mean"] > inc["final_frag_mean"] + tolerance:
+        reasons.append(
+            f"final mean fragmentation regressed: "
+            f"{cand['final_frag_mean']} vs {inc['final_frag_mean']} "
+            f"(tolerance {tolerance})"
+        )
+    if cand["mean_free_chip_frac"] < inc["mean_free_chip_frac"] - tolerance:
+        reasons.append(
+            f"whole-free-chip preservation regressed: "
+            f"{cand['mean_free_chip_frac']} vs "
+            f"{inc['mean_free_chip_frac']} (tolerance {tolerance})"
+        )
+    return {
+        "pass": not reasons,
+        "reasons": reasons,
+        "tolerance": tolerance,
+        "candidate": cand,
+        "incumbent": inc,
+    }
+
+
+def _p99(samples) -> float:
+    s = sorted(samples)
+    if not s:
+        return 0.0
+    return s[min(len(s) - 1, max(0, int(0.99 * len(s) + 0.5) - 1))]
+
+
+class SLOMonitor:
+    """Canary-time SLO watchdog.  Cheap to feed (deque appends under a
+    small lock); ``regressed()`` is evaluated periodically by the plane
+    and by ``check_slo()`` callers.
+
+    Regression conditions (any one trips):
+    - candidate bind p99 > incumbent bind p99 × (1 + p99_pct/100), with
+      at least ``min_samples`` per arm and an absolute floor so µs-level
+      jitter on an idle box cannot trip it;
+    - candidate filter-reject rate > incumbent rate + reject_delta
+      (min_samples filter decisions per arm);
+    - mean fragmentation index rose more than frag_delta since the
+      canary started (measured through the plane's frag provider).
+    """
+
+    def __init__(
+        self,
+        p99_pct: float = 25.0,
+        p99_floor_s: float = 0.001,
+        reject_delta: float = 0.15,
+        frag_delta: float = 0.15,
+        min_samples: int = 20,
+        window: int = 512,
+    ):
+        self.p99_pct = p99_pct
+        self.p99_floor_s = p99_floor_s
+        self.reject_delta = reject_delta
+        self.frag_delta = frag_delta
+        self.min_samples = min_samples
+        self._lock = threading.Lock()
+        self._lat = {
+            "candidate": deque(maxlen=window),
+            "incumbent": deque(maxlen=window),
+        }
+        # arm → [kept, total] filter candidate-node counts
+        self._filter = {"candidate": [0, 0], "incumbent": [0, 0]}
+        self.frag_baseline: Optional[float] = None
+        self.frag_current: Optional[float] = None
+
+    def note_latency(self, arm: str, seconds: float) -> None:
+        with self._lock:
+            self._lat[arm].append(seconds)
+
+    def note_filter(self, arm: str, kept: int, total: int) -> None:
+        with self._lock:
+            row = self._filter[arm]
+            row[0] += kept
+            row[1] += total
+
+    def set_frag_baseline(self, value: Optional[float]) -> None:
+        with self._lock:
+            self.frag_baseline = value
+            self.frag_current = value
+
+    def note_frag(self, value: Optional[float]) -> None:
+        if value is None:
+            return
+        with self._lock:
+            self.frag_current = value
+
+    def regressed(self) -> Optional[str]:
+        with self._lock:
+            cand = list(self._lat["candidate"])
+            inc = list(self._lat["incumbent"])
+            cf = tuple(self._filter["candidate"])
+            nf = tuple(self._filter["incumbent"])
+            base, cur = self.frag_baseline, self.frag_current
+        if len(cand) >= self.min_samples and len(inc) >= self.min_samples:
+            cp, ip = _p99(cand), _p99(inc)
+            if (
+                cp > ip * (1.0 + self.p99_pct / 100.0)
+                and cp - ip > self.p99_floor_s
+            ):
+                return (
+                    f"bind p99 regression: candidate {cp * 1e3:.3f}ms vs "
+                    f"incumbent {ip * 1e3:.3f}ms (budget +{self.p99_pct}%)"
+                )
+        if cf[1] >= self.min_samples and nf[1] >= self.min_samples:
+            cr = 1.0 - cf[0] / cf[1]
+            nr = 1.0 - nf[0] / nf[1]
+            if cr > nr + self.reject_delta:
+                return (
+                    f"filter-reject regression: candidate rejects "
+                    f"{cr:.2%} of candidate nodes vs incumbent {nr:.2%} "
+                    f"(delta budget {self.reject_delta:.2})"
+                )
+        if base is not None and cur is not None:
+            if cur - base > self.frag_delta:
+                return (
+                    f"fragmentation regression: mean index {cur:.3f} vs "
+                    f"{base:.3f} at canary start (delta budget "
+                    f"{self.frag_delta})"
+                )
+        return None
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "bind_p99_candidate_ms": round(
+                    _p99(list(self._lat["candidate"])) * 1e3, 3
+                ),
+                "bind_p99_incumbent_ms": round(
+                    _p99(list(self._lat["incumbent"])) * 1e3, 3
+                ),
+                "bind_samples": {
+                    a: len(q) for a, q in self._lat.items()
+                },
+                "filter_kept": {
+                    a: list(v) for a, v in self._filter.items()
+                },
+                "frag_baseline": self.frag_baseline,
+                "frag_current": self.frag_current,
+            }

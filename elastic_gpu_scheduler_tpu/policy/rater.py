@@ -1,0 +1,234 @@
+"""Policy-backed raters and the per-verb typed input tables.
+
+Each verb exposes a FIXED read-only input vocabulary; the compiler
+rejects any name outside it, and the fill functions below are the only
+code that can touch live scheduler state on a policy's behalf.  All
+inputs are floats (booleans are 1.0/0.0).
+
+``score`` (the rater verb — rate a placement option against the
+post-assignment chip state, same convention as ``core.rater``):
+
+    node_used     node-level core utilization BEFORE the option, [0,1]
+    chip_used     mean pre-assignment utilization of touched chips
+                  (fractional allocs), [0,1]
+    preserve      fully-free chips remaining / total chips, [0,1]
+    locality      whole-box ICI compactness bonus, [0,1]
+    free_chips    fully-free chips after the option (count)
+    total_chips   chips on the node
+    option_chips  chips this option touches
+    whole         1.0 if every TPU alloc is whole-chip
+    contiguous    1.0 if every TPU alloc is contiguous
+    tput          this class' measured tokens/s/chip on the node's
+                  generation, normalized by its best generation
+                  (profile observatory; 1.0 when unprofiled)
+    interference  the class' worst measured co-location ratio when the
+                  placement shares chips (1.0 exclusive/unprofiled)
+    base          the incumbent built-in rater's score for this option
+                  (computed only when referenced)
+
+A policy spelling out the built-in binpack formula —
+``35*node_used + 30*chip_used + 25*preserve + 10*locality`` — scores
+BIT-IDENTICAL to :class:`~..core.rater.Binpack` (pinned by tests and
+by the what-if parity gate).
+
+``filter`` (per-candidate-node keep/reject after the built-in filter
+passed it; result truthy = keep):
+
+    free_chips, free_core, free_hbm, total_chips, frag, largest_box,
+    demand_core, demand_hbm, demand_chips, tput, interference
+
+``preempt`` (victim-group ranking; HIGHER = evict first):
+
+    priority, chips, members, is_gang
+
+``defrag`` (victim scoring; HIGHER = move first):
+
+    chips, priority, whole, is_gang, node_free
+
+``kv`` (serving KV-page preemption victim; HIGHER = evict first):
+
+    priority, pages, tokens, slot
+"""
+
+from __future__ import annotations
+
+from math import isfinite as _isfinite
+from typing import Optional
+
+from ..core.allocator import ChipSet, Option, Rater
+from ..core.rater import (
+    ICILocality,
+    _chip_used_before,
+    _locality_bonus,
+    _node_used_before,
+)
+from ..profile.rater import ProfileAwareRater
+from .vm import PolicyFault, Program, evaluate
+
+
+def _option_chips(option: Option) -> float:
+    n = 0
+    for a in option.allocs:
+        if a.needs_tpu:
+            n += len(a.coords)
+    return float(n)
+
+
+def _all_whole(option: Option) -> float:
+    for a in option.allocs:
+        if a.needs_tpu and not a.whole:
+            return 0.0
+    return 1.0
+
+
+def _all_contiguous(option: Option) -> float:
+    for a in option.allocs:
+        if a.needs_tpu and not a.contiguous:
+            return 0.0
+    return 1.0
+
+
+# fill signature: (rater, chips, option) -> float.  ``rater`` carries the
+# profile plumbing and the incumbent (for ``base``).
+SCORE_FILLS = {
+    "node_used": lambda r, ch, o: _node_used_before(ch, o),
+    "chip_used": lambda r, ch, o: _chip_used_before(ch, o),
+    "preserve": lambda r, ch, o: ch.free_count() / max(1, ch.num_chips),
+    "locality": lambda r, ch, o: _locality_bonus(ch, o),
+    "free_chips": lambda r, ch, o: float(ch.free_count()),
+    "total_chips": lambda r, ch, o: float(ch.num_chips),
+    "option_chips": lambda r, ch, o: _option_chips(o),
+    "whole": lambda r, ch, o: _all_whole(o),
+    "contiguous": lambda r, ch, o: _all_contiguous(o),
+    "tput": lambda r, ch, o: r._prof._tput_factor(),
+    "interference": lambda r, ch, o: r._prof._interference_factor(ch, o),
+    "base": lambda r, ch, o: r.fallback.rate(ch, o),
+}
+SCORE_INPUTS = tuple(sorted(SCORE_FILLS))
+
+FILTER_INPUTS = (
+    "free_chips", "free_core", "free_hbm", "total_chips", "frag",
+    "largest_box", "demand_core", "demand_hbm", "demand_chips",
+    "tput", "interference",
+)
+PREEMPT_INPUTS = ("priority", "chips", "members", "is_gang")
+DEFRAG_INPUTS = ("chips", "priority", "whole", "is_gang", "node_free")
+KV_INPUTS = ("priority", "pages", "tokens", "slot")
+
+VERB_INPUTS = {
+    "score": SCORE_INPUTS,
+    "filter": FILTER_INPUTS,
+    "preempt": PREEMPT_INPUTS,
+    "defrag": DEFRAG_INPUTS,
+    "kv": KV_INPUTS,
+}
+
+
+class PolicyRater(Rater):
+    """A compiled ``score`` policy wrapped in the Rater interface, with
+    the incumbent built-in as its safe fallback: any
+    :class:`PolicyFault` (budget trip, deadline, math fault) scores the
+    option through ``fallback`` instead — never a failed bind — and is
+    reported through ``on_fault`` (the plane journals it as a
+    ``policy_fault`` annotation).
+
+    Profile plumbing mirrors :class:`ProfileAwareRater` (it IS one,
+    embedded): ``observe_profile``/``set_workload`` are duck-typed, so
+    ``journal.replay.what_if`` drives a policy-backed rater over
+    recorded profiles exactly like the PR 6 promotion harness.
+
+    Planner-shortcut flags default to False (the safe stance for an
+    unknown policy); a load request may declare ``translation_invariant``
+    / ``whole_chip_compact_first`` when the expression qualifies (e.g.
+    the binpack-parity policy).
+    """
+
+    def __init__(
+        self,
+        program: Program,
+        fallback: Optional[Rater] = None,
+        name: str = "policy",
+        translation_invariant: bool = False,
+        whole_chip_compact_first: bool = False,
+        on_fault=None,
+    ):
+        self.program = program
+        self.fallback = fallback or ICILocality()
+        self.name = name
+        self.translation_invariant = bool(translation_invariant)
+        self.whole_chip_compact_first = bool(whole_chip_compact_first)
+        self.on_fault = on_fault
+        self._prof = ProfileAwareRater(self.fallback)
+        # fills resolved ONCE, in slot order — rate() runs a tight loop
+        self._fills = tuple(SCORE_FILLS[n] for n in program.slots)
+        # fused fills+expression function (lang.build_filled_fn): the
+        # bind-path form, eligible exactly when py_fn is (static size ≤
+        # budget ⇒ budget/deadline can never trip).  None → interpret.
+        from .lang import build_filled_fn
+
+        self._rate_fn = build_filled_fn(program, self._fills)
+        self.evals = 0
+        self.faults = 0
+
+    # -- what_if hooks (duck-typed; see profile/rater.py) --------------------
+
+    def observe_profile(self, rec: dict) -> None:
+        self._prof.observe_profile(rec)
+
+    def set_workload(self, wclass, node=None, generation=None) -> None:
+        self._prof.set_workload(wclass, node=node, generation=generation)
+
+    # -- scoring -------------------------------------------------------------
+
+    def rate(self, chips: ChipSet, option: Option) -> float:
+        self.evals += 1
+        try:
+            fn = self._rate_fn
+            if fn is not None:
+                out = fn(self, chips, option)
+                if not _isfinite(out):
+                    raise PolicyFault("math", "non-finite result")
+            else:
+                vals = [fill(self, chips, option) for fill in self._fills]
+                out = evaluate(self.program, vals)
+        except PolicyFault as e:
+            self.faults += 1
+            if self.on_fault is not None:
+                self.on_fault("score", self.name, e)
+            return self.fallback.rate(chips, option)
+        except Exception as e:  # a broken fill must never fail a bind
+            self.faults += 1
+            if self.on_fault is not None:
+                self.on_fault("score", self.name, PolicyFault("fill", str(e)))
+            return self.fallback.rate(chips, option)
+        # bound into the Rater contract's [0, 100] (no-op for in-range
+        # scores, so parity with a built-in formula is exact)
+        if out < 0.0:
+            return 0.0
+        if out > 100.0:
+            return 100.0
+        return out
+
+
+def behavior_factors(profiles: dict, interference: dict, wclass: str,
+                     generation: str, neighbor_classes) -> tuple[float, float]:
+    """(tput, interference) filter-verb inputs from observatory state:
+    the class' normalized throughput on ``generation`` and its worst
+    measured co-location ratio against the classes currently resident
+    on the node.  1.0 / 1.0 when unprofiled."""
+    tput = 1.0
+    row = (profiles.get(wclass) or {}).get("tokens_per_sec_per_chip") or {}
+    if row:
+        best = max(row.values())
+        if best > 0:
+            here = row.get(generation)
+            tput = 0.75 if here is None else max(
+                0.0, min(1.0, here / best)
+            )
+    ifx = 1.0
+    irow = interference.get(wclass) or {}
+    for ncls in neighbor_classes:
+        r = irow.get(ncls)
+        if r is not None:
+            ifx = min(ifx, max(0.0, float(r)))
+    return tput, ifx

@@ -1,0 +1,779 @@
+"""The policy registry / control plane: one process-global object
+(``POLICIES``, the TRACER/JOURNAL/PROFILER pattern) that owns every
+hot-loaded policy, the canary state machine, and the ONE rater-spec
+parser both CLIs resolve through.
+
+Verbs and their attachment points:
+
+    score    the rater — scheduler bind/assume/score + gang planning
+             (promoted policies replace the engine rater wholesale;
+             canaries split the BIND path by deterministic pod hash)
+    filter   per-node keep/reject after the built-in filter passes it
+             (scheduler.assume + the gang prefilter)
+    preempt  victim-group ranking in TPUUnitScheduler.preempt
+    defrag   victim scoring in defrag's unblock/compact planners
+    kv       serving KV-page preemption victim (server/inference.py)
+
+Every decision an ACTIVE CANARY makes is journaled as a ``policy``
+record; every runtime fault (any verb, any state) is journaled as a
+``policy_fault`` annotation and falls back to the incumbent built-in.
+The plane is zero-cost until a policy is loaded: each hook pays one
+attribute check against an empty dict.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+import zlib
+from typing import Optional
+
+from ..core.rater import RATERS, get_rater
+from ..journal import JOURNAL
+from ..metrics import POLICY_EVALS, POLICY_EVENTS
+from .lang import CompileError, compile_expr
+from .promotion import SLOMonitor, replay_gate
+from .rater import PolicyRater, VERB_INPUTS
+from .vm import DEFAULT_BUDGET, PolicyFault, evaluate
+
+VERBS = tuple(VERB_INPUTS)
+
+_FAULT_JOURNAL_CAP = 64  # per loaded policy: faults are counted forever,
+# journaled at most this many times (a hot broken policy must not flood
+# the flight recorder)
+
+
+class LoadedPolicy:
+    """One compiled policy attached (staged/canary/active) to a verb."""
+
+    def __init__(self, name: str, verb: str, program, source: str,
+                 rater: Optional[PolicyRater] = None):
+        self.name = name
+        self.verb = verb
+        self.program = program
+        self.source = source
+        self.rater = rater  # score verb only
+        self.loaded_at = time.time()
+        self.evals = 0
+        self.faults = 0
+        self.fault_kinds: dict[str, int] = {}
+        self.journaled_faults = 0
+
+    def snapshot(self) -> dict:
+        out = {
+            "name": self.name,
+            "verb": self.verb,
+            "source": self.source,
+            "fingerprint": self.program.fingerprint,
+            "budget": self.program.budget,
+            "inputs": list(self.program.slots),
+            "loaded_at": self.loaded_at,
+            "evals": self.evals,
+            "faults": self.faults,
+            "fault_kinds": dict(self.fault_kinds),
+        }
+        if self.rater is not None:
+            out["evals"] = self.rater.evals
+            out["faults"] = self.rater.faults
+            out["translation_invariant"] = self.rater.translation_invariant
+            out["whole_chip_compact_first"] = (
+                self.rater.whole_chip_compact_first
+            )
+        return out
+
+
+def _gate_summary(gate: Optional[dict]) -> dict:
+    """Compact, JSON-stable view of a replay-gate result (the full
+    what-if dicts ride the load response; state keeps this)."""
+    if gate is None:
+        return {"pass": True, "reasons": ["gate skipped"]}
+    out = {"pass": bool(gate["pass"]),
+           "reasons": list(gate.get("reasons") or [])}
+    if "tolerance" in gate:
+        out["tolerance"] = gate["tolerance"]
+    if gate.get("gate_faults"):
+        out["gate_faults"] = gate["gate_faults"]
+    for side in ("candidate", "incumbent"):
+        d = gate.get(side)
+        if d:
+            out[side] = {
+                k: d[k]
+                for k in (
+                    "rater", "binds", "placed", "unplaced",
+                    "contiguous_frac", "final_frag_mean",
+                    "mean_free_chip_frac", "mean_score",
+                )
+                if k in d
+            }
+    return out
+
+
+def canary_bucket(pod_key: str) -> int:
+    """Deterministic 0..9999 split bucket for a pod key — the SAME pod
+    always lands on the same canary arm, across replicas and restarts."""
+    return zlib.crc32(pod_key.encode()) % 10000
+
+
+class PolicyPlane:
+    """Registry + canary state machine + SLO watchdog for all verbs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # verb → LoadedPolicy (promoted / canarying); absent = built-in
+        self.active: dict[str, LoadedPolicy] = {}
+        self.canary: dict[str, LoadedPolicy] = {}
+        self.canary_pct: dict[str, float] = {}
+        self.gate_results: dict[str, dict] = {}
+        # ONE SLO watchdog per canarying verb — loading a defrag policy
+        # must not wipe a live score canary's accumulated regression
+        # evidence (latency windows, frag baseline)
+        self.slos: dict[str, SLOMonitor] = {}
+        self.history: list[dict] = []  # load/gate/promote/rollback events
+        # canary decision counters: verb → {candidate, incumbent, diverged}
+        self.decisions: dict[str, dict] = {}
+        self._slo_stride = 0
+        self._orphan_faults_journaled = 0
+        # serializes SLO evaluation: concurrent binds may stride into
+        # check_slo together; the loser skips (the winner's verdict
+        # covers it) instead of double-rolling-back
+        self._slo_check_lock = threading.Lock()
+        # engines this plane steers (weakrefs: tests build many stacks).
+        # incumbent raters are remembered per engine so promote/rollback
+        # can swap and restore.
+        self._engines: "weakref.WeakSet" = weakref.WeakSet()
+        self._incumbents: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        self.frag_provider = None  # callable → {node: (frag, largest)}
+        self.gate_events_fn = None  # callable → journal event list | None
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, engines) -> None:
+        """Register scheduler engines; remembers each engine's CURRENT
+        rater as the incumbent the plane falls back to."""
+        for sched in engines:
+            if sched in self._engines:
+                continue
+            self._engines.add(sched)
+            self._incumbents[sched] = sched.rater
+            sched.policies = self
+
+    def incumbent_rater(self):
+        """The rater a score candidate must beat — the PROMOTED policy
+        when one is in force (the gate must not weaken to the original
+        built-in after a promotion), the attach-time built-in
+        otherwise."""
+        act = self.active.get("score")
+        if act is not None and act.rater is not None:
+            return act.rater
+        for sched in self._engines:
+            inc = self._incumbents.get(sched)
+            if inc is not None:
+                return inc
+        from ..core.rater import ICILocality
+
+        return ICILocality()
+
+    def reset(self) -> None:
+        """Test hook: drop every policy and restore engine raters."""
+        with self._lock:
+            self.active.clear()
+            self.canary.clear()
+            self.canary_pct.clear()
+            self.gate_results.clear()
+            self.history.clear()
+            self.decisions.clear()
+            self.slos.clear()
+        self._restore_engines()
+
+    @property
+    def slo(self) -> Optional[SLOMonitor]:
+        """The score-verb canary's SLO monitor (the common case for
+        tests and tools); per-verb monitors live in ``slos``."""
+        return self.slos.get("score") or next(iter(self.slos.values()), None)
+
+    # -- fast-path queries ----------------------------------------------------
+
+    def wants(self, verb: str) -> bool:
+        """One-dict-check gate the hooks pay when no policy is loaded."""
+        return verb in self.active or verb in self.canary
+
+    def decide(self, verb: str, key: str):
+        """(policy | None, arm): which policy decides for this key.
+        Promoted policies decide everything (arm ``active``); canaries
+        take their pod-hash fraction (arm ``candidate``), the rest is
+        the incumbent (arm ``incumbent``, still journaled for the
+        divergence comparison).  The INCUMBENT of a canary is whatever
+        was in force before it — the promoted active policy when one
+        exists, the built-in otherwise — so staging a candidate never
+        silently un-enforces a promoted policy on the incumbent arm."""
+        pol = self.canary.get(verb)
+        if pol is not None:
+            frac = self.canary_pct.get(verb, 0.0)
+            if canary_bucket(key) < frac * 100.0:
+                return pol, "candidate"
+            return self.active.get(verb), "incumbent"
+        pol = self.active.get(verb)
+        if pol is not None:
+            return pol, "active"
+        return None, "builtin"
+
+    # -- load / gate / canary / promote / rollback ----------------------------
+
+    def load(
+        self,
+        name: str,
+        verb: str,
+        expr: str,
+        canary_pct: float = 10.0,
+        tolerance: float = 0.02,
+        budget: int = DEFAULT_BUDGET,
+        translation_invariant: bool = False,
+        whole_chip_compact_first: bool = False,
+        gate_events: Optional[list] = None,
+        skip_gate: bool = False,
+    ) -> dict:
+        """Stage a candidate: compile, replay-gate (score verb), then
+        canary.  Returns {"state": blocked|canary, "gate": ...}.  A
+        blocked candidate leaves the plane untouched."""
+        if verb not in VERBS:
+            raise ValueError(f"unknown verb {verb!r}; choose from {VERBS}")
+        program = compile_expr(expr, VERB_INPUTS[verb], budget=budget)
+        rater = None
+        if verb == "score":
+            rater = PolicyRater(
+                program,
+                fallback=self.incumbent_rater(),
+                name=name,
+                translation_invariant=translation_invariant,
+                whole_chip_compact_first=whole_chip_compact_first,
+                on_fault=self.note_fault,
+            )
+        pol = LoadedPolicy(name, verb, program, expr, rater=rater)
+        POLICY_EVENTS.inc("load")
+        self._journal(
+            "load", verb=verb, name=name,
+            fingerprint=program.fingerprint,
+        )
+
+        gate = None
+        if verb == "score" and not skip_gate:
+            events = gate_events
+            if events is None and self.gate_events_fn is not None:
+                events = self.gate_events_fn()
+            if events is None:
+                gate = {
+                    "pass": False,
+                    "reasons": [
+                        "no recorded workload to gate against (enable "
+                        "the journal or pass skip_gate explicitly)"
+                    ],
+                }
+            else:
+                # the gate is an OFFLINE evaluation: a candidate that
+                # faults on every recorded bind must not write one
+                # policy_fault per eval into the LIVE flight recorder
+                # (nor count live-fault metrics) — count locally, report
+                # in the gate summary, restore the live hook after
+                gate_faults = [0]
+                rater.on_fault = (
+                    lambda _v, _n, _e: gate_faults.__setitem__(
+                        0, gate_faults[0] + 1
+                    )
+                )
+                try:
+                    gate = replay_gate(
+                        events, rater, self.incumbent_rater(),
+                        tolerance=tolerance,
+                    )
+                finally:
+                    rater.on_fault = self.note_fault
+                if gate_faults[0]:
+                    gate["gate_faults"] = gate_faults[0]
+                    gate.setdefault("reasons", [])
+                    if gate["pass"]:
+                        gate["pass"] = False
+                        gate["reasons"].append(
+                            f"candidate faulted {gate_faults[0]} time(s) "
+                            "during replay (fallback scores gated it "
+                            "through; a faulting policy must not ship)"
+                        )
+            self._journal(
+                "gate", verb=verb, name=name,
+                passed=bool(gate["pass"]),
+                reasons=gate.get("reasons") or None,
+            )
+            if not gate["pass"]:
+                POLICY_EVENTS.inc("gate_block")
+                self._note_history("gate_block", verb, name,
+                                   reasons=gate["reasons"])
+                return {"state": "blocked", "name": name, "verb": verb,
+                        "gate": _gate_summary(gate)}
+            POLICY_EVENTS.inc("gate_pass")
+
+        # preempt/defrag/kv have no per-pod split surface (a defrag
+        # round or page-pool eviction is not keyed by a pod hash), so a
+        # staged policy there decides EVERY operation: report 100%
+        # honestly instead of echoing a fraction that is not enforced
+        pct = max(0.0, min(100.0, float(canary_pct)))
+        if verb not in ("score", "filter"):
+            pct = 100.0
+        monitor = SLOMonitor()
+        with self._lock:
+            self.canary[verb] = pol
+            self.canary_pct[verb] = pct
+            self.gate_results[verb] = _gate_summary(gate) if gate else {
+                "pass": True, "reasons": ["gate skipped"],
+            }
+            self.decisions[verb] = {
+                "candidate": 0, "incumbent": 0, "diverged": 0,
+            }
+            self.slos[verb] = monitor
+        if self.frag_provider is not None:
+            monitor.set_frag_baseline(self._mean_frag())
+        self._journal("canary", verb=verb, name=name, pct=pct)
+        self._note_history("canary", verb, name, pct=pct)
+        return {"state": "canary", "name": name, "verb": verb,
+                "canary_pct": pct,
+                "gate": _gate_summary(gate) if gate else None}
+
+    def promote(self, verb: str) -> dict:
+        with self._lock:
+            pol = self.canary.pop(verb, None)
+            if pol is None:
+                raise ValueError(f"no canary staged for verb {verb!r}")
+            self.active[verb] = pol
+            self.canary_pct.pop(verb, None)
+            self.slos.pop(verb, None)
+        POLICY_EVENTS.inc("promote")
+        self._journal("promote", verb=verb, name=pol.name)
+        self._note_history("promote", verb, pol.name)
+        if verb == "score":
+            self._swap_engine_raters(pol.rater)
+        return {"state": "active", "name": pol.name, "verb": verb}
+
+    def rollback(self, verb: str, reason: str = "operator",
+                 auto: bool = False) -> dict:
+        with self._lock:
+            pol = self.canary.pop(verb, None) or self.active.pop(verb, None)
+            self.canary_pct.pop(verb, None)
+            self.slos.pop(verb, None)
+            if pol is None:
+                raise ValueError(f"nothing loaded for verb {verb!r}")
+        POLICY_EVENTS.inc("rollback")
+        self._journal(
+            "rollback", verb=verb, name=pol.name, reason=reason,
+            auto=auto or None,
+        )
+        self._note_history("rollback", verb, pol.name, reason=reason,
+                           auto=auto)
+        if verb == "score":
+            # a rolled-back CANARY must not dethrone a still-promoted
+            # active policy; only when nothing is left does the engine
+            # rater return to the incumbent built-in
+            act = self.active.get("score")
+            if act is not None:
+                self._swap_engine_raters(act.rater)
+            else:
+                self._restore_engines()
+        return {"state": "builtin", "rolled_back": pol.name, "verb": verb,
+                "reason": reason}
+
+    def _swap_engine_raters(self, rater) -> None:
+        for sched in list(self._engines):
+            with sched.lock:
+                sched.rater = rater
+                idx = getattr(sched, "index", None)
+            if idx is not None:
+                # the congruence-class memo caches SCORES from the old
+                # rater keyed by node state — state won't change at the
+                # swap instant, so flush it
+                with idx._lock:
+                    idx._memo.clear()
+
+    def _restore_engines(self) -> None:
+        for sched in list(self._engines):
+            inc = self._incumbents.get(sched)
+            if inc is None:
+                continue
+            with sched.lock:
+                sched.rater = inc
+                idx = getattr(sched, "index", None)
+            if idx is not None:
+                with idx._lock:
+                    idx._memo.clear()
+
+    # -- live-bind canary plumbing (score verb) -------------------------------
+
+    def score_rater_for(self, pod_key: str, incumbent):
+        """(rater, decision | None) for one bind.  A decision dict means
+        a canary is live and this bind must be journaled + SLO-fed."""
+        pol, arm = self.decide("score", pod_key)
+        if arm == "candidate" and pol is not None and pol.rater is not None:
+            return pol.rater, {"arm": arm, "policy": pol}
+        if arm == "incumbent":
+            # a rollback racing this bind may clear the canary between
+            # decide() and here — then there is nothing to journal
+            cur = self.canary.get("score")
+            return incumbent, (
+                {"arm": arm, "policy": cur} if cur is not None else None
+            )
+        if arm == "active" and pol is not None and pol.rater is not None:
+            return pol.rater, None
+        return incumbent, None
+
+    def note_bind_decision(
+        self, decision: dict, pod_key: str, node: str, opt,
+        latency_s: float, na, incumbent,
+    ) -> None:
+        """Journal one canary bind decision with the cross-scored
+        divergence (the OTHER arm's rating of the chosen placement),
+        feed the SLO monitor, and periodically evaluate rollback."""
+        pol = decision.get("policy")
+        if pol is None:
+            return
+        arm = decision["arm"]
+        chosen = opt.score
+        other_rater = incumbent if arm == "candidate" else pol.rater
+        try:
+            with na.lock:
+                other = other_rater.rate(na.chips, opt)
+        except Exception:
+            other = chosen
+        divergence = abs(chosen - other)
+        with self._lock:
+            d = self.decisions.setdefault(
+                "score", {"candidate": 0, "incumbent": 0, "diverged": 0}
+            )
+            d[arm] = d.get(arm, 0) + 1
+            if divergence > 1e-9:
+                d["diverged"] += 1
+        POLICY_EVALS.inc("score", arm)
+        self._journal(
+            "canary_decide", verb="score", name=pol.name, pod=pod_key,
+            node=node, arm=arm, score=round(chosen, 6),
+            score_other=round(other, 6),
+            divergence=round(divergence, 6),
+        )
+        slo = self.slos.get("score")
+        if slo is not None:
+            slo.note_latency(arm, latency_s)
+            self._slo_stride += 1
+            if self._slo_stride % 8 == 0:
+                self.check_slo()
+
+    def note_filter_decision(self, arm: str, kept: int, total: int) -> None:
+        """Feed the filter canary's SLO monitor (per-arm kept/total
+        candidate-node counts) and periodically evaluate rollback —
+        a filter-only canary has no bind decisions to ride, so its
+        watchdog strides HERE."""
+        slo = self.slos.get("filter")
+        if slo is None or arm not in ("candidate", "incumbent"):
+            return
+        slo.note_filter(arm, kept, total)
+        self._slo_stride += 1
+        if self._slo_stride % 8 == 0:
+            self.check_slo()
+
+    def check_slo(self) -> Optional[dict]:
+        """Evaluate every canarying verb's SLO monitor; a regression
+        auto-rolls back THAT verb's CANARY only (and reports why).
+        No-op without a live canary.  Concurrency-safe: racing binds
+        striding in together serialize on a try-lock (the loser skips —
+        the winner's verdict covers it), and the rollback targets the
+        canary atomically so a lost race can neither dethrone a
+        promoted active policy nor raise out of a bind."""
+        if not self._slo_check_lock.acquire(blocking=False):
+            return None
+        try:
+            out = None
+            for verb in list(self.canary):
+                slo = self.slos.get(verb)
+                if slo is None:
+                    continue
+                if self.frag_provider is not None:
+                    slo.note_frag(self._mean_frag())
+                reason = slo.regressed()
+                if reason is None:
+                    continue
+                out = self._rollback_canary(verb, reason) or out
+            return out
+        finally:
+            self._slo_check_lock.release()
+
+    def _rollback_canary(self, verb: str, reason: str) -> Optional[dict]:
+        """Auto-rollback of a CANARY only — never touches a promoted
+        active policy, returns None (instead of raising) if an operator
+        rollback raced it away."""
+        with self._lock:
+            pol = self.canary.pop(verb, None)
+            self.canary_pct.pop(verb, None)
+            self.slos.pop(verb, None)
+            if pol is None:
+                return None
+        POLICY_EVENTS.inc("rollback")
+        self._journal("rollback", verb=verb, name=pol.name, reason=reason,
+                      auto=True)
+        self._note_history("rollback", verb, pol.name, reason=reason,
+                           auto=True)
+        if verb == "score":
+            act = self.active.get("score")
+            if act is not None:
+                self._swap_engine_raters(act.rater)
+            else:
+                self._restore_engines()
+        return {"state": "builtin" if verb not in self.active else "active",
+                "rolled_back": pol.name, "verb": verb, "reason": reason}
+
+    def _mean_frag(self) -> Optional[float]:
+        try:
+            snap = self.frag_provider()
+        except Exception:
+            return None
+        if not snap:
+            return None
+        return sum(v[0] for v in snap.values()) / len(snap)
+
+    # -- non-score verb evaluation --------------------------------------------
+
+    def _eval(self, verb: str, pol: LoadedPolicy, inputs: dict):
+        """Evaluate a non-score policy over an input dict; returns the
+        float or None on fault (callers fall back to the built-in)."""
+        pol.evals += 1
+        if verb in self.slos and pol.evals % 16 == 0:
+            # preempt/defrag/kv canaries have no bind or filter traffic
+            # to ride — their SLO watchdog (frag regression vs the
+            # canary-start baseline) strides on their own evaluations
+            self.check_slo()
+        try:
+            vals = [float(inputs[n]) for n in pol.program.slots]
+            out = evaluate(pol.program, vals)
+            POLICY_EVALS.inc(verb, "ok")
+            return out
+        except PolicyFault as e:
+            self.note_fault(verb, pol.name, e, pol=pol)
+            return None
+        except Exception as e:
+            self.note_fault(verb, pol.name, PolicyFault("fill", str(e)),
+                            pol=pol)
+            return None
+
+    def eval_filter(self, pol: LoadedPolicy, inputs: dict) -> bool:
+        """truthy = keep the node; fault = keep (incumbent behavior is
+        'the built-in filter already passed it')."""
+        out = self._eval("filter", pol, inputs)
+        return True if out is None else out != 0.0
+
+    def preempt_score(self, inputs: dict) -> float:
+        """Victim-group rank (HIGHER = evict first); built-in equivalent
+        is ``-priority`` (evict the lowest-priority group first)."""
+        pol = self.canary.get("preempt") or self.active.get("preempt")
+        if pol is None:
+            return -float(inputs.get("priority", 0.0))
+        out = self._eval("preempt", pol, inputs)
+        if out is None:
+            return -float(inputs.get("priority", 0.0))
+        return out
+
+    def preempt_scores(self, infos: list) -> Optional[list]:
+        """Score EVERY victim group or none: returns the score list, or
+        None when no policy is loaded or ANY group faults — the caller
+        then orders the whole set by the built-in rule (mixing policy
+        scores with built-in key values in one sort would place the
+        faulted groups arbitrarily; same stance as defrag's
+        ``_order_victims``).  A staged canary takes precedence over a
+        promoted policy (it is the one under evaluation)."""
+        pol = self.canary.get("preempt") or self.active.get("preempt")
+        if pol is None:
+            return None
+        out = []
+        for info in infos:
+            s = self._eval("preempt", pol, info)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+
+    def defrag_score(self, inputs: dict) -> Optional[float]:
+        """Victim preference for the defrag planners (HIGHER = move
+        first); None on fault or no policy → caller's built-in order."""
+        pol = self.canary.get("defrag") or self.active.get("defrag")
+        if pol is None:
+            return None
+        return self._eval("defrag", pol, inputs)
+
+    def select_kv_victim(self, slots: list[dict]) -> int:
+        """Pick the serving KV-page preemption victim.  Built-in: the
+        lowest-priority slot, most pages held as tiebreak (the historic
+        hard-coded ``min(...)``).  With a loaded ``kv`` policy: the slot
+        with the HIGHEST policy score (built-in on fault)."""
+        pol = self.canary.get("kv") or self.active.get("kv")
+        if pol is not None:
+            best = None
+            ok = True
+            for info in slots:
+                s = self._eval("kv", pol, info)
+                if s is None:
+                    ok = False
+                    break
+                if best is None or s > best[0]:
+                    best = (s, int(info["slot"]))
+            if ok and best is not None:
+                return best[1]
+        return int(min(
+            slots, key=lambda i: (i["priority"], -i["pages"], i["slot"]),
+        )["slot"])
+
+    # -- fault + journal plumbing ---------------------------------------------
+
+    def note_fault(self, verb: str, name: str, fault: PolicyFault,
+                   pol: Optional[LoadedPolicy] = None) -> None:
+        """Count + journal one policy runtime fault (budget trip,
+        deadline, math).  The caller has already fallen back to the
+        incumbent — this is the annotation trail, never control flow."""
+        if pol is None:
+            pol = self.canary.get(verb) or self.active.get(verb)
+            if pol is not None and pol.name != name:
+                pol = None
+        POLICY_EVALS.inc(verb, "fault")
+        POLICY_EVENTS.inc("fault")
+        if pol is not None:
+            pol.faults += 1
+            pol.fault_kinds[fault.kind] = (
+                pol.fault_kinds.get(fault.kind, 0) + 1
+            )
+            if pol.journaled_faults >= _FAULT_JOURNAL_CAP:
+                return
+            pol.journaled_faults += 1
+        else:
+            # unattributable fault (raters held outside the plane, e.g.
+            # resolve_rater file policies): same flood cap, one shared
+            # budget — counting stays exact via the metric above
+            self._orphan_faults_journaled += 1
+            if self._orphan_faults_journaled > _FAULT_JOURNAL_CAP:
+                return
+        if JOURNAL.enabled:
+            JOURNAL.record(
+                "policy_fault", verb=verb, name=name, kind=fault.kind,
+                detail=fault.detail[:200] if fault.detail else None,
+            )
+
+    def _journal(self, action: str, **fields) -> None:
+        if JOURNAL.enabled:
+            JOURNAL.record("policy", action=action, **fields)
+
+    def _note_history(self, event: str, verb: str, name: str, **extra):
+        entry = {"t": time.time(), "event": event, "verb": verb,
+                 "name": name, **extra}
+        with self._lock:
+            self.history.append(entry)
+            del self.history[:-50]
+
+    # -- introspection --------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            out = {
+                "verbs": list(VERBS),
+                "active": {
+                    v: p.snapshot() for v, p in self.active.items()
+                },
+                "canary": {
+                    v: dict(p.snapshot(), canary_pct=self.canary_pct.get(v))
+                    for v, p in self.canary.items()
+                },
+                "gate_results": dict(self.gate_results),
+                "decisions": {
+                    v: dict(d) for v, d in self.decisions.items()
+                },
+                "history": list(self.history[-20:]),
+            }
+        slos = dict(self.slos)
+        if slos:
+            out["slo"] = {v: m.state() for v, m in slos.items()}
+        out["inputs"] = {v: list(n) for v, n in VERB_INPUTS.items()}
+        return out
+
+    def divergence_pct(self, verb: str = "score") -> float:
+        with self._lock:
+            d = self.decisions.get(verb) or {}
+            total = d.get("candidate", 0) + d.get("incumbent", 0)
+            if not total:
+                return 0.0
+            return 100.0 * d.get("diverged", 0) / total
+
+
+POLICIES = PolicyPlane()
+
+
+def default_gate_events():
+    """Read the live journal for the replay gate (flushes first so the
+    gate sees every bind up to now)."""
+    if not JOURNAL.enabled:
+        return None
+    from ..journal import read_journal
+
+    JOURNAL.flush()
+    if not JOURNAL.dir:
+        return None
+    return read_journal(JOURNAL.dir)
+
+
+def resolve_rater(spec: str):
+    """THE rater-spec parser — the scheduler CLI's ``--priority`` and
+    the journal CLI's ``--rater`` both resolve through here (built-ins
+    + profile-aware wrapping + loaded/file-backed policies):
+
+        binpack | spread | random | ici-locality   built-in geometry
+        profile-aware[:BASE]                        measured-behavior
+                                                    scaling over BASE
+        policy:NAME[:BASE]                          a policy loaded in
+                                                    this process, or an
+                                                    expression FILE
+                                                    (BASE = fallback)
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty rater spec")
+    head, _, rest = spec.partition(":")
+    if head == "profile-aware":
+        from ..profile.rater import ProfileAwareRater
+
+        return ProfileAwareRater(get_rater(rest) if rest else None)
+    if head == "policy":
+        src, _, base = rest.partition(":")
+        if not src:
+            raise ValueError(
+                "policy rater spec needs a name or file: policy:NAME[:BASE]"
+            )
+        fallback = get_rater(base) if base else None
+        loaded = POLICIES.active.get("score") or POLICIES.canary.get("score")
+        if loaded is not None and loaded.name == src:
+            return loaded.rater
+        if os.path.exists(src):
+            with open(src) as f:
+                expr = f.read()
+            try:
+                program = compile_expr(expr, VERB_INPUTS["score"])
+            except CompileError as e:
+                raise ValueError(f"policy file {src!r}: {e}") from None
+            return PolicyRater(
+                program, fallback=fallback,
+                name=os.path.basename(src),
+                # file policies live OUTSIDE the plane's registry, but
+                # their live faults must still journal + count (the
+                # orphan-fault cap in note_fault bounds the flood)
+                on_fault=POLICIES.note_fault,
+            )
+        raise ValueError(
+            f"policy {src!r}: not a loaded policy name or expression file"
+        )
+    if spec in RATERS:  # the FULL spec: 'binpack:v2' must error, not
+        return RATERS[spec]  # silently resolve to binpack
+    raise ValueError(
+        f"unknown rater {spec!r}; choose from {sorted(RATERS)}, "
+        "profile-aware[:BASE], or policy:NAME|FILE[:BASE]"
+    )

@@ -1,0 +1,238 @@
+"""Deterministic stack VM for operator-loaded scheduling policies.
+
+The programmable policy plane (ROADMAP item 4, gpu_ext direction) lets
+operators hot-load placement logic into a RUNNING scheduler.  That is
+only safe if a loaded policy can never take the bind path down with it,
+so the execution model is deliberately tiny:
+
+- straight-line stack bytecode compiled from a restricted expression
+  language (``lang.py``) — no loops exist in the instruction set, so
+  every program terminates by construction;
+- a strict INSTRUCTION BUDGET (default 512, hard cap 4096) counted per
+  executed instruction, plus a per-eval WALL DEADLINE checked every 64
+  instructions — a pathological program (or a host stall under it)
+  trips :class:`PolicyFault` instead of stretching a bind;
+- typed read-only inputs: the caller passes a flat float vector laid
+  out by the compiler's slot table; programs cannot reach anything the
+  verb did not explicitly expose (no I/O, no state, no allocation of
+  program-visible objects);
+- total determinism: float arithmetic only, division/modulo by zero
+  and non-finite results fault rather than propagate, so the same
+  program on the same inputs yields bit-identical results across
+  re-compiles and across replay (the what-if parity gate pins this).
+
+Faults never escape to the verb: :class:`~.rater.PolicyRater` and the
+verb hooks catch :class:`PolicyFault` and fall back to the incumbent
+built-in, journaling a ``policy_fault`` annotation.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+# -- instruction set ---------------------------------------------------------
+
+(
+    OP_CONST,   # push consts[arg]
+    OP_LOAD,    # push inputs[arg]
+    OP_ADD, OP_SUB, OP_MUL, OP_DIV, OP_MOD,
+    OP_NEG, OP_NOT, OP_TRUTH,
+    OP_LT, OP_LE, OP_GT, OP_GE, OP_EQ, OP_NE,
+    OP_JMP,     # pc = arg
+    OP_JMPF,    # pop; falsy → pc = arg
+    OP_MIN, OP_MAX, OP_ABS, OP_FLOOR, OP_CEIL,
+    OP_CLAMP,   # pop hi, lo, x → push min(max(x, lo), hi)
+) = range(24)
+
+OP_NAMES = {
+    OP_CONST: "CONST", OP_LOAD: "LOAD", OP_ADD: "ADD", OP_SUB: "SUB",
+    OP_MUL: "MUL", OP_DIV: "DIV", OP_MOD: "MOD", OP_NEG: "NEG",
+    OP_NOT: "NOT", OP_TRUTH: "TRUTH", OP_LT: "LT", OP_LE: "LE",
+    OP_GT: "GT", OP_GE: "GE", OP_EQ: "EQ", OP_NE: "NE", OP_JMP: "JMP",
+    OP_JMPF: "JMPF", OP_MIN: "MIN", OP_MAX: "MAX", OP_ABS: "ABS",
+    OP_FLOOR: "FLOOR", OP_CEIL: "CEIL", OP_CLAMP: "CLAMP",
+}
+
+DEFAULT_BUDGET = 512
+MAX_BUDGET = 4096
+DEFAULT_DEADLINE_S = 0.002  # 2ms: generous vs the ~µs a real eval takes,
+# tight vs the bind path's own budget — a wedged host trips here, not there
+_DEADLINE_STRIDE = 64  # instructions between perf_counter checks
+
+
+class PolicyFault(Exception):
+    """A policy program failed AT RUNTIME (budget, deadline, math, or a
+    malformed stack).  Verb hooks catch this and fall back to the
+    incumbent built-in — a fault is an annotation, never a failed bind."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+        self.detail = detail
+
+
+@dataclass(frozen=True)
+class Program:
+    """Compiled policy bytecode.  Immutable; safe to share across
+    threads (the VM keeps all mutable state on its own stack)."""
+
+    code: tuple  # ((op, arg), ...)
+    consts: tuple  # float literals
+    slots: tuple  # input names in LOAD-slot order (first-use assigned)
+    source: str
+    budget: int = DEFAULT_BUDGET
+    deadline_s: float = DEFAULT_DEADLINE_S
+    fingerprint: str = field(default="", compare=False)
+    # hot-path closure generated from the same AST (lang._build_py_fn),
+    # present ONLY when the static instruction count fits the budget —
+    # then neither budget nor deadline can trip at runtime (loop-free,
+    # straight-line), so the closure and the interpreter are behavior-
+    # identical (property-tested bit-for-bit).  Excluded from equality
+    # and the fingerprint: the bytecode is the canonical form.
+    py_fn: object = field(default=None, compare=False, repr=False)
+    # the parsed AST the emitters consumed — kept so PolicyRater can
+    # specialize a fused fills+expression rate function (lang.
+    # build_filled_fn).  Same canonical-form stance as py_fn.
+    ast: object = field(default=None, compare=False, repr=False)
+
+    def disasm(self) -> list[str]:
+        out = []
+        for pc, (op, arg) in enumerate(self.code):
+            name = OP_NAMES.get(op, f"OP{op}")
+            if op == OP_CONST:
+                out.append(f"{pc:3d} {name} {self.consts[arg]!r}")
+            elif op == OP_LOAD:
+                out.append(f"{pc:3d} {name} {self.slots[arg]}")
+            elif op in (OP_JMP, OP_JMPF):
+                out.append(f"{pc:3d} {name} ->{arg}")
+            else:
+                out.append(f"{pc:3d} {name}")
+        return out
+
+
+def evaluate(program: Program, inputs) -> float:
+    """Hot-path entry: the generated closure when the program qualifies
+    (static size ≤ budget — see ``Program.py_fn``), the interpreter
+    otherwise.  Identical results and fault semantics either way."""
+    fn = program.py_fn
+    if fn is None:
+        return run(program, inputs)
+    try:
+        result = fn(inputs)
+    except PolicyFault:
+        raise
+    except OverflowError:
+        raise PolicyFault("math", "overflow") from None
+    except Exception as e:  # defensive: closure bugs must fault, not leak
+        raise PolicyFault("fill", str(e)) from None
+    if not math.isfinite(result):
+        raise PolicyFault("math", "non-finite result")
+    return result
+
+
+def run(program: Program, inputs) -> float:
+    """Evaluate ``program`` over the input vector (floats, laid out per
+    ``program.slots``).  Raises :class:`PolicyFault` on budget trip,
+    deadline trip, math fault (div/mod by zero, non-finite result) or a
+    malformed program.  The hot loop allocates only Python floats and
+    one stack list — steady-state allocation is flat (pinned by the
+    property tests)."""
+    code = program.code
+    consts = program.consts
+    budget = program.budget
+    deadline_s = program.deadline_s
+    stack: list = []
+    push = stack.append
+    pop = stack.pop
+    pc = 0
+    ncode = len(code)
+    executed = 0
+    t0 = time.perf_counter() if deadline_s else 0.0
+    try:
+        while pc < ncode:
+            executed += 1
+            if executed > budget:
+                raise PolicyFault(
+                    "budget", f"exceeded {budget} instructions"
+                )
+            if deadline_s and executed % _DEADLINE_STRIDE == 0:
+                if time.perf_counter() - t0 > deadline_s:
+                    raise PolicyFault(
+                        "deadline", f"exceeded {deadline_s * 1e3:.1f}ms"
+                    )
+            op, arg = code[pc]
+            pc += 1
+            if op == OP_LOAD:
+                push(inputs[arg])
+            elif op == OP_CONST:
+                push(consts[arg])
+            elif op == OP_ADD:
+                b = pop(); push(pop() + b)
+            elif op == OP_SUB:
+                b = pop(); push(pop() - b)
+            elif op == OP_MUL:
+                b = pop(); push(pop() * b)
+            elif op == OP_DIV:
+                b = pop()
+                if b == 0.0:
+                    raise PolicyFault("math", "division by zero")
+                push(pop() / b)
+            elif op == OP_MOD:
+                b = pop()
+                if b == 0.0:
+                    raise PolicyFault("math", "modulo by zero")
+                push(math.fmod(pop(), b))
+            elif op == OP_NEG:
+                push(-pop())
+            elif op == OP_NOT:
+                push(1.0 if pop() == 0.0 else 0.0)
+            elif op == OP_TRUTH:
+                push(0.0 if pop() == 0.0 else 1.0)
+            elif op == OP_LT:
+                b = pop(); push(1.0 if pop() < b else 0.0)
+            elif op == OP_LE:
+                b = pop(); push(1.0 if pop() <= b else 0.0)
+            elif op == OP_GT:
+                b = pop(); push(1.0 if pop() > b else 0.0)
+            elif op == OP_GE:
+                b = pop(); push(1.0 if pop() >= b else 0.0)
+            elif op == OP_EQ:
+                b = pop(); push(1.0 if pop() == b else 0.0)
+            elif op == OP_NE:
+                b = pop(); push(1.0 if pop() != b else 0.0)
+            elif op == OP_JMP:
+                pc = arg
+            elif op == OP_JMPF:
+                if pop() == 0.0:
+                    pc = arg
+            elif op == OP_MIN:
+                b = pop(); a = pop(); push(a if a <= b else b)
+            elif op == OP_MAX:
+                b = pop(); a = pop(); push(a if a >= b else b)
+            elif op == OP_ABS:
+                push(abs(pop()))
+            elif op == OP_FLOOR:
+                push(float(math.floor(pop())))
+            elif op == OP_CEIL:
+                push(float(math.ceil(pop())))
+            elif op == OP_CLAMP:
+                hi = pop(); lo = pop(); x = pop()
+                if x < lo:
+                    x = lo
+                if x > hi:
+                    x = hi
+                push(x)
+            else:  # pragma: no cover - compiler never emits unknown ops
+                raise PolicyFault("op", f"unknown opcode {op}")
+    except IndexError:
+        raise PolicyFault("stack", "stack underflow") from None
+    except OverflowError:
+        raise PolicyFault("math", "overflow") from None
+    if len(stack) != 1:
+        raise PolicyFault("stack", f"ended with {len(stack)} values")
+    result = stack[0]
+    if not math.isfinite(result):
+        raise PolicyFault("math", "non-finite result")
+    return result

@@ -274,6 +274,11 @@ class WorkloadProfiler:
         self._tenancy_gen = 0
         self._pod_tenancy: dict[str, tuple] = {}
         self._chip_tenants: dict[tuple[str, str], dict[str, str]] = {}
+        # node → {workload class: live pod count} — maintained with the
+        # tenancy map so the policy plane's filter verb can answer
+        # "which classes are resident on this node" in O(classes)
+        # (never a scan over the pod map)
+        self._node_classes: dict[str, dict[str, int]] = {}
         # ONE gauge carries the refresher: a single run rebuilds both
         # series sets (replace()), and the registry collects the gauges
         # in registration order within a scrape — registering it twice
@@ -337,6 +342,7 @@ class WorkloadProfiler:
             self._tenancy_gen = 0
             self._pod_tenancy.clear()
             self._chip_tenants.clear()
+            self._node_classes.clear()
 
     # -- hot path ------------------------------------------------------------
 
@@ -448,6 +454,8 @@ class WorkloadProfiler:
             )
             for c in coords:
                 self._chip_tenants.setdefault((node, c), {})[pod_key] = wclass
+            row = self._node_classes.setdefault(node, {})
+            row[wclass] = row.get(wclass, 0) + 1
 
     def note_unbind(self, pod_key: str) -> None:
         if not self.enabled:
@@ -459,13 +467,29 @@ class WorkloadProfiler:
                 self._evict_tenancy_locked(pod_key, old)
 
     def _evict_tenancy_locked(self, pod_key: str, entry: tuple) -> None:
-        node, _cls, _gen, coords, _frac = entry
+        node, cls, _gen, coords, _frac = entry
         for c in coords:
             tenants = self._chip_tenants.get((node, c))
             if tenants is not None:
                 tenants.pop(pod_key, None)
                 if not tenants:
                     del self._chip_tenants[(node, c)]
+        row = self._node_classes.get(node)
+        if row is not None:
+            n = row.get(cls, 0) - 1
+            if n > 0:
+                row[cls] = n
+            else:
+                row.pop(cls, None)
+                if not row:
+                    del self._node_classes[node]
+
+    def classes_on_node(self, node: str) -> tuple[str, ...]:
+        """Distinct workload classes with live pods on ``node`` (the
+        policy filter verb's interference input source)."""
+        with self._tenancy_lock:
+            row = self._node_classes.get(node)
+            return tuple(sorted(row)) if row else ()
 
     def neighbors_of(self, pod_key: str) -> tuple[str, ...]:
         """Distinct co-tenant classes sharing any of the pod's chips

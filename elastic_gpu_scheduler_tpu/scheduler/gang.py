@@ -536,6 +536,21 @@ class GangCoordinator:
             # at fleet scale this is what keeps the clone count
             # proportional to plausible hosts, not to the cluster
             ordered = self._prune_ordered(idx, req, ordered)
+        # policy-plane filter verb (promoted policies only: every member
+        # of a gang must see the SAME candidate set, so the per-pod
+        # canary split never applies here).  Faults keep the node; a
+        # policy that empties the set makes the gang infeasible — the
+        # same verdict an operator's "never place here" rule implies.
+        plane = getattr(sched, "policies", None)
+        if plane is not None and ordered and "filter" in plane.active:
+            pol = plane.active["filter"]
+            inputs = sched.filter_policy_inputs(
+                req, self._req_wclass(req), [n for _, n in ordered]
+            )
+            ordered = [
+                (s, n) for s, n in ordered
+                if n not in inputs or plane.eval_filter(pol, inputs[n])
+            ]
         candidates = self._candidate_groups(ordered)
         # memoized trade results, shared across candidate groups — keyed by
         # full node state, so clones from different groups can only hit when
@@ -554,6 +569,13 @@ class GangCoordinator:
                 node_slices={n: s for s, n in ordered},
             )
         return None
+
+    @staticmethod
+    def _req_wclass(req: TPURequest) -> str:
+        """Workload class for the policy filter's behavior inputs — the
+        request wire type carries no annotations, so gangs profile under
+        the default class unless the request grew one."""
+        return getattr(req, "wclass", None) or consts.DEFAULT_WORKLOAD_CLASS
 
     @staticmethod
     def _candidate_groups(ordered: list[tuple[str, str]]) -> list[list[str]]:

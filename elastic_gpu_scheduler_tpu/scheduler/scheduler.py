@@ -147,6 +147,13 @@ class TPUUnitScheduler(ResourceScheduler):
         # itself when stale)
         self._frag_cache: dict[str, tuple[float, int]] = {}
         self._frag_cache_at = 0.0  # monotonic of the last refresh
+        # programmable policy plane (policy/PolicyPlane): None (or an
+        # empty plane) costs one attribute/dict check per verb.  When a
+        # score canary is live, bind splits raters by pod hash and
+        # journals both arms; a loaded filter policy prunes assume()'s
+        # feasible set; a preempt policy re-ranks reprieve order.
+        # build_stack attaches the process-global POLICIES here.
+        self.policies = None
         self._pool = ThreadPoolExecutor(
             max_workers=self.assume_workers, thread_name_prefix="assume"
         )
@@ -435,9 +442,119 @@ class TPUUnitScheduler(ResourceScheduler):
                     ok.append(name)
                 else:
                     failed[name] = err
+            plane = self.policies
+            if plane is not None and ok and plane.wants("filter"):
+                ok, failed = self._apply_filter_policy(
+                    plane, request, pod, ok, failed
+                )
             sp.set_attr("feasible", len(ok))
             sp.set_attr("index_decided", len(decided))
             return ok, failed
+
+    def filter_policy_inputs(
+        self, request: TPURequest, wclass: str, node_names: list[str],
+    ) -> dict[str, dict]:
+        """Per-node typed input vectors for the ``filter`` policy verb
+        (policy/rater.py FILTER_INPUTS): capacity/fragmentation from the
+        index entry when present (O(1), no node lock), allocator sums
+        otherwise, plus the profile observatory's measured behavior for
+        the pod's workload class (normalized throughput on the node's
+        generation; worst interference ratio vs the classes currently
+        resident there).  Shared by assume() and the gang prefilter."""
+        from ..policy.rater import behavior_factors
+
+        d_core, d_hbm, d_chips = request_demand(request)
+        entries = {}
+        if self.index is not None:
+            self.index.fold()
+            entries = self.index.entries
+        # one profiles/matrix fold per verb, not per node
+        prof_on = PROFILER.enabled
+        profiles = PROFILER.profiles() if prof_on else {}
+        matrix = PROFILER.interference_matrix() if prof_on else {}
+        out: dict[str, dict] = {}
+        by_name = self.get_allocators(
+            [n for n in node_names if n not in entries]
+        ) if any(n not in entries for n in node_names) else {}
+        for n in node_names:
+            e = entries.get(n)
+            if e is not None:
+                free_chips, free_core, free_hbm = (
+                    e.free_chips, e.free_core, e.free_hbm,
+                )
+                frag, largest, gen = e.frag, e.largest, e.generation
+                na = self.allocators.get(n)
+                if na is not None:
+                    total_chips = na.chips.num_chips
+                else:  # entry without a cached allocator: topology bound
+                    total_chips = 1
+                    for d in e.topo_key[0]:
+                        total_chips *= d
+            else:
+                na = by_name.get(n)
+                if na is None:
+                    continue
+                with na.lock:
+                    cs = na.chips
+                    free_chips = cs.free_count()
+                    free_core = cs.avail_core()
+                    free_hbm = cs.avail_hbm()
+                    total_chips = cs.num_chips
+                    largest = cs.largest_free_box() if free_chips else 0
+                frag = (
+                    round(1.0 - largest / free_chips, 4)
+                    if free_chips else 0.0
+                )
+                gen = na.generation
+            tput, ifx = 1.0, 1.0
+            if prof_on:
+                tput, ifx = behavior_factors(
+                    profiles, matrix, wclass, gen,
+                    PROFILER.classes_on_node(n),
+                )
+            out[n] = {
+                "free_chips": float(free_chips),
+                "free_core": float(free_core),
+                "free_hbm": float(free_hbm),
+                "total_chips": float(total_chips),
+                "frag": float(frag),
+                "largest_box": float(largest),
+                "demand_core": float(d_core),
+                "demand_hbm": float(d_hbm),
+                "demand_chips": float(d_chips),
+                "tput": tput,
+                "interference": ifx,
+            }
+        return out
+
+    def _apply_filter_policy(
+        self, plane, request: TPURequest, pod: Pod,
+        ok: list[str], failed: dict[str, str],
+    ) -> tuple[list[str], dict[str, str]]:
+        """Run the loaded ``filter`` policy over the feasible set.  A
+        canary filter splits by the same deterministic pod hash as the
+        score canary; faults KEEP the node (the incumbent already
+        passed it), and the SLO monitor watches the per-arm reject rate
+        for auto-rollback."""
+        pol, arm = plane.decide("filter", pod.key)
+        if pol is None:
+            if arm == "incumbent":
+                # the incumbent arm keeps every built-in-feasible node;
+                # its kept/total still feeds the reject-rate comparison
+                plane.note_filter_decision(arm, len(ok), len(ok))
+            return ok, failed
+        inputs = self.filter_policy_inputs(
+            request, workload_class(pod), ok
+        )
+        kept: list[str] = []
+        for n in ok:
+            info = inputs.get(n)
+            if info is None or plane.eval_filter(pol, info):
+                kept.append(n)
+            else:
+                failed[n] = f"rejected by policy {pol.name}"
+        plane.note_filter_decision(arm, len(kept), len(ok))
+        return kept, failed
 
     def score(self, node_names: list[str], pod: Pod) -> list[int]:
         """Priorities verb (reference: scheduler.go:170-184)."""
@@ -498,11 +615,23 @@ class TPUUnitScheduler(ResourceScheduler):
                 raise RuntimeError(
                     f"bind: node {node_name} has no TPU allocator"
                 )
+            # score-verb policy canary: a deterministic pod-hash fraction
+            # of binds places under the CANDIDATE policy rater, the rest
+            # under the incumbent; both arms journal a `policy` record
+            # with the cross-scored divergence (note_bind_decision) and
+            # feed the SLO monitor that auto-rolls a regressing canary
+            # back.  One dict check when nothing is canarying.
+            plane = self.policies
+            rater = self.rater
+            decision = None
+            t_bind0 = time.perf_counter()
+            if plane is not None and plane.wants("score"):
+                rater, decision = plane.score_rater_for(pod.key, self.rater)
             # the placement search runs under the NODE's lock only — binds
             # to different nodes no longer serialize on the registry lock
             # (a pod mid-bind carries no assumed label yet, so no
             # controller callback can race a forget in this window)
-            opt = na.allocate(request, self.rater)
+            opt = na.allocate(request, rater)
             with self.lock:
                 self.pod_maps[pod.key] = (node_name, opt)
                 self.released_pods.pop(pod.key, None)
@@ -515,6 +644,12 @@ class TPUUnitScheduler(ResourceScheduler):
                     trace_id=sp.trace_id or None,
                 )
             sp.event("allocated")
+            if decision is not None:
+                plane.note_bind_decision(
+                    decision, pod_key=pod.key, node=node_name, opt=opt,
+                    latency_s=time.perf_counter() - t_bind0, na=na,
+                    incumbent=self.rater,
+                )
 
             try:
                 updated = self._write_annotations(pod, opt, node_name)
@@ -670,11 +805,44 @@ class TPUUnitScheduler(ResourceScheduler):
             groups.setdefault(pod_gang_key(v) or f"solo/{v.key}", []).append(
                 (v, opt)
             )
-        needed: list[Pod] = []
-        for gkey, group in sorted(
+        # Reprieve order: built-in restores highest-priority victims
+        # first (key = -priority, ascending).  A loaded ``preempt``
+        # policy replaces the ranking with its own victim preference
+        # (HIGHER = evict first → reprieve LAST).  All-or-nothing like
+        # defrag's _order_victims: a policy that faults on ANY group
+        # falls back to the built-in order for the WHOLE set — mixing
+        # policy scores with -priority values in one sort would place
+        # the faulted groups arbitrarily under neither rule.
+        ordered_groups = sorted(
             groups.items(),
             key=lambda kv: -max((v.spec.priority or 0) for v, _ in kv[1]),
-        ):
+        )
+        plane = self.policies
+        if plane is not None and plane.wants("preempt"):
+            scores = plane.preempt_scores([
+                {
+                    "priority": float(
+                        max((v.spec.priority or 0) for v, _ in grp)
+                    ),
+                    "chips": float(sum(
+                        len(a.coords)
+                        for _v, o in grp
+                        for a in o.allocs if a.needs_tpu
+                    )),
+                    "members": float(len(grp)),
+                    "is_gang": 0.0 if gkey.startswith("solo/") else 1.0,
+                }
+                for gkey, grp in ordered_groups
+            ])
+            if scores is not None:
+                ordered_groups = [
+                    g for _s, g in sorted(
+                        zip(scores, ordered_groups), key=lambda t: t[0]
+                    )
+                ]
+
+        needed: list[Pod] = []
+        for gkey, group in ordered_groups:
             if gkey in doomed_gangs:
                 needed.extend(v for v, _ in group)
                 continue

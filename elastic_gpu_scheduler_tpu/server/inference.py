@@ -44,6 +44,7 @@ from typing import Optional
 
 from .. import __version__
 from ..metrics import REGISTRY, Counter, Gauge, Histogram
+from ..policy import POLICIES
 from ..profile import PROFILER
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..models.serving import (
@@ -106,6 +107,28 @@ SERVE_HOST_GAP = REGISTRY.register(
                  100.0, 500.0),
     )
 )
+
+
+def choose_kv_victim(eng) -> int:
+    """Pick the slot to preempt when the KV page pool is exhausted.
+
+    Routed through the policy registry's ``kv`` verb: the built-in
+    ranking is the historic hard-coded choice (lowest-priority slot,
+    most pages held as tiebreak); a hot-loaded ``kv`` policy re-ranks
+    with the typed inputs priority / pages / tokens / slot (HIGHER
+    score = evict first), falling back to the built-in on any policy
+    fault.  Only runs on the rare pool-exhausted path — never on the
+    per-token loop."""
+    return POLICIES.select_kv_victim([
+        {
+            "slot": float(i),
+            "priority": float(eng.priorities[i]),
+            "pages": float(len(eng.slot_pages[i])),
+            "tokens": float(len(getattr(s, "output", ()) or ())),
+        }
+        for i, s in enumerate(eng.slots)
+        if s is not None
+    ])
 
 
 class EngineLoop:
@@ -266,13 +289,7 @@ class EngineLoop:
                     # path.  First eviction is a requeue (exact resume);
                     # a repeat offender genuinely doesn't fit the pool
                     # and gets the terminal error (no infinite thrash).
-                    victim = min(
-                        (i for i, s in enumerate(eng.slots) if s is not None),
-                        key=lambda i: (
-                            int(eng.priorities[i]),
-                            -len(eng.slot_pages[i]),
-                        ),
-                    )
+                    victim = choose_kv_victim(eng)
                     req = eng.slots[victim]
                     log.warning(
                         "KV page pool exhausted; preempting priority-%d "

@@ -267,6 +267,11 @@ the Python analogues):</p>
  rate, autoscaler policy + last decision, resize history
  (--fleet=router|auto starts it; the router's own port serves the same
  payload at /debug/fleet)</li>
+<li><a href="/debug/policy">/debug/policy</a>
+ — programmable policy plane: active/canary policies per verb, replay-
+ gate results, canary decision counters + SLO watchdog state; POST
+ /policy/load stages a candidate (compile → replay gate → canary),
+ /policy/promote and /policy/rollback drive the state machine</li>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
@@ -428,6 +433,7 @@ class ExtenderServer:
         leader_check=None,  # callable → bool; None = always the leader
         defrag=None,  # optional defrag.DefragPlanner (plan preview + run)
         fleet=None,  # optional fleet state provider (debug_state() dict)
+        policy=None,  # optional policy.PolicyPlane (/policy/*, /debug/policy)
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -436,6 +442,7 @@ class ExtenderServer:
         self.preemption = preemption
         self.defrag = defrag
         self.fleet = fleet
+        self.policy = policy
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -598,6 +605,21 @@ class ExtenderServer:
                 json.dumps(RELAY_MONITOR.debug_state(), indent=1).encode(),
                 "application/json",
             )
+        if path == "/debug/policy":
+            if self.policy is None:
+                return (
+                    404,
+                    json.dumps({"error": "policy plane not configured"}).encode(),
+                    "application/json",
+                )
+            try:
+                out = self.policy.debug_state()
+            except Exception as e:
+                return (
+                    500, json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
+            return 200, json.dumps(out, indent=1).encode(), "application/json"
         if path == "/debug/journal":
             params = _parse_query(query)
             try:
@@ -673,6 +695,8 @@ class ExtenderServer:
             return 503, b'{"Error": "not the leader"}', "application/json"
         if path == "/defrag/run":
             return self._route_defrag_run(raw)
+        if path.startswith("/policy/"):
+            return self._route_policy(path, raw)
         # route existence FIRST: unknown paths are 404s regardless of
         # body, and metric labels only ever come from this fixed verb
         # set (an attacker cycling random paths must not grow /metrics)
@@ -805,6 +829,101 @@ class ExtenderServer:
             log.exception("defrag run failed")
             return (
                 500, json.dumps({"Error": f"defrag: {e}"}).encode(),
+                "application/json",
+            )
+
+    def _route_policy(self, path: str, raw: bytes) -> tuple[int, bytes, str]:
+        """Policy-plane control surface:
+
+        POST /policy/load      {"name", "verb", "expr", "canary_pct"?,
+                               "tolerance"?, "budget"?, "skip_gate"?,
+                               "translation_invariant"?,
+                               "whole_chip_compact_first"?}
+                               → compile, replay-gate against the live
+                               journal, stage as canary (409 when the
+                               gate blocks a worse candidate)
+        POST /policy/promote   {"verb"} → canary becomes active
+        POST /policy/rollback  {"verb", "reason"?} → drop candidate or
+                               active policy, restore the built-in
+
+        Introspection lives at GET /debug/policy."""
+        if self.policy is None:
+            return (
+                404,
+                json.dumps({"error": "policy plane not configured"}).encode(),
+                "application/json",
+            )
+        try:
+            body = json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return 400, b'{"Error": "malformed JSON body"}', "application/json"
+        if not isinstance(body, dict):
+            return (
+                400, b'{"Error": "body must be a JSON object"}',
+                "application/json",
+            )
+        try:
+            if path == "/policy/load":
+                for req_field in ("name", "verb", "expr"):
+                    if not body.get(req_field):
+                        return (
+                            400,
+                            json.dumps({
+                                "Error": f"missing field {req_field!r}"
+                            }).encode(),
+                            "application/json",
+                        )
+                result = self.policy.load(
+                    name=str(body["name"]),
+                    verb=str(body["verb"]),
+                    expr=str(body["expr"]),
+                    canary_pct=float(body.get("canary_pct", 10.0)),
+                    tolerance=float(body.get("tolerance", 0.02)),
+                    budget=int(body.get("budget", 512)),
+                    translation_invariant=bool(
+                        body.get("translation_invariant", False)
+                    ),
+                    whole_chip_compact_first=bool(
+                        body.get("whole_chip_compact_first", False)
+                    ),
+                    skip_gate=bool(body.get("skip_gate", False)),
+                )
+                code = 409 if result.get("state") == "blocked" else 200
+                return (
+                    code, json.dumps(result, indent=1).encode(),
+                    "application/json",
+                )
+            if path == "/policy/promote":
+                result = self.policy.promote(str(body.get("verb", "score")))
+                return (
+                    200, json.dumps(result, indent=1).encode(),
+                    "application/json",
+                )
+            if path == "/policy/rollback":
+                result = self.policy.rollback(
+                    str(body.get("verb", "score")),
+                    reason=str(body.get("reason", "operator")),
+                )
+                return (
+                    200, json.dumps(result, indent=1).encode(),
+                    "application/json",
+                )
+            return (
+                404, json.dumps({"error": f"no route {path}"}).encode(),
+                "application/json",
+            )
+        except (ValueError, TypeError) as e:
+            # compile errors, unknown verbs/names, and wrong-typed body
+            # fields (canary_pct: [10]) — malformed client input must
+            # never surface as a 500 (the _parse rule)
+            return (
+                400, json.dumps({"Error": str(e)}).encode(),
+                "application/json",
+            )
+        except Exception as e:
+            log.exception("policy route failed")
+            return (
+                500, json.dumps({"Error": f"policy: {e}"}).encode(),
                 "application/json",
             )
 

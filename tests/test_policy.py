@@ -1,0 +1,536 @@
+"""Policy plane units: expression VM, compiler, PolicyRater, registry.
+
+Property tests pinned here (ISSUE 10 satellites): instruction-budget
+trip → fallback to the incumbent, determinism across re-compiles,
+closure/interpreter bit-parity, steady-state allocation flatness,
+canary split determinism, and the KV-victim satellite (a loaded policy
+changes the evicted slot).  No jax anywhere — smoke tier.
+"""
+
+import random
+import sys
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.core.node import NodeAllocator
+from elastic_gpu_scheduler_tpu.core.rater import Binpack, Spread
+from elastic_gpu_scheduler_tpu.core.request import request_from_pod
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.policy import (
+    CompileError,
+    PolicyFault,
+    VERB_INPUTS,
+    canary_bucket,
+    compile_expr,
+    evaluate,
+    resolve_rater,
+    run,
+)
+from elastic_gpu_scheduler_tpu.policy.rater import PolicyRater
+from elastic_gpu_scheduler_tpu.policy.registry import PolicyPlane
+from elastic_gpu_scheduler_tpu.profile.rater import ProfileAwareRater
+from elastic_gpu_scheduler_tpu.utils import consts
+
+BINPACK_EXPR = "35*node_used + 30*chip_used + 25*preserve + 10*locality"
+
+
+def tpu_pod(name, core=0, hbm=0):
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+    )
+
+
+# -- language / VM -----------------------------------------------------------
+
+
+def test_precedence_and_functions():
+    p = compile_expr(
+        "1 + 2*3 - 4/2 + min(1, 2, 0.5) + max(3, 4) + clamp(9, 0, 5)"
+        " + abs(-2) + floor(1.7) + ceil(1.2)",
+        (),
+    )
+    # 1 + 6 - 2 + 0.5 + 4 + 5 + 2 + 1 + 2 = 19.5
+    assert run(p, []) == 19.5
+    assert evaluate(p, []) == 19.5
+
+
+def test_short_circuit_is_total():
+    p = compile_expr("x != 0 ? y / x : 0", ("x", "y"))
+    assert run(p, [0.0, 5.0]) == 0.0  # untaken branch never divides
+    assert run(p, [2.0, 5.0]) == 2.5
+    assert evaluate(p, [0.0, 5.0]) == 0.0
+    # and/or short-circuit too
+    q = compile_expr("x == 0 or 1 / x > 0", ("x",))
+    assert run(q, [0.0]) == 1.0
+    r = compile_expr("x != 0 and 1 / x > 0", ("x",))
+    assert run(r, [0.0]) == 0.0
+
+
+def test_unknown_input_is_compile_error():
+    with pytest.raises(CompileError, match="unknown input"):
+        compile_expr("node_used + typo_name", VERB_INPUTS["score"])
+
+
+@pytest.mark.parametrize(
+    "src",
+    ["", "1 +", "(1", "min()", "clamp(1, 2)", "1 2", "@", "x ? 1", "and 1"],
+)
+def test_syntax_errors(src):
+    with pytest.raises(CompileError):
+        compile_expr(src, ("x",))
+
+
+def test_nesting_cap():
+    with pytest.raises(CompileError, match="nests deeper"):
+        compile_expr("(" * 40 + "1" + ")" * 40, ())
+
+
+def test_determinism_across_recompiles():
+    a = compile_expr(BINPACK_EXPR, VERB_INPUTS["score"])
+    b = compile_expr(BINPACK_EXPR, VERB_INPUTS["score"])
+    assert a.fingerprint == b.fingerprint
+    assert a.code == b.code and a.consts == b.consts and a.slots == b.slots
+    rng = random.Random(5)
+    for _ in range(100):
+        vals = [rng.random() for _ in a.slots]
+        assert run(a, vals) == run(b, vals) == evaluate(a, vals)
+
+
+def test_closure_interpreter_bit_parity():
+    """The generated Python closure and the bytecode interpreter must
+    agree BIT-FOR-BIT, faults included, on arbitrary programs."""
+    rng = random.Random(11)
+    names = ("a", "b", "c")
+    exprs = [
+        "a + b*c - a / max(b, 0.5)",
+        "a < b ? c : -c",
+        "not (a and b) or c > 0",
+        "clamp(a*b, 0, 1) + floor(c) + ceil(a) + abs(-b)"
+        " + min(a, b, c) + max(a, b, c)",
+        "b != 0 ? a % b : 0",
+        "a == b or a != c and b <= c",
+        "a / b",  # faults at b == 0
+        "-a * (b - c) % max(a, 1)",
+    ]
+    for e in exprs:
+        p = compile_expr(e, names)
+        assert p.py_fn is not None
+        for _ in range(100):
+            vals = [float(rng.randint(-3, 3)) for _ in p.slots]
+            try:
+                r1 = run(p, vals)
+            except PolicyFault as f:
+                r1 = ("fault", f.kind)
+            try:
+                r2 = evaluate(p, vals)
+            except PolicyFault as f:
+                r2 = ("fault", f.kind)
+            assert r1 == r2, (e, vals, r1, r2)
+
+
+def test_budget_trip_is_runtime_fault():
+    p = compile_expr("1+1+1+1+1+1+1+1+1+1", (), budget=3)
+    assert p.py_fn is None  # over-budget programs never get the closure
+    with pytest.raises(PolicyFault) as ei:
+        evaluate(p, [])
+    assert ei.value.kind == "budget"
+
+
+def test_deadline_trips_interpreted_path():
+    # >64 instructions so the stride check fires; 1ns deadline always trips
+    p = compile_expr("+".join(["1"] * 200), (), deadline_s=1e-9)
+    with pytest.raises(PolicyFault) as ei:
+        run(p, [])
+    assert ei.value.kind == "deadline"
+
+
+def test_math_faults():
+    for src, vals in (("1/0", []), ("1 % 0", []), ("x/x", [0.0])):
+        p = compile_expr(src, ("x",))
+        with pytest.raises(PolicyFault) as ei:
+            evaluate(p, vals)
+        assert ei.value.kind == "math"
+    # non-finite result (inf via float multiply, no Python exception)
+    p = compile_expr("x * x", ("x",))
+    with pytest.raises(PolicyFault) as ei:
+        evaluate(p, [1e308])
+    assert ei.value.kind == "math"
+
+
+def test_steady_state_allocation_flat():
+    """The eval hot path must not ACCUMULATE allocations — floats churn
+    but net allocated blocks stay flat over thousands of evals."""
+    p = compile_expr(BINPACK_EXPR, VERB_INPUTS["score"])
+    vals = [0.5, 0.25, 0.8, 1.0]
+    for _ in range(200):  # warm caches
+        evaluate(p, vals)
+        run(p, vals)
+    before = sys.getallocatedblocks()
+    for _ in range(5000):
+        evaluate(p, vals)
+        run(p, vals)
+    delta = sys.getallocatedblocks() - before
+    assert abs(delta) < 500, f"allocation grew by {delta} blocks"
+
+
+# -- PolicyRater -------------------------------------------------------------
+
+
+def _allocator():
+    return NodeAllocator(
+        make_tpu_node("n0", chips=4, hbm_gib=64, accelerator="v5e")
+    )
+
+
+def test_binpack_parity_bit_identical():
+    """A policy spelling out the built-in binpack formula scores every
+    option BIT-IDENTICAL to Binpack, and trade picks the same
+    placement."""
+    rng = random.Random(3)
+    bp = Binpack()
+    pr = PolicyRater(
+        compile_expr(BINPACK_EXPR, VERB_INPUTS["score"]),
+        fallback=bp, translation_invariant=True,
+        whole_chip_compact_first=True,
+    )
+    na = _allocator()
+    for i in range(30):
+        core = rng.choice([50, 100, 200])
+        req = request_from_pod(tpu_pod(f"p{i}", core=core, hbm=2))
+        opt_b = na.chips.clone().trade(req, bp)
+        opt_p = na.chips.clone().trade(req, pr)
+        if opt_b is None:
+            assert opt_p is None
+            break
+        assert opt_p is not None
+        assert opt_b.score == opt_p.score  # bit-identical, not approx
+        assert [a.coords for a in opt_b.allocs] == [
+            a.coords for a in opt_p.allocs
+        ]
+        na.chips.transact(opt_b)
+        assert pr.faults == 0
+
+
+def test_budget_trip_falls_back_to_incumbent_score():
+    bp = Binpack()
+    # budget 2 < instruction count → every eval trips → fallback score
+    pr = PolicyRater(
+        compile_expr(BINPACK_EXPR, VERB_INPUTS["score"], budget=2),
+        fallback=bp,
+    )
+    na = _allocator()
+    req = request_from_pod(tpu_pod("p", core=50, hbm=2))
+    opt = na.chips.trade(req, bp)
+    na.chips.transact(opt)
+    assert pr.rate(na.chips, opt) == bp.rate(na.chips, opt)
+    assert pr.faults >= 1 and pr.evals >= 1
+
+
+def test_policy_rater_profile_hooks_duck_typed():
+    """observe_profile/set_workload flow into the tput input exactly as
+    ProfileAwareRater's plumbing (the what-if adapter contract)."""
+    pr = PolicyRater(
+        compile_expr("100 * tput", VERB_INPUTS["score"]), fallback=Binpack()
+    )
+    na = _allocator()
+    req = request_from_pod(tpu_pod("p", core=50, hbm=2))
+    opt = na.chips.trade(req, Binpack())
+    na.chips.transact(opt)
+    assert pr.rate(na.chips, opt) == 100.0  # unprofiled → tput 1.0
+    pr.observe_profile(
+        {"profiles": {"serve": {"tput": {"v5e": 100.0, "v5p": 400.0}}}}
+    )
+    pr.set_workload("serve", node="n0", generation="v5e")
+    assert pr.rate(na.chips, opt) == 25.0  # 100 * (100/400)
+
+
+# -- canary split ------------------------------------------------------------
+
+
+def test_canary_bucket_deterministic_and_uniform():
+    keys = [f"ns/pod-{i}" for i in range(20000)]
+    assert [canary_bucket(k) for k in keys[:50]] == [
+        canary_bucket(k) for k in keys[:50]
+    ]
+    frac = sum(1 for k in keys if canary_bucket(k) < 2500) / len(keys)
+    assert 0.22 < frac < 0.28  # 25% ± 3pp over 20k keys
+
+
+def test_canary_split_respects_fraction_bounds():
+    plane = PolicyPlane()
+    plane.load("p", "score", "locality", canary_pct=0.0, skip_gate=True)
+    assert all(
+        plane.decide("score", f"k{i}")[1] == "incumbent" for i in range(100)
+    )
+    plane.canary_pct["score"] = 100.0
+    assert all(
+        plane.decide("score", f"k{i}")[1] == "candidate" for i in range(100)
+    )
+    plane.reset()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_resolve_rater_unifies_specs(tmp_path):
+    assert resolve_rater("binpack") is not None
+    assert resolve_rater("binpack").name == "binpack"
+    pa = resolve_rater("profile-aware:spread")
+    assert isinstance(pa, ProfileAwareRater)
+    assert isinstance(pa.base, Spread)
+    f = tmp_path / "pol.expr"
+    f.write_text(BINPACK_EXPR)
+    pr = resolve_rater(f"policy:{f}:spread")
+    assert isinstance(pr, PolicyRater)
+    assert isinstance(pr.fallback, Spread)
+    with pytest.raises(ValueError):
+        resolve_rater("nonesuch")
+    with pytest.raises(ValueError):
+        resolve_rater("policy:not-a-loaded-name")
+    with pytest.raises(ValueError):
+        resolve_rater("policy:")
+    with pytest.raises(ValueError):
+        # trailing garbage on a built-in must ERROR, not silently
+        # resolve to the bare name (a typoed flag must fail loudly)
+        resolve_rater("binpack:v2")
+
+
+def test_load_rejects_unknown_verb_and_bad_expr():
+    plane = PolicyPlane()
+    with pytest.raises(ValueError):
+        plane.load("x", "nonesuch-verb", "1")
+    with pytest.raises(CompileError):
+        plane.load("x", "score", "node_used +", skip_gate=True)
+    # a compile error never stages anything
+    assert not plane.active and not plane.canary
+
+
+# -- kv victim satellite -----------------------------------------------------
+
+
+def _slots():
+    return [
+        {"slot": 0.0, "priority": 5.0, "pages": 10.0, "tokens": 3.0},
+        {"slot": 1.0, "priority": 1.0, "pages": 2.0, "tokens": 40.0},
+        {"slot": 2.0, "priority": 1.0, "pages": 7.0, "tokens": 9.0},
+    ]
+
+
+def test_kv_victim_builtin_ranking():
+    plane = PolicyPlane()
+    # lowest priority wins; most pages breaks the tie → slot 2
+    assert plane.select_kv_victim(_slots()) == 2
+
+
+def test_kv_victim_policy_changes_evicted_slot():
+    plane = PolicyPlane()
+    plane.load("most-tokens", "kv", "tokens", skip_gate=True)
+    # policy: evict the slot with the most emitted tokens → slot 1
+    assert plane.select_kv_victim(_slots()) == 1
+    plane.reset()
+    assert plane.select_kv_victim(_slots()) == 2
+
+
+def test_kv_victim_fault_falls_back_to_builtin():
+    plane = PolicyPlane()
+    plane.load("faulty", "kv", "1 / (pages - pages)", skip_gate=True)
+    assert plane.select_kv_victim(_slots()) == 2  # built-in ranking
+    pol = plane.canary.get("kv") or plane.active.get("kv")
+    assert pol.faults >= 1
+    plane.reset()
+
+
+# -- preempt / defrag verb evaluation ----------------------------------------
+
+
+def test_nonsplit_canary_takes_precedence_over_active():
+    """A staged kv/defrag/preempt canary IS the policy under evaluation
+    — a promoted active policy must not shadow it into zero evals."""
+    plane = PolicyPlane()
+    plane.load("k1", "kv", "pages", skip_gate=True)
+    plane.promote("kv")
+    plane.load("k2", "kv", "tokens", skip_gate=True)
+    # k2 (most tokens → slot 1) decides, not the promoted k1
+    assert plane.select_kv_victim(_slots()) == 1
+    assert plane.canary["kv"].evals > 0
+    plane.reset()
+
+
+def test_preempt_scores_all_or_nothing_on_fault():
+    plane = PolicyPlane()
+    infos = [
+        {"priority": 0.0, "chips": 2.0, "members": 1.0, "is_gang": 0.0},
+        {"priority": 5.0, "chips": 4.0, "members": 1.0, "is_gang": 0.0},
+    ]
+    assert plane.preempt_scores(infos) is None  # no policy
+    plane.load("chips", "preempt", "chips", skip_gate=True)
+    assert plane.preempt_scores(infos) == [2.0, 4.0]
+    # priority 0 faults the policy below → the WHOLE set reports None
+    plane.load("div", "preempt", "1 / priority", skip_gate=True)
+    assert plane.preempt_scores(infos) is None
+    plane.reset()
+
+
+def test_gate_faulting_candidate_journals_nothing_and_blocks():
+    """A candidate that faults during the OFFLINE replay gate must not
+    write per-eval policy_fault records into the live journal, and the
+    gate must refuse it (fallback scores would otherwise carry it)."""
+    import random as _random
+
+    from elastic_gpu_scheduler_tpu.core.chip import Chip
+    from elastic_gpu_scheduler_tpu.core.topology import Topology
+
+    # synthesize a tiny recorded workload: one node_add + binds
+    na = _allocator()
+    events = [dict(
+        type="node_add", seq=0, node="n0", generation="v5e",
+        **na.chips.inventory(),
+    )]
+    rng = _random.Random(1)
+    seq = 1
+    for i in range(6):
+        req = request_from_pod(tpu_pod(f"g{i}", core=50, hbm=2))
+        opt = na.chips.trade(req, Binpack())
+        if opt is None:
+            break
+        na.chips.transact(opt)
+        from elastic_gpu_scheduler_tpu.journal import option_record
+        events.append({
+            "type": "bind", "seq": seq, "pod": f"d/g{i}", "uid": f"u{i}",
+            "node": "n0", "option": option_record(opt), "gang": None,
+        })
+        seq += 1
+    plane = PolicyPlane()
+    res = plane.load(
+        "faulty", "score", "100 / (free_chips - free_chips)",
+        gate_events=events,
+    )
+    assert res["state"] == "blocked"
+    assert any("faulted" in r for r in res["gate"]["reasons"])
+    assert plane._orphan_faults_journaled == 0  # gate faults stay local
+    pol_rater_faults = res["gate"].get("gate_faults", 0)
+    assert pol_rater_faults > 0
+    plane.reset()
+
+
+def test_preempt_score_builtin_and_policy():
+    plane = PolicyPlane()
+    info = {"priority": 7.0, "chips": 2.0, "members": 1.0, "is_gang": 0.0}
+    assert plane.preempt_score(info) == -7.0  # built-in: -priority
+    plane.load("big-first", "preempt", "chips", skip_gate=True)
+    assert plane.preempt_score(info) == 2.0
+    plane.load("broken", "preempt", "1/0", skip_gate=True)
+    assert plane.preempt_score(info) == -7.0  # fault → built-in
+    plane.reset()
+
+
+def test_defrag_score_none_without_policy():
+    plane = PolicyPlane()
+    info = {"chips": 2.0, "priority": 0.0, "whole": 1.0, "is_gang": 0.0,
+            "node_free": 3.0}
+    assert plane.defrag_score(info) is None
+    plane.load("small-first", "defrag", "0 - chips", skip_gate=True)
+    assert plane.defrag_score(info) == -2.0
+    plane.reset()
+
+
+def test_defrag_victim_policy_reorders_planner_pool():
+    from elastic_gpu_scheduler_tpu.defrag import DefragPlanner, _Victim
+
+    planner = DefragPlanner([], clientset=None)
+    vs = [
+        _Victim(pod_key="a", uid="", node="n", option=None, priority=0,
+                gang="", whole=True, chips=1),
+        _Victim(pod_key="b", uid="", node="n", option=None, priority=5,
+                gang="", whole=True, chips=3),
+    ]
+    # built-in unblock order: biggest chips first → b, a
+    order = planner._order_victims(vs, 4, lambda v: -v.chips)
+    assert [v.pod_key for v in order] == ["b", "a"]
+    plane = PolicyPlane()
+    plane.load("low-prio-first", "defrag", "0 - priority", skip_gate=True)
+    planner.policies = plane
+    order = planner._order_victims(vs, 4, lambda v: -v.chips)
+    # policy: prefer LOW priority victims → a (prio 0) first
+    assert [v.pod_key for v in order] == ["a", "b"]
+    plane.reset()
+
+
+def test_defrag_victim_fault_restores_builtin_order_whole_pool():
+    """A policy faulting on ANY victim must order the WHOLE pool by the
+    built-in rule — mixing policy scores and built-in key values in one
+    sort would place faulted victims arbitrarily."""
+    from elastic_gpu_scheduler_tpu.defrag import DefragPlanner, _Victim
+
+    planner = DefragPlanner([], clientset=None)
+    vs = [
+        _Victim(pod_key="a", uid="", node="n", option=None, priority=0,
+                gang="", whole=True, chips=1),
+        _Victim(pod_key="b", uid="", node="n", option=None, priority=3,
+                gang="", whole=True, chips=3),
+        # priority 0 → the policy below divides by zero for this one
+        _Victim(pod_key="c", uid="", node="n", option=None, priority=0,
+                gang="", whole=True, chips=2),
+    ]
+    plane = PolicyPlane()
+    plane.load("div-by-prio", "defrag", "1 / priority", skip_gate=True)
+    planner.policies = plane
+    order = planner._order_victims(vs, 4, lambda v: -v.chips)
+    assert [v.pod_key for v in order] == ["b", "c", "a"]  # built-in
+    plane.reset()
+
+
+def test_nonsplit_verbs_stage_at_full_exposure():
+    """preempt/defrag/kv have no pod-hash split surface: a staged
+    policy decides every operation, and load() must SAY so (100%)
+    instead of echoing an unenforced fraction."""
+    plane = PolicyPlane()
+    res = plane.load("kv-pol", "kv", "pages", canary_pct=5.0,
+                     skip_gate=True)
+    assert res["canary_pct"] == 100.0
+    res = plane.load("f-pol", "filter", "free_chips >= 1",
+                     canary_pct=5.0, skip_gate=True)
+    assert res["canary_pct"] == 5.0  # split-capable verbs keep theirs
+    plane.reset()
+
+
+def test_per_verb_slo_monitors_survive_unrelated_loads():
+    """Loading a policy on one verb must not wipe another verb's live
+    canary SLO evidence."""
+    plane = PolicyPlane()
+    plane.load("s", "score", "locality", canary_pct=50.0, skip_gate=True)
+    score_slo = plane.slos["score"]
+    for _ in range(30):
+        score_slo.note_latency("candidate", 0.050)
+        score_slo.note_latency("incumbent", 0.001)
+    plane.load("d", "defrag", "chips", skip_gate=True)
+    assert plane.slos["score"] is score_slo  # evidence intact
+    out = plane.check_slo()
+    assert out is not None and out["verb"] == "score"
+    assert "defrag" in plane.canary  # only the regressing verb rolled
+    plane.reset()
+
+
+def test_filter_eval_fault_keeps_node():
+    plane = PolicyPlane()
+    plane.load("f", "filter", "1 / (frag - frag)", skip_gate=True)
+    pol = plane.canary["filter"]
+    assert plane.eval_filter(pol, {"frag": 0.5}) is True  # fault → keep
+    plane.load("g", "filter", "free_chips >= 2", skip_gate=True)
+    pol = plane.canary["filter"]
+    assert plane.eval_filter(pol, {"free_chips": 4.0}) is True
+    assert plane.eval_filter(pol, {"free_chips": 1.0}) is False
+    plane.reset()

@@ -1,0 +1,411 @@
+"""Policy plane integration: live stack + journal + HTTP surface.
+
+Covers the load → replay-gate → canary → promote / auto-rollback
+state machine against a real scheduler stack, journal reconstruction
+of every canary decision, the filter-verb hook on assume(), and the
+`/policy/*` + `/debug/policy` HTTP surface.  No jax — smoke tier.
+"""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.core.rater import Binpack
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+from elastic_gpu_scheduler_tpu.journal.replay import replay, what_if
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.policy import (
+    POLICIES,
+    VERB_INPUTS,
+    compile_expr,
+)
+from elastic_gpu_scheduler_tpu.policy.rater import PolicyRater
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+
+BINPACK_EXPR = "35*node_used + 30*chip_used + 25*preserve + 10*locality"
+SCALED_EXPR = (
+    "1 + 0.9*(35*node_used + 30*chip_used + 25*preserve + 10*locality)"
+)
+ANTI_EXPR = (
+    "100 - (35*node_used + 30*chip_used + 25*preserve + 10*locality)"
+)
+
+
+def tpu_pod(name, core=0):
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(
+                    limits={consts.RESOURCE_TPU_CORE: core}
+                ),
+            )
+        ],
+    )
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    POLICIES.reset()
+    JOURNAL.configure(str(tmp_path / "journal"), fsync="off")
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(
+            make_tpu_node(f"n{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, priority="binpack")
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    yield cluster, sched, str(tmp_path / "journal")
+    JOURNAL.close()
+    POLICIES.reset()
+
+
+def churn(cluster, sched, n, rng, start=0, forget_p=0.4, live=None):
+    nodes = [f"n{i}" for i in range(4)]
+    live = [] if live is None else live
+    bound = 0
+    for i in range(n):
+        if live and rng.random() < forget_p:
+            sched.forget_pod(live.pop(rng.randrange(len(live))))
+            continue
+        pod = tpu_pod(f"p{start + i}", core=rng.choice([50, 100, 200]))
+        cluster.create_pod(pod)
+        ok, _failed = sched.assume(nodes, pod)
+        if not ok:
+            continue
+        sched.bind(rng.choice(ok), pod)
+        live.append(pod)
+        bound += 1
+    return live, bound
+
+
+def test_gate_blocks_worse_and_passes_equivalent(stack):
+    cluster, sched, _dir = stack
+    churn(cluster, sched, 100, random.Random(1))
+    blocked = POLICIES.load("anti", "score", ANTI_EXPR)
+    assert blocked["state"] == "blocked"
+    assert blocked["gate"]["reasons"]
+    # a blocked candidate leaves the plane (and the engine) untouched
+    assert not POLICIES.wants("score")
+    assert sched.rater.name == "binpack"
+    passed = POLICIES.load(
+        "scaled", "score", SCALED_EXPR,
+        translation_invariant=True, whole_chip_compact_first=True,
+    )
+    assert passed["state"] == "canary"
+    assert passed["gate"]["pass"]
+
+
+def test_gate_fails_closed_on_empty_recording(stack):
+    _cluster, _sched, _dir = stack
+    res = POLICIES.load("x", "score", SCALED_EXPR)
+    assert res["state"] == "blocked"  # nothing recorded → cannot validate
+
+
+def test_canary_journals_both_arms_and_replay_reconstructs(stack):
+    cluster, sched, jdir = stack
+    rng = random.Random(2)
+    churn(cluster, sched, 80, rng)
+    res = POLICIES.load(
+        "scaled", "score", SCALED_EXPR, canary_pct=50.0,
+        translation_invariant=True, whole_chip_compact_first=True,
+    )
+    assert res["state"] == "canary"
+    churn(cluster, sched, 80, rng, start=1000, forget_p=0.5)
+    dec = POLICIES.decisions["score"]
+    assert dec["candidate"] > 0 and dec["incumbent"] > 0
+    assert dec["diverged"] > 0  # score scales differ on every decision
+    JOURNAL.flush()
+    JOURNAL.close()
+    events = read_journal(jdir)
+    rr = replay(events)
+    assert rr.violations == []
+    assert rr.policy_records > 0
+    # every canary decision is reconstructable: pod → (policy, arm)
+    assert len(rr.policy_decisions) == dec["candidate"] + dec["incumbent"]
+    arms = {d["arm"] for d in rr.policy_decisions.values()}
+    assert arms == {"candidate", "incumbent"}
+    assert all(
+        d["name"] == "scaled" for d in rr.policy_decisions.values()
+    )
+
+
+def test_promote_swaps_engine_rater_and_rollback_restores(stack):
+    cluster, sched, _dir = stack
+    live, _b = churn(cluster, sched, 60, random.Random(3))
+    POLICIES.load(
+        "scaled", "score", SCALED_EXPR, canary_pct=25.0,
+        translation_invariant=True, whole_chip_compact_first=True,
+    )
+    POLICIES.promote("score")
+    assert sched.rater.name == "scaled"
+    # binds still work under the promoted policy (continue the same
+    # churn so forgets can free phase-1 capacity)
+    _live, bound = churn(cluster, sched, 30, random.Random(4), start=2000,
+                         forget_p=0.5, live=live)
+    assert bound > 0
+    POLICIES.rollback("score")
+    assert sched.rater.name == "binpack"
+
+
+def test_canary_rollback_keeps_promoted_active_policy(stack):
+    cluster, sched, _dir = stack
+    churn(cluster, sched, 60, random.Random(5))
+    POLICIES.load(
+        "first", "score", SCALED_EXPR,
+        translation_invariant=True, whole_chip_compact_first=True,
+    )
+    POLICIES.promote("score")
+    assert sched.rater.name == "first"
+    # stage a second candidate, then roll IT back — the promoted policy
+    # must stay in force (regression guard: rollback used to restore
+    # the built-in incumbent over the active policy's head)
+    POLICIES.load("second", "score", BINPACK_EXPR, skip_gate=True)
+    POLICIES.rollback("score", reason="drop the candidate")
+    assert sched.rater.name == "first"
+    assert POLICIES.active["score"].name == "first"
+
+
+def test_injected_slo_regression_auto_rolls_back(stack):
+    cluster, sched, _dir = stack
+    churn(cluster, sched, 60, random.Random(6))
+    POLICIES.load("victim", "score", SCALED_EXPR, canary_pct=50.0,
+                  skip_gate=True)
+    slo = POLICIES.slo
+    for _ in range(40):
+        slo.note_latency("candidate", 0.050)
+        slo.note_latency("incumbent", 0.001)
+    out = POLICIES.check_slo()
+    assert out is not None and out["state"] == "builtin"
+    assert "regression" in out["reason"]
+    assert POLICIES.canary.get("score") is None
+    assert sched.rater.name == "binpack"
+    assert any(
+        h["event"] == "rollback" and h.get("auto")
+        for h in POLICIES.history
+    )
+
+
+def test_filter_only_canary_reject_regression_rolls_back(stack):
+    """A filter-verb canary with NO score canary must still auto-roll
+    back on reject-rate regression: its SLO watchdog strides on the
+    filter path itself (it has no bind decisions to ride)."""
+    cluster, sched, _dir = stack
+    nodes = [f"n{i}" for i in range(4)]
+    POLICIES.load("reject-all", "filter", "false", canary_pct=50.0,
+                  skip_gate=True)
+    rolled = False
+    for i in range(400):
+        pod = tpu_pod(f"fp{i}", core=50)
+        cluster.create_pod(pod)
+        sched.assume(nodes, pod)
+        if POLICIES.canary.get("filter") is None:
+            rolled = True
+            break
+    assert rolled, "reject-all filter canary never auto-rolled back"
+    assert any(
+        h["event"] == "rollback" and h.get("auto")
+        and h["verb"] == "filter"
+        for h in POLICIES.history
+    )
+
+
+def test_filter_policy_prunes_assume_feasible_set(stack):
+    cluster, sched, _dir = stack
+    nodes = [f"n{i}" for i in range(4)]
+    # occupy one chip of n0: the BUILT-IN filter still passes it (3 free
+    # chips + shareable capacity), only the policy can reject it
+    frac = tpu_pod("frac", core=50)
+    cluster.create_pod(frac)
+    sched.bind("n0", frac)
+    POLICIES.load(
+        "all-free-only", "filter", "free_chips >= total_chips",
+        canary_pct=100.0, skip_gate=True,
+    )
+    pod = tpu_pod("small", core=50)
+    cluster.create_pod(pod)
+    ok, failed = sched.assume(nodes, pod)
+    assert "n0" not in ok  # policy: only fully-free nodes
+    assert set(ok) == {"n1", "n2", "n3"}
+    assert "policy" in failed["n0"]
+    # faulting filter keeps every built-in-feasible node
+    POLICIES.reset()
+    POLICIES.load("broken", "filter", "1 / (frag - frag)",
+                  canary_pct=100.0, skip_gate=True)
+    pod2 = tpu_pod("small2", core=50)
+    cluster.create_pod(pod2)
+    ok2, _f2 = sched.assume(nodes, pod2)
+    assert set(ok2) == {"n0", "n1", "n2", "n3"}
+
+
+def test_filter_canary_incumbent_arm_enforces_active_policy(stack):
+    """Staging a filter candidate must not un-enforce a PROMOTED filter
+    policy on the incumbent arm — the incumbent of a canary is whatever
+    was in force before it."""
+    cluster, sched, _dir = stack
+    nodes = [f"n{i}" for i in range(4)]
+    frac = tpu_pod("frac", core=50)
+    cluster.create_pod(frac)
+    sched.bind("n0", frac)  # n0 no longer fully free
+    POLICIES.load("strict", "filter", "free_chips >= total_chips",
+                  canary_pct=100.0, skip_gate=True)
+    POLICIES.promote("filter")
+    # now stage a permissive candidate at 0% — every pod takes the
+    # incumbent arm, which must still be the PROMOTED strict policy
+    POLICIES.load("permissive", "filter", "true", canary_pct=0.0,
+                  skip_gate=True)
+    pod = tpu_pod("small", core=50)
+    cluster.create_pod(pod)
+    ok, failed = sched.assume(nodes, pod)
+    assert "n0" not in ok  # strict still enforced on the incumbent arm
+    assert set(ok) == {"n1", "n2", "n3"}
+
+
+def test_faulty_score_policy_never_fails_a_bind(stack):
+    cluster, sched, jdir = stack
+    churn(cluster, sched, 40, random.Random(7))
+    POLICIES.load(
+        "faulty", "score", "100 / (free_chips - free_chips)",
+        canary_pct=100.0, skip_gate=True,
+    )
+    _live, bound = churn(cluster, sched, 15, random.Random(8), start=3000,
+                         forget_p=0.0)
+    assert bound > 0  # every bind fell back to the incumbent
+    pol = POLICIES.canary["score"]
+    assert pol.rater.faults > 0
+    JOURNAL.flush()
+    JOURNAL.close()
+    rr = replay(read_journal(jdir))
+    assert rr.violations == []
+    assert rr.policy_faults > 0
+
+
+def test_what_if_policy_file_parity_via_resolver(stack, tmp_path):
+    """The journal CLI's --rater policy:FILE path: a policy file
+    spelling out binpack re-scores the recording identically to the
+    built-in."""
+    from elastic_gpu_scheduler_tpu.policy.registry import resolve_rater
+
+    cluster, sched, jdir = stack
+    churn(cluster, sched, 80, random.Random(9))
+    JOURNAL.flush()
+    JOURNAL.close()
+    events = read_journal(jdir)
+    f = tmp_path / "binpack.expr"
+    f.write_text(BINPACK_EXPR + "\n")
+    file_rater = resolve_rater(f"policy:{f}:binpack")
+    file_rater.translation_invariant = True
+    file_rater.whole_chip_compact_first = True
+    base = what_if(events, Binpack())
+    poli = what_if(events, file_rater)
+    assert base["mean_score"] == poli["mean_score"]
+    assert base["mean_free_chip_frac"] == poli["mean_free_chip_frac"]
+    assert base["placed"] == poli["placed"]
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture()
+def server(stack):
+    cluster, sched, jdir = stack
+    cluster_nodes = [f"n{i}" for i in range(4)]
+    churn(cluster, sched, 80, random.Random(10))
+
+    # minimal handler wiring (the policy routes don't touch the verbs)
+    class _Nope:
+        def handle(self, *_a, **_k):
+            raise AssertionError("not used")
+
+    srv = ExtenderServer(
+        _Nope(), _Nope(), _Nope(), lambda **_k: {},
+        host="127.0.0.1", port=0, policy=POLICIES,
+    )
+    port = srv.start()
+    yield port, sched, cluster_nodes
+    srv.stop()
+
+
+def test_policy_http_lifecycle(server):
+    port, sched, _nodes = server
+    # blocked candidate → 409, nothing staged
+    code, body = _post(port, "/policy/load", {
+        "name": "anti", "verb": "score", "expr": ANTI_EXPR,
+    })
+    assert code == 409 and body["state"] == "blocked"
+    # good candidate → 200, canary staged
+    code, body = _post(port, "/policy/load", {
+        "name": "scaled", "verb": "score", "expr": SCALED_EXPR,
+        "canary_pct": 25, "translation_invariant": True,
+        "whole_chip_compact_first": True,
+    })
+    assert code == 200 and body["state"] == "canary"
+    code, dbg = _get(port, "/debug/policy")
+    assert code == 200
+    assert "scaled" in dbg["canary"].get("score", {}).get("name", "")
+    assert dbg["gate_results"]["score"]["pass"] is True
+    assert "score" in dbg["inputs"]
+    # promote → active; engine rater swapped
+    code, body = _post(port, "/policy/promote", {"verb": "score"})
+    assert code == 200 and body["state"] == "active"
+    assert sched.rater.name == "scaled"
+    # rollback → builtin
+    code, body = _post(port, "/policy/rollback",
+                       {"verb": "score", "reason": "test"})
+    assert code == 200 and body["state"] == "builtin"
+    assert sched.rater.name == "binpack"
+
+
+def test_policy_http_validation_errors(server):
+    port, _sched, _nodes = server
+    code, body = _post(port, "/policy/load", {"name": "x", "verb": "score"})
+    assert code == 400  # missing expr
+    code, body = _post(port, "/policy/load", {
+        "name": "x", "verb": "score", "expr": "node_used +",
+    })
+    assert code == 400  # compile error → structured 400
+    code, body = _post(port, "/policy/load", {
+        "name": "x", "verb": "bogus", "expr": "1",
+    })
+    assert code == 400  # unknown verb
+    code, body = _post(port, "/policy/promote", {"verb": "score"})
+    assert code == 400  # nothing staged
+    code, body = _post(port, "/policy/nonesuch", {})
+    assert code == 404

@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""CI gate for the programmable policy plane (`make check-policy`).
+
+End-to-end promotion, all phases HARD-FAIL:
+
+1. **Record** — a randomized bind/forget soak (fractional + whole-chip
+   shapes, binpack incumbent) with the flight recorder on: the workload
+   the replay gate will judge candidates against.
+2. **Gate blocks worse** — an anti-binpack candidate (inverted formula)
+   must be BLOCKED by the replay gate (worse on the rater-neutral
+   metrics: placements completed / contiguity / whole-free-chip
+   preservation), with the verdict journaled.
+3. **Gate passes better** — a monotone transform of the incumbent's own
+   formula (same placement ordering, different score scale) must pass
+   and stage as a canary.
+4. **Canary divergence journaled** — live binds split by deterministic
+   pod hash; both arms must journal `policy` decide records, and the
+   cross-scored divergence must be non-zero (the score scales differ).
+5. **Promote** — the canary promotes; the engine rater IS the policy.
+6. **Fault fallback** — a candidate that faults at runtime (division by
+   zero) must still bind every pod (incumbent fallback) and journal
+   `policy_fault` annotations.
+7. **Injected SLO regression auto-rolls back** — synthetic candidate
+   bind-latency regression fed to the SLO monitor trips the automatic
+   rollback, journaled with the reason.
+8. **Replay reconstruction** — journal replay is clean (zero
+   violations), counts every policy record, and rebuilds WHICH policy
+   (and which arm) decided every canary bind; what-if under a policy
+   expressing the built-in binpack is BIT-IDENTICAL to the built-in.
+9. **Overhead budget** — bind p99 with a policy-backed rater stays
+   within POLICY_OVERHEAD_BUDGET_PCT (default 5) of the built-in via
+   bench.policy_bench's interleaved storm-trimmed estimator, x3
+   attempts like check-journal.
+
+Usage:
+    python tools/check_policy.py [--ops N] [--skip-overhead]
+
+Environment:
+    CHECK_POLICY_SEED           soak RNG seed (default 20260804)
+    POLICY_OVERHEAD_BUDGET_PCT  bind p99 budget (default 5)
+
+Wired into the Makefile as `make check-policy`, next to
+`check-cluster-scale`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elastic_gpu_scheduler_tpu.cli import build_stack  # noqa: E402
+from elastic_gpu_scheduler_tpu.core.rater import Binpack  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import replay, what_if  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.objects import (  # noqa: E402
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.policy import (  # noqa: E402
+    POLICIES,
+    VERB_INPUTS,
+    compile_expr,
+)
+from elastic_gpu_scheduler_tpu.policy.rater import PolicyRater  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+
+BINPACK_EXPR = "35*node_used + 30*chip_used + 25*preserve + 10*locality"
+ANTI_EXPR = "100 - (35*node_used + 30*chip_used + 25*preserve + 10*locality)"
+# monotone transform of the incumbent: same placement ordering (gate
+# ties on every neutral metric) but a different score scale, so every
+# canary decision has measurable divergence
+SCALED_EXPR = "1 + 0.9*(35*node_used + 30*chip_used + 25*preserve + 10*locality)"
+FAULTY_EXPR = "100 / (free_chips - free_chips)"  # div-by-zero every eval
+
+
+def _pod(name, core=0, chips=0):
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if chips:
+        res[consts.RESOURCE_TPU_CORE] = chips * 100
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(limits=res),
+            )
+        ],
+    )
+
+
+class Driver:
+    def __init__(self, seed: int, journal_dir: str):
+        JOURNAL.configure(journal_dir, fsync="off",
+                          max_segment_bytes=64 << 20)
+        self.cluster = FakeCluster()
+        for i in range(4):
+            self.cluster.add_node(
+                make_tpu_node(f"n{i}", chips=4, hbm_gib=64,
+                              accelerator="v5e")
+            )
+        self.nodes = [f"n{i}" for i in range(4)]
+        clientset = FakeClientset(self.cluster)
+        (self.registry, self.predicate, _prio, self.bind, _ctl,
+         self.status, self.gang) = build_stack(
+            clientset, cluster=None, priority="binpack",
+        )
+        self.sched = self.registry[consts.RESOURCE_TPU_CORE]
+        self.rng = random.Random(seed)
+        self.serial = 0
+        self.live: list = []
+
+    def churn(self, ops: int, forget_p: float = 0.4) -> int:
+        """Randomized bind/forget ops; returns binds committed."""
+        binds = 0
+        for _ in range(ops):
+            if self.live and self.rng.random() < forget_p:
+                pod = self.live.pop(self.rng.randrange(len(self.live)))
+                self.sched.forget_pod(pod, source="soak_delete")
+                continue
+            self.serial += 1
+            shape = self.rng.random()
+            if shape < 0.3:
+                pod = _pod(f"soak-{self.serial}", chips=2)  # whole 2-chip
+            else:
+                pod = _pod(f"soak-{self.serial}",
+                           core=self.rng.choice([50, 100, 200]))
+            self.cluster.create_pod(pod)
+            ok, _failed = self.sched.assume(list(self.nodes), pod)
+            if not ok:
+                continue
+            self.sched.bind(self.rng.choice(ok), pod)
+            self.live.append(pod)
+            binds += 1
+        return binds
+
+    def drain(self):
+        for pod in self.live:
+            self.sched.forget_pod(pod, source="soak_drain")
+        self.live = []
+
+
+def main() -> int:
+    ops = 140
+    skip_overhead = False
+    for a in sys.argv[1:]:
+        if a.startswith("--ops="):
+            ops = int(a.split("=", 1)[1])
+        elif a == "--skip-overhead":
+            skip_overhead = True
+        else:
+            print(f"unknown argument {a!r}", file=sys.stderr)
+            return 2
+
+    seed = int(os.environ.get("CHECK_POLICY_SEED", "20260804"))
+    tmp = tempfile.mkdtemp(prefix="tpu-policy-check-")
+    journal_dir = os.path.join(tmp, "journal")
+    failures: list[str] = []
+    result: dict = {"metric": "check_policy", "seed": seed, "ops": ops}
+    POLICIES.reset()
+    try:
+        drv = Driver(seed, journal_dir)
+        sched = drv.sched
+
+        # phase 1: record workload
+        binds = drv.churn(ops)
+        result["recorded_binds"] = binds
+        if binds < 20:
+            failures.append(f"soak recorded only {binds} binds")
+
+        # phase 2: the replay gate must BLOCK a worse candidate
+        blocked = POLICIES.load(
+            "anti-binpack", "score", ANTI_EXPR, canary_pct=50.0,
+        )
+        result["gate_block"] = blocked.get("gate")
+        if blocked.get("state") != "blocked":
+            failures.append(
+                f"replay gate passed the anti-binpack candidate: {blocked}"
+            )
+
+        # phase 3: a better/equal candidate passes and canaries
+        passed = POLICIES.load(
+            "binpack-scaled", "score", SCALED_EXPR, canary_pct=50.0,
+            translation_invariant=True, whole_chip_compact_first=True,
+        )
+        result["gate_pass"] = passed.get("gate")
+        if passed.get("state") != "canary":
+            failures.append(
+                f"replay gate blocked the equivalent candidate: {passed}"
+            )
+
+        # phase 4: canary — both arms journaled, divergence non-zero
+        drv.churn(80, forget_p=0.5)
+        dec = dict(POLICIES.decisions.get("score") or {})
+        result["canary_decisions"] = dec
+        if not dec.get("candidate"):
+            failures.append("no canary bind decided by the candidate arm")
+        if not dec.get("incumbent"):
+            failures.append("no canary bind decided by the incumbent arm")
+        if not dec.get("diverged"):
+            failures.append(
+                "zero canary divergence recorded — the scaled candidate "
+                "must cross-score differently from the incumbent"
+            )
+        result["canary_divergence_pct"] = POLICIES.divergence_pct("score")
+
+        # phase 5: promote — the engine rater IS the policy
+        POLICIES.promote("score")
+        if sched.rater.name != "binpack-scaled":
+            failures.append(
+                f"promotion did not swap the engine rater "
+                f"(got {sched.rater.name!r})"
+            )
+        drv.churn(20, forget_p=0.5)
+        POLICIES.rollback("score", reason="check-policy phase done")
+        if sched.rater.name != "binpack":
+            failures.append(
+                f"rollback did not restore the incumbent "
+                f"(got {sched.rater.name!r})"
+            )
+
+        # phase 6: runtime faults fall back to the incumbent, never a
+        # failed bind
+        POLICIES.load(
+            "faulty", "score", FAULTY_EXPR, canary_pct=100.0,
+            skip_gate=True,
+        )
+        before = len(drv.live)
+        drv.churn(12, forget_p=0.0)
+        pol = POLICIES.canary.get("score")
+        faults = pol.rater.faults if pol and pol.rater else 0
+        result["fault_evals"] = faults
+        if len(drv.live) <= before:
+            failures.append("faulty policy blocked binds (fallback broken)")
+        if faults < 1:
+            failures.append("faulty policy recorded zero faults")
+        POLICIES.rollback("score", reason="fault phase done")
+
+        # phase 7: injected SLO regression auto-rolls back
+        POLICIES.load(
+            "slo-victim", "score", SCALED_EXPR, canary_pct=50.0,
+            skip_gate=True,
+        )
+        slo = POLICIES.slo
+        for _ in range(40):
+            slo.note_latency("candidate", 0.050)
+            slo.note_latency("incumbent", 0.001)
+        rb = POLICIES.check_slo()
+        result["slo_rollback"] = rb
+        if rb is None or rb.get("state") != "builtin":
+            failures.append(
+                "injected bind-p99 regression did not auto-roll back"
+            )
+        if POLICIES.canary.get("score") is not None:
+            failures.append("canary still staged after SLO rollback")
+        if sched.rater.name != "binpack":
+            failures.append(
+                "engine rater not restored after SLO rollback"
+            )
+        hist = [h for h in POLICIES.history
+                if h["event"] == "rollback" and h.get("auto")]
+        if not hist:
+            failures.append("auto rollback missing from plane history")
+
+        # phase 8: replay reconstruction + what-if parity
+        drv.drain()
+        JOURNAL.flush()
+        JOURNAL.close()
+        events = read_journal(journal_dir)
+        result["records"] = len(events)
+        res = replay(events)
+        if res.violations:
+            failures.append(f"replay violations: {res.violations[:5]}")
+        result["policy_records"] = res.policy_records
+        result["policy_faults"] = res.policy_faults
+        result["policy_decisions"] = len(res.policy_decisions)
+        if res.policy_records < 6:
+            failures.append(
+                f"too few policy records replayed ({res.policy_records})"
+            )
+        if res.policy_faults < 1:
+            failures.append("no policy_fault annotation reached the journal")
+        want_decides = dec.get("candidate", 0) + dec.get("incumbent", 0)
+        if len(res.policy_decisions) < want_decides:
+            failures.append(
+                f"replay reconstructed {len(res.policy_decisions)} canary "
+                f"decisions, journal should hold >= {want_decides}"
+            )
+        arms = {d["arm"] for d in res.policy_decisions.values()}
+        if not {"candidate", "incumbent"} <= arms:
+            failures.append(f"replay decisions missing an arm: {arms}")
+
+        pr = PolicyRater(
+            compile_expr(BINPACK_EXPR, VERB_INPUTS["score"]),
+            fallback=Binpack(), name="parity",
+            translation_invariant=True, whole_chip_compact_first=True,
+        )
+        base = what_if(events, Binpack())
+        poli = what_if(events, pr)
+        result["what_if_base"] = base["mean_score"]
+        result["what_if_policy"] = poli["mean_score"]
+        for k in ("binds", "placed", "mean_score", "contiguous_frac",
+                  "final_frag_mean", "mean_free_chip_frac"):
+            if base[k] != poli[k]:
+                failures.append(
+                    f"what-if parity broke on {k}: policy {poli[k]} vs "
+                    f"built-in {base[k]} (must be bit-identical)"
+                )
+    finally:
+        JOURNAL.close()
+        POLICIES.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # phase 9: bind-p99 overhead budget (bench estimator, 3 attempts)
+    if not skip_overhead:
+        from bench import policy_bench
+
+        try:
+            budget = float(
+                os.environ.get("POLICY_OVERHEAD_BUDGET_PCT", "5")
+            )
+        except ValueError:
+            budget = 5.0
+        attempts = []
+        ok = False
+        overhead = {}
+        for _attempt in range(3):
+            overhead = policy_bench()
+            attempts.append(overhead["policy_overhead_pct"])
+            ok = (
+                overhead["policy_overhead_pct"] <= budget
+                or overhead["policy_overhead_trimmed_pct"] <= budget
+            )
+            if ok:
+                break
+        result.update(overhead)
+        result["overhead_budget_pct"] = budget
+        result["overhead_attempts_pct"] = attempts
+        if not ok:
+            failures.append(
+                f"policy-backed bind p99 over budget on every attempt "
+                f"({attempts}% vs {budget}%; trimmed "
+                f"{overhead.get('policy_overhead_trimmed_pct')}%)"
+            )
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
