@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -96,6 +96,15 @@ check-cluster-scale:
 # within POLICY_OVERHEAD_BUDGET_PCT (default 5%).
 check-policy:
 	python tools/check_policy.py
+
+# Warm-start compilation-plane gate: a cold process fills the shape
+# lattice into a persistent AOT cache; a SECOND process on the same dir
+# must perform zero new lowerings (fill/miss counters stay 0, measured
+# warm-up wall ≪ cold, token-identical output); a corrupted entry is
+# quarantined and recompiled, never fatal; concurrent misses on one key
+# compile once (single-flight).
+check-compile-cache:
+	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

@@ -1053,6 +1053,9 @@ def tpu_section_table():
         "serveoverlap": int(
             os.environ.get("BENCH_SECTION_TIMEOUT_SERVEOVERLAP", "900")
         ),
+        "compile": int(
+            os.environ.get("BENCH_SECTION_TIMEOUT_COMPILE", "900")
+        ),
         "model1b": int(os.environ.get("BENCH_SECTION_TIMEOUT_1B", "1800")),
         "flash32k": int(os.environ.get("BENCH_SECTION_TIMEOUT_32K", "600")),
         "pagedattn": int(os.environ.get("BENCH_SECTION_TIMEOUT_PAGED", "600")),
@@ -2210,10 +2213,136 @@ def fleet_bench_cpu(timeout: int = 900) -> dict:
         return {"fleet_bench_error": f"unparseable output: {e}"}
 
 
+def _tpu_section_compile():
+    """Warm-start compilation plane (compilecache/): cold-vs-warm
+    admission latency, shape-lattice warm-up wall for a fresh fill vs a
+    persistent-cache reload, and the serving-path cache hit rate.  Runs
+    on CPU (BENCH_ALLOW_CPU=1) into every artifact like serveoverlap;
+    tools/check_compile_cache.py gates the contract across real process
+    boundaries — these keys track the magnitude over time."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.compilecache import (
+        CompileCache,
+        WarmupState,
+        warmup_engine,
+    )
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import init_params
+
+    cfg = _bench_cfg(allow_cpu)
+    params = init_params(jax.random.key(0), cfg)
+    max_len = 256 if allow_cpu else 2048
+    eng_kw = dict(
+        max_batch=4 if allow_cpu else 8, max_len=max_len,
+        page_size=16, fused_steps=4 if allow_cpu else 16,
+    )
+
+    def admit_first_token_ms(eng) -> float:
+        first = [None]
+        req = Request(prompt=[3, 1, 4, 1, 5, 9, 2, 6], max_new_tokens=8)
+        t0 = _time.perf_counter()
+        req.on_token = lambda tok: first.__setitem__(
+            0, first[0] or (_time.perf_counter() - t0)
+        )
+        eng.submit(req)
+        eng.run_until_idle(max_steps=100_000)
+        assert not req.error, req.error
+        return first[0] * 1e3
+
+    workdir = _tempfile.mkdtemp(prefix="bench-compile-")
+    try:
+        # cold admission: no warm-up, every compile lands on the
+        # admission path (the p99.9 cliff this plane removes)
+        cold_admit = admit_first_token_ms(
+            InferenceEngine(
+                params, cfg, compile_cache=CompileCache(None), **eng_kw
+            )
+        )
+        # cold warm-up: fill the persistent lattice
+        cache1 = CompileCache(workdir)
+        eng1 = InferenceEngine(params, cfg, compile_cache=cache1, **eng_kw)
+        st1 = WarmupState()
+        t0 = _time.perf_counter()
+        warmup_engine(eng1, st1, journal=False)
+        cold_warm_wall = _time.perf_counter() - t0
+        # warm restart: a fresh cache instance on the same dir loads
+        # every entry (the AOT memo is per-instance, so nothing carries
+        # over in-process except XLA's own unused jit cache)
+        cache2 = CompileCache(workdir)
+        eng2 = InferenceEngine(params, cfg, compile_cache=cache2, **eng_kw)
+        st2 = WarmupState()
+        t0 = _time.perf_counter()
+        warmup_engine(eng2, st2, journal=False)
+        warm_warm_wall = _time.perf_counter() - t0
+        warm_admit = admit_first_token_ms(eng2)
+        hit_total = cache2.hits + cache2.loads + cache2.misses
+        out = {
+            "compile_lattice_size": st2.lattice_size,
+            "compile_cold_admit_ms": round(cold_admit, 2),
+            "compile_warm_admit_ms": round(warm_admit, 2),
+            "compile_admit_speedup": round(
+                cold_admit / max(warm_admit, 1e-9), 2
+            ),
+            "compile_warmup_cold_s": round(cold_warm_wall, 3),
+            "compile_warmup_warm_s": round(warm_warm_wall, 3),
+            "compile_warmup_speedup": round(
+                cold_warm_wall / max(warm_warm_wall, 1e-9), 1
+            ),
+            "compile_warm_fills": st2.fills,  # 0 = zero new lowerings
+            "compile_cache_hit_pct": round(
+                100.0 * (cache2.hits + cache2.loads) / max(1, hit_total), 2
+            ),
+        }
+        if st2.fills != 0:
+            out["compile_warm_fills_nonzero"] = True
+        return out if allow_cpu else {
+            f"tpu_{k}": v for k, v in out.items()
+        }
+    finally:
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
+def compile_bench_cpu(timeout: int = 900) -> dict:
+    """Run the compile section in a CPU subprocess (serveoverlap
+    pattern) so the BENCH artifact always carries the warm-start keys,
+    TPU relay up or down."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["BENCH_ALLOW_CPU"] = "1"
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, "--tpu-section=compile"],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"compile_bench_error": f"timed out after {timeout}s"}
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        return {"compile_bench_error": str(e)[:300]}
+    if p.returncode != 0:
+        return {
+            "compile_bench_error": p.stderr.decode(errors="replace")[-300:]
+        }
+    try:
+        return json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"compile_bench_error": f"unparseable output: {e}"}
+
+
 _TPU_SECTIONS = {
     "model": _tpu_section_model,
     "serve": _tpu_section_serve,
     "serveoverlap": _tpu_section_serveoverlap,
+    "compile": _tpu_section_compile,
     "fleet": _tpu_section_fleet,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
@@ -2451,6 +2580,22 @@ def main():
         results.update(fleet_bench_cpu())
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["fleet_bench_error"] = str(e)[:300]
+
+    # warm-start compilation plane: cold-vs-warm admission latency,
+    # lattice warm-up wall fresh-fill vs persistent reload, cache hit
+    # pct (tools/check_compile_cache.py gates the zero-new-lowerings
+    # contract across process boundaries; these keys track magnitude).
+    # Guarded like the journal bench.
+    try:
+        results.update(compile_bench_cpu())
+        if results.get("compile_warm_fills", 0) != 0:
+            print(
+                f"# WARNING: warm compile-cache restart performed "
+                f"{results['compile_warm_fills']} new lowerings "
+                "(expected 0)", file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["compile_bench_error"] = str(e)[:300]
 
     # cluster-scale placement: 10k synthetic nodes through the capacity
     # index + batch admission sweep (BENCH_CLUSTER=0 skips; node count via

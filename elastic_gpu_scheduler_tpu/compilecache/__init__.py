@@ -1,0 +1,39 @@
+"""Warm-start compilation plane (ROADMAP item 1).
+
+Three pieces, composing into "a replica never eats an XLA compile on
+the serving path":
+
+- :mod:`.cache` — the persistent AOT compile cache: serialized XLA
+  executables keyed by (function fingerprint, input shapes, backend),
+  CRC-checked entries under ``--compile-cache-dir``, single-flight
+  in-process compilation, hit/load/miss/fill counters
+  (``tpu_compile_cache_events_total``).
+- :mod:`.aot` — :class:`AotFunction`, the dispatch wrapper that routes
+  a jitted function's calls through the cache (jit stays the fallback).
+- :mod:`.lattice` — shape-lattice pre-lowering: enumerate the engine's
+  (batch, length)-bucket lattice at pod start and lower every fused
+  kernel BEFORE the pod reports Ready (``tpu_warmup_seconds``); the
+  fleet router's ``warming`` replica state and the autoscaler's
+  scale-up suppression gate traffic on the result.
+
+See OPERATIONS.md "Compilation warm-start" for the runbook and
+``make check-compile-cache`` for the CI gate.
+"""
+
+from .aot import AotFunction, wrap
+from .cache import CompileCache, cache_key
+from .lattice import (
+    WarmupState,
+    start_warmup_thread,
+    warmup_engine,
+)
+
+__all__ = [
+    "AotFunction",
+    "CompileCache",
+    "WarmupState",
+    "cache_key",
+    "start_warmup_thread",
+    "warmup_engine",
+    "wrap",
+]

@@ -1,0 +1,303 @@
+"""Persistent ahead-of-time compile cache: serialized XLA executables on
+disk, keyed by (function fingerprint, input shapes, backend).
+
+At millions-of-users traffic every shape-lattice miss at serving
+admission eats a full XLA compile — a p99.9 cliff the scheduler, router
+and autoscaler are all blind to (ROADMAP item 1).  This cache makes the
+compile a one-time cost per (code, shape, backend) triple:
+
+- **Entry format.**  ``<dir>/<key>.aotx``: an 8-byte magic, a
+  length-prefixed JSON header carrying the key, a CRC32 of the payload
+  and human-auditable metadata, then the payload — the pickled
+  ``jax.experimental.serialize_executable.serialize`` triple
+  (executable bytes, in_tree, out_tree).  Writes are atomic
+  (tmp + rename); a torn or bit-flipped entry fails the CRC and is
+  QUARANTINED (renamed ``.bad``) and recompiled, never fatal.
+- **Single-flight.**  Concurrent misses on one key compile ONCE: the
+  first caller owns the build, the rest park on an event and adopt the
+  winner's executable (``coalesced`` counter).  A pod start that fans
+  admission across handler threads cannot compile the same kernel N×.
+- **Counters.**  hits / loads / misses / fills / coalesced /
+  quarantined / persist_errors / fallbacks — exported as
+  ``tpu_compile_cache_events_total`` and surfaced on ``/v1/stats`` so
+  the fleet tooling (check-compile-cache, bench's compile section) can
+  assert "second start on the same dir performs zero new lowerings".
+
+Trust model: the cache dir is operator-owned state, same trust domain
+as a model checkpoint dir — the CRC detects corruption, not tampering.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import struct
+import threading
+import zlib
+from hashlib import blake2b
+from typing import Callable, Optional
+
+from ..metrics import COMPILE_CACHE_EVENTS
+
+log = logging.getLogger("tpu-scheduler")
+
+_MAGIC = b"TPUAOTC1"
+_SUFFIX = ".aotx"
+
+
+def cache_key(*parts) -> str:
+    """Stable hex digest over the fingerprint parts (stringified in
+    order).  Callers include everything that changes the lowered
+    program: function tag + variant, model/engine config, input shapes
+    and dtypes, mesh shape, backend, jax version."""
+    h = blake2b(digest_size=16)
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+class CompileCache:
+    """In-memory + optional on-disk executable cache with single-flight
+    compilation.  ``cache_dir=None`` keeps the single-flight memo and
+    counters but persists nothing (warm-up still works; warmth just
+    does not survive the process)."""
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir or None
+        if self.cache_dir:
+            try:
+                os.makedirs(self.cache_dir, exist_ok=True)
+            except OSError as e:
+                # the stance everywhere in this module: the cache can
+                # only ADD warmth, never take down serving — an
+                # unwritable dir (root-owned hostPath, read-only fs)
+                # degrades to in-memory-only, not a crash-looping pod
+                log.warning(
+                    "compile cache: cannot create %s (%s); running "
+                    "without persistence", self.cache_dir, e,
+                )
+                self.cache_dir = None
+        self._mem: dict[str, object] = {}  # key → loaded executable
+        self._lock = threading.Lock()  # memo + inflight bookkeeping
+        self._inflight: dict[str, threading.Event] = {}
+        self.hits = 0
+        self.loads = 0
+        self.misses = 0
+        self.fills = 0
+        self.coalesced = 0
+        self.quarantined = 0
+        self.persist_errors = 0
+        self.fallbacks = 0  # incremented by AotFunction
+
+    # -- events --------------------------------------------------------------
+
+    _EVENT_ATTR = {
+        "hit": "hits",
+        "load": "loads",
+        "miss": "misses",
+        "fill": "fills",
+        "coalesced": "coalesced",
+        "quarantined": "quarantined",
+        "persist_error": "persist_errors",
+        "fallback": "fallbacks",
+    }
+
+    def _event(self, name: str) -> None:
+        attr = self._EVENT_ATTR[name]
+        setattr(self, attr, getattr(self, attr) + 1)
+        COMPILE_CACHE_EVENTS.inc(name)
+
+    # -- disk format ---------------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key + _SUFFIX)
+
+    def _write_entry(self, key: str, payload: bytes, meta: dict) -> None:
+        header = json.dumps({
+            "key": key,
+            "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+            "len": len(payload),
+            "meta": meta,
+        }, sort_keys=True).encode()
+        path = self._path(key)
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<I", len(header)))
+            f.write(header)
+            f.write(payload)
+        os.replace(tmp, path)  # atomic: readers see whole entries only
+
+    def _read_entry(self, key: str) -> Optional[bytes]:
+        """Payload bytes for a valid entry, None for absent, and a
+        QUARANTINE (rename to .bad + None) for anything corrupt — a bad
+        entry must cost one recompile, never a crash loop."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            log.warning("compile cache: unreadable entry %s: %s", path, e)
+            return None
+        try:
+            if blob[: len(_MAGIC)] != _MAGIC:
+                raise ValueError("bad magic")
+            off = len(_MAGIC)
+            (hlen,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            header = json.loads(blob[off : off + hlen])
+            off += hlen
+            payload = blob[off:]
+            if header.get("key") != key:
+                raise ValueError("key mismatch")
+            if len(payload) != int(header.get("len", -1)):
+                raise ValueError("truncated payload")
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != int(header["crc"]):
+                raise ValueError("CRC mismatch")
+            return payload
+        except (ValueError, KeyError, struct.error,
+                json.JSONDecodeError) as e:
+            self._event("quarantined")
+            bad = path + ".bad"
+            try:
+                os.replace(path, bad)
+            except OSError:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            log.warning(
+                "compile cache: quarantined corrupt entry %s (%s)", path, e
+            )
+            return None
+
+    def _load(self, key: str):
+        """Deserialize a persistent entry into a callable executable, or
+        None.  Deserialization failures quarantine like CRC failures:
+        the bytes may be from an incompatible jaxlib."""
+        if not self.cache_dir:
+            return None
+        payload = self._read_entry(key)
+        if payload is None:
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            blob, in_tree, out_tree = pickle.loads(payload)
+            return serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree
+            )
+        except Exception as e:  # noqa: BLE001 — any failure = recompile
+            self._event("quarantined")
+            try:
+                os.replace(self._path(key), self._path(key) + ".bad")
+            except OSError:
+                pass
+            log.warning(
+                "compile cache: entry %s failed to deserialize (%s); "
+                "quarantined", key, e,
+            )
+            return None
+
+    def _persist(self, key: str, compiled, meta) -> None:
+        if not self.cache_dir:
+            return
+        try:
+            from jax.experimental import serialize_executable
+
+            triple = serialize_executable.serialize(compiled)
+            # meta may be a thunk: header metadata is only computed on
+            # this (rare) persist path, never per dispatch
+            self._write_entry(
+                key, pickle.dumps(triple),
+                meta() if callable(meta) else (meta or {}),
+            )
+            self._event("fill")
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            self._event("persist_error")
+            log.warning(
+                "compile cache: could not persist %s (%s); serving from "
+                "the in-process executable", key, e,
+            )
+
+    # -- the one entry point -------------------------------------------------
+
+    def get_or_compile(
+        self, key: str, build: Callable[[], object], meta=None
+    ):
+        """The executable for ``key``: in-memory hit, else persistent
+        load, else ``build()`` (lower+compile) + persist.  Concurrent
+        callers for one key coalesce behind a single builder.  ``meta``
+        (dict or zero-arg thunk) lands in the entry header — a thunk is
+        only evaluated when an entry is actually written."""
+        with self._lock:
+            exe = self._mem.get(key)
+            if exe is not None:
+                self._event("hit")
+                return exe
+            ev = self._inflight.get(key)
+            if ev is None:
+                self._inflight[key] = threading.Event()
+            # else: someone is building; fall through to wait
+        if ev is not None:
+            self._event("coalesced")
+            ev.wait()
+            with self._lock:
+                exe = self._mem.get(key)
+            if exe is not None:
+                return exe
+            # builder failed: take over the build ourselves
+            return self.get_or_compile(key, build, meta)
+        try:
+            exe = self._load(key)
+            if exe is not None:
+                self._event("load")
+            else:
+                self._event("miss")
+                exe = build()
+                self._persist(key, exe, meta or {})
+            with self._lock:
+                self._mem[key] = exe
+            return exe
+        finally:
+            with self._lock:
+                ev2 = self._inflight.pop(key, None)
+            if ev2 is not None:
+                ev2.set()
+
+    # -- introspection -------------------------------------------------------
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._mem)
+
+    def disk_entries(self) -> int:
+        if not self.cache_dir:
+            return 0
+        try:
+            return sum(
+                1 for n in os.listdir(self.cache_dir)
+                if n.endswith(_SUFFIX)
+            )
+        except OSError:
+            return 0
+
+    def stats(self) -> dict:
+        return {
+            "dir": self.cache_dir or "",
+            "entries": self.entries(),
+            "disk_entries": self.disk_entries(),
+            "hits": self.hits,
+            "loads": self.loads,
+            "misses": self.misses,
+            "fills": self.fills,
+            "coalesced": self.coalesced,
+            "quarantined": self.quarantined,
+            "persist_errors": self.persist_errors,
+            "fallbacks": self.fallbacks,
+        }

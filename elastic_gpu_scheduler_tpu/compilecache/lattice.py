@@ -1,0 +1,154 @@
+"""Shape-lattice pre-lowering: warm every serving-admission shape
+BEFORE the pod reports Ready.
+
+The serving engine buckets its dispatch shapes (prefill pad lengths and
+page-table widths round up to powers of two), so the set of programs
+admission can demand is a small, enumerable lattice —
+``InferenceEngine.aot_signatures`` — not an open set.  ``warmup_engine``
+walks that lattice through the AOT compile cache (lower + compile /
+load, never execute), publishing progress through a :class:`WarmupState`
+the HTTP plane surfaces:
+
+- ``/healthz`` answers ``503 {"warming": true}`` while the lattice
+  builds, so the fleet router holds the replica in the ``warming``
+  state and routes ZERO traffic into the compile storm;
+- ``/v1/stats`` carries the state + fill/load counters, which is what
+  lets check-compile-cache assert a second process start on the same
+  cache dir performs zero new lowerings;
+- the decision journal gets one ``warmup`` annotation record (lattice
+  size + fill time) so the flight recorder can reconstruct when a
+  replica actually became warm.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..metrics import WARMUP_SECONDS
+
+log = logging.getLogger("tpu-scheduler")
+
+WARMUP_STATES = ("none", "warming", "ready", "error")
+
+
+class WarmupState:
+    """Mutable warm-up progress, written by the warm-up thread and read
+    by HTTP handler threads (GIL-atomic attribute loads, the repo's
+    standard cross-thread stance for advisory state)."""
+
+    def __init__(self):
+        self.state = "none"
+        self.lattice_size = 0
+        self.built = 0
+        self.fills = 0
+        self.loads = 0
+        self.errors = 0
+        self.wall_s = 0.0
+        self.started_at = 0.0
+        self.detail = ""
+
+    @property
+    def warming(self) -> bool:
+        return self.state == "warming"
+
+    def to_dict(self) -> dict:
+        return {
+            "state": self.state,
+            "lattice_size": self.lattice_size,
+            "built": self.built,
+            "fills": self.fills,
+            "loads": self.loads,
+            "errors": self.errors,
+            "wall_s": round(self.wall_s, 3),
+            "detail": self.detail,
+        }
+
+
+def warmup_engine(
+    engine,
+    state: Optional[WarmupState] = None,
+    variants: str = "minimal",
+    journal: bool = True,
+) -> WarmupState:
+    """Pre-lower the engine's shape lattice through its compile cache.
+
+    Per-point failures are counted and skipped, never fatal — a shape
+    the warm-up could not build simply compiles on first use, exactly
+    as it would have without a warm-up; the pod still becomes Ready.
+    Returns the (possibly caller-provided) WarmupState, ``state.state``
+    ∈ ready | error (error only when the lattice itself could not be
+    enumerated)."""
+    st = state if state is not None else WarmupState()
+    cache = getattr(engine, "compile_cache", None)
+    if cache is None:
+        st.state = "ready"
+        st.detail = "no compile cache attached; nothing to pre-lower"
+        return st
+    t0 = time.perf_counter()
+    st.state = "warming"
+    st.started_at = time.time()
+    fills0, loads0 = cache.fills, cache.loads
+    try:
+        sigs = engine.aot_signatures(variants=variants)
+    except Exception as e:  # noqa: BLE001 — a broken lattice must not
+        # keep the pod unready forever; surface and serve cold
+        st.state = "error"
+        st.detail = f"lattice enumeration failed: {e}"[:300]
+        log.exception("warm-up: lattice enumeration failed")
+        return st
+    st.lattice_size = len(sigs)
+    for label, fn, args in sigs:
+        try:
+            fn.build(*args)
+            st.built += 1
+        except Exception as e:  # noqa: BLE001 — skip, compile on first use
+            st.errors += 1
+            log.warning("warm-up: %s failed to pre-lower: %s", label, e)
+        st.fills = cache.fills - fills0
+        st.loads = cache.loads - loads0
+        st.wall_s = time.perf_counter() - t0
+    st.wall_s = time.perf_counter() - t0
+    st.state = "ready"
+    st.detail = (
+        f"{st.built}/{st.lattice_size} lattice shapes warm "
+        f"({st.fills} compiled+persisted, {st.loads} loaded) in "
+        f"{st.wall_s:.2f}s"
+    )
+    WARMUP_SECONDS.set(value=st.wall_s)
+    log.info("warm-up: %s", st.detail)
+    if journal:
+        from ..journal import JOURNAL
+
+        if JOURNAL.enabled:
+            JOURNAL.record(
+                "warmup",
+                lattice_size=st.lattice_size,
+                built=st.built,
+                fills=st.fills,
+                loads=st.loads,
+                errors=st.errors,
+                wall_s=round(st.wall_s, 3),
+                cache_dir=cache.cache_dir or "",
+            )
+    return st
+
+
+def start_warmup_thread(
+    engine, state: WarmupState, variants: str = "minimal"
+) -> threading.Thread:
+    """Run ``warmup_engine`` on a daemon thread: the HTTP server is
+    already up and answering ``/healthz`` 503 {warming} while the
+    lattice builds, which is the whole readiness-gating contract."""
+    state.state = "warming"  # visible before the thread's first slice
+    t = threading.Thread(
+        target=warmup_engine,
+        args=(engine, state),
+        kwargs={"variants": variants},
+        name="compile-warmup",
+        daemon=True,
+    )
+    t.start()
+    return t

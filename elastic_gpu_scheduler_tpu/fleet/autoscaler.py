@@ -135,13 +135,18 @@ class PolicyEngine:
     def evaluate(
         self, signals: dict, n_replicas: int, now: float,
         total_replicas: Optional[int] = None,
+        warming_replicas: int = 0,
     ):
         """(action, reason) with action ∈ up | down | hold.
         ``n_replicas`` counts ROUTABLE ('up') replicas; ``total_replicas``
-        counts every registered one (incl. draining/down) — the floor
-        restore below caps on the TOTAL, or a fleet whose replicas are
-        all draining (relay outage) would admit a new pod every tick
-        until the cluster is full."""
+        counts every registered one (incl. warming/draining/down) — the
+        floor restore below caps on the TOTAL, or a fleet whose replicas
+        are all draining (relay outage) would admit a new pod every tick
+        until the cluster is full.  ``warming_replicas`` counts replicas
+        mid-compile-warm-up: capacity already admitted but not yet
+        routable — a scale-up while one is warming would double-buy the
+        same breach, so ups are suppressed until the warm-up lands (the
+        readiness-gating half of the warm-start compilation plane)."""
         p = self.policy
         self.suppressed = None
         total = n_replicas if total_replicas is None else total_replicas
@@ -155,6 +160,12 @@ class PolicyEngine:
                 return "hold", (
                     f"below min_replicas but {total} total replicas at "
                     f"max_replicas ({p.max_replicas})"
+                )
+            if warming_replicas > 0:
+                self.suppressed = "warming"
+                return "hold", (
+                    f"below min_replicas but {warming_replicas} "
+                    "replica(s) warming (capacity in flight)"
                 )
             if now - self.last_up < p.up_cooldown_s:
                 self.suppressed = "cooldown"
@@ -181,6 +192,16 @@ class PolicyEngine:
                 # fleet grow past the bound whenever one is draining
                 self.suppressed = "bounds"
                 return "hold", f"at max_replicas ({p.max_replicas})"
+            if warming_replicas > 0:
+                # a previous scale-up is still pre-lowering its compile
+                # lattice: the breach that bought it is the breach still
+                # showing — buying another replica for the same breach
+                # is the compile-storm version of flapping
+                self.suppressed = "warming"
+                return "hold", (
+                    f"{warming_replicas} replica(s) warming "
+                    "(scale-up already in flight)"
+                )
             if now - self.last_up < p.up_cooldown_s:
                 self.suppressed = "cooldown"
                 return "hold", "up cooldown"
@@ -272,13 +293,16 @@ class Autoscaler:
         all_reps = self.replicas.all()
         n = len([r for r in all_reps if r.state == "up"])
         total = len(all_reps)
+        warming = len([r for r in all_reps if r.state == "warming"])
         action, reason = self.engine.evaluate(
-            sig, n, now, total_replicas=total
+            sig, n, now, total_replicas=total, warming_replicas=warming
         )
         if self.engine.suppressed == "bounds":
             FLEET_EVENTS.inc("bounds_suppressed")
         elif self.engine.suppressed == "cooldown":
             FLEET_EVENTS.inc("cooldown_suppressed")
+        elif self.engine.suppressed == "warming":
+            FLEET_EVENTS.inc("warming_suppressed")
         gen_pref = (
             self.profiler.generation_preference(self.wclass)
             if self.profiler.enabled
@@ -290,6 +314,7 @@ class Autoscaler:
             "signals": sig,
             "replicas": n,
             "replicas_total": total,
+            "warming": warming,
             "policy": self.policy.name,
             "wclass": self.wclass,
             "generation_pref": gen_pref or None,
@@ -426,6 +451,7 @@ def score_policy(events: list[dict], policy: ScalingPolicy) -> dict:
         action, reason = engine.evaluate(
             rec.get("signals") or {}, n_up, t - t0,
             total_replicas=int(rec.get("replicas_total", n_up)),
+            warming_replicas=int(rec.get("warming", 0)),
         )
         rec_action = rec.get("action", "hold")
         would[action] = would.get(action, 0) + 1

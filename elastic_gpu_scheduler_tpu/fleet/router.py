@@ -23,9 +23,13 @@ router is the fan-out point.  Routing policy, in precedence order:
    circuit breaker.
 
 Replica health: a background loop polls ``/healthz`` + ``/v1/stats``.
-States: ``up`` (routable), ``draining`` (healthz 503 / relay down —
-finishes in-flight streams, gets no new sessions), ``down`` (breaker
-open or consecutive probe failures).  Replicas marked ``relay=True``
+States: ``up`` (routable), ``warming`` (healthz 503 with
+``{"warming": true}`` — the replica is pre-lowering its compile
+lattice at boot and must receive ZERO traffic until the cache is warm;
+distinct from draining because it is capacity ARRIVING, which the
+autoscaler reads as a scale-up already in flight), ``draining``
+(healthz 503 / relay down — finishes in-flight streams, gets no new
+sessions), ``down`` (breaker open or consecutive probe failures).  Replicas marked ``relay=True``
 serve through the TPU probe relay: when ``utils.tpuprobe``'s
 RelayMonitor last saw the relay down they are marked draining
 IMMEDIATELY, without burning a per-replica HTTP timeout first — the
@@ -63,7 +67,7 @@ from ..utils.tpuprobe import RELAY_MONITOR
 
 log = logging.getLogger("tpu-scheduler")
 
-REPLICA_STATES = ("up", "draining", "down")
+REPLICA_STATES = ("up", "warming", "draining", "down")
 
 
 class _RelayAborted(Exception):
@@ -296,7 +300,7 @@ class ReplicaSet:
             )
             return
         try:
-            status, _ = self._http_get(r, "/healthz")
+            status, body = self._http_get(r, "/healthz")
         except (OSError, ConnectionError) as e:
             r.note_failure(self.breaker_threshold, self.breaker_cooldown_s)
             if r.consecutive_failures < self.breaker_threshold:
@@ -304,6 +308,30 @@ class ReplicaSet:
                 r.state_reason = f"healthz failed: {e}"
             return
         if status == 503:
+            # a 503 is NOT one state: a replica mid-warm-up (compile
+            # lattice pre-lowering, compilecache/) answers 503
+            # {"warming": true} and is about to become capacity — the
+            # autoscaler must not scale again for it, and the router
+            # must not route into its compile storm.  Anything else is
+            # the classic drain.  Body parse failure = drain (the
+            # conservative historical reading).
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                payload = {}
+            if isinstance(payload, dict) and payload.get("warming"):
+                r.state = "warming"
+                wu = payload.get("warmup") or {}
+                r.state_reason = (
+                    "warming: lattice "
+                    f"{wu.get('built', 0)}/{wu.get('lattice_size', 0)} "
+                    "pre-lowered"
+                )
+                r.note_success()
+                # stats stay advisory but useful mid-warm-up (warm-up
+                # progress, page/queue config for the debug surfaces)
+                self._poll_stats(r)
+                return
             r.state = "draining"
             r.state_reason = "healthz 503 (replica draining)"
             r.note_success()
@@ -323,6 +351,9 @@ class ReplicaSet:
                 return
             r.state = "up"
             r.state_reason = "healthy"
+        self._poll_stats(r)
+
+    def _poll_stats(self, r: Replica) -> None:
         try:
             sstat, body = self._http_get(r, "/v1/stats")
             if sstat == 200:

@@ -118,6 +118,12 @@ class ReplayResult:
     # gang resize transactions verified (each checked against the chip-
     # conservation and membership all-or-nothing invariants)
     resizes: int = 0
+    # compile warm-up annotations (compilecache/): lattice size + fill
+    # time per pod boot — counted, dense-seq audited, zero allocator
+    # mutation; the latest kept so offline consumers can see when a
+    # replica last became warm (and whether it filled or loaded)
+    warmup_records: int = 0
+    last_warmup: Optional[dict] = None
     # policy-plane annotations (policy/ subsystem): lifecycle events
     # (load/gate/canary/promote/rollback) + canary bind decisions, and
     # runtime faults.  ``policy_decisions`` rebuilds WHICH policy (and
@@ -151,6 +157,7 @@ class ReplayResult:
             "profile_records": self.profiles,
             "fleet_records": self.fleet_records,
             "resizes": self.resizes,
+            "warmup_records": self.warmup_records,
             "policy_records": self.policy_records,
             "policy_faults": self.policy_faults,
             "policy_decisions": len(self.policy_decisions),
@@ -486,6 +493,22 @@ def replay(events: list[dict]) -> ReplayResult:
             # a policy runtime fault (budget/deadline/math): the verb
             # fell back to the incumbent built-in — annotation only
             res.policy_faults += 1
+        elif t == "warmup":
+            # compile warm-up completion (compilecache/): an annotation
+            # in the mutation stream — lattice size, fill/load split and
+            # wall time for one pod's pre-lowering phase.  Participates
+            # in the dense-seq audit, never touches allocator state.
+            res.warmup_records += 1
+            res.last_warmup = {
+                "seq": seq,
+                "t": rec.get("t"),
+                "lattice_size": rec.get("lattice_size"),
+                "built": rec.get("built"),
+                "fills": rec.get("fills"),
+                "loads": rec.get("loads"),
+                "wall_s": rec.get("wall_s"),
+                "cache_dir": rec.get("cache_dir"),
+            }
         elif t == "fleet":
             # autoscaler evaluation (fleet/ subsystem): an annotation
             # like `profile` — the signals + decision stream that
@@ -750,13 +773,14 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             if observe_profile is not None:
                 observe_profile(rec)
             continue
-        if t in ("fleet", "resize", "policy", "policy_fault"):
+        if t in ("fleet", "resize", "policy", "policy_fault", "warmup"):
             # annotations (autoscaler evaluations / resize summaries /
-            # policy-plane events): the member binds/forgets/migrates
-            # around a resize carry the state changes; scoring a scaling
-            # POLICY offline is fleet.autoscaler.score_policy's job, and
-            # the policy plane's own decision trail must not perturb a
-            # what-if re-run that may itself be gating a policy
+            # policy-plane events / compile warm-ups): the member
+            # binds/forgets/migrates around a resize carry the state
+            # changes; scoring a scaling POLICY offline is
+            # fleet.autoscaler.score_policy's job, and the policy
+            # plane's own decision trail must not perturb a what-if
+            # re-run that may itself be gating a policy
             continue
         if t in ("node_add", "node_resync"):
             try:

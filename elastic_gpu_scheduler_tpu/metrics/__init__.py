@@ -458,8 +458,8 @@ FLEET_ROUTE_OVERHEAD = REGISTRY.register(
 FLEET_REPLICAS = REGISTRY.register(
     Gauge(
         "tpu_fleet_replicas",
-        "Replica-set size by health state (up/draining/down), refreshed "
-        "by the router's health loop",
+        "Replica-set size by health state (up/warming/draining/down), "
+        "refreshed by the router's health loop",
         ("state",),
     )
 )
@@ -469,7 +469,8 @@ FLEET_EVENTS = REGISTRY.register(
         "Autoscaler lifecycle events: scale_up/scale_down (executed), "
         "scale_up_failed/scale_down_failed, hold (evaluation with no "
         "action), cooldown_suppressed, bounds_suppressed, "
-        "resize_executed/resize_failed",
+        "warming_suppressed (scale-up held while a replica pre-lowers "
+        "its compile lattice), resize_executed/resize_failed",
         ("event",),
     )
 )
@@ -480,6 +481,28 @@ FLEET_SCALE_LATENCY = REGISTRY.register(
         "admission/release through the scheduler surface → replica "
         "routable/drained)",
         buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+    )
+)
+COMPILE_CACHE_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_compile_cache_events_total",
+        "Warm-start compile cache events: hit (in-memory executable "
+        "reused), load (persistent entry deserialized — no lowering), "
+        "miss (lower+compile paid), fill (entry persisted to the cache "
+        "dir), coalesced (concurrent miss parked behind the "
+        "single-flight winner), quarantined (corrupt entry moved aside, "
+        "recompiled), persist_error (serialize/write failed — compile "
+        "still served), fallback (AOT path error → jit dispatch)",
+        ("event",),
+    )
+)
+WARMUP_SECONDS = REGISTRY.register(
+    Gauge(
+        "tpu_warmup_seconds",
+        "Wall time of the shape-lattice pre-lowering phase at pod start "
+        "(0 until a warm-up has completed); the window the pod reports "
+        "healthz 503 {warming:true} and the fleet router keeps it out "
+        "of rotation",
     )
 )
 POLICY_EVALS = REGISTRY.register(

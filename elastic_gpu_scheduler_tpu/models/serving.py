@@ -1309,6 +1309,7 @@ class InferenceEngine:
         prefill_chunk: int = 0,
         max_queue: int = 0,
         overlap: bool = True,
+        compile_cache=None,
     ):
         """``spec_k`` > 0 enables speculative decoding inside the engine:
         steps where some greedy slot is generating run a fused VERIFY
@@ -1538,22 +1539,52 @@ class InferenceEngine:
         # two chunk variants: plain sampling, and per-slot top-k/top-p
         # filtering (compiled lazily, only if a request ever asks for it)
         self.logprobs_k = max(0, logprobs_k)
+        # warm-start compilation plane (compilecache/): when a cache is
+        # attached, every fused-kernel dispatch below routes through AOT
+        # executables keyed by (static fingerprint, input shapes) — a
+        # shape pre-lowered at warm-up (or persisted by a previous
+        # process) never compiles on the admission path.  ``None`` (the
+        # default) keeps the exact historical jit dispatch.
+        self.compile_cache = compile_cache
+        _devs = jax.devices()
+        self._aot_fp = (
+            repr(cfg), max_batch, max_len, page_size, self.fused_steps,
+            kv_int8, paged_kernel, self.logprobs_k,
+            tuple(sorted(self.adapter_index)),
+            tuple(sorted(mesh.shape.items())) if mesh is not None else None,
+            jax.__version__, jax.default_backend(), jax.device_count(),
+            # device KIND, not just backend: a fleet-shared cache dir
+            # (PVC) serves mixed v5e/v5p/v6e replicas — without the kind
+            # in the key two generations would perpetually quarantine
+            # each other's entries under the same digest
+            getattr(_devs[0], "device_kind", "") if _devs else "",
+        )
+
+        def _aot(jitfn, tag):
+            from ..compilecache.aot import wrap as _aot_wrap
+
+            return _aot_wrap(jitfn, compile_cache, self._aot_fp, tag)
+
         self._chunks = {
-            (use_filters, want_lp, use_pen, use_seed, use_min): jax.jit(
-                functools.partial(
-                    _fused_serve_chunk,
-                    cfg=cfg,
-                    page_size=page_size,
-                    n_steps=self.fused_steps,
-                    use_filters=use_filters,
-                    paged_kernel=self.paged_kernel,
-                    mesh=mesh,
-                    logprobs_k=self.logprobs_k if want_lp else 0,
-                    use_pen=use_pen,
-                    use_seed=use_seed,
-                    use_min=use_min,
+            (use_filters, want_lp, use_pen, use_seed, use_min): _aot(
+                jax.jit(
+                    functools.partial(
+                        _fused_serve_chunk,
+                        cfg=cfg,
+                        page_size=page_size,
+                        n_steps=self.fused_steps,
+                        use_filters=use_filters,
+                        paged_kernel=self.paged_kernel,
+                        mesh=mesh,
+                        logprobs_k=self.logprobs_k if want_lp else 0,
+                        use_pen=use_pen,
+                        use_seed=use_seed,
+                        use_min=use_min,
+                    ),
+                    donate_argnums=(1,),  # the kv pool pytree
                 ),
-                donate_argnums=(1,),  # the kv pool pytree
+                f"serve_chunk:{int(use_filters)}{int(want_lp)}{int(use_pen)}"
+                f"{int(use_seed)}{int(use_min)}",
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
@@ -1621,20 +1652,24 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         self._verify_chunks = {
-            (use_filters, want_lp, use_pen, use_seed, use_min): jax.jit(
-                functools.partial(
-                    _fused_verify_chunk,
-                    cfg=cfg,
-                    page_size=page_size,
-                    use_filters=use_filters,
-                    paged_kernel=self.paged_kernel,
-                    mesh=mesh,
-                    logprobs_k=self.logprobs_k if want_lp else 0,
-                    use_pen=use_pen,
-                    use_seed=use_seed,
-                    use_min=use_min,
+            (use_filters, want_lp, use_pen, use_seed, use_min): _aot(
+                jax.jit(
+                    functools.partial(
+                        _fused_verify_chunk,
+                        cfg=cfg,
+                        page_size=page_size,
+                        use_filters=use_filters,
+                        paged_kernel=self.paged_kernel,
+                        mesh=mesh,
+                        logprobs_k=self.logprobs_k if want_lp else 0,
+                        use_pen=use_pen,
+                        use_seed=use_seed,
+                        use_min=use_min,
+                    ),
+                    donate_argnums=(1,),  # the kv pool pytree
                 ),
-                donate_argnums=(1,),  # the kv pool pytree
+                f"verify_chunk:{self.spec_k}:{int(use_filters)}"
+                f"{int(want_lp)}{int(use_pen)}{int(use_seed)}{int(use_min)}",
             )
             for use_filters in (False, True)
             for want_lp in (False, True)
@@ -1642,18 +1677,24 @@ class InferenceEngine:
             for use_seed in (False, True)
             for use_min in (False, True)
         }
-        self._prefill = jax.jit(
-            functools.partial(
-                _paged_prefill, cfg=cfg, page_size=page_size, mesh=mesh
+        self._prefill = _aot(
+            jax.jit(
+                functools.partial(
+                    _paged_prefill, cfg=cfg, page_size=page_size, mesh=mesh
+                ),
+                donate_argnums=(2,),  # the kv pool pytree
             ),
-            donate_argnums=(2,),  # the kv pool pytree
+            "prefill",
         )
-        self._prefill_prefixed = jax.jit(
-            functools.partial(
-                _paged_prefill_prefixed, cfg=cfg, page_size=page_size,
-                mesh=mesh,
+        self._prefill_prefixed = _aot(
+            jax.jit(
+                functools.partial(
+                    _paged_prefill_prefixed, cfg=cfg, page_size=page_size,
+                    mesh=mesh,
+                ),
+                donate_argnums=(2,),
             ),
-            donate_argnums=(2,),
+            "prefill_prefixed",
         )
         self._key = jax.random.key(0)
         # -- automatic prefix caching (vLLM-style, opt-in) -------------------
@@ -1861,6 +1902,136 @@ class InferenceEngine:
                 continue
             self.step()
         raise RuntimeError("run_until_idle: step budget exhausted")
+
+    # -- warm-start compilation plane (compilecache/) ------------------------
+
+    @staticmethod
+    def _pow2_lattice(start: int, cap: int) -> list[int]:
+        """The power-of-two bucket values the dispatch paths round up to,
+        clamped at ``cap`` — exactly the widths the pad/bucket recipes in
+        ``_prefill_dispatch`` / ``_prepare_step`` can produce."""
+        out, w = [], start
+        while True:
+            out.append(min(w, cap))
+            if w >= cap:
+                break
+            w *= 2
+        return sorted(set(out))
+
+    def aot_signatures(self, variants: str = "minimal") -> list:
+        """The engine's (batch, length)-bucket shape lattice as concrete
+        dispatch signatures: ``[(label, fn, args), ...]`` where ``fn``
+        is the AOT-wrapped dispatch callable and ``args`` mirror — shape
+        for shape, dtype for dtype — what the live paths pass.  The
+        warm-up driver lowers each BEFORE the pod reports Ready, so
+        serving admission never eats an XLA compile on a lattice shape.
+
+        ``variants``: ``minimal`` pre-lowers the chunk variants default
+        traffic hits (plain + top-k/p filtered sampling); ``full`` walks
+        all 32 flag combinations (logprobs / penalties / seeds /
+        min-token suppression too).
+
+        Args intentionally reuse live engine state (params / kv /
+        lora_bank / bias rows) so the signatures cannot drift from the
+        real dispatches; zero-filled host arrays stand in for the
+        per-slot state.  Nothing here executes — the warm-up path only
+        ever calls ``fn.build(*args)`` (lower + compile)."""
+        B, V = self.max_batch, self.cfg.vocab_size
+        key = jax.random.key(0)
+        if variants == "full":
+            import itertools
+
+            vtuples = list(itertools.product((False, True), repeat=5))
+        else:
+            vtuples = [
+                (False, False, False, False, False),
+                (True, False, False, False, False),
+            ]
+        z32 = lambda *s: np.zeros(s, np.int32)  # noqa: E731
+        zf = lambda *s: np.zeros(s, np.float32)  # noqa: E731
+        zb = lambda *s: np.zeros(s, bool)  # noqa: E731
+        stop_dummy = zf(B, V)
+        sigs: list = []
+        # prefill lattice: padded length buckets × the page-table widths
+        # those lengths need at admission (t0=0).  The prefixed variant
+        # only runs for chunked prefill / prefix-cache hits — lower it
+        # only when the deployment can reach it — and there the pad
+        # bucket follows the CHUNK remainder n while the table width
+        # follows t0+n, so small tpads legitimately pair with EVERY
+        # width ≥ their own need (a 4k prompt ingesting 512-token
+        # chunks walks tpad=512 against pbucket 64→128→256): the
+        # prefixed lattice is the full (tpad, width ≥ need) grid, not
+        # the diagonal.
+        pb_all = self._pow2_lattice(1, self.max_pages_per_slot)
+        for tpad in self._pow2_lattice(8, self.max_len):
+            need = -(-tpad // self.page_size)
+            pbucket = min(
+                next((w for w in pb_all if w >= need), pb_all[-1]),
+                self.max_pages_per_slot,
+            )
+            args = (
+                self.params, z32(1, tpad), self.kv, z32(pbucket),
+                np.int32(tpad), self.lora_bank, np.int32(0),
+            )
+            sigs.append((f"prefill:t{tpad}:p{pbucket}", self._prefill, args))
+            if self.prefill_chunk > 0 or self.prefix_cache:
+                for pw in pb_all:
+                    if pw < need:
+                        continue
+                    pargs = (
+                        self.params, z32(1, tpad), self.kv, z32(pw),
+                        np.int32(0), np.int32(tpad), self.lora_bank,
+                        np.int32(0),
+                    )
+                    sigs.append((
+                        f"prefill_prefixed:t{tpad}:p{pw}",
+                        self._prefill_prefixed, pargs,
+                    ))
+        # decode chunks: one signature per page-table width bucket ×
+        # variant; every other array is (B,)-fixed
+        for pbucket in self._pow2_lattice(1, self.max_pages_per_slot):
+            for vt in vtuples:
+                use_filters, want_lp, use_pen, use_seed, use_min = vt
+                args = (
+                    self.params, self.kv, z32(B, pbucket), z32(B), z32(B),
+                    zb(B), z32(B, self.max_len), z32(B), zf(B), z32(B),
+                    np.ones(B, np.float32), key, self.lora_bank, z32(B),
+                    self._bias_dev,
+                    zf(B) if use_pen else None,
+                    zf(B) if use_pen else None,
+                    z32(B, V) if use_pen else None,
+                    self._seed_keys if use_seed else None,
+                    zb(B) if use_seed else None,
+                    stop_dummy if use_min else None,
+                    z32(B) if use_min else None,
+                )
+                sigs.append((
+                    f"serve_chunk:{''.join(str(int(x)) for x in vt)}"
+                    f":p{pbucket}",
+                    self._chunks[vt], args,
+                ))
+                if self.spec_k > 0:
+                    W = self.spec_k + 1
+                    vargs = (
+                        self.params, self.kv, z32(B, pbucket), z32(B, W),
+                        z32(B), zb(B), zf(B), z32(B),
+                        np.ones(B, np.float32), key, self.lora_bank,
+                        z32(B), self._bias_dev,
+                        zf(B) if use_pen else None,
+                        zf(B) if use_pen else None,
+                        z32(B, V) if use_pen else None,
+                        z32(B) if (use_pen or use_min) else None,
+                        self._seed_keys if use_seed else None,
+                        zb(B) if use_seed else None,
+                        stop_dummy if use_min else None,
+                        z32(B) if use_min else None,
+                    )
+                    sigs.append((
+                        f"verify_chunk:"
+                        f"{''.join(str(int(x)) for x in vt)}:p{pbucket}",
+                        self._verify_chunks[vt], vargs,
+                    ))
+        return sigs
 
     # -- engine internals ----------------------------------------------------
 

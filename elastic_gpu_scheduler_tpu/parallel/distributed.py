@@ -48,6 +48,24 @@ def maybe_initialize_distributed(
 
     if num_processes <= 1 and not coordinator:
         return False
+    # CPU backend: XLA's default CPU client has no cross-process
+    # collectives ("Multiprocess computations aren't implemented on the
+    # CPU backend") — switch to the gloo implementation BEFORE any
+    # backend initializes, so multi-process CPU simulation (tests, dev
+    # boxes) runs the same global-mesh code path real slices do.  Only
+    # when CPU was explicitly selected: on TPU the default is correct.
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        plats = getattr(jax.config, "jax_platforms", None) or plats
+    except Exception:
+        pass
+    if "cpu" in (plats or "").split(","):
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except Exception:  # unknown option on this jaxlib: keep defaults
+            pass
     kwargs = {}
     if coordinator:
         kwargs["coordinator_address"] = coordinator
@@ -76,3 +94,72 @@ def process_info() -> tuple[int, int]:
         return jax.process_index(), jax.process_count()
     except Exception:
         return 0, 1
+
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def gang_info_from_annotations(
+    annotations: dict,
+) -> tuple[int, int, list[str]]:
+    """(rank, size, ordered peer keys) from the gang commit's bind
+    annotations (scheduler/gang.py phase 2).  The peer list is
+    authoritative for size when present; rank defaults to 0 and size to
+    the ``gang-size`` annotation (or 1) for pods bound before this
+    ledger field existed."""
+    from ..utils import consts
+
+    ann = annotations or {}
+    peers = [
+        p for p in ann.get(consts.ANNOTATION_GANG_PEERS, "").split(",") if p
+    ]
+    try:
+        rank = int(ann.get(consts.ANNOTATION_GANG_RANK, "0"))
+    except ValueError:
+        rank = 0
+    if peers:
+        size = len(peers)
+    else:
+        try:
+            size = int(ann.get(consts.ANNOTATION_GANG_SIZE, "1") or 1)
+        except ValueError:
+            size = 1
+    return rank, max(1, size), peers
+
+
+def initialize_for_gang(
+    annotations: dict,
+    coordinator: str = "",
+    coordinator_port: int = 0,
+) -> bool:
+    """Initialize ``jax.distributed`` for a scheduler-bound gang member:
+    process_id = the member's journaled gang rank, num_processes = gang
+    size, coordinator = rank 0.
+
+    Coordinator resolution order: explicit argument →
+    ``TPU_COORDINATOR_ADDRESS`` → derived from peer 0's pod name (in a
+    headless-Service/jobset deployment the pod name IS the stable DNS
+    host) on ``coordinator_port`` (default TPU_COORDINATOR_PORT or
+    8476).  A gang of one is a no-op: single-process serving/training
+    keeps its exact historical boot path.  Returns True when the global
+    (cross-host) device view is active."""
+    rank, size, peers = gang_info_from_annotations(annotations)
+    if size <= 1:
+        return False
+    if not coordinator:
+        coordinator = os.environ.get("TPU_COORDINATOR_ADDRESS", "")
+    if not coordinator and peers:
+        host = peers[0].rsplit("/", 1)[-1]  # "ns/name" → name
+        port = coordinator_port or int(
+            os.environ.get("TPU_COORDINATOR_PORT", "0")
+            or DEFAULT_COORDINATOR_PORT
+        )
+        coordinator = f"{host}:{port}"
+    if not coordinator:
+        raise ValueError(
+            f"gang of {size} needs a coordinator address (no gang-peers "
+            "annotation, no TPU_COORDINATOR_ADDRESS)"
+        )
+    return maybe_initialize_distributed(
+        coordinator=coordinator, num_processes=size, process_id=rank
+    )

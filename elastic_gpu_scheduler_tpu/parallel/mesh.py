@@ -244,6 +244,75 @@ def coords_from_annotations(
     return [parse_coord(p) for p in raw.split(",") if p]
 
 
+def gang_rank_order(devs: list[jax.Device]) -> list[jax.Device]:
+    """Global device order for a multi-host gang mesh: gang-rank-major
+    (process id == the scheduler's journaled gang rank by construction —
+    parallel/distributed.initialize_for_gang), ICI-ordered within each
+    member's chips.  Every process computes this order from the SAME
+    global ``jax.devices()`` list, so all gang members agree on the
+    mesh layout without exchanging a byte beyond jax.distributed's own
+    handshake."""
+
+    def key(d):
+        c = getattr(d, "coords", None)
+        pi = getattr(d, "process_index", 0)
+        if c is None:
+            return (pi, 0, d.id)
+        return (pi, 0, *tuple(c), getattr(d, "core_on_chip", 0))
+
+    try:
+        return sorted(devs, key=key)
+    except TypeError:  # heterogeneous keys; keep enumeration order
+        return devs
+
+
+def gang_mesh(
+    spec: MeshSpec,
+    annotations: Optional[dict] = None,
+    coordinator: str = "",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """ONE SPMD mesh for a scheduler-planned gang, single- or
+    multi-host.
+
+    The scheduler already plans multi-node gangs and journals each
+    member's rank + ordered peer list at commit
+    (``elasticgpu.io/gang-rank`` / ``gang-peers``); this consumes that
+    ledger: ``jax.distributed`` initializes with process_id = rank and
+    coordinator = peer 0 (so the planned placement IS the process
+    layout), then the global device view is laid out gang-rank-major /
+    ICI-ordered-within-member and reshaped to ``spec``.  Collectives
+    ride ICI within a member's chips and the cross-host fabric between
+    members — the mesh the fleet's live gang resize drains and reshards
+    around.
+
+    A gang of one (or no gang annotations at all) builds EXACTLY
+    ``make_mesh(spec)`` — single-host parity is a tested invariant, so
+    existing single-process deployments keep their mesh bit-for-bit.
+
+    ``coordinator`` overrides the derived peer-0 address (tests, or
+    deployments whose coordinator DNS differs from the pod name).
+    """
+    from .distributed import gang_info_from_annotations, initialize_for_gang
+
+    rank, size, _peers = gang_info_from_annotations(annotations or {})
+    if size > 1 and devices is None:
+        initialize_for_gang(annotations or {}, coordinator=coordinator)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if size <= 1:
+        return make_mesh(spec, devs)
+    if len(devs) != spec.num_devices:
+        raise ValueError(
+            f"gang mesh spec needs {spec.num_devices} devices, have "
+            f"{len(devs)} across {size} members"
+        )
+    flat = gang_rank_order(devs)
+    arr = np.array(flat, dtype=object).reshape(
+        spec.data, spec.fsdp, spec.expert, spec.pipe, spec.tensor, spec.seq
+    )
+    return Mesh(arr, AXES)
+
+
 def mesh_from_allocation(
     annotations: dict[str, str],
     container: str,

@@ -1554,15 +1554,28 @@ class GangCoordinator:
             gang_slices = sorted({s for s in node_slice.values() if s})
             straddles = len(gang_slices) > 1
 
+            # SPMD identity (every gang): the member's rank in the
+            # deterministic sorted-member order and the ordered peer
+            # list, so the workload side can form ONE cross-host mesh —
+            # jax.distributed process_id = rank, num_processes = gang
+            # size, coordinator = peer 0 (parallel/mesh.gang_mesh).
+            rank_of = {key: i for i, (key, _) in enumerate(members)}
+            peers = ",".join(key for key, _ in members)
+
             # phase 2: annotation ledger for ALL members (reversible)
             def annotate(item):
                 pod, node, opt = item
-                extra = None
+                extra = {
+                    consts.ANNOTATION_GANG_RANK: str(
+                        rank_of.get(pod.key, 0)
+                    ),
+                    consts.ANNOTATION_GANG_PEERS: peers,
+                }
                 if straddles:
-                    extra = {
+                    extra.update({
                         consts.ANNOTATION_SLICE: node_slice.get(node, ""),
                         consts.ANNOTATION_GANG_SLICES: ",".join(gang_slices),
-                    }
+                    })
                 sched.gang_annotate(pod, opt, node, extra=extra)
 
             phase2_err, done2 = run_phase(annotate)

@@ -1126,6 +1126,8 @@ class TPUUnitScheduler(ResourceScheduler):
                     consts.ANNOTATION_TOPOLOGY,
                     consts.ANNOTATION_SLICE,
                     consts.ANNOTATION_GANG_SLICES,
+                    consts.ANNOTATION_GANG_RANK,
+                    consts.ANNOTATION_GANG_PEERS,
                     consts.ANNOTATION_TRACEPARENT,
                 ):
                     ann.pop(key, None)

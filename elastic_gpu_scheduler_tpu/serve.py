@@ -106,6 +106,23 @@ def build_args(argv=None):
                         "(default from POD_NAME; the front-door router "
                         "keys its replica set and prefix-affinity map "
                         "by it)")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent AOT compile-cache directory (default "
+                        "from TPU_COMPILE_CACHE_DIR).  Lattice shapes "
+                        "lowered at warm-up serialize here (CRC-checked "
+                        "entries); a later pod start on the same dir "
+                        "loads them back and performs ZERO new lowerings "
+                        "— see OPERATIONS.md 'Compilation warm-start'")
+    p.add_argument("--warmup", choices=["auto", "off", "lattice", "full"],
+                   default="auto",
+                   help="shape-lattice pre-lowering at pod start: the "
+                        "engine's (batch, length)-bucket lattice compiles "
+                        "BEFORE the pod reports Ready (/healthz 503 "
+                        "{warming:true} meanwhile, so the fleet router "
+                        "gates traffic on the warm cache).  'lattice' = "
+                        "the default-traffic chunk variants, 'full' = all "
+                        "32 variant combinations, 'auto' = lattice when a "
+                        "compile cache is configured else off")
     p.add_argument("--workload-class", default="",
                    help="profile class this pod's measured behavior "
                         "aggregates under (default from "
@@ -263,6 +280,23 @@ def main(argv=None) -> int:
         neighbors=neighbors,
     )
 
+    # warm-start compilation plane (compilecache/): a persistent AOT
+    # cache when a dir is configured; an in-memory single-flight cache
+    # when only the warm-up is requested (warmth then lives for this
+    # process).  'auto' warms exactly when a cache dir is set — the
+    # combination the zero-lowerings-on-restart contract needs.
+    cache_dir = args.compile_cache_dir or _os.environ.get(
+        "TPU_COMPILE_CACHE_DIR", ""
+    )
+    warmup_mode = args.warmup
+    if warmup_mode == "auto":
+        warmup_mode = "lattice" if cache_dir else "off"
+    compile_cache = None
+    if cache_dir or warmup_mode != "off":
+        from .compilecache import CompileCache
+
+        compile_cache = CompileCache(cache_dir or None)
+
     engine = InferenceEngine(
         params, cfg,
         max_batch=args.max_batch, max_len=args.max_len,
@@ -273,6 +307,7 @@ def main(argv=None) -> int:
         prefill_chunk=args.prefill_chunk,
         max_queue=args.max_queue, logprobs_k=args.logprobs_k,
         overlap=args.serve_overlap == "on",
+        compile_cache=compile_cache,
     )
     # fleet identity (/v1/stats "replica"): the front-door router keys
     # its replica set by this
@@ -280,6 +315,20 @@ def main(argv=None) -> int:
         args.replica_name or _os.environ.get("POD_NAME", "")
     )
     server, loop = serve_inference(engine, port=args.port, host=args.host)
+    if warmup_mode != "off":
+        # the HTTP server is already up: /healthz answers 503
+        # {"warming": true} for the whole pre-lowering window, so the
+        # router/Service gate traffic instead of routing into a compile
+        # storm; requests that arrive anyway are served (they just pay
+        # compiles the warm-up hasn't reached yet)
+        from .compilecache import WarmupState, start_warmup_thread
+
+        loop.warmup = WarmupState()
+        loop.warmup.state = "warming"  # visible before the thread spins up
+        start_warmup_thread(
+            engine, loop.warmup,
+            variants="full" if warmup_mode == "full" else "minimal",
+        )
     log.info(
         "serving %s model (%d layers, d=%d) on %s:%d",
         "hf-imported" if args.hf else "random-init",

@@ -152,6 +152,12 @@ class EngineLoop:
         self.drained = threading.Event()
         self.http_inflight = 0
         self._inflight_lock = threading.Lock()
+        # warm-start compilation plane (compilecache/): when serve.py
+        # runs a shape-lattice warm-up, its WarmupState lands here and
+        # /healthz answers 503 {"warming": true} until it completes —
+        # the fleet router keeps the replica out of rotation meanwhile.
+        # None = no warm-up phase (the historical boot path).
+        self.warmup = None
 
     def inflight_enter(self) -> None:
         with self._inflight_lock:
@@ -477,6 +483,17 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     # new requests here while in-flight ones finish
                     return self._json(503, {"ok": False,
                                             "draining": True})
+                wu = loop.warmup
+                if wu is not None and wu.warming:
+                    # not-ready while the shape lattice pre-lowers: the
+                    # fleet router parses the body and holds the replica
+                    # in 'warming' (distinct from draining — capacity is
+                    # COMING, so the autoscaler must not double-scale)
+                    return self._json(503, {
+                        "ok": False,
+                        "warming": True,
+                        "warmup": wu.to_dict(),
+                    })
                 return self._json(200, {"ok": True})
             if self.path == "/version":
                 return self._json(200, {"version": __version__})
@@ -566,6 +583,19 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "page_size": eng.page_size,
                     "chunks_discarded": int(eng.chunks_discarded),
                     "replica": getattr(eng, "replica_name", ""),
+                    # warm-start compilation plane: warm-up phase state
+                    # (router/autoscaler readiness gating) + the AOT
+                    # cache's fill/load counters (check-compile-cache
+                    # asserts "second start → zero new lowerings" here)
+                    "warmup": (
+                        loop.warmup.to_dict()
+                        if loop.warmup is not None else {"state": "none"}
+                    ),
+                    "compile_cache": (
+                        eng.compile_cache.stats()
+                        if getattr(eng, "compile_cache", None) is not None
+                        else None
+                    ),
                 })
             return self._json(404, {"error": f"no route {self.path}"})
 

@@ -31,6 +31,14 @@ ANNOTATION_GANG_SIZE = "elasticgpu.io/gang-size"  # min members for all-or-nothi
 # × inner ICI axes, parallel/mesh.py hierarchical_mesh).
 ANNOTATION_SLICE = "elasticgpu.io/slice"
 ANNOTATION_GANG_SLICES = "elasticgpu.io/gang-slices"  # "sliceA,sliceB,..."
+# Multi-host SPMD gang identity (written at gang commit for EVERY gang):
+# the member's deterministic rank in the gang's sorted member order, and
+# the ordered peer list ("ns/name,ns/name,...").  parallel/mesh.py's
+# gang_mesh derives jax.distributed process ids from the rank and the
+# coordinator host from peer 0, turning a multi-node gang into ONE
+# cross-host jax.sharding.Mesh.
+ANNOTATION_GANG_RANK = "elasticgpu.io/gang-rank"
+ANNOTATION_GANG_PEERS = "elasticgpu.io/gang-peers"
 
 # Scheduling-trace propagation (tracing/__init__.py): written with the
 # bind-time allocation ledger so the on-node side (device plugin, launcher)

@@ -54,12 +54,14 @@ class FakeReplicaServer:
     and records every request body it saw."""
 
     def __init__(self, name, queued=0, active_slots=0, max_batch=8,
-                 draining=False, fail_completions=False, slow_stream=0.0):
+                 draining=False, warming=False, fail_completions=False,
+                 slow_stream=0.0):
         self.name = name
         self.queued = queued
         self.active_slots = active_slots
         self.max_batch = max_batch
         self.draining = draining
+        self.warming = warming
         self.fail_completions = fail_completions
         self.slow_stream = slow_stream  # s between SSE chunks
         self.requests: list[dict] = []
@@ -85,6 +87,15 @@ class FakeReplicaServer:
                     if outer.draining:
                         return self._json(503, {"ok": False,
                                                 "draining": True})
+                    if outer.warming:
+                        # server/inference.py's warm-up shape: a pod
+                        # pre-lowering its compile lattice before Ready
+                        return self._json(503, {
+                            "ok": False,
+                            "warming": True,
+                            "warmup": {"state": "warming",
+                                       "built": 3, "lattice_size": 12},
+                        })
                     return self._json(200, {"ok": True})
                 if self.path == "/v1/stats":
                     outer.stats_polls += 1
@@ -302,6 +313,93 @@ def test_draining_replica_gets_no_new_sessions():
         assert len(servers[1].requests) == 3
     finally:
         router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_routes_zero_requests_to_warming_replica():
+    """THE readiness-gating contract (ISSUE 11 satellite): a replica
+    mid-compile-warm-up answers stats polls fine but must receive ZERO
+    traffic — before the ``warming`` state, any healthz-200-shaped
+    reading would stall first requests behind a compile storm."""
+    servers, rs, router = make_fleet(2)
+    try:
+        servers[0].warming = True
+        rs.refresh()
+        r0 = rs.get("rep-0")
+        assert r0.state == "warming"
+        assert "lattice 3/12" in r0.state_reason
+        # warming ≠ draining: the autoscaler distinguishes arriving
+        # capacity from leaving capacity
+        assert r0.state != "draining"
+        # stats polls still flow (warm-up progress is advisory data)...
+        assert servers[0].stats_polls > 0
+        port = router.start()
+        for _ in range(4):
+            st, _ = post_completion(port, {"prompt": [1, 2, 3]})
+            assert st == 200
+        # ...but not one completion reached the warming replica
+        assert not servers[0].requests
+        assert len(servers[1].requests) == 4
+        # warm-up completes → next health pass restores rotation
+        servers[0].warming = False
+        rs.refresh()
+        assert rs.get("rep-0").state == "up"
+        for _ in range(8):
+            post_completion(port, {"prompt": [5, 6, 7]})
+        assert servers[0].requests  # back in rotation
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_autoscaler_suppresses_scale_up_while_replica_warming():
+    """Scale-up gating on warm caches: a breach that already bought a
+    (still-warming) replica must not buy another; the warm-up landing
+    releases the hold.  Pure PolicyEngine inputs + a tick-level pass
+    through a real ReplicaSet."""
+    pol = ScalingPolicy(min_replicas=1, max_replicas=8,
+                        hysteresis_rounds=1, up_cooldown_s=0.0)
+    eng = PolicyEngine(pol)
+    breach = {"queue_per_replica": 99.0, "occupancy": 0.0, "page_util": 0.0}
+    action, reason = eng.evaluate(
+        breach, 2, now=100.0, total_replicas=3, warming_replicas=1
+    )
+    assert action == "hold" and eng.suppressed == "warming"
+    assert "warming" in reason
+    # warm-up done → the same breach scales
+    action, _ = eng.evaluate(
+        breach, 2, now=101.0, total_replicas=3, warming_replicas=0
+    )
+    assert action == "up"
+    # floor restores hold too while capacity is in flight
+    eng2 = PolicyEngine(pol)
+    action, reason = eng2.evaluate(
+        {}, 0, now=0.0, total_replicas=1, warming_replicas=1
+    )
+    assert action == "hold" and eng2.suppressed == "warming"
+
+    # tick level: a warming replica in the set journals the hold
+    servers = [FakeReplicaServer("rep-0"), FakeReplicaServer("rep-1",
+                                                            warming=True)]
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    for s in servers:
+        rs.add(s.replica())
+    try:
+        rs.refresh()
+        auto = Autoscaler(
+            rs, executor=None,
+            policy=ScalingPolicy(min_replicas=1, max_replicas=4,
+                                 hysteresis_rounds=1, up_cooldown_s=0.0),
+        )
+        servers[0].queued = 1000  # breaching hard
+        rs.refresh()
+        rec = auto.tick(now=10.0)
+        assert rec["warming"] == 1
+        assert rec["action"] == "hold"
+        assert "warming" in rec["reason"]
+    finally:
         for s in servers:
             s.stop()
 

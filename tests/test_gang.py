@@ -131,6 +131,46 @@ def test_gang_binds_all_members(small_stack):
         assert bound.metadata.annotations[consts.ANNOTATION_ASSUMED] == "true"
 
 
+def test_gang_bind_writes_rank_and_peer_annotations(small_stack):
+    """The commit's phase-2 ledger carries the SPMD identity every
+    member needs to join one cross-host mesh: a deterministic rank in
+    the sorted-member order and the gang's ordered peer list
+    (parallel/mesh.gang_mesh consumes exactly these)."""
+    cluster, registry, predicate, bind, gang = small_stack
+    nodes = [f"node-{i}" for i in range(4)]
+    pods = [gang_pod(f"m-{i}", "meshset", 4, core=400) for i in range(4)]
+    for p in pods:
+        cluster.create_pod(p)
+    results = [None] * 4
+    threads = [
+        threading.Thread(
+            target=drive_member,
+            args=(cluster, predicate, bind, p, nodes, results, i),
+        )
+        for i, p in enumerate(pods)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(r is not None and r[0] == "ok" for r in results), results
+    expected_peers = ",".join(
+        sorted(f"default/m-{i}" for i in range(4))
+    )
+    ranks = []
+    for p in pods:
+        ann = cluster.get_pod("default", p.metadata.name).metadata.annotations
+        assert ann[consts.ANNOTATION_GANG_PEERS] == expected_peers
+        ranks.append(int(ann[consts.ANNOTATION_GANG_RANK]))
+        # rank matches the member's position in the sorted peer list —
+        # the property jax.distributed process ids are derived from
+        assert (
+            expected_peers.split(",")[ranks[-1]]
+            == f"default/{p.metadata.name}"
+        )
+    assert sorted(ranks) == [0, 1, 2, 3]
+
+
 def test_gang_timeout_binds_nothing(small_stack):
     cluster, registry, predicate, bind, gang = small_stack
     nodes = [f"node-{i}" for i in range(4)]
