@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -105,6 +105,26 @@ check-policy:
 # compile once (single-flight).
 check-compile-cache:
 	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
+
+# Invariant-analysis gate: AST lockdep (rank inversions / finalizer
+# locks / blocking calls under control-plane locks), journal
+# emit-vs-replay exhaustiveness + mutation choke points, and conformance
+# lints (tpu_* metric naming+docs, /debug index, GIL-atomic allowlist),
+# diffed against tools/analysis_baseline.json (every grandfathered
+# finding carries a written justification; new findings, stale entries
+# and unjustified entries all fail).  Includes an injection self-test:
+# synthetic violations per rule must be flagged or the gate fails.
+check-analysis:
+	python tools/check_analysis.py
+
+# Native-kernel sanitizer gate: rebuild placement.cc with
+# ASan+UBSan (-fno-sanitize-recover) and run a seeded differential
+# fuzzer (NATIVE_FUZZ_SEED / NATIVE_FUZZ_ITERS) that requires
+# plan_gang / plan_gang_batch / enumerate_free_boxes to stay
+# bit-identical to their Python fallbacks on every iteration, under the
+# sanitizer (memory errors or UB abort the run).
+check-native-san:
+	python tools/check_native_san.py
 
 # Overlapped-decode gate: randomized request soak through the serving
 # engine with overlap off then on; hard-fails on any token/logprob parity

@@ -1,0 +1,139 @@
+"""Invariant analysis plane: mechanical checkers for the conventions prose
+used to carry.
+
+The codebase's correctness story lives in conventions — ranked TimedLocks
+(gang 10 → resize 14 → defrag 15 → scheduler 20 → node 30), journal records
+emitted only at commit choke points, "finalizers may take no locks",
+GIL-atomic off-lock mutation patterns, and a native kernel that must stay
+bit-identical to its Python fallback.  The runtime checkers (the rank guard
+in ``metrics.TimedLock``, the replay invariant audit) only fire on paths
+that EXECUTE, and the GIL hides most interleavings from the test suite.
+This package checks the contracts statically, over every path the AST can
+see:
+
+- ``lockdep``    — static lock-order analysis over a heuristic call graph:
+                   rank inversions on never-executed paths, locks taken
+                   from GC finalizers, blocking calls (HTTP / fsync /
+                   subprocess / jax dispatch) reachable while a
+                   control-plane rank (≤ 20) is held.
+- ``journalcheck`` — journal discipline: every emitted record type has a
+                   replay handler (and a conscious ``what_if`` stance),
+                   ``ChipSet._set_slot`` stays confined to its choke
+                   modules, live allocator mutations stay inside the
+                   journaling perimeter.
+- ``conformance`` — registered metric names follow the ``tpu_*`` scheme
+                   and appear in OPERATIONS.md, every ``/debug/*`` route
+                   is listed in the ``/debug/`` index, off-lock mutations
+                   of module-level containers match the documented
+                   GIL-atomic allowlist.
+
+Findings are diffed against a checked-in baseline
+(``tools/analysis_baseline.json``): pre-existing findings are
+grandfathered EXPLICITLY (each entry carries a written justification) and
+any NEW finding fails CI (``make check-analysis``).  The analysis is
+deliberately heuristic — name-based call resolution, receiver-name type
+hints — and errs toward reporting; the baseline is the pressure valve,
+never silence.
+
+Entry points: ``python -m elastic_gpu_scheduler_tpu.analysis`` (CLI),
+``run_all(root)`` (programmatic; the fixture tests drive it directly).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation.  ``key`` is the stable identity the baseline matches
+    on — rule + file + enclosing symbol + salient detail, NO line numbers,
+    so unrelated edits shifting lines don't churn the baseline.  ``line``
+    is for humans."""
+
+    rule: str
+    file: str
+    line: int
+    key: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}\n    key: {self.key}"
+
+
+@dataclass
+class AnalysisConfig:
+    """Knobs the passes read.  Defaults describe THIS repository; the
+    fixture tests override them to aim the passes at synthetic trees."""
+
+    # text of OPERATIONS.md (metric-documentation lint); empty string
+    # disables the documentation check, not the naming check
+    ops_text: str = ""
+    # module basename (relative path suffix) holding the replay dispatch
+    replay_module: str = "journal/replay.py"
+    # relative-path suffixes allowed to call ChipSet._set_slot/_set_total
+    setslot_modules: tuple = ("core/allocator.py", "core/chip.py")
+    # modules exempt from the journaling-perimeter rule (they mutate
+    # rebuilt/offline state, not the live allocator)
+    journal_exempt_modules: tuple = ("journal/replay.py", "journal/__main__.py")
+    # (module-relpath, global-name) pairs allowed to mutate module-level
+    # containers without holding a lock — the documented GIL-atomic
+    # patterns (ADVICE r5 #1 and the LOCK_WAIT drain design): appends and
+    # slice/del pairs on plain lists are single bytecodes under CPython's
+    # GIL, and each listed site pairs a hot-path append with a reader-side
+    # drain that tolerates concurrent tails.
+    gil_atomic_allowlist: tuple = (
+        # dying TimedLocks park their wait buffers from a GC finalizer
+        # that may run inside any metric lock — it MUST NOT lock
+        ("metrics/__init__.py", "_ORPHAN_WAITS"),
+        ("metrics/__init__.py", "_ORPHAN_DROPPED"),
+    )
+    # record types replay may handle without any live emission site
+    # (forward-compat handlers); populated from the baseline workflow
+    dead_handler_allow: tuple = ()
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", "_native_build") and not d.startswith(".")
+        ]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_all(root: str, config: Optional[AnalysisConfig] = None) -> list:
+    """Parse every module under ``root`` and run all three passes.
+    Returns findings sorted by (file, line)."""
+    from .callgraph import PackageIndex
+    from .conformance import check_conformance
+    from .journalcheck import check_journal
+    from .lockdep import check_lockdep
+
+    cfg = config or AnalysisConfig()
+    index = PackageIndex.load(root)
+    findings: list[Finding] = []
+    findings.extend(check_lockdep(index, cfg))
+    findings.extend(check_journal(index, cfg))
+    findings.extend(check_conformance(index, cfg))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def default_ops_text() -> str:
+    """OPERATIONS.md of this repository (metric-doc lint input)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "OPERATIONS.md")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return ""
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,88 @@
+"""CLI: run the invariant analysis over the package and diff against the
+checked-in baseline.
+
+    python -m elastic_gpu_scheduler_tpu.analysis [--baseline PATH]
+        [--root DIR] [--write-baseline] [--json]
+
+Exit 0 = clean (possibly with explicitly-baselined findings), 1 = new
+findings / stale baseline entries / invalid baseline.  ``make
+check-analysis`` wraps this plus an injection self-test
+(tools/check_analysis.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import AnalysisConfig, default_ops_text, package_root, run_all
+from .baseline import diff_baseline, load_baseline, write_baseline
+
+
+def default_baseline_path() -> str:
+    repo = os.path.dirname(package_root())
+    return os.path.join(repo, "tools", "analysis_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="elastic_gpu_scheduler_tpu.analysis")
+    ap.add_argument("--root", default=package_root(),
+                    help="package directory to analyze")
+    ap.add_argument("--baseline", default=default_baseline_path())
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="overwrite the baseline with the current findings "
+                         "(each entry still needs a written justification)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    cfg = AnalysisConfig(ops_text=default_ops_text())
+    findings = run_all(args.root, cfg)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except ValueError as e:
+        print(f"INVALID BASELINE: {e}", file=sys.stderr)
+        return 1
+    diff = diff_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in diff.new],
+            "suppressed": [f.key for f in diff.suppressed],
+            "stale": diff.stale,
+            "invalid": diff.invalid,
+        }, indent=1))
+        return 0 if diff.ok else 1
+
+    if diff.suppressed:
+        print(f"{len(diff.suppressed)} finding(s) suppressed by baseline "
+              f"({os.path.relpath(args.baseline)})")
+    for f in diff.new:
+        print(f"NEW: {f.render()}")
+    for k in diff.stale:
+        print(f"STALE BASELINE ENTRY (violation gone — delete it): {k}")
+    for msg in diff.invalid:
+        print(f"INVALID BASELINE: {msg}")
+    if diff.ok:
+        print(f"analysis clean: {len(findings)} finding(s), all baselined "
+              "with justification")
+        return 0
+    print(
+        f"\nanalysis FAILED: {len(diff.new)} new, {len(diff.stale)} stale, "
+        f"{len(diff.invalid)} invalid baseline entr(ies).\n"
+        "How to read a finding: OPERATIONS.md §'Static analysis & "
+        "sanitizers'.  Fix the violation, or baseline it WITH a written "
+        "justification in tools/analysis_baseline.json."
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

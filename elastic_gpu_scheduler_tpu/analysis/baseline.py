@@ -1,0 +1,95 @@
+"""Baseline mechanism: explicit grandfathering of pre-existing findings.
+
+The baseline file (``tools/analysis_baseline.json``) is a list of
+entries, each carrying the finding's stable ``key`` and a WRITTEN
+justification:
+
+    {"entries": [
+        {"key": "lockdep-blocking::defrag/__init__.py::...",
+         "justification": "planner lock exists to serialize rounds; ..."}
+    ]}
+
+Semantics (all three outcomes fail the gate):
+
+- a finding whose key is NOT in the baseline is **new** → fail;
+- a baseline entry matching NO current finding is **stale** → fail (the
+  violation was fixed: delete the entry, or the key drifted: re-anchor
+  it) — this is what makes suppression reversible instead of rot;
+- an entry with an empty/missing justification is **invalid** → fail
+  (grandfathering without a reason is just silence).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BaselineDiff:
+    new: list = field(default_factory=list)        # [Finding]
+    suppressed: list = field(default_factory=list) # [Finding]
+    stale: list = field(default_factory=list)      # [key]
+    invalid: list = field(default_factory=list)    # [reason]
+
+    @property
+    def ok(self) -> bool:
+        return not (self.new or self.stale or self.invalid)
+
+
+def load_baseline(path: str) -> dict:
+    """key → justification.  Raises ValueError on malformed entries."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    entries = data.get("entries", data if isinstance(data, list) else [])
+    out = {}
+    for i, e in enumerate(entries):
+        key = e.get("key", "")
+        just = (e.get("justification") or "").strip()
+        if not key:
+            raise ValueError(f"baseline entry {i}: missing key")
+        if key in out:
+            raise ValueError(f"baseline entry {i}: duplicate key {key!r}")
+        out[key] = just
+    return out
+
+
+def diff_baseline(findings: list, baseline: dict) -> BaselineDiff:
+    d = BaselineDiff()
+    for key, just in baseline.items():
+        if not just:
+            d.invalid.append(
+                f"baseline entry {key!r} has no justification — "
+                "grandfathering without a reason is just silence"
+            )
+    matched = set()
+    for f in findings:
+        if f.key in baseline:
+            matched.add(f.key)
+            d.suppressed.append(f)
+        else:
+            d.new.append(f)
+    d.stale = sorted(set(baseline) - matched)
+    return d
+
+
+def write_baseline(path: str, findings: list, justification: str = "TODO: justify") -> None:
+    """Emit a baseline covering the current findings (the bootstrap /
+    re-anchor workflow; every generated entry still needs a real
+    justification before the gate passes)."""
+    entries = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: f.key):
+        if f.key in seen:
+            continue  # keys are line-free; two sites can share one
+        seen.add(f.key)
+        entries.append({
+            "key": f.key, "justification": justification,
+            "finding": f"{f.file}:{f.line}: {f.message}",
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=1)
+        fh.write("\n")

@@ -1,0 +1,614 @@
+"""AST package index + heuristic call graph for the analysis passes.
+
+Resolution strategy (deliberately simple, documented so findings can be
+read back to source):
+
+- Functions are keyed ``relpath::Class.method`` / ``relpath::func``.
+- ``self.m(...)`` resolves within the enclosing class, then its
+  package-local base classes.
+- Well-known receiver names resolve through ``RECEIVER_CLASS_HINTS``
+  (``sched`` → TPUUnitScheduler, ``na``/``na_*`` → NodeAllocator, the
+  process-global singletons JOURNAL/TRACER/PROFILER, …).
+- A bare name resolves to a same-module def, then a ``from x import y``
+  target, then a unique package-wide def.
+- Anything else falls back to every package def of that name, capped at
+  ``MAX_NAME_CANDIDATES`` and filtered through ``COMMON_NAMES`` —
+  over-approximate where cheap, silent where the name is too generic to
+  mean anything.
+
+Lock model: ``TimedLock("name", rank=N[, reentrant=True])`` assignments
+to ``self.attr`` (or module globals) define RANKED locks;
+``threading.Lock()/RLock()/Condition()`` define PLAIN locks (they opt out
+of the rank hierarchy but still count for the finalizer rule).  A
+``with``-block over a resolved lock establishes held-context for every
+call lexically inside it; bare ``.acquire()`` marks the function as an
+acquirer without establishing context (release-flow is not modeled).
+Try-locks (``blocking=False``) and timeout-bounded acquires are exempt,
+mirroring the runtime checker in ``metrics.TimedLock``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Receiver variable/attr basename → class name.  The codebase's naming is
+# consistent enough that this table IS the type inference.
+RECEIVER_CLASS_HINTS = {
+    "sched": "TPUUnitScheduler",
+    "scheduler": "TPUUnitScheduler",
+    "engine": "TPUUnitScheduler",
+    "na": "NodeAllocator",
+    "allocator": "NodeAllocator",
+    "nalloc": "NodeAllocator",
+    "planner": "DefragPlanner",
+    "resizer": "GangResizer",
+    "coordinator": "GangCoordinator",
+    "JOURNAL": "Journal",
+    "TRACER": "Tracer",
+    "PROFILER": "WorkloadProfiler",
+}
+
+# Names too generic for package-wide fallback resolution (they still
+# resolve through self/hints).
+COMMON_NAMES = frozenset(
+    "get set add pop push put items keys values append extend update copy "
+    "clear close open read write send recv join split strip sort index "
+    "count remove insert encode decode format replace start stop run flush "
+    "lower upper status name keys get_pod info debug warning error "
+    "exception to_dict from_record record_step wait notify notify_all "
+    "acquire release submit result cancel done "
+    "match fullmatch search sub findall finditer group groups compile".split()
+)
+MAX_NAME_CANDIDATES = 4
+
+# Direct blocking primitives (dotted-name match) for the
+# no-blocking-under-control-plane-lock rule.
+BLOCKING_CALLS = {
+    "urllib.request.urlopen": "HTTP (urlopen)",
+    "urlopen": "HTTP (urlopen)",
+    "os.fsync": "fsync",
+    "fsync": "fsync",
+    "subprocess.run": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "time.sleep": "sleep",
+    "socket.create_connection": "socket connect",
+}
+# any call whose dotted path starts with one of these roots is treated as
+# potentially blocking (XLA compile/dispatch can stall for seconds)
+BLOCKING_ROOTS = ("jax.",)
+
+
+@dataclass(frozen=True)
+class LockDef:
+    key: str          # "Class.attr" or "module_relpath::NAME"
+    lock_name: str    # TimedLock label, or the attr/global name
+    rank: Optional[int]
+    reentrant: bool
+    kind: str         # "timed" | "plain"
+
+
+@dataclass
+class Acquire:
+    lock: LockDef
+    line: int
+    bare: bool  # .acquire() outside a with (no held-context established)
+    held: tuple = ()  # LockDefs with-held at the acquire site
+
+
+@dataclass
+class CallSite:
+    recv: str         # receiver basename ('' = bare name, 'self', 'sched', …)
+    attr: str         # called name
+    line: int
+    held: tuple       # LockDefs held (with-context) at this site
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    module: str       # relpath
+    cls: Optional[str]
+    name: str
+    line: int
+    acquires: list = field(default_factory=list)   # [Acquire]
+    calls: list = field(default_factory=list)      # [CallSite]
+    blocking: list = field(default_factory=list)   # [(label, line, held)]
+    has_clone_call: bool = False                   # '.clone(' appears inside
+    node: object = None                            # the ast def node
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _recv_basename(node) -> str:
+    """Basename of a call/lock receiver: self.sched.lock → 'sched';
+    clones[n].transact → 'clones'; sched.lock → 'sched'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _recv_basename(node.value)
+    if isinstance(node, ast.Call):
+        return _recv_basename(node.func)
+    return ""
+
+
+def _lock_ctor(call: ast.Call) -> Optional[tuple]:
+    """(kind, lock_name, rank, reentrant) when ``call`` constructs a lock."""
+    name = _dotted(call.func)
+    if name is None:
+        return None
+    base = name.split(".")[-1]
+    if base == "TimedLock":
+        lock_name = ""
+        if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str
+        ):
+            lock_name = call.args[0].value
+        rank = None
+        reentrant = False
+        for kw in call.keywords:
+            if kw.arg == "rank" and isinstance(kw.value, ast.Constant):
+                rank = kw.value.value
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                reentrant = bool(kw.value.value)
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+            reentrant = bool(call.args[1].value)
+        return ("timed", lock_name, rank, reentrant)
+    if base in ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"):
+        if name in (base, f"threading.{base}"):
+            return ("plain", base, None, base in ("RLock", "Condition"))
+    return None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Populate one FunctionInfo: acquisitions, held-context call sites,
+    direct blocking primitives."""
+
+    def __init__(self, index: "PackageIndex", info: FunctionInfo, cls: Optional[str]):
+        self.index = index
+        self.info = info
+        self.cls = cls
+        self.held: list[LockDef] = []
+
+    # nested defs get their own FunctionInfo; don't descend here
+    def visit_FunctionDef(self, node):
+        if node is not self.info.node:
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.generic_visit(node)
+
+    def _resolve_lock(self, expr) -> Optional[LockDef]:
+        return self.index.resolve_lock(expr, self.info.module, self.cls)
+
+    def visit_With(self, node):
+        resolved = []
+        for item in node.items:
+            ld = self._resolve_lock(item.context_expr)
+            if ld is not None:
+                self.info.acquires.append(
+                    Acquire(
+                        ld, item.context_expr.lineno, bare=False,
+                        held=tuple(self.held) + tuple(resolved),
+                    )
+                )
+                resolved.append(ld)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(resolved)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in resolved:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        func = node.func
+        dotted = _dotted(func)
+        # blocking primitive?
+        if dotted is not None:
+            label = BLOCKING_CALLS.get(dotted)
+            if label is None and any(
+                dotted.startswith(r) for r in BLOCKING_ROOTS
+            ):
+                label = f"jax dispatch ({dotted})"
+            if label is not None:
+                self.info.blocking.append(
+                    (label, node.lineno, tuple(self.held))
+                )
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr == "clone":
+                self.info.has_clone_call = True
+            if attr == "acquire":
+                ld = self._resolve_lock(func.value)
+                if ld is not None and not _acquire_exempt(node):
+                    # held context recorded so lockdep can flag a bare
+                    # acquire INSIDE a with-held lock in the same
+                    # function (neither the direct-nesting walk nor the
+                    # call-path rule sees that shape)
+                    self.info.acquires.append(
+                        Acquire(ld, node.lineno, bare=True,
+                                held=tuple(self.held))
+                    )
+                self.generic_visit(node)
+                return
+            recv = ""
+            if isinstance(func.value, ast.Name):
+                recv = func.value.id
+            else:
+                recv = _recv_basename(func.value)
+            self.info.calls.append(
+                CallSite(recv, attr, node.lineno, tuple(self.held))
+            )
+        elif isinstance(func, ast.Name):
+            self.info.calls.append(
+                CallSite("", func.id, node.lineno, tuple(self.held))
+            )
+        self.generic_visit(node)
+
+
+def _acquire_exempt(call: ast.Call) -> bool:
+    """Try-locks and timeout-bounded acquires cannot deadlock — same
+    exemption as the runtime checker."""
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and a0.value is False:
+            return True
+        if len(call.args) > 1:  # explicit timeout positional
+            a1 = call.args[1]
+            if not (isinstance(a1, ast.Constant) and a1.value in (-1,)):
+                return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout":
+            if not (isinstance(kw.value, ast.Constant) and kw.value.value == -1):
+                return True
+    return False
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str
+    tree: ast.Module
+    source: str
+    # name → list of qualnames (module-level defs incl. nested)
+    defs: dict = field(default_factory=dict)
+    # from-import: local name → imported name
+    from_imports: dict = field(default_factory=dict)
+    # module-level mutable containers: name → lineno
+    mutable_globals: dict = field(default_factory=dict)
+
+
+class PackageIndex:
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}          # relpath → ModuleInfo
+        self.functions: dict[str, FunctionInfo] = {}      # qualname → info
+        self.classes: dict[str, dict] = {}                # class → {module, bases, methods{name→qualname}}
+        self.class_locks: dict[tuple, LockDef] = {}       # (class, attr) → LockDef
+        self.module_locks: dict[tuple, LockDef] = {}      # (relpath, name) → LockDef
+        self.by_name: dict[str, list] = {}                # func name → [qualname]
+        self.finalizer_roots: list[tuple] = []            # (qualname, via, line)
+
+    # -- loading -------------------------------------------------------------
+
+    @classmethod
+    def load(cls, root: str) -> "PackageIndex":
+        from . import iter_py_files
+
+        idx = cls(root)
+        for path in iter_py_files(root):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=path)
+            except (OSError, SyntaxError):
+                continue
+            idx.modules[rel] = ModuleInfo(rel, tree, src)
+        for mi in idx.modules.values():
+            idx._collect_defs(mi)
+        for mi in idx.modules.values():
+            idx._collect_finalizers(mi)
+        for mi in idx.modules.values():
+            idx._scan_functions(mi)
+        return idx
+
+    def _collect_finalizers(self, mi: ModuleInfo) -> None:
+        """Runs after EVERY module's defs are registered, so a finalize
+        callback defined in another module still resolves."""
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if dotted in ("weakref.finalize", "finalize") and len(node.args) >= 2:
+                    cb_name = _dotted(node.args[1])
+                    if cb_name:
+                        for q in self.by_name.get(cb_name.split(".")[-1], []):
+                            self.finalizer_roots.append(
+                                (q, "weakref.finalize", node.lineno)
+                            )
+        for cname, entry in self.classes.items():
+            if entry["module"] == mi.relpath and "__del__" in entry["methods"]:
+                self.finalizer_roots.append(
+                    (entry["methods"]["__del__"], "__del__", 0)
+                )
+
+    def _collect_defs(self, mi: ModuleInfo) -> None:
+        for node in mi.tree.body:
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    mi.from_imports[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.Assign):
+                self._module_assign(mi, node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                # annotated module globals: `_BUF: list = []`
+                if isinstance(node.target, ast.Name):
+                    synth = ast.Assign(targets=[node.target], value=node.value)
+                    ast.copy_location(synth, node)
+                    self._module_assign(mi, synth)
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.ClassDef):
+                entry = self.classes.setdefault(
+                    node.name,
+                    {"module": mi.relpath, "bases": [], "methods": {}},
+                )
+                entry["bases"] = [
+                    b for b in (_dotted(x) for x in node.bases) if b
+                ]
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        q = f"{mi.relpath}::{node.name}.{item.name}"
+                        entry["methods"][item.name] = q
+                        self._register_function(mi, item, node.name, q)
+                    elif isinstance(item, ast.Assign):
+                        pass  # class-level locks are rare; self.attr wins
+                # lock attrs assigned in any method body
+                for item in ast.walk(node):
+                    if isinstance(item, ast.Assign) and len(item.targets) == 1:
+                        tgt = item.targets[0]
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and isinstance(item.value, ast.Call)
+                        ):
+                            lk = _lock_ctor(item.value)
+                            if lk is not None:
+                                kind, lname, rank, reent = lk
+                                self.class_locks[(node.name, tgt.attr)] = LockDef(
+                                    key=f"{node.name}.{tgt.attr}",
+                                    lock_name=lname or tgt.attr,
+                                    rank=rank, reentrant=reent, kind=kind,
+                                )
+        # module-level (non-class) functions, incl. nested
+        for node in ast.walk(mi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._enclosing_class(mi, node) is None:
+                    q = f"{mi.relpath}::{node.name}"
+                    self._register_function(mi, node, None, q)
+
+    def _module_assign(self, mi: ModuleInfo, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        v = node.value
+        if isinstance(v, ast.Call):
+            lk = _lock_ctor(v)
+            if lk is not None:
+                kind, lname, rank, reent = lk
+                self.module_locks[(mi.relpath, name)] = LockDef(
+                    key=f"{mi.relpath}::{name}", lock_name=lname or name,
+                    rank=rank, reentrant=reent, kind=kind,
+                )
+                return
+            ctor = _dotted(v.func)
+            if ctor in ("list", "dict", "set", "collections.deque", "deque") \
+                    and not v.args:
+                mi.mutable_globals[name] = node.lineno
+        elif isinstance(v, (ast.List, ast.Dict, ast.Set)):
+            # literal-initialized module containers: only EMPTY ones are
+            # runtime mutation buffers; populated literals are config
+            # tables (never mutated off-lock by design)
+            if isinstance(v, ast.List) and not v.elts:
+                mi.mutable_globals[name] = node.lineno
+            elif isinstance(v, ast.Dict) and not v.keys:
+                mi.mutable_globals[name] = node.lineno
+            elif isinstance(v, ast.Set) and not v.elts:
+                mi.mutable_globals[name] = node.lineno
+
+    def _enclosing_class(self, mi: ModuleInfo, func) -> Optional[str]:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if item is func:
+                        return node.name
+        return None
+
+    def _register_function(self, mi, node, cls, qualname) -> None:
+        if qualname in self.functions:
+            return
+        info = FunctionInfo(
+            qualname=qualname, module=mi.relpath, cls=cls,
+            name=node.name, line=node.lineno, node=node,
+        )
+        self.functions[qualname] = info
+        self.by_name.setdefault(node.name, []).append(qualname)
+        mi.defs.setdefault(node.name, []).append(qualname)
+
+    def _scan_functions(self, mi: ModuleInfo) -> None:
+        for info in self.functions.values():
+            if info.module != mi.relpath:
+                continue
+            scanner = _FunctionScanner(self, info, info.cls)
+            scanner.visit(info.node)
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_lock(self, expr, module: str, cls: Optional[str]) -> Optional[LockDef]:
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get((module, expr.id))
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and cls:
+            ld = self._class_lock(cls, attr)
+            if ld is not None:
+                return ld
+        # foreign receiver: hint table, then unique-attr fallback
+        base = _recv_basename(recv) if not (
+            isinstance(recv, ast.Name) and recv.id == "self"
+        ) else ""
+        hint = self._hint_class(base)
+        if hint is not None:
+            ld = self._class_lock(hint, attr)
+            if ld is not None:
+                return ld
+        cands = [
+            ld for (c, a), ld in self.class_locks.items() if a == attr
+        ]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _class_lock(self, cls: str, attr: str) -> Optional[LockDef]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            ld = self.class_locks.get((c, attr))
+            if ld is not None:
+                return ld
+            entry = self.classes.get(c)
+            if entry:
+                stack.extend(b.split(".")[-1] for b in entry["bases"])
+        return None
+
+    def _hint_class(self, base: str) -> Optional[str]:
+        if not base:
+            return None
+        if base in RECEIVER_CLASS_HINTS:
+            return RECEIVER_CLASS_HINTS[base]
+        if base.startswith("na_"):
+            return "NodeAllocator"
+        if base.startswith("sched"):
+            return "TPUUnitScheduler"
+        return None
+
+    def resolve_call(self, site: CallSite, caller: FunctionInfo) -> list:
+        """Candidate callee qualnames for a call site."""
+        attr, recv = site.attr, site.recv
+        if recv == "self" and caller.cls:
+            q = self._class_method(caller.cls, attr)
+            if q:
+                return [q]
+        if recv == "":
+            mi = self.modules.get(caller.module)
+            if mi and attr in mi.defs:
+                return list(mi.defs[attr])
+            if mi and attr in mi.from_imports:
+                target = mi.from_imports[attr].split(".")[-1]
+                cands = self.by_name.get(target, [])
+                if len(cands) == 1:
+                    return list(cands)
+            cands = self.by_name.get(attr, [])
+            if len(cands) == 1:
+                return list(cands)
+            return []
+        hint = self._hint_class(recv)
+        if hint is not None:
+            q = self._class_method(hint, attr)
+            if q:
+                return [q]
+        if attr in COMMON_NAMES:
+            return []
+        cands = self.by_name.get(attr, [])
+        if 1 <= len(cands) <= MAX_NAME_CANDIDATES:
+            return list(cands)
+        return []
+
+    def _class_method(self, cls: str, name: str) -> Optional[str]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            entry = self.classes.get(c)
+            if not entry:
+                continue
+            q = entry["methods"].get(name)
+            if q:
+                return q
+            stack.extend(b.split(".")[-1] for b in entry["bases"])
+        return None
+
+    # -- propagation helpers -------------------------------------------------
+
+    def propagate(self, direct: dict) -> dict:
+        """Generic transitive closure over the call graph.
+
+        ``direct``: qualname → dict payload {token: witness} where witness
+        is ``(line, via_qualname_or_None)``.  Returns the fixed point:
+        each function's payload merged with every callee's, the witness
+        recording WHICH call site imported the token (for path
+        reconstruction in messages)."""
+        out = {q: dict(d) for q, d in direct.items()}
+        for q in self.functions:
+            out.setdefault(q, {})
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.functions.items():
+                mine = out[q]
+                for site in info.calls:
+                    for callee in self.resolve_call(site, info):
+                        if callee == q:
+                            continue
+                        for token in out.get(callee, ()):
+                            if token not in mine:
+                                mine[token] = (site.line, callee)
+                                changed = True
+        return out
+
+    def witness_path(self, closure: dict, qualname: str, token, limit: int = 8) -> str:
+        """Human-readable call chain from ``qualname`` to the function
+        that directly carries ``token``."""
+        parts = [qualname]
+        cur = qualname
+        for _ in range(limit):
+            wit = closure.get(cur, {}).get(token)
+            if wit is None or wit[1] is None:
+                break
+            cur = wit[1]
+            parts.append(cur)
+        return " → ".join(p.split("::")[-1] for p in parts)

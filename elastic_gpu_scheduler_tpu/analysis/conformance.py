@@ -1,0 +1,237 @@
+"""Conformance lints: the operational contracts OPERATIONS.md promises.
+
+- ``conformance-metric-name``        — a registered metric whose name does
+  not follow the ``tpu_*`` scheme (every exported series shares the
+  prefix so fleet dashboards can glob one namespace).
+- ``conformance-metric-undocumented``— a registered metric name absent
+  from OPERATIONS.md (an operator paging through the runbook must be
+  able to find every series /metrics can emit).
+- ``conformance-debug-index``        — a ``/debug/*`` route dispatched by
+  the HTTP server but missing from the ``/debug/`` index page (the index
+  is the discovery surface; an unlisted endpoint is invisible).
+- ``conformance-offlock-mutation``   — a module-level mutable container
+  mutated outside any lock and outside the documented GIL-atomic
+  allowlist.  Plain-list appends/slice-dels ARE GIL-atomic in CPython,
+  but each such site is a load-bearing concurrency argument that must be
+  listed (with its pairing reader) in ``AnalysisConfig.gil_atomic_allowlist``,
+  not discovered in a post-mortem.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import Finding
+from .callgraph import PackageIndex, _dotted
+
+METRIC_CTORS = ("Counter", "Gauge", "Histogram", "LazyGauge")
+MUTATING_METHODS = (
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "add", "discard", "popitem", "appendleft", "popleft",
+)
+
+
+def check_conformance(index: PackageIndex, cfg) -> list:
+    findings: list[Finding] = []
+    findings.extend(_check_metrics(index, cfg))
+    findings.extend(_check_debug_index(index, cfg))
+    findings.extend(_check_offlock_globals(index, cfg))
+    return findings
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def _check_metrics(index: PackageIndex, cfg) -> list:
+    out = []
+    for rel, mi in index.modules.items():
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or dotted.split(".")[-1] not in METRIC_CTORS:
+                continue
+            # only REGISTERED metrics (REGISTRY.register(Ctor(...)) or a
+            # module-level CTOR assignment in a metrics module) are export
+            # surface; ad-hoc local Histograms in tests/tools are not
+            if not _is_registered(mi, node):
+                continue
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if not name.startswith("tpu_"):
+                out.append(Finding(
+                    rule="conformance-metric-name",
+                    file=rel, line=node.lineno,
+                    key=f"conformance-metric-name::{name}",
+                    message=(
+                        f"registered metric {name!r} does not follow the "
+                        "tpu_* naming scheme"
+                    ),
+                ))
+            if cfg.ops_text and name not in cfg.ops_text:
+                out.append(Finding(
+                    rule="conformance-metric-undocumented",
+                    file=rel, line=node.lineno,
+                    key=f"conformance-metric-undocumented::{name}",
+                    message=(
+                        f"registered metric {name!r} is not mentioned in "
+                        "OPERATIONS.md — document every exported series"
+                    ),
+                ))
+    return out
+
+
+def _is_registered(mi, ctor_call: ast.Call) -> bool:
+    """True when the ctor call is the argument of REGISTRY.register(...)."""
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None or not dotted.endswith("register"):
+            continue
+        for a in node.args:
+            if a is ctor_call:
+                return True
+    return False
+
+
+# -- /debug index -----------------------------------------------------------
+
+INDEX_EXEMPT = ("/debug", "/debug/", "/debug/pprof", "/debug/pprof/")
+
+
+def _check_debug_index(index: PackageIndex, cfg) -> list:
+    out = []
+    for rel, mi in index.modules.items():
+        if not rel.endswith("routes.py"):
+            continue
+        index_text = ""
+        for node in ast.walk(mi.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and "<html>" in node.value
+                and "/debug/" in node.value
+            ):
+                index_text += node.value
+        if not index_text:
+            continue
+        endpoints = {}
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Constant):
+                continue
+            v = node.value
+            if not (isinstance(v, str) and v.startswith("/debug/") and len(v) > 7):
+                continue
+            if v in INDEX_EXEMPT:
+                continue
+            endpoints.setdefault(v.rstrip("/"), node.lineno)
+        for ep, line in sorted(endpoints.items()):
+            if ep in INDEX_EXEMPT:
+                continue
+            # boundary match, not substring: "/debug/frag" must not pass
+            # because the index lists "/debug/fragmentation"
+            if not re.search(re.escape(ep) + r"(?![\w-])", index_text):
+                out.append(Finding(
+                    rule="conformance-debug-index",
+                    file=rel, line=line,
+                    key=f"conformance-debug-index::{ep}",
+                    message=(
+                        f"debug endpoint {ep!r} is served but absent from "
+                        "the /debug/ index page — unlisted endpoints are "
+                        "invisible to operators"
+                    ),
+                ))
+    return out
+
+
+# -- off-lock global mutations ----------------------------------------------
+
+
+def _check_offlock_globals(index: PackageIndex, cfg) -> list:
+    out = []
+    allow = set(cfg.gil_atomic_allowlist)
+    for q, info in index.functions.items():
+        mi = index.modules.get(info.module)
+        if mi is None or not mi.mutable_globals:
+            continue
+        for node, held in _walk_with_held(index, info):
+            name = _mutated_global(node, mi.mutable_globals)
+            if name is None:
+                continue
+            if held:
+                continue  # under some lock: the lock is the argument
+            if (info.module, name) in allow or any(
+                info.module.endswith(m) and n == name for m, n in allow
+            ):
+                continue
+            out.append(Finding(
+                rule="conformance-offlock-mutation",
+                file=info.module,
+                line=node.lineno,
+                key=(
+                    f"conformance-offlock-mutation::{info.module}::"
+                    f"{q.split('::')[-1]}::{name}"
+                ),
+                message=(
+                    f"module-level container {name!r} mutated outside any "
+                    "lock — GIL-atomicity-dependent patterns must be listed "
+                    "in the documented allowlist (analysis.AnalysisConfig."
+                    "gil_atomic_allowlist) with their pairing reader"
+                ),
+            ))
+    return out
+
+
+def _walk_with_held(index, info):
+    """Yield (node, held_locks) for every statement-level node in the
+    function, tracking with-lock context."""
+    import ast as _ast
+
+    def visit(node, held):
+        if isinstance(node, (_ast.With, _ast.AsyncWith)):
+            # ANY with-context (even one the resolver can't type) counts
+            # as "locked": this lint is about mutations with no
+            # synchronization in sight
+            ctx = held + [object()]
+            for stmt in node.body:
+                yield from visit(stmt, ctx)
+        elif isinstance(node, (_ast.FunctionDef, _ast.AsyncFunctionDef,
+                               _ast.Lambda, _ast.ClassDef)):
+            return
+        else:
+            yield (node, held)
+            for child in _ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+    for stmt in info.node.body:
+        yield from visit(stmt, [])
+
+
+def _mutated_global(node, mutable_globals) -> str:
+    import ast as _ast
+
+    if isinstance(node, _ast.Call) and isinstance(node.func, _ast.Attribute):
+        if node.func.attr in MUTATING_METHODS and isinstance(
+            node.func.value, _ast.Name
+        ):
+            name = node.func.value.id
+            if name in mutable_globals:
+                return name
+    if isinstance(node, _ast.Delete):
+        for t in node.targets:
+            if isinstance(t, _ast.Subscript) and isinstance(t.value, _ast.Name):
+                if t.value.id in mutable_globals:
+                    return t.value.id
+    if isinstance(node, (_ast.Assign, _ast.AugAssign)):
+        targets = node.targets if isinstance(node, _ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, _ast.Subscript) and isinstance(t.value, _ast.Name):
+                if t.value.id in mutable_globals:
+                    return t.value.id
+    return None

@@ -1,0 +1,398 @@
+"""Journal discipline: emit-site vs replay-dispatch exhaustiveness and the
+allocator-mutation choke-point rules.
+
+The flight recorder's whole value rests on two properties no test can
+prove exhaustively:
+
+1. **Every record type emitted anywhere in the package has a matching
+   handler in the replay engine** — a new record type that replay treats
+   as "unknown" silently drops state from every offline audit.  Emit
+   sites are ``JOURNAL.record("type", ...)`` calls (string literal), the
+   call sites of thin wrappers that forward a parameter into
+   ``JOURNAL.record`` (``_journal_event``-style), and literal
+   ``{"type": "..."}`` dicts inside the journal package itself (the
+   checkpoint writer bypasses ``record()``).  Handler sets are the
+   string constants ``replay()`` / ``what_if()`` compare the record type
+   against.  Rules:
+   - ``journal-unhandled-type``   — emitted, no ``replay()`` handler.
+   - ``journal-whatif-unhandled`` — emitted, ``what_if()`` neither
+     handles nor explicitly skips it (silent indifference is how the two
+     functions drift; the MAINTENANCE NOTE in replay.py demands the
+     mirror stays conscious).
+   - ``journal-dead-handler``     — ``replay()`` handles a type nothing
+     emits (stale handler, or a mutation path that stopped journaling).
+   - ``journal-dynamic-type``     — a wrapper call site passes a
+     non-literal record type: exhaustiveness can no longer be checked.
+
+2. **Allocator mutations happen only inside the journaling perimeter.**
+   - ``journal-setslot-outside-core`` — ``_set_slot``/``_set_total`` (the
+     single packed-state choke point) called outside core/allocator.py +
+     core/chip.py.
+   - ``journal-unjournaled-mutation`` — a live ``NodeAllocator``
+     mutation (``na.allocate/forget/add/refresh_from_node``) from a
+     function that neither journals (directly or via a wrapper) nor is
+     reachable only through journaling callers.  Clone-context ChipSet
+     ``transact``/``cancel`` is exempt when the function visibly builds
+     clones (``.clone()`` in its body) or lives in a core/replay module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from . import Finding
+from .callgraph import PackageIndex, _dotted
+
+MUTATION_ATTRS = ("allocate", "forget", "add", "refresh_from_node")
+NA_RECEIVERS = ("na", "allocator", "nalloc")
+CHIPSET_MUT_ATTRS = ("transact", "cancel")
+CHIPSET_RECEIVERS = ("cs", "chips", "cs_to", "cs_from", "chipset")
+CLONE_RECEIVERS = ("scratch", "clone", "clones", "sim", "dest")
+
+
+def _is_journal_record(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "record"
+        and _dotted(f.value) is not None
+        and _dotted(f.value).split(".")[-1] == "JOURNAL"
+    )
+
+
+def check_journal(index: PackageIndex, cfg) -> list:
+    findings: list[Finding] = []
+
+    emitted: dict[str, tuple] = {}     # type → (module, line)
+    # wrapper function name → (positional index of type_ incl. self,
+    # parameter name, defined-as-method)
+    wrappers: dict[str, tuple] = {}
+    dynamic_sites: list[tuple] = []    # (module, line, qualname, wrapper)
+
+    # pass 1: direct emit sites + wrapper definitions
+    for q, info in index.functions.items():
+        params = [a.arg for a in info.node.args.args]
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Call) and _is_journal_record(node)):
+                continue
+            if not node.args:
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                emitted.setdefault(a0.value, (info.module, node.lineno))
+            elif isinstance(a0, ast.Name) and a0.id in params:
+                wrappers[info.name] = (
+                    params.index(a0.id), a0.id, info.cls is not None
+                )
+            else:
+                dynamic_sites.append(
+                    (info.module, node.lineno, q, "JOURNAL.record")
+                )
+
+    # pass 2: wrapper call sites contribute their literal types.  A site
+    # the scan cannot resolve (keyword mismatch, out-of-range, computed
+    # value) is flagged journal-dynamic-type, NEVER skipped — a silently
+    # uncounted emit site is exactly the hole this pass exists to close.
+    for q, info in index.functions.items():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname not in wrappers:
+                continue
+            pos, pname, is_method = wrappers[fname]
+            # self-forwarding inside the wrapper itself is pass 1's site
+            if info.name == fname:
+                continue
+            a = None
+            for kw in node.keywords:  # keyword-style: _journal_event(type_="x")
+                if kw.arg == pname:
+                    a = kw.value
+                    break
+            if a is None:
+                arg_pos = pos
+                if is_method and isinstance(node.func, ast.Attribute):
+                    arg_pos = pos - 1  # 'self' is implicit at a bound call
+                if 0 <= arg_pos < len(node.args):
+                    a = node.args[arg_pos]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                emitted.setdefault(a.value, (info.module, node.lineno))
+            else:
+                dynamic_sites.append((info.module, node.lineno, q, fname))
+
+    # pass 3: literal {"type": "..."} dicts inside the journal package
+    for rel, mi in index.modules.items():
+        if "journal/" not in rel and not rel.startswith("journal"):
+            continue
+        if rel.endswith(cfg.replay_module):
+            continue  # replay builds nothing it emits
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant) and k.value == "type"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        emitted.setdefault(v.value, (rel, node.lineno))
+
+    # handler sets from the replay module
+    replay_mod = None
+    for rel in index.modules:
+        if rel.endswith(cfg.replay_module):
+            replay_mod = rel
+            break
+    replay_handled = _handled_types(index, replay_mod, "replay")
+    whatif_handled = _handled_types(index, replay_mod, "what_if")
+
+    if replay_mod is not None:
+        for t, (mod, line) in sorted(emitted.items()):
+            if t not in replay_handled:
+                findings.append(Finding(
+                    rule="journal-unhandled-type",
+                    file=mod, line=line,
+                    key=f"journal-unhandled-type::{t}",
+                    message=(
+                        f"journal record type {t!r} is emitted here but "
+                        f"{replay_mod}::replay() has no handler for it — "
+                        "a new record type must never silently skip replay"
+                    ),
+                ))
+            if whatif_handled and t not in whatif_handled:
+                findings.append(Finding(
+                    rule="journal-whatif-unhandled",
+                    file=mod, line=line,
+                    key=f"journal-whatif-unhandled::{t}",
+                    message=(
+                        f"journal record type {t!r} is emitted here but "
+                        f"{replay_mod}::what_if() neither handles nor "
+                        "explicitly skips it (add it to a handler or the "
+                        "skip tuple — the replay mirror must stay conscious)"
+                    ),
+                ))
+        for t in sorted(replay_handled - set(emitted)):
+            if t in cfg.dead_handler_allow:
+                continue
+            findings.append(Finding(
+                rule="journal-dead-handler",
+                file=replay_mod, line=0,
+                key=f"journal-dead-handler::{t}",
+                message=(
+                    f"replay() handles record type {t!r} but nothing in the "
+                    "package emits it — stale handler, or a mutation path "
+                    "that stopped journaling"
+                ),
+            ))
+
+    for mod, line, q, wrapper in dynamic_sites:
+        findings.append(Finding(
+            rule="journal-dynamic-type",
+            file=mod, line=line,
+            key=f"journal-dynamic-type::{mod}::{q.split('::')[-1]}::{wrapper}",
+            message=(
+                f"{wrapper}() is passed a non-literal record type — "
+                "emit/replay exhaustiveness cannot be checked for this site"
+            ),
+        ))
+
+    # -- choke-point rules -------------------------------------------------
+    journaling = _journaling_functions(index, wrappers)
+
+    for q, info in index.functions.items():
+        in_exempt = any(
+            info.module.endswith(m) for m in cfg.journal_exempt_modules
+        )
+        in_setslot_mod = any(
+            info.module.endswith(m) for m in cfg.setslot_modules
+        )
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in ("_set_slot", "_set_total") and not in_setslot_mod:
+                findings.append(Finding(
+                    rule="journal-setslot-outside-core",
+                    file=info.module, line=node.lineno,
+                    key=(
+                        f"journal-setslot-outside-core::{info.module}::"
+                        f"{q.split('::')[-1]}"
+                    ),
+                    message=(
+                        f"direct {attr}() call outside the ChipSet choke "
+                        f"modules ({', '.join(cfg.setslot_modules)}) — all "
+                        "packed-state writes must flow through ChipSet/"
+                        "ChipRef so journaled commit points see them"
+                    ),
+                ))
+                continue
+            if in_exempt or in_setslot_mod or info.module.endswith("core/node.py"):
+                continue
+            recv = _recv_of(node.func.value)
+            is_na_mut = attr in MUTATION_ATTRS and _looks_na(recv)
+            is_cs_mut = (
+                attr in CHIPSET_MUT_ATTRS
+                and _looks_chipset(recv)
+                and not info.has_clone_call
+                and recv not in CLONE_RECEIVERS
+                and recv not in _clone_locals(info)
+            )
+            if not (is_na_mut or is_cs_mut):
+                continue
+            if q in journaling:
+                continue
+            findings.append(Finding(
+                rule="journal-unjournaled-mutation",
+                file=info.module, line=node.lineno,
+                key=(
+                    f"journal-unjournaled-mutation::{info.module}::"
+                    f"{q.split('::')[-1]}::{recv}.{attr}"
+                ),
+                message=(
+                    f"live allocator mutation {recv}.{attr}() in a function "
+                    "that never journals — every mutation must be reachable "
+                    "only through a journaling choke point (JOURNAL.record "
+                    "or a _journal_* wrapper in the same function)"
+                ),
+            ))
+    return findings
+
+
+def _recv_of(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _recv_of(node.value)
+    return ""
+
+
+def _looks_na(recv: str) -> bool:
+    return recv in NA_RECEIVERS or recv.startswith("na_")
+
+
+def _looks_chipset(recv: str) -> bool:
+    return recv in CHIPSET_RECEIVERS
+
+
+def _clone_locals(info) -> set:
+    """Local names visibly bound to cloned chip state: assigned from a
+    call whose name mentions 'clone' (``get_clone``/``_clone_ctx``/…) or
+    from a subscript of a clone container.  Mutating a clone is planning,
+    not a live allocator commit."""
+    out = getattr(info, "_clone_locals", None)
+    if out is not None:
+        return out
+    out = set()
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        src = None
+        if isinstance(v, ast.Call):
+            f = v.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else ""
+            )
+            if "clone" in fname:
+                src = True
+        elif isinstance(v, ast.Subscript):
+            base = _recv_of(v.value)
+            if base in CLONE_RECEIVERS:
+                src = True
+        if src:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            out.add(e.id)
+    info._clone_locals = out
+    return out
+
+
+def _journaling_functions(index: PackageIndex, wrappers: dict) -> set:
+    """Functions inside the journaling perimeter: a direct JOURNAL.record
+    call, or a call (by name) to a function that itself emits — the
+    ``_journal_event``/``_journal_migrate``/``_journal_resize`` wrapper
+    pattern, whether or not the wrapper forwards a type parameter."""
+    direct = set()
+    for q, info in index.functions.items():
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) and _is_journal_record(node):
+                direct.add(q)
+                break
+    emitter_names = {q.split("::")[-1].split(".")[-1] for q in direct}
+    emitter_names.update(wrappers)
+    out = set(direct)
+    for q, info in index.functions.items():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname in emitter_names:
+                out.add(q)
+                break
+    return out
+
+
+def _handled_types(index: PackageIndex, replay_mod: Optional[str], func: str) -> set:
+    """String constants the named function compares a record's type
+    against (``t == "x"``, ``t in ("a", "b")``)."""
+    if replay_mod is None:
+        return set()
+    info = index.functions.get(f"{replay_mod}::{func}")
+    if info is None:
+        return set()
+    # the dispatch variable: any name assigned from rec.get("type") /
+    # rec["type"] — only comparisons against THAT name count (the replay
+    # body compares plenty of other strings)
+    type_vars = set()
+    for node in ast.walk(info.node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        v = node.value
+        if (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "get"
+            and v.args
+            and isinstance(v.args[0], ast.Constant)
+            and v.args[0].value == "type"
+        ) or (
+            isinstance(v, ast.Subscript)
+            and isinstance(v.slice, ast.Constant)
+            and v.slice.value == "type"
+        ):
+            type_vars.add(tgt.id)
+    out = set()
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name) and node.left.id in type_vars):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+                    out.add(comp.value)
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for elt in comp.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            out.add(elt.value)
+    return out

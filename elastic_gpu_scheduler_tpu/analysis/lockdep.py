@@ -1,0 +1,263 @@
+"""Static lockdep: the rank rules of ``metrics.TimedLock``, checked over
+every path the call graph can see instead of only the paths tests run.
+
+Rules:
+
+- ``lockdep-inversion``        — a function acquires rank r while the
+  lexical with-context already holds rank ≥ r (direct inversion), or a
+  call made under held rank R can transitively reach an indefinite
+  blocking acquire of rank ≤ R.  Same-lock reentrant re-acquires are
+  exempt (the runtime owner check allows them); try-locks and
+  timeout-bounded acquires were already dropped at scan time.
+- ``lockdep-finalizer``        — a GC finalizer root (``weakref.finalize``
+  callback, ``__del__``) can reach ANY lock acquisition, ranked or plain.
+  A finalizer runs on whatever thread triggers collection — possibly one
+  already inside that very lock.
+- ``lockdep-blocking``         — a blocking primitive (HTTP, fsync,
+  subprocess, sleep, socket connect, jax compile/dispatch) is reachable
+  while a control-plane rank ≤ 20 is held (gang/resize/defrag/engine:
+  the locks every verb queues on).  Node locks (rank 30) are leaf locks
+  around pure chip math and are exempt by the rule's definition.
+"""
+
+from __future__ import annotations
+
+from . import Finding
+from .callgraph import PackageIndex
+
+ENGINE_RANK_CEILING = 20
+
+
+def _acq_token(acq):
+    return ("acq", acq.lock.key)
+
+
+def check_lockdep(index: PackageIndex, cfg) -> list:
+    findings: list[Finding] = []
+
+    # direct payloads for propagation -------------------------------------
+    direct_acquires = {}   # qualname → {("acq", key): (line, None)}
+    direct_blocking = {}   # qualname → {("blk", label): (line, None)}
+    for q, info in index.functions.items():
+        acc = {}
+        for acq in info.acquires:
+            tok = _acq_token(acq)
+            if tok not in acc:
+                acc[tok] = (acq.line, None)
+        if acc:
+            direct_acquires[q] = acc
+        blk = {}
+        for label, line, _held in info.blocking:
+            tok = ("blk", label)
+            if tok not in blk:
+                blk[tok] = (line, None)
+        if blk:
+            direct_blocking[q] = blk
+
+    may_acquire = index.propagate(direct_acquires)
+    may_block = index.propagate(direct_blocking)
+
+    lock_by_key = {}
+    for ld in list(index.class_locks.values()) + list(index.module_locks.values()):
+        lock_by_key[ld.key] = ld
+
+    # -- rule 1: inversions ------------------------------------------------
+    # direct with-inside-with nesting within one function body
+    for q, info in index.functions.items():
+        _direct_nesting(index, info, findings, lock_by_key)
+
+    # bare .acquire() inside a with-held lock in the same function —
+    # the one direct shape the nesting walk (With items only) and the
+    # call-path rule (other functions' acquires) both miss
+    for q, info in index.functions.items():
+        for acq in info.acquires:
+            if not acq.bare or acq.lock.rank is None:
+                continue
+            for h in acq.held:
+                if h.rank is None:
+                    continue
+                if h.key == acq.lock.key and acq.lock.reentrant:
+                    continue
+                if acq.lock.rank <= h.rank:
+                    findings.append(Finding(
+                        rule="lockdep-inversion",
+                        file=info.module,
+                        line=acq.line,
+                        key=(
+                            f"lockdep-inversion::{info.module}::"
+                            f"{_sym(q)}::{h.key}->{acq.lock.key}"
+                        ),
+                        message=(
+                            f"bare acquire of {acq.lock.lock_name!r} "
+                            f"(rank {acq.lock.rank}) while holding "
+                            f"{h.lock_name!r} (rank {h.rank}) — ranks "
+                            "must strictly increase"
+                        ),
+                    ))
+
+    # call-path inversions
+    for q, info in index.functions.items():
+        for site in info.calls:
+            if not site.held:
+                continue
+            callees = index.resolve_call(site, info)
+            for callee in callees:
+                for tok, wit in may_acquire.get(callee, {}).items():
+                    _, key = tok
+                    tgt = lock_by_key.get(key)
+                    if tgt is None or tgt.rank is None:
+                        continue
+                    for held in site.held:
+                        if held.rank is None:
+                            continue
+                        if held.key == key and tgt.reentrant:
+                            continue
+                        if tgt.rank <= held.rank:
+                            path = index.witness_path(may_acquire, callee, tok)
+                            findings.append(Finding(
+                                rule="lockdep-inversion",
+                                file=info.module,
+                                line=site.line,
+                                key=(
+                                    f"lockdep-inversion::{info.module}::"
+                                    f"{_sym(q)}::{held.key}->{key}"
+                                ),
+                                message=(
+                                    f"call to {site.attr}() while holding "
+                                    f"{held.lock_name!r} (rank {held.rank}) can "
+                                    f"acquire {tgt.lock_name!r} (rank {tgt.rank}) "
+                                    f"via {path} — ranks must strictly increase"
+                                ),
+                            ))
+
+    # -- rule 2: finalizers take no locks ---------------------------------
+    seen_final = set()
+    for q, via, line in index.finalizer_roots:
+        if q in seen_final:
+            continue
+        seen_final.add(q)
+        info = index.functions.get(q)
+        if info is None:
+            continue
+        for tok, wit in may_acquire.get(q, {}).items():
+            _, key = tok
+            tgt = lock_by_key.get(key)
+            path = index.witness_path(may_acquire, q, tok)
+            findings.append(Finding(
+                rule="lockdep-finalizer",
+                file=info.module,
+                line=wit[0] if wit[1] is None else info.line,
+                key=f"lockdep-finalizer::{info.module}::{_sym(q)}::{key}",
+                message=(
+                    f"finalizer {info.name}() (registered via {via}) can "
+                    f"acquire lock {tgt.lock_name if tgt else key!r} via "
+                    f"{path} — finalizers may take no locks (they can run "
+                    "on a thread already inside that lock)"
+                ),
+            ))
+
+    # -- rule 3: no blocking call under a control-plane rank --------------
+    for q, info in index.functions.items():
+        # direct blocking primitive inside a with-block
+        for label, line, held in info.blocking:
+            worst = _worst_control_rank(held)
+            if worst is not None:
+                findings.append(Finding(
+                    rule="lockdep-blocking",
+                    file=info.module,
+                    line=line,
+                    key=(
+                        f"lockdep-blocking::{info.module}::{_sym(q)}::"
+                        f"{worst.key}::{label}"
+                    ),
+                    message=(
+                        f"blocking {label} while holding {worst.lock_name!r} "
+                        f"(rank {worst.rank}) — no blocking calls under a "
+                        f"control-plane lock (rank ≤ {ENGINE_RANK_CEILING})"
+                    ),
+                ))
+        for site in info.calls:
+            worst = _worst_control_rank(site.held)
+            if worst is None:
+                continue
+            for callee in index.resolve_call(site, info):
+                for tok, wit in may_block.get(callee, {}).items():
+                    _, label = tok
+                    path = index.witness_path(may_block, callee, tok)
+                    findings.append(Finding(
+                        rule="lockdep-blocking",
+                        file=info.module,
+                        line=site.line,
+                        key=(
+                            f"lockdep-blocking::{info.module}::{_sym(q)}::"
+                            f"{worst.key}::{label}::{_sym(callee)}"
+                        ),
+                        message=(
+                            f"call to {site.attr}() while holding "
+                            f"{worst.lock_name!r} (rank {worst.rank}) can reach "
+                            f"blocking {label} via {path}"
+                        ),
+                    ))
+    return findings
+
+
+def _direct_nesting(index, info, findings, lock_by_key) -> None:
+    """With-inside-with inversions within one function body."""
+    import ast
+
+    def visit(node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            resolved = []
+            for item in node.items:
+                ld = index.resolve_lock(
+                    item.context_expr, info.module, info.cls
+                )
+                if ld is not None:
+                    for h in held + resolved:
+                        if h.rank is None or ld.rank is None:
+                            continue
+                        if h.key == ld.key and ld.reentrant:
+                            continue
+                        if ld.rank <= h.rank:
+                            findings.append(Finding(
+                                rule="lockdep-inversion",
+                                file=info.module,
+                                line=item.context_expr.lineno,
+                                key=(
+                                    f"lockdep-inversion::{info.module}::"
+                                    f"{_sym(info.qualname)}::"
+                                    f"{h.key}->{ld.key}"
+                                ),
+                                message=(
+                                    f"acquires {ld.lock_name!r} (rank "
+                                    f"{ld.rank}) while holding "
+                                    f"{h.lock_name!r} (rank {h.rank}) — "
+                                    "ranks must strictly increase"
+                                ),
+                            ))
+                    resolved.append(ld)
+            for stmt in node.body:
+                visit(stmt, held + resolved)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda, ast.ClassDef)):
+            return  # nested scopes analyzed separately
+        else:
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+    for stmt in info.node.body:
+        visit(stmt, [])
+
+
+def _worst_control_rank(held):
+    worst = None
+    for ld in held:
+        if ld.rank is None or ld.rank > ENGINE_RANK_CEILING:
+            continue
+        if worst is None or ld.rank > worst.rank:
+            worst = ld
+    return worst
+
+
+def _sym(qualname: str) -> str:
+    return qualname.split("::")[-1]

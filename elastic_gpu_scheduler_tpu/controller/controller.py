@@ -159,6 +159,7 @@ class Controller:
         while not self._stop.wait(self.resync_period):
             self._enqueue_all()
             self._prune_cordons()
+            self._prune_vanished_nodes()
 
     def _prune_cordons(self) -> None:
         """Expire stale defrag cordons (the safety net for a planner
@@ -174,6 +175,53 @@ class Controller:
                 sched.prune_cordons()
             except Exception:
                 pass
+
+    def _prune_vanished_nodes(self) -> None:
+        """Drop allocators for nodes the cluster no longer lists
+        (decommissioned/renamed hardware).  Without this the registry —
+        and every journal checkpoint snapshotting it — leaked each dead
+        node forever, and replay's ``node_remove`` handler had no live
+        emitter.  ``remove_node`` journals the removal and refuses while
+        ledger pods still charge the node (their forgets must land
+        first), so a node with a lost DELETE event drains naturally over
+        successive resyncs."""
+        # snapshot every registry BEFORE the node listing: an allocator
+        # materialized for a node that joins the cluster AFTER
+        # list_nodes() returns must never land in the prune set (it
+        # would be removed as "vanished" while perfectly alive).  An
+        # allocator in the pre-listing snapshot whose node is absent
+        # from the post-snapshot listing really is gone.
+        snapshots: list[tuple] = []
+        seen: list[int] = []
+        for sched in self.registry.values():
+            if id(sched) in seen:
+                continue
+            seen.append(id(sched))
+            remove = getattr(sched, "remove_node", None)
+            if remove is None:
+                continue
+            try:
+                with sched.lock:
+                    snapshots.append((remove, list(sched.allocators)))
+            except Exception:
+                log.exception("vanished-node prune failed")
+        try:
+            live = {n.metadata.name for n in self.cluster.list_nodes()}
+        except Exception as e:
+            log.warning("resync node list failed: %s", e)
+            return
+        if not live:
+            # an empty listing is far more likely an API failure than a
+            # nodeless cluster; removing every idle allocator on a blip
+            # would churn node_add/node_remove records
+            return
+        for remove, known in snapshots:
+            for name in known:
+                if name not in live:
+                    try:
+                        remove(name)
+                    except Exception:
+                        log.exception("vanished-node prune failed")
 
     def _enqueue_all(self) -> None:
         try:

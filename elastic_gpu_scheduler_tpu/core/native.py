@@ -37,15 +37,18 @@ def _source_path() -> str:
     return os.path.join(repo, "native", "placement.cc")
 
 
-def build(force: bool = False) -> str | None:
-    """Compile the extension; returns the .so path or None on failure."""
+def _compile(so_name: str, extra_flags: list, force: bool, timeout: float) -> str | None:
+    """Shared compile path for the production and sanitized variants —
+    ONE place owns the mtime-freshness check, suffix/include discovery,
+    and failure handling, so a fix to either never desynchronizes the
+    CI sanitizer build from the production one."""
     src = _source_path()
     if not os.path.exists(src):
         return None
     out_dir = _build_dir()
     os.makedirs(out_dir, exist_ok=True)
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so = os.path.join(out_dir, f"_placement{suffix}")
+    so = os.path.join(out_dir, f"{so_name}{suffix}")
     if (
         not force
         and os.path.exists(so)
@@ -53,16 +56,62 @@ def build(force: bool = False) -> str | None:
     ):
         return so
     include = sysconfig.get_paths()["include"]
+    # compile to a per-pid temp path and rename into place: the warm
+    # thread makes every stack-building process race this build on a
+    # fresh checkout, and a sibling dlopening a partially-written .so
+    # would pin itself to the Python fallback for its whole lifetime.
+    # rename is atomic on the same filesystem (the compilecache
+    # subsystem's entry-write discipline, applied here)
+    tmp = os.path.join(out_dir, f".{so_name}.{os.getpid()}.tmp{suffix}")
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        f"-I{include}", src, "-o", so,
+        "g++", *extra_flags, "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", tmp,
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, so)
         return so
     except Exception as e:  # missing toolchain, etc. → Python fallback
-        log.debug("native placement build failed: %s", e)
+        log.debug("native placement build failed (%s): %s", so_name, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the extension; returns the .so path or None on failure."""
+    return _compile("_placement", ["-O2"], force, timeout=120)
+
+
+def build_sanitized(force: bool = False) -> str | None:
+    """Compile placement.cc with ASan+UBSan into a SEPARATE extension
+    (``_placement_san``).  The differential fuzz gate
+    (tools/check_native_san.py, ``make check-native-san``) loads it in a
+    child process with libasan LD_PRELOADed and hammers
+    plan_gang/plan_gang_batch against the Python fallback — memory
+    errors and UB abort the child, parity breaks fail the diff.  Never
+    loaded by the scheduler itself."""
+    return _compile(
+        "_placement_san",
+        ["-O1", "-g", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=all", "-fno-omit-frame-pointer"],
+        force, timeout=240,
+    )
+
+
+def sanitizer_preload() -> str | None:
+    """Path to libasan.so for LD_PRELOAD (ASan must be the first loaded
+    runtime when the instrumented code lives in a dlopen()ed extension)."""
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, timeout=30, check=True,
+        ).stdout.decode().strip()
+    except Exception:
+        return None
+    return out if out and os.path.sep in out and os.path.exists(out) else None
 
 
 def get_placement():

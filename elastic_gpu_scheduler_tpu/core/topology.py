@@ -271,3 +271,29 @@ def is_contiguous(coords: Sequence[Coord], topo: Topology) -> bool:
                 seen.add(n)
                 frontier.append(n)
     return len(seen) == len(cs)
+
+
+def reference_free_boxes(topo: Topology, free_set, count: int, max_out: int):
+    """Deliberately-NAIVE reference enumeration of fully-free contiguous
+    boxes: the canonical compact-first candidate stream
+    (``box_shapes`` × ``placements``) filtered by the free mask, deduped,
+    truncated at ``max_out`` — each result a frozenset of coords.
+
+    This is the parity ORACLE for the native kernel and its Python
+    fallback.  tests/test_native.py and tools/check_native_san.py both
+    assert bit-identical results against this ONE definition, so a
+    change to the enumeration contract reaches the curated tests and
+    the sanitizer fuzz gate together, never one of them."""
+    out: list = []
+    seen: set = set()
+    for shape in topo.box_shapes(count):
+        for box in topo.placements(shape):
+            if len(out) >= max_out:
+                return out
+            if all(c in free_set for c in box):
+                key = frozenset(box)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(key)
+    return out

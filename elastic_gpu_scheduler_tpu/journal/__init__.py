@@ -14,9 +14,15 @@ stack:
   the commit boundaries above ``ChipSet._set_slot`` (the scheduler's
   bind commit / ledger write, ``forget_pod``, ``add_pod``/startup
   replay, allocator creation and capacity resync, gang admit and
-  rollback, and the defrag planner's ``migrate`` evict→rebind
+  rollback, the defrag planner's ``migrate`` evict→rebind
   transactions — replay verifies a migration conserves the pod's
-  per-container chip demand).  Each record carries the pod's
+  per-container chip demand — and ``node_remove`` when the
+  reconciliation controller drops a node the cluster no longer lists;
+  the live removal refuses while ledger pods still charge the node, so
+  replay treats an occupied removal as a conservation violation).
+  Emit-site vs replay-handler exhaustiveness is checked statically:
+  a record type emitted anywhere without a ``journal/replay.py``
+  handler fails ``make check-analysis``.  Each record carries the pod's
   ``trace_id`` so journal entries cross-link to ``/traces``, plus the
   node's fragmentation snapshot at the checkpoint (the gauges' source
   of truth).  The profile observatory (``profile/``) additionally lands

@@ -452,8 +452,23 @@ def replay(events: list[dict]) -> ReplayResult:
                     f"member(s) still journaled as bound: {bound[:4]}"
                 )
         elif t == "node_remove":
+            # the live remove_node refuses while ledger pods still charge
+            # the node, so a journal recording a removal with live pods on
+            # it witnesses a conservation break (capacity vaporized with
+            # its charges)
             node = rec.get("node")
+            still = [
+                pk for pk, lp in res.pods.items()
+                if lp.node == node and lp.charged
+            ]
+            if still:
+                res.violations.append(
+                    f"{where}: node_remove of {node} with {len(still)} "
+                    f"live pod(s) still charging it: {still[:4]} — "
+                    "capacity removed out from under its charges"
+                )
             res.nodes.pop(node, None)
+            res.generations.pop(node, None)
         elif t == "profile":
             # workload-profile snapshot (profile/ observatory): an
             # ANNOTATION in the mutation stream — it participates in the
@@ -773,14 +788,30 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             if observe_profile is not None:
                 observe_profile(rec)
             continue
-        if t in ("fleet", "resize", "policy", "policy_fault", "warmup"):
+        if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
+                 "gang_admit", "gang_rollback"):
             # annotations (autoscaler evaluations / resize summaries /
-            # policy-plane events / compile warm-ups): the member
-            # binds/forgets/migrates around a resize carry the state
-            # changes; scoring a scaling POLICY offline is
-            # fleet.autoscaler.score_policy's job, and the policy
-            # plane's own decision trail must not perturb a what-if
-            # re-run that may itself be gating a policy
+            # policy-plane events / compile warm-ups / gang admit+rollback
+            # markers): the member binds/forgets/migrates around a
+            # resize or gang commit carry the state changes; scoring a
+            # scaling POLICY offline is fleet.autoscaler.score_policy's
+            # job, the policy plane's own decision trail must not
+            # perturb a what-if re-run that may itself be gating a
+            # policy, and gang markers are verified by replay()'s
+            # all-or-nothing audit, not re-placed here
+            continue
+        if t == "node_remove":
+            # mirrors replay(): the live remove refuses while pods still
+            # charge the node, so dropping it (and any what-if placement
+            # stranded there by a policy that placed where the recorded
+            # stream did not) keeps the streams consistent
+            node = rec.get("node")
+            for pk in [p for p, (n, _o) in placed.items() if n == node]:
+                placed.pop(pk)
+            nodes.pop(node, None)
+            gens.pop(node, None)
+            _free_resync(node)
+            _total_resync(node)
             continue
         if t in ("node_add", "node_resync"):
             try:

@@ -271,6 +271,13 @@ class RestClusterView:
     def get_pod(self, namespace, name):
         return self.rest.get_pod(namespace, name)
 
+    def list_nodes(self):
+        # the controller's vanished-node prune (journaled node_remove)
+        # needs the node listing through the SAME view surface
+        # FakeCluster provides — without this delegation the prune only
+        # ever ran in tests
+        return self.rest.list_nodes()
+
     # -- streaming watch -----------------------------------------------------
 
     def watch_pods(self):

@@ -79,6 +79,13 @@ class FakeCluster:
             node.metadata.resource_version = self._next_rv()
             self._nodes[node.metadata.name] = node.clone()
 
+    def remove_node(self, name: str) -> None:
+        """Decommission a node (it stops appearing in list_nodes; the
+        controller's resync then prunes its allocator via the journaled
+        remove_node path)."""
+        with self._lock:
+            self._nodes.pop(name, None)
+
     def get_node(self, name: str) -> Node:
         with self._lock:
             n = self._nodes.get(name)

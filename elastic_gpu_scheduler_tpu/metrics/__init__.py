@@ -25,14 +25,21 @@ _WAITS_CAP = 20000
 # Lock-ordering enforcement (VERDICT r4 #9): each ranked TimedLock may
 # only be acquired while every lock this thread already holds has a
 # STRICTLY LOWER rank.  The codebase's documented hierarchy:
-#     gang coordinator (10)  →  defrag planner (15)  →
-#     scheduler engine (20)  →  per-node allocator locks (30)
-# (per-gang condition vars sit below 10; the defrag planner lock
-# serializes migration rounds and may be held while taking engine/node
-# locks — the gang filter only calls the planner AFTER releasing its
-# own lock.)  An
+#     gang coordinator (10)  →  gang resizer (14)  →
+#     defrag planner (15)  →  scheduler engine (20)  →
+#     per-node allocator locks (30)
+# (per-gang condition vars sit below 10; the resize lock —
+# fleet/resize.py — serializes whole membership transactions and takes
+# engine/node locks and the defrag planner's run_round inside them; the
+# defrag planner lock serializes migration rounds and may be held while
+# taking engine/node locks — the gang filter only calls the planner
+# AFTER releasing its own lock, and resize/defrag never nest in the
+# other order.)  An
 # inversion raises immediately: it is a deadlock that hasn't happened
-# yet, and the GIL hides it from every stress test.
+# yet, and the GIL hides it from every stress test.  The static
+# analysis plane (analysis/lockdep.py, `make check-analysis`) checks
+# the same rule over the whole call graph, including paths no test
+# executes.
 _HELD_RANKS = threading.local()
 
 
@@ -92,6 +99,12 @@ class Counter:
         use this so vanished labels don't linger at stale values)."""
         with self._lock:
             self._values.clear()
+
+    def remove(self, *labels: str) -> None:
+        """Drop ONE label series (a removed node must not keep exporting
+        a stale per-node gauge)."""
+        with self._lock:
+            self._values.pop(labels, None)
 
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"

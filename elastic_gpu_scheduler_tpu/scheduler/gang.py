@@ -193,6 +193,22 @@ class GangCoordinator:
         )
         # pod key → last commit duration (post-barrier); benchmark telemetry
         self.commit_secs: dict[str, float] = {}
+        # Backstop warm of the native placement kernel for stacks built
+        # WITHOUT cli.build_stack (tests, embedded executors): the cli
+        # path already warms get_placement() synchronously before
+        # constructing this coordinator (a deliberate
+        # compile-before-serving readiness choice, cli.py), which makes
+        # this thread a memoized no-op there.  For direct constructions
+        # the first plan_gang call used to pay the g++ fork (~120s cold)
+        # while HOLDING the gang lock — the static lockdep pass
+        # (analysis/) flagged the path.  Daemon thread so construction
+        # never stalls; a plan arriving mid-warm parks on the build's
+        # own unranked lock exactly as it did pre-warm.
+        from ..core.native import get_placement
+
+        threading.Thread(
+            target=get_placement, name="native-warm", daemon=True
+        ).start()
         # optional DefragPlanner (defrag/): when set and in auto mode, an
         # infeasible gang plan triggers one defrag round and ONE filter
         # retry (the admission-retry path).  None = a single attribute

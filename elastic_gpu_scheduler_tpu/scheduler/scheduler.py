@@ -633,6 +633,16 @@ class TPUUnitScheduler(ResourceScheduler):
             # controller callback can race a forget in this window)
             opt = na.allocate(request, rater)
             with self.lock:
+                if self.allocators.get(node_name) is not na:
+                    # the node was pruned (remove_node: it vanished from
+                    # the cluster) between the off-lock fetch and this
+                    # commit — committing would charge a zombie allocator
+                    # and journal a bind AFTER the node_remove.  Free the
+                    # orphan charge and refuse; kube-scheduler retries.
+                    na.forget(opt)
+                    raise RuntimeError(
+                        f"bind: node {node_name} was removed mid-bind"
+                    )
                 self.pod_maps[pod.key] = (node_name, opt)
                 self.released_pods.pop(pod.key, None)
                 # journal at the COMMIT point, not after the API writes:
@@ -931,6 +941,9 @@ class TPUUnitScheduler(ResourceScheduler):
         with TRACER.span(
             "sched.migrate", pod=pod.key, src=from_node, dst=to_node,
         ) as sp:
+            # cold-build off the engine lock (see gang_allocate); the
+            # plan-staleness check below revalidates under the lock
+            na_to = self._get_allocator(to_node)
             with self.lock:
                 entry = self.pod_maps.get(pod.key)
                 if (
@@ -942,12 +955,18 @@ class TPUUnitScheduler(ResourceScheduler):
                         f"migrate {pod.key}: plan stale (live placement "
                         "changed since planning)"
                     )
-                na_to = self._get_allocator(to_node)
                 na_from = self.allocators.get(from_node)
                 if na_to is None or na_from is None:
                     raise RuntimeError(
                         f"migrate {pod.key}: allocator missing for "
                         f"{from_node if na_from is None else to_node}"
+                    )
+                if self.allocators.get(to_node) is not na_to:
+                    # destination pruned (remove_node) since the off-lock
+                    # fetch: charging it would journal onto a removed node
+                    raise RuntimeError(
+                        f"migrate {pod.key}: node {to_node} was removed "
+                        "mid-commit"
                     )
                 na_to.add(new_opt)  # validating transact: raises if taken
                 na_from.forget(old_opt)
@@ -963,6 +982,7 @@ class TPUUnitScheduler(ResourceScheduler):
             except Exception:
                 # reverse in memory + journal the compensation, so the
                 # durable ledger (still from_node/old) and memory agree
+                ledger_skew = False
                 with self.lock:
                     entry = self.pod_maps.get(pod.key)
                     if entry is not None and entry[0] == to_node:
@@ -973,13 +993,10 @@ class TPUUnitScheduler(ResourceScheduler):
                             # via a filterless bind racing the cordon):
                             # keep the new placement in memory and flag it
                             # LOUDLY — the ledger now disagrees until the
-                            # next annotation write succeeds
-                            self._record_event(
-                                pod, "Warning", "MigrationLedgerSkew",
-                                f"migration {from_node}->{to_node} could "
-                                "not roll back (source chips taken); "
-                                "annotations are stale",
-                            )
+                            # next annotation write succeeds.  The k8s
+                            # Event write is HTTP; it happens after the
+                            # lock releases
+                            ledger_skew = True
                         else:
                             na_to.forget(new_opt)
                             self.pod_maps[pod.key] = (from_node, old_opt)
@@ -989,6 +1006,13 @@ class TPUUnitScheduler(ResourceScheduler):
                                 pod, to_node, from_node, new_opt, old_opt,
                                 source="migrate_rollback",
                             )
+                if ledger_skew:
+                    self._record_event(
+                        pod, "Warning", "MigrationLedgerSkew",
+                        f"migration {from_node}->{to_node} could "
+                        "not roll back (source chips taken); "
+                        "annotations are stale",
+                    )
                 raise
             AUDIT.record(
                 pod.key, "migrate", trace_id=sp.trace_id,
@@ -1038,11 +1062,23 @@ class TPUUnitScheduler(ResourceScheduler):
         ``source`` labels the journal record (``gang`` for coordinator
         commits, ``resize`` for live gang-membership grows)."""
         request = request_from_pod(pod)
+        # cold allocator materialization (k8s node fetch + assumed-pod
+        # replay) stays OFF the engine lock — _get_allocator is race-safe
+        # and idempotent, and a cold build under the lock would stall
+        # every concurrent verb on one node's HTTP round-trip
+        na = self._get_allocator(node_name)
         with self.lock:
-            na = self._get_allocator(node_name)
             if na is None:
                 raise RuntimeError(
                     f"gang allocate: node {node_name} has no TPU allocator"
+                )
+            if self.allocators.get(node_name) is not na:
+                # pruned (remove_node) between the off-lock fetch and
+                # this commit — charging the zombie instance would break
+                # the journal's conservation invariant
+                raise RuntimeError(
+                    f"gang allocate: node {node_name} was removed "
+                    "mid-commit"
                 )
             opt = na.allocate(request, self.rater)
             self.pod_maps[pod.key] = (node_name, opt)
@@ -1065,11 +1101,16 @@ class TPUUnitScheduler(ResourceScheduler):
         """Apply a PRE-PLANNED option (validating transact — raises
         ValueError if the placement was taken since planning).  Lets a gang
         commit skip the per-member trade DFS."""
+        # cold-build off the engine lock (see gang_allocate)
+        na = self._get_allocator(node_name)
         with self.lock:
-            na = self._get_allocator(node_name)
             if na is None:
                 raise RuntimeError(
                     f"gang apply: node {node_name} has no TPU allocator"
+                )
+            if self.allocators.get(node_name) is not na:
+                raise RuntimeError(
+                    f"gang apply: node {node_name} was removed mid-commit"
                 )
             na.add(opt)
             self.pod_maps[pod.key] = (node_name, opt)
@@ -1399,14 +1440,20 @@ class TPUUnitScheduler(ResourceScheduler):
         node_name = assigned_node(pod)
         if not node_name:
             return
+        if pod.key in self.pod_maps:  # GIL-atomic fast path; re-checked
+            return                    # under the lock below
+        # cold-build off the engine lock (see gang_allocate)
+        na = self._get_allocator(node_name)
+        if na is None:
+            return
         with self.lock:
+            # _get_allocator may already have replayed this pod, or a
+            # racing add_pod may have won
             if pod.key in self.pod_maps:
                 return
-            na = self._get_allocator(node_name)
-            if na is None:
-                return
-            # _get_allocator may already have replayed this pod
-            if pod.key in self.pod_maps:
+            if self.allocators.get(node_name) is not na:
+                # pruned (remove_node) since the off-lock fetch; if the
+                # node truly exists the next resync re-learns the pod
                 return
             opt = option_from_pod(pod, na.chips.topo)
             if opt is None:
@@ -1438,6 +1485,49 @@ class TPUUnitScheduler(ResourceScheduler):
             self.released_pods[pod.key] = pod.metadata.uid
             while len(self.released_pods) > self.released_pods_max:
                 self.released_pods.pop(next(iter(self.released_pods)))
+
+    def remove_node(self, node_name: str, source: str = "resync") -> bool:
+        """Drop a node whose Node object vanished from the cluster (the
+        reconciliation controller's resync calls this; before it existed
+        the allocator registry leaked every decommissioned node forever,
+        and journal/replay.py carried a ``node_remove`` handler nothing
+        emitted).  Refuses while any ledger pod still charges the node —
+        capacity leaves only through forget/migrate, so replay can hold
+        its capacity-conservation invariant across the removal.  The
+        ``node_remove`` record is emitted under the engine lock at the
+        commit point, like every allocator mutation.
+
+        The occupancy check is pod_maps-ONLY: in-flight verbs that
+        prefetched this node's allocator off-lock (bind / gang commit /
+        migrate / add_pod) are not visible here, so each of those commit
+        points re-validates registry membership under the lock and backs
+        out if the allocator was pruned in the window — a removal can
+        cost a racing verb one clean retry, never a zombie charge."""
+        with self.lock:
+            na = self.allocators.get(node_name)
+            if na is None:
+                return False
+            if any(n == node_name for n, _opt in self.pod_maps.values()):
+                log.warning(
+                    "remove_node %s: refused — ledger pods still charge "
+                    "it (forget/migrate them first)", node_name,
+                )
+                return False
+            del self.allocators[node_name]
+            self.cordoned.pop(node_name, None)
+            self._frag_cache.pop(node_name, None)
+            if self.index is not None:
+                self.index.drop_node(node_name)
+            CHIPS_ALLOCATED.remove(node_name)
+            FRAG_INDEX.remove(node_name)
+            FREE_SUBMESH.remove(node_name)
+            if JOURNAL.enabled:
+                JOURNAL.record(
+                    "node_remove", node=node_name, source=source
+                )
+        log.info("removed vanished node %s from the allocator registry",
+                 node_name)
+        return True
 
     def known_pod(self, pod: Pod) -> bool:
         with self.lock:

@@ -1,0 +1,579 @@
+"""Invariant-analysis plane (analysis/): fixture modules with KNOWN
+violations per pass, asserting each rule flags exactly the planted
+lines, plus the baseline suppress/un-suppress mechanics and a
+zero-new-findings check over the real package.
+
+Fixtures are synthetic packages written to tmp_path — the passes are
+pure AST (no imports executed), so fixture code never has to run."""
+
+import json
+import textwrap
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.analysis import (
+    AnalysisConfig,
+    default_ops_text,
+    package_root,
+    run_all,
+)
+from elastic_gpu_scheduler_tpu.analysis.baseline import (
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+
+def write_pkg(tmp_path, files: dict) -> str:
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def keys_by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- lockdep ------------------------------------------------------------------
+
+
+def test_lockdep_direct_inversion_flagged(tmp_path):
+    root = write_pkg(tmp_path, {"mod.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.node_lk = TimedLock("node", rank=30)
+                self.gang_lk = TimedLock("gang", rank=10)
+
+            def bad(self):
+                with self.node_lk:
+                    with self.gang_lk:   # inversion: 10 under 30
+                        pass
+
+            def good(self):
+                with self.gang_lk:
+                    with self.node_lk:
+                        pass
+    """})
+    found = keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-inversion")
+    assert len(found) == 1
+    assert found[0].line == 11
+    assert "S.bad" in found[0].key and "good" not in found[0].key
+
+
+def test_lockdep_call_path_inversion_flagged(tmp_path):
+    """The inversion no test executes: f holds 20 and calls g, which
+    acquires 10 two hops down."""
+    root = write_pkg(tmp_path, {"mod.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.sched_lk = TimedLock("sched", rank=20)
+                self.gang_lk = TimedLock("gang", rank=10)
+
+            def f(self):
+                with self.sched_lk:
+                    self.g()
+
+            def g(self):
+                self.h()
+
+            def h(self):
+                with self.gang_lk:
+                    pass
+    """})
+    found = keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-inversion")
+    assert len(found) == 1
+    assert "S.f" in found[0].key
+    assert "S.h" in found[0].message  # the witness path names the acquirer
+
+
+def test_lockdep_bare_acquire_under_with_flagged(tmp_path):
+    """The direct shape neither the With-nesting walk nor the call-path
+    rule sees: a bare .acquire() of a lower rank inside a with-held
+    higher rank, in the same function."""
+    root = write_pkg(tmp_path, {"mod.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.node_lk = TimedLock("node", rank=30)
+                self.gang_lk = TimedLock("gang", rank=10)
+
+            def bad(self):
+                with self.node_lk:
+                    self.gang_lk.acquire()
+
+            def try_is_fine(self):
+                with self.node_lk:
+                    if self.gang_lk.acquire(blocking=False):
+                        self.gang_lk.release()
+    """})
+    found = keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-inversion")
+    assert len(found) == 1
+    assert "S.bad" in found[0].key and "bare acquire" in found[0].message
+
+
+def test_lockdep_reentrant_same_lock_exempt(tmp_path):
+    root = write_pkg(tmp_path, {"mod.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.lk = TimedLock("sched", rank=20, reentrant=True)
+
+            def f(self):
+                with self.lk:
+                    self.g()
+
+            def g(self):
+                with self.lk:
+                    pass
+    """})
+    assert not keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-inversion")
+
+
+def test_lockdep_trylock_exempt(tmp_path):
+    root = write_pkg(tmp_path, {"mod.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.sched_lk = TimedLock("sched", rank=20)
+                self.gang_lk = TimedLock("gang", rank=10)
+
+            def f(self):
+                with self.sched_lk:
+                    if self.gang_lk.acquire(blocking=False):
+                        self.gang_lk.release()
+    """})
+    assert not keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-inversion")
+
+
+def test_lockdep_finalizer_lock_flagged(tmp_path):
+    root = write_pkg(tmp_path, {"mod.py": """
+        import threading
+        import weakref
+
+        _LK = threading.Lock()
+
+        def _finalize_cb(name):
+            with _LK:          # finalizers may take no locks
+                pass
+
+        def _clean(name):
+            return name
+
+        def register(obj):
+            weakref.finalize(obj, _finalize_cb, "x")
+            weakref.finalize(obj, _clean, "y")
+    """})
+    found = keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-finalizer")
+    assert len(found) == 1
+    assert "_finalize_cb" in found[0].key
+
+
+def test_lockdep_blocking_under_engine_lock_flagged(tmp_path):
+    root = write_pkg(tmp_path, {"mod.py": """
+        import urllib.request
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.lk = TimedLock("sched", rank=20)
+                self.node_lk = TimedLock("node", rank=30)
+
+            def bad(self):
+                with self.lk:
+                    self.fetch()
+
+            def node_is_exempt(self):
+                with self.node_lk:   # rank 30 > 20: leaf lock, exempt
+                    self.fetch()
+
+            def fetch(self):
+                return urllib.request.urlopen("http://x/")
+    """})
+    found = keys_by_rule(run_all(root, AnalysisConfig()), "lockdep-blocking")
+    assert len(found) == 1
+    assert "S.bad" in found[0].key and "urlopen" in found[0].message
+
+
+# -- journal discipline -------------------------------------------------------
+
+REPLAY_FIXTURE = """
+    def replay(events):
+        for rec in events:
+            t = rec.get("type")
+            if t == "bind":
+                pass
+            elif t in ("profile", "checkpoint"):
+                pass
+
+    def what_if(events, rater):
+        for rec in events:
+            t = rec.get("type")
+            if t == "bind":
+                pass
+            if t in ("profile", "checkpoint"):
+                continue
+"""
+
+
+def test_journal_unhandled_type_flagged(tmp_path):
+    root = write_pkg(tmp_path, {
+        "journal/replay.py": REPLAY_FIXTURE,
+        "emit.py": """
+            from journal import JOURNAL
+
+            def ok(pod):
+                JOURNAL.record("bind", pod=pod)
+
+            def bad(pod):
+                JOURNAL.record("orphan_type", pod=pod)
+        """,
+    })
+    found = run_all(root, AnalysisConfig())
+    unhandled = keys_by_rule(found, "journal-unhandled-type")
+    assert [f.key for f in unhandled] == [
+        "journal-unhandled-type::orphan_type"
+    ]
+    # and what_if must consciously skip it too
+    assert "journal-whatif-unhandled::orphan_type" in {
+        f.key for f in keys_by_rule(found, "journal-whatif-unhandled")
+    }
+
+
+def test_journal_wrapper_forwarding_resolved(tmp_path):
+    """A _journal_event-style wrapper: literal types at the CALL SITES
+    are what must be handled; a non-literal site is its own finding."""
+    root = write_pkg(tmp_path, {
+        "journal/replay.py": REPLAY_FIXTURE,
+        "emit.py": """
+            from journal import JOURNAL
+
+            class S:
+                def _journal_event(self, type_, pod):
+                    JOURNAL.record(type_, pod=pod)
+
+                def a(self, pod):
+                    self._journal_event("bind", pod)
+
+                def b(self, pod):
+                    self._journal_event("wrapped_orphan", pod)
+
+                def c(self, pod, t):
+                    self._journal_event(t, pod)
+        """,
+    })
+    found = run_all(root, AnalysisConfig())
+    assert "journal-unhandled-type::wrapped_orphan" in {
+        f.key for f in keys_by_rule(found, "journal-unhandled-type")
+    }
+    dyn = keys_by_rule(found, "journal-dynamic-type")
+    assert len(dyn) == 1 and "S.c" in dyn[0].key
+
+
+def test_journal_wrapper_keyword_and_module_calls_resolved(tmp_path):
+    """The blind spots the wrapper scan must NOT have: keyword-style
+    type args resolve like positionals, module-level (unbound) wrappers
+    get no spurious self-shift, and any call the scan can't resolve is
+    flagged dynamic rather than silently uncounted."""
+    root = write_pkg(tmp_path, {
+        "journal/replay.py": REPLAY_FIXTURE,
+        "emit.py": """
+            from journal import JOURNAL
+
+            def mod_wrapper(type_, pod):
+                JOURNAL.record(type_, pod=pod)
+
+            class S:
+                def _journal_event(self, type_, pod):
+                    JOURNAL.record(type_, pod=pod)
+
+                def kw_call(self, pod):
+                    self._journal_event(type_="kw_orphan", pod=pod)
+
+                def kw_unresolvable(self, pod, t):
+                    self._journal_event(pod=pod, type_=t)
+
+            def module_call(pod):
+                mod_wrapper("mod_orphan", pod)
+        """,
+    })
+    found = run_all(root, AnalysisConfig())
+    unhandled = {f.key for f in keys_by_rule(found, "journal-unhandled-type")}
+    assert "journal-unhandled-type::kw_orphan" in unhandled
+    assert "journal-unhandled-type::mod_orphan" in unhandled
+    dyn = keys_by_rule(found, "journal-dynamic-type")
+    assert any("kw_unresolvable" in f.key for f in dyn)
+
+
+def test_debug_index_prefix_is_not_a_listing(tmp_path):
+    """Substring blind spot: an endpoint that is a PREFIX of a listed
+    one is still unlisted."""
+    root = write_pkg(tmp_path, {"server/routes.py": '''
+        _DEBUG_INDEX = """
+        <html>
+        <li>/debug/fragmentation</li>
+        </html>
+        """
+
+        def dispatch(path):
+            if path == "/debug/fragmentation":
+                return 1
+            if path == "/debug/frag":
+                return 2
+    '''})
+    found = keys_by_rule(
+        run_all(root, AnalysisConfig()), "conformance-debug-index"
+    )
+    assert [f.key for f in found] == ["conformance-debug-index::/debug/frag"]
+
+
+def test_journal_dead_handler_flagged(tmp_path):
+    root = write_pkg(tmp_path, {
+        "journal/replay.py": """
+            def replay(events):
+                for rec in events:
+                    t = rec.get("type")
+                    if t == "bind":
+                        pass
+                    elif t == "ghost_type":
+                        pass
+
+            def what_if(events, rater):
+                for rec in events:
+                    t = rec.get("type")
+                    if t == "bind":
+                        pass
+        """,
+        "emit.py": """
+            from journal import JOURNAL
+
+            def ok(pod):
+                JOURNAL.record("bind", pod=pod)
+        """,
+    })
+    found = run_all(root, AnalysisConfig())
+    assert "journal-dead-handler::ghost_type" in {f.key for f in found}
+    # the allow knob (baseline workflow) silences it
+    cfg = AnalysisConfig(dead_handler_allow=("ghost_type",))
+    assert "journal-dead-handler::ghost_type" not in {
+        f.key for f in run_all(root, cfg)
+    }
+
+
+def test_journal_setslot_and_unjournaled_mutation(tmp_path):
+    root = write_pkg(tmp_path, {
+        "journal/replay.py": REPLAY_FIXTURE,
+        "core/allocator.py": """
+            class ChipSet:
+                def _set_slot(self, i, c, h):
+                    pass
+
+                def transact(self, opt):
+                    self._set_slot(0, 0, 0)   # choke module: allowed
+        """,
+        "other.py": """
+            from journal import JOURNAL
+
+            def sneaky(cs):
+                cs._set_slot(0, 0, 0)        # outside the choke modules
+
+            def unjournaled(na, request, rater):
+                return na.allocate(request, rater)
+
+            def journaled(na, request, rater):
+                opt = na.allocate(request, rater)
+                JOURNAL.record("bind", pod="p")
+                return opt
+
+            def clone_planning(sched):
+                cs = sched.clone()
+                cs.transact(None)            # clone context: allowed
+        """,
+    })
+    found = run_all(root, AnalysisConfig())
+    setslot = keys_by_rule(found, "journal-setslot-outside-core")
+    assert len(setslot) == 1 and "sneaky" in setslot[0].key
+    unj = keys_by_rule(found, "journal-unjournaled-mutation")
+    assert len(unj) == 1 and "unjournaled" in unj[0].key
+
+
+# -- conformance --------------------------------------------------------------
+
+
+def test_metric_naming_and_docs(tmp_path):
+    root = write_pkg(tmp_path, {"m.py": """
+        REGISTRY = object()
+
+        class Counter:
+            def __init__(self, name, help_):
+                pass
+
+        A = REGISTRY.register(Counter("tpu_documented_total", "x"))
+        B = REGISTRY.register(Counter("tpu_undocumented_total", "x"))
+        C = REGISTRY.register(Counter("badprefix_total", "x"))
+        LOCAL = Counter("not_registered_anything", "x")
+    """})
+    cfg = AnalysisConfig(ops_text="... tpu_documented_total ... "
+                                  "badprefix_total ...")
+    found = run_all(root, cfg)
+    assert {f.key for f in keys_by_rule(found, "conformance-metric-name")} \
+        == {"conformance-metric-name::badprefix_total"}
+    assert {
+        f.key for f in keys_by_rule(found, "conformance-metric-undocumented")
+    } == {"conformance-metric-undocumented::tpu_undocumented_total"}
+
+
+def test_debug_index_lint(tmp_path):
+    root = write_pkg(tmp_path, {"server/routes.py": '''
+        _DEBUG_INDEX = """
+        <html>
+        <li>/debug/listed</li>
+        </html>
+        """
+
+        def dispatch(path):
+            if path == "/debug/listed":
+                return 1
+            if path == "/debug/unlisted":
+                return 2
+            if path in ("/debug", "/debug/"):
+                return _DEBUG_INDEX
+    '''})
+    found = keys_by_rule(
+        run_all(root, AnalysisConfig()), "conformance-debug-index"
+    )
+    assert [f.key for f in found] == [
+        "conformance-debug-index::/debug/unlisted"
+    ]
+
+
+def test_offlock_mutation_allowlist(tmp_path):
+    files = {"m.py": """
+        import threading
+
+        _PARKED = []
+        _GUARDED = []
+        _LK = threading.Lock()
+
+        def offlock(v):
+            _PARKED.append(v)
+
+        def locked(v):
+            with _LK:
+                _GUARDED.append(v)
+    """}
+    root = write_pkg(tmp_path, files)
+    found = keys_by_rule(
+        run_all(root, AnalysisConfig()), "conformance-offlock-mutation"
+    )
+    assert len(found) == 1 and "_PARKED" in found[0].key
+    cfg = AnalysisConfig(gil_atomic_allowlist=(("m.py", "_PARKED"),))
+    assert not keys_by_rule(
+        run_all(root, cfg), "conformance-offlock-mutation"
+    )
+
+
+# -- baseline mechanics -------------------------------------------------------
+
+
+def _one_finding_pkg(tmp_path):
+    return write_pkg(tmp_path, {"m.py": """
+        from x import TimedLock
+
+        class S:
+            def __init__(self):
+                self.a = TimedLock("sched", rank=20)
+                self.b = TimedLock("gang", rank=10)
+
+            def bad(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """})
+
+
+def test_baseline_suppresses_and_unsuppresses(tmp_path):
+    root = _one_finding_pkg(tmp_path)
+    findings = run_all(root, AnalysisConfig())
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+
+    # no baseline: the finding is NEW (gate fails)
+    diff = diff_baseline(findings, load_baseline(str(bl)))
+    assert [f.key for f in diff.new] == [findings[0].key] and not diff.ok
+
+    # baselined with justification: suppressed, gate passes
+    bl.write_text(json.dumps({"entries": [
+        {"key": findings[0].key, "justification": "known, tracked in #123"}
+    ]}))
+    diff = diff_baseline(findings, load_baseline(str(bl)))
+    assert diff.ok and len(diff.suppressed) == 1
+
+    # violation fixed → the entry is STALE and the gate fails again
+    # (un-suppression: a baseline can never silently outlive its finding)
+    diff = diff_baseline([], load_baseline(str(bl)))
+    assert diff.stale == [findings[0].key] and not diff.ok
+
+    # justification-less entries are invalid
+    bl.write_text(json.dumps({"entries": [
+        {"key": findings[0].key, "justification": ""}
+    ]}))
+    diff = diff_baseline(findings, load_baseline(str(bl)))
+    assert diff.invalid and not diff.ok
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    root = _one_finding_pkg(tmp_path)
+    findings = run_all(root, AnalysisConfig())
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings, justification="bootstrap")
+    diff = diff_baseline(findings, load_baseline(str(bl)))
+    assert diff.ok
+
+
+def test_baseline_rejects_duplicates(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [
+        {"key": "k", "justification": "a"},
+        {"key": "k", "justification": "b"},
+    ]}))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_baseline(str(bl))
+
+
+# -- the real tree ------------------------------------------------------------
+
+
+def test_real_package_clean_against_checked_in_baseline():
+    """The tree the repo ships must pass its own gate: no new findings,
+    no stale entries, every baselined finding justified."""
+    import os
+
+    baseline_path = os.path.join(
+        os.path.dirname(package_root()), "tools", "analysis_baseline.json"
+    )
+    cfg = AnalysisConfig(ops_text=default_ops_text())
+    findings = run_all(package_root(), cfg)
+    diff = diff_baseline(findings, load_baseline(baseline_path))
+    assert diff.ok, (
+        [f.render() for f in diff.new], diff.stale, diff.invalid
+    )
+
+
+def test_real_package_hierarchy_has_no_inversions():
+    """The strongest claim the plane makes about the live tree: ZERO
+    rank inversions and ZERO finalizer lock acquisitions on any path
+    the call graph can see — not grandfathered, absent."""
+    cfg = AnalysisConfig(ops_text=default_ops_text())
+    findings = run_all(package_root(), cfg)
+    assert not keys_by_rule(findings, "lockdep-inversion")
+    assert not keys_by_rule(findings, "lockdep-finalizer")

@@ -263,6 +263,170 @@ def test_replay_detects_partial_gang_admit():
     assert any("all-or-nothing" in v for v in res.violations), res.violations
 
 
+def test_node_remove_journaled_refused_while_occupied(journal_dir):
+    """remove_node (the controller's vanished-node prune): refused while
+    ledger pods still charge the node, journaled as ``node_remove`` when
+    empty, and replay rebuilds a state diff_live-identical to the engine
+    (the node truly gone, not zeroed)."""
+    cluster, registry, predicate, bind, status = fresh_stack(n_nodes=3)
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    p = tpu_pod("p0", core=100)
+    cluster.create_pod(p)
+    nodes = ["node-0", "node-1", "node-2"]
+    filt = predicate.handle(ExtenderArgs(pod=p, node_names=nodes))
+    assert filt.node_names
+    target = filt.node_names[0]
+    res = bind.handle(ExtenderBindingArgs(
+        pod_name=p.metadata.name, pod_namespace=p.metadata.namespace,
+        pod_uid=p.metadata.uid, node=target,
+    ))
+    assert not res.error
+    victim = next(n for n in nodes if n != target and n in sched.allocators)
+    # occupied node: refused, nothing journaled for it
+    assert sched.remove_node(target) is False
+    assert target in sched.allocators
+    # idle node: removed + journaled; second call is a no-op
+    assert sched.remove_node(victim) is True
+    assert victim not in sched.allocators
+    assert sched.remove_node(victim) is False
+    # free the pod → its node becomes removable
+    sched.forget_pod(p)
+    assert sched.remove_node(target) is True
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    removed = [e["node"] for e in events if e["type"] == "node_remove"]
+    assert removed == [victim, target]
+    rep = replay(events)
+    assert not rep.violations, rep.violations
+    assert victim not in rep.nodes and target not in rep.nodes
+    assert diff_live(rep, status()) == []
+
+
+def test_prune_never_removes_node_that_joined_after_listing(journal_dir):
+    """The prune snapshots allocator registries BEFORE list_nodes: an
+    allocator materialized for a node that joins the cluster after the
+    listing returns must not be removed as 'vanished'."""
+    cluster = FakeCluster()
+    cluster.add_node(
+        make_tpu_node("node-0", chips=4, hbm_gib=64, accelerator="v5e")
+    )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, priority="binpack")
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    assert sched._get_allocator("node-0") is not None
+
+    real_list = cluster.list_nodes
+
+    def racing_list():
+        # the listing is taken, THEN a new node joins and a filter
+        # materializes its allocator before the prune loop runs
+        nodes = real_list()
+        cluster.add_node(
+            make_tpu_node("late", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+        assert sched._get_allocator("late") is not None
+        return nodes
+
+    cluster.list_nodes = racing_list
+    try:
+        controller._prune_vanished_nodes()
+    finally:
+        cluster.list_nodes = real_list
+    # the late joiner survives (created after the snapshot), node-0 too
+    assert set(sched.allocators) == {"node-0", "late"}
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    assert not [e for e in events if e["type"] == "node_remove"]
+
+
+def test_commit_refuses_zombie_allocator_after_remove(journal_dir):
+    """remove_node racing a verb that prefetched the allocator OFF the
+    engine lock: the commit re-validates registry membership under the
+    lock and backs out — no charge on the pruned instance, no bind
+    journaled after the node_remove, replay stays clean."""
+    cluster, registry, predicate, bind, status = fresh_stack(n_nodes=2)
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    na = sched._get_allocator("node-0")
+    assert na is not None
+    free0 = na.chips.avail_core()
+    cluster.remove_node("node-0")
+    assert sched.remove_node("node-0") is True
+    # simulate the prefetch having happened BEFORE the prune
+    orig = sched._get_allocator
+    sched._get_allocator = lambda n: na if n == "node-0" else orig(n)
+    try:
+        p = tpu_pod("zpod", core=100)
+        cluster.create_pod(p)
+        with pytest.raises(RuntimeError, match="removed mid"):
+            sched.gang_allocate("node-0", p)
+        with pytest.raises(RuntimeError, match="removed mid"):
+            sched.bind("node-0", p)
+    finally:
+        sched._get_allocator = orig
+    assert na.chips.avail_core() == free0  # zombie never stays charged
+    assert p.key not in sched.pod_maps
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    assert not [e for e in events if e.get("pod") == p.key]
+    rep = replay(events)
+    assert not rep.violations, rep.violations
+
+
+def test_replay_flags_node_remove_of_occupied_node():
+    """A forged/buggy stream that removes a node out from under a live
+    pod's charge is a conservation violation, not a silent drop."""
+    node_add = {
+        "seq": 0, "type": "node_add", "node": "n0",
+        "dims": [4], "wrap": [False],
+        "chips": [[[i], 100, 16] for i in range(4)],
+    }
+    bind_rec = {
+        "seq": 1, "type": "bind", "pod": "ns/a", "node": "n0",
+        "option": {
+            "hash": "a", "score": 0.0,
+            "allocs": [["main", [[0]], True, 0, 0, True]],
+        },
+    }
+    removal = {"seq": 2, "type": "node_remove", "node": "n0"}
+    res = replay([node_add, bind_rec, removal])
+    assert any("node_remove" in v and "ns/a" in v for v in res.violations), \
+        res.violations
+
+
+def test_controller_resync_prunes_vanished_node(journal_dir):
+    """End to end: a node decommissioned from the cluster leaves the
+    allocator registry at the next resync tick, journaled."""
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e")
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=cluster, priority="binpack")
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    p = tpu_pod("p0", core=100)
+    cluster.create_pod(p)
+    filt = predicate.handle(
+        ExtenderArgs(pod=p, node_names=["node-0", "node-1"])
+    )
+    assert filt.node_names  # both allocators materialized by the filter
+    assert set(sched.allocators) == {"node-0", "node-1"}
+    cluster.remove_node("node-1")
+    controller._prune_vanished_nodes()
+    assert set(sched.allocators) == {"node-0"}
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    assert [e["node"] for e in events if e["type"] == "node_remove"] \
+        == ["node-1"]
+    rep = replay(events)
+    assert not rep.violations, rep.violations
+    assert diff_live(rep, status()) == []
+
+
 def test_unmatched_forget_is_warning_not_violation():
     res = replay([
         {"seq": 0, "type": "forget", "pod": "ns/ghost", "node": "n0"},

@@ -1,8 +1,12 @@
-"""Lock-ordering enforcement (VERDICT r4 #9): the two big control-plane
-locks carry ranks (gang=10 → scheduler=20) and TimedLock raises on any
-inversion — a deadlock that hasn't happened yet, which the GIL hides from
-every stress test.  Plus a multi-process bind storm through real sockets:
-contention from OS processes, not GIL-serialized threads."""
+"""Lock-ordering enforcement (VERDICT r4 #9): the control-plane locks
+carry ranks — gang (10) → resize (14) → defrag (15) → scheduler (20) →
+node (30) — and TimedLock raises on any inversion: a deadlock that
+hasn't happened yet, which the GIL hides from every stress test.  The
+full chain is pinned here; the static lockdep pass
+(analysis/lockdep.py, `make check-analysis`) checks the same rule over
+every call path the AST can see.  Plus a multi-process bind storm
+through real sockets: contention from OS processes, not GIL-serialized
+threads."""
 
 import json
 import multiprocessing as mp
@@ -45,6 +49,54 @@ def test_same_rank_is_an_inversion():
     with a:
         with pytest.raises(RuntimeError, match="lock-order inversion"):
             b.acquire()
+
+
+def test_full_hierarchy_chain():
+    """The complete documented hierarchy nests cleanly in rank order:
+    gang 10 → resize 14 → defrag 15 → scheduler 20 → node 30 (the ranks
+    the live subsystems construct — scheduler/gang.py, fleet/resize.py,
+    defrag/__init__.py, scheduler/scheduler.py, core/node.py)."""
+    gang = TimedLock("t-gang-c", rank=10)
+    resize = TimedLock("t-resize-c", rank=14)
+    defrag = TimedLock("t-defrag-c", rank=15)
+    sched = TimedLock("t-sched-c", reentrant=True, rank=20)
+    node = TimedLock("t-node-c", rank=30)
+    with gang:
+        with resize:
+            with defrag:
+                with sched:
+                    with sched:  # reentrant engine re-acquire
+                        with node:
+                            pass
+    # the chain with a member skipped is equally legal (strictly
+    # increasing, not dense): resize → node, gang → defrag, …
+    with resize:
+        with node:
+            pass
+    with gang:
+        with defrag:
+            with sched:
+                pass
+
+
+def test_full_hierarchy_every_adjacent_inversion_raises():
+    """Every adjacent pair taken in the wrong order trips the checker —
+    14 under 15, 10 under 14, 20 under 30, 15 under 20."""
+    ranks = [
+        ("gang", 10), ("resize", 14), ("defrag", 15), ("sched", 20),
+        ("node", 30),
+    ]
+    locks = [
+        TimedLock(f"t-inv-{name}", rank=r) for name, r in ranks
+    ]
+    for lower, higher in zip(locks, locks[1:]):
+        with higher:
+            with pytest.raises(RuntimeError, match="lock-order inversion"):
+                lower.acquire()
+        # and the failed acquire never poisons the legal order
+        with lower:
+            with higher:
+                pass
 
 
 def test_unranked_locks_unaffected():

@@ -15,20 +15,13 @@ native = get_placement()
 needs_native = pytest.mark.skipif(native is None, reason="g++/toolchain missing")
 
 
+from elastic_gpu_scheduler_tpu.core.topology import reference_free_boxes
+
+
 def python_boxes(topo, free_set, count, max_out):
-    out = []
-    seen = set()
-    for shape in topo.box_shapes(count):
-        for box in topo.placements(shape):
-            if len(out) >= max_out:
-                return out
-            if all(c in free_set for c in box):
-                key = frozenset(box)
-                if key in seen:
-                    continue
-                seen.add(key)
-                out.append(key)
-    return out
+    # ONE oracle definition shared with the sanitizer fuzz gate
+    # (tools/check_native_san.py) — see reference_free_boxes
+    return reference_free_boxes(topo, free_set, count, max_out)
 
 
 def native_boxes(topo, free_set, count, max_out):

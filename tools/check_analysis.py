@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Invariant-analysis gate (``make check-analysis``).
+
+Two halves, both must pass:
+
+1. **Tree check** — run every analysis pass over the live package and
+   diff against tools/analysis_baseline.json: any NEW finding, STALE
+   baseline entry, or entry without a written justification fails.
+
+2. **Injection self-test** — copy the package to a temp dir, inject one
+   synthetic violation per core rule (a lock-order inversion, an
+   unjournaled ``_set_slot`` caller, a journal record type with no
+   replay handler, an off-lock global mutation, an unindexed /debug
+   endpoint) and assert the analyzer flags EXACTLY those keys as new.
+   This is the guard against the analyzer rotting into a no-op: a pass
+   that silently stops seeing its violation class fails the gate even
+   though the tree check stays green.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elastic_gpu_scheduler_tpu.analysis import (  # noqa: E402
+    AnalysisConfig,
+    default_ops_text,
+    package_root,
+    run_all,
+)
+from elastic_gpu_scheduler_tpu.analysis.baseline import (  # noqa: E402
+    diff_baseline,
+    load_baseline,
+)
+
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "analysis_baseline.json",
+)
+
+INJECTIONS = {
+    # rule expected in the new findings → injected module source
+    "lockdep-inversion": '''
+from ..metrics import TimedLock
+
+class _SynthInversion:
+    def __init__(self):
+        self._node_lk = TimedLock("synth-node", rank=30)
+        self._gang_lk = TimedLock("synth-gang", rank=10)
+
+    def bad(self):
+        with self._node_lk:
+            with self._gang_lk:
+                return 1
+''',
+    "journal-setslot-outside-core": '''
+def synth_unjournaled(cs):
+    cs._set_slot(0, 0, 0)
+    return cs
+''',
+    "journal-unhandled-type": '''
+from ..journal import JOURNAL
+
+def synth_emit():
+    JOURNAL.record("synth_unreplayed_record")
+''',
+    "conformance-offlock-mutation": '''
+_SYNTH_BUFFER: list = []
+
+def synth_offlock(v):
+    _SYNTH_BUFFER.append(v)
+''',
+}
+
+
+def tree_check() -> int:
+    cfg = AnalysisConfig(ops_text=default_ops_text())
+    findings = run_all(package_root(), cfg)
+    try:
+        baseline = load_baseline(BASELINE)
+    except ValueError as e:
+        print(f"FAIL: invalid baseline: {e}", file=sys.stderr)
+        return 1
+    diff = diff_baseline(findings, baseline)
+    for f in diff.new:
+        print(f"NEW: {f.render()}", file=sys.stderr)
+    for k in diff.stale:
+        print(f"STALE: {k}", file=sys.stderr)
+    for m in diff.invalid:
+        print(f"INVALID: {m}", file=sys.stderr)
+    if not diff.ok:
+        print(
+            f"FAIL: tree check — {len(diff.new)} new / {len(diff.stale)} "
+            f"stale / {len(diff.invalid)} invalid", file=sys.stderr,
+        )
+        return 1
+    print(f"tree check OK: {len(findings)} finding(s), all baselined with "
+          "justification")
+    return 0
+
+
+def injection_check() -> int:
+    cfg = AnalysisConfig(ops_text=default_ops_text())
+    root = package_root()
+    failures = 0
+    with tempfile.TemporaryDirectory(prefix="analysis-inject-") as tmp:
+        copy = os.path.join(tmp, "pkg")
+        shutil.copytree(
+            root, copy,
+            ignore=shutil.ignore_patterns("__pycache__", "_native_build"),
+        )
+        clean = {f.key for f in run_all(copy, cfg)}
+        for i, (rule, src) in enumerate(sorted(INJECTIONS.items())):
+            path = os.path.join(copy, "core", f"_synth_{i}.py")
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(src)
+        # a served-but-unindexed /debug endpoint (string constant in the
+        # routes module, absent from the index page)
+        with open(os.path.join(copy, "server", "routes.py"), "a",
+                  encoding="utf-8") as fh:
+            fh.write('\n_SYNTH_ENDPOINT = "/debug/synthunlisted"\n')
+        expected_rules = set(INJECTIONS) | {"conformance-debug-index"}
+        new = [f for f in run_all(copy, cfg) if f.key not in clean]
+        got_rules = {f.rule for f in new}
+        for rule in sorted(expected_rules):
+            if rule in got_rules:
+                print(f"injection OK: {rule} flagged")
+            else:
+                print(f"FAIL: injected {rule} violation NOT flagged — the "
+                      "pass went blind", file=sys.stderr)
+                failures += 1
+        # and the baseline must NOT be able to silently absorb them: a
+        # diff against the real baseline reports them as new
+        diff = diff_baseline(new, load_baseline(BASELINE))
+        if len(diff.new) != len(new):
+            print("FAIL: baseline absorbed injected findings", file=sys.stderr)
+            failures += 1
+    return 1 if failures else 0
+
+
+def main() -> int:
+    rc = tree_check()
+    rc |= injection_check()
+    print("check-analysis", "FAILED" if rc else "OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

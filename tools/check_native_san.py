@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Sanitizer + differential-fuzz gate for the native placement kernels
+(``make check-native-san``).
+
+Two claims the ordinary test suite cannot make:
+
+1. **Memory/UB safety**: placement.cc is hand-written CPython C API —
+   refcount slips, OOB reads on the odometer walks, signed overflow on
+   big meshes would all pass a parity test silently.  The gate rebuilds
+   the extension with ``-fsanitize=address,undefined
+   -fno-sanitize-recover=all`` (core/native.build_sanitized) and runs
+   every fuzz iteration under it: any violation aborts the child
+   process, which fails the gate.
+
+2. **Differential parity at fuzz scale**: the curated parity tests in
+   tests/test_native.py pin known shapes; this gate hammers randomized
+   topologies / free-set partitions / gang specs and requires
+   ``plan_gang``, ``plan_gang_batch`` and ``enumerate_free_boxes`` to
+   be BIT-identical (order included) to their Python fallbacks on every
+   iteration — the acceptance contract, under the sanitizer.
+
+Env knobs: ``NATIVE_FUZZ_SEED`` (default 20260804),
+``NATIVE_FUZZ_ITERS`` (default 120).  A failure prints the seed +
+iteration + full inputs for offline reproduction.
+
+Mechanics: the parent builds the sanitized .so, locates libasan
+(``g++ -print-file-name=libasan.so``) and re-execs itself ``--child``
+with ``LD_PRELOAD`` set — ASan must be the first runtime in a process
+that dlopens instrumented code.  ``detect_leaks=0`` because the leak
+checker would report CPython's own arenas, not the kernel's.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import random
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = int(os.environ.get("NATIVE_FUZZ_SEED", "20260804"))
+ITERS = int(os.environ.get("NATIVE_FUZZ_ITERS", "120"))
+
+
+def _load_san_module(so_path: str):
+    # the init symbol is PyInit__placement regardless of the file name,
+    # so the spec must use the C module's own name
+    spec = importlib.util.spec_from_file_location("_placement", so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _random_topo(rng):
+    nd = rng.randint(1, 3)
+    while True:
+        dims = tuple(rng.randint(1, 6) for _ in range(nd))
+        n = 1
+        for d in dims:
+            n *= d
+        if n <= 256:
+            break
+    wrap = tuple(rng.random() < 0.5 for _ in range(nd))
+    return dims, wrap
+
+
+def _random_nodes(topo, rng, free_p=0.8):
+    """Random host partition of the mesh.  Deliberately WIDER than
+    tests/test_native.py's generator (k starts at 1: single-cell hosts
+    are in-contract and worth fuzzing) — the distributions differ on
+    purpose; the shared contract lives in reference_free_boxes and the
+    fallback kernels, not in the generators."""
+    cells = list(range(topo.num_chips))
+    rng.shuffle(cells)
+    nodes, i = [], 0
+    while i < len(cells):
+        k = rng.randint(1, 8)
+        nodes.append(
+            tuple(sorted(c for c in cells[i:i + k] if rng.random() < free_p))
+        )
+        i += k
+    return nodes
+
+
+def _fail(msg: str, **ctx):
+    print(f"NATIVE-SAN PARITY FAILURE: {msg}", file=sys.stderr)
+    for k, v in ctx.items():
+        print(f"  {k} = {v!r}", file=sys.stderr)
+    print(f"  repro: NATIVE_FUZZ_SEED={SEED} NATIVE_FUZZ_ITERS={ITERS} "
+          "make check-native-san", file=sys.stderr)
+    sys.exit(2)
+
+
+def run_child() -> int:
+    from elastic_gpu_scheduler_tpu.core.allocator import (
+        plan_gang_batch_fallback,
+        plan_gang_fallback,
+    )
+    from elastic_gpu_scheduler_tpu.core.native import build_sanitized
+    from elastic_gpu_scheduler_tpu.core.topology import (
+        Topology,
+        reference_free_boxes,
+    )
+
+    so = build_sanitized()
+    if so is None:
+        print("sanitized build unavailable", file=sys.stderr)
+        return 3
+    native = _load_san_module(so)
+    rng = random.Random(SEED)
+    boxes_checked = plans_checked = batches_checked = 0
+    for it in range(ITERS):
+        dims, wrap = _random_topo(rng)
+        topo = Topology(dims, wrap)
+        nodes = _random_nodes(topo, rng)
+        # edge shapes ride iteration 0 deterministically
+        if it == 0:
+            nodes = [(), tuple(range(topo.num_chips))] + nodes
+
+        # enumerate_free_boxes parity on one random free mask
+        free = {c for c in topo.coords() if rng.random() < 0.7}
+        mask = bytearray(topo.num_chips)
+        for c in free:
+            mask[topo.index(c)] = 1
+        for count in (1, 2, 4):
+            for max_out in (1, 8, 64):
+                nat = [
+                    frozenset(topo.coord_of(i) for i in box)
+                    for box in native.enumerate_free_boxes(
+                        topo.dims, topo.wrap, bytes(mask), count, max_out
+                    )
+                ]
+                py = reference_free_boxes(topo, free, count, max_out)
+                if nat != py:
+                    _fail("enumerate_free_boxes diverged",
+                          iteration=it, dims=dims, wrap=wrap, count=count,
+                          max_out=max_out, free=sorted(free))
+                boxes_checked += 1
+
+        # plan_gang parity
+        for count in (1, 2, 4, 8):
+            members = rng.randint(0, topo.num_chips // count + 2)
+            max_c = rng.choice((1, 8, 64))
+            nat = native.plan_gang(
+                topo.dims, topo.wrap, nodes, count, members, max_c
+            )
+            py = plan_gang_fallback(topo, nodes, count, members, max_c)
+            if nat != py:
+                _fail("plan_gang diverged",
+                      iteration=it, dims=dims, wrap=wrap, count=count,
+                      members=members, max_candidates=max_c, nodes=nodes)
+            plans_checked += 1
+
+        # plan_gang_batch parity (a queue of specs, all-or-nothing each)
+        specs = [
+            (rng.choice((1, 2, 4, 8)), rng.randint(1, 6))
+            for _ in range(rng.randint(0, 5))
+        ]
+        nat = native.plan_gang_batch(topo.dims, topo.wrap, nodes, specs, 64)
+        py = plan_gang_batch_fallback(topo, nodes, specs, 64)
+        if nat != py:
+            _fail("plan_gang_batch diverged",
+                  iteration=it, dims=dims, wrap=wrap, specs=specs,
+                  nodes=nodes)
+        batches_checked += 1
+    print(
+        f"native-san: {ITERS} iterations clean under ASan/UBSan — "
+        f"{boxes_checked} enumerations, {plans_checked} plans, "
+        f"{batches_checked} batch sweeps, all bit-identical to the "
+        "Python fallback"
+    )
+    return 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return run_child()
+    from elastic_gpu_scheduler_tpu.core.native import (
+        build_sanitized,
+        sanitizer_preload,
+    )
+
+    so = build_sanitized()
+    if so is None:
+        print("FAIL: could not build the sanitized extension (g++ with "
+              "-fsanitize=address,undefined required)", file=sys.stderr)
+        return 1
+    preload = sanitizer_preload()
+    env = dict(os.environ)
+    if preload:
+        env["LD_PRELOAD"] = preload
+    env["ASAN_OPTIONS"] = env.get(
+        "ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1"
+    )
+    env["UBSAN_OPTIONS"] = env.get(
+        "UBSAN_OPTIONS", "print_stacktrace=1:halt_on_error=1"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, timeout=1800,
+    )
+    if proc.returncode != 0:
+        print(f"FAIL: sanitized differential fuzz exited "
+              f"{proc.returncode} (parity break, sanitizer abort, or "
+              "missing toolchain — see output above)", file=sys.stderr)
+        return 1
+    print("check-native-san OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
