@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -116,6 +116,18 @@ check-compile-cache:
 # synthetic violations per rule must be flagged or the gate fails.
 check-analysis:
 	python tools/check_analysis.py
+
+# HA gate: seeded chaos soak — a leader on a fleetgen cluster ships its
+# journal to a live follower under an injected fault plan (stream/ledger/
+# fsync faults), the leader is killed mid-gang-commit and mid-write
+# (torn tail), and a standby warm-takes-over.  Hard-fails on follower
+# lag/divergence, any replay violation (double-book / conservation /
+# gang all-or-nothing), takeover state differing from a cold ledger
+# rebuild, a non-self-contained new-leader journal, a warm takeover
+# slower than CHECK_HA_MIN_SPEEDUP x cold, or election/breaker chaos
+# failing to self-heal.
+check-ha:
+	python tools/check_ha.py
 
 # Native-kernel sanitizer gate: rebuild placement.cc with
 # ASan+UBSan (-fno-sanitize-recover) and run a seeded differential

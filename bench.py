@@ -799,6 +799,145 @@ def policy_bench(chunks: int = 40, chunk_n: int = 40) -> dict:
     }
 
 
+def ha_bench(nodes_n: int | None = None, seed: int | None = None) -> dict:
+    """HA section (ROADMAP item 2's availability half): journal-shipped
+    warm standby vs the cold annotation-ledger rebuild it replaces, at
+    the same fleetgen scale the cluster section uses.
+
+    Emits:
+      ha_takeover_warm_ms     adopt the follower's replayed state + diff
+                              resync vs the ledger (min of reps — the
+                              once-only wall is GC-noise-prone)
+      ha_takeover_cold_ms     full ledger rebuild (one get_node +
+                              list_pods per materialized node, option
+                              replay per pod) — the old failover cost
+      ha_takeover_speedup     cold / warm (acceptance: ≥10× at 10k)
+      ha_follow_lag_p99_seqs  p99 follower lag (seqs) sampled while a
+                              live churn runs against the leader
+      ha_follow_catchup_s     wall from final flush to lag == 0
+
+    Seeded + deterministic; tools/check_ha.py runs the same machinery
+    smaller with fault injection + divergence audits and hard-fails."""
+    import gc
+    import random as _random
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from tools.fleetgen import make_fleet
+    from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+    from elastic_gpu_scheduler_tpu.journal.replay import replay
+    from elastic_gpu_scheduler_tpu.journal.ship import JournalFollower
+    from elastic_gpu_scheduler_tpu.scheduler.ha import warm_takeover
+
+    nodes_n = nodes_n or int(
+        os.environ.get("BENCH_HA_NODES",
+                       os.environ.get("BENCH_CLUSTER_NODES", "10000"))
+    )
+    seed = seed or int(os.environ.get("BENCH_HA_SEED", "20260804"))
+    rng = _random.Random(seed)
+    out: dict = {}
+    tmp = _tempfile.mkdtemp(prefix="bench_ha_")
+    try:
+        cluster = FakeCluster()
+        names = make_fleet(cluster, nodes=nodes_n, seed=seed)
+        clientset = FakeClientset(cluster)
+        JOURNAL.configure(
+            os.path.join(tmp, "journal"), fsync="off",
+            max_segment_bytes=16 << 20,
+        )
+        registry, predicate, prioritize, bind, _c, status, gang = build_stack(
+            clientset, cluster=None, gang_timeout=300.0
+        )
+        sched = registry[consts.RESOURCE_TPU_CORE]
+        out["ha_nodes"] = len(names)
+        sched.get_allocators(names)  # materialize + journal every node
+
+        # ~35% whole-host fill so the rebuild carries a realistic ledger
+        serial = [0]
+
+        def _mk(core):
+            serial[0] += 1
+            p = tpu_pod(f"ha-{serial[0]}", core=core)
+            cluster.create_pod(p)
+            return p
+
+        for n in rng.sample(names, int(len(names) * 0.35)):
+            na = sched.allocators.get(n)
+            chips = na.chips.num_chips if na is not None else 4
+            try:
+                sched.bind(n, _mk(chips * 100))
+            except Exception:
+                pass
+        with sched.lock:
+            out["ha_pods"] = len(sched.pod_maps)
+
+        # live churn with a follower attached: lag sampled per poll
+        server = ExtenderServer(
+            predicate, prioritize, bind, status, host="127.0.0.1", port=0
+        )
+        port = server.start()
+        follower = JournalFollower(
+            f"http://127.0.0.1:{port}", wait_s=0.5
+        ).start()
+        lags: list[int] = []
+        churn_end = time.monotonic() + 6.0
+        while time.monotonic() < churn_end:
+            n = rng.choice(names)
+            na = sched.allocators.get(n)
+            if na is None:
+                continue
+            try:
+                sched.bind(n, _mk(50))
+            except Exception:
+                pass
+            lags.append(follower.lag_seqs())
+            time.sleep(0.005)
+        JOURNAL.flush()
+        t0 = time.perf_counter()
+        while follower.lag_seqs() > 0 and time.perf_counter() - t0 < 30:
+            time.sleep(0.02)
+        out["ha_follow_catchup_s"] = round(time.perf_counter() - t0, 3)
+        lags.sort()
+        out["ha_follow_lag_p99_seqs"] = (
+            lags[int(len(lags) * 0.99)] if lags else 0
+        )
+        follower.stop()
+        server.stop()
+        JOURNAL.close()
+
+        # cold: the pre-shipping failover path (fresh engine, full
+        # ledger rebuild) — measured once; it only flatters warm if slow
+        gc.collect()
+        t0 = time.perf_counter()
+        build_stack(clientset, cluster=None, gang_timeout=300.0)
+        out["ha_takeover_cold_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 1
+        )
+
+        # warm: adopt replayed state + diff resync (min of 2 reps)
+        events = read_journal(os.path.join(tmp, "journal"))
+        walls = []
+        for _rep in range(2):
+            res = replay(events)
+            reg_w, _pw, _prw, _bw, _cw, _sw, _gw = build_stack(
+                clientset, cluster=None, gang_timeout=300.0,
+                rebuild_on_start=False,
+            )
+            gc.collect()
+            t0 = time.perf_counter()
+            warm_takeover(reg_w[consts.RESOURCE_TPU_CORE], res)
+            walls.append((time.perf_counter() - t0) * 1000.0)
+        out["ha_takeover_warm_ms"] = round(min(walls), 2)
+        out["ha_takeover_speedup"] = round(
+            out["ha_takeover_cold_ms"] / max(out["ha_takeover_warm_ms"],
+                                             1e-3), 1
+        )
+    finally:
+        JOURNAL.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def cluster_bench(
     nodes_n: int | None = None,
     seed: int | None = None,
@@ -2605,6 +2744,14 @@ def main():
             results.update(cluster_bench())
         except Exception as e:  # noqa: BLE001 — report, keep the artifact
             results["cluster_bench_error"] = str(e)[:300]
+
+    # HA: journal-shipped warm takeover vs cold ledger rebuild at the
+    # same fleetgen scale (BENCH_HA=0 skips; node count BENCH_HA_NODES).
+    if os.environ.get("BENCH_HA", "1") != "0":
+        try:
+            results.update(ha_bench())
+        except Exception as e:  # noqa: BLE001 — report, keep the artifact
+            results["ha_bench_error"] = str(e)[:300]
 
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`

@@ -147,7 +147,12 @@ def check_journal(index: PackageIndex, cfg) -> list:
         if rel.endswith(cfg.replay_module):
             replay_mod = rel
             break
-    replay_handled = _handled_types(index, replay_mod, "replay")
+    # the batch replay() wrapper delegates record dispatch to the
+    # incremental ReplayEngine.apply (the journal-shipping follower's
+    # entry point) — handler sets union both, so either layout lints
+    replay_handled = _handled_types(
+        index, replay_mod, "replay"
+    ) | _handled_types(index, replay_mod, "ReplayEngine.apply")
     whatif_handled = _handled_types(index, replay_mod, "what_if")
 
     if replay_mod is not None:

@@ -50,6 +50,7 @@ def build_stack(
     defrag_priority_ceiling: int = 0,
     defrag_interval: float = 30.0,
     defrag_min_interval: float = 5.0,
+    rebuild_on_start: bool = True,
 ):
     """Wire registry + handlers + controller (reference: main.go:56-96)."""
     # warm the native placement extension at startup so the first large-mesh
@@ -65,6 +66,7 @@ def build_stack(
     rater = resolve_rater(priority)
     config = SchedulerConfig(
         clientset=clientset, rater=rater, placement_index=placement_index,
+        rebuild_on_start=rebuild_on_start,
     )
     registry = build_resource_schedulers(list(modes), config)
     gang = GangCoordinator(
@@ -181,6 +183,24 @@ def main(argv=None) -> int:
         "report /healthz 503 until they acquire the lease)",
     )
     p.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p.add_argument(
+        "--follow", default="",
+        help="warm-standby mode: continuously replay this leader's "
+        "journal stream (http://leader:port) into live state via "
+        "GET /journal/stream, so election (--leader-elect) swaps the "
+        "replayed state in and resyncs as a DIFF against the annotation "
+        "ledger instead of a cold rebuild.  Lag exported as "
+        "tpu_ha_follow_lag_seqs/_seconds; posture at /debug/leader",
+    )
+    p.add_argument(
+        "--fault-plan", default="",
+        help="deterministic fault injection (chaos drills): a JSON plan "
+        "list or @file — [{\"site\": ..., \"kind\": error|timeout|"
+        "partition|torn-write|crash, \"p\"|\"nth\": ..., \"seed\": N}]. "
+        "Also via TPU_FAULT_PLAN env or POST /faults/load at runtime; "
+        "state at /debug/faults.  NEVER enable on a production leader "
+        "except as a supervised game-day exercise",
+    )
     p.add_argument(
         "--http-workers",
         type=int,
@@ -347,6 +367,19 @@ def main(argv=None) -> int:
             max_segment_bytes=args.journal_max_bytes,
         )
 
+    if args.fault_plan:
+        from .faultinject import FAULTS
+
+        raw_plan = args.fault_plan
+        if raw_plan.startswith("@"):
+            with open(raw_plan[1:]) as f:
+                raw_plan = f.read()
+        try:
+            FAULTS.load_json(raw_plan)
+        except (ValueError, OSError) as e:
+            print(f"error: --fault-plan: {e}", file=sys.stderr)
+            return 2
+
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -416,9 +449,19 @@ def main(argv=None) -> int:
         defrag_priority_ceiling=args.defrag_priority_ceiling,
         defrag_interval=args.defrag_interval,
         defrag_min_interval=args.defrag_min_interval,
+        # a warm standby's state arrives via journal shipping and is
+        # swapped in at election — a cold ledger rebuild here would only
+        # be thrown away (and pay 10k get_node calls doing it)
+        rebuild_on_start=not args.follow,
     )
     if controller is not None:
         controller.start()
+
+    follower = None
+    if args.follow:
+        from .journal.ship import JournalFollower
+
+        follower = JournalFollower(args.follow).start()
 
     elector = None
     if args.leader_elect:
@@ -426,13 +469,48 @@ def main(argv=None) -> int:
 
         from .scheduler.leader import LeaderElector
 
+        def on_started_leading():
+            if args.journal_dir:
+                # a previous step-down flushed AND closed the journal;
+                # re-acquiring reopens it (seq numbering resumes, the
+                # writer adds a boot checkpoint) BEFORE takeover so the
+                # takeover itself is journaled.  configure() clears the
+                # checkpoint provider, so re-register it even WITHOUT a
+                # follower — otherwise every later segment lacks a head
+                # checkpoint and pruning eventually makes the journal
+                # unreplayable (and unshippable to fresh followers)
+                from .journal import JOURNAL
+
+                if not JOURNAL.enabled:
+                    JOURNAL.configure(
+                        args.journal_dir,
+                        fsync=args.journal_fsync,
+                        max_segment_bytes=args.journal_max_bytes,
+                    )
+                    eng = next(iter(registry.values()), None)
+                    if eng is not None:
+                        eng.register_checkpoint_provider()
+            if follower is not None:
+                # warm takeover: adopt the follower's replayed state,
+                # resync as a diff against the annotation ledger.  The
+                # replayed ChipSets are adopted (not cloned) so exactly
+                # ONE engine may take them; additional engines (multi-
+                # mode deployments) cold-rebuild as before.
+                from .scheduler.ha import warm_takeover
+
+                engines = list({id(s): s for s in registry.values()}.values())
+                if engines:
+                    warm_takeover(engines[0], follower)
+                for sched in engines[1:]:
+                    sched._rebuild_state()
+
         elector = LeaderElector(
             clientset,
             identity=f"{_socket.gethostname()}-{os.getpid()}",
             lease_duration=args.leader_lease_duration,
             renew_period=args.leader_lease_duration / 3.0,
+            on_started_leading=on_started_leading,
         )
-        elector.start()
 
     defrag = gang.defrag
     if elector is not None:
@@ -517,7 +595,29 @@ def main(argv=None) -> int:
         defrag=defrag,
         fleet=fleet_state,
         policy=POLICIES,
+        elector=elector,
+        follower=follower,
     )
+
+    if elector is not None:
+        def on_stepping_down():
+            # runs fenced (is_leader already False → new verbs 503) but
+            # BEFORE the lease drops: drain in-flight verb handlers so
+            # nothing commits after a successor could serve, then flush
+            # + close the journal so the last sealed records reached
+            # disk (and the shipping stream) while they were still ours
+            server.wait_verbs_idle(
+                timeout_s=max(1.0, args.leader_lease_duration / 3.0)
+            )
+            if args.journal_dir:
+                from .journal import JOURNAL
+
+                JOURNAL.flush(timeout=5.0)
+                JOURNAL.close()
+
+        elector.on_stepping_down = on_stepping_down
+        # started only now: the hooks close over the fully-built server
+        elector.start()
 
     stop = threading.Event()
 
@@ -544,6 +644,8 @@ def main(argv=None) -> int:
         while not stop.wait(0.5):
             pass
     finally:
+        if follower is not None:
+            follower.stop()
         if fleet_state is not None:
             fleet_state.stop()
         defrag.stop()

@@ -92,6 +92,26 @@ class NodeAllocator:
         self.generation = labels.get(consts.LABEL_TPU_ACCELERATOR, "v5e")
         topo, chips = chips_from_node(node)
         self.chips = ChipSet(topo, chips)
+        self._init_shared()
+
+    @classmethod
+    def from_state(
+        cls, node_name: str, generation: str, chips: ChipSet
+    ) -> "NodeAllocator":
+        """Adopt an already-built ChipSet — the HA warm-takeover path
+        (scheduler/ha.py): a journal-shipping follower's replayed chip
+        state becomes this node's live allocator WITHOUT a get_node /
+        list_pods round-trip per node (the whole cost a cold failover
+        pays 10k times).  The ChipSet is adopted, not cloned: the
+        follower stops consuming it before takeover swaps it in."""
+        self = cls.__new__(cls)
+        self.node_name = node_name
+        self.generation = generation or "v5e"
+        self.chips = chips
+        self._init_shared()
+        return self
+
+    def _init_shared(self) -> None:
         self.allocated: dict[str, Option] = {}  # request hash → assumed option
         self._allocated_at: dict[str, float] = {}  # request hash → monotonic
         # the mutation shard of the scheduler's lock hierarchy: gang

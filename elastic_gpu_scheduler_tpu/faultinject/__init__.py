@@ -1,0 +1,279 @@
+"""Deterministic fault-injection plane (process-global ``FAULTS``).
+
+None of the control plane's crash paths — leader death mid-gang-commit,
+a journal writer's torn tail under kill -9, the router losing a replica
+mid-stream, an apiserver flap — were exercised by INJECTED faults before
+this module; they were only covered where a test happened to simulate
+them by hand.  ``FAULTS`` is the TRACER/JOURNAL-pattern singleton that
+fixes that: code threads named **sites** through its I/O edges, a test
+(or the chaos gate, tools/check_ha.py) loads a seeded **plan**, and the
+same failure schedule replays exactly on every run.
+
+Sites (``FAULTS.maybe_fire(site)`` — one attribute check when off):
+
+    k8s.request        RestClientset._req (every real apiserver call)
+    k8s.update_pod     FakeClientset.update_pod (the annotation ledger)
+    k8s.bind           FakeClientset.bind (the Binding subresource)
+    k8s.list_pods      FakeClientset.list_pods (resync / rebuild reads)
+    lease.acquire      LeaderElector._try_acquire (lease get/create/CAS)
+    lease.renew        LeaderElector._renew
+    journal.write      journal writer thread, per record written
+    journal.fsync      journal writer thread, per fsync
+    gang.phase2        gang commit, between the phase-1 seal and the
+                       first annotation write (the mid-commit kill point)
+    router.connect     FleetRouter._forward backend connect
+    router.probe       ReplicaSet._http_get health/stats probe
+    ship.stream        /journal/stream handler, per request (leader side)
+    ship.follow        JournalFollower, per poll (follower side)
+
+Kinds:
+
+    error       raise ``InjectedFault`` (an ``OSError`` — existing
+                failure handling treats it like a real I/O error)
+    timeout     sleep ``delay_s`` then raise ``InjectedTimeout``
+                (a ``TimeoutError``)
+    partition   raise ``InjectedPartition`` (a ``ConnectionError``) —
+                the socket-level look of a network partition
+    torn-write  no raise: ``maybe_fire`` RETURNS the plan and the call
+                site implements the tear (the journal writer emits a
+                partial record then fails the batch — byte-for-byte what
+                kill -9 mid-write leaves on disk)
+    crash       ``os._exit(137)`` — the process dies as if SIGKILLed.
+                Only subprocess-driven tests/gates use this kind.
+
+A plan is a small dict (JSON over CLI ``--fault-plan``, env
+``TPU_FAULT_PLAN``, or ``POST /faults/load``)::
+
+    {"site": "lease.renew", "kind": "error",
+     "p": 0.05,        # per-call probability (seeded RNG), and/or
+     "nth": 12,        # fire on the 12th call at the site (1-based)
+     "count": 1,       # max fires (default unlimited)
+     "delay_s": 0.05}  # timeout kind: how long the hang lasts
+
+Determinism: every plan draws from ONE seeded ``random.Random`` (the
+registry's ``seed``), and per-site call counters are exact — the same
+plan + the same call sequence fires the same faults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+__all__ = [
+    "FAULTS",
+    "FaultPlan",
+    "FaultRegistry",
+    "InjectedFault",
+    "InjectedPartition",
+    "InjectedTimeout",
+    "KINDS",
+]
+
+KINDS = ("error", "timeout", "partition", "torn-write", "crash")
+
+
+class InjectedFault(OSError):
+    """A fault-plane 'error' firing.  OSError: every I/O edge with a
+    site already handles the OSError family."""
+
+
+class InjectedTimeout(TimeoutError):
+    """A fault-plane 'timeout' firing (TimeoutError ⊂ OSError)."""
+
+
+class InjectedPartition(ConnectionError):
+    """A fault-plane 'partition' firing (ConnectionError ⊂ OSError)."""
+
+
+class FaultPlan:
+    def __init__(
+        self,
+        site: str,
+        kind: str,
+        p: float = 0.0,
+        nth: int = 0,
+        count: int = 0,
+        delay_s: float = 0.05,
+    ):
+        if kind not in KINDS:
+            raise ValueError(f"fault kind {kind!r} not in {KINDS}")
+        if not site:
+            raise ValueError("fault plan needs a site")
+        if p <= 0.0 and nth <= 0:
+            raise ValueError(
+                f"fault plan for {site!r} needs p > 0 and/or nth > 0"
+            )
+        self.site = site
+        self.kind = kind
+        self.p = min(max(float(p), 0.0), 1.0)
+        self.nth = int(nth)
+        self.count = int(count)  # 0 = unlimited
+        self.delay_s = max(0.0, float(delay_s))
+        self.fired = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        if not isinstance(d, dict):
+            # a plans list containing strings/numbers must be a
+            # structured client error, never an AttributeError-500
+            raise ValueError(
+                f"fault plan entry must be an object, got {type(d).__name__}"
+            )
+        return cls(
+            site=str(d.get("site", "")),
+            kind=str(d.get("kind", "error")),
+            p=float(d.get("p", 0.0)),
+            nth=int(d.get("nth", 0)),
+            count=int(d.get("count", 0)),
+            delay_s=float(d.get("delay_s", 0.05)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "site": self.site, "kind": self.kind, "p": self.p,
+            "nth": self.nth, "count": self.count, "delay_s": self.delay_s,
+            "fired": self.fired,
+        }
+
+
+class FaultRegistry:
+    """Process-global fault registry.  ``enabled`` is False until a plan
+    loads; every site guards with ``if FAULTS.enabled:`` first, so the
+    production cost is one attribute load per site."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._plans: dict[str, list[FaultPlan]] = {}  # site → plans
+        self._calls: dict[str, int] = {}  # site → call count (1-based)
+        self._fires: dict[str, int] = {}  # site → fires
+        self.seed = 0
+        self._rng = None  # seeded random.Random while enabled
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, plans: list, seed: int = 0) -> None:
+        """Replace ALL plans (empty list disables).  ``plans`` entries
+        are FaultPlan objects or plain dicts."""
+        import random
+
+        parsed = [
+            p if isinstance(p, FaultPlan) else FaultPlan.from_dict(p)
+            for p in plans
+        ]
+        with self._lock:
+            self._plans = {}
+            for p in parsed:
+                self._plans.setdefault(p.site, []).append(p)
+            self._calls = {}
+            self._fires = {}
+            self.seed = int(seed)
+            self._rng = random.Random(self.seed)
+            self.enabled = bool(self._plans)
+
+    def configure_from_env(self) -> bool:
+        """Load ``TPU_FAULT_PLAN`` (JSON: a plan list, or
+        {"seed": N, "plans": [...]}); returns True when a plan loaded."""
+        raw = os.environ.get("TPU_FAULT_PLAN", "")
+        if not raw:
+            return False
+        self.load_json(raw)
+        return self.enabled
+
+    def load_json(self, raw: str) -> None:
+        spec = json.loads(raw)
+        try:
+            if isinstance(spec, list):
+                self.configure(spec)
+            elif isinstance(spec, dict):
+                plans = spec.get("plans") or []
+                if not isinstance(plans, list):
+                    raise ValueError('"plans" must be a list')
+                self.configure(plans, seed=int(spec.get("seed", 0)))
+            else:
+                raise ValueError(
+                    "fault plan JSON must be a list or an object"
+                )
+        except (TypeError, AttributeError) as e:
+            # wrong-typed FIELDS inside otherwise-valid JSON ({"p": []},
+            # a string where a plan object belongs): one error type for
+            # callers (the HTTP route answers 400, the CLI exits 2)
+            raise ValueError(f"malformed fault plan: {e}") from None
+
+    def clear(self) -> None:
+        self.configure([])
+
+    # -- the site hook -------------------------------------------------------
+
+    def maybe_fire(self, site: str):
+        """Called at a fault site.  Returns None (no fault) or the
+        FaultPlan of a fired ``torn-write`` (the caller implements the
+        tear); other kinds raise/exit and never return."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            plans = self._plans.get(site)
+            if not plans:
+                return None
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            firing = None
+            for p in plans:
+                if p.count and p.fired >= p.count:
+                    continue
+                if (p.nth and n == p.nth) or (
+                    p.p and self._rng.random() < p.p
+                ):
+                    p.fired += 1
+                    self._fires[site] = self._fires.get(site, 0) + 1
+                    firing = p
+                    break
+            if firing is None:
+                return None
+            kind = firing.kind
+            delay = firing.delay_s
+        # act OUTSIDE the lock: a timeout's sleep (or a crash) must not
+        # hold the registry against every other site
+        if kind == "error":
+            raise InjectedFault(f"injected fault at {site}")
+        if kind == "timeout":
+            import time
+
+            time.sleep(delay)
+            raise InjectedTimeout(f"injected timeout at {site}")
+        if kind == "partition":
+            raise InjectedPartition(f"injected partition at {site}")
+        if kind == "crash":
+            os._exit(137)
+        return firing  # torn-write: the site implements the tear
+
+    # -- introspection (/debug/faults) ---------------------------------------
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "seed": self.seed,
+                "plans": [
+                    p.to_dict()
+                    for plans in self._plans.values()
+                    for p in plans
+                ],
+                "calls": dict(self._calls),
+                "fires": dict(self._fires),
+            }
+
+
+# Process-global instance (TRACER/JOURNAL/PROFILER pattern): sites import
+# this and check .enabled first.
+FAULTS = FaultRegistry()
+
+# one env probe at import so subprocess-driven chaos (tools/check_ha.py
+# spawning a leader with TPU_FAULT_PLAN set) needs no plumbing
+try:
+    FAULTS.configure_from_env()
+except (ValueError, json.JSONDecodeError):  # a bad env plan must not
+    pass  # poison every import — the CLI surfaces the parse error

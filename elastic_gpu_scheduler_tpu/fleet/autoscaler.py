@@ -518,40 +518,54 @@ class SchedulerGangExecutor:
         self.serial = 0
         self.pods: dict[str, object] = {}  # replica name → Pod
 
-    def _post(self, path: str, body: dict) -> dict:
+    # scheduler 503s are leaderless-window answers (leader fencing/
+    # failing over; routes.py stamps Retry-After) — retried under the
+    # shared jittered backoff honoring the server's floor, bounded by
+    # one deadline per operation.  Anything else fails fast: a 4xx/5xx
+    # with a body is a real verdict, not a window.
+    RETRY_DEADLINE_S = 15.0
+
+    def _request(self, method: str, path: str, body=None) -> dict:
         import http.client
 
-        conn = http.client.HTTPConnection(
-            *self.scheduler_addr, timeout=self.http_timeout_s
-        )
-        try:
-            conn.request(
-                "POST", path, json.dumps(body),
-                {"Content-Type": "application/json"},
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base_s=0.25, max_s=5.0,
+                     deadline_s=self.RETRY_DEADLINE_S)
+        while True:
+            conn = http.client.HTTPConnection(
+                *self.scheduler_addr, timeout=self.http_timeout_s
             )
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status != 200:
-                raise RuntimeError(f"{path} -> {resp.status}: {data[:200]}")
-            return json.loads(data)
-        finally:
-            conn.close()
+            try:
+                if method == "POST":
+                    conn.request(
+                        "POST", path, json.dumps(body),
+                        {"Content-Type": "application/json"},
+                    )
+                else:
+                    conn.request("GET", path)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 503:
+                    try:
+                        floor = float(resp.headers.get("Retry-After", "0"))
+                    except (TypeError, ValueError):
+                        floor = 0.0
+                    if bo.sleep(floor_s=min(floor, 5.0)):
+                        continue
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"{path} -> {resp.status}: {data[:200]}"
+                    )
+                return json.loads(data)
+            finally:
+                conn.close()
+
+    def _post(self, path: str, body: dict) -> dict:
+        return self._request("POST", path, body)
 
     def _get(self, path: str) -> dict:
-        import http.client
-
-        conn = http.client.HTTPConnection(
-            *self.scheduler_addr, timeout=self.http_timeout_s
-        )
-        try:
-            conn.request("GET", path)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status != 200:
-                raise RuntimeError(f"{path} -> {resp.status}: {data[:200]}")
-            return json.loads(data)
-        finally:
-            conn.close()
+        return self._request("GET", path)
 
     def _node_generations(self) -> dict[str, str]:
         # summary mode: one aggregate poll instead of a node-list walk
@@ -653,10 +667,15 @@ class SchedulerGangExecutor:
         if r is None:
             return False
         # wait for the router's in-flight streams to the replica to end
-        # (it is already draining — no new sessions arrive)
-        deadline = time.monotonic() + self.drain_timeout_s
-        while time.monotonic() < deadline and r.inflight > 0:
-            time.sleep(0.02)
+        # (it is already draining — no new sessions arrive); jittered
+        # growth instead of the old constant 20ms busy-poll — long
+        # drains back off to coarse checks, short ones stay snappy
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base_s=0.02, max_s=0.5, jitter=0.3,
+                     deadline_s=self.drain_timeout_s)
+        while r.inflight > 0 and bo.sleep():
+            pass
         if r.inflight > 0:
             return False  # still streaming: refuse, autoscaler restores
         pod = self.pods.pop(name, None)

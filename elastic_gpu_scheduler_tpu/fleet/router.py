@@ -55,6 +55,7 @@ from collections import OrderedDict
 from http.server import ThreadingHTTPServer
 from typing import Optional
 
+from ..faultinject import FAULTS
 from ..metrics import (
     FLEET_REPLICAS,
     FLEET_ROUTE_OVERHEAD,
@@ -63,6 +64,7 @@ from ..metrics import (
 )
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..utils import prefixdigest
+from ..utils.backoff import Backoff
 from ..utils.tpuprobe import RELAY_MONITOR
 
 log = logging.getLogger("tpu-scheduler")
@@ -108,6 +110,12 @@ class Replica:
         self._state_lock = threading.Lock()
         self.consecutive_failures = 0
         self.breaker_open_until = 0.0  # monotonic; 0 = closed
+        # breaker cooldown policy (utils/backoff): each re-open after a
+        # failed half-open probe grows the cooldown exponentially, and
+        # EVERY cooldown is jittered — a fleet-wide flap that opened
+        # every breaker in one instant must not close them all in one
+        # instant either (the synchronized half-open probe storm)
+        self._breaker_backoff = Backoff(base_s=0.0, max_s=120.0, jitter=0.5)
         # requests this router is relaying right now.  '+= 1' on an
         # attribute is LOAD/ADD/STORE — not atomic across handler
         # threads, and a lost decrement would block scale-down forever
@@ -146,7 +154,11 @@ class Replica:
     def note_failure(self, threshold: int, cooldown_s: float) -> None:
         self.consecutive_failures += 1
         if self.consecutive_failures >= threshold:
-            self.breaker_open_until = time.monotonic() + cooldown_s
+            # jittered, escalating cooldown: base follows the configured
+            # cooldown, repeat opens back off exponentially (capped)
+            bo = self._breaker_backoff
+            bo.base_s = max(0.01, float(cooldown_s))
+            self.breaker_open_until = time.monotonic() + bo.next_delay()
             self.state = "down"
             self.state_reason = (
                 f"circuit breaker open ({self.consecutive_failures} "
@@ -156,6 +168,7 @@ class Replica:
     def note_success(self) -> None:
         self.consecutive_failures = 0
         self.breaker_open_until = 0.0
+        self._breaker_backoff.reset()
 
     def to_dict(self) -> dict:
         return {
@@ -260,6 +273,8 @@ class ReplicaSet:
         """Tiny one-shot GET (no http.client: its default parsing is
         fine, but a 3-line raw exchange keeps the probe dependency-free
         and its timeout semantics obvious)."""
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("router.probe")
         with socket.create_connection(
             replica.addr, timeout=self.probe_timeout_s
         ) as s:
@@ -568,6 +583,10 @@ class FleetRouter:
         written if the backend is unreachable or answers 5xx, so the
         caller can fail over cleanly."""
         t0 = time.perf_counter()
+        if FAULTS.enabled:
+            # router→replica socket: 'partition'/'error' here exercises
+            # the before-first-client-byte failover path deterministically
+            FAULTS.maybe_fire("router.connect")
         bs = socket.create_connection(replica.addr, timeout=5.0)
         try:
             bs.settimeout(self.backend_timeout_s)

@@ -80,10 +80,13 @@ import zlib
 from collections import OrderedDict, deque
 from typing import Iterator, Optional
 
+from ..faultinject import FAULTS
+
 __all__ = [
     "Journal",
     "JOURNAL",
     "option_record",
+    "parse_records",
     "read_journal",
     "read_segment",
     "segment_paths",
@@ -133,20 +136,17 @@ def segment_paths(dirpath: str) -> list[str]:
     return [os.path.join(dirpath, n) for n in segs]
 
 
-def read_segment(path: str) -> tuple[list[dict], bool, int]:
-    """Parse one segment.  Returns (records, torn, good_bytes): ``torn``
-    is True when the segment ends in a record that fails the length/CRC
-    check (crash mid-write) — everything before is trusted, nothing
-    after; ``good_bytes`` is the offset of the first bad byte (what
-    ``configure`` truncates to when repairing a crashed tail).
+def parse_records(data: bytes) -> tuple[list[dict], bool, int]:
+    """Parse a byte run of journal wire lines.  Returns (records, torn,
+    good_bytes): ``torn`` is True when the run ends in a record that
+    fails the length/CRC check (crash mid-write, or a shipping stream
+    cut mid-record) — everything before is trusted, nothing after;
+    ``good_bytes`` is the offset of the first bad byte.
 
     JSON payloads never contain a raw newline (json.dumps escapes), so
-    line-splitting cannot cut a valid record."""
-    try:
-        with open(path, "rb") as f:
-            data = f.read()
-    except OSError:
-        return [], True, 0
+    line-splitting cannot cut a valid record.  Shared by segment reads
+    and the journal-shipping follower (journal/ship.py), so both sides
+    of the wire trust bytes by exactly the same rule."""
     out: list[dict] = []
     pos = 0
     for line in data.split(b"\n"):
@@ -168,6 +168,18 @@ def read_segment(path: str) -> tuple[list[dict], bool, int]:
         out.append(rec)
         pos += len(line) + 1
     return out, False, len(data)
+
+
+def read_segment(path: str) -> tuple[list[dict], bool, int]:
+    """Parse one segment file (see ``parse_records`` for the trust
+    rule); ``good_bytes`` is what ``configure`` truncates to when
+    repairing a crashed tail."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], True, 0
+    return parse_records(data)
 
 
 def read_journal(dirpath: str) -> list[dict]:
@@ -228,6 +240,7 @@ class Journal:
         self._fh = None
         self._segment_index = 0
         self._segment_bytes = 0
+        self._poisoned = False  # last write failed; reopen = fresh segment
         self._thread: Optional[threading.Thread] = None
         self._stop = False
 
@@ -303,6 +316,7 @@ class Journal:
             self._rotations = self._pruned = 0
             self._tail.clear()
             self._pod_seqs.clear()
+            self._poisoned = False
             self._stop = False
             self.enabled = True
         self._open_segment()
@@ -337,6 +351,35 @@ class Journal:
             except OSError:
                 pass
             self._fh = None
+
+    def abort(self) -> None:
+        """Crash simulation (HA tests/chaos gate): stop WITHOUT draining
+        — buffered records that never reached the writer are dropped,
+        exactly what kill -9 loses.  The file handle is abandoned, not
+        closed (closing would flush Python's userspace buffer — bytes a
+        real crash never writes)."""
+        t = self._thread
+        with self._cond:
+            dropped = len(self._buf)
+            self._buf = []
+            self._dropped += dropped
+            self.enabled = False
+            self._stop = True
+            self._cond.notify_all()
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        self._fh = None  # abandoned, never flushed
+
+    def request_checkpoint(self) -> None:
+        """Ask the writer to emit a full-state boot checkpoint with its
+        next batch (HA warm takeover: the new leader's journal must be
+        self-contained — replayable without the previous leader's
+        stream — so takeover snapshots the adopted state here instead of
+        re-journaling 10k node_add/bind re-assertions)."""
+        with self._cond:
+            self._pending_checkpoint = True
+            self._cond.notify_all()
 
     # -- hot path ------------------------------------------------------------
 
@@ -470,6 +513,8 @@ class Journal:
         if self._fh is None:
             return
         try:
+            if FAULTS.enabled:
+                FAULTS.maybe_fire("journal.fsync")
             self._fh.flush()
             os.fsync(self._fh.fileno())
         except OSError:
@@ -496,7 +541,34 @@ class Journal:
                 written_lines = 0
                 try:
                     if self._fh is None:  # recover from an earlier failure
-                        self._open_segment()
+                        if self._poisoned:
+                            # the failed segment may end in a PARTIAL
+                            # record — REPAIR it (truncate back to its
+                            # last valid record, same rule as the
+                            # configure() crash repair): CRC readers
+                            # stop at the first bad line, so a tear left
+                            # mid-journal would strand every later
+                            # segment for replay AND the shipping
+                            # stream.  Then recover onto a FRESH segment
+                            # headed by a state checkpoint; records the
+                            # failed batch lost stay visible as an
+                            # honest seq gap.
+                            try:
+                                prev = os.path.join(
+                                    self.dir, self._segment_name()
+                                )
+                                _recs, torn, good = read_segment(prev)
+                                if torn:
+                                    with open(prev, "r+b") as f:
+                                        f.truncate(good)
+                            except OSError:
+                                pass  # unreadable: rotation still moves on
+                            self._poisoned = False
+                            self._segment_index += 1
+                            self._open_segment()
+                            self._write_checkpoint()
+                        else:
+                            self._open_segment()
                     if (
                         self._pending_checkpoint
                         and self.checkpoint_provider is not None
@@ -507,6 +579,23 @@ class Journal:
                         self._write_checkpoint()
                     for rec in batch:
                         line = _encode(rec)
+                        if FAULTS.enabled:
+                            # deterministic chaos: 'error' fails the
+                            # batch like a dead disk; 'torn-write' emits
+                            # a PARTIAL record then fails — byte-for-byte
+                            # the tail kill -9 leaves mid-write (the
+                            # repair path in configure() and the
+                            # follower's CRC check both train on it)
+                            directive = FAULTS.maybe_fire("journal.write")
+                            if (
+                                directive is not None
+                                and directive.kind == "torn-write"
+                            ):
+                                self._fh.write(line[: max(1, len(line) // 2)])
+                                self._fh.flush()
+                                raise OSError(
+                                    "injected torn write at journal.write"
+                                )
                         self._fh.write(line)
                         written_lines += 1
                         if written_lines % 16 == 0:
@@ -535,6 +624,7 @@ class Journal:
                     except OSError:
                         pass
                     self._fh = None
+                    self._poisoned = True  # reopen on a FRESH segment
                     dirty = False
             now = time.monotonic()
             if dirty and (

@@ -23,6 +23,14 @@ Three consumers:
 - ``what_if(events, rater)``: replay the recorded workload but let a
   DIFFERENT rater choose each placement — offline placement-policy
   scoring against real recorded demand (the Gavel/Tesserae use case).
+
+HA: ``replay()`` is a thin wrapper over the INCREMENTAL ``ReplayEngine``
+(``apply()`` one record at a time) so a warm standby (journal/ship.py's
+``JournalFollower``) can keep a live ChipSet + pod ledger current as the
+leader's stream arrives, instead of re-running a batch replay per poll.
+The engine's state is what ``scheduler/ha.warm_takeover`` swaps into a
+scheduler on ``on_started_leading`` — the whole point of shipping the
+journal is that this state is ALREADY BUILT when the leader dies.
 """
 
 from __future__ import annotations
@@ -133,6 +141,14 @@ class ReplayResult:
     policy_faults: int = 0
     last_policy: Optional[dict] = None
     policy_decisions: dict = field(default_factory=dict)  # pod → decision
+    # HA takeover annotations (scheduler/ha.py): a new leader journaled
+    # that it adopted a follower's replayed state and diff-resynced
+    # against the annotation ledger — counted, dense-seq audited, zero
+    # allocator mutation (the adopted state's mutations were journaled
+    # by the PREVIOUS leader; this leader's own journal opens with a
+    # boot checkpoint)
+    ha_takeovers: int = 0
+    last_takeover: Optional[dict] = None
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -161,6 +177,7 @@ class ReplayResult:
             "policy_records": self.policy_records,
             "policy_faults": self.policy_faults,
             "policy_decisions": len(self.policy_decisions),
+            "ha_takeovers": self.ha_takeovers,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -219,15 +236,34 @@ def _boot_from_checkpoint(rec: dict, res: ReplayResult) -> None:
         )
 
 
-def replay(events: list[dict]) -> ReplayResult:
-    """Rebuild state from a record stream; every anomaly is collected,
-    never raised — a corrupt journal must yield a report, not a
-    traceback."""
-    res = ReplayResult()
-    expected_seq: Optional[int] = None
-    booted_from_checkpoint = False
-    boot_as_of = -1
-    for rec in events:
+class ReplayEngine:
+    """Incremental replay: ``apply()`` one record at a time into a live
+    ``ReplayResult``.  ``replay()`` below wraps it for batch callers;
+    the journal-shipping follower (journal/ship.py) feeds it the
+    leader's stream as it arrives, keeping a warm standby's state
+    CURRENT instead of re-replaying the whole journal per poll.
+
+    Every anomaly is collected in ``result.violations``, never raised —
+    a corrupt journal must yield a report, not a traceback.
+    ``conservation_violations()`` runs the end-of-stream post-conditions
+    on demand (a follower checks them at takeover, not per record)."""
+
+    def __init__(self):
+        self.result = ReplayResult()
+        self._expected_seq: Optional[int] = None
+        self._booted_from_checkpoint = False
+        self._boot_as_of = -1
+
+    def next_seq(self) -> Optional[int]:
+        """The sequence number the stream should produce next (None
+        before anything seq-bearing — or a checkpoint boot — arrived).
+        The shipping follower keys its dedup/gap decisions off this, so
+        they stay correct across a checkpoint boot (where ``last_seq``
+        is still -1 but the snapshot already covers a prefix)."""
+        return self._expected_seq
+
+    def apply(self, rec: dict) -> None:
+        res = self.result
         res.records += 1
         t = rec.get("type")
         if t == "checkpoint":
@@ -235,35 +271,36 @@ def replay(events: list[dict]) -> ReplayResult:
             # stream).  Mid-stream copies are redundant re-assertions;
             # the FIRST record being one means the prefix was pruned and
             # this snapshot is the boot state.
-            if expected_seq is None and not res.nodes and not res.pods:
+            if self._expected_seq is None and not res.nodes and not res.pods:
                 _boot_from_checkpoint(rec, res)
-                booted_from_checkpoint = True
-                boot_as_of = rec.get("as_of_seq", -1)
-                if boot_as_of >= 0:
+                self._booted_from_checkpoint = True
+                self._boot_as_of = rec.get("as_of_seq", -1)
+                if self._boot_as_of >= 0:
                     # the dense-seq audit must hold ACROSS the boot
                     # boundary too: the first applied record is as_of+1
                     # unless something was lost
-                    expected_seq = boot_as_of + 1
-            continue
+                    self._expected_seq = self._boot_as_of + 1
+            return
         seq = rec.get("seq", -1)
-        if booted_from_checkpoint and seq <= boot_as_of:
+        if self._booted_from_checkpoint and seq <= self._boot_as_of:
             # appended before the boot snapshot → its mutation is already
             # inside the checkpoint; re-applying would double-book (bind)
             # or double-free (forget)
-            continue
-        if expected_seq is None:
-            if seq > 0 and not booted_from_checkpoint:
+            return
+        if self._expected_seq is None:
+            if seq > 0 and not self._booted_from_checkpoint:
                 res.violations.append(
                     f"journal starts mid-stream at seq {seq} with no "
                     "checkpoint — prefix pruned/lost; state cannot be "
                     "reconstructed"
                 )
-        elif seq != expected_seq:
+        elif seq != self._expected_seq:
             res.violations.append(
-                f"seq gap: expected {expected_seq}, found {seq} — records "
-                "lost (writer drops or a pruned/torn segment mid-stream)"
+                f"seq gap: expected {self._expected_seq}, found {seq} — "
+                "records lost (writer drops or a pruned/torn segment "
+                "mid-stream)"
             )
-        expected_seq = seq + 1
+        self._expected_seq = seq + 1
         res.last_seq = seq
         where = f"seq {seq}"
         if t in ("node_add", "node_resync"):
@@ -272,7 +309,7 @@ def replay(events: list[dict]) -> ReplayResult:
                 cs = _chipset_from_record(rec)
             except Exception as e:
                 res.violations.append(f"{where}: bad {t} record: {e}")
-                continue
+                return
             if rec.get("reset"):
                 # layout-change resync: the live allocator rebuilt the
                 # ChipSet and WIPED usage while the scheduler ledger kept
@@ -306,12 +343,12 @@ def replay(events: list[dict]) -> ReplayResult:
                 res.violations.append(
                     f"{where}: bind {pod} on unknown node {node}"
                 )
-                continue
+                return
             try:
                 opt = option_from_record(rec["option"])
             except Exception as e:
                 res.violations.append(f"{where}: bad bind option: {e}")
-                continue
+                return
             if pod in res.pods:
                 lp = res.pods[pod]
                 if lp.node == node and lp.option.allocs == opt.allocs:
@@ -321,19 +358,19 @@ def replay(events: list[dict]) -> ReplayResult:
                     # state.  (Scores may differ: annotation recovery
                     # rebuilds options with score 0.)
                     lp.seq = seq
-                    continue
+                    return
                 res.violations.append(
                     f"{where}: double bind of {pod} (already live on "
                     f"{res.pods[pod].node} since seq {res.pods[pod].seq} "
                     "with a different placement)"
                 )
-                continue
+                return
             if not cs.can_transact(opt):
                 res.violations.append(
                     f"{where}: bind {pod} on {node} double-books a chip "
                     f"(placement no longer fits the replayed state)"
                 )
-                continue
+                return
             cs.transact(opt)
             res.pods[pod] = _LivePod(
                 node=node, option=opt, uid=rec.get("uid", ""),
@@ -346,21 +383,21 @@ def replay(events: list[dict]) -> ReplayResult:
                 # legitimate race: a pod deleted mid-gang-commit journals
                 # a forget before its bind was ever journaled
                 res.warnings.append(f"{where}: forget of unbound pod {pod}")
-                continue
+                return
             if not lp.charged:
-                continue  # reset-resync wiped its charge; nothing to free
+                return  # reset-resync wiped its charge; nothing to free
             cs = res.nodes.get(lp.node)
             if cs is None:
                 res.violations.append(
                     f"{where}: forget {pod} on unknown node {lp.node}"
                 )
-                continue
+                return
             if not cs.can_cancel(lp.option):
                 res.violations.append(
                     f"{where}: forget {pod} would free capacity not "
                     f"charged on {lp.node} (double free / inflation)"
                 )
-                continue
+                return
             cs.cancel(lp.option)
         elif t == "migrate":
             # defrag live migration: one atomic evict→rebind.  Invariant:
@@ -375,25 +412,25 @@ def replay(events: list[dict]) -> ReplayResult:
                 res.violations.append(
                     f"{where}: migrate of unbound pod {pod}"
                 )
-                continue
+                return
             try:
                 new = option_from_record(rec["option"])
                 old = option_from_record(rec["option_old"])
             except Exception as e:
                 res.violations.append(f"{where}: bad migrate option: {e}")
-                continue
+                return
             if option_demand(old) != option_demand(new):
                 res.violations.append(
                     f"{where}: migrate {pod} does not conserve per-pod "
                     "chip demand (chips created or destroyed in flight)"
                 )
-                continue
+                return
             if lp.node != frm or lp.option.allocs != old.allocs:
                 res.violations.append(
                     f"{where}: migrate {pod} from {frm} does not match "
                     f"its live placement (on {lp.node} since seq {lp.seq})"
                 )
-                continue
+                return
             cs_to = res.nodes.get(to)
             cs_from = res.nodes.get(frm)
             if cs_to is None or cs_from is None:
@@ -401,13 +438,13 @@ def replay(events: list[dict]) -> ReplayResult:
                     f"{where}: migrate {pod} touches unknown node "
                     f"{frm if cs_from is None else to}"
                 )
-                continue
+                return
             if not cs_to.can_transact(new):
                 res.violations.append(
                     f"{where}: migrate {pod} onto {to} double-books a "
                     "chip (destination no longer fits the replayed state)"
                 )
-                continue
+                return
             cs_to.transact(new)
             if lp.charged:
                 if cs_from.can_cancel(old):
@@ -592,37 +629,72 @@ def replay(events: list[dict]) -> ReplayResult:
                         f"{where}: resize of gang {gang}: removed member "
                         f"{r} is still bound"
                     )
+        elif t == "ha_takeover":
+            # warm-takeover summary (scheduler/ha.py): the new leader
+            # adopted a follower's replayed state and diff-resynced
+            # against the annotation ledger.  An ANNOTATION — the diff's
+            # actual mutations (add_pod binds / forgets) journaled
+            # individually around it; participates in the dense-seq
+            # audit, never mutates allocator state here.
+            res.ha_takeovers += 1
+            res.last_takeover = {
+                "seq": seq,
+                "t": rec.get("t"),
+                "nodes": rec.get("nodes"),
+                "pods": rec.get("pods"),
+                "adopted_seq": rec.get("adopted_seq"),
+                "diff_added": rec.get("diff_added"),
+                "diff_removed": rec.get("diff_removed"),
+                "wall_ms": rec.get("wall_ms"),
+            }
         else:
             res.warnings.append(f"{where}: unknown record type {t!r}")
 
-    # post-conditions: per-node capacity conservation — the chips charged
-    # by live pods must account exactly for total - avail
-    for node, cs in sorted(res.nodes.items()):
-        exp_core = exp_hbm = 0
-        for lp in res.pods.values():
-            if lp.node != node or not lp.charged:
-                continue
-            for a in lp.option.allocs:
-                if not a.needs_tpu:
+    def conservation_violations(self) -> list[str]:
+        """End-of-stream post-conditions: per-node capacity conservation
+        — the chips charged by live pods must account exactly for
+        total - avail.  Returns a FRESH list (never appended to the
+        result), so a follower can audit repeatedly while streaming."""
+        res = self.result
+        out: list[str] = []
+        for node, cs in sorted(res.nodes.items()):
+            exp_core = exp_hbm = 0
+            for lp in res.pods.values():
+                if lp.node != node or not lp.charged:
                     continue
-                for c in a.coords:
-                    i = cs._slot.get(c)
-                    if i is None:
+                for a in lp.option.allocs:
+                    if not a.needs_tpu:
                         continue
-                    if a.whole:
-                        exp_core += cs._core_total[i]
-                        exp_hbm += cs._hbm_total[i]
-                    else:
-                        exp_core += a.core
-                        exp_hbm += a.hbm
-        used_core = cs.total_core() - cs.avail_core()
-        used_hbm = cs.total_hbm() - cs.avail_hbm()
-        if used_core != exp_core or used_hbm != exp_hbm:
-            res.violations.append(
-                f"node {node}: capacity not conserved — chips show "
-                f"core={used_core}/hbm={used_hbm} in use but live pods "
-                f"charge core={exp_core}/hbm={exp_hbm}"
-            )
+                    for c in a.coords:
+                        i = cs._slot.get(c)
+                        if i is None:
+                            continue
+                        if a.whole:
+                            exp_core += cs._core_total[i]
+                            exp_hbm += cs._hbm_total[i]
+                        else:
+                            exp_core += a.core
+                            exp_hbm += a.hbm
+            used_core = cs.total_core() - cs.avail_core()
+            used_hbm = cs.total_hbm() - cs.avail_hbm()
+            if used_core != exp_core or used_hbm != exp_hbm:
+                out.append(
+                    f"node {node}: capacity not conserved — chips show "
+                    f"core={used_core}/hbm={used_hbm} in use but live pods "
+                    f"charge core={exp_core}/hbm={exp_hbm}"
+                )
+        return out
+
+
+def replay(events: list[dict]) -> ReplayResult:
+    """Rebuild state from a record stream; every anomaly is collected,
+    never raised — a corrupt journal must yield a report, not a
+    traceback.  (Batch wrapper over the incremental ``ReplayEngine``.)"""
+    eng = ReplayEngine()
+    for rec in events:
+        eng.apply(rec)
+    res = eng.result
+    res.violations.extend(eng.conservation_violations())
     return res
 
 
@@ -789,7 +861,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                 observe_profile(rec)
             continue
         if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
-                 "gang_admit", "gang_rollback"):
+                 "gang_admit", "gang_rollback", "ha_takeover"):
             # annotations (autoscaler evaluations / resize summaries /
             # policy-plane events / compile warm-ups / gang admit+rollback
             # markers): the member binds/forgets/migrates around a

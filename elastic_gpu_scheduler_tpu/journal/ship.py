@@ -1,0 +1,350 @@
+"""Journal shipping: the leader serves its journal as a resumable
+stream; a follower replays it into live state (the warm-standby half of
+the HA control plane).
+
+Before this module, ``--leader-elect`` standbys started COLD: a new
+leader rebuilt all state from the annotation ledger — one ``get_node``
+plus one ``list_pods`` per materialized node, then an option replay per
+pod, then index/profile warm-up — a full resync on every failover
+(ROADMAP item 2's availability gap).  The journal is already the source
+of truth (snapshot+log, deterministic replay); shipping it makes the
+standby's state CURRENT before the leader dies:
+
+- **Server** (``stream_since``, mounted at ``GET /journal/stream`` on
+  the scheduler server): serves sealed segments plus a long-polled live
+  tail in the journal's own wire format (CRC per record — the follower
+  trusts bytes by exactly the same rule a segment reader does).
+  ``from_seq`` resumes mid-stream; ``from_seq=0`` serves from the oldest
+  segment INCLUDING its head checkpoint, so a fresh follower boots the
+  same way a pruned-prefix replay does.  A response never splits a
+  record (records are serialized lines), but a fault-injected or
+  network-cut TORN TAIL is detected by the follower's CRC check and
+  simply re-requested — resume-from-seq makes the stream idempotent.
+
+- **Follower** (``JournalFollower``, CLI ``--follow <leader-url>``):
+  long-polls the stream and feeds each record through the incremental
+  ``ReplayEngine`` — live ChipSet + pod ledger + generations, the state
+  ``scheduler/ha.warm_takeover`` swaps in on ``on_started_leading``.
+  Lag is exported as ``tpu_ha_follow_lag_seqs`` / ``_seconds``.  A SEQ
+  GAP (records lost between leader and follower — pruned past our
+  position, or a writer drop) HARD-FAILS the follower: a standby whose
+  state silently skipped mutations would take over with a corrupt
+  ledger, which is strictly worse than a cold start.  Transport errors
+  (leader restarting, partitions) are NOT gaps: the follower backs off
+  (``utils/backoff``) and resumes from its last applied seq.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from ..faultinject import FAULTS
+from ..metrics import HA_FOLLOW_LAG_SECONDS, HA_FOLLOW_LAG_SEQS
+from ..utils.backoff import Backoff
+from . import _encode, parse_records, read_segment, segment_paths
+from .replay import ReplayEngine
+
+log = logging.getLogger("tpu-scheduler")
+
+__all__ = ["JournalFollower", "stream_since", "segment_first_seq"]
+
+# one shipping response is bounded so a follower far behind catches up
+# in chunks instead of buffering the whole journal in one HTTP body
+DEFAULT_MAX_BYTES = 4 << 20
+
+
+def segment_first_seq(path: str) -> Optional[int]:
+    """The first sequence number a segment CONTRIBUTES: its first
+    seq-bearing record, or (for a segment headed by a checkpoint)
+    ``as_of_seq + 1``.  None for an unreadable/empty segment.  Reads at
+    most the head of the file — the stream server uses this to skip
+    whole segments below ``from_seq`` without parsing them."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(1 << 20)
+    except OSError:
+        return None
+    recs, _torn, _good = parse_records(head)
+    for rec in recs:
+        if "seq" in rec:
+            return rec["seq"]
+        if rec.get("type") == "checkpoint":
+            return int(rec.get("as_of_seq", -1)) + 1
+    return None
+
+
+def stream_since(
+    journal,
+    from_seq: int,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+    wait_s: float = 0.0,
+) -> tuple[bytes, int]:
+    """Encode every available record with ``seq >= from_seq`` (plus the
+    boot checkpoint when serving from the journal's head) in the wire
+    format, up to ``max_bytes``.  Long-poll: with ``wait_s`` > 0 and
+    nothing new, parks until a record lands or the wait expires.
+    Returns ``(payload, last_seq)`` — ``last_seq`` is the newest seq the
+    LEADER has assigned (the follower's lag numerator), not the newest
+    in the payload."""
+    if FAULTS.enabled:
+        FAULTS.maybe_fire("ship.stream")
+    deadline = time.monotonic() + max(0.0, wait_s)
+    while True:
+        # cheap in-memory guard first: a caught-up follower's long poll
+        # must park on the assigned-seq counter, not re-read and
+        # CRC-parse the live segment from disk every 50ms (that was
+        # continuous wasted I/O per idle follower).  last_seq() >=
+        # from_seq is necessary for _collect to return anything —
+        # assigned-but-unflushed records just mean one more 50ms lap.
+        if journal.last_seq() >= from_seq:
+            payload = _collect(journal, from_seq, max_bytes)
+            if payload:
+                return payload, journal.last_seq()
+        if time.monotonic() >= deadline:
+            return b"", journal.last_seq()
+        # the writer flushes batches within its 100ms poll; half that
+        # keeps tail latency low without busy-spinning the handler
+        time.sleep(0.05)
+
+
+def _collect(journal, from_seq: int, max_bytes: int) -> bytes:
+    dirpath = journal.dir
+    if not dirpath:
+        return b""
+    out: list[bytes] = []
+    size = 0
+    served_any = False
+    paths = segment_paths(dirpath)
+    for i, path in enumerate(paths):
+        if not served_any and i + 1 < len(paths):
+            # skip whole segments strictly below from_seq (the NEXT
+            # segment's first seq tells us this one contributes nothing)
+            nxt = segment_first_seq(paths[i + 1])
+            if nxt is not None and nxt <= from_seq:
+                continue
+        recs, torn, _good = read_segment(path)
+        for rec in recs:
+            seq = rec.get("seq")
+            if seq is None:
+                # checkpoint: ship it only when it carries state the
+                # follower does not already cover (as_of >= from_seq —
+                # the boot-after-prune case); a caught-up follower must
+                # NOT be re-sent the head checkpoint every poll
+                if rec.get("type") != "checkpoint":
+                    continue
+                if served_any or int(rec.get("as_of_seq", -1)) < from_seq:
+                    continue
+            elif seq < from_seq:
+                continue
+            line = _encode(rec)
+            if size + len(line) > max_bytes and served_any:
+                return b"".join(out)
+            out.append(line)
+            size += len(line)
+            served_any = True
+        if torn:
+            break  # nothing after a tear has continuity
+    return b"".join(out)
+
+
+class JournalFollower:
+    """Continuously replay a leader's journal stream into live state.
+
+    States: ``following`` (healthy; transport errors retry under
+    backoff), ``failed`` (seq gap — HARD stop, see module docstring),
+    ``stopped``.  ``engine.result`` holds the replayed ChipSets/pods —
+    read it only after ``stop()`` (the poll thread mutates it)."""
+
+    def __init__(
+        self,
+        leader_url: str,
+        wait_s: float = 10.0,
+        timeout_s: float = 30.0,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        backoff: Optional[Backoff] = None,
+    ):
+        self.leader_url = leader_url.rstrip("/")
+        self.wait_s = max(0.0, float(wait_s))
+        self.timeout_s = max(self.wait_s + 5.0, float(timeout_s))
+        self.max_bytes = max_bytes
+        self.backoff = backoff if backoff is not None else Backoff(
+            base_s=0.2, max_s=10.0
+        )
+        self.engine = ReplayEngine()
+        self.state = "init"
+        self.error: Optional[str] = None
+        self.leader_last_seq = -1
+        self.last_applied_t: Optional[float] = None  # record wall clock
+        self.polls = 0
+        self.records_applied = 0
+        self.transport_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lag -----------------------------------------------------------------
+
+    @property
+    def applied_seq(self) -> int:
+        return self.engine.result.last_seq
+
+    def lag_seqs(self) -> int:
+        return max(0, self.leader_last_seq - self.applied_seq)
+
+    def lag_seconds(self) -> float:
+        if self.lag_seqs() == 0 or self.last_applied_t is None:
+            return 0.0
+        return max(0.0, time.time() - self.last_applied_t)
+
+    def _export_lag(self) -> None:
+        HA_FOLLOW_LAG_SEQS.set(value=float(self.lag_seqs()))
+        HA_FOLLOW_LAG_SECONDS.set(value=round(self.lag_seconds(), 3))
+
+    # -- polling -------------------------------------------------------------
+
+    def poll_once(self, wait_s: Optional[float] = None) -> int:
+        """One stream request; returns records applied.  Raises OSError
+        on transport failure (the loop backs off), RuntimeError on a seq
+        gap (the loop hard-fails)."""
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("ship.follow")
+        from_seq = self.applied_seq + 1
+        q = urllib.parse.urlencode({
+            "from_seq": from_seq,
+            "wait_s": self.wait_s if wait_s is None else wait_s,
+            "max_bytes": self.max_bytes,
+        })
+        url = f"{self.leader_url}/journal/stream?{q}"
+        req = urllib.request.Request(url)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                data = resp.read()
+                leader_seq = resp.headers.get("X-Journal-Last-Seq")
+        except urllib.error.HTTPError as e:
+            raise OSError(f"/journal/stream -> {e.code}") from None
+        self.polls += 1
+        if leader_seq is not None:
+            try:
+                self.leader_last_seq = int(leader_seq)
+            except ValueError:
+                pass
+            else:
+                if self.leader_last_seq < self.applied_seq:
+                    # seq REGRESSION: the leader restarted with a
+                    # fresh/wiped journal (new incarnation, seqs from
+                    # 0).  Applying its records on top of the previous
+                    # incarnation's state would merge two histories
+                    # into one standby ledger — hard-fail, like a gap
+                    self.state = "failed"
+                    self.error = (
+                        f"seq regression: applied up to "
+                        f"{self.applied_seq} but the leader's journal "
+                        f"only reaches {self.leader_last_seq} — the "
+                        "leader restarted with a new journal; restart "
+                        "this follower to re-replay the new stream"
+                    )
+                    raise RuntimeError(self.error)
+        recs, torn, _good = parse_records(data)
+        if torn:
+            # a cut/injected tear: everything before it is trusted, the
+            # torn record is NOT applied — the next poll re-requests it
+            # by seq (idempotent resume; never a gap)
+            log.warning(
+                "journal follower: torn tail in stream response "
+                "(%d clean records kept); re-requesting", len(recs),
+            )
+        applied = 0
+        for rec in recs:
+            seq = rec.get("seq")
+            if seq is not None:
+                expected = self.engine.next_seq()
+                if expected is not None and seq < expected:
+                    continue  # server overlap on resume — already applied
+                if expected is not None and seq > expected:
+                    self.state = "failed"
+                    self.error = (
+                        f"seq gap: expected {expected}, stream produced "
+                        f"{seq} — records lost between leader and "
+                        "follower (journal pruned past this follower, or "
+                        "writer drops); a silent skip would corrupt the "
+                        "standby ledger, refusing to follow"
+                    )
+                    raise RuntimeError(self.error)
+            self.engine.apply(rec)
+            if rec.get("t") is not None:
+                try:
+                    self.last_applied_t = float(rec["t"])
+                except (TypeError, ValueError):
+                    pass
+            if seq is not None:
+                # only seq-bearing records count as PROGRESS: a shipped
+                # checkpoint the engine ignored must never make a
+                # drain-until-idle loop believe the stream still moves
+                applied += 1
+        self.records_applied += applied
+        self._export_lag()
+        return applied
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+                self.backoff.reset()
+                self.state = "following"
+                self.error = None
+            except RuntimeError:
+                return  # seq gap: state/error already set; HARD stop
+            except Exception as e:
+                # transport: leader restarting / partition / injected
+                # fault — resume from applied_seq under jittered backoff
+                self.transport_errors += 1
+                self.error = f"transport: {e}"
+                self._export_lag()
+                delay = self.backoff.next_delay()
+                if self._stop.wait(delay):
+                    return
+
+    def start(self) -> "JournalFollower":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.state = "following"
+        self._thread = threading.Thread(
+            target=self._run, name="journal-follower", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.timeout_s + 5)
+        if self.state != "failed":
+            self.state = "stopped"
+
+    # -- introspection (/debug/leader) ---------------------------------------
+
+    def debug_state(self) -> dict:
+        res = self.engine.result
+        return {
+            "leader_url": self.leader_url,
+            "state": self.state,
+            "error": self.error,
+            "applied_seq": self.applied_seq,
+            "leader_last_seq": self.leader_last_seq,
+            "lag_seqs": self.lag_seqs(),
+            "lag_seconds": round(self.lag_seconds(), 3),
+            "records_applied": self.records_applied,
+            "polls": self.polls,
+            "transport_errors": self.transport_errors,
+            "nodes": len(res.nodes),
+            "live_pods": len(res.pods),
+            "violations": len(res.violations),
+        }

@@ -18,6 +18,8 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
+from ..faultinject import FAULTS
+from ..utils.backoff import Backoff
 from .fake import ApiError, FakeCluster
 from .objects import Binding, Node, Pod
 
@@ -75,12 +77,22 @@ class FakeClientset(Clientset):
         return self.cluster.get_pod(namespace, name)
 
     def list_pods(self, label_selector=None, field_selector=None, node_name=None):
+        # fault sites on the verbs chaos drills exercise (ledger reads,
+        # annotation writes, Binding POSTs) — the in-memory fake is what
+        # the deterministic soak (tools/check_ha.py) schedules against,
+        # so the injection must live here too, not only on the REST path
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("k8s.list_pods")
         return self.cluster.list_pods(label_selector, field_selector, node_name)
 
     def update_pod(self, pod):
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("k8s.update_pod")
         return self.cluster.update_pod(pod)
 
     def bind(self, binding):
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("k8s.bind")
         return self.cluster.bind(binding)
 
     def get_node(self, name):
@@ -161,6 +173,8 @@ class RestClientset(Clientset):
         return req, ctx
 
     def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("k8s.request")
         req, ctx = self.prepare(path, method, body)
         try:
             with urllib.request.urlopen(req, context=ctx, timeout=30) as resp:
@@ -300,8 +314,12 @@ class RestClusterView:
             stop.set()
 
     def _watch_loop(self, q, stop):
-        import time as _time
-
+        # jittered-exponential reconnect (utils/backoff): a fixed delay
+        # here meant an apiserver flap re-connected EVERY watcher in the
+        # fleet in lockstep — the synchronized-retry-storm failure mode
+        # the shared policy exists to kill.  base = the old fixed delay;
+        # a healthy stream resets the run.
+        bo = Backoff(base_s=self.reconnect_delay, max_s=30.0)
         while not stop.is_set():
             try:
                 req, ctx = self.rest.prepare("/api/v1/pods?watch=true")
@@ -312,6 +330,7 @@ class RestClusterView:
                         raw = raw.strip()
                         if not raw:
                             continue
+                        bo.reset()  # a live event = the stream is healthy
                         evt = json.loads(raw)
                         etype = evt.get("type", "")
                         obj = evt.get("object") or {}
@@ -320,4 +339,5 @@ class RestClusterView:
             except Exception:
                 if stop.is_set():
                     return
-                _time.sleep(self.reconnect_delay)
+                if stop.wait(bo.next_delay()):
+                    return

@@ -538,6 +538,41 @@ POLICY_EVENTS = REGISTRY.register(
         ("event",),
     )
 )
+LEADER_STATE = REGISTRY.register(
+    Gauge(
+        "tpu_leader_state",
+        "Leader-election state of this replica: 1 = leading (serving "
+        "verbs), 0.5 = fenced (stepping down: new verbs already 503, "
+        "in-flight verbs draining, journal flushing), 0 = standby",
+    )
+)
+HA_FOLLOW_LAG_SEQS = REGISTRY.register(
+    Gauge(
+        "tpu_ha_follow_lag_seqs",
+        "Journal-shipping follower lag in sequence numbers: the "
+        "leader's newest assigned seq minus the newest seq this "
+        "follower has replayed (0 = caught up; alert when it grows — a "
+        "takeover from a lagging follower pays the difference as diff "
+        "resync)",
+    )
+)
+HA_FOLLOW_LAG_SECONDS = REGISTRY.register(
+    Gauge(
+        "tpu_ha_follow_lag_seconds",
+        "Journal-shipping follower lag in wall seconds: age of the "
+        "newest replayed record while the follower is behind (0 when "
+        "caught up)",
+    )
+)
+HA_TAKEOVER_SECONDS = REGISTRY.register(
+    Gauge(
+        "tpu_ha_takeover_seconds",
+        "Wall time of the most recent warm takeover: adopting the "
+        "follower's replayed state plus the diff resync against the "
+        "annotation ledger (0 until a takeover has happened; the "
+        "journaled ha_takeover record carries the same number)",
+    )
+)
 
 
 class _LockWaitHistogram(Histogram):

@@ -79,6 +79,7 @@ from ..core.allocator import (
 )
 from ..core.index import request_demand
 from ..core.request import TPURequest, request_from_pod
+from ..faultinject import FAULTS
 from ..journal import JOURNAL
 from ..k8s.objects import Pod
 from ..metrics import GANG_COMMIT, GANG_EVENTS, PLAN_CACHE, TimedLock
@@ -1581,6 +1582,14 @@ class GangCoordinator:
             # phase 2: annotation ledger for ALL members (reversible)
             def annotate(item):
                 pod, node, opt = item
+                if FAULTS.enabled:
+                    # the mid-gang-commit kill point (HA chaos gate):
+                    # 'crash' here dies AFTER the phase-1 journal seal
+                    # with zero/partial ledger writes — the follower's
+                    # replay plus the takeover diff must reconcile it
+                    # with no double-book; 'error' exercises the
+                    # balancing rollback ledger-strip path
+                    FAULTS.maybe_fire("gang.phase2")
                 extra = {
                     consts.ANNOTATION_GANG_RANK: str(
                         rank_of.get(pod.key, 0)

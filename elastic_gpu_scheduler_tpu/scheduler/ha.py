@@ -1,0 +1,221 @@
+"""Warm takeover: swap a journal-shipping follower's replayed state into
+a live scheduler engine, then resync as a DIFF against the annotation
+ledger.
+
+The cold path (``_rebuild_state`` at construction) lists every assumed
+pod, then pays one ``get_node`` + one ``list_pods`` per materialized
+node plus an option replay per pod — at a 10k-node fleet that is the
+whole failover budget.  A caught-up follower already holds the complete
+per-node ChipSet state, the pod ledger, and the node generations; warm
+takeover:
+
+1. **Adopts** the follower's ``ReplayEngine`` state — each replayed
+   ChipSet becomes a live ``NodeAllocator`` (``from_state``: zero
+   network), pod placements land in ``pod_maps``, the capacity index is
+   rebuilt from the adopted entries.
+2. **Diff-resyncs** against the annotation ledger with ONE ``list_pods``
+   call: pods in the ledger the journal never shipped (bound in the
+   leader's final unflushed window) are adopted through the normal
+   ``add_pod`` path; replayed pods absent from the ledger (phase-2
+   writes that never landed, deletions in flight) are forgotten.  Both
+   directions journal through the standard commit points — a takeover
+   leaves the same audit trail any reconciliation does.
+3. **Journals** an ``ha_takeover`` annotation (replay counts it;
+   ``what_if`` skips it) and requests a BOOT CHECKPOINT, so the new
+   leader's journal is self-contained without re-journaling 10k
+   node_add/bind re-assertions.
+
+The ledger remains the arbiter: the diff is computed FROM it, so a
+follower that lagged simply pays a bigger diff — correctness never
+depends on the follower being caught up, only takeover SPEED does.
+All clientset I/O happens off the engine lock (the lockdep rule);
+the install itself is pure dict/index work under it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..core.annotations import assigned_node, option_from_pod
+from ..core.node import NodeAllocator
+from ..journal import JOURNAL
+from ..metrics import HA_TAKEOVER_SECONDS
+from ..utils import consts
+from ..utils.backoff import Backoff, retry_call
+
+log = logging.getLogger("tpu-scheduler")
+
+__all__ = ["warm_takeover"]
+
+
+class _ShimMeta:
+    __slots__ = ("namespace", "name", "uid", "annotations", "labels")
+
+
+class _ShimPod:
+    """The minimal Pod surface ``forget_pod`` consumes (key + uid) for
+    replayed pods whose ledger entry vanished — there is no live Pod
+    object to pass, the ledger is exactly what lost it."""
+
+    __slots__ = ("key", "metadata")
+
+    def __init__(self, pod_key: str, uid: str):
+        ns, _, name = pod_key.partition("/")
+        self.key = pod_key
+        self.metadata = _ShimMeta()
+        self.metadata.namespace = ns or "default"
+        self.metadata.name = name
+        self.metadata.uid = uid
+        self.metadata.annotations = {}
+        self.metadata.labels = {}
+
+
+def warm_takeover(sched, source, clientset=None) -> dict:
+    """Install a follower's replayed state into ``sched`` and diff-resync
+    against the annotation ledger.  ``source`` is a
+    ``journal.ship.JournalFollower`` (stopped first) or a bare
+    ``ReplayResult``.  Returns a summary dict (also journaled as the
+    ``ha_takeover`` record)."""
+    t0 = time.perf_counter()
+    follower = None
+    if hasattr(source, "engine"):  # a JournalFollower
+        follower = source
+        follower.stop()  # settle: the poll thread must not mutate under us
+        res = follower.engine.result
+    else:
+        res = source
+    cs_client = clientset if clientset is not None else sched.clientset
+
+    # -- ledger fetch, OFF the engine lock (network I/O) ---------------------
+    ledger: dict[str, object] = {}
+    try:
+        pods = retry_call(
+            lambda: cs_client.list_pods(
+                label_selector={consts.ANNOTATION_ASSUMED: "true"}
+            ),
+            attempts=3,
+            retry_on=(Exception,),
+            backoff=Backoff(base_s=0.1, max_s=1.0, deadline_s=3.0),
+        )
+        for pod in pods:
+            if pod.is_completed() or not assigned_node(pod):
+                continue
+            ledger[pod.key] = pod
+    except Exception as e:
+        # a takeover against a flapping apiserver still installs the
+        # replayed state (serving resumes); the controller's periodic
+        # resync converges the ledger diff later
+        log.warning("warm takeover: ledger list failed (%s); installing "
+                    "replayed state, resync deferred to the controller", e)
+        ledger = None  # sentinel: skip the diff pass
+
+    # -- build allocators off-lock (pure compute) ----------------------------
+    adopted = {
+        name: NodeAllocator.from_state(
+            name, res.generations.get(name, "v5e"), cs
+        )
+        for name, cs in res.nodes.items()
+    }
+
+    # -- install under the engine lock (dict/index work only) ----------------
+    nodes_installed = pods_installed = 0
+    skipped_nodes: set[str] = set()
+    with sched.lock:
+        for name, na in adopted.items():
+            if name in sched.allocators:
+                # the standby engine materialized this node already
+                # (e.g. a verb raced the election) — its live allocator
+                # wins; the diff below still converges the pods
+                skipped_nodes.add(name)
+                continue
+            sched.allocators[name] = na
+            if sched.index is not None:
+                na.on_change = sched.index.mark_dirty
+                sched.index.note_node(name, na)
+            nodes_installed += 1
+        for pod_key, lp in res.pods.items():
+            if pod_key in sched.pod_maps:
+                continue
+            if lp.node in skipped_nodes:
+                # its charges live only in the NOT-adopted replayed
+                # ChipSet; installing the ledger entry without charging
+                # the live allocator would leave the chips looking free
+                # (double-book).  The ledger diff below re-adopts the
+                # pod through add_pod, which charges na.add properly.
+                continue
+            sched.pod_maps[pod_key] = (lp.node, lp.option)
+            sched.released_pods.pop(pod_key, None)
+            pods_installed += 1
+
+    # -- diff resync vs the ledger (normal journaled verbs, off-lock) --------
+    diff_added = diff_removed = 0
+    if ledger is not None:
+        with sched.lock:
+            replayed_view = {
+                pk: (node, opt) for pk, (node, opt) in sched.pod_maps.items()
+            }
+        for pod_key, pod in ledger.items():
+            node = assigned_node(pod)
+            entry = replayed_view.get(pod_key)
+            if entry is not None and entry[0] == node:
+                # same node: confirm the PLACEMENT too — a rebind that
+                # rewrote the annotation in the lost window must win
+                # (the ledger is the arbiter, the journal only a replica)
+                na = sched.allocators.get(node)
+                ledger_opt = (
+                    option_from_pod(pod, na.chips.topo)
+                    if na is not None else None
+                )
+                if ledger_opt is None or (
+                    ledger_opt.allocs == entry[1].allocs
+                ):
+                    continue  # agree — the common case when caught up
+            if entry is not None:
+                # ledger moved the pod (migrate/rebind in the lost
+                # window): release the replayed placement, adopt the
+                # ledger's
+                sched.forget_pod(pod, source="takeover")
+                diff_removed += 1
+            sched.add_pod(pod, source="takeover")
+            diff_added += 1
+        for pod_key in set(replayed_view) - set(ledger):
+            lp = res.pods.get(pod_key)
+            sched.forget_pod(
+                _ShimPod(pod_key, lp.uid if lp else ""), source="takeover"
+            )
+            diff_removed += 1
+
+    wall_ms = round((time.perf_counter() - t0) * 1000.0, 2)
+    summary = {
+        "nodes": nodes_installed,
+        "nodes_skipped": len(skipped_nodes),
+        "pods": pods_installed,
+        "diff_added": diff_added,
+        "diff_removed": diff_removed,
+        "adopted_seq": res.last_seq,
+        "ledger_pods": len(ledger) if ledger is not None else None,
+        "wall_ms": wall_ms,
+    }
+    if JOURNAL.enabled:
+        # a reconfigured journal (new leader, fresh dir) cleared its
+        # checkpoint provider — the adopted engine is the snapshot source
+        sched.register_checkpoint_provider()
+        # the new leader's journal must replay WITHOUT the previous
+        # leader's stream: snapshot the adopted state at the head.
+        # Requested BEFORE the first record: the writer emits a pending
+        # checkpoint at the top of its next non-empty batch, so
+        # request-then-record guarantees the checkpoint precedes every
+        # record of this incarnation (a mid-stream checkpoint would not
+        # BOOT a replay, and every adopted node would look unknown)
+        JOURNAL.request_checkpoint()
+        JOURNAL.record("ha_takeover", **summary)
+    HA_TAKEOVER_SECONDS.set(value=wall_ms / 1000.0)
+    log.info(
+        "warm takeover: adopted %d nodes / %d pods from seq %d, ledger "
+        "diff +%d/-%d, %.1fms",
+        nodes_installed, pods_installed, res.last_seq,
+        diff_added, diff_removed, wall_ms,
+    )
+    return summary

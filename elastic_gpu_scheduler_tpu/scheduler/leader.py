@@ -30,7 +30,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..faultinject import FAULTS
 from ..k8s.fake import is_conflict, is_not_found
+from ..metrics import LEADER_STATE
 
 log = logging.getLogger("tpu-scheduler")
 
@@ -52,6 +54,7 @@ class LeaderElector:
         renew_period: float = 5.0,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
+        on_stepping_down: Optional[Callable[[], None]] = None,
     ):
         self.clientset = clientset
         self.identity = identity
@@ -61,7 +64,20 @@ class LeaderElector:
         self.renew_period = renew_period
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
+        # runs BETWEEN fencing and surrendering leadership: is_leader()
+        # already answers False (new verbs 503) but the lease is still
+        # ours, so this hook can drain in-flight verb handlers and
+        # flush+close the journal while no standby can have taken over —
+        # the step-down race the old fail-stop (rely on process exit)
+        # left open.  Bounded work only: it runs on the elector thread.
+        self.on_stepping_down = on_stepping_down
         self._leading = False
+        # fencing flag: True while a step-down is draining.  Ordering on
+        # the step-down path is store-fence-THEN-drain, so a verb that
+        # read is_leader()==True before the fence is inside the drain
+        # window, and one that reads after sees False.
+        self.fenced = False
+        self.transitions = 0  # local count of step-up/step-down cycles
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # our own last SUCCESSFUL renew (monotonic) — leadership expires by
@@ -76,13 +92,16 @@ class LeaderElector:
     # -- public --------------------------------------------------------------
 
     def is_leader(self) -> bool:
-        """Leading AND renewed within the lease duration.  The time check
-        means a leader whose renewal request is stuck on a slow apiserver
-        stops serving the moment its lease could have expired — before any
-        standby is allowed to take over — so two replicas can never both
-        answer True."""
+        """Leading AND not fenced AND renewed within the lease duration.
+        The time check means a leader whose renewal request is stuck on a
+        slow apiserver stops serving the moment its lease could have
+        expired — before any standby is allowed to take over — so two
+        replicas can never both answer True.  ``fenced`` covers the
+        step-down window: verbs are rejected while in-flight handlers
+        drain and the journal flushes, BEFORE the lease is surrendered."""
         return (
             self._leading
+            and not self.fenced
             and time.monotonic() - self._last_renew_mono < self.lease_duration
         )
 
@@ -96,9 +115,13 @@ class LeaderElector:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=self.renew_period + 1)
-        if self._leading:
-            self._release()
+        was_leading = self._leading
+        # fence + drain FIRST: blanking the lease before stepping down
+        # would let a standby acquire while our in-flight verbs are
+        # still committing (a graceful-stop split-brain window)
         self._step_down()
+        if was_leading:
+            self._release()
 
     def _release(self) -> None:
         """Graceful handoff: blank the holder so standbys can acquire
@@ -145,6 +168,8 @@ class LeaderElector:
             self._stop.wait(self.renew_period)
 
     def _try_acquire(self) -> None:
+        if FAULTS.enabled:
+            FAULTS.maybe_fire("lease.acquire")
         try:
             lease = self.clientset.get_lease(self.namespace, self.lease_name)
         except Exception as e:
@@ -211,6 +236,10 @@ class LeaderElector:
 
     def _renew(self) -> None:
         try:
+            if FAULTS.enabled:
+                # inside the try: an injected failure IS a renewal
+                # failure — fail-stop fences, drains, surrenders
+                FAULTS.maybe_fire("lease.renew")
             lease = self.clientset.get_lease(self.namespace, self.lease_name)
             spec = lease.get("spec") or {}
             if spec.get("holderIdentity") != self.identity:
@@ -242,12 +271,54 @@ class LeaderElector:
         if not self._leading:
             log.info("leader election: %s is leading (%s)", self.identity, how)
             self._leading = True
+            self.fenced = False
+            self.transitions += 1
+            LEADER_STATE.set(value=1.0)
             if self.on_started_leading:
                 self.on_started_leading()
 
     def _step_down(self) -> None:
-        if self._leading:
-            log.info("leader election: %s stepped down", self.identity)
-            self._leading = False
-            if self.on_stopped_leading:
-                self.on_stopped_leading()
+        if not self._leading:
+            return
+        log.info("leader election: %s stepping down (fencing)", self.identity)
+        # 1. fence: is_leader() answers False from here — new verbs get
+        #    503+Retry-After while the lease is STILL OURS, so no standby
+        #    can serve concurrently with our drain
+        self.fenced = True
+        LEADER_STATE.set(value=0.5)
+        # 2. drain + flush: in-flight verb handlers finish (or are
+        #    rejected), the journal's buffered tail reaches disk and the
+        #    shipping stream — the records a follower needs to take over
+        #    from exactly where we stopped
+        if self.on_stepping_down:
+            try:
+                self.on_stepping_down()
+            except Exception:
+                log.exception("step-down drain hook failed")
+        # 3. surrender
+        log.info("leader election: %s stepped down", self.identity)
+        self._leading = False
+        self.fenced = False
+        self.transitions += 1
+        LEADER_STATE.set(value=0.0)
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
+
+    def debug_state(self) -> dict:
+        """The /debug/leader payload (elector half)."""
+        now = time.monotonic()
+        return {
+            "identity": self.identity,
+            "leader": self.is_leader(),
+            "leading_flag": self._leading,
+            "fenced": self.fenced,
+            "lease": f"{self.namespace}/{self.lease_name}",
+            "lease_duration_s": self.lease_duration,
+            "renew_period_s": self.renew_period,
+            "last_renew_age_s": (
+                round(now - self._last_renew_mono, 3)
+                if self._last_renew_mono else None
+            ),
+            "observed_holder": self._observed_holder or None,
+            "transitions": self.transitions,
+        }

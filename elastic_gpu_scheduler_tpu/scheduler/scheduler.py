@@ -67,6 +67,11 @@ class SchedulerConfig:
     # hook.  False = the full-rescan oracle path everywhere (the parity
     # baseline tools/check_cluster_scale.py measures against).
     placement_index: bool = True
+    # False = skip the cold annotation-ledger rebuild at construction —
+    # the HA follower path (--follow): a standby's state arrives via
+    # journal shipping and is swapped in by scheduler/ha.warm_takeover
+    # on election, so a cold rebuild here would only be thrown away.
+    rebuild_on_start: bool = True
 
 
 class ResourceScheduler:
@@ -163,15 +168,14 @@ class TPUUnitScheduler(ResourceScheduler):
         # (LazyGauge) — never on the bind path.  weakref: tests build
         # many engines; a dead one must not be pinned or probed.
         ref = weakref.ref(self)
-        JOURNAL.checkpoint_provider = lambda: (
-            lambda s: s._journal_checkpoint() if s is not None else None
-        )(ref())
+        self.register_checkpoint_provider()
         refresher = lambda: (  # noqa: E731 — tiny weakref trampoline
             lambda s: s._refresh_frag_gauges() if s is not None else None
         )(ref())
         FRAG_INDEX.refresher = refresher
         FREE_SUBMESH.refresher = refresher
-        self._rebuild_state()
+        if config.rebuild_on_start:
+            self._rebuild_state()
 
     # -- startup rebuild (reference: scheduler.go:86-106) --------------------
 
@@ -1273,6 +1277,17 @@ class TPUUnitScheduler(ResourceScheduler):
         # (frag_snapshot) — whole-dict swap, GIL-atomic for readers
         self._frag_cache = cache
         self._frag_cache_at = time.monotonic()
+
+    def register_checkpoint_provider(self) -> None:
+        """Point the global journal's segment-head checkpoints at THIS
+        engine.  Called at construction, and again after a journal
+        reconfigure (``Journal.configure`` clears the provider — a new
+        leader reopening its journal at warm takeover must re-register
+        before its requested boot checkpoint can be written)."""
+        ref = weakref.ref(self)
+        JOURNAL.checkpoint_provider = lambda: (
+            lambda s: s._journal_checkpoint() if s is not None else None
+        )(ref())
 
     def _journal_checkpoint(self) -> Optional[dict]:
         """Full-state snapshot for the journal's segment-head checkpoint

