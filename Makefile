@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -128,6 +128,18 @@ check-analysis:
 # failing to self-heal.
 check-ha:
 	python tools/check_ha.py
+
+# Disaggregated-serving gate: a seeded burst of concurrent greedy
+# streams through the fleet router while live sessions migrate between
+# replicas (wire bundle → import → relayed continuation); hard-fails on
+# any token-parity break or dropped stream, on a cold-replica
+# prefix-page adoption that fails to beat re-prefill by
+# DISAGG_ADOPT_FLOOR (import cost included), on stale prefix-index
+# entries surviving a holder leaving rotation, or on a journal replay
+# that has violations / fails to reconstruct every commanded
+# `kv_migrate` record.
+check-disagg:
+	JAX_PLATFORMS=cpu python tools/check_disagg.py
 
 # Native-kernel sanitizer gate: rebuild placement.cc with
 # ASan+UBSan (-fno-sanitize-recover) and run a seeded differential

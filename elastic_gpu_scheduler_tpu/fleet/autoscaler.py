@@ -43,7 +43,7 @@ from dataclasses import asdict, dataclass
 from typing import Optional
 
 from ..journal import JOURNAL
-from ..metrics import FLEET_EVENTS, FLEET_SCALE_LATENCY
+from ..metrics import FLEET_EVENTS, FLEET_SCALE_LATENCY, KV_MIGRATIONS
 from ..profile import PROFILER, generation_preference
 from ..tracing import TRACER
 
@@ -250,7 +250,21 @@ class Autoscaler:
         interval_s: float = 5.0,
         wclass: str = "serve",
         profiler=None,
+        migrator=None,
+        shed_queue_margin: float = 0.0,
     ):
+        """``migrator``: duck-typed live-migration command —
+        ``migrator(src_name, dst_name) -> dict`` with at least ``ok``
+        (``FleetRouter.migrate_session`` is the production shape).  With
+        one wired, the autoscaler REBALANCES in-flight sessions instead
+        of only trading replicas: a hot replica sheds a session to the
+        idlest one when their queue depths diverge by
+        ``shed_queue_margin`` (> 0 enables; checked on 'hold' ticks so
+        shedding never races a scale action), and scale-down migrates
+        the victim's live sessions away instead of waiting out their
+        generation.  Every commanded migration journals a ``kv_migrate``
+        annotation — the decision trail replay audits alongside
+        ``fleet`` records."""
         self.replicas = replicas
         self.executor = executor
         self.policy = policy or ScalingPolicy()
@@ -258,9 +272,13 @@ class Autoscaler:
         self.interval_s = max(0.05, float(interval_s))
         self.wclass = wclass
         self.profiler = profiler if profiler is not None else PROFILER
+        self.migrator = migrator
+        self.shed_queue_margin = float(shed_queue_margin)
         self.evaluations = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        self.sheds = 0
+        self.last_shed: Optional[dict] = None
         self.last_decision: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -361,6 +379,12 @@ class Autoscaler:
                     "fleet.scale_down", reason=reason, replica=victim,
                 ) as sp:
                     self.replicas.drain(victim, reason="scale-down")
+                    if self.migrator is not None:
+                        # rebalance instead of draining: hand the
+                        # victim's live sessions to surviving replicas
+                        # (≤1 lost chunk each, token-identical), so the
+                        # release waits on byte relays, not generation
+                        rec["migrated_off"] = self._migrate_off(victim)
                     try:
                         ok = self.executor.scale_down(victim, reason)
                     except Exception:
@@ -383,10 +407,118 @@ class Autoscaler:
                         sp.end(status="error")
         else:
             FLEET_EVENTS.inc("hold")
+            if self.migrator is not None and self.shed_queue_margin > 0:
+                # load rebalance rides 'hold' ticks only: a shed must
+                # never race a scale action it could invalidate
+                shed = self._maybe_shed()
+                if shed is not None:
+                    rec["shed"] = shed
         if JOURNAL.enabled:
             JOURNAL.record("fleet", **rec)
         self.last_decision = rec
         return rec
+
+    # -- in-flight session rebalance (disaggregated data plane) --------------
+
+    def _journal_migrate(self, src: str, dst: str, reason: str,
+                         res: dict) -> None:
+        """One ``kv_migrate`` annotation per commanded migration —
+        replay counts them next to fleet records (never an allocator
+        mutation); what-if skips them."""
+        if not JOURNAL.enabled:
+            return
+        JOURNAL.record(
+            "kv_migrate",
+            src=src,
+            dst=dst,
+            reason=reason,
+            ok=bool(res.get("ok")),
+            pages=res.get("pages_shipped"),
+            tokens_done=res.get("tokens_done"),
+            slot=res.get("slot"),
+            error=res.get("error"),
+        )
+
+    def _queue_key(self, r) -> int:
+        return int(r.stats.get("queued", 0)) + int(r.inflight)
+
+    def _maybe_shed(self) -> Optional[dict]:
+        """One session hop per tick, hottest → idlest replica, when
+        their queue depths diverge past ``shed_queue_margin`` and the
+        hot one actually has a live session to hand off."""
+        # prefill-role replicas take no completion traffic (the router's
+        # invariant) — they must not become migration DESTINATIONS
+        # either, or the shed lands a decode token loop on them
+        ups = [
+            r for r in self.replicas.all()
+            if r.state == "up" and getattr(r, "role", "both") != "prefill"
+        ]
+        if len(ups) < 2:
+            return None
+        busy = max(ups, key=self._queue_key)
+        idle = min(ups, key=self._queue_key)
+        if (
+            busy is idle
+            or self._queue_key(busy) - self._queue_key(idle)
+            < self.shed_queue_margin
+            or int(busy.stats.get("active_slots", 0)) < 1
+        ):
+            return None
+        try:
+            res = self.migrator(busy.name, idle.name)
+        except Exception as e:  # noqa: BLE001 — a failed shed is data
+            res = {"ok": False, "error": str(e)}
+        ok = bool(res.get("ok"))
+        if ok:
+            self.sheds += 1
+            KV_MIGRATIONS.inc("shed")
+            FLEET_EVENTS.inc("shed_executed")
+        else:
+            KV_MIGRATIONS.inc("shed_failed")
+            FLEET_EVENTS.inc("shed_failed")
+        self._journal_migrate(busy.name, idle.name, "shed", res)
+        out = {
+            "src": busy.name, "dst": idle.name, "ok": ok,
+            "error": res.get("error"),
+        }
+        self.last_shed = out
+        return out
+
+    def _migrate_off(self, victim: str) -> int:
+        """Scale-down rebalance: migrate the draining victim's live
+        sessions to the least-loaded surviving replicas, bounded by its
+        slot count (each hop journals a ``kv_migrate``).  Returns
+        sessions moved; stops at the first 'nothing live' verdict."""
+        v = self.replicas.get(victim)
+        if v is None:
+            return 0
+        moved = 0
+        budget = max(1, int(v.stats.get("max_batch", 1)))
+        for _ in range(budget):
+            survivors = [
+                r for r in self.replicas.all()
+                if r.state == "up" and r.name != victim
+                and getattr(r, "role", "both") != "prefill"
+            ]
+            if not survivors:
+                break
+            dst = min(survivors, key=self._queue_key)
+            try:
+                res = self.migrator(victim, dst.name)
+            except Exception as e:  # noqa: BLE001 — failed hop is data
+                res = {"ok": False, "error": str(e)}
+            if not res.get("ok"):
+                # 409 = no live session left: the clean exit
+                if res.get("status") != 409:
+                    KV_MIGRATIONS.inc("shed_failed")
+                    self._journal_migrate(
+                        victim, dst.name, "scale_down", res
+                    )
+                break
+            moved += 1
+            KV_MIGRATIONS.inc("shed")
+            self._journal_migrate(victim, dst.name, "scale_down", res)
+        return moved
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -421,6 +553,9 @@ class Autoscaler:
             "evaluations": self.evaluations,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
+            "sheds": self.sheds,
+            "shed_queue_margin": self.shed_queue_margin,
+            "last_shed": self.last_shed,
             "last_decision": self.last_decision,
         }
 

@@ -6,18 +6,34 @@ router is the fan-out point.  Routing policy, in precedence order:
 
 1. **Prefix affinity.**  The incoming prompt's rolling BLAKE2b digest
    chain (utils/prefixdigest — the SAME chain the engine's prefix cache
-   keys pages by) is matched longest-first against the chains of prompts
-   this router previously sent to each replica: a hit routes the session
-   to the replica whose KV cache already holds that prefix, so the
-   engine's ``_match_prefix`` turns the route into real skipped prefill
-   work.  The affinity map is a bounded LRU — cold digests age out at
-   roughly the rate replica caches recycle pages.
-2. **Least loaded.**  No affinity match (or the matched replica is not
-   routable): pick the replica with the smallest (queued + router
-   in-flight, active slot fraction) from the health loop's last
-   ``/v1/stats`` poll plus the router's own in-flight counter (fresher
-   than any poll).
-3. **Failover.**  Connect failure or a 5xx status line from the chosen
+   keys pages by) is matched longest-first against the FLEET-WIDE
+   prefix-cache index (:class:`PrefixIndex`): one digest may be held by
+   several replicas, and the route goes to the routable holder with the
+   longest match (locality score, load as tiebreak), so the engine's
+   ``_match_prefix`` turns the route into real skipped prefill work.
+   The index is a bounded LRU — cold digests age out at roughly the
+   rate replica caches recycle pages — and entries pointing at replicas
+   LEAVING rotation (removed, scaled down, breaker-down) are pruned
+   eagerly, so a stale digest can never steer a prompt at a dead
+   backend ahead of the health fallback.
+2. **Page adoption.**  Holders exist but none is routable (draining /
+   warming / prefill-role) — or load-margin shedding is enabled and the
+   holder is overloaded: the request routes to the best candidate WITH
+   an ``X-KV-Source`` header naming the holder, and the backend pulls
+   the prefix's KV pages over the wire (utils/kvwire) before admission
+   — the fleet moves the KV, not the request.  A cold scale-up starts
+   winning repeated-prefix traffic immediately instead of re-prefilling.
+3. **Prefill/decode split.**  A long prompt with no index hit routes
+   through a ``prefill``-role replica first (``/v1/prefill`` batches
+   the chunked prefill and caches the pages), then the completion runs
+   on a decode replica that adopts the pages — decode slots never stall
+   behind a long admission.  Replicas advertise their role in
+   ``/v1/stats``; prefill-role replicas get ZERO completion traffic.
+4. **Least loaded.**  No index hit: the candidate with the smallest
+   (queued + router in-flight, active slot fraction) from the health
+   loop's last ``/v1/stats`` poll plus the router's own in-flight
+   counter (fresher than any poll).
+5. **Failover.**  Connect failure or a 5xx status line from the chosen
    replica (detected BEFORE any byte is forwarded to the client) falls
    through to the next candidate; each failure feeds that replica's
    circuit breaker.
@@ -46,6 +62,7 @@ client → router → replica → engine step forms ONE W3C trace chain.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import socket
@@ -65,11 +82,34 @@ from ..metrics import (
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..utils import prefixdigest
 from ..utils.backoff import Backoff
+from ..utils.kvwire import KV_SOURCE_HEADER
 from ..utils.tpuprobe import RELAY_MONITOR
 
 log = logging.getLogger("tpu-scheduler")
 
 REPLICA_STATES = ("up", "warming", "draining", "down")
+
+
+def _post_json(
+    addr: tuple[str, int], path: str, payload: bytes,
+    timeout: float = 30.0,
+) -> tuple[int, bytes]:
+    """Small blocking replica POST (prefill split, migration command).
+    http.client rather than a raw socket: these answers may be chunked,
+    and hand-rolled chunk parsing is exactly the wire logic the stdlib
+    already gets right.  Protocol errors surface as ConnectionError so
+    callers keep one except-clause for 'the replica broke'."""
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, payload, {"Content-Type": "application/json"}
+        )
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    except http.client.HTTPException as e:
+        raise ConnectionError(f"malformed replica response: {e}") from None
+    finally:
+        conn.close()
 
 
 class _RelayAborted(Exception):
@@ -131,6 +171,22 @@ class Replica:
     def addr(self) -> tuple[str, int]:
         return (self.host, self.port)
 
+    @property
+    def role(self) -> str:
+        """Disaggregated-serving role advertised on /v1/stats: 'prefill'
+        replicas never receive completion traffic (they serve
+        /v1/prefill + /v1/kv/export only); 'decode'/'both' do."""
+        return str(self.stats.get("role") or "both")
+
+    def exportable(self, now: float) -> bool:
+        """Can this replica still serve /v1/kv/export pulls?  Draining
+        is fine (the engine is healthy, it just takes no new sessions);
+        down/breaker-open means nobody should connect at all."""
+        return (
+            self.state in ("up", "draining")
+            and now >= self.breaker_open_until
+        )
+
     def inflight_enter(self) -> None:
         with self._inflight_lock:
             self.inflight += 1
@@ -177,6 +233,7 @@ class Replica:
             "state": self.state,
             "reason": self.state_reason,
             "relay": self.relay,
+            "role": self.role,
             "inflight": self.inflight,
             "routed": self.routed,
             "consecutive_failures": self.consecutive_failures,
@@ -184,6 +241,7 @@ class Replica:
             "queued": self.stats.get("queued"),
             "active_slots": self.stats.get("active_slots"),
             "max_batch": self.stats.get("max_batch"),
+            "kv": self.stats.get("kv"),
         }
 
 
@@ -214,6 +272,13 @@ class ReplicaSet:
         self._replicas: dict[str, Replica] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # leaving-rotation listeners (name, reason): fired when a replica
+        # is removed, pinned-draining (scale-down / migration victim) or
+        # observed transitioning to 'down' — the router prunes its
+        # prefix-index entries here so a stale digest can never route a
+        # prompt at a dead backend ahead of the health fallback
+        self.on_leave: list = []
+        self._last_states: dict[str, str] = {}
 
     # -- membership ----------------------------------------------------------
 
@@ -224,7 +289,18 @@ class ReplicaSet:
 
     def remove(self, name: str) -> Optional[Replica]:
         with self._lock:
-            return self._replicas.pop(name, None)
+            r = self._replicas.pop(name, None)
+            self._last_states.pop(name, None)
+        if r is not None:
+            self._fire_leave(name, "removed")
+        return r
+
+    def _fire_leave(self, name: str, reason: str) -> None:
+        for cb in list(self.on_leave):
+            try:
+                cb(name, reason)
+            except Exception:
+                log.exception("replica-leave listener failed for %s", name)
 
     def get(self, name: str) -> Optional[Replica]:
         with self._lock:
@@ -251,6 +327,9 @@ class ReplicaSet:
             r.state = "draining"
             r.state_reason = reason
             r.pinned_draining = True
+        # a pinned drain IS leaving rotation (scale-down victim, move in
+        # progress): affinity must stop steering repeated prefixes here
+        self._fire_leave(name, f"draining: {reason}")
         return True
 
     def undrain(self, name: str, reason: str = "restored") -> bool:
@@ -383,6 +462,13 @@ class ReplicaSet:
         counts = {s: 0 for s in REPLICA_STATES}
         for r in self.all():
             counts[r.state] = counts.get(r.state, 0) + 1
+            # down-transition detection AFTER the pass: catches both the
+            # health loop's own verdicts and breaker opens fed by the
+            # relay path between passes
+            prev = self._last_states.get(r.name)
+            if r.state == "down" and prev != "down":
+                self._fire_leave(r.name, r.state_reason or "down")
+            self._last_states[r.name] = r.state
         for s, n in counts.items():
             FLEET_REPLICAS.set(s, value=float(n))
 
@@ -413,11 +499,89 @@ class ReplicaSet:
             t.join(timeout=2)
 
 
+class PrefixIndex:
+    """Fleet-wide prefix-cache index: digest-chain link → the replicas
+    believed to hold that prefix's KV pages (a prefix can live on
+    SEVERAL replicas once pages ship — adoption, prefill export,
+    migration — and the router should know every copy).  Bounded LRU on
+    digests; a holder whose pages were LRU-evicted replica-side just
+    costs one empty export (adoption falls back to re-prefill), so no
+    per-holder freshness is tracked.
+    ``drop_replica`` prunes every entry naming a replica that
+    left rotation — the satellite bugfix: without it a stale digest
+    keeps steering repeated prompts at a dead backend until the LRU
+    happens to age it out."""
+
+    def __init__(self, cap: int = 65536):
+        self._map: "OrderedDict[bytes, set[str]]" = OrderedDict()
+        self._cap = max(1024, int(cap))
+        self._lock = threading.Lock()
+
+    def record(self, digests: list[bytes], name: str) -> None:
+        if not digests:
+            return
+        with self._lock:
+            for d in digests:
+                ent = self._map.get(d)
+                if ent is None:
+                    ent = self._map[d] = set()
+                ent.add(name)
+                self._map.move_to_end(d)
+            while len(self._map) > self._cap:
+                self._map.popitem(last=False)
+
+    def lookup(self, digests: list[bytes]) -> dict[str, int]:
+        """replica name → matched page count (each replica's LONGEST
+        known link of this chain).  Touches the longest hit digest."""
+        out: dict[str, int] = {}
+        with self._lock:
+            touched = False
+            for k in range(len(digests) - 1, -1, -1):
+                ent = self._map.get(digests[k])
+                if not ent:
+                    continue
+                if not touched:
+                    self._map.move_to_end(digests[k])
+                    touched = True
+                for name in ent:
+                    if name not in out:
+                        out[name] = k + 1
+        return out
+
+    def drop_replica(self, name: str) -> int:
+        """Prune every entry naming ``name``; returns digests touched."""
+        with self._lock:
+            dead = []
+            n = 0
+            for d, ent in self._map.items():
+                if name in ent:
+                    ent.discard(name)
+                    n += 1
+                    if not ent:
+                        dead.append(d)
+            for d in dead:
+                del self._map[d]
+        return n
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
 class FleetRouter:
     """The /v1/* front door over a ReplicaSet (see the module docstring
     for policy).  ``page_size`` must match the replicas' engine page
     size for affinity hits to be REAL cache hits; the health loop adopts
-    the first replica's advertised value when they disagree."""
+    the first replica's advertised value when they disagree.
+
+    Disaggregated-serving knobs: ``adopt`` (pull pages to the chosen
+    replica when the prefix's holders aren't routable; default on),
+    ``adopt_load_margin`` (> 0 enables load-based shedding: route AWAY
+    from an overloaded holder and adopt instead when its queue exceeds
+    the best alternative's by this many requests; 0 = affinity always
+    wins, the historic behavior), ``disagg_min_pages`` (a no-hit prompt
+    with at least this many full pages routes through a prefill-role
+    replica first when one is up; 0 disables the split)."""
 
     def __init__(
         self,
@@ -428,6 +592,10 @@ class FleetRouter:
         prefix_cap: int = 65536,
         max_affinity_pages: int = 64,
         backend_timeout_s: float = 300.0,
+        adopt: bool = True,
+        adopt_min_pages: int = 1,
+        adopt_load_margin: float = 0.0,
+        disagg_min_pages: int = 4,
     ):
         self.replicas = replicas
         self.host = host
@@ -435,22 +603,28 @@ class FleetRouter:
         self.page_size = max(1, int(page_size))
         self.max_affinity_pages = max(1, int(max_affinity_pages))
         self.backend_timeout_s = backend_timeout_s
+        self.adopt = bool(adopt)
+        self.adopt_min_pages = max(1, int(adopt_min_pages))
+        self.adopt_load_margin = float(adopt_load_margin)
+        self.disagg_min_pages = max(0, int(disagg_min_pages))
         # optional callable → dict serving the COMBINED fleet payload
         # (router + autoscaler + resize) at this port's /debug/fleet —
         # the CLI wires FleetState.debug_state here so both servers
         # answer with the same shape; unset (library use) falls back to
         # the router-only view
         self.state_provider = None
-        # digest → replica name, newest-matched last (LRU).  One map for
-        # the whole fleet: lookups walk the request's chain longest-first
-        # and stop at the first known link.
-        self._prefix_map: "OrderedDict[bytes, str]" = OrderedDict()
-        self._prefix_cap = max(1024, int(prefix_cap))
-        self._prefix_lock = threading.Lock()
+        # the fleet-wide prefix-cache index; entries naming a replica
+        # that leaves rotation are pruned via the leave listener
+        self.prefix_index = PrefixIndex(prefix_cap)
+        replicas.on_leave.append(self._on_replica_leave)
         self._page_size_resolved = False  # one-shot adoption latch
         self.affinity_hits = 0
         self.affinity_requests = 0
         self.matched_pages = 0
+        self.adoptions = 0  # routes shipped with an X-KV-Source header
+        self.disagg_prefills = 0  # long prompts split through prefill
+        self.migrations = 0  # migrate_session calls that handed off
+        self.pruned_digests = 0  # index entries dropped by leave events
         self.requests = 0
         # per-request router overhead samples (seconds) — the
         # FLEET_ROUTE_OVERHEAD histogram's raw tail for tools that need
@@ -481,14 +655,22 @@ class FleetRouter:
             if ps != self.page_size:
                 log.warning(
                     "fleet router adopting replica-advertised page_size "
-                    "%d (configured %d); affinity map reset",
+                    "%d (configured %d); prefix index reset",
                     ps, self.page_size,
                 )
-                with self._prefix_lock:
-                    self._prefix_map.clear()
+                self.prefix_index = PrefixIndex(self.prefix_index._cap)
                 self.page_size = ps
             self._page_size_resolved = True
             return
+
+    def _on_replica_leave(self, name: str, reason: str) -> None:
+        n = self.prefix_index.drop_replica(name)
+        if n:
+            self.pruned_digests += n
+            log.info(
+                "fleet router pruned %d prefix-index digests for "
+                "replica %s leaving rotation (%s)", n, name, reason,
+            )
 
     def _digests(self, body: dict) -> list[bytes]:
         prompt = body.get("prompt")
@@ -517,52 +699,169 @@ class FleetRouter:
             seed=seed,
         )
 
-    def _affinity_lookup(self, digests: list[bytes]) -> tuple[Optional[str], int]:
-        """(replica name, matched page count) for the LONGEST known link
-        of the chain, or (None, 0)."""
-        with self._prefix_lock:
-            for k in range(len(digests) - 1, -1, -1):
-                name = self._prefix_map.get(digests[k])
-                if name is not None:
-                    self._prefix_map.move_to_end(digests[k])
-                    return name, k + 1
-        return None, 0
-
     def _affinity_record(self, digests: list[bytes], name: str) -> None:
-        with self._prefix_lock:
-            for d in digests:
-                self._prefix_map[d] = name
-                self._prefix_map.move_to_end(d)
-            while len(self._prefix_map) > self._prefix_cap:
-                self._prefix_map.popitem(last=False)
+        self.prefix_index.record(digests, name)
 
-    def select(self, body: dict) -> tuple[Optional[Replica], str, list[bytes]]:
-        """(replica, kind, digests): the routing decision, before any
-        network IO.  kind ∈ affinity | least_loaded | no_replica."""
-        candidates = self.replicas.routable()
+    def _completion_candidates(self) -> list[Replica]:
+        """Routable replicas that take completion traffic — the
+        prefill/decode split keeps prefill-role replicas out."""
+        now = time.monotonic()
+        return [
+            r for r in self.replicas.all()
+            if r.routable(now) and r.role != "prefill"
+        ]
+
+    def select(
+        self, body: dict
+    ) -> tuple[Optional[Replica], str, list[bytes], Optional[Replica]]:
+        """(replica, kind, digests, donor): the routing decision, before
+        any network IO.  kind ∈ affinity | adopt | least_loaded |
+        no_replica; ``donor`` (adopt only) is the replica the target
+        should pull the prefix's KV pages from (X-KV-Source)."""
+        candidates = self._completion_candidates()
         digests = self._digests(body)
         if digests:
             self.affinity_requests += 1
         if not candidates:
-            return None, "no_replica", digests
-        by_name = {r.name: r for r in candidates}
-        name, pages = self._affinity_lookup(digests)
-        if name is not None and name in by_name:
-            self.affinity_hits += 1
-            self.matched_pages += pages
-            return by_name[name], "affinity", digests
-        return (
-            min(candidates, key=lambda r: r.load_key()),
-            "least_loaded",
-            digests,
-        )
+            return None, "no_replica", digests, None
+        matches = self.prefix_index.lookup(digests) if digests else {}
+        least = min(candidates, key=lambda r: r.load_key())
+        if matches:
+            by_name = {r.name: r for r in self.replicas.all()}
+            cand_names = {r.name for r in candidates}
+            routable_holders = sorted(
+                ((pages, by_name[n]) for n, pages in matches.items()
+                 if n in cand_names),
+                key=lambda t: (-t[0], t[1].load_key()),
+            )
+            if routable_holders:
+                pages, best = routable_holders[0]
+                if (
+                    self.adopt
+                    and self.adopt_load_margin > 0
+                    and best is not least
+                    and pages >= self.adopt_min_pages
+                    and best.load_key()[0] - least.load_key()[0]
+                    >= self.adopt_load_margin
+                ):
+                    # the holder is the hot spot: move the KV, not the
+                    # request — the least-loaded candidate pulls the
+                    # pages and takes the session (load-margin shedding)
+                    self.matched_pages += pages
+                    return least, "adopt", digests, best
+                self.affinity_hits += 1
+                self.matched_pages += pages
+                return best, "affinity", digests, None
+            # holders exist but none takes completions (draining /
+            # warming / prefill-role / just removed): adopt the prefix
+            # onto the best candidate from any holder still able to
+            # serve exports
+            now = time.monotonic()
+            donors = sorted(
+                ((pages, by_name[n]) for n, pages in matches.items()
+                 if n in by_name and by_name[n].exportable(now)),
+                key=lambda t: -t[0],
+            )
+            if (
+                self.adopt and donors
+                and donors[0][0] >= self.adopt_min_pages
+            ):
+                pages, donor = donors[0]
+                self.matched_pages += pages
+                return least, "adopt", digests, donor
+        return least, "least_loaded", digests, None
 
     def failover_order(self, first: Replica) -> list[Replica]:
         rest = sorted(
-            (r for r in self.replicas.routable() if r.name != first.name),
+            (
+                r for r in self._completion_candidates()
+                if r.name != first.name
+            ),
             key=lambda r: r.load_key(),
         )
         return [first] + rest
+
+    # -- disaggregated serving orchestration ---------------------------------
+
+    def _prefill_split(self, body: dict, digests: list[bytes]) -> Optional[Replica]:
+        """Route a long no-hit prompt through a prefill-role replica:
+        POST /v1/prefill there (chunked prefill caches the pages), then
+        return it as the donor the decode replica adopts from.  Returns
+        None when the split doesn't apply or the prefill failed (the
+        request then just prefills on the decode replica — correctness
+        never depends on the split)."""
+        if self.disagg_min_pages <= 0 or len(digests) < self.disagg_min_pages:
+            return None
+        now = time.monotonic()
+        prefills = [
+            r for r in self.replicas.all()
+            if r.routable(now) and r.role == "prefill"
+        ]
+        if not prefills:
+            return None
+        target = min(prefills, key=lambda r: r.load_key())
+        payload = json.dumps({
+            "prompt": body.get("prompt"),
+            "adapter": str(body.get("adapter", "")),
+        }).encode()
+        target.inflight_enter()
+        try:
+            status, _body = _post_json(
+                target.addr, "/v1/prefill", payload,
+                timeout=self.backend_timeout_s,
+            )
+        except (OSError, ConnectionError) as e:
+            log.warning("disagg prefill on %s failed: %s", target.name, e)
+            target.note_failure(
+                self.replicas.breaker_threshold,
+                self.replicas.breaker_cooldown_s,
+            )
+            return None
+        finally:
+            target.inflight_exit()
+        if status != 200:
+            return None
+        target.note_success()
+        target.routed += 1
+        self.disagg_prefills += 1
+        # the prefill replica now holds the pages: index them so later
+        # repeats of the prefix adopt from it directly
+        self._affinity_record(digests, target.name)
+        return target
+
+    def migrate_session(
+        self, src: str, dst: str, slot: Optional[int] = None,
+        timeout: float = 30.0,
+    ) -> dict:
+        """Command a live session handoff: POST /v1/migrate/out on
+        ``src`` naming ``dst`` as the destination (both replica names).
+        Returns the backend's verdict plus ok=False shapes for
+        transport errors — the autoscaler's rebalance path consumes
+        this, journaling each call as a ``kv_migrate`` record."""
+        s, d = self.replicas.get(src), self.replicas.get(dst)
+        if s is None or d is None:
+            return {"ok": False, "error": "unknown replica"}
+        body = {"dest": f"{d.host}:{d.port}"}
+        if slot is not None:
+            body["slot"] = int(slot)
+        try:
+            status, payload = _post_json(
+                s.addr, "/v1/migrate/out", json.dumps(body).encode(),
+                timeout=timeout,
+            )
+        except (OSError, ConnectionError) as e:
+            return {"ok": False, "error": str(e)}
+        try:
+            res = json.loads(payload)
+        except ValueError:
+            res = {}
+        res.setdefault("ok", status == 200)
+        res["status"] = status
+        if res.get("ok"):
+            # the session's KV lives on dst now; index updates ride the
+            # next routed request for that prefix
+            self.migrations += 1
+        return res
 
     # -- relay ---------------------------------------------------------------
 
@@ -574,6 +873,7 @@ class FleetRouter:
         body: bytes,
         traceparent: str,
         client_sock: socket.socket,
+        extra_headers: Optional[dict] = None,
     ) -> tuple[int, float]:
         """Send the request to ``replica`` and pump the response back to
         the client verbatim.  Returns (backend status, router overhead
@@ -599,6 +899,8 @@ class FleetRouter:
             )
             if traceparent:
                 headers += f"{TRACEPARENT_HEADER}: {traceparent}\r\n"
+            for k, v in (extra_headers or {}).items():
+                headers += f"{k}: {v}\r\n"
             bs.sendall(headers.encode("latin1") + b"\r\n" + body)
             overhead = time.perf_counter() - t0
             # read until the backend's header block is complete: the
@@ -667,24 +969,44 @@ class FleetRouter:
             "fleet.route", parent=traceparent or None, path=path,
             stream=bool(body.get("stream")),
         ) as sp:
-            replica, kind, digests = self.select(body)
+            replica, kind, digests, donor = self.select(body)
             if replica is None:
                 FLEET_ROUTED.inc("no_replica")
                 sp.set_attr("kind", "no_replica")
                 return 503, json.dumps(
                     {"error": "no serving replica available"}
                 ).encode()
+            if (
+                kind == "least_loaded"
+                and path == "/v1/completions"
+                and donor is None
+            ):
+                # prefill/decode split: a long no-hit prompt prefills on
+                # a prefill-role replica; the decode target then adopts
+                # the pages instead of stalling its slots on the prompt
+                donor = self._prefill_split(body, digests)
+                if donor is not None:
+                    kind = "disagg"
             # the router hop joins the W3C chain: the backend request
             # carries THIS span's context, so the replica's serve.request
             # span becomes its child
             backend_tp = sp.traceparent() if sp else traceparent
             attempt_kind = kind
             last_err: Optional[str] = None
+            extra = None
+            if donor is not None:
+                # adoption: the target pulls the prefix's pages from the
+                # donor before admission (utils/kvwire; best-effort on
+                # the backend — a failed pull just re-prefills)
+                extra = {KV_SOURCE_HEADER: f"{donor.host}:{donor.port}"}
+                self.adoptions += 1
+                sp.set_attr("kv_source", donor.name)
             for target in self.failover_order(replica):
                 target.inflight_enter()
                 try:
                     status, overhead = self._forward(
-                        target, method, path, raw, backend_tp, client_sock
+                        target, method, path, raw, backend_tp,
+                        client_sock, extra_headers=extra,
                     )
                 except _RelayAborted as e:
                     # bytes already reached the client: no failover (a
@@ -734,8 +1056,6 @@ class FleetRouter:
     # -- introspection -------------------------------------------------------
 
     def debug_state(self) -> dict:
-        with self._prefix_lock:
-            prefix_entries = len(self._prefix_map)
         return {
             "replicas": [r.to_dict() for r in self.replicas.all()],
             "requests": self.requests,
@@ -747,8 +1067,17 @@ class FleetRouter:
                     / max(1, self.affinity_requests), 2,
                 ),
                 "matched_pages": self.matched_pages,
-                "map_entries": prefix_entries,
+                "map_entries": len(self.prefix_index),
                 "page_size": self.page_size,
+            },
+            "disagg": {
+                "adoptions": self.adoptions,
+                "disagg_prefills": self.disagg_prefills,
+                "migrations": self.migrations,
+                "pruned_digests": self.pruned_digests,
+                "adopt": self.adopt,
+                "adopt_load_margin": self.adopt_load_margin,
+                "disagg_min_pages": self.disagg_min_pages,
             },
         }
 

@@ -33,10 +33,14 @@ stack:
   subsystem (``fleet/``) adds two more types: ``fleet`` (autoscaler
   evaluations — signals + decision, the stream
   ``fleet.autoscaler.score_policy`` replays a candidate scaling policy
-  against offline; annotations like ``profile``) and ``resize`` (a gang
+  against offline; annotations like ``profile``), ``resize`` (a gang
   membership-change commit summary; replay VERIFIES it — chip
   conservation per member and exact all-or-nothing membership — against
-  the state the surrounding bind/forget/migrate records rebuilt).
+  the state the surrounding bind/forget/migrate records rebuilt), and
+  ``kv_migrate`` (a commanded live KV-session hop between serving
+  replicas — shed or scale-down rebalance on the disaggregated data
+  plane; an annotation, since the pages move between engine HBM pools,
+  never between scheduler-plane chips).
 
 - **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
 

@@ -149,6 +149,14 @@ class ReplayResult:
     # boot checkpoint)
     ha_takeovers: int = 0
     last_takeover: Optional[dict] = None
+    # live KV-session migration annotations (fleet/ disaggregated data
+    # plane): the autoscaler/router journals every commanded session
+    # hop (shed or scale-down rebalance) — counted, dense-seq audited,
+    # zero allocator mutation (the KV pages move between serving
+    # replicas, not between scheduler-plane chips)
+    kv_migrations: int = 0
+    kv_migrations_failed: int = 0
+    last_kv_migration: Optional[dict] = None
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -178,6 +186,8 @@ class ReplayResult:
             "policy_faults": self.policy_faults,
             "policy_decisions": len(self.policy_decisions),
             "ha_takeovers": self.ha_takeovers,
+            "kv_migrations": self.kv_migrations,
+            "kv_migrations_failed": self.kv_migrations_failed,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -629,6 +639,27 @@ class ReplayEngine:
                         f"{where}: resize of gang {gang}: removed member "
                         f"{r} is still bound"
                     )
+        elif t == "kv_migrate":
+            # live KV-session migration (fleet/ disaggregated data
+            # plane): a commanded session hop between serving replicas —
+            # an ANNOTATION in the mutation stream (dense-seq audited,
+            # zero allocator mutation: KV pages move between engines'
+            # HBM pools, not between scheduler-plane chips).  Failed
+            # hops are counted separately — a fleet whose sheds mostly
+            # fail is an operational signal replay should surface.
+            res.kv_migrations += 1
+            if not rec.get("ok", False):
+                res.kv_migrations_failed += 1
+            res.last_kv_migration = {
+                "seq": seq,
+                "t": rec.get("t"),
+                "src": rec.get("src"),
+                "dst": rec.get("dst"),
+                "reason": rec.get("reason"),
+                "ok": rec.get("ok"),
+                "pages": rec.get("pages"),
+                "tokens_done": rec.get("tokens_done"),
+            }
         elif t == "ha_takeover":
             # warm-takeover summary (scheduler/ha.py): the new leader
             # adopted a follower's replayed state and diff-resynced
@@ -861,7 +892,8 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                 observe_profile(rec)
             continue
         if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
-                 "gang_admit", "gang_rollback", "ha_takeover"):
+                 "gang_admit", "gang_rollback", "ha_takeover",
+                 "kv_migrate"):
             # annotations (autoscaler evaluations / resize summaries /
             # policy-plane events / compile warm-ups / gang admit+rollback
             # markers): the member binds/forgets/migrates around a

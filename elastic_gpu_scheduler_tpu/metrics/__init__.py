@@ -496,6 +496,46 @@ FLEET_SCALE_LATENCY = REGISTRY.register(
         buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
     )
 )
+KV_PAGES_RESIDENT = REGISTRY.register(
+    Gauge(
+        "tpu_kv_pages_resident",
+        "Serving-engine KV page pool residency by kind, set at scrape "
+        "time from live engine state: active (referenced by live "
+        "slots), cached (prefix-cache registered, LRU-evictable), free",
+        ("kind",),
+    )
+)
+KV_PAGES_SHIPPED = REGISTRY.register(
+    Gauge(
+        "tpu_kv_pages_shipped",
+        "Monotonic count of KV pages shipped replica-to-replica over "
+        "the disaggregated data plane, by direction (exported/"
+        "imported); exposed at scrape time from the engine's counters "
+        "(the tpu_serve_spills stance)",
+        ("direction",),
+    )
+)
+KV_PREFIX_ADMISSIONS = REGISTRY.register(
+    Gauge(
+        "tpu_kv_prefix_admissions",
+        "Monotonic admission-level prefix-cache outcomes (hit = at "
+        "least one full cached page attached at admission, incl. "
+        "adopted pages; miss = prefill from scratch), set at scrape "
+        "time from engine counters",
+        ("result",),
+    )
+)
+KV_MIGRATIONS = REGISTRY.register(
+    Counter(
+        "tpu_kv_migrations_total",
+        "Live KV session migrations by outcome: out (handoff accepted, "
+        "continuation relayed), out_refused (destination refused — "
+        "session resumed locally, exact), in (session adopted from a "
+        "peer), shed (autoscaler-commanded rebalance executed), "
+        "shed_failed",
+        ("result",),
+    )
+)
 COMPILE_CACHE_EVENTS = REGISTRY.register(
     Counter(
         "tpu_compile_cache_events_total",

@@ -54,7 +54,7 @@ import numpy as np
 
 from ..ops.attention import NEG_INF
 from ..tracing import TRACER
-from ..utils import prefixdigest
+from ..utils import kvwire, prefixdigest
 from .generate import cached_attention
 from .quantize import wmat
 from .transformer import TransformerConfig, _embed_lookup, rms_norm, rope
@@ -1711,8 +1711,96 @@ class InferenceEngine:
         self.page_lru: dict[int, int] = {}
         self._lru_clock = 0
         self.prefix_hit_tokens = 0
+        # -- disaggregated serving data plane (fleet/, utils/kvwire) ---------
+        # Cross-thread engine tasks: HTTP handlers may not touch slot /
+        # page / pool state (the engine thread is its sole owner), so
+        # KV export/import and migration run as queued thunks the engine
+        # thread drains at the top of every _admit (run_task parks the
+        # caller until its thunk ran).  The queue is also part of the
+        # EngineLoop's idle re-check, so a task can never be lost
+        # between the loop's _work.clear() and its park.
+        self._tasks: "queue.Queue" = queue.Queue()
+        # shipping + adoption counters (/v1/stats "kv" section and the
+        # scrape-time tpu_kv_* gauges — host-side int adds; a refused
+        # migrate-out handoff rolls its bumps back so fleet-wide
+        # sum(migrated_out) == sum(migrated_in) holds)
+        self.kv_pages_exported = 0
+        self.kv_pages_imported = 0
+        self.kv_exports = 0  # export bundles served
+        self.kv_imports = 0  # import bundles applied
+        self.sessions_migrated_out = 0
+        self.sessions_migrated_in = 0
+        # admission-level prefix-cache outcome counters (hit = at least
+        # one full page attached at admission)
+        self.prefix_lookups = 0
+        self.prefix_admission_hits = 0
+        # tokens each live slot got from the prefix cache at admission —
+        # a ``kv`` policy-verb input: a slot with a large cached/adopted
+        # prefix is the cheapest eviction (re-admission re-matches it)
+        self.matched_toks = np.zeros(max_batch, np.int32)
 
     # -- public API ----------------------------------------------------------
+
+    def _invalid_reason(self, req: Request) -> Optional[str]:
+        """Shared request validation + normalization (seed domain,
+        logprobs clamp) for BOTH admission doors — local ``submit`` and
+        migrated-session ``resume_session``.  One rule set, two error
+        deliveries (req.error vs raise): a migrated session must never
+        be accepted with parameters local submission would reject.
+        Mutates req (seed normalization, logprobs clamp) — call once."""
+        if len(req.prompt) + req.max_new_tokens > self.max_len:
+            return (
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len {self.max_len}"
+            )
+        if req.adapter not in self.adapter_index:
+            return (
+                f"unknown adapter {req.adapter!r} "
+                f"(registered: {sorted(self.adapter_index)})"
+            )
+        if req.seed is not None:
+            if isinstance(req.seed, bool) or not isinstance(req.seed, int):
+                return "seed must be an integer"
+            if req.temperature <= 0:
+                req.seed = None  # greedy ignores draws; don't pay the
+                # seeded chunk variant's compile for a no-op
+            else:
+                req.seed &= 0xFFFFFFFF  # uint32 domain (np.uint32 of an
+                # out-of-range int raises OverflowError under NumPy 2)
+        for pen in (req.frequency_penalty, req.presence_penalty):
+            if not np.isfinite(pen):
+                return "penalties must be finite"
+        if req.allowed_tokens and not all(
+            isinstance(k, int) and not isinstance(k, bool)
+            and 0 <= k < self.cfg.vocab_size
+            for k in req.allowed_tokens
+        ):
+            return (
+                f"allowed_tokens must be token ids in "
+                f"[0, {self.cfg.vocab_size})"
+            )
+        if req.logit_bias and not all(
+            isinstance(k, int) and not isinstance(k, bool)
+            and 0 <= k < self.cfg.vocab_size
+            and isinstance(v, (int, float)) and np.isfinite(v)
+            for k, v in req.logit_bias.items()
+        ):
+            return (
+                f"logit_bias keys must be token ids in "
+                f"[0, {self.cfg.vocab_size}) with finite values"
+            )
+        if req.logprobs > 0 and self.logprobs_k <= 0:
+            # a silent drop would be indistinguishable from a bug to the
+            # caller; fail the request like any other invalid ask
+            return "engine built with logprobs_k=0 (logprobs off)"
+        if isinstance(req.priority, bool) or not isinstance(
+            req.priority, int
+        ):
+            return "priority must be an integer"
+        # the top-k width is compiled into the chunk (engine logprobs_k);
+        # a wider ask gets the compiled width
+        req.logprobs = min(max(0, req.logprobs), self.logprobs_k)
+        return None
 
     def submit(self, req: Request) -> Request:
         """Validate and enqueue; invalid requests are failed immediately
@@ -1725,77 +1813,14 @@ class InferenceEngine:
             req.error = "empty prompt"
             req.done.set()
             return req
-        if len(req.prompt) + req.max_new_tokens > self.max_len:
-            req.error = (
-                f"prompt {len(req.prompt)} + max_new_tokens "
-                f"{req.max_new_tokens} exceeds max_len {self.max_len}"
-            )
-            req.done.set()
-            return req
-        if req.adapter not in self.adapter_index:
-            req.error = (
-                f"unknown adapter {req.adapter!r} "
-                f"(registered: {sorted(self.adapter_index)})"
-            )
+        err = self._invalid_reason(req)
+        if err is not None:
+            req.error = err
             req.done.set()
             return req
         if req.max_new_tokens <= 0:
             req.done.set()  # nothing to generate
             return req
-        if req.seed is not None:
-            if isinstance(req.seed, bool) or not isinstance(req.seed, int):
-                req.error = "seed must be an integer"
-                req.done.set()
-                return req
-            if req.temperature <= 0:
-                req.seed = None  # greedy ignores draws; don't pay the
-                # seeded chunk variant's compile for a no-op
-            else:
-                req.seed &= 0xFFFFFFFF  # uint32 domain (np.uint32 of an
-                # out-of-range int raises OverflowError under NumPy 2)
-        for pen in (req.frequency_penalty, req.presence_penalty):
-            if not np.isfinite(pen):
-                req.error = "penalties must be finite"
-                req.done.set()
-                return req
-        if req.allowed_tokens and not all(
-            isinstance(k, int) and not isinstance(k, bool)
-            and 0 <= k < self.cfg.vocab_size
-            for k in req.allowed_tokens
-        ):
-            req.error = (
-                f"allowed_tokens must be token ids in "
-                f"[0, {self.cfg.vocab_size})"
-            )
-            req.done.set()
-            return req
-        if req.logit_bias and not all(
-            isinstance(k, int) and not isinstance(k, bool)
-            and 0 <= k < self.cfg.vocab_size
-            and isinstance(v, (int, float)) and np.isfinite(v)
-            for k, v in req.logit_bias.items()
-        ):
-            req.error = (
-                f"logit_bias keys must be token ids in "
-                f"[0, {self.cfg.vocab_size}) with finite values"
-            )
-            req.done.set()
-            return req
-        if req.logprobs > 0 and self.logprobs_k <= 0:
-            # a silent drop would be indistinguishable from a bug to the
-            # caller; fail the request like any other invalid ask
-            req.error = "engine built with logprobs_k=0 (logprobs off)"
-            req.done.set()
-            return req
-        if isinstance(req.priority, bool) or not isinstance(
-            req.priority, int
-        ):
-            req.error = "priority must be an integer"
-            req.done.set()
-            return req
-        # the top-k width is compiled into the chunk (engine logprobs_k);
-        # a wider ask gets the compiled width
-        req.logprobs = min(max(0, req.logprobs), self.logprobs_k)
         if self.max_queue:
             # cap-check + enqueue must be atomic across handler threads
             # (ThreadingHTTPServer), else a burst overshoots the bound;
@@ -2066,6 +2091,10 @@ class InferenceEngine:
                 req.on_token = None
 
     def _admit(self) -> None:
+        # cross-thread engine tasks first (KV export/import, session
+        # migration): the engine thread is the sole owner of slot/page/
+        # pool state, so the HTTP layer's disagg verbs run here
+        self._run_tasks()
         # anti-thrash: while a stalled slot outranks the queue's best,
         # admitting lower classes would immediately re-trigger the spill
         # they were evicted by — leave them queued until pressure clears
@@ -2150,6 +2179,11 @@ class InferenceEngine:
             # no page zeroing needed: the position mask only exposes
             # positions <= length, all of which the new tenant rewrites
             matched = self._match_prefix(i, req) if self.prefix_cache else 0
+            if self.prefix_cache:
+                self.prefix_lookups += 1
+                if matched:
+                    self.prefix_admission_hits += 1
+            self.matched_toks[i] = matched
             self.lengths[i] = matched
             if matched:
                 self.next_token[i] = int(self.prompts[i, matched])
@@ -2419,6 +2453,7 @@ class InferenceEngine:
         self.prefilling[i] = False
         self.gen_before[i] = 0
         self.priorities[i] = 0
+        self.matched_toks[i] = 0
         self._seeded[i] = False
         self._clear_bias(i)
         self._clear_stop(i)
@@ -2440,6 +2475,7 @@ class InferenceEngine:
         self.prefilling[i] = False
         self.gen_before[i] = 0
         self.priorities[i] = 0
+        self.matched_toks[i] = 0
         self._seeded[i] = False
         self._clear_bias(i)
         self._clear_stop(i)
@@ -2469,6 +2505,377 @@ class InferenceEngine:
         self._release_slot(i)
         if requeue and not req.done.is_set():
             self._enqueue(req)
+
+    # -- disaggregated serving data plane (utils/kvwire, fleet/) -------------
+    #
+    # Prefill/decode split, replica-to-replica KV-page shipping and live
+    # session migration all reduce to four engine-thread primitives:
+    # export cached prefix pages as a wire bundle, import a bundle's
+    # pages into the local pool + prefix cache, detach a live slot into
+    # a session bundle (evict→export), and resume a shipped session
+    # (enqueue→prefix-match the imported pages → token-identical
+    # continuation, the same exactness contract as the local spill).
+    # HTTP handlers reach them through run_task — the engine thread is
+    # the sole owner of slot/page/pool state.
+
+    def run_task(self, fn, timeout: float = 30.0,
+                 abandon_on_timeout: bool = True):
+        """Execute ``fn()`` on the engine thread (drained at the top of
+        every ``_admit``) and return its result, re-raising whatever it
+        raised.  Callers must be driving the engine from another thread
+        (the EngineLoop case); with no loop running this times out.
+
+        A timeout ABANDONS the thunk: the engine thread skips it if it
+        hasn't started yet, so a timed-out caller can safely treat the
+        task as never-ran (the migrate-in path relies on this — a late
+        import would resurrect the session on a second replica).  A
+        thunk already mid-execution when the caller gives up cannot be
+        recalled; that window is one lock-free flag check wide.
+        ``abandon_on_timeout=False`` keeps the thunk runnable after a
+        timeout — for callers whose thunk MUST eventually happen (the
+        migrate-out local re-enqueue: losing it loses the session)."""
+        done = threading.Event()
+        box: dict = {"abandoned": False}
+
+        def thunk():
+            if box["abandoned"]:  # caller timed out before we started
+                done.set()
+                return
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # re-raised on the caller thread
+                box["error"] = e
+            finally:
+                done.set()
+
+        self._tasks.put(thunk)
+        self._work.set()  # wake a parked EngineLoop
+        if not done.wait(timeout):
+            box["abandoned"] = abandon_on_timeout
+            raise TimeoutError("engine task timed out (no engine loop?)")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _run_tasks(self) -> None:
+        while True:
+            try:
+                thunk = self._tasks.get_nowait()
+            except queue.Empty:
+                return
+            thunk()  # thunk() never raises (errors park in its box)
+
+    def _chain_seed(self, adapter: str) -> bytes:
+        if adapter not in self.adapter_index:
+            raise ValueError(
+                f"unknown adapter {adapter!r} "
+                f"(registered: {sorted(self.adapter_index)})"
+            )
+        return _prefix_seed(int(self.adapter_index[adapter]))
+
+    def _pool_keys(self) -> tuple:
+        return ("k", "v", "ks", "vs") if self.kv_int8 else ("k", "v")
+
+    def _wire_header(self, adapter: str, kind: str) -> dict:
+        """Geometry fields the importer verifies before any page lands —
+        two engines can only exchange pages when their pools are laid
+        out identically (fleet replicas of one deployment are)."""
+        return {
+            "kind": kind,
+            "page_size": self.page_size,
+            "n_layers": self.cfg.n_layers,
+            "kv_heads": self.cfg.kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "dtype": str(np.dtype(self.kv["k"].dtype)),
+            "kv_int8": self.kv_int8,
+            "adapter": adapter,
+        }
+
+    def cached_prefix_pages(self, tokens, adapter: str = "") -> list[int]:
+        """Page ids for the longest locally-cached run of ``tokens``'
+        leading full pages, capped at len-1 (mirroring ``_match_prefix``:
+        a page the destination's admission can never attach is not worth
+        shipping).  Read-only — no refs taken, no LRU touch."""
+        ps = self.page_size
+        toks = np.asarray(list(tokens), np.int32)
+        key = self._chain_seed(adapter)
+        out: list[int] = []
+        for j in range((max(0, len(toks) - 1)) // ps):
+            key = _prefix_page_key(key, toks[j * ps:(j + 1) * ps])
+            pg = self.prefix_entries.get(key)
+            if pg is None:
+                break
+            out.append(pg)
+        return out
+
+    def _page_payloads(self, pgs: list[int]) -> list[bytes]:
+        """Serialize pool pages ``pgs`` → raw per-page payload bytes
+        (concatenated pool keys, layer-major).  ONE device→host gather
+        per pool key, not one per page; reading the current ``self.kv``
+        blocks until any in-flight chunk lands, and the chunk only
+        scatters at positions past what we export, so the bytes are the
+        confirmed values."""
+        idx = np.asarray(pgs, np.int32)
+        per_key = {
+            k: np.ascontiguousarray(np.asarray(self.kv[k][:, idx]))
+            for k in self._pool_keys()
+        }
+        return [
+            b"".join(
+                np.ascontiguousarray(per_key[k][:, j]).tobytes()
+                for k in self._pool_keys()
+            )
+            for j in range(len(pgs))
+        ]
+
+    def export_prefix_pages(
+        self, tokens, adapter: str = "", max_pages: int = 0
+    ) -> Optional[bytes]:
+        """Wire bundle of the cached pages covering ``tokens``' leading
+        full pages, or None when nothing is cached.  The receiving
+        replica re-derives registration keys from the shipped token
+        content with ITS adapter seed, so bank-index skew between
+        replicas cannot alias pages."""
+        toks = [int(t) for t in tokens]
+        pgs = self.cached_prefix_pages(toks, adapter)
+        if max_pages > 0:
+            pgs = pgs[:max_pages]
+        if not pgs:
+            return None
+        ps = self.page_size
+        payloads = self._page_payloads(pgs)
+        pages = [
+            (toks[j * ps:(j + 1) * ps], payloads[j])
+            for j in range(len(pgs))
+        ]
+        for pg in pgs:
+            self._touch(pg)  # shipped = used: keep under LRU pressure
+        self.kv_exports += 1
+        self.kv_pages_exported += len(pgs)
+        return kvwire.encode_bundle(
+            self._wire_header(adapter, "prefix"), pages,
+            self._chain_seed(adapter),
+        )
+
+    def import_pages(self, header: dict, pages: list) -> dict:
+        """Land a decoded bundle's pages in the local pool and register
+        them in the prefix cache (content-addressed under THIS engine's
+        chain).  Geometry mismatch raises before anything lands; pool
+        pressure stops the import cleanly (later pages are useless
+        without their predecessors — ``_match_prefix`` walks in order).
+        Returns {"imported", "already", "tokens", "stopped"}."""
+        if not self.prefix_cache:
+            raise ValueError("prefix cache disabled (--prefix-cache)")
+        mine = self._wire_header(str(header.get("adapter", "")), "")
+        for f in ("page_size", "n_layers", "kv_heads", "head_dim",
+                  "dtype", "kv_int8"):
+            if header.get(f) != mine[f]:
+                raise ValueError(
+                    f"incompatible KV geometry: {f} "
+                    f"{header.get(f)!r} != {mine[f]!r}"
+                )
+        adapter = str(header.get("adapter", ""))
+        key = self._chain_seed(adapter)  # raises on unknown adapter
+        ps = self.page_size
+        kdt = np.dtype(self.kv["k"].dtype)
+        L, hkv, hd = self.cfg.n_layers, self.cfg.kv_heads, self.cfg.head_dim
+        sizes = {
+            k: (L * ps * hkv * (hd if k in ("k", "v") else 1))
+            * (kdt.itemsize if k in ("k", "v") else 4)
+            for k in self._pool_keys()
+        }
+        shapes = {
+            k: (L, ps, hkv, hd) if k in ("k", "v") else (L, ps, hkv)
+            for k in self._pool_keys()
+        }
+        # validate EVERY page's frame against the geometry BEFORE any
+        # allocation or registration: a raise below this loop would
+        # otherwise leave earlier pages registered in prefix_entries
+        # with never-written pool content (the garbage-page hazard
+        # _register_prompt_pages documents) — the method's contract is
+        # that a rejection lands NOTHING
+        payload_size = sum(sizes.values())
+        for toks, payload in pages:
+            if len(toks) != ps:
+                raise ValueError("partial page in bundle")
+            if len(payload) != payload_size:
+                raise ValueError("payload size does not match geometry")
+        staged: list[tuple[int, dict]] = []
+        pinned: list[int] = []  # ref-bumped for the import's duration
+        imported = already = covered = 0
+        stopped = None
+        try:
+            for toks, payload in pages:
+                key = _prefix_page_key(key, np.asarray(toks, np.int32))
+                existing = self.prefix_entries.get(key)
+                if existing is not None:
+                    already += 1
+                    covered += ps
+                    self._touch(existing)
+                    # pin: a later page's allocation must not LRU-evict
+                    # an earlier link of the SAME chain (match walks in
+                    # order)
+                    self.page_ref[existing] += 1
+                    pinned.append(existing)
+                    continue
+                pg = self._alloc_page()
+                if pg is None:
+                    stopped = "page pool exhausted"
+                    break
+                parsed, off = {}, 0
+                for k in self._pool_keys():
+                    dt = kdt if k in ("k", "v") else np.dtype(np.float32)
+                    parsed[k] = np.frombuffer(
+                        payload[off:off + sizes[k]], dt
+                    ).reshape(shapes[k])
+                    off += sizes[k]
+                # pinned while the import runs so a later page's
+                # allocation cannot cannibalize this one; released to
+                # ref 0 (cached, LRU-evictable) below
+                self.page_ref[pg] = 1
+                pinned.append(pg)
+                self.prefix_entries[key] = pg
+                self.page_key[pg] = key
+                self._touch(pg)
+                staged.append((pg, parsed))
+                imported += 1
+                covered += ps
+        finally:
+            for pg in pinned:
+                self.page_ref[pg] -= 1
+        if staged:
+            idx = jnp.asarray(
+                np.asarray([pg for pg, _ in staged], np.int32)
+            )
+            for k in self._pool_keys():
+                stack = np.stack([p[k] for _, p in staged], axis=1)
+                self.kv[k] = self.kv[k].at[:, idx].set(jnp.asarray(stack))
+            self.kv_imports += 1
+            self.kv_pages_imported += imported
+        return {
+            "imported": imported,
+            "already": already,
+            "tokens": covered,
+            "stopped": stopped,
+        }
+
+    def migrate_out_bundle(self, slot: int) -> Optional[bytes]:
+        """Detach live slot ``slot`` into a ``kind="session"`` bundle:
+        request state + the K/V pages covering its confirmed sequence,
+        then evict WITHOUT a local requeue (the caller owns the request
+        from here — it re-enqueues locally only if the destination
+        refuses).  The eviction discards at most the one in-flight
+        overlapped chunk (``evict_slot``'s contract); everything the
+        bundle carries is confirmed state, so the destination resumes
+        token-identically."""
+        req = self.slots[slot]
+        if req is None or req.done.is_set():
+            return None
+        seq = list(req.prompt) + list(req.output)
+        ps = self.page_size
+        # confirmed written positions only: lengths may be eagerly
+        # advanced for an undrained chunk, but positions < len(seq)-1
+        # are always written and match seq's content
+        end = min(int(self.lengths[slot]), len(seq) - 1)
+        n = max(0, min(end // ps, len(self.slot_pages[slot])))
+        pages = []
+        if n > 0:
+            payloads = self._page_payloads(self.slot_pages[slot][:n])
+            pages = [
+                (seq[j * ps:(j + 1) * ps], payloads[j]) for j in range(n)
+            ]
+        header = self._wire_header(req.adapter, "session")
+        header["request"] = {
+            "prompt": [int(t) for t in req.prompt],
+            "output": [int(t) for t in req.output],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "adapter": req.adapter,
+            "stop_tokens": [int(t) for t in req.stop_tokens],
+            "logprobs": int(req.logprobs),
+            "token_logprobs": list(req.token_logprobs),
+            "top_logprobs": [
+                [[int(t), float(lp)] for t, lp in top]
+                for top in req.top_logprobs
+            ],
+            "logit_bias": {
+                str(k): float(v) for k, v in req.logit_bias.items()
+            },
+            "frequency_penalty": float(req.frequency_penalty),
+            "presence_penalty": float(req.presence_penalty),
+            "min_tokens": int(req.min_tokens),
+            "priority": int(req.priority),
+            "seed": req.seed,
+            "allowed_tokens": [int(t) for t in req.allowed_tokens],
+            "pool_spills": int(req.pool_spills),
+        }
+        data = kvwire.encode_bundle(
+            header, pages, self._chain_seed(req.adapter)
+        )
+        self.sessions_migrated_out += 1
+        self.kv_pages_exported += n
+        self.evict_slot(slot, requeue=False)
+        return data
+
+    def resume_session(self, state: dict, on_token=None) -> Request:
+        """Re-create a migrated session's Request and enqueue it for the
+        engine's spill-resume machinery (``_admit`` feeds prompt+output
+        and prefix-matches the imported pages, so the re-prefill covers
+        only the unshipped tail).  Bypasses the admission cap — a
+        migrated session is in-flight work, not new traffic (the spill
+        requeue's stance).  Raises on invalid state; returns the live
+        Request (done/output/error owned by this engine from here)."""
+        if self.draining:
+            raise RuntimeError(DRAINING_ERROR)
+        prompt = [int(t) for t in (state.get("prompt") or [])]
+        if not prompt:
+            raise ValueError("session has an empty prompt")
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=int(state.get("max_new_tokens", 16)),
+            temperature=float(state.get("temperature", 0.0)),
+            top_k=int(state.get("top_k", 0)),
+            top_p=float(state.get("top_p", 1.0)),
+            adapter=str(state.get("adapter", "")),
+            stop_tokens=tuple(
+                int(t) for t in (state.get("stop_tokens") or ())
+            ),
+            logprobs=int(state.get("logprobs", 0)),
+            logit_bias={
+                int(k): float(v)
+                for k, v in (state.get("logit_bias") or {}).items()
+            },
+            frequency_penalty=float(state.get("frequency_penalty", 0.0)),
+            presence_penalty=float(state.get("presence_penalty", 0.0)),
+            min_tokens=int(state.get("min_tokens", 0)),
+            priority=int(state.get("priority", 0)),
+            seed=state.get("seed"),
+            allowed_tokens=tuple(
+                int(t) for t in (state.get("allowed_tokens") or ())
+            ),
+        )
+        err = self._invalid_reason(req)  # submit()'s exact rule set
+        if err is not None:
+            raise ValueError(err)
+        req.output = [int(t) for t in (state.get("output") or [])]
+        req.token_logprobs = [
+            None if lp is None else float(lp)
+            for lp in (state.get("token_logprobs") or [])
+        ]
+        req.top_logprobs = [
+            [(int(t), float(lp)) for t, lp in top]
+            for top in (state.get("top_logprobs") or [])
+        ]
+        req.pool_spills = int(state.get("pool_spills", 0))
+        req.on_token = on_token
+        self.sessions_migrated_in += 1
+        if len(req.output) >= req.max_new_tokens:
+            req.done.set()  # arrived complete: nothing left to generate
+            return req
+        self._enqueue(req)
+        return req
 
     def _prepare_step(self, lookahead: int):
         """Host-side slot scan shared by BOTH step flavors (sequential

@@ -45,9 +45,15 @@ passed it; result truthy = keep):
 
     chips, priority, whole, is_gang, node_free
 
-``kv`` (serving KV-page preemption victim; HIGHER = evict first):
+``kv`` (serving KV-page preemption/migration victim; HIGHER = evict or
+migrate first):
 
-    priority, pages, tokens, slot
+    priority, pages, tokens, slot, matched
+
+``matched`` is the disaggregated data plane's input: tokens the slot
+got from the prefix cache at admission (local hit or adopted pages) —
+a slot riding a big cached prefix is the cheapest to evict or migrate,
+because re-admission re-matches the pages instead of re-prefilling.
 """
 
 from __future__ import annotations
@@ -113,7 +119,7 @@ FILTER_INPUTS = (
 )
 PREEMPT_INPUTS = ("priority", "chips", "members", "is_gang")
 DEFRAG_INPUTS = ("chips", "priority", "whole", "is_gang", "node_free")
-KV_INPUTS = ("priority", "pages", "tokens", "slot")
+KV_INPUTS = ("priority", "pages", "tokens", "slot", "matched")
 
 VERB_INPUTS = {
     "score": SCORE_INPUTS,

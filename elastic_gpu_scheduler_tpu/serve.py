@@ -101,6 +101,18 @@ def build_args(argv=None):
                         "/debug/profiles and the tpu_workload_* metrics "
                         "serve the result; cost per sampled step is one "
                         "ring-buffer append off the device path")
+    p.add_argument("--fleet-role", choices=["both", "prefill", "decode"],
+                   default="",
+                   help="disaggregated-serving role (default from "
+                        "TPU_FLEET_ROLE, else 'both'): 'prefill' "
+                        "replicas batch chunked long-prompt prefill and "
+                        "export the KV pages (/v1/prefill + "
+                        "/v1/kv/export; the router keeps them out of "
+                        "completion rotation), 'decode' replicas run "
+                        "the token loop and adopt shipped pages, "
+                        "'both' serves everything (the classic single-"
+                        "role pod).  Requires --prefix-cache for the "
+                        "page-shipping paths")
     p.add_argument("--replica-name", default="",
                    help="fleet identity this replica reports in /v1/stats "
                         "(default from POD_NAME; the front-door router "
@@ -314,6 +326,33 @@ def main(argv=None) -> int:
     engine.replica_name = (
         args.replica_name or _os.environ.get("POD_NAME", "")
     )
+    # disaggregated-serving role (/v1/stats "role"): the router reads it
+    # from stats polls — prefill-role replicas get zero completion
+    # traffic, only /v1/prefill + /v1/kv/export work
+    fleet_role = (
+        args.fleet_role
+        or _os.environ.get("TPU_FLEET_ROLE", "").strip().lower()
+        or "both"
+    )
+    if fleet_role not in ("both", "prefill", "decode"):
+        # argparse choices only guard the flag; the env path must fail
+        # fast too — a typo'd role would silently disable the router's
+        # prefill isolation (the replica would advertise an unknown
+        # role and be treated as completion-taking)
+        raise SystemExit(
+            f"TPU_FLEET_ROLE={fleet_role!r} invalid "
+            "(want both|prefill|decode)"
+        )
+    if fleet_role != "both" and not args.prefix_cache:
+        # same fail-fast stance: a prefill replica without the prefix
+        # cache starts healthy but is dead capacity (zero completion
+        # traffic from the router, every /v1/prefill + /v1/kv/export a
+        # 409), and a decode replica can't adopt shipped pages
+        raise SystemExit(
+            f"--fleet-role {fleet_role} requires --prefix-cache "
+            "(KV pages are cached prefix pages)"
+        )
+    engine.fleet_role = fleet_role
     server, loop = serve_inference(engine, port=args.port, host=args.host)
     if warmup_mode != "off":
         # the HTTP server is already up: /healthz answers 503

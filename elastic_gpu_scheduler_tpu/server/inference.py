@@ -31,6 +31,7 @@ the inference story the workload plane opened.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import math
@@ -43,7 +44,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import __version__
-from ..metrics import REGISTRY, Counter, Gauge, Histogram
+from ..metrics import (
+    KV_MIGRATIONS,
+    KV_PAGES_RESIDENT,
+    KV_PAGES_SHIPPED,
+    KV_PREFIX_ADMISSIONS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+)
 from ..policy import POLICIES
 from ..profile import PROFILER
 from ..tracing import TRACEPARENT_HEADER, TRACER
@@ -53,6 +63,8 @@ from ..models.serving import (
     InferenceEngine,
     Request,
 )
+from ..utils import kvwire
+from ..utils.kvwire import KV_SOURCE_HEADER
 from .routes import _REASONS
 
 log = logging.getLogger("tpu-scheduler")
@@ -115,19 +127,28 @@ def choose_kv_victim(eng) -> int:
     Routed through the policy registry's ``kv`` verb: the built-in
     ranking is the historic hard-coded choice (lowest-priority slot,
     most pages held as tiebreak); a hot-loaded ``kv`` policy re-ranks
-    with the typed inputs priority / pages / tokens / slot (HIGHER
-    score = evict first), falling back to the built-in on any policy
-    fault.  Only runs on the rare pool-exhausted path — never on the
-    per-token loop."""
+    with the typed inputs priority / pages / tokens / slot / matched
+    (HIGHER score = evict first), falling back to the built-in on any
+    policy fault.  ``matched`` is the disagg plane's input: tokens the
+    slot got from the prefix cache at admission — a slot riding a big
+    cached/adopted prefix is the cheapest eviction OR migration victim
+    (re-admission re-matches the pages instead of re-prefilling).  Only
+    runs on the rare pool-exhausted path and the migration picker —
+    never on the per-token loop."""
     return POLICIES.select_kv_victim([
         {
             "slot": float(i),
             "priority": float(eng.priorities[i]),
             "pages": float(len(eng.slot_pages[i])),
             "tokens": float(len(getattr(s, "output", ()) or ())),
+            "matched": float(eng.matched_toks[i]),
         }
         for i, s in enumerate(eng.slots)
-        if s is not None
+        # done-but-unreleased slots (released at the next _prepare_step)
+        # are not candidates: the migration picker runs before that
+        # release, and a 'victim' with nothing left to run would turn
+        # into a spurious no-live-session verdict
+        if s is not None and not s.done.is_set()
     ])
 
 
@@ -279,6 +300,7 @@ class EngineLoop:
                     eng._work.clear()
                     if (
                         eng.queue.empty()
+                        and eng._tasks.empty()
                         and not any(s is not None for s in eng.slots)
                         and not self._stop.is_set()
                     ):
@@ -458,6 +480,110 @@ def _drain_burst(q: "queue.Queue", first, cap: int = 512) -> list:
     return events
 
 
+def _split_hostport(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad replica address {addr!r} (want host:port)")
+    return host, int(port)
+
+
+# Ceiling on the in-request adoption pull (X-KV-Source / /v1/kv/adopt →
+# donor /v1/kv/export): adoption is a latency OPTIMIZATION, so a stalled
+# donor must cost less than the re-prefill it was meant to save.
+ADOPT_PULL_TIMEOUT_S = 5.0
+
+
+def _backend_post(
+    addr: str, path: str, body: bytes, ctype: str, timeout: float = 30.0
+) -> tuple[int, bytes]:
+    """One replica-to-replica POST (KV export pulls).  Small bodies,
+    full read — streaming exchanges go through ``_backend_stream``."""
+    host, port = _split_hostport(addr)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body, {"Content-Type": ctype})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _backend_stream(addr: str, path: str, body: bytes, timeout: float = 300.0):
+    """Open a streaming POST to a peer replica; returns (response, conn,
+    error) with the connection left open for incremental reads — the
+    migration handoff reads the continuation token by token."""
+    try:
+        host, port = _split_hostport(addr)
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.request(
+            "POST", path, body,
+            {"Content-Type": "application/octet-stream"},
+        )
+        return conn.getresponse(), conn, None
+    except (OSError, ConnectionError, ValueError) as e:
+        return None, None, str(e)
+
+
+def _relay_migrated(req: Request, resp, conn) -> None:
+    """Source-side continuation pump for a migrated session: the
+    destination streams the remaining tokens as SSE events; this thread
+    feeds them into the ORIGINAL request object (output/logprobs/
+    on_token/done) exactly as the engine thread would have — ownership
+    of the request passed from the engine to this thread at eviction,
+    so nothing else mutates it.  The client's connection never moves;
+    only the compute did.  A client cancel propagates by dropping the
+    relay connection — the destination sees the disconnect at its next
+    write and cancels its side."""
+    try:
+        while True:
+            if req.cancelled:
+                break  # closing conn below cancels the destination too
+            line = resp.readline()
+            if not line:
+                if not req.cancelled and not req.error:
+                    req.error = "migrated session relay closed early"
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[6:]
+            if payload == b"[DONE]":
+                break
+            ev = json.loads(payload)
+            if "error" in ev:
+                req.error = str(ev["error"])
+                continue  # the [DONE] terminator follows
+            tok = ev.get("token")
+            if tok is None:
+                continue
+            if req.logprobs > 0:
+                req.token_logprobs.append(ev.get("logprob"))
+                req.top_logprobs.append([
+                    (int(d["id"]), float(d["logprob"]))
+                    for d in ev.get("top_logprobs") or []
+                ])
+            req.output.append(int(tok))
+            cb = req.on_token
+            if cb is not None:
+                try:
+                    cb(int(tok))
+                except Exception:
+                    log.warning(
+                        "on_token raised during migration relay; "
+                        "streaming disabled", exc_info=True,
+                    )
+                    req.on_token = None
+    except (OSError, ConnectionError, ValueError) as e:
+        if not req.error:
+            req.error = f"migration relay broke: {e}"
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        req.done.set()
+
+
 def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
     engine = loop.engine
 
@@ -508,6 +634,34 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     for pri, depth in engine.queue_depths().items():
                         SERVE_QUEUE_DEPTH.set(str(pri), value=float(depth))
                     SERVE_SPILLS.set(value=float(engine.spills))
+                    # disaggregated-serving gauges from live engine
+                    # state (monotonic counters exposed at scrape time,
+                    # the SERVE_SPILLS stance): page residency split,
+                    # pages shipped each way, prefix-cache admissions
+                    free = len(engine.free_pages)
+                    cached = len(engine.page_key)
+                    total = engine.n_pages - 1
+                    KV_PAGES_RESIDENT.set(
+                        "active", value=float(total - free - cached)
+                    )
+                    KV_PAGES_RESIDENT.set("cached", value=float(cached))
+                    KV_PAGES_RESIDENT.set("free", value=float(free))
+                    KV_PAGES_SHIPPED.set(
+                        "exported", value=float(engine.kv_pages_exported)
+                    )
+                    KV_PAGES_SHIPPED.set(
+                        "imported", value=float(engine.kv_pages_imported)
+                    )
+                    KV_PREFIX_ADMISSIONS.set(
+                        "hit", value=float(engine.prefix_admission_hits)
+                    )
+                    KV_PREFIX_ADMISSIONS.set(
+                        "miss",
+                        value=float(
+                            engine.prefix_lookups
+                            - engine.prefix_admission_hits
+                        ),
+                    )
                     # fold the engine's buffered per-chunk gap samples
                     # (the scraper pays the bucketing, never the engine)
                     SERVE_HOST_GAP.observe_batch(
@@ -583,6 +737,29 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                     "page_size": eng.page_size,
                     "chunks_discarded": int(eng.chunks_discarded),
                     "replica": getattr(eng, "replica_name", ""),
+                    # disaggregated serving: the replica's role in the
+                    # prefill/decode split (the router keeps prefill-role
+                    # replicas out of completion rotation) and the KV
+                    # shipping/prefix-cache counters the fleet index and
+                    # the tpu_kv_* gauges read
+                    "role": getattr(eng, "fleet_role", "both"),
+                    "kv": {
+                        "pages_exported": int(eng.kv_pages_exported),
+                        "pages_imported": int(eng.kv_pages_imported),
+                        "export_bundles": int(eng.kv_exports),
+                        "import_bundles": int(eng.kv_imports),
+                        "migrated_out": int(eng.sessions_migrated_out),
+                        "migrated_in": int(eng.sessions_migrated_in),
+                        "prefix_lookups": int(eng.prefix_lookups),
+                        "prefix_hits": int(eng.prefix_admission_hits),
+                        "prefix_misses": int(
+                            eng.prefix_lookups - eng.prefix_admission_hits
+                        ),
+                        "resident_pages": int(
+                            eng.n_pages - 1 - len(eng.free_pages)
+                        ),
+                        "cached_pages": len(eng.page_key),
+                    },
                     # warm-start compilation plane: warm-up phase state
                     # (router/autoscaler readiness gating) + the AOT
                     # cache's fill/load counters (check-compile-cache
@@ -609,6 +786,21 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 loop.inflight_exit()
 
         def _do_post(self):
+            # disaggregated serving data plane (OPERATIONS.md
+            # "Disaggregated serving"): prefill-only admissions, KV-page
+            # export/adopt, and live session migration ride the same
+            # server; engine state is only ever touched via
+            # ``engine.run_task`` (the engine thread owns it)
+            if self.path == "/v1/prefill":
+                return self._prefill_only()
+            if self.path == "/v1/kv/export":
+                return self._kv_export()
+            if self.path == "/v1/kv/adopt":
+                return self._kv_adopt()
+            if self.path == "/v1/migrate/out":
+                return self._migrate_out()
+            if self.path == "/v1/migrate/in":
+                return self._migrate_in()
             if self.path != "/v1/completions":
                 return self._json(404, {"error": f"no route {self.path}"})
             try:
@@ -639,6 +831,21 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # max_tokens, temperature, ...) — a clean 400, not an
                 # aborted connection
                 return self._json(400, {"error": str(e)})
+            kv_src = self.headers.get(KV_SOURCE_HEADER)
+            if kv_src and engine.prefix_cache:
+                # fleet prefix-index adoption: the router knows another
+                # replica holds this prompt's KV pages — pull them
+                # before admission so _match_prefix turns the route into
+                # skipped prefill.  Strictly best-effort: any failure
+                # just re-prefills locally (never fails the request).
+                try:
+                    self._adopt_from(kv_src, body.get("prompt"),
+                                     str(body.get("adapter", "")))
+                except Exception:
+                    log.warning(
+                        "KV adoption from %s failed; re-prefilling",
+                        kv_src, exc_info=True,
+                    )
             # serving-plane tracing: a client traceparent header joins its
             # trace; otherwise each request roots a fresh one.  The span
             # context rides on the Request so the ENGINE thread can drop
@@ -659,6 +866,358 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 if n > 1:
                     return self._multi(reqs, n)
                 return self._single(req, sp)
+
+        # -- disaggregated serving data plane ------------------------------
+
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+
+        def _bytes_resp(
+            self, code: int, data: bytes,
+            ctype: str = "application/octet-stream",
+        ) -> None:
+            self.send_response(code, _REASONS.get(code, ""))
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _adopt_from(
+            self, source: str, tokens, adapter: str, max_pages: int = 0
+        ) -> dict:
+            """Pull the prefix's cached pages from ``source`` and land
+            them locally.  Skips the pull when the local cache already
+            covers everything adoptable — the common re-route case must
+            not re-ship pages it has."""
+            if not isinstance(tokens, list) or not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in tokens
+            ):
+                return {"imported": 0, "reason": "no adoptable prompt"}
+            ps = engine.page_size
+            want = max(0, (len(tokens) - 1) // ps)
+            if max_pages > 0:
+                want = min(want, max_pages)
+            if want == 0:
+                return {"imported": 0,
+                        "reason": "prompt shorter than one full page"}
+            have = engine.run_task(
+                lambda: len(engine.cached_prefix_pages(tokens, adapter))
+            )
+            if have >= want:
+                return {"imported": 0, "already": have,
+                        "reason": "local cache already covers the prefix"}
+            # bounded pull: this runs INSIDE the client's completion
+            # request (the X-KV-Source pre-admission path) — a donor
+            # that stopped answering (health-drained for unreachability)
+            # must cost seconds before the best-effort fallback
+            # re-prefills, not the 30s backend default
+            status, data = _backend_post(
+                source, "/v1/kv/export",
+                json.dumps({
+                    "tokens": tokens, "adapter": adapter,
+                    "max_pages": max_pages,
+                }).encode(),
+                "application/json",
+                timeout=ADOPT_PULL_TIMEOUT_S,
+            )
+            if status != 200:
+                return {"imported": 0,
+                        "reason": f"source answered {status}"}
+            hdr, pages = kvwire.decode_bundle(data)
+            return engine.run_task(
+                lambda: engine.import_pages(hdr, pages)
+            )
+
+        def _prefill_only(self):
+            """Prefill-role admission (the disagg split's first half):
+            run the prompt through (chunked) prefill so its pages land
+            in THIS replica's prefix cache, ready for export.  Costs one
+            emitted-and-discarded token — the exact completion path, so
+            every prefill optimization (chunking, prefix hits) applies."""
+            if not engine.prefix_cache:
+                return self._json(409, {
+                    "error": "prefix cache disabled (--prefix-cache)"
+                })
+            try:
+                body = self._read_json()
+                prompt = _token_ids(
+                    body.get("prompt"), engine.cfg.vocab_size, "prompt"
+                )
+                adapter = str(body.get("adapter", ""))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return self._json(400, {"error": str(e)})
+            t0 = time.monotonic()
+            req = Request(
+                prompt=list(prompt), max_new_tokens=1, adapter=adapter
+            )
+            engine.submit(req)
+            if not req.done.wait(request_timeout):
+                req.cancel()
+                req.done.wait(10.0)
+                return self._json(504, {"error": "prefill timed out"})
+            if req.error:
+                return self._json(
+                    _reject_code(req.error), {"error": req.error}
+                )
+            return self._json(200, {
+                "ok": True,
+                "tokens": len(prompt),
+                # pages a later admission (or export) can actually use —
+                # the chain's plen-1 cap, same as _match_prefix
+                "pages": max(0, (len(prompt) - 1) // engine.page_size),
+                "replica": getattr(engine, "replica_name", ""),
+                "wall_ms": round((time.monotonic() - t0) * 1000, 3),
+            })
+
+        def _kv_export(self):
+            if not engine.prefix_cache:
+                return self._json(409, {
+                    "error": "prefix cache disabled (--prefix-cache)"
+                })
+            try:
+                body = self._read_json()
+                tokens = _token_ids(
+                    body.get("tokens"), engine.cfg.vocab_size, "tokens"
+                )
+                adapter = str(body.get("adapter", ""))
+                max_pages = int(body.get("max_pages", 0))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return self._json(400, {"error": str(e)})
+            try:
+                data = engine.run_task(
+                    lambda: engine.export_prefix_pages(
+                        tokens, adapter, max_pages
+                    )
+                )
+            except TimeoutError as e:
+                return self._json(503, {"error": str(e)})
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            if data is None:
+                return self._json(404, {
+                    "error": "no cached pages for this prefix"
+                })
+            return self._bytes_resp(200, data)
+
+        def _kv_adopt(self):
+            if not engine.prefix_cache:
+                return self._json(409, {
+                    "error": "prefix cache disabled (--prefix-cache)"
+                })
+            try:
+                body = self._read_json()
+                source = str(body.get("source", ""))
+                if not source:
+                    raise ValueError("'source' (host:port) is required")
+                res = self._adopt_from(
+                    source, body.get("tokens"),
+                    str(body.get("adapter", "")),
+                    int(body.get("max_pages", 0)),
+                )
+            except kvwire.WireError as e:
+                return self._json(502, {"error": f"corrupt bundle: {e}"})
+            except (OSError, ConnectionError) as e:
+                return self._json(502, {"error": f"source pull failed: {e}"})
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return self._json(400, {"error": str(e)})
+            return self._json(200, res)
+
+        def _migrate_out(self):
+            """Live migration, source side: detach a session (chosen by
+            the ``kv`` policy verb unless a slot is named), ship the
+            bundle to ``dest``, then RELAY the destination's continuation
+            into the original request — the client's connection never
+            moves, only the compute does.  A refused handoff re-enqueues
+            locally (exact resume), so the session is never lost."""
+            try:
+                body = self._read_json()
+                dest = str(body.get("dest", ""))
+                if not dest:
+                    raise ValueError("'dest' (host:port) is required")
+                slot = body.get("slot")
+                if slot is not None and (
+                    isinstance(slot, bool) or not isinstance(slot, int)
+                ):
+                    raise ValueError("'slot' must be an integer")
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return self._json(400, {"error": str(e)})
+
+            def grab():
+                i = slot
+                if i is None:
+                    if not any(
+                        s is not None and not s.done.is_set()
+                        for s in engine.slots
+                    ):
+                        return None
+                    i = choose_kv_victim(engine)
+                elif not 0 <= i < engine.max_batch:
+                    return None
+                r = engine.slots[i]
+                if r is None or r.done.is_set():
+                    return None
+                before = engine.kv_pages_exported
+                data = engine.migrate_out_bundle(i)
+                return (i, r, data, engine.kv_pages_exported - before)
+
+            try:
+                got = engine.run_task(grab)
+            except TimeoutError as e:
+                # nothing was detached (the thunk is abandoned): the
+                # session never left this replica
+                return self._json(503, {"error": str(e)})
+            if got is None:
+                return self._json(409, {
+                    "error": "no live session to migrate"
+                })
+            i, req, data, n_pages = got
+            resp, conn, err = _backend_stream(dest, "/v1/migrate/in", data)
+            if resp is None or resp.status != 200:
+                if resp is not None:
+                    err = f"destination answered {resp.status}"
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                # the session is OURS again: exact local resume (the
+                # spill-requeue path — the client never notices), and
+                # the migrate-out stats roll back so fleet-wide
+                # sum(migrated_out) keeps matching sum(migrated_in)
+                # (the OPERATIONS cross-check) with refused hops
+                def resume_local():
+                    engine._enqueue(req)
+                    engine.sessions_migrated_out -= 1
+                    engine.kv_pages_exported -= n_pages
+
+                try:
+                    # non-abandonable: a timeout here must NOT drop the
+                    # re-enqueue — the thunk still runs when the engine
+                    # catches up, so the session is never lost
+                    engine.run_task(resume_local, abandon_on_timeout=False)
+                except TimeoutError:
+                    log.warning(
+                        "local resume of refused migration is queued "
+                        "behind a busy engine; it will run at the next "
+                        "admission pass"
+                    )
+                KV_MIGRATIONS.inc("out_refused")
+                return self._json(502, {
+                    "ok": False, "resumed_local": True, "error": err,
+                })
+            threading.Thread(
+                target=_relay_migrated, args=(req, resp, conn),
+                name="migrate-relay", daemon=True,
+            ).start()
+            KV_MIGRATIONS.inc("out")
+            return self._json(200, {
+                "ok": True, "slot": i, "dest": dest,
+                "pages_shipped": n_pages,
+                "tokens_done": len(req.output),
+            })
+
+        def _migrate_in(self):
+            """Live migration, destination side: import the bundle's
+            pages, resume the session (prefix-matching what just
+            landed), and stream the continuation back to the source as
+            SSE events — the source relays them into the original
+            client connection."""
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+            try:
+                hdr, pages = kvwire.decode_bundle(raw)
+            except kvwire.WireError as e:
+                return self._json(400, {"error": str(e)})
+            if hdr.get("kind") != "session":
+                return self._json(400, {
+                    "error": f"expected a session bundle, "
+                             f"got {hdr.get('kind')!r}"
+                })
+            state = hdr.get("request") or {}
+            q: "queue.Queue" = queue.Queue()
+            box: dict = {}
+
+            def on_token(tok):
+                r = box["req"]
+                if r.logprobs > 0:
+                    q.put((tok, r.token_logprobs[-1], r.top_logprobs[-1]))
+                else:
+                    q.put((tok, None, None))
+
+            def setup():
+                imported = None
+                if pages and engine.prefix_cache:
+                    imported = engine.import_pages(hdr, pages)
+                r = engine.resume_session(state, on_token=on_token)
+                box["req"] = r
+                return r, imported
+
+            try:
+                req, _imported = engine.run_task(setup)
+            except TimeoutError as e:
+                # the thunk is abandoned (engine busy): nothing landed,
+                # the source keeps the session — a clean refusal, never
+                # a session running on both replicas
+                return self._json(503, {"error": str(e)})
+            except RuntimeError as e:
+                return self._json(503, {"error": str(e)})
+            except (ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            KV_MIGRATIONS.inc("in")
+            self.send_response(200, "OK")
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk_many(payloads: list) -> None:
+                payload = b"".join(
+                    f"data: {p}\n\n".encode() for p in payloads
+                )
+                self.wfile.write(
+                    f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
+                )
+                self.wfile.flush()
+
+            def event_json(item) -> str:
+                tok, lp, top = item
+                ev = {"token": tok}
+                if lp is not None:
+                    ev["logprob"] = lp
+                    ev["top_logprobs"] = [
+                        {"id": t, "logprob": l} for t, l in top
+                    ]
+                return json.dumps(ev)
+
+            deadline = time.monotonic() + request_timeout
+            try:
+                while time.monotonic() < deadline:
+                    try:
+                        first = q.get(timeout=0.1)
+                    except queue.Empty:
+                        if req.done.is_set() and q.empty():
+                            break
+                        continue
+                    chunk_many([
+                        event_json(e) for e in _drain_burst(q, first)
+                    ])
+                if not req.done.is_set():
+                    req.cancel()
+                    chunk_many([json.dumps(
+                        {"error": "migrated session timed out"}
+                    )])
+                elif req.error:
+                    chunk_many([json.dumps({"error": req.error})])
+                chunk_many(["[DONE]"])
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # the source (or its client) went away: stop generating
+                req.cancel()
 
         def _single(self, req, sp):
             t0 = time.monotonic()

@@ -1,0 +1,151 @@
+"""KV-page wire format: replica-to-replica shipping of paged K/V cache
+content (the disaggregated serving data plane's byte-level contract).
+
+One bundle carries an ordered run of FULL pages — each page is the raw
+token ids it covers plus the engine's serialized K/V payload for those
+positions — framed with the journal's durability conventions:
+length-prefixed records, a CRC32 per record, and a 16-byte BLAKE2b
+digest-chain link per page (utils/prefixdigest — the SAME chain the
+engine's prefix cache and the fleet router key by).  Three consumers:
+
+- ``/v1/kv/export`` / ``/v1/kv/adopt`` — a replica pulls another
+  replica's cached prefix pages instead of re-prefilling (the fleet
+  prefix-cache index's "move the KV, not the request" path);
+- ``/v1/migrate/out`` → ``/v1/migrate/in`` — live session migration: a
+  ``kind="session"`` bundle adds the full request state (prompt, output
+  so far, sampling params, seed) so the destination resumes
+  token-identically;
+- the prefill/decode split — a prefill-role replica exports the pages
+  its chunked prefill produced and a decode-role replica imports them
+  before running the token loop.
+
+This module is deliberately jax/numpy-free (the router — scheduler
+plane, smoke tier — must parse headers without the model stack);
+payload bytes are opaque here.  The engine owns producing/consuming
+them (models/serving.py ``export_prefix_pages``/``import_pages``) and
+guards geometry compatibility via the header fields.
+
+Integrity model: the receiver re-derives the digest chain from the
+SHIPPED token bytes and the header's seed — a flipped token byte, a
+reordered page or a truncated run fails loudly before any K/V lands in
+a pool.  Payload corruption is caught by the per-page CRC32.  (Same
+trust stance as the journal reader: bytes are only believed after the
+frame checks pass.)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+from . import prefixdigest
+
+__all__ = [
+    "KV_SOURCE_HEADER", "MAGIC", "WireError",
+    "decode_bundle", "encode_bundle",
+]
+
+MAGIC = b"TPUKV1\n"
+# router → backend HTTP header naming the replica to pull this prompt's
+# prefix pages from before admission (the adoption path); defined here
+# so the jax-free router and the serving HTTP layer share one spelling
+KV_SOURCE_HEADER = "X-KV-Source"
+_U32 = struct.Struct("<I")
+
+
+class WireError(ValueError):
+    """A malformed / corrupt / truncated KV bundle.  Always safe to
+    surface as a 400 — nothing was imported when this raises."""
+
+
+def _u32(data: bytes, off: int) -> tuple[int, int]:
+    if off + 4 > len(data):
+        raise WireError("truncated bundle (length field)")
+    return _U32.unpack_from(data, off)[0], off + 4
+
+
+def encode_bundle(
+    header: dict, pages: list[tuple[list, bytes]], seed: bytes
+) -> bytes:
+    """Frame ``pages`` ([(token_ids, payload_bytes), ...], chain order)
+    under ``header`` (JSON-serializable geometry + request metadata).
+    ``seed`` roots the digest chain; it ships in the header (hex) so the
+    receiver verifies the SAME chain — registration keys are re-derived
+    receiver-side with the receiver's own adapter seed, so the wire seed
+    only needs equality semantics, like the router's."""
+    hdr = dict(header)
+    hdr["v"] = 1
+    hdr["pages"] = len(pages)
+    hdr["seed"] = seed.hex()
+    hjson = json.dumps(hdr, sort_keys=True).encode()
+    out = [MAGIC, _U32.pack(len(hjson)), hjson,
+           _U32.pack(zlib.crc32(hjson))]
+    key = seed
+    for toks, payload in pages:
+        tb = prefixdigest.token_bytes(toks)
+        key = prefixdigest.prefix_page_key(key, tb)
+        out.append(_U32.pack(len(tb)))
+        out.append(tb)
+        out.append(key)  # 16-byte chain link
+        out.append(_U32.pack(len(payload)))
+        out.append(payload)
+        out.append(_U32.pack(zlib.crc32(tb + key + payload)))
+    return b"".join(out)
+
+
+def decode_bundle(data: bytes) -> tuple[dict, list[tuple[list, bytes]]]:
+    """→ (header, [(token_ids, payload_bytes), ...]) after verifying the
+    magic, every CRC, and the digest chain.  Raises WireError on ANY
+    integrity failure — partial results are never returned."""
+    if not data.startswith(MAGIC):
+        raise WireError("bad magic (not a KV bundle)")
+    off = len(MAGIC)
+    hlen, off = _u32(data, off)
+    if off + hlen + 4 > len(data):
+        raise WireError("truncated bundle (header)")
+    hjson = data[off:off + hlen]
+    off += hlen
+    hcrc, off = _u32(data, off)
+    if zlib.crc32(hjson) != hcrc:
+        raise WireError("header CRC mismatch")
+    try:
+        header = json.loads(hjson)
+    except ValueError as e:
+        raise WireError(f"header not JSON: {e}") from None
+    if header.get("v") != 1:
+        raise WireError(f"unsupported bundle version {header.get('v')!r}")
+    try:
+        key = bytes.fromhex(header.get("seed", ""))
+    except ValueError:
+        raise WireError("malformed chain seed") from None
+    n_pages = int(header.get("pages", 0))
+    pages: list[tuple[list, bytes]] = []
+    for j in range(n_pages):
+        tlen, off = _u32(data, off)
+        if off + tlen + 16 > len(data):
+            raise WireError(f"truncated bundle (page {j} tokens)")
+        tb = data[off:off + tlen]
+        off += tlen
+        link = data[off:off + 16]
+        off += 16
+        plen, off = _u32(data, off)
+        if off + plen + 4 > len(data):
+            raise WireError(f"truncated bundle (page {j} payload)")
+        payload = data[off:off + plen]
+        off += plen
+        crc, off = _u32(data, off)
+        if zlib.crc32(tb + link + payload) != crc:
+            raise WireError(f"page {j} CRC mismatch")
+        key = prefixdigest.prefix_page_key(key, tb)
+        if key != link:
+            raise WireError(
+                f"page {j} digest-chain break (corrupt or reordered)"
+            )
+        if tlen % 4:
+            raise WireError(f"page {j} token bytes not int32-aligned")
+        toks = list(struct.unpack(f"<{tlen // 4}i", tb))
+        pages.append((toks, payload))
+    if off != len(data):
+        raise WireError(f"{len(data) - off} trailing bytes after last page")
+    return header, pages

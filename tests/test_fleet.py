@@ -55,7 +55,7 @@ class FakeReplicaServer:
 
     def __init__(self, name, queued=0, active_slots=0, max_batch=8,
                  draining=False, warming=False, fail_completions=False,
-                 slow_stream=0.0):
+                 slow_stream=0.0, role="both"):
         self.name = name
         self.queued = queued
         self.active_slots = active_slots
@@ -64,6 +64,7 @@ class FakeReplicaServer:
         self.warming = warming
         self.fail_completions = fail_completions
         self.slow_stream = slow_stream  # s between SSE chunks
+        self.role = role  # disagg prefill/decode split
         self.requests: list[dict] = []
         self.stats_polls = 0
         outer = self
@@ -106,6 +107,7 @@ class FakeReplicaServer:
                         "free_pages": 10, "total_pages": 16,
                         "page_size": 4,
                         "replica": outer.name,
+                        "role": outer.role,
                     })
                 return self._json(404, {"error": "no route"})
 
@@ -113,7 +115,21 @@ class FakeReplicaServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 body["_traceparent"] = self.headers.get("traceparent", "")
+                body["_kv_source"] = self.headers.get("X-KV-Source", "")
+                body["_path"] = self.path
                 outer.requests.append(body)
+                if self.path == "/v1/prefill":
+                    # prefill-role half of the disagg split: the real
+                    # server runs chunked prefill + caches the pages;
+                    # the fake just acknowledges
+                    return self._json(200, {
+                        "ok": True,
+                        "tokens": len(body.get("prompt") or []),
+                        "pages": max(
+                            0, (len(body.get("prompt") or []) - 1) // 4
+                        ),
+                        "replica": outer.name,
+                    })
                 if outer.fail_completions:
                     return self._json(500, {"error": "boom"})
                 toks = body.get("prompt", [])[:4]
@@ -910,3 +926,224 @@ def test_router_drain_hook_brackets_moves():
     assert rs.get("default/pod-a").state == "draining"
     hook.resume("default/pod-a", "node-0")
     assert rs.get("default/pod-a").state == "up"
+
+
+# -- disaggregated data plane: fleet prefix index + adoption routing --------
+
+
+def test_prefix_index_multi_holder_lookup_and_prune():
+    from elastic_gpu_scheduler_tpu.fleet.router import PrefixIndex
+
+    idx = PrefixIndex(cap=2048)
+    d = [bytes([i]) * 16 for i in range(4)]
+    idx.record(d[:2], "rep-0")  # rep-0 holds 2 pages
+    idx.record(d, "rep-1")  # rep-1 holds all 4
+    got = idx.lookup(d)
+    assert got == {"rep-0": 2, "rep-1": 4}
+    # longest-match-per-replica, not first-hit-wins
+    assert idx.lookup(d[:1]) == {"rep-0": 1, "rep-1": 1}
+    # pruning one holder leaves the other's entries intact
+    n = idx.drop_replica("rep-1")
+    assert n == 4
+    assert idx.lookup(d) == {"rep-0": 2}
+    assert len(idx) == 2  # digests held only by rep-1 are gone
+    assert idx.drop_replica("rep-0") == 2
+    assert len(idx) == 0
+
+
+def test_router_prunes_stale_affinity_for_leaving_replicas():
+    """The satellite bugfix: a replica leaving rotation (removed /
+    pinned-draining / breaker-down) must take its prefix-index entries
+    with it — a stale digest must not steer prompts at a dead backend
+    ahead of the health fallback."""
+    servers, rs, router = make_fleet(3)
+    try:
+        port = router.start()
+        prompt = [7, 3, 9, 1, 4, 4, 2, 8]
+        st, _ = post_completion(port, {"prompt": prompt})
+        assert st == 200
+        holder = next(s for s in servers if s.requests)
+        assert len(router.prefix_index) == 2
+        # removal prunes immediately
+        rs.remove(holder.name)
+        assert router.pruned_digests == 2
+        assert len(router.prefix_index) == 0
+        # the repeat routes least-loaded, never at the ghost
+        st, _ = post_completion(port, {"prompt": prompt + [9, 9]})
+        assert st == 200
+        assert len(holder.requests) == 1  # nothing new reached it
+        # pinned drain (scale-down victim) prunes too
+        holder2 = next(
+            s for s in servers
+            if s.name != holder.name and len(s.requests) == 1
+        )
+        before = router.pruned_digests
+        rs.drain(holder2.name, reason="scale-down")
+        assert router.pruned_digests > before
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prunes_on_breaker_down_transition():
+    servers, rs, router = make_fleet(2)
+    holder = None
+    try:
+        port = router.start()
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        st, _ = post_completion(port, {"prompt": prompt})
+        assert st == 200
+        holder = next(s for s in servers if s.requests)
+        # kill the holder's backend: health passes open the breaker
+        holder.stop()
+        rs.refresh()  # failure 1
+        rs.refresh()  # failure 2 -> breaker opens -> down + prune
+        assert rs.get(holder.name).state == "down"
+        assert router.pruned_digests == 2
+        assert len(router.prefix_index) == 0
+    finally:
+        router.stop()
+        for s in servers:
+            if s is not holder:
+                s.stop()
+
+
+def test_router_adopts_from_unroutable_holder():
+    """Holder drained (but still export-capable) → the route goes to a
+    live candidate carrying an X-KV-Source header naming the holder, so
+    the backend pulls the pages instead of re-prefilling."""
+    servers, rs, router = make_fleet(2)
+    try:
+        port = router.start()
+        prompt = [7, 3, 9, 1, 4, 4, 2, 8]
+        st, _ = post_completion(port, {"prompt": prompt})
+        assert st == 200
+        holder = next(s for s in servers if s.requests)
+        other = next(s for s in servers if s is not holder)
+        # drain WITHOUT the leave listener pruning masking the test:
+        # health-loop drain (not pinned) keeps index entries — the
+        # holder is expected back, but it takes no sessions meanwhile
+        rs.get(holder.name).state = "draining"
+        st, _ = post_completion(port, {"prompt": prompt + [5, 5]})
+        assert st == 200
+        assert len(other.requests) == 1
+        got = other.requests[0]
+        assert got["_kv_source"] == f"127.0.0.1:{holder.port}"
+        dbg = router.debug_state()
+        assert dbg["disagg"]["adoptions"] == 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_load_margin_shedding_adopts_away_from_hot_holder():
+    servers, rs, router = make_fleet(2)
+    router.adopt_load_margin = 3.0
+    try:
+        port = router.start()
+        prompt = [7, 3, 9, 1, 4, 4, 2, 8]
+        st, _ = post_completion(port, {"prompt": prompt})
+        assert st == 200
+        holder = next(s for s in servers if s.requests)
+        other = next(s for s in servers if s is not holder)
+        holder.queued, other.queued = 8, 0
+        rs.refresh()
+        st, _ = post_completion(port, {"prompt": prompt + [1]})
+        assert st == 200
+        assert len(other.requests) == 1
+        assert other.requests[0]["_kv_source"] == (
+            f"127.0.0.1:{holder.port}"
+        )
+        # margin respected: with balanced load, affinity wins again
+        holder.queued = 0
+        rs.refresh()
+        st, _ = post_completion(port, {"prompt": prompt + [1, 2]})
+        assert st == 200
+        assert len(holder.requests) == 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prefill_split_routes_long_noise_through_prefill_role():
+    pre = FakeReplicaServer("pre-0", role="prefill")
+    dec = FakeReplicaServer("dec-0", role="decode")
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    rs.add(pre.replica())
+    rs.add(dec.replica())
+    rs.refresh()
+    router = FleetRouter(
+        rs, host="127.0.0.1", port=0, page_size=4, disagg_min_pages=3
+    )
+    try:
+        port = router.start()
+        long_prompt = list(range(1, 15))  # 3 full pages at ps=4
+        st, _ = post_completion(port, {"prompt": long_prompt})
+        assert st == 200
+        # the prefill replica saw /v1/prefill, the decode replica the
+        # completion WITH the adoption header naming the prefill pod
+        assert [r["_path"] for r in pre.requests] == ["/v1/prefill"]
+        assert [r["_path"] for r in dec.requests] == ["/v1/completions"]
+        assert dec.requests[0]["_kv_source"] == f"127.0.0.1:{pre.port}"
+        assert router.disagg_prefills == 1
+        # prefill-role replicas NEVER take completions, even as failover
+        assert router._completion_candidates() == [rs.get("dec-0")]
+        # short prompts skip the split
+        st, _ = post_completion(port, {"prompt": [1, 2, 3]})
+        assert st == 200
+        assert len(pre.requests) == 1
+    finally:
+        router.stop()
+        pre.stop()
+        dec.stop()
+
+
+def test_autoscaler_shed_rebalances_on_hold(tmp_path):
+    """A hot/idle queue split past the margin sheds ONE session per
+    hold tick through the migrator, journaled as `kv_migrate`."""
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    a = rs.add(Replica("a", "127.0.0.1", 1))
+    b = rs.add(Replica("b", "127.0.0.1", 2))
+    a.stats = {"queued": 9, "active_slots": 2, "max_batch": 4}
+    b.stats = {"queued": 0, "active_slots": 0, "max_batch": 4}
+    calls = []
+    JOURNAL.configure(str(tmp_path / "j"), fsync="off")
+    try:
+        auto = Autoscaler(
+            rs, executor=None,
+            migrator=lambda s, d: (calls.append((s, d))
+                                   or {"ok": True, "slot": 0}),
+            shed_queue_margin=2.0,
+        )
+        rec = auto.tick()
+        assert rec["action"] == "hold"
+        assert rec["shed"] == {"src": "a", "dst": "b", "ok": True,
+                               "error": None}
+        assert calls == [("a", "b")]
+        assert auto.sheds == 1
+        # balanced queues: no shed
+        a.stats = {"queued": 1, "active_slots": 1, "max_batch": 4}
+        rec = auto.tick()
+        assert "shed" not in rec
+        # scale-down rebalance: migrate the victim's sessions off until
+        # the 409 'nothing live' verdict
+        seq = iter([
+            {"ok": True, "slot": 0}, {"ok": True, "slot": 1},
+            {"ok": False, "status": 409, "error": "no live session"},
+        ])
+        calls.clear()
+        auto.migrator = lambda s, d: next(seq)
+        moved = auto._migrate_off("a")
+        assert moved == 2
+        assert JOURNAL.flush()
+    finally:
+        JOURNAL.close()
+    events = read_journal(str(tmp_path / "j"))
+    kinds = [e.get("type") for e in events]
+    assert kinds.count("kv_migrate") == 3  # 1 shed + 2 scale-down hops
+    res = replay(events)
+    assert res.kv_migrations == 3 and not res.violations
+    assert res.last_kv_migration["reason"] == "scale_down"

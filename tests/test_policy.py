@@ -323,9 +323,12 @@ def test_load_rejects_unknown_verb_and_bad_expr():
 
 def _slots():
     return [
-        {"slot": 0.0, "priority": 5.0, "pages": 10.0, "tokens": 3.0},
-        {"slot": 1.0, "priority": 1.0, "pages": 2.0, "tokens": 40.0},
-        {"slot": 2.0, "priority": 1.0, "pages": 7.0, "tokens": 9.0},
+        {"slot": 0.0, "priority": 5.0, "pages": 10.0, "tokens": 3.0,
+         "matched": 64.0},
+        {"slot": 1.0, "priority": 1.0, "pages": 2.0, "tokens": 40.0,
+         "matched": 0.0},
+        {"slot": 2.0, "priority": 1.0, "pages": 7.0, "tokens": 9.0,
+         "matched": 16.0},
     ]
 
 
@@ -342,6 +345,16 @@ def test_kv_victim_policy_changes_evicted_slot():
     assert plane.select_kv_victim(_slots()) == 1
     plane.reset()
     assert plane.select_kv_victim(_slots()) == 2
+
+
+def test_kv_victim_policy_reads_matched_prefix_input():
+    """The disagg plane's `matched` input: a policy can prefer evicting
+    or migrating the slot whose context is mostly cached/adopted prefix
+    (cheapest to rebuild elsewhere) → slot 0 here."""
+    plane = PolicyPlane()
+    plane.load("cheapest-move", "kv", "matched - tokens", skip_gate=True)
+    assert plane.select_kv_victim(_slots()) == 0
+    plane.reset()
 
 
 def test_kv_victim_fault_falls_back_to_builtin():

@@ -9,6 +9,7 @@ import json
 
 import jax
 import pytest
+from conftest import poll  # shared polling helper
 
 from elastic_gpu_scheduler_tpu.models.serving import InferenceEngine, Request
 from elastic_gpu_scheduler_tpu.models.transformer import (
@@ -101,6 +102,9 @@ def test_engine_loop_emits_profile_samples(profiler):
         body = json.loads(resp.read())
         conn.close()
         assert resp.status == 200 and len(body["tokens"]) == 24
+        # the final chunk's record_step lands on the engine thread AFTER
+        # done wakes this client, so poll instead of racing the loop
+        poll(lambda: profiler.profiles()["serve"]["tokens"] >= 23)
         prof = profiler.profiles()["serve"]
         assert prof["samples"] > 0
         # the first token can emit on the admission/prefill path outside

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""CI gate for the disaggregated serving data plane (`make check-disagg`).
+
+A multi-replica CPU soak over REAL engines (tiny model, real inference
+HTTP servers, the real fleet router), all HARD-FAIL:
+
+1. **Migration under churn, zero parity breaks** — a seeded burst of
+   concurrent greedy streams through the router while live sessions are
+   repeatedly migrated between replicas (`/v1/migrate/out` → bundle →
+   `/v1/migrate/in` → relayed continuation): EVERY stream must complete
+   cleanly ([DONE]) and token-identical to an undisturbed reference
+   run, and at least CHECK_DISAGG_MIN_MIGRATIONS sessions must actually
+   have hopped (a soak where nothing migrated gates nothing).
+2. **Cold-replica adoption beats re-prefill** — a repeated long prefix
+   served to a cold engine via imported KV pages (the wire bundle) must
+   reach its first tokens at least DISAGG_ADOPT_FLOOR× faster than
+   re-prefilling from scratch, import cost included, with identical
+   tokens (best of 3 independent trials; bench.py's disagg section
+   records the headline magnitude, this guards the direction).
+3. **Prefix-index hygiene** — routed prefixes land in the fleet index;
+   draining a holder (scale-down pin) prunes its entries, so stale
+   digests cannot steer prompts at a leaving backend.
+4. **Clean journal replay** — every commanded migration is journaled as
+   a `kv_migrate` annotation; replay reports ZERO violations and zero
+   warnings, and reconstructs exactly the commanded count.
+
+Usage:
+    python tools/check_disagg.py
+
+Environment:
+    CHECK_DISAGG_SEED             soak RNG seed (default 20260804)
+    CHECK_DISAGG_MIN_MIGRATIONS   executed-hop floor (default 3)
+    DISAGG_ADOPT_FLOOR            adoption speedup floor (default 1.2)
+
+Wired into the Makefile as `make check-disagg`, next to `check-ha`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from bench import _make_cpu_replica  # noqa: E402
+from elastic_gpu_scheduler_tpu.fleet import (  # noqa: E402
+    Autoscaler,
+    FleetRouter,
+    ReplicaSet,
+)
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import replay  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import kvwire  # noqa: E402
+
+
+class _NoRelay:
+    up = None
+    detail = ""
+
+
+def stream_request(port, prompt, max_tokens, results, idx):
+    """One streaming completion through the router; records
+    (tokens list, done_clean, error)."""
+    import socket as _socket
+
+    raw = json.dumps(
+        {"prompt": prompt, "max_tokens": max_tokens, "stream": True}
+    ).encode()
+    toks: list[int] = []
+    try:
+        with _socket.create_connection(
+            ("127.0.0.1", port), timeout=300
+        ) as s:
+            s.sendall((
+                f"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(raw)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode() + raw)
+            buf = b""
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                buf += b
+        for line in buf.split(b"\n"):
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[6:]
+            if payload == b"[DONE]":
+                continue
+            try:
+                ev = json.loads(payload)
+            except ValueError:
+                continue
+            if "token" in ev:
+                toks.append(ev["token"])
+        results[idx] = (toks, b"data: [DONE]" in buf, "")
+    except OSError as e:
+        results[idx] = (toks, False, str(e))
+
+
+def main() -> int:
+    seed = int(os.environ.get("CHECK_DISAGG_SEED", "20260804"))
+    min_migrations = int(
+        os.environ.get("CHECK_DISAGG_MIN_MIGRATIONS", "3")
+    )
+    try:
+        adopt_floor = float(os.environ.get("DISAGG_ADOPT_FLOOR", "1.2"))
+    except ValueError:
+        adopt_floor = 1.2
+    rng = random.Random(seed)
+    tmp = tempfile.mkdtemp(prefix="tpu-disagg-check-")
+    journal_dir = os.path.join(tmp, "journal")
+    failures: list[str] = []
+    result: dict = {"metric": "check_disagg", "seed": seed}
+
+    import jax
+
+    from elastic_gpu_scheduler_tpu.models.serving import (
+        InferenceEngine,
+        Request,
+    )
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+    JOURNAL.configure(journal_dir, fsync="off")
+
+    reps = [
+        _make_cpu_replica(
+            f"disagg-rep-{i}", params, cfg,
+            max_batch=4, max_len=256, page_size=16, fused_steps=4,
+            prefix_cache=True,
+        )
+        for i in range(3)
+    ]
+    rs = ReplicaSet(interval_s=0.2, relay_monitor=_NoRelay())
+    for r in reps:
+        rs.add(r["replica"])
+    rs.refresh()
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=16)
+    rport = router.start()
+    # the journaling shape the production rebalance path uses
+    auto = Autoscaler(
+        rs, executor=None, migrator=router.migrate_session,
+        shed_queue_margin=1.0,
+    )
+
+    commanded = 0
+    try:
+        # ---- 1. migration-under-churn parity soak ----------------------
+        prompts = []
+        for i in range(14):
+            n = rng.randrange(4, 40)
+            prompts.append(
+                [rng.randrange(0, 64) for _ in range(n)]
+            )
+        max_toks = [rng.randrange(32, 64) for _ in prompts]
+        # references: undisturbed greedy runs on a private engine
+        ref_eng = InferenceEngine(
+            params, cfg, max_batch=4, max_len=256, page_size=16,
+            fused_steps=4, prefix_cache=True,
+        )
+        refs = []
+        for p, mt in zip(prompts, max_toks):
+            req = ref_eng.submit(Request(prompt=list(p), max_new_tokens=mt))
+            ref_eng.run_until_idle(max_steps=200_000)
+            assert not req.error, req.error
+            refs.append(list(req.output))
+
+        results: dict = {}
+        threads = [
+            threading.Thread(
+                target=stream_request,
+                args=(rport, p, mt, results, i),
+                daemon=True,
+            )
+            for i, (p, mt) in enumerate(zip(prompts, max_toks))
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        names = [r["name"] for r in reps]
+        migrate_ok = 0
+        deadline = time.monotonic() + 120
+        while (
+            any(t.is_alive() for t in threads)
+            and time.monotonic() < deadline
+        ):
+            src, dst = rng.sample(names, 2)
+            res = router.migrate_session(src, dst)
+            commanded += 1
+            auto._journal_migrate(src, dst, "churn", res)
+            if res.get("ok"):
+                migrate_ok += 1
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=60)
+        result["streams"] = len(threads)
+        result["migrations_commanded"] = commanded
+        result["migrations_ok"] = migrate_ok
+        parity_breaks = dropped = 0
+        for i, ref in enumerate(refs):
+            toks, clean, err = results.get(i, ([], False, "no result"))
+            if not clean or err:
+                dropped += 1
+            elif toks != ref:
+                parity_breaks += 1
+        result["parity_breaks"] = parity_breaks
+        result["dropped_streams"] = dropped
+        if parity_breaks:
+            failures.append(
+                f"{parity_breaks} stream(s) diverged from the "
+                "undisturbed reference under migration churn"
+            )
+        if dropped:
+            failures.append(
+                f"{dropped} stream(s) dropped (no clean [DONE])"
+            )
+        if migrate_ok < min_migrations:
+            failures.append(
+                f"only {migrate_ok} migrations executed "
+                f"(< {min_migrations}); the soak gated nothing"
+            )
+        moved_in = sum(r["engine"].sessions_migrated_in for r in reps)
+        result["sessions_migrated_in"] = moved_in
+        if moved_in != migrate_ok:
+            failures.append(
+                f"engines report {moved_in} sessions migrated in, "
+                f"router reports {migrate_ok} ok handoffs"
+            )
+
+        # ---- 2. cold-replica adoption beats re-prefill ------------------
+        # a HEAVIER model than the soak's: adoption pays when prefill
+        # COMPUTE dominates page-shipping BYTES, which needs a real
+        # d_model even on CPU (compute scales d², bytes d) — the same
+        # configuration bench.py's disagg section records
+        acfg = TransformerConfig(
+            vocab_size=256, d_model=256, n_layers=4, n_heads=8,
+            d_ff=512, dtype="float32",
+        )
+        aparams = init_params(jax.random.key(1), acfg)
+        long_prompt = [rng.randrange(0, 256) for _ in range(449)]
+        warm_other = [rng.randrange(0, 256) for _ in range(449)]
+
+        def mk():
+            return InferenceEngine(
+                aparams, acfg, max_batch=2, max_len=512, page_size=16,
+                fused_steps=8, prefix_cache=True,
+            )
+
+        donor = mk()
+        req = donor.submit(
+            Request(prompt=list(long_prompt), max_new_tokens=2)
+        )
+        donor.run_until_idle(max_steps=200_000)
+        data = donor.export_prefix_pages(long_prompt, "")
+        hdr, pages = kvwire.decode_bundle(data)
+        result["adopt_pages"] = len(pages)
+
+        def run_once(eng, p):
+            r = eng.submit(Request(prompt=list(p), max_new_tokens=2))
+            t0 = time.perf_counter()
+            eng.run_until_idle(max_steps=200_000)
+            assert not r.error, r.error
+            return time.perf_counter() - t0, list(r.output)
+
+        best = 0.0
+        ref_toks = None
+        for _ in range(3):
+            cold = mk()
+            run_once(cold, warm_other)  # compile warm
+            w_re, t_re = run_once(cold, long_prompt)
+            adopted = mk()
+            run_once(adopted, warm_other)
+            t0 = time.perf_counter()
+            adopted.import_pages(hdr, pages)
+            imp = time.perf_counter() - t0
+            w_ad, t_ad = run_once(adopted, long_prompt)
+            if t_ad != t_re:
+                failures.append("adopted tokens diverged from re-prefill")
+                break
+            ref_toks = t_re
+            best = max(best, w_re / (w_ad + imp))
+        del ref_toks
+        result["adopt_speedup_best"] = round(best, 2)
+        if best < adopt_floor:
+            failures.append(
+                f"cold-replica adoption speedup {best:.2f}x below the "
+                f"{adopt_floor}x floor — shipping pages lost to "
+                "re-prefilling"
+            )
+
+        # ---- 3. prefix-index hygiene ------------------------------------
+        idx_before = len(router.prefix_index)
+        holder = max(
+            reps, key=lambda r: r["engine"].prefix_lookups
+        )["name"]
+        rs.drain(holder, reason="scale-down")
+        pruned = router.pruned_digests
+        rs.undrain(holder)
+        result["index_entries"] = idx_before
+        result["pruned_digests"] = pruned
+        if idx_before == 0:
+            failures.append(
+                "routed prefixes never landed in the fleet index"
+            )
+        if pruned == 0:
+            failures.append(
+                "draining a holder pruned zero index entries — stale "
+                "digests would outlive the backend"
+            )
+    finally:
+        router.stop()
+        for r in reps:
+            r["server"].shutdown()
+            r["loop"].stop()
+        JOURNAL.flush()
+        JOURNAL.close()
+
+    # ---- 4. journal replay ----------------------------------------------
+    events = read_journal(journal_dir)
+    res = replay(events)
+    result["journal_kv_migrations"] = res.kv_migrations
+    if res.violations:
+        failures.append(f"replay violations: {res.violations[:5]}")
+    if res.warnings:
+        failures.append(f"replay warnings: {res.warnings[:5]}")
+    if res.kv_migrations != commanded:
+        failures.append(
+            f"replay reconstructed {res.kv_migrations} kv_migrate "
+            f"records, {commanded} were commanded"
+        )
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
