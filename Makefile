@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-slo check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -140,6 +140,17 @@ check-ha:
 # `kv_migrate` record.
 check-disagg:
 	JAX_PLATFORMS=cpu python tools/check_disagg.py
+
+# Fleet SLO-plane gate: a seeded soak where a deterministic `delay`
+# fault at a real serve.py subprocess's serve.request site must trip
+# the multi-window burn-rate alert, journal the breach with an exemplar
+# trace id that resolves via the cross-process assembler into spans
+# from >=2 processes in causal order, surface the burn posture in a
+# journaled autoscaler evaluation that decides `up` on an idle queue,
+# and replay clean; router hop p99 with the SLO plane on must stay
+# within SLO_OVERHEAD_BUDGET_PCT of off (x3 storm-trimmed attempts).
+check-slo:
+	JAX_PLATFORMS=cpu python tools/check_slo.py
 
 # Native-kernel sanitizer gate: rebuild placement.cc with
 # ASan+UBSan (-fno-sanitize-recover) and run a seeded differential

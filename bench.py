@@ -2511,6 +2511,179 @@ def disagg_bench_cpu(timeout: int = 900) -> dict:
         return {"disagg_bench_error": f"unparseable output: {e}"}
 
 
+def _tpu_section_slo():
+    """Fleet SLO plane (slo/): the cost of observing.  Three keys:
+
+    - ``slo_record_overhead_pct`` — router hop p99 with journey
+      recording ON vs OFF through a real CPU replica (interleaved
+      chunks, storm-trimmed p99s — the journal-bench estimator); the
+      budgeted number check-slo gates.
+    - ``slo_assembly_ms`` — wall to assemble one request's trace
+      cross-process (local ring + one HTTP /traces pull from the
+      replica) in causal order.
+    - ``slo_breach_detect_ms`` — wall for one evaluate() pass (fold +
+      multi-window burn over every objective + breach transition) over
+      a 4k-journey window: the alerting tick's cost at steady state.
+    """
+    import time as _time
+
+    jax, allow_cpu = _section_env()
+
+    from elastic_gpu_scheduler_tpu.fleet import FleetRouter, ReplicaSet
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from elastic_gpu_scheduler_tpu.slo import SLO
+    from elastic_gpu_scheduler_tpu.slo.assembly import TraceAssembler
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+    out: dict = {}
+
+    SLO.reset()
+    SLO.load_config({
+        "classes": {"default": {"ttft_p95_ms": 500, "e2e_p99_ms": 5000,
+                                "availability": 0.99}},
+    }, journal=False)
+
+    class _NoRelay:
+        up = None
+        detail = ""
+
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=_NoRelay())
+    rep = _make_cpu_replica("slo-bench-rep", params, cfg,
+                            max_batch=4, max_len=128, page_size=8,
+                            fused_steps=4)
+    rs.add(rep["replica"])
+    rs.refresh()
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=8)
+    router_port = router.start()
+    try:
+        # warm the compile + connection path off the measured window
+        for _ in range(4):
+            _fleet_post(router_port, {"prompt": [3, 9], "max_tokens": 1})
+
+        def probe_chunk(n=25):
+            samples = []
+            for i in range(n):
+                mark = len(router.overhead_samples)
+                st, _ = _fleet_post(router_port, {
+                    "prompt": [(7 * i) % 64, 3], "max_tokens": 1,
+                })
+                assert st == 200, st
+                samples.extend(router.overhead_samples[mark:])
+            return samples
+
+        on_samples, off_samples = [], []
+        for chunk in range(6):  # interleaved: both modes see the same
+            if chunk % 2 == 0:  # box weather (the journal-bench rule)
+                SLO.enabled = True
+                on_samples.extend(probe_chunk())
+            else:
+                SLO.enabled = False
+                off_samples.extend(probe_chunk())
+        SLO.enabled = True
+
+        def trimmed_p99_ms(xs):
+            xs = sorted(xs)[: max(1, int(len(xs) * 0.9))]
+            return p99(xs) * 1000 if xs else 0.0
+
+        on_ms = trimmed_p99_ms(on_samples)
+        off_ms = trimmed_p99_ms(off_samples)
+        out["slo_hop_p99_on_ms"] = round(on_ms, 3)
+        out["slo_hop_p99_off_ms"] = round(off_ms, 3)
+        out["slo_record_overhead_pct"] = round(
+            100.0 * (on_ms - off_ms) / off_ms, 2
+        ) if off_ms > 0 else 0.0
+
+        # -- cross-process assembly wall --------------------------------
+        # one streamed request leaves a multi-span trace; assemble it
+        # with the replica's /traces as a real HTTP source (in-process
+        # spans dedup by span_id, the pull cost is what's measured)
+        st, _ = _fleet_post(router_port, {
+            "prompt": [5, 9, 12, 3], "max_tokens": 4, "stream": True,
+        })
+        assert st == 200, st
+        tid = SLO.debug_state()["recent"][-1]["trace_id"]
+        asm = TraceAssembler(
+            sources=lambda: [
+                ("slo-bench-rep",
+                 ("127.0.0.1", rep["server"].server_address[1])),
+            ],
+        )
+        walls = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            rec = asm.assemble(tid)
+            walls.append(_time.perf_counter() - t0)
+        assert rec["span_count"] >= 1, rec
+        out["slo_assembly_ms"] = round(min(walls) * 1000, 2)
+        out["slo_assembly_spans"] = rec["span_count"]
+    finally:
+        router.stop()
+        rep["server"].shutdown()
+        rep["loop"].stop()
+
+    # -- breach-detection wall ------------------------------------------
+    # steady-state evaluate cost over a full 4k-journey class window
+    # (fold + burn over 3 objectives + transition scan), then the
+    # breach-detecting pass itself
+    import random as _random
+
+    rng = _random.Random(11)
+    for i in range(4096):
+        SLO.record_journey(
+            wclass="default", ok=True,
+            ttft_ms=rng.uniform(1, 400), e2e_ms=rng.uniform(5, 2000),
+            trace_id=f"warm-{i}",
+        )
+    SLO.evaluate(force=True)
+    for i in range(256):  # the violating tail that trips the breach
+        SLO.record_journey(
+            wclass="default", ok=False, ttft_ms=900.0, e2e_ms=9000.0,
+            trace_id=f"bad-{i}",
+        )
+    t0 = _time.perf_counter()
+    posture = SLO.evaluate(force=True)
+    out["slo_breach_detect_ms"] = round(
+        (_time.perf_counter() - t0) * 1000, 3
+    )
+    out["slo_breach_detected"] = bool(posture["burning"])
+    SLO.reset()
+    return out
+
+
+def slo_bench_cpu(timeout: int = 900) -> dict:
+    """Run the slo section in a CPU subprocess (serveoverlap's pattern)
+    so the BENCH artifact always carries the SLO-plane cost keys."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["BENCH_ALLOW_CPU"] = "1"
+    try:
+        p = subprocess.run(
+            [_sys.executable, __file__, "--tpu-section=slo"],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"slo_bench_error": f"timed out after {timeout}s"}
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        return {"slo_bench_error": str(e)[:300]}
+    if p.returncode != 0:
+        return {
+            "slo_bench_error": p.stderr.decode(errors="replace")[-300:]
+        }
+    try:
+        return json.loads(p.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"slo_bench_error": f"unparseable output: {e}"}
+
+
 def _tpu_section_compile():
     """Warm-start compilation plane (compilecache/): cold-vs-warm
     admission latency, shape-lattice warm-up wall for a fresh fill vs a
@@ -2643,6 +2816,7 @@ _TPU_SECTIONS = {
     "compile": _tpu_section_compile,
     "fleet": _tpu_section_fleet,
     "disagg": _tpu_section_disagg,
+    "slo": _tpu_section_slo,
     "model1b": _tpu_section_model1b,
     "flash32k": _tpu_section_flash32k,
     "pagedattn": _tpu_section_pagedattn,
@@ -2897,6 +3071,16 @@ def main():
             )
     except Exception as e:  # noqa: BLE001 — report, keep the artifact
         results["disagg_bench_error"] = str(e)[:300]
+
+    # fleet SLO plane: router hop p99 with journey recording on vs off,
+    # cross-process trace-assembly wall, breach-detection (evaluate)
+    # wall over a full journey window (tools/check_slo.py gates the
+    # end-to-end breach→exemplar→scale-up contract; these keys track
+    # the cost of observing).  Guarded like the journal bench.
+    try:
+        results.update(slo_bench_cpu())
+    except Exception as e:  # noqa: BLE001 — report, keep the artifact
+        results["slo_bench_error"] = str(e)[:300]
 
     # warm-start compilation plane: cold-vs-warm admission latency,
     # lattice warm-up wall fresh-fill vs persistent reload, cache hit

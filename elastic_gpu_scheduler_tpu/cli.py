@@ -358,6 +358,25 @@ def main(argv=None) -> int:
         help="workload class the autoscaler reads generation "
         "throughput preferences for (profile observatory)",
     )
+    p.add_argument(
+        "--slo-config", default=os.environ.get("TPU_SLO_CONFIG", ""),
+        help="fleet SLO plane: per-class objectives as inline JSON or "
+        "@file — {\"classes\": {\"serve\": {\"ttft_p95_ms\": 200, "
+        "\"e2e_p99_ms\": 2000, \"availability\": 0.99}}, "
+        "\"window_short_s\": 60, \"window_long_s\": 300, "
+        "\"burn_threshold\": 1.0}.  Enables request-journey recording "
+        "at the fleet router, burn-rate breach journaling (`slo` "
+        "records with exemplar trace ids), tpu_slo_* metrics, "
+        "/debug/slo + /debug/trace/<id>, and the autoscaler's "
+        "SLO-proactive scale-up input.  Also loadable at runtime via "
+        "POST /slo/load",
+    )
+    p.add_argument(
+        "--slo-interval", type=float, default=5.0,
+        help="SLO evaluate tick period (burn-rate computation + breach "
+        "journaling; the autoscaler tick also drives it when --fleet="
+        "auto)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -390,6 +409,17 @@ def main(argv=None) -> int:
             fsync=args.journal_fsync,
             max_segment_bytes=args.journal_max_bytes,
         )
+
+    if args.slo_config:
+        # after the journal configures, so the objective load itself
+        # lands as an `slo` annotation in the flight recorder
+        from .slo import SLO, load_config_source
+
+        try:
+            SLO.load_config(load_config_source(args.slo_config))
+        except (ValueError, TypeError, OSError) as e:
+            print(f"error: --slo-config: {e}", file=sys.stderr)
+            return 2
 
     if args.fault_plan:
         from .faultinject import FAULTS
@@ -589,6 +619,29 @@ def main(argv=None) -> int:
             adopt_load_margin=args.fleet_adopt_margin,
             disagg_min_pages=args.fleet_disagg_min_pages,
         )
+        from .slo import SLO
+        from .slo.assembly import TraceAssembler
+
+        # cross-process trace assembly: /debug/trace/<id> on both ports
+        # pulls every replica's /traces through the live replica set, so
+        # the pull list tracks scale-ups/downs; SLO breaches capture
+        # their exemplar journeys eagerly (before replica rings evict)
+        assembler = TraceAssembler(
+            sources=lambda: [
+                (r.name, (r.host, r.port)) for r in replica_set.all()
+            ],
+        )
+        router.assembler = assembler
+        # wired UNCONDITIONALLY: objectives may arrive at runtime via
+        # POST /slo/load, and the hooks/ticker/provider must already be
+        # in place when they do (evaluate() and scaling_input() no-op
+        # while no objectives are loaded, so an SLO-less fleet pays one
+        # attribute check per tick)
+        SLO.breach_hooks.append(assembler.on_breach)
+        # standalone evaluate tick: in auto mode the autoscaler's
+        # slo_provider also drives evaluation, but breach detection
+        # must not depend on an autoscaler being wired
+        SLO.start_ticker(args.slo_interval)
         autoscaler = None
         if args.fleet == "auto":
             autoscaler = Autoscaler(
@@ -612,8 +665,16 @@ def main(argv=None) -> int:
                     if args.fleet_shed_margin > 0 else None
                 ),
                 shed_queue_margin=args.fleet_shed_margin,
+                # burn posture as a pure evaluate input: scale up on
+                # budget burn before queue depth moves (journaled in
+                # every `fleet` record, replayed by score_policy).
+                # Always wired — scaling_input answers None until
+                # objectives load, incl. a runtime POST /slo/load
+                slo_provider=SLO.scaling_input,
             )
-        fleet_state = FleetState(router=router, autoscaler=autoscaler)
+        fleet_state = FleetState(
+            router=router, autoscaler=autoscaler, assembler=assembler
+        )
         # both ports answer /debug/fleet with the SAME combined payload
         router.state_provider = fleet_state.debug_state
 
@@ -632,6 +693,9 @@ def main(argv=None) -> int:
         policy=POLICIES,
         elector=elector,
         follower=follower,
+        assembler=(
+            fleet_state.assembler if fleet_state is not None else None
+        ),
     )
 
     if elector is not None:
@@ -682,6 +746,9 @@ def main(argv=None) -> int:
         if follower is not None:
             follower.stop()
         if fleet_state is not None:
+            from .slo import SLO
+
+            SLO.stop_ticker()  # started whenever the fleet is on
             fleet_state.stop()
         defrag.stop()
         if relay_monitor is not None:

@@ -25,6 +25,10 @@ Sites (``FAULTS.maybe_fire(site)`` — one attribute check when off):
     router.probe       ReplicaSet._http_get health/stats probe
     ship.stream        /journal/stream handler, per request (leader side)
     ship.follow        JournalFollower, per poll (follower side)
+    serve.request      inference /v1/completions handler, before
+                       admission (the SLO plane's latency-injection
+                       point: a ``delay`` plan here degrades TTFT/e2e
+                       without failing anything — check-slo's fault)
 
 Kinds:
 
@@ -32,6 +36,9 @@ Kinds:
                 failure handling treats it like a real I/O error)
     timeout     sleep ``delay_s`` then raise ``InjectedTimeout``
                 (a ``TimeoutError``)
+    delay       sleep ``delay_s`` and RETURN — pure added latency, no
+                failure (SLO-breach drills: the request succeeds, just
+                slower)
     partition   raise ``InjectedPartition`` (a ``ConnectionError``) —
                 the socket-level look of a network partition
     torn-write  no raise: ``maybe_fire`` RETURNS the plan and the call
@@ -72,7 +79,7 @@ __all__ = [
     "KINDS",
 ]
 
-KINDS = ("error", "timeout", "partition", "torn-write", "crash")
+KINDS = ("error", "timeout", "delay", "partition", "torn-write", "crash")
 
 
 class InjectedFault(OSError):
@@ -244,6 +251,11 @@ class FaultRegistry:
 
             time.sleep(delay)
             raise InjectedTimeout(f"injected timeout at {site}")
+        if kind == "delay":
+            import time
+
+            time.sleep(delay)
+            return None  # pure latency: the call proceeds normally
         if kind == "partition":
             raise InjectedPartition(f"injected partition at {site}")
         if kind == "crash":

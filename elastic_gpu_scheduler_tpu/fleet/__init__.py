@@ -62,10 +62,12 @@ class FleetState:
         router: Optional[FleetRouter] = None,
         autoscaler: Optional[Autoscaler] = None,
         resizer: Optional[GangResizer] = None,
+        assembler=None,  # slo.assembly.TraceAssembler (SLO plane)
     ):
         self.router = router
         self.autoscaler = autoscaler
         self.resizer = resizer
+        self.assembler = assembler
 
     def debug_state(self) -> dict:
         out: dict = {}
@@ -75,6 +77,8 @@ class FleetState:
             out["autoscaler"] = self.autoscaler.debug_state()
         if self.resizer is not None:
             out["resize"] = self.resizer.debug_state()
+        if self.assembler is not None:
+            out["trace_assembly"] = self.assembler.debug_state()
         return out
 
     def stop(self) -> None:
@@ -82,3 +86,5 @@ class FleetState:
             self.autoscaler.stop()
         if self.router is not None:
             self.router.stop()
+        if self.assembler is not None:
+            self.assembler.stop()

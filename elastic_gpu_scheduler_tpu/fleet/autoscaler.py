@@ -136,6 +136,7 @@ class PolicyEngine:
         self, signals: dict, n_replicas: int, now: float,
         total_replicas: Optional[int] = None,
         warming_replicas: int = 0,
+        slo: Optional[dict] = None,
     ):
         """(action, reason) with action ∈ up | down | hold.
         ``n_replicas`` counts ROUTABLE ('up') replicas; ``total_replicas``
@@ -146,10 +147,20 @@ class PolicyEngine:
         mid-compile-warm-up: capacity already admitted but not yet
         routable — a scale-up while one is warming would double-buy the
         same breach, so ups are suppressed until the warm-up lands (the
-        readiness-gating half of the warm-start compilation plane)."""
+        readiness-gating half of the warm-start compilation plane).
+        ``slo`` is the SLO plane's burn posture (``SLO.scaling_input``:
+        ``{"burning": bool, "breached": [...]}``, journaled verbatim in
+        the ``fleet`` record and replayed by ``score_policy``) — a
+        burning error budget counts as a scale-up breach, so the fleet
+        grows on budget burn BEFORE queue depth moves; it also vetoes a
+        scale-down (shrinking a fleet that is blowing its SLO is never
+        right, however idle the queue looks).  PURE input like every
+        other: None (no SLO plane) reproduces the historic behavior
+        exactly."""
         p = self.policy
         self.suppressed = None
         total = n_replicas if total_replicas is None else total_replicas
+        slo_burning = bool(slo and slo.get("burning"))
         if n_replicas < p.min_replicas:
             # the floor is not a watermark decision — but it still
             # respects the up-cooldown (one restore per cooldown window,
@@ -176,10 +187,12 @@ class PolicyEngine:
             signals.get("queue_per_replica", 0.0) >= p.queue_high
             or signals.get("occupancy", 0.0) >= p.occupancy_high
             or signals.get("page_util", 0.0) >= p.page_high
+            or slo_burning
         )
         breach_down = (
             signals.get("queue_per_replica", 0.0) <= p.queue_low
             and signals.get("occupancy", 0.0) <= p.occupancy_low
+            and not slo_burning
         )
         self.up_streak = self.up_streak + 1 if breach_up else 0
         self.down_streak = self.down_streak + 1 if breach_down else 0
@@ -207,7 +220,7 @@ class PolicyEngine:
                 return "hold", "up cooldown"
             self.up_streak = 0
             self.last_up = now
-            return "up", self._breach_reason(signals)
+            return "up", self._breach_reason(signals, slo)
         if breach_down:
             if self.down_streak < p.hysteresis_rounds:
                 return "hold", f"down hysteresis {self.down_streak}/{p.hysteresis_rounds}"
@@ -222,7 +235,8 @@ class PolicyEngine:
             return "down", "idle (queue and occupancy below low watermarks)"
         return "hold", "within watermarks"
 
-    def _breach_reason(self, signals: dict) -> str:
+    def _breach_reason(self, signals: dict,
+                       slo: Optional[dict] = None) -> str:
         p = self.policy
         parts = []
         if signals.get("queue_per_replica", 0.0) >= p.queue_high:
@@ -234,6 +248,12 @@ class PolicyEngine:
             parts.append(f"occupancy {signals['occupancy']}>={p.occupancy_high}")
         if signals.get("page_util", 0.0) >= p.page_high:
             parts.append(f"page_util {signals['page_util']}>={p.page_high}")
+        if slo and slo.get("burning"):
+            for b in (slo.get("breached") or [])[:2]:
+                parts.append(
+                    f"slo burn {b.get('wclass')}:{b.get('objective')} "
+                    f"short={b.get('burn_short')} long={b.get('burn_long')}"
+                )
         return "; ".join(parts) or "breach"
 
 
@@ -252,8 +272,17 @@ class Autoscaler:
         profiler=None,
         migrator=None,
         shed_queue_margin: float = 0.0,
+        slo_provider=None,
     ):
-        """``migrator``: duck-typed live-migration command —
+        """``slo_provider``: callable → the SLO plane's burn posture
+        (``SLO.scaling_input`` is the production shape; None while no
+        objectives are loaded).  The posture is a PURE evaluate input —
+        journaled inside every ``fleet`` record (``slo`` field) so
+        ``score_policy`` replays candidates against exactly the burn
+        history the incumbent saw; a burning budget triggers scale-up
+        before queue depth moves and vetoes scale-down.
+
+        ``migrator``: duck-typed live-migration command —
         ``migrator(src_name, dst_name) -> dict`` with at least ``ok``
         (``FleetRouter.migrate_session`` is the production shape).  With
         one wired, the autoscaler REBALANCES in-flight sessions instead
@@ -274,6 +303,7 @@ class Autoscaler:
         self.profiler = profiler if profiler is not None else PROFILER
         self.migrator = migrator
         self.shed_queue_margin = float(shed_queue_margin)
+        self.slo_provider = slo_provider
         self.evaluations = 0
         self.scale_ups = 0
         self.scale_downs = 0
@@ -312,8 +342,19 @@ class Autoscaler:
         n = len([r for r in all_reps if r.state == "up"])
         total = len(all_reps)
         warming = len([r for r in all_reps if r.state == "warming"])
+        slo = None
+        if self.slo_provider is not None:
+            try:
+                slo = self.slo_provider()
+            except Exception:
+                # the SLO plane failing must never take the scaler with
+                # it — posture degrades to "no SLO input", the historic
+                # behavior
+                log.exception("fleet slo provider failed")
+                slo = None
         action, reason = self.engine.evaluate(
-            sig, n, now, total_replicas=total, warming_replicas=warming
+            sig, n, now, total_replicas=total, warming_replicas=warming,
+            slo=slo,
         )
         if self.engine.suppressed == "bounds":
             FLEET_EVENTS.inc("bounds_suppressed")
@@ -333,6 +374,7 @@ class Autoscaler:
             "replicas": n,
             "replicas_total": total,
             "warming": warming,
+            "slo": slo,
             "policy": self.policy.name,
             "wclass": self.wclass,
             "generation_pref": gen_pref or None,
@@ -587,6 +629,7 @@ def score_policy(events: list[dict], policy: ScalingPolicy) -> dict:
             rec.get("signals") or {}, n_up, t - t0,
             total_replicas=int(rec.get("replicas_total", n_up)),
             warming_replicas=int(rec.get("warming", 0)),
+            slo=rec.get("slo"),
         )
         rec_action = rec.get("action", "hold")
         would[action] = would.get(action, 0) + 1

@@ -79,6 +79,7 @@ from ..metrics import (
     FLEET_ROUTED,
     REGISTRY,
 )
+from ..slo import SLO
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..utils import prefixdigest
 from ..utils.backoff import Backoff
@@ -110,6 +111,40 @@ def _post_json(
         raise ConnectionError(f"malformed replica response: {e}") from None
     finally:
         conn.close()
+
+
+def _scan_journey(journey: dict, data: bytes, now: float) -> None:
+    """SLO journey telemetry from relayed bytes (only when the SLO plane
+    is on — the pump stays a pure byte pump otherwise).  Counts SSE
+    ``data:`` events for TTFT/TPOT, and picks the backend's queue-wait
+    out of the one ``: slo {...}`` comment the stream path emits.  Cost
+    per burst: one-two ``bytes.count`` scans."""
+    if not data:
+        return
+    n = data.count(b"data:")
+    if n:
+        if journey.get("t_first") is None:
+            journey["t_first"] = now
+        journey["events"] = journey.get("events", 0) + n
+        journey["done_events"] = (
+            journey.get("done_events", 0) + data.count(b"data: [DONE]")
+        )
+        journey["t_last"] = now
+    elif journey.get("t_first") is None:
+        # non-SSE body bytes: first body byte IS the client-perceived
+        # first response byte (a blocking completion's headers+body
+        # arrive after generation)
+        journey["t_first"] = now
+    if "queue_ms" not in journey and b": slo " in data:
+        i = data.find(b": slo ")
+        end = data.find(b"\n", i)
+        line = data[i + 6:end if end != -1 else len(data)]
+        try:
+            meta = json.loads(line)
+            if isinstance(meta, dict) and "queue_ms" in meta:
+                journey["queue_ms"] = float(meta["queue_ms"])
+        except (ValueError, TypeError):
+            pass  # a torn comment split across bursts: drop, not crash
 
 
 class _RelayAborted(Exception):
@@ -613,6 +648,13 @@ class FleetRouter:
         # answer with the same shape; unset (library use) falls back to
         # the router-only view
         self.state_provider = None
+        # SLO plane (slo/): the router is the one vantage that sees
+        # client-perceived latency, so it records one journey per routed
+        # completion when objectives are loaded (SLO.enabled); the
+        # assembler (slo/assembly.py, wired by the CLI) serves
+        # /debug/trace/<id> cross-process on this port
+        self.slo = SLO
+        self.assembler = None
         # the fleet-wide prefix-cache index; entries naming a replica
         # that leaves rotation are pruned via the leave listener
         self.prefix_index = PrefixIndex(prefix_cap)
@@ -874,6 +916,7 @@ class FleetRouter:
         traceparent: str,
         client_sock: socket.socket,
         extra_headers: Optional[dict] = None,
+        journey: Optional[dict] = None,
     ) -> tuple[int, float]:
         """Send the request to ``replica`` and pump the response back to
         the client verbatim.  Returns (backend status, router overhead
@@ -918,6 +961,22 @@ class FleetRouter:
                 raise ConnectionError("malformed backend status line")
             if status >= 500:
                 raise ConnectionError(f"backend answered {status}")
+            if journey is not None:
+                # backend queue wait rides a response header on blocking
+                # completions (streams carry it as an SSE comment the
+                # scan below picks up)
+                head, _, body_start = buf.partition(b"\r\n\r\n")
+                for hline in head.split(b"\r\n")[1:]:
+                    k, _, v = hline.partition(b":")
+                    if k.strip().lower() == b"x-tpu-queue-wait-ms":
+                        try:
+                            journey["queue_ms"] = float(v.strip())
+                        except ValueError:
+                            pass
+                        break
+                _scan_journey(
+                    journey, body_start, time.perf_counter()
+                )
             # byte pump: each backend burst (the engine coalesces SSE
             # events into one chunk per burst) is one send to the client
             # — framing and syscall economy pass through unchanged.
@@ -936,6 +995,8 @@ class FleetRouter:
                     )
                 if not b:
                     break
+                if journey is not None:
+                    _scan_journey(journey, b, time.perf_counter())
                 try:
                     client_sock.sendall(b)
                 except OSError as e:
@@ -965,6 +1026,15 @@ class FleetRouter:
                 raise ValueError("body must be a JSON object")
         except ValueError as e:
             return 400, json.dumps({"error": f"router: {e}"}).encode()
+        # SLO request journey: the router is the one vantage that sees
+        # client-perceived latency.  One dict per request when the plane
+        # is on; the relay's scan fills TTFT/token timing into it, and
+        # _record_journey folds it into the per-class windows.
+        slo_on = self.slo.enabled and path == "/v1/completions"
+        journey: Optional[dict] = (
+            {"t0": time.perf_counter()} if slo_on else None
+        )
+        jevents: list = []
         with TRACER.span(
             "fleet.route", parent=traceparent or None, path=path,
             stream=bool(body.get("stream")),
@@ -973,6 +1043,11 @@ class FleetRouter:
             if replica is None:
                 FLEET_ROUTED.inc("no_replica")
                 sp.set_attr("kind", "no_replica")
+                if journey is not None:
+                    self._record_journey(
+                        body, sp, journey, jevents, ok=False,
+                        kind="no_replica", replica="", status=503,
+                    )
                 return 503, json.dumps(
                     {"error": "no serving replica available"}
                 ).encode()
@@ -987,6 +1062,9 @@ class FleetRouter:
                 donor = self._prefill_split(body, digests)
                 if donor is not None:
                     kind = "disagg"
+                    jevents.append({
+                        "event": "prefill_split", "replica": donor.name,
+                    })
             # the router hop joins the W3C chain: the backend request
             # carries THIS span's context, so the replica's serve.request
             # span becomes its child
@@ -1001,12 +1079,17 @@ class FleetRouter:
                 extra = {KV_SOURCE_HEADER: f"{donor.host}:{donor.port}"}
                 self.adoptions += 1
                 sp.set_attr("kv_source", donor.name)
+                if kind == "adopt":
+                    jevents.append({
+                        "event": "adopt", "donor": donor.name,
+                    })
             for target in self.failover_order(replica):
                 target.inflight_enter()
                 try:
                     status, overhead = self._forward(
                         target, method, path, raw, backend_tp,
                         client_sock, extra_headers=extra,
+                        journey=journey,
                     )
                 except _RelayAborted as e:
                     # bytes already reached the client: no failover (a
@@ -1021,6 +1104,16 @@ class FleetRouter:
                     sp.set_attr("kind", "aborted")
                     sp.set_attr("replica", target.name)
                     sp.end(status="error")
+                    if journey is not None:
+                        jevents.append({
+                            "event": "aborted",
+                            "client_side": e.client_side,
+                        })
+                        self._record_journey(
+                            body, sp, journey, jevents, ok=False,
+                            kind="aborted", replica=target.name,
+                            status=499,
+                        )
                     return None
                 except (OSError, ConnectionError) as e:
                     last_err = str(e)
@@ -1029,6 +1122,15 @@ class FleetRouter:
                         self.replicas.breaker_cooldown_s,
                     )
                     attempt_kind = "failover"
+                    jevents.append({
+                        "event": "failover", "replica": target.name,
+                        "error": str(e)[:120],
+                    })
+                    if target.state == "down":
+                        jevents.append({
+                            "event": "breaker_open",
+                            "replica": target.name,
+                        })
                     continue
                 finally:
                     target.inflight_exit()
@@ -1044,14 +1146,68 @@ class FleetRouter:
                 sp.set_attr("kind", attempt_kind)
                 sp.set_attr("overhead_ms", round(overhead * 1e3, 3))
                 sp.set_attr("status", status)
+                if journey is not None:
+                    journey["hop_ms"] = overhead * 1000
+                    self._record_journey(
+                        body, sp, journey, jevents, ok=status < 400,
+                        kind=attempt_kind, replica=target.name,
+                        status=status,
+                    )
                 return None
             # distinct from no_replica (nothing routable → 503): here
             # replicas LOOKED routable but every connect/forward failed
             FLEET_ROUTED.inc("exhausted")
             sp.set_attr("kind", "exhausted")
+            if journey is not None:
+                self._record_journey(
+                    body, sp, journey, jevents, ok=False,
+                    kind="exhausted", replica="", status=502,
+                )
             return 502, json.dumps(
                 {"error": f"every replica failed (last: {last_err})"}
             ).encode()
+
+    def _record_journey(
+        self, body: dict, sp, journey: dict, jevents: list,
+        ok: bool, kind: str, replica: str, status: int,
+    ) -> None:
+        """Fold one relayed request into the SLO plane's journey ring
+        (hot-path cost: arithmetic + one list append)."""
+        now = time.perf_counter()
+        t0 = journey["t0"]
+        t_first = journey.get("t_first")
+        t_last = journey.get("t_last")
+        tokens = max(
+            0, journey.get("events", 0) - journey.get("done_events", 0)
+        )
+        tpot_ms = None
+        if tokens > 1 and t_first is not None and t_last is not None \
+                and t_last > t_first:
+            tpot_ms = round((t_last - t_first) * 1000 / (tokens - 1), 3)
+        self.slo.record_journey(
+            wclass=str(
+                body.get("workload_class") or self.slo.default_class
+            ),
+            tenant=str(body.get("tenant", "")),
+            ok=ok,
+            ttft_ms=(
+                round((t_first - t0) * 1000, 3)
+                if t_first is not None else None
+            ),
+            tpot_ms=tpot_ms,
+            e2e_ms=round((now - t0) * 1000, 3),
+            queue_ms=journey.get("queue_ms"),
+            hop_ms=(
+                round(journey["hop_ms"], 3)
+                if journey.get("hop_ms") is not None else None
+            ),
+            tokens=tokens,
+            trace_id=sp.trace_id if sp else "",
+            replica=replica,
+            kind=kind,
+            events=jevents + [{"status": status}],
+            vantage="router",
+        )
 
     # -- introspection -------------------------------------------------------
 
@@ -1168,6 +1324,28 @@ class FleetRouter:
                             provider() if provider is not None
                             else router.debug_state()
                         )
+                        return self._respond(
+                            200, json.dumps(payload, indent=1).encode(),
+                        )
+                    if path == "/debug/slo":
+                        return self._respond(
+                            200,
+                            json.dumps(
+                                router.slo.debug_state(), indent=1
+                            ).encode(),
+                        )
+                    if path.startswith("/debug/trace/"):
+                        # cross-process assembly when the CLI wired an
+                        # assembler; local-ring fallback otherwise
+                        tid = path[len("/debug/trace/"):]
+                        if router.assembler is not None:
+                            payload = router.assembler.assemble(tid)
+                        else:
+                            from ..slo.assembly import (
+                                local_trace_payload,
+                            )
+
+                            payload = local_trace_payload(tid)
                         return self._respond(
                             200, json.dumps(payload, indent=1).encode(),
                         )

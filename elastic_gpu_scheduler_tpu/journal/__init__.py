@@ -40,7 +40,11 @@ stack:
   ``kv_migrate`` (a commanded live KV-session hop between serving
   replicas — shed or scale-down rebalance on the disaggregated data
   plane; an annotation, since the pages move between engine HBM pools,
-  never between scheduler-plane chips).
+  never between scheduler-plane chips).  The SLO plane (``slo/``) adds
+  ``slo``: objective loads and error-budget burn breach/recovery
+  transitions — annotations whose breach form carries exemplar trace
+  ids, so the flight recorder links a p99 alert to the concrete
+  request journeys (``/debug/trace/<id>``) that caused it.
 
 - **Wire format.**  Length-prefixed JSONL with a per-record CRC32::
 

@@ -157,6 +157,14 @@ class ReplayResult:
     kv_migrations: int = 0
     kv_migrations_failed: int = 0
     last_kv_migration: Optional[dict] = None
+    # SLO-plane annotations (slo/): objective loads and burn-rate
+    # breach/recovery transitions — counted, dense-seq audited, zero
+    # allocator mutation.  Breach records carry exemplar trace ids so
+    # an offline audit can name the concrete journeys behind an alert;
+    # the latest breach is kept for the replay CLI / check-slo gate.
+    slo_records: int = 0
+    slo_breaches: int = 0
+    last_slo_breach: Optional[dict] = None
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -188,6 +196,8 @@ class ReplayResult:
             "ha_takeovers": self.ha_takeovers,
             "kv_migrations": self.kv_migrations,
             "kv_migrations_failed": self.kv_migrations_failed,
+            "slo_records": self.slo_records,
+            "slo_breaches": self.slo_breaches,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -575,7 +585,9 @@ class ReplayEngine:
             # autoscaler evaluation (fleet/ subsystem): an annotation
             # like `profile` — the signals + decision stream that
             # fleet.autoscaler.score_policy replays a candidate scaling
-            # policy against.  Never mutates allocator state.
+            # policy against.  Never mutates allocator state.  The
+            # ``slo`` field (burn posture the evaluation saw) replays
+            # with the signals so candidates face the same SLO history.
             res.fleet_records += 1
             res.last_fleet = {
                 "seq": seq,
@@ -583,7 +595,27 @@ class ReplayEngine:
                 "action": rec.get("action"),
                 "signals": rec.get("signals") or {},
                 "replicas": rec.get("replicas"),
+                "slo": rec.get("slo"),
             }
+        elif t == "slo":
+            # SLO-plane annotation (slo/): objective loads and burn-rate
+            # breach/recovery transitions.  Participates in the dense-
+            # seq audit, never mutates allocator state; a breach record
+            # carries the exemplar trace ids that resolve via
+            # /debug/trace/<id> — the offline audit trail from a p99
+            # alert to the concrete journeys behind it.
+            res.slo_records += 1
+            if rec.get("action") == "breach":
+                res.slo_breaches += 1
+                res.last_slo_breach = {
+                    "seq": seq,
+                    "t": rec.get("t"),
+                    "wclass": rec.get("wclass"),
+                    "objective": rec.get("objective"),
+                    "burn_short": rec.get("burn_short"),
+                    "burn_long": rec.get("burn_long"),
+                    "exemplars": rec.get("exemplars") or [],
+                }
         elif t == "resize":
             # gang-resize commit summary (fleet/resize.py).  The member
             # binds/forgets/migrates that changed state were journaled
@@ -893,7 +925,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             continue
         if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
                  "gang_admit", "gang_rollback", "ha_takeover",
-                 "kv_migrate"):
+                 "kv_migrate", "slo"):
             # annotations (autoscaler evaluations / resize summaries /
             # policy-plane events / compile warm-ups / gang admit+rollback
             # markers): the member binds/forgets/migrates around a

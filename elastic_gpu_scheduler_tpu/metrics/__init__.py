@@ -356,12 +356,14 @@ PLAN_CACHE = REGISTRY.register(
 METRICS_DROPPED = REGISTRY.register(
     Counter(
         "tpu_metrics_dropped_samples_total",
-        "Lock-wait samples discarded by bounded buffers, by reason: "
-        "waits_cap = a TimedLock's wait buffer trimmed with nothing "
-        "scraping LOCK_WAIT; orphan_cap = a dying lock's parked waits "
-        "dropped at the 4096-entry orphan-list cap.  Non-zero values "
-        "mean lock-wait counts/sums UNDERSTATE reality by that many "
-        "samples",
+        "Samples discarded by bounded buffers, by reason: waits_cap = a "
+        "TimedLock's wait buffer trimmed with nothing scraping "
+        "LOCK_WAIT; orphan_cap = a dying lock's parked waits dropped at "
+        "the 4096-entry orphan-list cap; trace_pin_cap = a pinned "
+        "trace's parked span evicted at the tracer's pinned-span cap "
+        "(an open pod trace or pinned stream outgrew the protected "
+        "store).  Non-zero values mean the corresponding histograms/"
+        "traces UNDERSTATE reality by that many samples",
         ("reason",),
     )
 )

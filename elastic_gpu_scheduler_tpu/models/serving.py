@@ -217,6 +217,13 @@ class Request:
     # flag (atomic under the GIL) the engine checks at every chunk
     # boundary — tokens already emitted stay in ``output``.
     cancelled: bool = False
+    # SLO-plane queue-wait telemetry: monotonic stamps of FIRST enqueue
+    # and FIRST slot admission (a spill-resume re-queues but the queue
+    # wait a client perceived is the first one).  0.0 = not yet stamped;
+    # queue wait = t_admit - t_submit.  Written by the enqueue/admit
+    # paths, read by the HTTP layer after admission — GIL-atomic floats.
+    t_submit: float = 0.0
+    t_admit: float = 0.0
 
     def cancel(self) -> None:
         """Stop generation at the next chunk boundary (client timeout or
@@ -1864,6 +1871,8 @@ class InferenceEngine:
                 "engine.queued", parent=req.trace_ctx,
                 priority=req.priority, resumed=bool(req.output),
             )
+        if req.t_submit == 0.0:
+            req.t_submit = time.monotonic()
         self.queue.put((-req.priority, next(self._submit_seq), req))
         self._work.set()  # wake a parked EngineLoop
 
@@ -2132,6 +2141,8 @@ class InferenceEngine:
                     "engine.admitted", parent=req.trace_ctx, slot=i,
                     prefill_tokens=len(fed),
                 )
+            if req.t_admit == 0.0:
+                req.t_admit = time.monotonic()
             self.slots[i] = req
             # gap metric: only back-to-back decode chunks count.  Most
             # admissions reset via _prefill_dispatch, but a plen-1 or

@@ -142,6 +142,14 @@ def build_args(argv=None):
                         "elasticgpu.io/workload-class annotation's "
                         "default class).  The scheduler keys interference "
                         "and throughput tables by it")
+    p.add_argument("--slo-config", default="",
+                   help="replica-side SLO plane: per-class objectives "
+                        "as inline JSON or @file (default from "
+                        "TPU_SLO_CONFIG).  Enables this pod's own "
+                        "request-journey window (vantage=replica) at "
+                        "/debug/slo and the queue-wait/TTFT telemetry "
+                        "the fleet router folds into the client-"
+                        "perceived journey records")
     return p.parse_args(argv)
 
 
@@ -280,17 +288,30 @@ def main(argv=None) -> int:
         c for c in _os.environ.get("TPU_COTENANT_CLASSES", "").split(",")
         if c
     )
+    wclass = (
+        args.workload_class
+        or _os.environ.get("TPU_WORKLOAD_CLASS", "")
+        or DEFAULT_WORKLOAD_CLASS
+    )
     PROFILER.set_identity(
         pod=pod_key,
-        wclass=(
-            args.workload_class
-            or _os.environ.get("TPU_WORKLOAD_CLASS", "")
-            or DEFAULT_WORKLOAD_CLASS
-        ),
+        wclass=wclass,
         generation=generation,
         chips=max(1, args.tensor),
         neighbors=neighbors,
     )
+
+    # SLO plane (slo/): objectives from the flag (env TPU_SLO_CONFIG
+    # already applied at import); this pod's replica-vantage journeys
+    # aggregate under its workload class either way
+    from .slo import SLO, load_config_source
+
+    if args.slo_config:
+        try:
+            SLO.load_config(load_config_source(args.slo_config))
+        except (ValueError, TypeError, OSError) as e:
+            raise SystemExit(f"--slo-config: {e}")
+    SLO.default_class = wclass
 
     # warm-start compilation plane (compilecache/): a persistent AOT
     # cache when a dir is configured; an in-memory single-flight cache

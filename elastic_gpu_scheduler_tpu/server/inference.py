@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import __version__
+from ..faultinject import FAULTS
 from ..metrics import (
     KV_MIGRATIONS,
     KV_PAGES_RESIDENT,
@@ -56,6 +57,7 @@ from ..metrics import (
 )
 from ..policy import POLICIES
 from ..profile import PROFILER
+from ..slo import SLO
 from ..tracing import TRACEPARENT_HEADER, TRACER
 from ..models.serving import (
     DRAINING_ERROR,
@@ -366,6 +368,14 @@ class EngineLoop:
         self._stop.wait(min(1.0, 0.05 * (2 ** min(failures, 10))))
 
 
+def _queue_wait_ms(req) -> Optional[float]:
+    """Queue wait the request perceived (first enqueue → first slot
+    admission), or None before admission stamped."""
+    if req.t_submit > 0.0 and req.t_admit > 0.0:
+        return max(0.0, (req.t_admit - req.t_submit) * 1000.0)
+    return None
+
+
 def _token_ids(x, vocab_size: int, what: str) -> list:
     """Validate a JSON field as a list of in-range token ids.  bool is an
     int subclass in Python, so ``true`` would otherwise slip through; and
@@ -594,11 +604,14 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
         def log_message(self, fmt, *args):  # route through our logger
             log.debug("inference http: " + fmt, *args)
 
-        def _json(self, code: int, obj: dict) -> None:
+        def _json(self, code: int, obj: dict,
+                  extra_headers: Optional[dict] = None) -> None:
             data = json.dumps(obj).encode()
             self.send_response(code, _REASONS.get(code, ""))
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -680,6 +693,19 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # the profile observatory's serving-plane surface: this
                 # pod's per-class behavior + whatever co-tenancy it knows
                 return self._json(200, PROFILER.debug_state())
+            if self.path == "/debug/slo":
+                # the SLO plane's replica-side surface: this pod's own
+                # journey windows (vantage=replica) + loaded objectives
+                return self._json(200, SLO.debug_state())
+            if self.path.startswith("/debug/trace/"):
+                # one trace's spans from THIS process, causally ordered
+                # (the fleet router/scheduler serve the cross-process
+                # assembly; a replica answers its own ring so the
+                # assembler — or an operator — can pull it)
+                from ..slo.assembly import local_trace_payload
+
+                tid = self.path[len("/debug/trace/"):].split("?", 1)[0]
+                return self._json(200, local_trace_payload(tid))
             if self.path.split("?", 1)[0] == "/traces":
                 # serving-plane traces (request → engine step → SSE flush);
                 # one response shape shared with the scheduler's /traces
@@ -831,6 +857,15 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # max_tokens, temperature, ...) — a clean 400, not an
                 # aborted connection
                 return self._json(400, {"error": str(e)})
+            if FAULTS.enabled:
+                # the SLO plane's latency-injection point: a 'delay'
+                # plan here degrades TTFT/e2e without failing anything
+                # (check-slo's breach drill); error-family kinds answer
+                # 503 like any transient backend failure
+                try:
+                    FAULTS.maybe_fire("serve.request")
+                except OSError as e:
+                    return self._json(503, {"error": str(e)})
             kv_src = self.headers.get(KV_SOURCE_HEADER)
             if kv_src and engine.prefix_cache:
                 # fleet prefix-index adoption: the router knows another
@@ -1219,6 +1254,26 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # the source (or its client) went away: stop generating
                 req.cancel()
 
+        def _replica_journey(self, sp, ok: bool, e2e_ms: float,
+                             queue_ms, tokens: int,
+                             ttft_ms=None, tpot_ms=None) -> None:
+            """This pod's own vantage on the journey (the router records
+            the client-perceived one) — one append when the SLO plane is
+            on, nothing otherwise."""
+            if not SLO.enabled:
+                return
+            SLO.record_journey(
+                vantage="replica",
+                ok=ok,
+                ttft_ms=ttft_ms,
+                tpot_ms=tpot_ms,
+                e2e_ms=round(e2e_ms, 3),
+                queue_ms=queue_ms,
+                tokens=tokens,
+                trace_id=sp.trace_id if sp else "",
+                replica=getattr(engine, "replica_name", ""),
+            )
+
         def _single(self, req, sp):
             t0 = time.monotonic()
             engine.submit(req)
@@ -1229,9 +1284,15 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 # next chunk boundary is normally well under this wait
                 acked = req.done.wait(10.0)
                 SERVE_REQUESTS.inc("timeout")
-                SERVE_LATENCY.observe(value=time.monotonic() - t0)
+                e2e = time.monotonic() - t0
+                SERVE_LATENCY.observe(value=e2e)
                 if acked:  # partial tokens handed over are emitted work
                     SERVE_TOKENS.inc(value=len(req.output))
+                self._replica_journey(
+                    sp, ok=False, e2e_ms=e2e * 1000,
+                    queue_ms=_queue_wait_ms(req),
+                    tokens=len(req.output) if acked else 0,
+                )
                 return self._json(504, {
                     "error": "generation timed out",
                     # tokens generated before the deadline are real work —
@@ -1243,11 +1304,17 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                         if acked and req.logprobs > 0 else {}
                     ),
                 })
-            SERVE_LATENCY.observe(value=time.monotonic() - t0)
+            e2e = time.monotonic() - t0
+            SERVE_LATENCY.observe(value=e2e)
+            queue_ms = _queue_wait_ms(req)
             if req.error:
                 SERVE_REQUESTS.inc("error")
                 sp.set_attr("error", req.error)
                 code = _reject_code(req.error)
+                self._replica_journey(
+                    sp, ok=False, e2e_ms=e2e * 1000, queue_ms=queue_ms,
+                    tokens=0,
+                )
                 return self._json(code, {"error": req.error})
             SERVE_REQUESTS.inc("ok")
             SERVE_TOKENS.inc(value=len(req.output))
@@ -1255,7 +1322,19 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
             resp = {"tokens": req.output}
             if req.logprobs > 0:
                 resp["logprobs"] = _logprobs_payload(req)
-            return self._json(200, resp)
+            self._replica_journey(
+                sp, ok=True, e2e_ms=e2e * 1000, queue_ms=queue_ms,
+                tokens=len(req.output),
+            )
+            # queue wait rides a response header: the router folds it
+            # into the client-perceived journey record (a non-streamed
+            # response sends headers AFTER generation, so the wait is
+            # known here; streams carry it as an SSE comment instead)
+            extra = (
+                {"X-TPU-Queue-Wait-Ms": f"{queue_ms:.3f}"}
+                if queue_ms is not None else None
+            )
+            return self._json(200, resp, extra_headers=extra)
 
         def _multi(self, reqs, n: int) -> None:
             """n parallel completions (OpenAI's ``n``): submit every
@@ -1434,6 +1513,15 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 return json.dumps(ev)
 
             sent = 0
+            t_first_tok = t_last_tok = 0.0
+            slo_meta_sent = False
+            # pin the stream's trace while it lives: a long SSE
+            # generation's engine.step spans must survive span pressure
+            # from concurrent requests (FIFO eviction would drop this
+            # request's history mid-flight; unpinned in finally)
+            pinned_tid = sp.trace_id if sp is not None else ""
+            if pinned_tid:
+                TRACER.pin(pinned_tid)
             deadline = time.monotonic() + request_timeout
             try:
                 while time.monotonic() < deadline:
@@ -1464,9 +1552,26 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                                     "client disconnected"
                                 ) from None
                         continue
+                    if not slo_meta_sent:
+                        slo_meta_sent = True
+                        t_first_tok = time.monotonic()
+                        # SSE comment (spec-ignored by clients): hands
+                        # the router the queue wait for its journey
+                        # record — stream headers went out before
+                        # admission, so a header can't carry it
+                        qw = _queue_wait_ms(reqs[0])
+                        if qw is not None:
+                            meta = (
+                                f': slo {{"queue_ms": {qw:.3f}}}\n\n'
+                            ).encode()
+                            self.wfile.write(
+                                f"{len(meta):x}\r\n".encode()
+                                + meta + b"\r\n"
+                            )
                     events = _drain_burst(q, first)
                     chunk_many([event_json(e) for e in events])
                     sent += len(events)
+                    t_last_tok = time.monotonic()
                 timed_out = not all(r.done.is_set() for r in reqs)
                 if timed_out:
                     # timed out mid-generation: tell the client the truth
@@ -1497,11 +1602,34 @@ def make_handler(loop: EngineLoop, request_timeout: float = 300.0):
                 SERVE_REQUESTS.inc("cancelled", value=float(n))
                 log.info("stream client disconnected after %d tokens", sent)
             finally:
-                SERVE_LATENCY.observe(value=time.monotonic() - t0)
+                e2e = time.monotonic() - t0
+                SERVE_LATENCY.observe(value=e2e)
                 SERVE_TOKENS.inc(value=sent)
                 if sp is not None:
                     sp.set_attr("sse_chunks", sent)
                     sp.set_attr("sse_flushes", flushes[0])
+                if pinned_tid:
+                    TRACER.unpin(pinned_tid)
+                self._replica_journey(
+                    sp,
+                    ok=all(
+                        r.done.is_set() and not r.error for r in reqs
+                    ),
+                    e2e_ms=e2e * 1000,
+                    queue_ms=_queue_wait_ms(reqs[0]),
+                    tokens=sent,
+                    ttft_ms=(
+                        round((t_first_tok - t0) * 1000, 3)
+                        if t_first_tok else None
+                    ),
+                    tpot_ms=(
+                        round(
+                            (t_last_tok - t_first_tok) * 1000
+                            / (sent - 1), 3,
+                        )
+                        if sent > 1 and t_last_tok > t_first_tok else None
+                    ),
+                )
 
     return InferenceHandler
 

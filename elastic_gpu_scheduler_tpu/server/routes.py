@@ -47,6 +47,7 @@ from ..k8s.extender import (
 )
 from ..metrics import LOCK_WAIT, REGISTRY, VERB_LATENCY, VERB_TOTAL
 from ..profile import PROFILER
+from ..slo import SLO
 from ..tracing import AUDIT, TRACER
 from ..utils.tpuprobe import RELAY_MONITOR
 from .handlers import Bind, Predicate, Preemption, Prioritize
@@ -273,6 +274,16 @@ the Python analogues):</p>
  gate results, canary decision counters + SLO watchdog state; POST
  /policy/load stages a candidate (compile → replay gate → canary),
  /policy/promote and /policy/rollback drive the state machine</li>
+<li><a href="/debug/slo">/debug/slo</a>
+ — fleet SLO plane: declared objectives, per-class sliding-window
+ latency percentiles (TTFT/TPOT/e2e/queue/hop), error-budget burn
+ rates, active breaches with exemplar trace ids, recent request
+ journeys (POST /slo/load installs objectives; --slo-config /
+ TPU_SLO_CONFIG at start)</li>
+<li>/debug/trace/&lt;trace_id&gt;
+ — one request end-to-end ACROSS processes: spans pulled from every
+ replica's /traces (and this process's ring) merged in causal order —
+ the resolution target of an SLO breach record's exemplar ids</li>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
@@ -445,6 +456,7 @@ class ExtenderServer:
         policy=None,  # optional policy.PolicyPlane (/policy/*, /debug/policy)
         elector=None,  # optional LeaderElector (/debug/leader)
         follower=None,  # optional journal.ship.JournalFollower (HA standby)
+        assembler=None,  # optional slo.assembly.TraceAssembler
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -456,6 +468,7 @@ class ExtenderServer:
         self.policy = policy
         self.elector = elector
         self.follower = follower
+        self.assembler = assembler
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -632,6 +645,37 @@ class ExtenderServer:
                 json.dumps(PROFILER.debug_state(), indent=1).encode(),
                 "application/json",
             )
+        if path == "/debug/slo":
+            # the SLO plane: objectives, sliding-window percentiles,
+            # burn rates, breaches + exemplars.  Folding happens HERE,
+            # on the reader's thread (the /debug/profiles stance).
+            return (
+                200,
+                json.dumps(SLO.debug_state(), indent=1).encode(),
+                "application/json",
+            )
+        if path.startswith("/debug/trace/"):
+            # one request end-to-end across processes: the assembler
+            # (when the fleet wired one) pulls every replica's /traces;
+            # otherwise this process's own ring answers, causally
+            # ordered either way
+            tid = path[len("/debug/trace/"):]
+            try:
+                if self.assembler is not None:
+                    payload = self.assembler.assemble(tid)
+                else:
+                    from ..slo.assembly import local_trace_payload
+
+                    payload = local_trace_payload(tid)
+            except Exception as e:
+                return (
+                    500, json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
+            return (
+                200, json.dumps(payload, indent=1).encode(),
+                "application/json",
+            )
         if path == "/debug/relay":
             return (
                 200,
@@ -782,6 +826,8 @@ class ExtenderServer:
             return self._route_defrag_run(raw)
         if path.startswith("/policy/"):
             return self._route_policy(path, raw)
+        if path == "/slo/load":
+            return self._route_slo_load(raw)
         # route existence FIRST: unknown paths are 404s regardless of
         # body, and metric labels only ever come from this fixed verb
         # set (an attacker cycling random paths must not grow /metrics)
@@ -1011,6 +1057,45 @@ class ExtenderServer:
                 500, json.dumps({"Error": f"policy: {e}"}).encode(),
                 "application/json",
             )
+
+    def _route_slo_load(self, raw: bytes) -> tuple[int, bytes, str]:
+        """POST /slo/load — install per-class SLO objectives::
+
+            {"window_short_s": 60, "window_long_s": 300,
+             "burn_threshold": 1.0,
+             "classes": {"serve": {"ttft_p95_ms": 200,
+                                   "e2e_p99_ms": 2000,
+                                   "availability": 0.99}}}
+
+        Replaces ALL objectives; the load is journaled as an ``slo``
+        annotation.  Introspection at GET /debug/slo."""
+        try:
+            body = json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return 400, b'{"Error": "malformed JSON body"}', "application/json"
+        if not isinstance(body, dict):
+            return (
+                400, b'{"Error": "body must be a JSON object"}',
+                "application/json",
+            )
+        try:
+            summary = SLO.load_config(body)
+        except (ValueError, TypeError) as e:
+            return (
+                400, json.dumps({"Error": str(e)}).encode(),
+                "application/json",
+            )
+        return (
+            200,
+            json.dumps({
+                "ok": True,
+                "objectives": summary,
+                "window_short_s": SLO.window_short_s,
+                "window_long_s": SLO.window_long_s,
+                "burn_threshold": SLO.burn_threshold,
+            }, indent=1).encode(),
+            "application/json",
+        )
 
     def _route_faults(self, path: str, raw: bytes) -> tuple[int, bytes, str]:
         """Fault-plane control (deterministic chaos, faultinject/):

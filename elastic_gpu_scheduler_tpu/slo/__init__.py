@@ -1,0 +1,922 @@
+"""Fleet-wide SLO plane: request journeys, error budgets, burn rate.
+
+Every hop of a request already emits W3C-chained spans (client → router
+→ replica → engine.step) and every control-plane decision is journaled,
+yet nothing could answer the operator's first question — *is this
+workload class meeting its latency SLO, and which requests blew it?* —
+because spans die in per-process ``/traces`` rings and no surface
+computes TTFT/TPOT/e2e against a declared objective.  This module is
+that surface:
+
+- **Request-journey records.**  The fleet router (the one vantage that
+  sees client-perceived latency) calls :meth:`SloPlane.record_journey`
+  once per routed request with queue wait, TTFT, per-token TPOT, e2e
+  wall, hop overhead and journey events (prefill split, adoption,
+  failover, breaker trips); serving replicas record their own vantage.
+  The hot path follows the PROFILER discipline exactly: one GIL-atomic
+  list append, cap-trimmed through a try-lock with the drop COUNTED
+  (``tpu_slo_dropped_samples_total``) — all folding into per-class
+  sliding windows happens lazily on reader threads (scrape, /debug/slo,
+  the evaluate tick).
+
+- **Declared objectives + burn rate.**  Per-class targets load from
+  ``--slo-config`` / ``TPU_SLO_CONFIG`` / ``POST /slo/load`` as
+  ``{"classes": {cls: {"ttft_p95_ms": 200, "e2e_p99_ms": 2000,
+  "availability": 0.99, ...}}}``: ``<metric>_p<NN>_ms`` declares "NN% of
+  requests must see <metric> ≤ that many ms", ``availability`` the ok
+  fraction.  The error budget is ``1 - target``; the burn rate over a
+  window is the violating fraction divided by the budget (burn 1.0 =
+  consuming budget exactly as fast as sustainable).  Breach fires when
+  BOTH the short and long windows burn past ``burn_threshold``
+  (multi-window, so one slow request cannot page and a long regression
+  cannot hide) with at least ``min_samples`` journeys in the short
+  window; recovery when both drop back under.
+
+- **Journal + exemplars.**  Breach/recovery/objective-load land as
+  ``slo`` records in the decision journal — ANNOTATIONS (dense-seq
+  audited, zero allocator mutation; ``what_if`` skips them) — and a
+  breach record carries the exemplar trace ids of the concrete journeys
+  that violated, so a p99 alert links straight to
+  ``/debug/trace/<trace_id>`` (slo/assembly.py pulls those spans
+  fleet-wide before per-process rings evict them; breach hooks let the
+  wiring capture exemplars eagerly).
+
+- **SLO-proactive scaling.**  :meth:`SloPlane.scaling_input` returns the
+  burn posture as PURE data for the fleet autoscaler's
+  ``PolicyEngine.evaluate`` — journaled inside ``fleet`` records and
+  replayed by ``score_policy``, so scale-ups can trigger on budget burn
+  before queue depth moves, advisory-safe like every other input.
+
+Process-global instance ``SLO`` (TRACER/JOURNAL/PROFILER pattern):
+emission sites check ``.enabled`` first — one attribute load when no
+objectives are configured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..metrics import (
+    REGISTRY,
+    Counter,
+    LazyGauge,
+    _exact_quantile,
+)
+
+__all__ = [
+    "SLO",
+    "SloObjective",
+    "SloPlane",
+    "parse_objectives",
+]
+
+# latency metrics a journey can carry (availability is derived from ok)
+LATENCY_METRICS = ("ttft", "tpot", "e2e", "queue", "hop")
+
+SLO_LATENCY = REGISTRY.register(
+    LazyGauge(
+        "tpu_slo_latency_ms",
+        "Per-class request-journey latency percentiles over the short "
+        "SLO window, in ms, by metric (ttft/tpot/e2e/queue/hop) and "
+        "quantile (p50/p95/p99) — folded from the journey ring at "
+        "scrape time, the client-perceived numbers the declared "
+        "objectives are judged against",
+        ("wclass", "metric", "quantile"),
+    )
+)
+SLO_BURN = REGISTRY.register(
+    LazyGauge(
+        "tpu_slo_burn_rate",
+        "Error-budget burn rate per declared objective and window "
+        "(short/long): violating fraction over the window divided by "
+        "the objective's error budget (1 - target).  1.0 = consuming "
+        "budget exactly as fast as sustainable; a breach journals when "
+        "BOTH windows exceed the configured threshold",
+        ("wclass", "objective", "window"),
+    )
+)
+SLO_BREACHED = REGISTRY.register(
+    LazyGauge(
+        "tpu_slo_breached",
+        "1 while the (class, objective) pair is in a journaled breach "
+        "(multi-window burn above threshold), 0 once recovered — the "
+        "alerting surface; the journaled `slo` record carries the "
+        "exemplar trace ids",
+        ("wclass", "objective"),
+    )
+)
+SLO_EVENTS = REGISTRY.register(
+    Counter(
+        "tpu_slo_events_total",
+        "SLO-plane lifecycle events: breach (burn alert tripped, "
+        "journaled with exemplars), recover, objectives_loaded",
+        ("event",),
+    )
+)
+SLO_RECORDS = REGISTRY.register(
+    Counter(
+        "tpu_slo_records_total",
+        "Request-journey records folded into the SLO windows, by "
+        "vantage (router = client-perceived, replica = server-side)",
+        ("vantage",),
+    )
+)
+SLO_DROPPED = REGISTRY.register(
+    Counter(
+        "tpu_slo_dropped_samples_total",
+        "Journey records discarded because the raw ring hit its cap "
+        "with no reader folding it — non-zero means the SLO windows "
+        "UNDERSTATE traffic by that many requests",
+        ("reason",),
+    )
+)
+
+
+def _num(val, what: str) -> float:
+    """Config value → float with ONE error type: a null/list/string
+    value must surface as the same ValueError a malformed key does
+    (float(None) raises TypeError, which would otherwise escape every
+    config error handler as a crash)."""
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        raise ValueError(f"{what} must be a number, got {val!r}") from None
+
+
+class SloObjective:
+    """One declared objective: ``target`` fraction of journeys must be
+    good.  Latency objectives (``metric`` in LATENCY_METRICS) judge
+    ``value <= threshold_ms``; the ``availability`` objective judges the
+    journey's ``ok`` flag.  ``key`` is the config-file spelling
+    (``ttft_p95_ms`` / ``availability``) used VERBATIM in journal
+    records, metrics labels and /debug/slo — a fractional percentile
+    like ``e2e_p99.5_ms`` keeps its declared name."""
+
+    __slots__ = ("metric", "target", "threshold_ms", "key")
+
+    def __init__(self, metric: str, target: float,
+                 threshold_ms: Optional[float] = None,
+                 key: Optional[str] = None):
+        if metric != "availability" and metric not in LATENCY_METRICS:
+            raise ValueError(f"unknown SLO metric {metric!r}")
+        target = _num(target, "SLO target")
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"SLO target must be in (0, 1), got {target} — a target "
+                "of 1.0 has zero error budget and every request is a page"
+            )
+        if metric != "availability":
+            threshold_ms = _num(
+                threshold_ms, f"latency objective {metric!r} threshold"
+            )
+            if threshold_ms <= 0:
+                raise ValueError(
+                    f"latency objective {metric!r} needs a positive "
+                    "threshold_ms"
+                )
+            self.key = key or f"{metric}_p{target * 100:g}_ms"
+        else:
+            self.key = key or "availability"
+        self.metric = metric
+        self.target = target
+        self.threshold_ms = (
+            float(threshold_ms) if threshold_ms is not None else None
+        )
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def violated(self, journey: tuple) -> Optional[bool]:
+        """True/False verdict for one journey tuple, or None when the
+        journey carries no value for this metric (a non-streamed
+        completion has no TPOT — it must not count either way)."""
+        if self.metric == "availability":
+            return not journey[_J_OK]
+        v = journey[_J_METRIC_IDX[self.metric]]
+        if v is None:
+            return None
+        return v > self.threshold_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "metric": self.metric,
+            "target": self.target,
+            "threshold_ms": self.threshold_ms,
+        }
+
+
+def parse_objectives(spec: dict) -> list[SloObjective]:
+    """One class's config dict → objectives.  Keys: ``<metric>_p<NN>_ms``
+    (latency) and ``availability`` (fraction); unknown keys are errors —
+    a typo'd objective silently never alerting is the worst outcome."""
+    out: list[SloObjective] = []
+    for key, val in sorted(spec.items()):
+        if key == "availability":
+            out.append(SloObjective("availability", _num(val, key)))
+            continue
+        parts = key.split("_")
+        if (
+            len(parts) == 3
+            and parts[0] in LATENCY_METRICS
+            and parts[1].startswith("p")
+            and parts[2] == "ms"
+        ):
+            try:
+                pct = float(parts[1][1:])
+            except ValueError:
+                raise ValueError(f"bad SLO objective key {key!r}")
+            out.append(
+                # the declared spelling IS the objective's identity:
+                # journal records / metric labels / debug must name
+                # exactly what the operator wrote (p99.5 stays p99.5)
+                SloObjective(parts[0], pct / 100.0, _num(val, key),
+                             key=key)
+            )
+            continue
+        raise ValueError(
+            f"unknown SLO objective key {key!r} (want "
+            "<ttft|tpot|e2e|queue|hop>_p<NN>_ms or availability)"
+        )
+    if not out:
+        raise ValueError("SLO class config declares no objectives")
+    return out
+
+
+# journey tuple layout (hot path appends tuples, not objects)
+_J_T = 0
+_J_VANTAGE = 1
+_J_CLASS = 2
+_J_OK = 3
+_J_TTFT = 4
+_J_TPOT = 5
+_J_E2E = 6
+_J_QUEUE = 7
+_J_HOP = 8
+_J_TOKENS = 9
+_J_TRACE = 10
+_J_REPLICA = 11
+_J_KIND = 12
+_J_TENANT = 13
+_J_METRIC_IDX = {
+    "ttft": _J_TTFT, "tpot": _J_TPOT, "e2e": _J_E2E,
+    "queue": _J_QUEUE, "hop": _J_HOP,
+}
+
+
+class _ClassWindow:
+    """Per-class sliding journey window (fold-path only: every mutation
+    happens under the plane's fold lock).
+
+    Raw journeys feed percentiles/exemplars/debug and are bounded two
+    ways — by age (older than the long window prunes at fold) and by
+    count (the deque cap).  BURN accounting deliberately does NOT read
+    the raw deque: at high traffic the count cap would silently
+    truncate the long window (4096 journeys at 100 rps cover ~41s —
+    less than the short window — collapsing multi-window alerting into
+    single-window paging).  Instead ``buckets`` holds time-bucketed
+    per-objective (total, bad) counters: exact counts at any rate,
+    memory bounded by window_long / bucket width per objective, with
+    at most one bucket width of boundary slop."""
+
+    __slots__ = ("journeys", "exemplars", "count", "violations",
+                 "buckets")
+
+    def __init__(self, cap: int):
+        self.journeys: deque = deque(maxlen=cap)
+        # objective key → recent violating trace ids (the breach
+        # record's exemplar source)
+        self.exemplars: dict[str, deque] = {}
+        self.count = 0  # lifetime folded journeys
+        self.violations: dict[str, int] = {}  # lifetime per objective
+        # bucket index (t // bucket_s) → {objective key: [total, bad]}
+        self.buckets: dict[int, dict[str, list]] = {}
+
+    def fresh_exemplars(self, key: str, horizon: float) -> list:
+        """Violating trace ids recorded at or after ``horizon`` — a
+        breach must never cite journeys older than its own burn
+        windows (their spans are long evicted and the evidence would
+        point at the wrong requests)."""
+        return [
+            tid for t, tid in self.exemplars.get(key, ())
+            if t >= horizon
+        ]
+
+
+class SloPlane:
+    """Declared objectives + journey windows + burn-rate alerting.
+
+    Concurrency model (mirrors profile.WorkloadProfiler): the HOT path —
+    :meth:`record_journey` — is one GIL-atomic list append behind an
+    ``enabled`` check; folding, percentile math, burn computation and
+    breach journaling run under ``_fold_lock`` on READER threads (the
+    evaluate tick, /debug/slo, the gauge refresher)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.default_class = "default"
+        self.window_short_s = 60.0
+        self.window_long_s = 300.0
+        self.burn_threshold = 1.0
+        self.min_samples = 5
+        self._cap = 20000  # raw-buffer bound, same stance as PROFILER
+        self._window_cap = 4096  # raw journeys kept per class
+        # burn-counter bucket width (recomputed at load_config so the
+        # boundary slop stays a small fraction of the short window)
+        self.bucket_s = 2.0
+        self._exemplar_cap = 8
+        self._buf: list[tuple] = []
+        self.dropped = 0
+        self._fold_lock = threading.Lock()
+        self._classes: dict[str, _ClassWindow] = {}
+        self._objectives: dict[str, list[SloObjective]] = {}
+        self._breached: dict[tuple[str, str], dict] = {}
+        self._recent: deque = deque(maxlen=64)  # full dicts for /debug
+        self._folded = {"router": 0, "replica": 0}
+        self.breaches = 0
+        self.recoveries = 0
+        self.journal_records = 0
+        # breach hooks: called (record dict) AFTER the breach journals —
+        # the CLI wires eager exemplar-trace capture here.  Fired on the
+        # evaluate tick's thread (never the scrape path).
+        self.breach_hooks: list = []
+        self._eval_lock = threading.Lock()
+        self._eval_at = 0.0
+        self.min_eval_interval_s = 0.5
+        self._ticker: Optional[threading.Thread] = None
+        self._ticker_stop = threading.Event()
+        SLO_LATENCY.refresher = self._refresh_gauges
+
+    # -- configuration -------------------------------------------------------
+
+    def load_config(self, spec: dict, journal: bool = True) -> dict:
+        """Install objectives from a config dict::
+
+            {"window_short_s": 60, "window_long_s": 300,
+             "burn_threshold": 1.0, "min_samples": 5,
+             "default_class": "default",
+             "classes": {"serve": {"ttft_p95_ms": 200,
+                                   "e2e_p99_ms": 2000,
+                                   "availability": 0.99}}}
+
+        Replaces ALL objectives (the policy-plane load-by-name stance);
+        raises ValueError on any malformed entry, installing nothing.
+        Returns the /debug/slo-shaped objective summary."""
+        if not isinstance(spec, dict):
+            raise ValueError("SLO config must be a JSON object")
+        classes = spec.get("classes")
+        if not isinstance(classes, dict) or not classes:
+            raise ValueError('SLO config needs a non-empty "classes" map')
+        parsed = {
+            str(cls): parse_objectives(objs)
+            for cls, objs in classes.items()
+        }
+        short = _num(
+            spec.get("window_short_s", self.window_short_s),
+            "window_short_s",
+        )
+        long_ = _num(
+            spec.get("window_long_s", self.window_long_s),
+            "window_long_s",
+        )
+        burn_thr = _num(
+            spec.get("burn_threshold", self.burn_threshold),
+            "burn_threshold",
+        )
+        min_samples = int(_num(
+            spec.get("min_samples", self.min_samples), "min_samples"
+        ))
+        if not 0 < short < long_:
+            raise ValueError(
+                f"need 0 < window_short_s ({short}) < window_long_s "
+                f"({long_})"
+            )
+        with self._fold_lock:
+            self._objectives = parsed
+            self.window_short_s = short
+            self.window_long_s = long_
+            # ≤ ~3% boundary slop on the short window; bucket scale
+            # changed ⇒ existing bucket indices are meaningless
+            self.bucket_s = max(0.05, short / 30.0)
+            for win in self._classes.values():
+                win.buckets.clear()
+                # exemplars cite objectives that may no longer exist
+                # (or have new thresholds): a breach after the swap
+                # must only cite journeys judged under the NEW config
+                win.exemplars.clear()
+            self.burn_threshold = max(0.01, burn_thr)
+            self.min_samples = max(1, min_samples)
+            if spec.get("default_class"):
+                self.default_class = str(spec["default_class"])
+            self._breached.clear()
+            self.enabled = True
+        SLO_EVENTS.inc("objectives_loaded")
+        summary = self.objectives_dict()
+        if journal:
+            from ..journal import JOURNAL
+
+            if JOURNAL.enabled:
+                JOURNAL.record(
+                    "slo", action="objectives", classes=summary,
+                    window_short_s=self.window_short_s,
+                    window_long_s=self.window_long_s,
+                    burn_threshold=self.burn_threshold,
+                )
+                self.journal_records += 1
+        return summary
+
+    def objectives_dict(self) -> dict:
+        return {
+            cls: {o.key: o.to_dict() for o in objs}
+            for cls, objs in sorted(self._objectives.items())
+        }
+
+    def reset(self) -> None:
+        """Drop every buffer/aggregate and disable (tests, CI soaks)."""
+        with self._fold_lock:
+            del self._buf[:]
+            self.dropped = 0
+            self._classes.clear()
+            self._objectives = {}
+            self._breached.clear()
+            self._recent.clear()
+            self._folded = {"router": 0, "replica": 0}
+            self.breaches = self.recoveries = 0
+            self.journal_records = 0
+            self.enabled = False
+        del self.breach_hooks[:]
+
+    # -- hot path ------------------------------------------------------------
+
+    def record_journey(
+        self,
+        wclass: str = "",
+        ok: bool = True,
+        ttft_ms: Optional[float] = None,
+        tpot_ms: Optional[float] = None,
+        e2e_ms: Optional[float] = None,
+        queue_ms: Optional[float] = None,
+        hop_ms: Optional[float] = None,
+        tokens: int = 0,
+        trace_id: str = "",
+        replica: str = "",
+        kind: str = "",
+        tenant: str = "",
+        vantage: str = "router",
+        events: Optional[list] = None,
+    ) -> bool:
+        """One request journey.  Cost when the plane is on: one tuple
+        append (the PROFILER stance); returns False when disabled."""
+        if not self.enabled:
+            return False
+        buf = self._buf
+        buf.append((
+            time.monotonic(), vantage,
+            wclass or self.default_class, bool(ok),
+            ttft_ms, tpot_ms, e2e_ms, queue_ms, hop_ms,
+            int(tokens), trace_id, replica, kind, tenant,
+            tuple(events) if events else (),
+        ))
+        if len(buf) > self._cap and self._fold_lock.acquire(blocking=False):
+            # nothing is folding: trim like the TimedLock wait buffers —
+            # try-acquire keeps this path non-blocking, and the drop is
+            # COUNTED (never silently discard journeys)
+            try:
+                n = self._cap // 2
+                del buf[:n]
+                self.dropped += n
+            finally:
+                self._fold_lock.release()
+        return True
+
+    # -- fold path (reader threads) ------------------------------------------
+
+    def _fold_locked(self, now: float) -> None:
+        """Drain the raw ring into the per-class windows (caller holds
+        ``_fold_lock``).  Slice-then-del is safe against concurrent
+        hot-path appends landing at the tail (the TimedLock pattern)."""
+        n = len(self._buf)
+        rows = self._buf[:n]
+        del self._buf[:n]
+        folded = {"router": 0, "replica": 0}
+        recent_rows: list[tuple] = []
+        for row in rows:
+            vantage = row[_J_VANTAGE]
+            folded[vantage] = folded.get(vantage, 0) + 1
+            cls = row[_J_CLASS]
+            if cls not in self._objectives:
+                # the class name arrives from the CLIENT's request body:
+                # undeclared values collapse into the default class so
+                # per-class state (and tpu_slo_* label cardinality) is
+                # bounded by the operator's config, never by a client
+                # cycling random strings (the fixed-verb-set stance the
+                # HTTP layer takes for its own metric labels)
+                cls = self.default_class
+            win = self._classes.get(cls)
+            if win is None:
+                win = self._classes[cls] = _ClassWindow(self._window_cap)
+            win.journeys.append(row)
+            win.count += 1
+            # burn counters + exemplars per objective.  Counters are
+            # time-bucketed so burn never reads the count-capped raw
+            # deque; only the ROUTER vantage contributes (one journey
+            # must not count twice when both vantages record it).
+            if vantage == "router":
+                objs = self._objectives.get(cls, ())
+                bucket = None
+                if objs:
+                    bidx = int(row[_J_T] / self.bucket_s)
+                    bucket = win.buckets.get(bidx)
+                    if bucket is None:
+                        bucket = win.buckets[bidx] = {}
+                for obj in objs:
+                    verdict = obj.violated(row)
+                    if verdict is None:
+                        continue
+                    cell = bucket.get(obj.key)
+                    if cell is None:
+                        cell = bucket[obj.key] = [0, 0]
+                    cell[0] += 1
+                    cell[1] += verdict
+                    if verdict:
+                        win.violations[obj.key] = (
+                            win.violations.get(obj.key, 0) + 1
+                        )
+                        if row[_J_TRACE]:
+                            ex = win.exemplars.get(obj.key)
+                            if ex is None:
+                                ex = win.exemplars[obj.key] = deque(
+                                    maxlen=self._exemplar_cap
+                                )
+                            ex.append((row[_J_T], row[_J_TRACE]))
+                recent_rows.append(row)
+        # only the tail of the fold can survive the 64-entry recent
+        # deque — building a 15-key dict per folded row would make a
+        # post-burst fold (up to _cap rows) pay ~300x for nothing,
+        # under the same lock readers and the hot-path trim contend on
+        for row in recent_rows[-(self._recent.maxlen or 64):]:
+            self._recent.append(self._journey_dict(row))
+        # time-bound prune: journeys/buckets older than the long window
+        # carry no signal and only slow the percentile sorts
+        horizon = now - self.window_long_s
+        for win in self._classes.values():
+            while win.journeys and win.journeys[0][_J_T] < horizon:
+                win.journeys.popleft()
+            if win.buckets:
+                dead = [
+                    b for b in win.buckets
+                    if (b + 1) * self.bucket_s < horizon
+                ]
+                for b in dead:
+                    del win.buckets[b]
+        for k, v in folded.items():
+            self._folded[k] = self._folded.get(k, 0) + v
+        dropped, self.dropped = self.dropped, 0
+        # counter metrics outside would be nicer, but their own locks
+        # suffice and the amounts are tiny; keep the call order simple
+        for k, v in folded.items():
+            if v:
+                SLO_RECORDS.inc(k, value=float(v))
+        if dropped:
+            SLO_DROPPED.inc("journey_cap", value=float(dropped))
+
+    @staticmethod
+    def _journey_dict(row: tuple) -> dict:
+        return {
+            "t_mono": round(row[_J_T], 3),
+            "vantage": row[_J_VANTAGE],
+            "wclass": row[_J_CLASS],
+            "tenant": row[_J_TENANT],
+            "ok": row[_J_OK],
+            "ttft_ms": row[_J_TTFT],
+            "tpot_ms": row[_J_TPOT],
+            "e2e_ms": row[_J_E2E],
+            "queue_ms": row[_J_QUEUE],
+            "hop_ms": row[_J_HOP],
+            "tokens": row[_J_TOKENS],
+            "trace_id": row[_J_TRACE],
+            "replica": row[_J_REPLICA],
+            "kind": row[_J_KIND],
+            "events": list(row[14]),
+        }
+
+    def _burn_locked(self, now: float) -> dict:
+        """Per-class, per-objective burn rates over both windows from
+        the time-bucketed counters (caller holds ``_fold_lock``; fold
+        first).  Exact counts at any traffic rate — burn never reads
+        the count-capped raw deque — with at most one bucket width of
+        window-boundary slop."""
+        out: dict[str, dict] = {}
+        t_short = now - self.window_short_s
+        t_long = now - self.window_long_s
+        for cls, objs in sorted(self._objectives.items()):
+            win = self._classes.get(cls)
+            entry = out[cls] = {}
+            counts = {
+                obj.key: [0, 0, 0, 0]  # tot_s, bad_s, tot_l, bad_l
+                for obj in objs
+            }
+            if win is not None:
+                for bidx, bucket in win.buckets.items():
+                    b_end = (bidx + 1) * self.bucket_s
+                    if b_end <= t_long:
+                        continue
+                    in_short = b_end > t_short
+                    for key, (tot, bad) in bucket.items():
+                        c = counts.get(key)
+                        if c is None:
+                            continue  # stale key from replaced config
+                        c[2] += tot
+                        c[3] += bad
+                        if in_short:
+                            c[0] += tot
+                            c[1] += bad
+            for obj in objs:
+                tot_s, bad_s, tot_l, bad_l = counts[obj.key]
+                budget = obj.budget
+                burn_s = (bad_s / tot_s / budget) if tot_s else 0.0
+                burn_l = (bad_l / tot_l / budget) if tot_l else 0.0
+                entry[obj.key] = {
+                    "burn_short": round(burn_s, 4),
+                    "burn_long": round(burn_l, 4),
+                    "bad_short": bad_s,
+                    "total_short": tot_s,
+                    "bad_long": bad_l,
+                    "total_long": tot_l,
+                    "target": obj.target,
+                    "threshold_ms": obj.threshold_ms,
+                }
+        return out
+
+    # -- evaluation (the alerting tick) --------------------------------------
+
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> dict:
+        """Fold, compute burn, journal breach/recovery transitions, fire
+        breach hooks.  Rate-limited (``min_eval_interval_s``) so both an
+        autoscaler tick and a standalone ticker can call it freely.
+        Returns the posture dict (:meth:`posture`).  Runs on background
+        threads — never wire it into the scrape path (the gauge
+        refresher is the side-effect-free sibling)."""
+        now = time.monotonic() if now is None else now
+        if not self.enabled:
+            return {"burning": False, "breached": []}
+        with self._eval_lock:
+            if not force and now - self._eval_at < self.min_eval_interval_s:
+                return self.posture()
+            self._eval_at = now
+            transitions: list[dict] = []
+            with self._fold_lock:
+                self._fold_locked(now)
+                burn = self._burn_locked(now)
+                thr = self.burn_threshold
+                for cls, objs in burn.items():
+                    win = self._classes.get(cls)
+                    for key, b in objs.items():
+                        pair = (cls, key)
+                        burning = (
+                            b["burn_short"] >= thr
+                            and b["burn_long"] >= thr
+                            and b["total_short"] >= self.min_samples
+                        )
+                        was = pair in self._breached
+                        if burning and not was:
+                            exemplars = win.fresh_exemplars(
+                                key, now - self.window_long_s
+                            ) if win is not None else []
+                            rec = {
+                                "action": "breach",
+                                "wclass": cls,
+                                "objective": key,
+                                **b,
+                                "burn_threshold": thr,
+                                "window_short_s": self.window_short_s,
+                                "window_long_s": self.window_long_s,
+                                "exemplars": exemplars,
+                            }
+                            self._breached[pair] = rec
+                            self.breaches += 1
+                            transitions.append(rec)
+                        elif was and not burning and (
+                            b["burn_short"] < thr and b["burn_long"] < thr
+                        ):
+                            self._breached.pop(pair, None)
+                            self.recoveries += 1
+                            transitions.append({
+                                "action": "recover",
+                                "wclass": cls,
+                                "objective": key,
+                                **b,
+                                "burn_threshold": thr,
+                            })
+        # journal + hooks OUTSIDE the fold lock: the journal's own lock
+        # suffices, and a hook doing HTTP must never block a folding
+        # scraper behind it
+        if transitions:
+            from ..journal import JOURNAL
+
+            for rec in transitions:
+                SLO_EVENTS.inc(rec["action"])
+                if JOURNAL.enabled:
+                    JOURNAL.record("slo", **rec)
+                    self.journal_records += 1
+                if rec["action"] == "breach":
+                    for hook in list(self.breach_hooks):
+                        try:
+                            hook(rec)
+                        except Exception:
+                            pass  # exemplar capture is best-effort
+        return self.posture()
+
+    def posture(self) -> dict:
+        """The autoscaler's pure input: compact burn posture (plain data
+        — journaled verbatim inside ``fleet`` records and replayed by
+        ``score_policy``)."""
+        with self._fold_lock:
+            breached = [
+                {
+                    "wclass": cls,
+                    "objective": key,
+                    "burn_short": rec.get("burn_short"),
+                    "burn_long": rec.get("burn_long"),
+                }
+                for (cls, key), rec in sorted(self._breached.items())
+            ][:8]
+        return {"burning": bool(breached), "breached": breached}
+
+    def scaling_input(self) -> Optional[dict]:
+        """``Autoscaler(slo_provider=SLO.scaling_input)``: evaluate
+        (rate-limited) then return the posture; None while no objectives
+        are configured, so journaled ``fleet`` records stay unchanged
+        for deployments without an SLO plane."""
+        if not self.enabled:
+            return None
+        return self.evaluate()
+
+    # -- read APIs -----------------------------------------------------------
+
+    def _percentiles_locked(self, now: float) -> dict:
+        t_short = now - self.window_short_s
+        out: dict[str, dict] = {}
+        for cls, win in sorted(self._classes.items()):
+            rows = [r for r in win.journeys if r[_J_T] >= t_short]
+            if not rows:
+                continue
+            entry: dict = {"samples": len(rows)}
+            ok_n = sum(1 for r in rows if r[_J_OK])
+            entry["ok_frac"] = round(ok_n / len(rows), 4)
+            for metric, idx in _J_METRIC_IDX.items():
+                vals = sorted(
+                    r[idx] for r in rows if r[idx] is not None
+                )
+                if not vals:
+                    continue
+                entry[metric + "_ms"] = {
+                    "p50": round(_exact_quantile(vals, 0.5), 3),
+                    "p95": round(_exact_quantile(vals, 0.95), 3),
+                    "p99": round(_exact_quantile(vals, 0.99), 3),
+                }
+            out[cls] = entry
+        return out
+
+    def debug_state(self) -> dict:
+        """The /debug/slo payload (folds first)."""
+        now = time.monotonic()
+        with self._fold_lock:
+            if self.enabled:
+                self._fold_locked(now)
+            burn = self._burn_locked(now) if self.enabled else {}
+            pct = self._percentiles_locked(now)
+            breached = {
+                f"{cls}:{key}": dict(rec)
+                for (cls, key), rec in sorted(self._breached.items())
+            }
+            ex_horizon = now - self.window_long_s
+            exemplars = {}
+            for cls, win in sorted(self._classes.items()):
+                fresh = {
+                    k: win.fresh_exemplars(k, ex_horizon)
+                    for k in sorted(win.exemplars)
+                }
+                fresh = {k: v for k, v in fresh.items() if v}
+                if fresh:
+                    exemplars[cls] = fresh
+            recent = list(self._recent)[-16:]
+            folded = dict(self._folded)
+            pending = len(self._buf)
+        return {
+            "enabled": self.enabled,
+            "default_class": self.default_class,
+            "window_short_s": self.window_short_s,
+            "window_long_s": self.window_long_s,
+            "burn_threshold": self.burn_threshold,
+            "min_samples": self.min_samples,
+            "objectives": self.objectives_dict(),
+            "windows": pct,
+            "burn": burn,
+            "breached": breached,
+            "breaches": self.breaches,
+            "recoveries": self.recoveries,
+            "journal_records": self.journal_records,
+            "exemplars": exemplars,
+            "recent": recent,
+            "folded": folded,
+            "pending": pending,
+        }
+
+    # -- metrics export (LazyGauge refresher; scrape-time only) --------------
+
+    def _refresh_gauges(self) -> None:
+        # side-effect-free sibling of evaluate(): fold + compute only —
+        # journaling and hooks belong to the tick thread, never a scrape
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        with self._fold_lock:
+            self._fold_locked(now)
+            burn = self._burn_locked(now)
+            pct = self._percentiles_locked(now)
+            breached = set(self._breached)
+        lat: dict[tuple[str, ...], float] = {}
+        for cls, entry in pct.items():
+            for metric in LATENCY_METRICS:
+                q = entry.get(metric + "_ms")
+                if q:
+                    for qk, v in q.items():
+                        lat[(cls, metric, qk)] = v
+        burns: dict[tuple[str, ...], float] = {}
+        states: dict[tuple[str, ...], float] = {}
+        for cls, objs in burn.items():
+            for key, b in objs.items():
+                burns[(cls, key, "short")] = b["burn_short"]
+                burns[(cls, key, "long")] = b["burn_long"]
+                states[(cls, key)] = 1.0 if (cls, key) in breached else 0.0
+        # whole-dict swap per gauge (the PROFILER stance): a racing
+        # scrape sees either the old series set or the new one
+        SLO_LATENCY.replace(lat)
+        SLO_BURN.replace(burns)
+        SLO_BREACHED.replace(states)
+
+    # -- ticker --------------------------------------------------------------
+
+    def start_ticker(self, interval_s: float = 5.0) -> "SloPlane":
+        """Background evaluate loop for deployments where no autoscaler
+        tick drives :meth:`scaling_input` (``--fleet=router`` or a bare
+        replica).  Idempotent."""
+        if self._ticker is not None:
+            return self
+        self._ticker_stop.clear()
+
+        def loop():
+            while not self._ticker_stop.wait(max(0.2, interval_s)):
+                try:
+                    self.evaluate()
+                except Exception:
+                    pass  # alerting must never kill its own thread
+
+        self._ticker = threading.Thread(
+            target=loop, name="slo-ticker", daemon=True
+        )
+        self._ticker.start()
+        return self
+
+    def stop_ticker(self) -> None:
+        self._ticker_stop.set()
+        t, self._ticker = self._ticker, None
+        if t is not None:
+            t.join(timeout=2)
+
+
+def load_config_source(raw: str) -> dict:
+    """``--slo-config`` / ``TPU_SLO_CONFIG`` value → config dict: inline
+    JSON, or ``@path`` to a JSON file."""
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    spec = json.loads(raw)
+    if not isinstance(spec, dict):
+        raise ValueError("SLO config must be a JSON object")
+    return spec
+
+
+def configure_from_env() -> None:
+    """Apply ``TPU_SLO_CONFIG`` when set (JSON or @file) — subprocesses
+    (bench sections, check tools, replica pods) need no flag plumbing.
+    A malformed env config must not poison every import; the CLI
+    surfaces the parse error for the flag path."""
+    raw = os.environ.get("TPU_SLO_CONFIG", "")
+    if not raw:
+        return
+    try:
+        SLO.load_config(load_config_source(raw), journal=False)
+    except (ValueError, TypeError, OSError, json.JSONDecodeError):
+        pass
+
+
+SLO = SloPlane()
+configure_from_env()

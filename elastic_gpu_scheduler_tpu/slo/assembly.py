@@ -1,0 +1,275 @@
+"""Cross-process trace assembly: one request, every process's spans.
+
+Spans die in per-process ``/traces`` rings — the router's ``fleet.route``
+span lives in the scheduler/router process, the replica's
+``serve.request``/``engine.step`` spans in the serving pod, and the
+scheduler's placement spans in its own ring.  All of them share ONE W3C
+trace id (the traceparent chain PRs 1/7 built), so assembling a request
+end-to-end is a pull problem, not an instrumentation problem:
+:class:`TraceAssembler` pulls ``/traces?trace=<id>`` from every
+configured source, merges with the local tracer's ring, orders the spans
+causally (parents before children, siblings by start time) and keeps the
+result in a bounded LRU store — ``GET /debug/trace/<trace_id>`` then
+renders one journey across processes even after the origin rings
+recycled.
+
+SLO-breach integration: a breach record carries exemplar trace ids;
+:meth:`capture_async` pins those journeys by assembling them eagerly on
+the assembler's worker thread (never the scrape or breach-detection
+path), so the evidence for a p99 alert survives span pressure.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..tracing import TRACER
+
+__all__ = ["TraceAssembler"]
+
+
+def _pull_trace(
+    addr: tuple[str, int], trace_id: str, timeout_s: float
+) -> list[dict]:
+    """GET /traces?trace=<id> from one source — the same 3-line raw
+    exchange the router's health probe uses (dependency-free, obvious
+    timeout semantics)."""
+    with socket.create_connection(addr, timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        s.sendall(
+            f"GET /traces?trace={trace_id} HTTP/1.1\r\n"
+            f"Host: {addr[0]}\r\nConnection: close\r\n\r\n".encode()
+        )
+        buf = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+    head, _, body = buf.partition(b"\r\n\r\n")
+    try:
+        status = int(head.split(b" ", 2)[1])
+    except (IndexError, ValueError):
+        raise ConnectionError("malformed status line")
+    if status != 200:
+        raise ConnectionError(f"/traces answered {status}")
+    payload = json.loads(body)
+    spans = payload.get("spans")
+    return spans if isinstance(spans, list) else []
+
+
+def causal_order(spans: list[dict]) -> list[dict]:
+    """Parents before children, siblings by start time.  Spans whose
+    parent is outside the collected set (a remote parent the pull
+    missed) rank as roots by their own start time — the order degrades
+    to start-time sorting, never drops a span."""
+    by_id = {s.get("span_id"): s for s in spans if s.get("span_id")}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        parent = s.get("parent_id") or ""
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    key = lambda s: (s.get("start_unix") or 0.0, s.get("span_id") or "")
+    out: list[dict] = []
+    stack = sorted(roots, key=key, reverse=True)
+    seen: set = set()
+    while stack:
+        s = stack.pop()
+        sid = s.get("span_id")
+        if sid in seen:
+            continue  # defensive: duplicate ids must not loop
+        seen.add(sid)
+        out.append(s)
+        stack.extend(
+            sorted(children.get(sid, ()), key=key, reverse=True)
+        )
+    return out
+
+
+def local_trace_payload(trace_id: str, tracer=None) -> dict:
+    """The assembler-less ``/debug/trace/<id>`` answer: THIS process's
+    spans only, causally ordered, in the same shape ``assemble()``
+    returns — every server's fallback shares this one construction so
+    consumers can read ``sources``/``processes`` regardless of which
+    port answered."""
+    tracer = tracer if tracer is not None else TRACER
+    spans = causal_order(tracer.trace(trace_id))
+    for s in spans:
+        s.setdefault("source", "local")
+    return {
+        "trace_id": trace_id,
+        "spans": spans,
+        "span_count": len(spans),
+        "sources": ["local"] if spans else [],
+        "processes": 1 if spans else 0,
+    }
+
+
+class TraceAssembler:
+    """Bounded fleet-wide trace store fed by on-demand pulls.
+
+    ``sources``: callable returning ``[(name, (host, port)), ...]`` —
+    the CLI wires the router's live replica set here, so the pull list
+    tracks scale-ups/downs; extra static sources (another scheduler)
+    can ride the same list.  The local tracer is always a source
+    (name ``local``)."""
+
+    def __init__(
+        self,
+        sources=None,
+        tracer=None,
+        cap: int = 256,
+        pull_timeout_s: float = 2.0,
+    ):
+        self.sources = sources or (lambda: [])
+        self.tracer = tracer if tracer is not None else TRACER
+        self.cap = max(8, int(cap))
+        self.pull_timeout_s = pull_timeout_s
+        self._lock = threading.Lock()
+        self._store: "OrderedDict[str, dict]" = OrderedDict()
+        self.assemblies = 0
+        self.pulls = 0
+        self.pull_errors = 0
+        self.captured = 0  # breach-exemplar eager captures
+        self._q: "queue.Queue" = queue.Queue(maxsize=64)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- assembly ------------------------------------------------------------
+
+    def assemble(self, trace_id: str, refresh: bool = True) -> dict:
+        """Merge local + every source's spans for ``trace_id`` into one
+        causally-ordered record.  ``refresh=False`` serves the cached
+        assembly when present (the exemplar-capture path pinned it);
+        otherwise sources are re-pulled and merged INTO any cached spans
+        — a replica whose ring already evicted the trace cannot erase
+        spans an earlier assembly saved."""
+        if not refresh:
+            with self._lock:
+                cached = self._store.get(trace_id)
+                if cached is not None:
+                    self._store.move_to_end(trace_id)
+                    return cached
+        merged: dict[str, dict] = {}
+        with self._lock:
+            prev = self._store.get(trace_id)
+            if prev is not None:
+                for s in prev["spans"]:
+                    merged[s.get("span_id")] = s
+        for s in self.tracer.trace(trace_id):
+            s.setdefault("source", "local")
+            merged[s.get("span_id")] = s
+        errors: dict[str, str] = {}
+        for name, addr in list(self.sources() or []):
+            self.pulls += 1
+            try:
+                for s in _pull_trace(
+                    tuple(addr), trace_id, self.pull_timeout_s
+                ):
+                    s.setdefault("source", name)
+                    # first writer wins: a span already captured (e.g.
+                    # by the local tracer for an in-process source)
+                    # keeps its original source tag
+                    merged.setdefault(s.get("span_id"), s)
+            except (OSError, ConnectionError, ValueError) as e:
+                self.pull_errors += 1
+                errors[name] = str(e)
+        spans = causal_order(list(merged.values()))
+        record = {
+            "trace_id": trace_id,
+            "spans": spans,
+            "span_count": len(spans),
+            "sources": sorted({
+                s.get("source", "local") for s in spans
+            }),
+            "processes": len({s.get("source", "local") for s in spans}),
+            "assembled_unix": round(time.time(), 3),
+            "pull_errors": errors,
+        }
+        with self._lock:
+            self._store[trace_id] = record
+            self._store.move_to_end(trace_id)
+            while len(self._store) > self.cap:
+                self._store.popitem(last=False)
+        self.assemblies += 1
+        return record
+
+    # -- breach-exemplar capture ---------------------------------------------
+
+    def capture_async(self, trace_ids: list) -> None:
+        """Queue exemplar trace ids for eager assembly on the worker
+        thread (breach hooks run on the evaluate tick; the HTTP pulls
+        must not stall it).  A full queue drops the capture — the trace
+        may still assemble on demand while the rings hold it."""
+        self._ensure_worker()
+        for tid in trace_ids or []:
+            if not tid:
+                continue
+            try:
+                self._q.put_nowait(tid)
+            except queue.Full:
+                break
+
+    def on_breach(self, rec: dict) -> None:
+        """``SLO.breach_hooks`` shape: capture the breach's exemplars."""
+        self.capture_async(rec.get("exemplars") or [])
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    tid = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                try:
+                    self.assemble(tid)
+                    self.captured += 1
+                except Exception:
+                    pass  # capture is best-effort evidence pinning
+
+        self._worker = threading.Thread(
+            target=loop, name="trace-assembler", daemon=True
+        )
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._worker = self._worker, None
+        if t is not None:
+            t.join(timeout=2)
+
+    # -- introspection -------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            stored = [
+                {
+                    "trace_id": tid,
+                    "spans": rec["span_count"],
+                    "processes": rec["processes"],
+                    "assembled_unix": rec["assembled_unix"],
+                }
+                for tid, rec in self._store.items()
+            ]
+        return {
+            "stored": len(stored),
+            "cap": self.cap,
+            "assemblies": self.assemblies,
+            "pulls": self.pulls,
+            "pull_errors": self.pull_errors,
+            "captured": self.captured,
+            "traces": stored[-16:],
+        }

@@ -8,9 +8,15 @@ outcomes were previously visible only as aggregate histograms
 - **Spans.**  A thread-safe ring-buffer tracer with W3C-style trace/span
   ids, wall + monotonic timestamps and structured attributes.  Finished
   spans land in a bounded deque (old traces evict FIFO — a long-lived
-  scheduler never grows without limit); export is Chrome trace-event
-  JSON (open in Perfetto) or a per-trace JSON tree, both served by
-  ``/traces`` (server/routes.py).
+  scheduler never grows without limit), EXCEPT spans of **pinned**
+  traces: a trace with an open pod root (and any trace explicitly
+  pinned via :meth:`Tracer.pin`, e.g. a long-lived SSE stream) parks
+  its finished spans in a separate bounded store so span pressure can
+  no longer evict a live request's history mid-flight; pinned-overflow
+  evictions are counted in ``tpu_metrics_dropped_samples_total``
+  (reason ``trace_pin_cap``), never silent.  Export is Chrome
+  trace-event JSON (open in Perfetto) or a per-trace JSON tree, both
+  served by ``/traces`` (server/routes.py).
 
 - **Pod-scoped traces.**  kube-scheduler's verbs arrive as independent
   HTTP requests with no trace headers, so the tracer keeps a bounded
@@ -287,7 +293,7 @@ class Tracer:
     pod per scheduling attempt)."""
 
     def __init__(self, capacity: int = 4096, sample: Optional[float] = None,
-                 pod_capacity: int = 2048):
+                 pod_capacity: int = 2048, pinned_capacity: int = 4096):
         if sample is None:
             try:
                 sample = float(os.environ.get("TPU_TRACE_SAMPLE", "1"))
@@ -296,6 +302,7 @@ class Tracer:
         self.sample = max(0.0, min(1.0, sample))
         self.capacity = capacity
         self.pod_capacity = pod_capacity
+        self.pinned_capacity = pinned_capacity
         self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._tls = threading.local()
@@ -303,6 +310,18 @@ class Tracer:
         # force-closed so it still shows up in the ring)
         self._pod_roots: "OrderedDict[str, Span]" = OrderedDict()
         self.dropped = 0  # spans evicted from the ring (telemetry)
+        # pinned traces: trace_id → pin count.  A pinned trace's
+        # finished spans park in _pinned_spans instead of the FIFO ring,
+        # so span pressure cannot drop a LIVE request's history
+        # mid-flight (open pod roots pin automatically; long streams pin
+        # explicitly).  Bounded by pinned_capacity across all traces —
+        # overflow evicts the oldest pinned span and COUNTS it
+        # (tpu_metrics_dropped_samples_total{reason="trace_pin_cap"}).
+        self._pinned: dict[str, int] = {}
+        self._pinned_spans: dict[str, list] = {}
+        self._pin_ring: deque = deque()  # append-order trace_id tokens
+        self._pin_count = 0
+        self.dropped_pinned = 0  # pinned-overflow evictions (telemetry)
 
     # -- config --------------------------------------------------------------
 
@@ -321,6 +340,11 @@ class Tracer:
             self._spans.clear()
             self._pod_roots.clear()
             self.dropped = 0
+            self._pinned.clear()
+            self._pinned_spans.clear()
+            self._pin_ring.clear()
+            self._pin_count = 0
+            self.dropped_pinned = 0
 
     # -- span lifecycle ------------------------------------------------------
 
@@ -373,10 +397,84 @@ class Tracer:
         return None
 
     def _finish(self, span: Span) -> None:
+        overflowed = 0
         with self._lock:
-            if len(self._spans) == self._spans.maxlen:
-                self.dropped += 1
-            self._spans.append(span)
+            if span.trace_id in self._pinned:
+                # pinned trace: park the span where FIFO pressure from
+                # OTHER traces cannot evict it while the request lives
+                self._pinned_spans.setdefault(span.trace_id, []).append(
+                    span
+                )
+                self._pin_ring.append(span.trace_id)
+                self._pin_count += 1
+                while self._pin_count > self.pinned_capacity:
+                    tid = self._pin_ring.popleft()
+                    lst = self._pinned_spans.get(tid)
+                    if not lst:
+                        continue  # stale token (trace already unpinned)
+                    lst.pop(0)
+                    if not lst:
+                        self._pinned_spans.pop(tid, None)
+                    self._pin_count -= 1
+                    self.dropped_pinned += 1
+                    overflowed += 1
+            else:
+                if len(self._spans) == self._spans.maxlen:
+                    self.dropped += 1
+                self._spans.append(span)
+        if overflowed:
+            # even pinned storage is bounded; the overflow is COUNTED
+            # (never silently discard samples).  Lazy import: tracing
+            # stays importable without the metrics module loaded first.
+            from ..metrics import METRICS_DROPPED
+
+            METRICS_DROPPED.inc("trace_pin_cap", value=float(overflowed))
+
+    # -- trace pinning -------------------------------------------------------
+
+    def pin(self, trace_id: str) -> None:
+        """Protect ``trace_id``'s finished spans from FIFO eviction
+        until :meth:`unpin`.  Counted (nested pins are legal: the pod
+        registry and an SSE handler may pin the same trace)."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._pinned[trace_id] = self._pinned.get(trace_id, 0) + 1
+
+    def unpin(self, trace_id: str) -> None:
+        """Release one pin; at zero the trace's parked spans rejoin the
+        ordinary ring (subject to its normal FIFO bound)."""
+        if not trace_id:
+            return
+        with self._lock:
+            n = self._pinned.get(trace_id, 0) - 1
+            if n > 0:
+                self._pinned[trace_id] = n
+                return
+            self._pinned.pop(trace_id, None)
+            released = self._pinned_spans.pop(trace_id, None)
+            if released:
+                self._pin_count -= len(released)
+                # purge this trace's ring tokens NOW: leaving them would
+                # grow the ring one stale token per released span forever
+                # (the overflow loop only runs past pinned_capacity), and
+                # a later RE-pin of the same trace id would let a stale
+                # token evict one of the new trace's spans prematurely.
+                # O(ring) per trace close; the purge keeps the ring
+                # bounded by _pin_count, so the scan itself stays small.
+                self._pin_ring = deque(
+                    t for t in self._pin_ring if t != trace_id
+                )
+                for sp in released:
+                    if len(self._spans) == self._spans.maxlen:
+                        self.dropped += 1
+                    self._spans.append(sp)
+
+    def pinned_spans(self) -> list:
+        with self._lock:
+            return [
+                sp for lst in self._pinned_spans.values() for sp in lst
+            ]
 
     # thread-local active-span stack (context-manager protocol only)
 
@@ -443,10 +541,19 @@ class Tracer:
             if cur is not None:  # lost the creation race
                 return cur
             self._pod_roots[pod_key] = sp
+            if isinstance(sp, Span):
+                # an OPEN pod trace pins itself: its already-finished
+                # verb spans must survive span pressure until bind (or
+                # registry eviction) closes the trace
+                self._pinned[sp.trace_id] = (
+                    self._pinned.get(sp.trace_id, 0) + 1
+                )
             if len(self._pod_roots) > self.pod_capacity:
                 _, evicted = self._pod_roots.popitem(last=False)
         if evicted is not None:
             evicted.end(status="evicted")
+            if isinstance(evicted, Span):
+                self.unpin(evicted.trace_id)
         return sp
 
     def pod_context(self, pod_key: str) -> Optional[SpanContext]:
@@ -466,12 +573,17 @@ class Tracer:
             sp = self._pod_roots.pop(pod_key, None)
         if sp is not None:
             sp.end(status=status)
+            if isinstance(sp, Span):
+                self.unpin(sp.trace_id)
 
     # -- export --------------------------------------------------------------
 
     def finished(self) -> list:
         with self._lock:
-            return list(self._spans)
+            out = list(self._spans)
+            for lst in self._pinned_spans.values():
+                out.extend(lst)
+            return out
 
     def open_pod_roots(self) -> list:
         with self._lock:
@@ -568,6 +680,10 @@ class Tracer:
                 "capacity": self.capacity,
                 "open_pod_traces": len(self._pod_roots),
                 "dropped_spans": self.dropped,
+                "pinned_traces": len(self._pinned),
+                "pinned_spans": self._pin_count,
+                "pinned_capacity": self.pinned_capacity,
+                "dropped_pinned_spans": self.dropped_pinned,
             }
 
 

@@ -1,0 +1,753 @@
+"""Fleet-wide SLO plane (slo/): objective parsing, sliding-window burn
+rate, breach/recovery journaling with exemplar trace ids, the
+autoscaler's SLO-proactive input (journaled + replayed), cross-process
+trace assembly in causal order, and the router's request-journey
+recording.
+
+Smoke tier: no jax — replicas are stdlib HTTP fakes speaking the
+/v1/completions (SSE) + /traces surface the real servers expose."""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.fleet import (
+    Autoscaler,
+    FleetRouter,
+    PolicyEngine,
+    Replica,
+    ReplicaSet,
+    ScalingPolicy,
+    score_policy,
+)
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal
+from elastic_gpu_scheduler_tpu.journal.replay import replay, what_if
+from elastic_gpu_scheduler_tpu.slo import (
+    SLO,
+    SloObjective,
+    SloPlane,
+    parse_objectives,
+)
+from elastic_gpu_scheduler_tpu.slo.assembly import (
+    TraceAssembler,
+    causal_order,
+)
+from elastic_gpu_scheduler_tpu.tracing import TRACER
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    TRACER.reset()
+    SLO.reset()
+    yield
+    SLO.reset()
+    TRACER.reset()
+    if JOURNAL.enabled:
+        JOURNAL.close()
+
+
+def plane(classes=None, **kw):
+    p = SloPlane()
+    spec = {
+        "classes": classes or {
+            "serve": {"ttft_p95_ms": 100, "availability": 0.9},
+        },
+    }
+    spec.update(kw)
+    p.load_config(spec, journal=False)
+    return p
+
+
+# -- objectives & burn math -------------------------------------------------
+
+
+def test_objective_parsing():
+    objs = parse_objectives({
+        "ttft_p95_ms": 200, "e2e_p99_ms": 2000, "availability": 0.99,
+    })
+    by_key = {o.key: o for o in objs}
+    assert by_key["ttft_p95_ms"].metric == "ttft"
+    assert by_key["ttft_p95_ms"].target == 0.95
+    assert by_key["ttft_p95_ms"].threshold_ms == 200
+    assert by_key["e2e_p99_ms"].target == 0.99
+    assert by_key["availability"].threshold_ms is None
+    assert abs(by_key["availability"].budget - 0.01) < 1e-9
+
+
+@pytest.mark.parametrize("bad", [
+    {"ttft_p95": 200},              # malformed key
+    {"latency_p95_ms": 200},        # unknown metric
+    {"availability": 1.0},          # zero error budget
+    {"ttft_p95_ms": 0},             # non-positive threshold
+    {},                             # no objectives at all
+])
+def test_objective_parsing_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_objectives(bad)
+
+
+def test_bad_config_installs_nothing():
+    p = SloPlane()
+    with pytest.raises(ValueError):
+        p.load_config({"classes": {"a": {"nope_p95_ms": 1}}},
+                      journal=False)
+    assert not p.enabled
+
+
+def test_burn_rate_math():
+    # availability target 0.9 → budget 0.1; half the journeys failing
+    # burns at 0.5/0.1 = 5x sustainable
+    p = plane(classes={"serve": {"availability": 0.9}})
+    for i in range(10):
+        p.record_journey(wclass="serve", ok=(i % 2 == 0), e2e_ms=1.0)
+    state = p.debug_state()
+    b = state["burn"]["serve"]["availability"]
+    assert b["total_short"] == 10
+    assert b["bad_short"] == 5
+    assert abs(b["burn_short"] - 5.0) < 1e-6
+
+
+def test_journeys_without_metric_do_not_count():
+    # a journey with no TTFT (blocking completion) must not count for
+    # or against a TTFT objective
+    p = plane(classes={"serve": {"ttft_p95_ms": 100}})
+    for _ in range(4):
+        p.record_journey(wclass="serve", ok=True, e2e_ms=50.0)
+    p.record_journey(wclass="serve", ok=True, ttft_ms=50.0, e2e_ms=60.0)
+    b = p.debug_state()["burn"]["serve"]["ttft_p95_ms"]
+    assert b["total_short"] == 1
+    assert b["bad_short"] == 0
+
+
+def test_percentile_windows():
+    p = plane()
+    for i in range(100):
+        p.record_journey(
+            wclass="serve", ok=True, ttft_ms=float(i + 1), e2e_ms=10.0,
+        )
+    w = p.debug_state()["windows"]["serve"]
+    assert w["samples"] == 100
+    assert w["ttft_ms"]["p50"] == 50.0
+    assert w["ttft_ms"]["p99"] == 99.0
+
+
+def test_hot_path_disabled_is_one_check():
+    p = SloPlane()
+    assert p.record_journey(wclass="x", ok=True) is False
+    assert p._buf == []
+
+
+def test_buffer_cap_counts_drops():
+    p = plane()
+    p._cap = 100
+    for i in range(250):
+        p.record_journey(wclass="serve", ok=True, e2e_ms=1.0)
+    # trims happened and were counted, never silent
+    state = p.debug_state()
+    assert state["folded"]["router"] + state["pending"] < 250
+
+
+def test_fractional_percentile_key_preserved():
+    # the declared spelling is the objective's identity: p99.5 must not
+    # silently rename to p100 in journal records / metric labels
+    objs = parse_objectives({"e2e_p99.5_ms": 3000})
+    assert objs[0].key == "e2e_p99.5_ms"
+    assert abs(objs[0].target - 0.995) < 1e-9
+    p = plane(classes={"serve": {"e2e_p99.5_ms": 3000}})
+    assert "e2e_p99.5_ms" in p.debug_state()["burn"]["serve"]
+
+
+def test_null_config_values_are_value_errors():
+    # float(None) is a TypeError — it must surface as the one error
+    # type every config handler catches, never a crash (and a bad env
+    # config must not poison import: configure_from_env catches it)
+    with pytest.raises(ValueError):
+        parse_objectives({"availability": None})
+    with pytest.raises(ValueError):
+        parse_objectives({"ttft_p95_ms": [200]})
+    p = SloPlane()
+    with pytest.raises(ValueError):
+        p.load_config({"classes": {"a": {"e2e_p99_ms": 50}},
+                       "window_short_s": None}, journal=False)
+    assert not p.enabled
+
+
+def test_undeclared_class_collapses_to_default():
+    # the class name arrives from the CLIENT's body: undeclared values
+    # must not mint per-class state (or tpu_slo_* label cardinality)
+    p = plane(classes={"default": {"availability": 0.5}})
+    for i in range(50):
+        p.record_journey(wclass=f"attacker-{i}", ok=True, e2e_ms=1.0)
+    state = p.debug_state()
+    assert list(state["windows"]) == ["default"]
+    assert state["windows"]["default"]["samples"] == 50
+    with p._fold_lock:
+        assert set(p._classes) == {"default"}
+
+
+def test_breach_exemplars_exclude_stale_blips(tmp_path):
+    # a violation blip long outside the burn windows must not be cited
+    # as evidence when a LATER breach fires — its spans are long gone
+    # and the alert would point at the wrong requests
+    p = plane(window_short_s=0.2, window_long_s=0.4, min_samples=2)
+    for i in range(3):
+        p.record_journey(wclass="serve", ok=False, e2e_ms=1.0,
+                         trace_id=f"stale-{i}")
+    p.debug_state()  # fold the blip (below nothing — just recorded)
+    time.sleep(0.6)  # the blip ages out of both windows
+    seen = []
+    p.breach_hooks.append(lambda rec: seen.extend(rec["exemplars"]))
+    for i in range(5):
+        p.record_journey(wclass="serve", ok=False, e2e_ms=1.0,
+                         trace_id=f"fresh-{i}")
+    p.evaluate(force=True)
+    assert seen and all(t.startswith("fresh-") for t in seen)
+    state = p.debug_state()
+    for by_obj in state["exemplars"].values():
+        for ids in by_obj.values():
+            assert all(t.startswith("fresh-") for t in ids)
+
+
+def test_long_window_burn_survives_raw_cap():
+    # burn must NOT read the count-capped raw deque: at high traffic
+    # the cap used to truncate the long window below the short one,
+    # collapsing multi-window alerting into single-window paging.  A
+    # flood of GOOD journeys past the cap must keep diluting the long
+    # window even after the raw deque forgot them.
+    p = plane(classes={"serve": {"availability": 0.9}})
+    p._window_cap = 64  # tiny raw cap; bucketed counters don't care
+    with p._fold_lock:
+        p._classes.clear()
+    for i in range(1000):
+        p.record_journey(wclass="serve", ok=True, e2e_ms=1.0)
+    for i in range(20):  # recent blip, well past the raw cap
+        p.record_journey(wclass="serve", ok=False, e2e_ms=1.0)
+    b = p.debug_state()["burn"]["serve"]["availability"]
+    assert b["total_long"] == 1020  # every journey still counted
+    assert b["bad_long"] == 20
+    # long burn stays diluted (~0.196) — nowhere near the short-window
+    # figure a truncated deque (64 rows: 44 good + 20 bad) would show
+    assert b["burn_long"] < 0.25
+
+
+# -- breach / recovery + journal --------------------------------------------
+
+
+def test_breach_journals_with_exemplars(tmp_path):
+    JOURNAL.configure(str(tmp_path / "j"))
+    p = plane(window_short_s=0.3, window_long_s=0.9, min_samples=3)
+    for i in range(8):
+        p.record_journey(
+            wclass="serve", ok=True, ttft_ms=500.0, e2e_ms=600.0,
+            trace_id=f"trace-{i}",
+        )
+    posture = p.evaluate(force=True)
+    assert posture["burning"] is True
+    assert p.breaches == 1
+    # a second evaluate must not re-journal the same breach
+    p.evaluate(force=True)
+    assert p.breaches == 1
+    JOURNAL.flush()
+    events = read_journal(JOURNAL.dir)
+    slo_recs = [r for r in events if r.get("type") == "slo"]
+    assert len(slo_recs) == 1
+    rec = slo_recs[0]
+    assert rec["action"] == "breach"
+    assert rec["wclass"] == "serve"
+    assert rec["objective"] == "ttft_p95_ms"
+    assert rec["burn_short"] >= p.burn_threshold
+    assert "trace-7" in rec["exemplars"]
+    # recovery: wait out the long window, then enough good journeys
+    time.sleep(1.0)
+    for _ in range(8):
+        p.record_journey(wclass="serve", ok=True, ttft_ms=5.0,
+                         e2e_ms=10.0)
+    posture = p.evaluate(force=True)
+    assert posture["burning"] is False
+    assert p.recoveries == 1
+    JOURNAL.flush()
+    events = read_journal(JOURNAL.dir)
+    actions = [r["action"] for r in events if r.get("type") == "slo"]
+    assert actions == ["breach", "recover"]
+    # replay accepts slo annotations: counted, zero violations, breach
+    # exemplars reconstructed
+    res = replay(events)
+    assert res.violations == []
+    assert res.slo_records == 2
+    assert res.slo_breaches == 1
+    assert "trace-7" in res.last_slo_breach["exemplars"]
+    # what_if explicitly skips them
+    from elastic_gpu_scheduler_tpu.core.rater import Binpack
+
+    wi = what_if(events, Binpack())
+    assert wi["binds"] == 0
+
+
+def test_breach_hook_fires_once_per_breach():
+    p = plane(window_short_s=0.3, window_long_s=0.9, min_samples=2)
+    seen = []
+    p.breach_hooks.append(lambda rec: seen.append(rec["objective"]))
+    for i in range(5):
+        p.record_journey(wclass="serve", ok=False, e2e_ms=1.0,
+                         trace_id=f"t{i}")
+    p.evaluate(force=True)
+    p.evaluate(force=True)
+    assert seen == ["availability"]
+
+
+def test_objectives_load_journaled(tmp_path):
+    JOURNAL.configure(str(tmp_path / "j"))
+    p = SloPlane()
+    p.load_config({"classes": {"a": {"e2e_p99_ms": 50}}})
+    JOURNAL.flush()
+    events = read_journal(JOURNAL.dir)
+    recs = [r for r in events if r.get("type") == "slo"]
+    assert recs and recs[0]["action"] == "objectives"
+    assert replay(events).violations == []
+
+
+# -- autoscaler SLO input ---------------------------------------------------
+
+
+def _idle_signals():
+    return {"queue_per_replica": 0.0, "occupancy": 0.0, "page_util": 0.0}
+
+
+def test_policy_engine_scales_up_on_burn():
+    eng = PolicyEngine(ScalingPolicy(min_replicas=1, max_replicas=4,
+                                     hysteresis_rounds=2))
+    burn = {"burning": True, "breached": [
+        {"wclass": "serve", "objective": "ttft_p95_ms",
+         "burn_short": 3.0, "burn_long": 2.0},
+    ]}
+    a1, _ = eng.evaluate(_idle_signals(), 2, 100.0, slo=burn)
+    assert a1 == "hold"  # hysteresis round 1
+    a2, reason = eng.evaluate(_idle_signals(), 2, 101.0, slo=burn)
+    assert a2 == "up"
+    assert "slo burn serve:ttft_p95_ms" in reason
+
+
+def test_policy_engine_burn_vetoes_scale_down():
+    eng = PolicyEngine(ScalingPolicy(min_replicas=1, max_replicas=4,
+                                     hysteresis_rounds=1,
+                                     down_cooldown_s=0.0))
+    burn = {"burning": True, "breached": []}
+    # idle signals would scale down — unless the budget is burning
+    a, _ = eng.evaluate(_idle_signals(), 2, 100.0, slo=burn)
+    assert a != "down"
+    eng2 = PolicyEngine(ScalingPolicy(min_replicas=1, max_replicas=4,
+                                      hysteresis_rounds=1,
+                                      down_cooldown_s=0.0))
+    a2, _ = eng2.evaluate(_idle_signals(), 2, 100.0, slo=None)
+    assert a2 == "down"  # the historic behavior without an SLO plane
+
+
+def test_autoscaler_journals_slo_posture(tmp_path):
+    JOURNAL.configure(str(tmp_path / "j"))
+    rs = ReplicaSet(interval_s=60.0)
+    rs.add(Replica("r0", "127.0.0.1", 1))
+    posture = {"burning": True, "breached": [
+        {"wclass": "serve", "objective": "e2e_p99_ms",
+         "burn_short": 2.5, "burn_long": 1.5},
+    ]}
+    scaler = Autoscaler(
+        rs, executor=None,
+        policy=ScalingPolicy(hysteresis_rounds=1),
+        slo_provider=lambda: posture,
+    )
+    rec = scaler.tick(now=100.0)
+    assert rec["slo"] == posture
+    assert rec["action"] == "up"  # advisory (no executor) but decided
+    JOURNAL.flush()
+    events = read_journal(JOURNAL.dir)
+    fleet = [r for r in events if r.get("type") == "fleet"]
+    assert fleet and fleet[0]["slo"] == posture
+    assert replay(events).violations == []
+    # score_policy replays candidates against the same burn history:
+    # a same-shaped candidate agrees on the slo-driven up
+    rpt = score_policy(fleet, ScalingPolicy(name="cand",
+                                            hysteresis_rounds=1))
+    assert rpt["evaluations"] == 1
+    assert rpt["agreement_pct"] == 100.0
+    assert rpt["candidate_decisions"]["up"] == 1
+
+
+def test_autoscaler_slo_provider_failure_degrades():
+    rs = ReplicaSet(interval_s=60.0)
+    rs.add(Replica("r0", "127.0.0.1", 1))
+
+    def boom():
+        raise RuntimeError("slo plane down")
+
+    scaler = Autoscaler(rs, executor=None,
+                        policy=ScalingPolicy(hysteresis_rounds=1),
+                        slo_provider=boom)
+    rec = scaler.tick(now=100.0)
+    assert rec["slo"] is None  # degraded to the historic behavior
+
+
+# -- cross-process trace assembly -------------------------------------------
+
+
+class FakeTraceSource:
+    """Stdlib stand-in for a replica's /traces endpoint."""
+
+    def __init__(self, name, spans_by_trace):
+        self.name = name
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                tid = ""
+                for part in query.split("&"):
+                    if part.startswith("trace="):
+                        tid = part[len("trace="):]
+                data = json.dumps({
+                    "trace_id": tid,
+                    "spans": outer.spans_by_trace.get(tid, []),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.spans_by_trace = spans_by_trace
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _span(tid, sid, parent, name, start, source=None):
+    s = {
+        "trace_id": tid, "span_id": sid, "parent_id": parent,
+        "name": name, "start_unix": start, "duration_ms": 1.0,
+        "status": "ok", "attrs": {}, "events": [],
+    }
+    if source:
+        s["source"] = source
+    return s
+
+
+def test_causal_order_parents_before_children():
+    tid = "t" * 32
+    spans = [
+        _span(tid, "c2", "c1", "engine.step", 3.0),
+        _span(tid, "r1", "", "fleet.route", 1.0),
+        _span(tid, "c1", "r1", "serve.request", 2.0),
+        _span(tid, "c3", "c1", "engine.step", 2.5),
+    ]
+    ordered = causal_order(spans)
+    names = [s["span_id"] for s in ordered]
+    assert names.index("r1") < names.index("c1")
+    assert names.index("c1") < names.index("c3") < names.index("c2")
+
+
+def test_assembly_merges_processes_in_causal_order():
+    # the "router" span lives in the LOCAL tracer; replica + engine
+    # spans live on a fake remote /traces — one trace id end-to-end
+    sp = TRACER.span("fleet.route", path="/v1/completions")
+    tid = sp.trace_id
+    route_sid = sp.span_id
+    sp.end()
+    remote = FakeTraceSource("rep-0", {
+        tid: [
+            _span(tid, "bb", "aa", "engine.step", time.time() + 0.2),
+            _span(tid, "aa", route_sid, "serve.request",
+                  time.time() + 0.1),
+        ],
+    })
+    try:
+        asm = TraceAssembler(
+            sources=lambda: [("rep-0", ("127.0.0.1", remote.port))],
+        )
+        rec = asm.assemble(tid)
+        assert rec["span_count"] == 3
+        assert rec["processes"] >= 2
+        assert set(rec["sources"]) == {"local", "rep-0"}
+        order = [s["span_id"] for s in rec["spans"]]
+        assert order.index(route_sid) < order.index("aa") < order.index("bb")
+        # cached assembly survives the remote ring evicting the trace
+        remote.spans_by_trace.clear()
+        rec2 = asm.assemble(tid, refresh=False)
+        assert rec2["span_count"] == 3
+        # a refresh merges INTO the cache — the evicted remote cannot
+        # erase spans an earlier assembly saved
+        rec3 = asm.assemble(tid)
+        assert rec3["span_count"] == 3
+    finally:
+        remote.stop()
+
+
+def test_assembly_survives_dead_source():
+    sp = TRACER.span("fleet.route")
+    tid = sp.trace_id
+    sp.end()
+    asm = TraceAssembler(
+        sources=lambda: [("gone", ("127.0.0.1", 1))],  # nothing listens
+        pull_timeout_s=0.2,
+    )
+    rec = asm.assemble(tid)
+    assert rec["span_count"] == 1
+    assert "gone" in rec["pull_errors"]
+    assert asm.pull_errors == 1
+
+
+def test_breach_capture_pins_exemplar(tmp_path):
+    sp = TRACER.span("fleet.route")
+    tid = sp.trace_id
+    sp.end()
+    asm = TraceAssembler(sources=lambda: [])
+    asm.on_breach({"exemplars": [tid]})
+    deadline = time.monotonic() + 5.0
+    while asm.captured < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert asm.captured == 1
+    assert asm.assemble(tid, refresh=False)["span_count"] == 1
+    asm.stop()
+
+
+# -- router request journeys ------------------------------------------------
+
+
+class FakeSSEReplica:
+    """Minimal /v1/completions SSE backend: emits the queue-wait SLO
+    comment, then one token per prompt id, then [DONE] — the wire shape
+    server/inference.py streams."""
+
+    def __init__(self, name, queue_ms=7.5, fail=False):
+        self.name = name
+        self.queue_ms = queue_ms
+        self.fail = fail
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                data = (
+                    json.dumps({"ok": True}).encode()
+                    if self.path == "/healthz"
+                    else json.dumps({
+                        "queued": 0, "active_slots": 0, "max_batch": 8,
+                        "page_size": 4, "replica": outer.name,
+                    }).encode()
+                )
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if outer.fail:
+                    data = b'{"error": "boom"}'
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                toks = body.get("prompt", [])[:3]
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                meta = (
+                    f': slo {{"queue_ms": {outer.queue_ms}}}\n\n'
+                ).encode()
+                self.wfile.write(b"%x\r\n%b\r\n" % (len(meta), meta))
+                payload = b"".join(
+                    b"data: %b\n\n" % json.dumps({"token": t}).encode()
+                    for t in toks
+                ) + b"data: [DONE]\n\n"
+                self.wfile.write(
+                    b"%x\r\n%b\r\n0\r\n\r\n" % (len(payload), payload)
+                )
+                self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def replica(self):
+        return Replica(self.name, "127.0.0.1", self.port)
+
+
+def _route_once(router, body):
+    """Drive handle_completion with a socketpair standing in for the
+    client connection; returns the bytes the 'client' received."""
+    a, b = socket.socketpair()
+    try:
+        out = router.handle_completion(
+            "POST", "/v1/completions", json.dumps(body).encode(), "", a,
+        )
+        a.shutdown(socket.SHUT_WR)
+        buf = bytearray()
+        b.settimeout(2.0)
+        try:
+            while True:
+                chunk = b.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        except (TimeoutError, OSError):
+            pass
+        return out, bytes(buf)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_router_records_journey():
+    SLO.load_config(
+        {"classes": {"default": {"ttft_p95_ms": 5000,
+                                 "availability": 0.5}}},
+        journal=False,
+    )
+    srv = FakeSSEReplica("rep-0")
+    rs = ReplicaSet(interval_s=60.0)
+    rs.add(srv.replica())
+    rs.refresh()
+    router = FleetRouter(rs, port=0, page_size=4)
+    try:
+        out, raw = _route_once(
+            router, {"prompt": [1, 2, 3, 4], "stream": True},
+        )
+        assert out is None  # relayed
+        assert raw.count(b"data:") == 4  # 3 tokens + [DONE]
+        state = SLO.debug_state()
+        assert state["folded"]["router"] == 1
+        j = state["recent"][-1]
+        assert j["vantage"] == "router"
+        assert j["ok"] is True
+        assert j["replica"] == "rep-0"
+        assert j["tokens"] == 3
+        assert j["queue_ms"] == 7.5  # parsed from the SSE comment
+        assert j["ttft_ms"] is not None and j["ttft_ms"] >= 0
+        assert j["e2e_ms"] >= j["ttft_ms"]
+        assert j["hop_ms"] is not None
+        assert j["trace_id"]
+        assert j["events"][-1] == {"status": 200}
+        w = state["windows"]["default"]
+        assert w["samples"] == 1
+    finally:
+        srv.stop()
+
+
+def test_router_journey_records_failover_events():
+    SLO.load_config(
+        {"classes": {"default": {"availability": 0.5}}}, journal=False,
+    )
+    bad = FakeSSEReplica("bad", fail=True)
+    good = FakeSSEReplica("good")
+    rs = ReplicaSet(interval_s=60.0, breaker_threshold=1,
+                    breaker_cooldown_s=0.2)
+    rs.add(bad.replica())
+    rs.add(good.replica())
+    rs.refresh()
+    router = FleetRouter(rs, port=0, page_size=4)
+    # force the bad replica to be chosen first (least-loaded is
+    # name-tiebroken; pin by loading the good one)
+    rs.get("good").inflight = 5
+    try:
+        out, raw = _route_once(
+            router, {"prompt": [1, 2], "stream": True},
+        )
+        assert out is None
+        j = SLO.debug_state()["recent"][-1]
+        assert j["ok"] is True
+        assert j["replica"] == "good"
+        kinds = [e.get("event") for e in j["events"]]
+        assert "failover" in kinds
+        assert "breaker_open" in kinds  # threshold 1 opened it
+    finally:
+        bad.stop()
+        good.stop()
+
+
+def test_router_journey_disabled_zero_cost():
+    # SLO off: no journey dict is built and nothing folds
+    srv = FakeSSEReplica("rep-0")
+    rs = ReplicaSet(interval_s=60.0)
+    rs.add(srv.replica())
+    rs.refresh()
+    router = FleetRouter(rs, port=0, page_size=4)
+    try:
+        out, _ = _route_once(router, {"prompt": [1, 2], "stream": True})
+        assert out is None
+        assert SLO.enabled is False
+        assert SLO.debug_state()["folded"]["router"] == 0
+    finally:
+        srv.stop()
+
+
+def test_router_port_serves_slo_and_trace():
+    SLO.load_config(
+        {"classes": {"default": {"availability": 0.5}}}, journal=False,
+    )
+    srv = FakeSSEReplica("rep-0")
+    rs = ReplicaSet(interval_s=60.0)
+    rs.add(srv.replica())
+    rs.refresh()
+    router = FleetRouter(rs, port=0, page_size=4)
+    router.assembler = TraceAssembler(sources=lambda: [])
+    port = router.start()
+    try:
+        _route_once(router, {"prompt": [1, 2], "stream": True})
+        tid = SLO.debug_state()["recent"][-1]["trace_id"]
+
+        def get(path):
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(
+                    f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                    "Connection: close\r\n\r\n".encode()
+                )
+                buf = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            head, _, body = buf.partition(b"\r\n\r\n")
+            return int(head.split(b" ", 2)[1]), json.loads(body)
+
+        code, slo_state = get("/debug/slo")
+        assert code == 200 and slo_state["enabled"] is True
+        code, trace = get(f"/debug/trace/{tid}")
+        assert code == 200
+        assert trace["trace_id"] == tid
+        assert trace["span_count"] >= 1
+        names = [s["name"] for s in trace["spans"]]
+        assert "fleet.route" in names
+    finally:
+        router.stop()
+        srv.stop()

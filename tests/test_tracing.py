@@ -200,6 +200,123 @@ def test_pod_root_registry_bounded_and_evicted_roots_closed():
     assert len(evicted) == 6
 
 
+# -- trace pinning (open pod traces survive span pressure) --------------------
+
+
+def test_open_pod_trace_survives_span_pressure():
+    # the PR-1 wart: a long-lived pod's filter/priorities spans used to
+    # evict FIFO under span pressure before bind closed the trace
+    tr = Tracer(capacity=16, sample=1.0)
+    root = tr.pod_span("default/slow-pod")
+    for k in range(3):
+        tr.span(f"filter-{k}", parent=root).end()
+    # flood: 10x capacity of unrelated spans
+    for i in range(160):
+        tr.span(f"noise-{i}").end()
+    mine = [s for s in tr.finished() if s.trace_id == root.trace_id]
+    assert len(mine) == 3  # every verb span survived the flood
+    assert tr.trace(root.trace_id)  # and /traces can still render it
+    # bind closes the trace → spans rejoin the ordinary ring and a new
+    # flood evicts them like anything else
+    tr.finish_pod("default/slow-pod")
+    for i in range(160):
+        tr.span(f"noise2-{i}").end()
+    assert [s for s in tr.finished() if s.trace_id == root.trace_id] == []
+
+
+def test_explicit_pin_is_counted_and_nested():
+    tr = Tracer(capacity=8, sample=1.0)
+    sp = tr.span("serve.request")
+    tid = sp.trace_id
+    tr.pin(tid)
+    tr.pin(tid)  # second pinner (e.g. pod registry + stream handler)
+    for k in range(4):
+        tr.span(f"engine.step-{k}", parent=sp).end()
+    for i in range(50):
+        tr.span(f"noise-{i}").end()
+    assert len([s for s in tr.finished() if s.trace_id == tid]) == 4
+    tr.unpin(tid)  # still pinned by the other holder
+    for i in range(50):
+        tr.span(f"noise-{i}").end()
+    assert len([s for s in tr.finished() if s.trace_id == tid]) == 4
+    tr.unpin(tid)  # last pin released → ordinary FIFO rules apply
+    for i in range(50):
+        tr.span(f"noise2-{i}").end()
+    assert [s for s in tr.finished() if s.trace_id == tid] == []
+
+
+def test_pinned_overflow_is_bounded_and_counted():
+    from elastic_gpu_scheduler_tpu.metrics import METRICS_DROPPED
+
+    with METRICS_DROPPED._lock:
+        before = METRICS_DROPPED._values.get(("trace_pin_cap",), 0.0)
+    tr = Tracer(capacity=8, sample=1.0, pinned_capacity=5)
+    sp = tr.span("serve.request")
+    tid = sp.trace_id
+    tr.pin(tid)
+    for k in range(9):
+        tr.span(f"engine.step-{k}", parent=sp).end()
+    # bounded: only pinned_capacity spans survive, overflow counted —
+    # in the tracer's own telemetry AND the shared dropped-samples metric
+    assert len([s for s in tr.finished() if s.trace_id == tid]) == 5
+    assert tr.dropped_pinned == 4
+    assert tr.status()["dropped_pinned_spans"] == 4
+    with METRICS_DROPPED._lock:
+        after = METRICS_DROPPED._values.get(("trace_pin_cap",), 0.0)
+    assert after - before == 4.0
+    # the oldest were evicted, the newest kept
+    kept = sorted(
+        s.name for s in tr.finished() if s.trace_id == tid
+    )
+    assert kept == [f"engine.step-{k}" for k in range(4, 9)]
+
+
+def test_pin_ring_tokens_purged_on_unpin():
+    # regression: unpin used to release a trace's parked spans but
+    # leave their _pin_ring tokens behind — one stale token per span
+    # forever (the overflow loop, the only other drain point, never
+    # runs below pinned_capacity), and a RE-pinned trace id could have
+    # a stale token evict one of its NEW spans as a phantom overflow
+    tr = Tracer(capacity=64, sample=1.0, pinned_capacity=8)
+    for round_ in range(20):
+        sp = tr.span("serve.request")
+        tid = sp.trace_id
+        tr.pin(tid)
+        for k in range(4):
+            tr.span(f"step-{round_}-{k}", parent=sp).end()
+        tr.unpin(tid)
+    assert len(tr._pin_ring) == 0
+    assert tr._pin_count == 0
+    assert tr.dropped_pinned == 0  # no phantom overflow evictions
+    # re-pin churn on ONE trace id: parked spans survive intact
+    sp = tr.span("serve.request")
+    tid = sp.trace_id
+    for _ in range(5):
+        tr.pin(tid)
+        tr.span("step", parent=sp).end()
+        tr.unpin(tid)
+    tr.pin(tid)
+    for k in range(6):
+        tr.span(f"live-{k}", parent=sp).end()
+    assert len([s for s in tr.pinned_spans()]) == 6
+    assert tr.dropped_pinned == 0
+
+
+def test_unpin_releases_into_bounded_ring():
+    tr = Tracer(capacity=4, sample=1.0, pinned_capacity=64)
+    sp = tr.span("serve.request")
+    tid = sp.trace_id
+    tr.pin(tid)
+    for k in range(10):
+        tr.span(f"engine.step-{k}", parent=sp).end()
+    assert len(tr.finished()) == 10
+    tr.unpin(tid)
+    # released spans honor the ordinary ring bound (and count drops)
+    assert len(tr.finished()) == 4
+    assert tr.dropped == 6
+    assert tr.status()["pinned_spans"] == 0
+
+
 def test_audit_bounded():
     audit = ScheduleAudit(capacity=3, max_records=5, enabled=True)
     for i in range(6):

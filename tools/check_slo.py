@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""CI gate for the fleet SLO plane (`make check-slo`).
+
+A seeded fleet soak over REAL engines — two in-process CPU replicas for
+the overhead phases plus ONE true subprocess replica (serve.py) carrying
+an injected latency fault — all HARD-FAIL:
+
+1. **Burn-rate alert** — a deterministic `delay` fault plan at the
+   replica's ``serve.request`` site (faultinject/) degrades TTFT/e2e
+   without failing anything; the router's journey records must push the
+   declared objective's multi-window burn rate past threshold and trip
+   a breach.
+2. **Journaled breach with a resolvable exemplar** — the breach lands
+   as an ``slo`` journal record carrying exemplar trace ids, and the
+   exemplar must resolve via the trace assembler
+   (``GET /debug/trace/<id>``) into one causally-ordered journey with
+   spans from AT LEAST TWO PROCESSES (the router's ``fleet.route`` span
+   + the subprocess replica's ``serve.request``/``engine.step`` spans).
+3. **SLO-proactive scaling** — a journaled autoscaler evaluation must
+   carry the burn posture (``slo`` field) and decide ``up`` on it while
+   the queue signal is still idle (budget burn leads queue depth).
+4. **Replay** — journal replay reports ZERO violations and reconstructs
+   the breach (count + exemplars).
+5. **Router overhead** — hop p99 with the SLO plane ON stays within
+   SLO_OVERHEAD_BUDGET_PCT of OFF (interleaved on/off chunks, pooled
+   per-mode storm-trimmed p99s, ×3 attempts — every attempt must
+   breach for the gate to fail, the check-journal stance on noisy CI
+   boxes; deltas under SLO_OVERHEAD_FLOOR_MS pass outright).
+
+Usage:
+    python tools/check_slo.py
+
+Environment:
+    CHECK_SLO_SEED              soak RNG seed (default 20260804)
+    SLO_OVERHEAD_BUDGET_PCT     hop-p99 on-vs-off budget (default 25)
+    SLO_OVERHEAD_FLOOR_MS       absolute delta below which the budget
+                                cannot fail (default 2.0)
+
+Wired into the Makefile as `make check-slo`, next to `check-disagg`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from bench import _fleet_post, _make_cpu_replica, p99  # noqa: E402
+from elastic_gpu_scheduler_tpu.fleet import (  # noqa: E402
+    Autoscaler,
+    FleetRouter,
+    Replica,
+    ReplicaSet,
+    ScalingPolicy,
+)
+from elastic_gpu_scheduler_tpu.journal import JOURNAL, read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import replay  # noqa: E402
+from elastic_gpu_scheduler_tpu.slo import SLO  # noqa: E402
+from elastic_gpu_scheduler_tpu.slo.assembly import TraceAssembler  # noqa: E402
+
+
+class _NoRelay:
+    up = None
+    detail = ""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http_get(port: int, path: str, timeout=5.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _stream_once(port, prompt, max_tokens=8, timeout=120.0):
+    """One streaming completion through the router; returns the raw
+    bytes (the SLO journey is recorded router-side)."""
+    raw = json.dumps({
+        "prompt": prompt, "max_tokens": max_tokens, "stream": True,
+    }).encode()
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall((
+            f"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(raw)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode() + raw)
+        buf = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+    return buf
+
+
+def spawn_faulty_replica(port: int, delay_s: float, tmp: str):
+    """A REAL serve.py subprocess (its spans live in ITS ring — the
+    cross-process half of the trace-assembly contract) with a
+    deterministic serve.request delay plan."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_FAULT_PLAN"] = json.dumps([{
+        "site": "serve.request", "kind": "delay", "p": 1.0,
+        "delay_s": delay_s,
+    }])
+    env["POD_NAME"] = "slow-replica"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "elastic_gpu_scheduler_tpu.serve",
+            "--init", "--cpu", "--port", str(port),
+            "--host", "127.0.0.1",
+            "--vocab-size", "64", "--d-model", "32", "--n-layers", "2",
+            "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
+            "--max-batch", "2", "--max-len", "128", "--page-size", "8",
+            "--fused-steps", "4",
+        ],
+        stdout=open(os.path.join(tmp, "replica.log"), "wb"),
+        stderr=subprocess.STDOUT,
+        env=env,
+    )
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica subprocess died (rc={proc.returncode}); see "
+                f"{tmp}/replica.log"
+            )
+        try:
+            st, _ = _http_get(port, "/healthz", timeout=1.0)
+            if st == 200:
+                return proc
+        except OSError:
+            pass
+        time.sleep(0.25)
+    proc.terminate()
+    raise RuntimeError("replica subprocess never became healthy")
+
+
+def main() -> int:
+    seed = int(os.environ.get("CHECK_SLO_SEED", "20260804"))
+    budget_pct = float(os.environ.get("SLO_OVERHEAD_BUDGET_PCT", "25"))
+    floor_ms = float(os.environ.get("SLO_OVERHEAD_FLOOR_MS", "2.0"))
+    rng = random.Random(seed)
+    tmp = tempfile.mkdtemp(prefix="tpu-slo-check-")
+    journal_dir = os.path.join(tmp, "journal")
+    failures: list[str] = []
+    result: dict = {"metric": "check_slo", "seed": seed}
+
+    import jax
+
+    from elastic_gpu_scheduler_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype="float32",
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    JOURNAL.configure(journal_dir, fsync="off")
+    SLO.reset()
+    SLO.load_config({
+        # tight TTFT objective the delay fault will blow; generous
+        # windows so the whole fault phase fits the short window
+        "classes": {"default": {"ttft_p95_ms": 150,
+                                "availability": 0.5}},
+        "window_short_s": 30, "window_long_s": 60,
+        "burn_threshold": 1.0, "min_samples": 4,
+    })
+
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=_NoRelay())
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=8)
+    assembler = TraceAssembler(
+        sources=lambda: [(r.name, (r.host, r.port)) for r in rs.all()],
+    )
+    router.assembler = assembler
+    SLO.breach_hooks.append(assembler.on_breach)
+
+    reps = [
+        _make_cpu_replica(f"slo-rep-{i}", params, cfg,
+                          max_batch=4, max_len=128, page_size=8,
+                          fused_steps=4)
+        for i in range(2)
+    ]
+    for r in reps:
+        rs.add(r["replica"])
+    rs.refresh()
+    router_port = router.start()
+    proc = None
+
+    try:
+        # phase 1: hop-p99 overhead, SLO plane on vs off ------------------
+        # interleaved chunks (journal/profile gate pattern): per-mode
+        # pools see the same box weather; ×3 storm-trimmed attempts
+        def probe_chunk(n=20):
+            out = []
+            for _ in range(n):
+                mark = len(router.overhead_samples)
+                st, _ = _fleet_post(router_port, {
+                    "prompt": [rng.randrange(64) for _ in range(4)],
+                    "max_tokens": 1,
+                })
+                if st != 200:
+                    failures.append(f"overhead probe failed: {st}")
+                    return out
+                out.extend(router.overhead_samples[mark:])
+            return out
+
+        attempts = []
+        passed_budget = False
+        for attempt in range(3):
+            on_samples: list[float] = []
+            off_samples: list[float] = []
+            for chunk in range(6):
+                if chunk % 2 == 0:
+                    SLO.enabled = True
+                    on_samples.extend(probe_chunk())
+                else:
+                    SLO.enabled = False
+                    off_samples.extend(probe_chunk())
+            SLO.enabled = True
+
+            def trimmed_p99(xs):
+                xs = sorted(xs)[: max(1, int(len(xs) * 0.9))]
+                return p99(xs) * 1000 if xs else 0.0
+
+            on_ms, off_ms = trimmed_p99(on_samples), trimmed_p99(off_samples)
+            pct = (
+                100.0 * (on_ms - off_ms) / off_ms if off_ms > 0 else 0.0
+            )
+            attempts.append({
+                "on_p99_ms": round(on_ms, 3),
+                "off_p99_ms": round(off_ms, 3),
+                "overhead_pct": round(pct, 2),
+            })
+            if pct <= budget_pct or (on_ms - off_ms) <= floor_ms:
+                passed_budget = True
+                break
+        result["overhead_attempts"] = attempts
+        result["slo_record_overhead_pct"] = attempts[-1]["overhead_pct"]
+        if not passed_budget:
+            failures.append(
+                f"router hop p99 with the SLO plane on exceeded the "
+                f"{budget_pct}% budget in every attempt: {attempts}"
+            )
+
+        # phase 2: injected latency fault → burn-rate breach --------------
+        slow_port = _free_port()
+        proc = spawn_faulty_replica(slow_port, delay_s=0.4, tmp=tmp)
+        rs.add(Replica("slow-replica", "127.0.0.1", slow_port))
+        # the healthy in-process replicas leave rotation: every journey
+        # now pays the injected delay
+        rs.drain("slo-rep-0", reason="slo drill")
+        rs.drain("slo-rep-1", reason="slo drill")
+        rs.refresh()
+        breaches_before = SLO.breaches
+        t_fault0 = time.perf_counter()
+        for i in range(6):
+            buf = _stream_once(
+                router_port,
+                [rng.randrange(64) for _ in range(6)], max_tokens=8,
+            )
+            if b"data: [DONE]" not in buf:
+                failures.append(f"fault-phase stream {i} did not finish")
+        posture = SLO.evaluate(force=True)
+        breach_ms = (time.perf_counter() - t_fault0) * 1000
+        result["slo_breach_detect_ms"] = round(breach_ms, 1)
+        result["posture"] = posture
+        if not posture["burning"] or SLO.breaches <= breaches_before:
+            failures.append(
+                f"injected latency fault did not trip the burn-rate "
+                f"alert: {posture}; state={SLO.debug_state()['burn']}"
+            )
+
+        # phase 3: the breach's exemplar resolves across processes --------
+        state = SLO.debug_state()
+        # exemplars dict: class → {objective: [trace ids]}
+        exemplars = []
+        for _cls, by_obj in state["exemplars"].items():
+            for _obj_key, ids in by_obj.items():
+                exemplars.extend(ids)
+        if not exemplars:
+            failures.append("breach produced no exemplar trace ids")
+        else:
+            ex = exemplars[-1]
+            t_asm0 = time.perf_counter()
+            rec = assembler.assemble(ex)
+            result["slo_assembly_ms"] = round(
+                (time.perf_counter() - t_asm0) * 1000, 2
+            )
+            result["exemplar_trace"] = {
+                "trace_id": ex,
+                "spans": rec["span_count"],
+                "processes": rec["processes"],
+                "sources": rec["sources"],
+            }
+            names = [s["name"] for s in rec["spans"]]
+            if rec["processes"] < 2:
+                failures.append(
+                    f"exemplar trace {ex} did not assemble spans from "
+                    f">=2 processes: {rec['sources']} ({names})"
+                )
+            if "fleet.route" not in names:
+                failures.append(
+                    f"exemplar trace missing the router span: {names}"
+                )
+            if "serve.request" not in names:
+                failures.append(
+                    f"exemplar trace missing the replica span: {names}"
+                )
+            # causal order: the router span precedes its replica child
+            if (
+                "fleet.route" in names and "serve.request" in names
+                and names.index("fleet.route")
+                > names.index("serve.request")
+            ):
+                failures.append(
+                    f"assembled spans not in causal order: {names}"
+                )
+
+        # phase 4: SLO-proactive autoscaler evaluation, journaled ---------
+        scaler = Autoscaler(
+            rs, executor=None,  # advisory: the DECISION is the contract
+            policy=ScalingPolicy(
+                min_replicas=1, max_replicas=4, hysteresis_rounds=1,
+                up_cooldown_s=0.0,
+            ),
+            slo_provider=SLO.scaling_input,
+        )
+        decision = scaler.tick()
+        result["autoscaler_decision"] = {
+            "action": decision["action"],
+            "reason": decision["reason"],
+            "slo_burning": bool((decision.get("slo") or {}).get("burning")),
+        }
+        if not (decision.get("slo") or {}).get("burning"):
+            failures.append(
+                f"autoscaler evaluation did not see the SLO burn "
+                f"posture: {decision}"
+            )
+        if decision["action"] != "up":
+            failures.append(
+                f"burning budget did not drive a scale-up decision "
+                f"while the queue was idle: {decision}"
+            )
+        if "slo burn" not in decision["reason"]:
+            failures.append(
+                f"scale-up reason does not name the slo burn: "
+                f"{decision['reason']}"
+            )
+    finally:
+        try:
+            router.stop()
+        except Exception:
+            pass
+        for r in reps:
+            r["server"].shutdown()
+            r["loop"].stop()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        assembler.stop()
+        SLO.stop_ticker()
+
+    # phase 5: journal round trip ----------------------------------------
+    if not JOURNAL.flush():
+        failures.append("journal flush failed (write loss?)")
+    JOURNAL.close()
+    events = read_journal(journal_dir)
+    slo_recs = [e for e in events if e.get("type") == "slo"]
+    fleet_recs = [e for e in events if e.get("type") == "fleet"]
+    result["journal_slo_records"] = len(slo_recs)
+    result["journal_fleet_records"] = len(fleet_recs)
+    if not any(r.get("action") == "breach" for r in slo_recs):
+        failures.append("no slo breach record reached the journal")
+    else:
+        breach = next(
+            r for r in slo_recs if r.get("action") == "breach"
+        )
+        if not breach.get("exemplars"):
+            failures.append("journaled breach carries no exemplars")
+    if not any(
+        (r.get("slo") or {}).get("burning") for r in fleet_recs
+    ):
+        failures.append(
+            "no journaled autoscaler evaluation carries the SLO input"
+        )
+    res = replay(events)
+    if res.violations:
+        failures.append(f"replay violations: {res.violations[:5]}")
+    if res.slo_breaches < 1:
+        failures.append("replay did not reconstruct the slo breach")
+    elif not (res.last_slo_breach or {}).get("exemplars"):
+        failures.append("replayed breach lost its exemplar trace ids")
+
+    SLO.reset()
+    shutil.rmtree(tmp, ignore_errors=True)
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
