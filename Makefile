@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-slo check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-slo check-twin check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -151,6 +151,18 @@ check-disagg:
 # within SLO_OVERHEAD_BUDGET_PCT of off (x3 storm-trimmed attempts).
 check-slo:
 	JAX_PLATFORMS=cpu python tools/check_slo.py
+
+# Digital-twin gate: record a seeded live soak (binds + SLO journeys +
+# profile EWMAs on 4x4-mesh v5e nodes), run the twin over the
+# recording, and hard-fail on replay invariant violations in the twin
+# journal, nondeterminism across two same-seed runs (byte-identical
+# journals + identical burn/packing scores required), fitted per-class
+# tokens/s drifting >20% from the recorded profiles, live-vs-simulated
+# SLO burn posture disagreement, or an autosearch round surfacing a
+# gate-rejected candidate; the seeded fixture must also yield >=1
+# candidate beating the incumbent binpack on rater-neutral metrics.
+check-twin:
+	JAX_PLATFORMS=cpu python tools/check_twin.py
 
 # Native-kernel sanitizer gate: rebuild placement.cc with
 # ASan+UBSan (-fno-sanitize-recover) and run a seeded differential

@@ -263,6 +263,7 @@ class DefragPlanner:
         cordon_ttl_s: float = 120.0,
         interval_s: float = 30.0,
         hooks: Optional[list] = None,
+        clock=time.monotonic,
     ):
         if mode not in MODES:
             raise ValueError(f"defrag mode {mode!r} not in {MODES}")
@@ -291,7 +292,11 @@ class DefragPlanner:
         # empty plane = one attribute check per round, zero per bind.
         self.policies = None
         self._lock = TimedLock("defrag", rank=15)
-        self._last_round = 0.0  # monotonic; rate-limits try_unblock
+        # time source for the rate limiter — the digital twin (twin/)
+        # injects a VirtualClock so simulated rounds rate-limit against
+        # simulated time; live planners keep time.monotonic
+        self.clock = clock
+        self._last_round = 0.0  # clock units; rate-limits try_unblock
         self._rounds_run = 0
         self._moves_executed = 0
         self._last_result: Optional[dict] = None
@@ -836,7 +841,7 @@ class DefragPlanner:
             if (
                 min_interval_guard
                 and not dry_run
-                and time.monotonic() - self._last_round < self.min_interval_s
+                and self.clock() - self._last_round < self.min_interval_s
             ):
                 DEFRAG_EVENTS.inc("unblock_rate_limited")
                 return {"rate_limited": True, "dry_run": False, "executed": 0}
@@ -857,7 +862,7 @@ class DefragPlanner:
             # rounds must count against the rate limiter too, or a
             # persistently-failing round lets every gang-filter retry
             # thrash the cluster with full execute+rollback cycles
-            self._last_round = time.monotonic()
+            self._last_round = self.clock()
             if plan.moves():
                 result["executed"] = self._execute(sched, plan)["executed"]
                 self._rounds_run += 1
@@ -913,7 +918,7 @@ class DefragPlanner:
         with self._lock:
             if self._feasible(self._chip_clones(sched), *want):
                 return True
-        now = time.monotonic()
+        now = self.clock()
         if now - self._last_round < self.min_interval_s:
             DEFRAG_EVENTS.inc("unblock_rate_limited")
             return False

@@ -273,6 +273,7 @@ class Autoscaler:
         migrator=None,
         shed_queue_margin: float = 0.0,
         slo_provider=None,
+        clock=time.monotonic,
     ):
         """``slo_provider``: callable → the SLO plane's burn posture
         (``SLO.scaling_input`` is the production shape; None while no
@@ -304,6 +305,10 @@ class Autoscaler:
         self.migrator = migrator
         self.shed_queue_margin = float(shed_queue_margin)
         self.slo_provider = slo_provider
+        # time source for tick's default ``now`` — the digital twin
+        # (twin/) injects a VirtualClock so cooldowns/hysteresis run in
+        # simulated time; live scalers keep time.monotonic
+        self.clock = clock
         self.evaluations = 0
         self.scale_ups = 0
         self.scale_downs = 0
@@ -335,7 +340,7 @@ class Autoscaler:
     def tick(self, now: Optional[float] = None) -> dict:
         """Evaluate once; journal the evaluation; execute a decision.
         Returns the decision record (also kept as ``last_decision``)."""
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         self.evaluations += 1
         sig = self.signals()
         all_reps = self.replicas.all()

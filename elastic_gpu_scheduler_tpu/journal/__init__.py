@@ -222,6 +222,11 @@ class Journal:
         # still replays: any segment suffix starts with a full state
         # snapshot (snapshot+log).  The engine registers itself here.
         self.checkpoint_provider = None
+        # record-timestamp source.  The digital twin (twin/) runs its
+        # OWN Journal instance with a VirtualClock here so twin records
+        # carry SIMULATED time (and two same-seed runs are byte-
+        # identical); the process-global JOURNAL keeps wall time.
+        self.wall_clock = time.time
         self._atexit_registered = False
         self._pending_checkpoint = False
         self.dir: Optional[str] = None
@@ -409,7 +414,7 @@ class Journal:
             seq = self._seq
             self._seq += 1
             rec["seq"] = seq
-            rec["t"] = round(time.time(), 6)
+            rec["t"] = round(self.wall_clock(), 6)
             # the raw dict: encoding happens on the WRITER thread.  The
             # bind path pays one dict append — moving json+CRC here was
             # measured at ~+10% bind latency on a 2-core box
@@ -510,7 +515,7 @@ class Journal:
         if as_of is None:
             as_of = fallback_as_of
         rec = {
-            "type": "checkpoint", "t": round(time.time(), 6),
+            "type": "checkpoint", "t": round(self.wall_clock(), 6),
             "as_of_seq": as_of, **state,
         }
         line = _encode(rec)

@@ -165,6 +165,13 @@ class ReplayResult:
     slo_records: int = 0
     slo_breaches: int = 0
     last_slo_breach: Optional[dict] = None
+    # digital-twin annotations (twin/): scenario metadata stamped at the
+    # head/tail of a twin journal (seed, scenario, workload model,
+    # scores) — counted, dense-seq audited, zero allocator mutation.
+    # Their presence marks a journal as SIMULATED: tooling must never
+    # mistake a twin journal for a live flight recording.
+    twin_records: int = 0
+    last_twin: Optional[dict] = None
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -198,6 +205,7 @@ class ReplayResult:
             "kv_migrations_failed": self.kv_migrations_failed,
             "slo_records": self.slo_records,
             "slo_breaches": self.slo_breaches,
+            "twin_records": self.twin_records,
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -222,6 +230,12 @@ class ReplayResult:
 def _chipset_from_record(rec: dict) -> ChipSet:
     topo = Topology(tuple(rec["dims"]), tuple(bool(w) for w in rec["wrap"]))
     return ChipSet(topo, [Chip.from_record(c) for c in rec["chips"]])
+
+
+# public alias: the digital twin (twin/) rebuilds a recorded fleet's
+# node ChipSets from node_add records through the same decoder replay
+# uses, so a twin fleet can never diverge from what replay would build
+chipset_from_record = _chipset_from_record
 
 
 def _boot_from_checkpoint(rec: dict, res: ReplayResult) -> None:
@@ -616,6 +630,18 @@ class ReplayEngine:
                     "burn_long": rec.get("burn_long"),
                     "exemplars": rec.get("exemplars") or [],
                 }
+        elif t == "twin":
+            # digital-twin scenario annotation (twin/): seed + scenario
+            # + model/score metadata a twin run stamps into ITS OWN
+            # journal.  Participates in the dense-seq audit, never
+            # mutates allocator state — and marks the stream as
+            # simulated.
+            res.twin_records += 1
+            res.last_twin = {"seq": seq, **{
+                k: rec.get(k)
+                for k in ("action", "scenario", "seed", "mode")
+                if rec.get(k) is not None
+            }}
         elif t == "resize":
             # gang-resize commit summary (fleet/resize.py).  The member
             # binds/forgets/migrates that changed state were journaled
@@ -925,7 +951,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
             continue
         if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
                  "gang_admit", "gang_rollback", "ha_takeover",
-                 "kv_migrate", "slo"):
+                 "kv_migrate", "slo", "twin"):
             # annotations (autoscaler evaluations / resize summaries /
             # policy-plane events / compile warm-ups / gang admit+rollback
             # markers): the member binds/forgets/migrates around a

@@ -284,6 +284,12 @@ the Python analogues):</p>
  — one request end-to-end ACROSS processes: spans pulled from every
  replica's /traces (and this process's ring) merged in causal order —
  the resolution target of an SLO breach record's exemplar ids</li>
+<li><a href="/debug/twin">/debug/twin</a>
+ — digital twin: last time-warped simulation report (packing scores,
+ simulated SLO burn, replay-invariant verdict); POST /twin/run launches
+ a scenario ({"mode": "synthetic"|"recorded", "duration_s": N, ...} —
+ recorded mode replays this process's own journal through the twin);
+ offline CLI: python -m elastic_gpu_scheduler_tpu.twin</li>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
@@ -654,6 +660,16 @@ class ExtenderServer:
                 json.dumps(SLO.debug_state(), indent=1).encode(),
                 "application/json",
             )
+        if path == "/debug/twin":
+            # digital twin: last scenario report (lazy import — the twin
+            # package only loads when someone actually asks for it)
+            from ..twin import debug_state as twin_debug_state
+
+            return (
+                200,
+                json.dumps(twin_debug_state(), indent=1).encode(),
+                "application/json",
+            )
         if path.startswith("/debug/trace/"):
             # one request end-to-end across processes: the assembler
             # (when the fleet wired one) pulls every replica's /traces;
@@ -824,6 +840,8 @@ class ExtenderServer:
     ) -> tuple[int, bytes, str]:
         if path == "/defrag/run":
             return self._route_defrag_run(raw)
+        if path == "/twin/run":
+            return self._route_twin_run(raw)
         if path.startswith("/policy/"):
             return self._route_policy(path, raw)
         if path == "/slo/load":
@@ -912,6 +930,67 @@ class ExtenderServer:
         return self._verb(
             "preemption", lambda: self.preemption.handle(args).to_dict()
         )
+
+    def _route_twin_run(self, raw: bytes) -> tuple[int, bytes, str]:
+        """POST /twin/run — run a digital-twin scenario and return its
+        report.  Body: TwinScenario fields, all optional ({"mode":
+        "synthetic"|"recorded", "duration_s": N, "seed": N, ...}).
+        ``recorded`` mode replays this process's own journal through the
+        twin; the run builds fresh instances only, so live scheduler
+        state, journal sequence and metrics are untouched (the
+        tests/test_twin.py isolation guarantee)."""
+        try:
+            body = json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return 400, b'{"Error": "malformed JSON body"}', "application/json"
+        if not isinstance(body, dict):
+            return (
+                400, b'{"Error": "body must be a JSON object"}',
+                "application/json",
+            )
+        # lazy import: the twin package loads only when a run is asked for
+        from ..journal import JOURNAL, read_journal
+        from ..twin import TwinScenario, run_scenario
+
+        try:
+            scenario = TwinScenario.from_dict(body)
+        except (KeyError, TypeError, ValueError) as e:
+            return (
+                400, json.dumps({"Error": f"bad scenario: {e}"}).encode(),
+                "application/json",
+            )
+        events = None
+        if scenario.mode == "recorded":
+            # a closed journal keeps its old dir attribute — require a
+            # LIVE journal, not a stale path from a previous configure
+            if not JOURNAL.enabled or JOURNAL.dir is None:
+                return (
+                    409,
+                    json.dumps({
+                        "Error": "recorded mode needs a journal; start "
+                        "the scheduler with --journal-dir or run a "
+                        "synthetic scenario",
+                    }).encode(),
+                    "application/json",
+                )
+            JOURNAL.flush()
+            events = read_journal(JOURNAL.dir)
+        try:
+            report = run_scenario(scenario, events=events)
+            return 200, json.dumps(report, indent=1).encode(), "application/json"
+        except ValueError as e:
+            # scenario/recording mismatch (e.g. a journal with no binds
+            # to fit a model from) — the caller's problem, not a crash
+            return (
+                409, json.dumps({"Error": str(e)}).encode(),
+                "application/json",
+            )
+        except Exception as e:
+            log.exception("twin run failed")
+            return (
+                500, json.dumps({"error": str(e)}).encode(),
+                "application/json",
+            )
 
     def _route_defrag_run(self, raw: bytes) -> tuple[int, bytes, str]:
         """POST /defrag/run — run one defrag round.  Body (all optional):

@@ -317,8 +317,18 @@ class SloPlane:
     breach journaling run under ``_fold_lock`` on READER threads (the
     evaluate tick, /debug/slo, the gauge refresher)."""
 
-    def __init__(self):
+    def __init__(self, clock=time.monotonic):
         self.enabled = False
+        # time source for journey stamps and burn buckets — the digital
+        # twin (twin/) swaps in a VirtualClock so simulated journeys land
+        # in simulated buckets; live planes keep time.monotonic, so
+        # behavior there is bit-identical
+        self.clock = clock
+        # journal sink override: None = the process-global JOURNAL (live
+        # planes); the twin wires its OWN Journal instance here so
+        # simulated breach records can never land in — or burn seq
+        # numbers of — the live flight recorder
+        self.journal = None
         self.default_class = "default"
         self.window_short_s = 60.0
         self.window_long_s = 300.0
@@ -418,8 +428,7 @@ class SloPlane:
         SLO_EVENTS.inc("objectives_loaded")
         summary = self.objectives_dict()
         if journal:
-            from ..journal import JOURNAL
-
+            JOURNAL = self._journal_sink()
             if JOURNAL.enabled:
                 JOURNAL.record(
                     "slo", action="objectives", classes=summary,
@@ -429,6 +438,13 @@ class SloPlane:
                 )
                 self.journal_records += 1
         return summary
+
+    def _journal_sink(self):
+        if self.journal is not None:
+            return self.journal
+        from ..journal import JOURNAL
+
+        return JOURNAL
 
     def objectives_dict(self) -> dict:
         return {
@@ -449,6 +465,8 @@ class SloPlane:
             self.breaches = self.recoveries = 0
             self.journal_records = 0
             self.enabled = False
+            self.clock = time.monotonic
+            self.journal = None
         del self.breach_hooks[:]
 
     # -- hot path ------------------------------------------------------------
@@ -476,7 +494,7 @@ class SloPlane:
             return False
         buf = self._buf
         buf.append((
-            time.monotonic(), vantage,
+            self.clock(), vantage,
             wclass or self.default_class, bool(ok),
             ttft_ms, tpot_ms, e2e_ms, queue_ms, hop_ms,
             int(tokens), trace_id, replica, kind, tenant,
@@ -663,7 +681,7 @@ class SloPlane:
         Returns the posture dict (:meth:`posture`).  Runs on background
         threads — never wire it into the scrape path (the gauge
         refresher is the side-effect-free sibling)."""
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         if not self.enabled:
             return {"burning": False, "breached": []}
         with self._eval_lock:
@@ -718,8 +736,7 @@ class SloPlane:
         # suffices, and a hook doing HTTP must never block a folding
         # scraper behind it
         if transitions:
-            from ..journal import JOURNAL
-
+            JOURNAL = self._journal_sink()
             for rec in transitions:
                 SLO_EVENTS.inc(rec["action"])
                 if JOURNAL.enabled:
@@ -786,7 +803,7 @@ class SloPlane:
 
     def debug_state(self) -> dict:
         """The /debug/slo payload (folds first)."""
-        now = time.monotonic()
+        now = self.clock()
         with self._fold_lock:
             if self.enabled:
                 self._fold_locked(now)
@@ -836,7 +853,7 @@ class SloPlane:
         # journaling and hooks belong to the tick thread, never a scrape
         if not self.enabled:
             return
-        now = time.monotonic()
+        now = self.clock()
         with self._fold_lock:
             self._fold_locked(now)
             burn = self._burn_locked(now)

@@ -1,0 +1,74 @@
+"""Trace-driven digital twin: time-warped fleet simulation.
+
+The twin replays a recorded journal workload — or a workload model
+fitted from it, or a fully synthetic one — through the REAL scheduler
+building blocks (ChipSet placement search, SLO burn buckets, the
+autoscaler PolicyEngine, the defrag planner) under a ``VirtualClock``,
+so thirty simulated minutes of fleet behavior folds into about a
+wall-second.  Every simulated decision is journaled through the real
+``Journal`` wire format and the resulting twin journal replays through
+the existing ``journal.replay`` invariant checks, so a twin run is
+held to the same conservation standards as a live one.
+
+Entry points:
+
+- ``python -m elastic_gpu_scheduler_tpu.twin run`` — CLI scenario runner
+- ``python -m elastic_gpu_scheduler_tpu.twin autosearch`` — policy search
+- ``GET /debug/twin`` / ``POST /twin/run`` — server surface
+- ``tools/check_twin.py`` (``make check-twin``) — the conformance gate
+
+Isolation: the twin NEVER touches live singletons (global JOURNAL,
+SLO, POLICIES, PROFILER).  Every run builds fresh instances and leaves
+live scheduler state, journal sequence numbers, and metrics untouched
+(tests/test_twin.py holds this).
+"""
+
+from __future__ import annotations
+
+from .autosearch import (
+    INCUMBENT_SOURCE,
+    autosearch,
+    crossover,
+    genome_from_source,
+    mutate,
+    render_source,
+)
+from .clock import VirtualClock
+from .model import (
+    ClassModel,
+    WorkloadModel,
+    fit_workload_model,
+    objectives_spec_from_events,
+    sample_latency,
+    synthesize_model,
+)
+from .runner import (
+    TwinRunner,
+    TwinScenario,
+    debug_state,
+    resolve_twin_rater,
+    run_scenario,
+    synthesize_fleet,
+)
+
+__all__ = [
+    "INCUMBENT_SOURCE",
+    "ClassModel",
+    "TwinRunner",
+    "TwinScenario",
+    "VirtualClock",
+    "WorkloadModel",
+    "autosearch",
+    "crossover",
+    "debug_state",
+    "fit_workload_model",
+    "genome_from_source",
+    "mutate",
+    "objectives_spec_from_events",
+    "render_source",
+    "resolve_twin_rater",
+    "run_scenario",
+    "sample_latency",
+    "synthesize_fleet",
+    "synthesize_model",
+]

@@ -1,0 +1,154 @@
+"""CLI for the digital twin.
+
+    python -m elastic_gpu_scheduler_tpu.twin run --synthetic --duration 1800
+    python -m elastic_gpu_scheduler_tpu.twin run --journal /var/log/egs/journal
+    python -m elastic_gpu_scheduler_tpu.twin autosearch --journal DIR --rounds 4
+
+``run`` replays a recorded journal (or a synthetic growth scenario)
+under virtual time and prints the score report; ``autosearch`` evolves
+scoring-policy candidates against the recording and prints the ranked
+report.  Neither touches live state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .autosearch import autosearch
+from .runner import TwinScenario, run_scenario
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    events = None
+    if args.journal:
+        from ..journal import read_journal
+
+        events = read_journal(args.journal)
+        if not events:
+            print(f"no journal records under {args.journal}",
+                  file=sys.stderr)
+            return 1
+    scenario = TwinScenario(
+        name=args.name,
+        mode="recorded" if args.journal else "synthetic",
+        seed=args.seed,
+        duration_s=args.duration,
+        step_s=args.step,
+        arrival_scale=args.scale,
+        growth=args.growth,
+        rater=args.rater,
+        defrag_mode=args.defrag,
+        out_dir=args.out,
+    )
+    report = run_scenario(scenario, events=events)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        pk = report["packing"]
+        slo = report["slo"]
+        sc = report["scenario"]
+        name = sc.get("name", "twin") if isinstance(sc, dict) else sc
+        print(f"twin '{name}' ({report['mode']}, "
+              f"seed={report['seed']}): {report['sim_duration_s']:.0f}s "
+              f"simulated in {report['wall_s']:.2f}s wall "
+              f"({report['speedup_vs_wall']:.0f}x)")
+        print(f"  packing: {pk['placed']} placed / {pk['unplaced']} "
+              f"unplaced, contiguous={pk['contiguous_frac']:.3f}, "
+              f"frag={pk['final_frag_mean']:.3f}, "
+              f"free_chip_frac={pk['mean_free_chip_frac']:.3f}")
+        print(f"  slo: journeys={report['journeys']}, "
+              f"burning={slo['posture'].get('burning')}, "
+              f"breaches={slo['breaches']}")
+        print(f"  replay: {report['replay']['records']} records, "
+              f"{len(report['replay']['violations'])} violations")
+        print(f"  journal: {report['journal_dir']}")
+    return 2 if report["replay"]["violations"] else 0
+
+
+def _cmd_autosearch(args: argparse.Namespace) -> int:
+    from ..journal import read_journal
+
+    events = read_journal(args.journal)
+    if not events:
+        print(f"no journal records under {args.journal}", file=sys.stderr)
+        return 1
+    report = autosearch(
+        events,
+        seed=args.seed,
+        rounds=args.rounds,
+        population=args.population,
+        tolerance=args.tolerance,
+    )
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True,
+                  default=str)
+        print()
+    else:
+        inc = report["incumbent"]
+        print(f"autosearch seed={report['seed']} "
+              f"rounds={report['rounds']} "
+              f"evaluated={report['evaluated']}")
+        print(f"  incumbent {inc['name']}: {inc['stats']}")
+        beats = report["beats_incumbent"]
+        print(f"  {len(beats)} candidate(s) beat the incumbent on "
+              f"rater-neutral metrics:")
+        for row in beats:
+            print(f"    fitness={row['fitness']} wins={row['wins']}")
+            print(f"      {row['source']}")
+        print(f"  {report['promotion']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elastic_gpu_scheduler_tpu.twin",
+        description="digital-twin fleet simulation and policy autosearch",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="run a twin scenario")
+    run_p.add_argument("--journal", default="",
+                       help="recorded journal dir to replay (omit for "
+                            "a synthetic scenario)")
+    run_p.add_argument("--synthetic", action="store_true",
+                       help="force synthetic mode (default when no "
+                            "--journal)")
+    run_p.add_argument("--name", default="twin")
+    run_p.add_argument("--duration", type=float, default=1800.0,
+                       help="simulated seconds (default 1800)")
+    run_p.add_argument("--step", type=float, default=1.0)
+    run_p.add_argument("--seed", type=int, default=20260807)
+    run_p.add_argument("--scale", type=float, default=1.0,
+                       help="arrival-rate multiplier (what-if load)")
+    run_p.add_argument("--growth", type=float, default=1.0,
+                       help="arrival growth over the run (2.0 = "
+                            "doubles by the end)")
+    run_p.add_argument("--rater", default="binpack",
+                       help="builtin rater name or a policy score "
+                            "expression")
+    run_p.add_argument("--defrag", default="auto",
+                       choices=("off", "observe", "auto"))
+    run_p.add_argument("--out", default=None,
+                       help="twin journal output dir (default: tmpdir)")
+    run_p.add_argument("--json", action="store_true")
+    run_p.set_defaults(fn=_cmd_run)
+
+    as_p = sub.add_parser("autosearch",
+                          help="evolve scoring policies on a recording")
+    as_p.add_argument("--journal", required=True)
+    as_p.add_argument("--rounds", type=int, default=4)
+    as_p.add_argument("--population", type=int, default=12)
+    as_p.add_argument("--seed", type=int, default=20260807)
+    as_p.add_argument("--tolerance", type=float, default=0.02)
+    as_p.add_argument("--json", action="store_true")
+    as_p.set_defaults(fn=_cmd_autosearch)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,399 @@
+"""Policy autosearch: evolve scoring-policy candidates on the twin.
+
+The policy AST (policy/lang.py — the PR 10 expression language) is the
+genome.  Each generation perturbs constants, swaps operators, grafts
+input leaves, and recombines subtrees from the fitter half; every
+candidate is scored OFFLINE on the recorded workload through the
+existing promotion-gate machinery (``replay_gate`` — rater-neutral
+packing metrics over a ``what_if`` replay), optionally plus a short
+twin run that converts the candidate's packing into a simulated SLO
+burn score.
+
+The search NEVER applies anything.  Its output is a ranked report of
+gate-PASSED candidates; a human promotes a winner through the existing
+policy lifecycle (``POST /policy/load`` → replay gate → canary →
+promote), which re-runs the same gate live before any traffic shifts.
+Candidates whose gate failed are listed separately for diagnostics and
+are never ranked — an autosearch round can therefore never surface a
+gate-rejected genome as promotable (tools/check_twin.py holds this).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+from ..core.rater import Binpack
+from ..policy.lang import CompileError, compile_expr
+from ..policy.promotion import replay_gate
+from ..policy.rater import SCORE_INPUTS, PolicyRater
+
+# the incumbent binpack formula in policy-expression form (the same
+# weights core/rater.py's Binpack hard-codes) — the seed genome, so the
+# search starts AT the incumbent and explores its neighborhood
+INCUMBENT_SOURCE = (
+    "35*node_used + 30*chip_used + 25*preserve + 10*locality"
+)
+
+_BIN_SWAPS = {"+": ("+", "-"), "-": ("-", "+"), "*": ("*",), "/": ("/",)}
+_LEAVES = tuple(SCORE_INPUTS)
+
+
+# -- AST ↔ source -------------------------------------------------------------
+# The compiler's ``load`` nodes carry slot INDICES into Program.slots;
+# the genome normalizes them to input NAMES so subtrees recombine across
+# programs with different slot orders, then renders back to policy
+# SOURCE (not Python — Program._py_src is the Python emitter) so every
+# candidate round-trips through the real compiler.
+
+
+def _named_ast(node, slots):
+    kind = node[0]
+    if kind == "num":
+        return node
+    if kind == "load":
+        return ("load", slots[node[1]])
+    if kind in ("neg", "not"):
+        return (kind, _named_ast(node[1], slots))
+    if kind == "bin":
+        return ("bin", node[1], _named_ast(node[2], slots),
+                _named_ast(node[3], slots))
+    if kind in ("and", "or"):
+        return (kind, _named_ast(node[1], slots),
+                _named_ast(node[2], slots))
+    if kind == "ternary":
+        return ("ternary", _named_ast(node[1], slots),
+                _named_ast(node[2], slots), _named_ast(node[3], slots))
+    if kind == "call":
+        return ("call", node[1], [_named_ast(a, slots) for a in node[2]])
+    raise ValueError(f"unknown AST node {kind!r}")
+
+
+def render_source(node) -> str:
+    """Named AST → policy-language source (parenthesized everywhere —
+    verbose but unambiguous, and the compiler normalizes anyway)."""
+    kind = node[0]
+    if kind == "num":
+        v = node[1]
+        return repr(int(v)) if float(v).is_integer() else repr(v)
+    if kind == "load":
+        return node[1]
+    if kind == "neg":
+        return f"(-{render_source(node[1])})"
+    if kind == "not":
+        return f"(!{render_source(node[1])})"
+    if kind == "bin":
+        return (f"({render_source(node[2])} {node[1]} "
+                f"{render_source(node[3])})")
+    if kind == "and":
+        return f"({render_source(node[1])} && {render_source(node[2])})"
+    if kind == "or":
+        return f"({render_source(node[1])} || {render_source(node[2])})"
+    if kind == "ternary":
+        return (f"({render_source(node[1])} ? {render_source(node[2])} "
+                f": {render_source(node[3])})")
+    if kind == "call":
+        args = ", ".join(render_source(a) for a in node[2])
+        return f"{node[1]}({args})"
+    raise ValueError(f"unknown AST node {kind!r}")
+
+
+def genome_from_source(source: str):
+    """Compile + normalize: source → named AST (raises CompileError on
+    an invalid genome, which the search treats as dead)."""
+    program = compile_expr(source, SCORE_INPUTS)
+    return _named_ast(program.ast, program.slots)
+
+
+# -- mutation -----------------------------------------------------------------
+
+
+def _subtrees(node, acc=None):
+    """All nodes in pre-order (shared references — read-only walk)."""
+    if acc is None:
+        acc = []
+    acc.append(node)
+    kind = node[0]
+    if kind in ("neg", "not"):
+        _subtrees(node[1], acc)
+    elif kind == "bin":
+        _subtrees(node[2], acc)
+        _subtrees(node[3], acc)
+    elif kind in ("and", "or"):
+        _subtrees(node[1], acc)
+        _subtrees(node[2], acc)
+    elif kind == "ternary":
+        for c in node[1:]:
+            _subtrees(c, acc)
+    elif kind == "call":
+        for a in node[2]:
+            _subtrees(a, acc)
+    return acc
+
+
+def _map_nth(node, n: int, fn, counter=None):
+    """Rebuild the tree with pre-order node ``n`` replaced by
+    ``fn(node)``.  Counter rides in a one-element list."""
+    if counter is None:
+        counter = [0]
+    idx = counter[0]
+    counter[0] += 1
+    if idx == n:
+        return fn(node)
+    kind = node[0]
+    if kind in ("num", "load"):
+        return node
+    if kind in ("neg", "not"):
+        return (kind, _map_nth(node[1], n, fn, counter))
+    if kind == "bin":
+        return ("bin", node[1], _map_nth(node[2], n, fn, counter),
+                _map_nth(node[3], n, fn, counter))
+    if kind in ("and", "or"):
+        return (kind, _map_nth(node[1], n, fn, counter),
+                _map_nth(node[2], n, fn, counter))
+    if kind == "ternary":
+        return ("ternary", _map_nth(node[1], n, fn, counter),
+                _map_nth(node[2], n, fn, counter),
+                _map_nth(node[3], n, fn, counter))
+    if kind == "call":
+        return ("call", node[1],
+                [_map_nth(a, n, fn, counter) for a in node[2]])
+    return node
+
+
+def mutate(genome, rng: random.Random):
+    """One random edit: perturb a constant, swap a +/- operator, swap
+    an input leaf, or graft a fresh weighted-input term onto the root."""
+    nodes = _subtrees(genome)
+    choice = rng.random()
+    if choice < 0.45:
+        # perturb a constant (the workhorse: reweighting the formula)
+        idxs = [i for i, nd in enumerate(nodes) if nd[0] == "num"]
+        if idxs:
+            n = rng.choice(idxs)
+            factor = rng.choice((0.5, 0.8, 1.25, 2.0))
+            return _map_nth(
+                genome, n,
+                lambda nd: ("num", round(nd[1] * factor, 4)),
+            )
+    if choice < 0.65:
+        # swap an additive operator's sign
+        idxs = [i for i, nd in enumerate(nodes)
+                if nd[0] == "bin" and nd[1] in ("+", "-")]
+        if idxs:
+            n = rng.choice(idxs)
+            return _map_nth(
+                genome, n,
+                lambda nd: ("bin", "-" if nd[1] == "+" else "+",
+                            nd[2], nd[3]),
+            )
+    if choice < 0.85:
+        # re-aim an input leaf at a different score input
+        idxs = [i for i, nd in enumerate(nodes) if nd[0] == "load"]
+        if idxs:
+            n = rng.choice(idxs)
+            leaf = rng.choice(_LEAVES)
+            return _map_nth(genome, n, lambda _nd: ("load", leaf))
+    # graft: root ± weight * fresh_input
+    leaf = rng.choice(_LEAVES)
+    weight = rng.choice((1.0, 2.0, 5.0, 10.0))
+    op = rng.choice(("+", "-"))
+    return ("bin", op, genome,
+            ("bin", "*", ("num", weight), ("load", leaf)))
+
+
+def crossover(a, b, rng: random.Random):
+    """Swap a random subtree of ``a`` for a random subtree of ``b``."""
+    donors = _subtrees(b)
+    donor = donors[rng.randrange(len(donors))]
+    n = rng.randrange(len(_subtrees(a)))
+    return _map_nth(a, n, lambda _nd: donor)
+
+
+# -- scoring ------------------------------------------------------------------
+
+
+def _neutral_wins(cand: dict, inc: dict) -> list:
+    """Rater-neutral metrics where the candidate is STRICTLY better
+    than the incumbent (what_if stat dicts)."""
+    wins = []
+    if cand["placed"] > inc["placed"]:
+        wins.append("placed")
+    if cand["contiguous_frac"] > inc["contiguous_frac"]:
+        wins.append("contiguous_frac")
+    if cand["final_frag_mean"] < inc["final_frag_mean"]:
+        wins.append("final_frag_mean")
+    if cand["mean_free_chip_frac"] > inc["mean_free_chip_frac"]:
+        wins.append("mean_free_chip_frac")
+    return wins
+
+
+def _fitness(gate: dict, burn: Optional[float]) -> float:
+    """Scalar rank: packing improvement over the incumbent, minus
+    simulated burn when a burn evaluator ran.  Only meaningful among
+    gate-PASSED candidates (failed ones never rank)."""
+    cand, inc = gate["candidate"], gate["incumbent"]
+    score = (
+        (cand["mean_free_chip_frac"] - inc["mean_free_chip_frac"]) * 10.0
+        + (inc["final_frag_mean"] - cand["final_frag_mean"]) * 10.0
+        + (cand["contiguous_frac"] - inc["contiguous_frac"]) * 5.0
+        + (cand["placed"] - inc["placed"]) * 0.5
+    )
+    if burn is not None:
+        score -= burn
+    return round(score, 6)
+
+
+def autosearch(
+    events: list,
+    seed: int = 20260807,
+    rounds: int = 4,
+    population: int = 12,
+    tolerance: float = 0.02,
+    burn_eval: Optional[Callable] = None,
+    incumbent_source: str = INCUMBENT_SOURCE,
+) -> dict:
+    """Evolve score-policy candidates against a recorded journal.
+
+    ``burn_eval(rater) -> float`` optionally scores each gate-passed
+    candidate's simulated SLO burn (twin run with the candidate as the
+    scenario rater); lower is better.  Returns a report dict::
+
+        {"seed", "rounds", "population", "incumbent": {...},
+         "candidates": [ranked gate-passed, best first],
+         "rejected": [gate-failed, for diagnostics],
+         "beats_incumbent": [subset of candidates strictly better on
+                             ≥1 rater-neutral metric],
+         "promotion": how to promote (never done automatically)}
+    """
+    rng = random.Random(seed)
+    incumbent = Binpack()
+    seed_genome = genome_from_source(incumbent_source)
+
+    # incumbent baseline (also sanity-checks the recording is gateable)
+    base_gate = replay_gate(events, incumbent, incumbent,
+                            tolerance=tolerance)
+    inc_stats = base_gate["incumbent"]
+
+    # generation 0: the incumbent genome + seeded weight perturbations
+    pool = [seed_genome]
+    while len(pool) < population:
+        g = seed_genome
+        for _ in range(rng.randrange(1, 3)):
+            g = mutate(g, rng)
+        pool.append(g)
+
+    seen: set = set()
+    scored: dict[str, dict] = {}  # source → result row
+    for rnd_i in range(rounds):
+        for genome in pool:
+            src = render_source(genome)
+            if src in seen:
+                continue
+            seen.add(src)
+            try:
+                program = compile_expr(src, SCORE_INPUTS)
+            except CompileError as e:
+                scored[src] = {"source": src, "compile_error": str(e),
+                               "gate": None, "fitness": None}
+                continue
+            faults: list = []
+            rater = PolicyRater(
+                program, fallback=Binpack(),
+                name=f"twin-gen{rnd_i}",
+                on_fault=lambda *a, **k: faults.append(1),
+            )
+            gate = replay_gate(events, rater, incumbent,
+                               tolerance=tolerance)
+            burn = None
+            if gate["pass"] and burn_eval is not None:
+                try:
+                    burn = float(burn_eval(rater))
+                except Exception:
+                    burn = None
+            scored[src] = {
+                "source": src,
+                "genome": genome,
+                "gate": gate,
+                "faults": len(faults),
+                "burn": burn,
+                "fitness": _fitness(gate, burn) if gate["pass"] else None,
+                "wins": _neutral_wins(gate["candidate"],
+                                      gate["incumbent"])
+                if gate["pass"] else [],
+            }
+        # next generation: mutate + recombine the fitter half
+        passed = sorted(
+            (r for r in scored.values() if r.get("fitness") is not None),
+            key=lambda r: r["fitness"], reverse=True,
+        )
+        parents = [r["genome"] for r in passed[:max(2, population // 2)]]
+        if not parents:
+            parents = [seed_genome]
+        pool = []
+        while len(pool) < population:
+            if len(parents) >= 2 and rng.random() < 0.3:
+                a, b = rng.sample(range(len(parents)), 2)
+                child = crossover(parents[a], parents[b], rng)
+            else:
+                child = mutate(parents[rng.randrange(len(parents))], rng)
+            pool.append(child)
+
+    def _row(r: dict) -> dict:
+        gate = r["gate"]
+        out = {
+            "source": r["source"],
+            "fitness": r.get("fitness"),
+            "burn": r.get("burn"),
+            "faults": r.get("faults", 0),
+            "wins": r.get("wins", []),
+        }
+        if r.get("compile_error"):
+            out["compile_error"] = r["compile_error"]
+        if gate is not None:
+            out["gate"] = {
+                "pass": gate["pass"],
+                "reasons": gate["reasons"],
+                "candidate": {
+                    k: gate["candidate"][k]
+                    for k in ("placed", "unplaced", "contiguous_frac",
+                              "final_frag_mean", "mean_free_chip_frac")
+                },
+            }
+        return out
+
+    ranked = sorted(
+        (r for r in scored.values() if r.get("fitness") is not None),
+        key=lambda r: r["fitness"], reverse=True,
+    )
+    rejected = [r for r in scored.values() if r.get("fitness") is None]
+    # "beats" = gate-PASSED and strictly better on ≥1 rater-neutral
+    # metric.  The identity genome is excluded by its RENDERED source
+    # (render_source parenthesizes, so comparing against the raw
+    # incumbent_source string would never match).
+    identity = render_source(seed_genome)
+    beats = [r for r in ranked if r["wins"] and r["source"] != identity]
+    return {
+        "seed": seed,
+        "rounds": rounds,
+        "population": population,
+        "tolerance": tolerance,
+        "evaluated": len(scored),
+        "incumbent": {
+            "name": incumbent.name,
+            "source": incumbent_source,
+            "stats": {
+                k: inc_stats[k]
+                for k in ("placed", "unplaced", "contiguous_frac",
+                          "final_frag_mean", "mean_free_chip_frac")
+            },
+        },
+        "candidates": [_row(r) for r in ranked[:16]],
+        "rejected": [_row(r) for r in rejected[:16]],
+        "beats_incumbent": [_row(r) for r in beats[:8]],
+        "promotion": (
+            "nothing is applied automatically — promote a winner with "
+            "POST /policy/load (verb=score, source=<candidate>) and let "
+            "the replay gate + canary lifecycle take it from there"
+        ),
+    }

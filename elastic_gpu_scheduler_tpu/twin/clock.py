@@ -1,0 +1,45 @@
+"""Virtual time for the digital twin.
+
+A ``VirtualClock`` is a plain callable — drop-in for ``time.monotonic``
+everywhere a subsystem accepts a ``clock=`` hook (SloPlane, Autoscaler,
+DefragPlanner, Journal.wall_clock).  Time only moves when the scenario
+runner advances it, so a day of simulated workload folds into however
+many wall-seconds the event loop needs — and two same-seed runs read
+IDENTICAL timestamps, which is what makes twin journals byte-identical
+across runs.
+"""
+
+from __future__ import annotations
+
+
+class VirtualClock:
+    """Monotonic simulated time.  ``clock()`` reads, ``advance``/
+    ``advance_to`` move it forward; moving backward is refused (the
+    subsystems fed by this clock assume monotonic time, exactly like
+    ``time.monotonic``)."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"virtual clock cannot move backward ({dt})")
+        self._now += dt
+        return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Jump to absolute sim time ``t`` (no-op if already past it)."""
+        if t > self._now:
+            self._now = float(t)
+        return self._now
+
+    def __repr__(self) -> str:
+        return f"VirtualClock(t={self._now:.3f})"

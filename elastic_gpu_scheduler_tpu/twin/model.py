@@ -1,0 +1,359 @@
+"""Workload models for the digital twin.
+
+Two sources, one shape:
+
+- ``fit_workload_model(events, slo_state=None)`` — fitted from a
+  RECORDED journal: per-class arrival rate and pod lifetime from the
+  bind/forget stream, chip-shape mix from the recorded options,
+  tokens/s/chip by TPU generation + interference slowdowns from the
+  profile observatory's journaled EWMA snapshots, and (when the live
+  SLO plane's ``debug_state()`` rides along) per-class journey-latency
+  quantiles + ok-rate from the recorded journey windows.
+
+- ``synthesize_model(seed)`` — a seeded synthetic model for what-if
+  growth scenarios when there is nothing recorded yet.
+
+Latency generation uses inverse-transform sampling through the fitted
+(p50, p95, p99) quantiles — a piecewise-linear CDF — so a twin run's
+simulated journey population reproduces the recorded latency posture:
+if the recorded p95 sat above the objective threshold, the simulated
+p95 does too, which is what makes simulated SLO burn agree with the
+live-recorded posture.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_CLASS = "default"
+# synthetic-model fallbacks (tokens/s/chip by generation roughly in the
+# ratio of the profile observatory's bench fixtures)
+_DEFAULT_TPUT = {"v5e": 900.0, "v5p": 1800.0, "v6e": 1400.0}
+_DEFAULT_QUANTILES = {
+    "ttft": {"p50": 80.0, "p95": 180.0, "p99": 320.0},
+    "e2e": {"p50": 400.0, "p95": 900.0, "p99": 1600.0},
+    "queue": {"p50": 5.0, "p95": 20.0, "p99": 45.0},
+}
+
+
+@dataclass
+class ClassModel:
+    """Fitted behavior of one workload class."""
+
+    wclass: str = DEFAULT_CLASS
+    arrival_rate_per_s: float = 0.5  # pod/request arrivals
+    mean_lifetime_s: float = 120.0  # bind → forget
+    # request journeys observed per second (router vantage) — pods are
+    # long-lived serving replicas; journeys are the requests they serve
+    journeys_per_s: float = 10.0
+    # placement-shape mix: ("whole", n_chips, weight) | ("core", units, weight)
+    shapes: list = field(default_factory=lambda: [
+        ("whole", 2, 0.3), ("core", 100, 0.4), ("core", 50, 0.3),
+    ])
+    prompt_tokens_mean: float = 512.0
+    output_tokens_mean: float = 128.0
+    tokens_per_sec_per_chip: dict = field(
+        default_factory=lambda: dict(_DEFAULT_TPUT)
+    )
+    # neighbor class → throughput ratio under co-tenancy (1.0 = no slow-
+    # down), the profile observatory's interference_matrix row
+    interference: dict = field(default_factory=dict)
+    # journey latency quantiles in ms: metric → {p50, p95, p99}
+    latency_ms: dict = field(
+        default_factory=lambda: {
+            m: dict(q) for m, q in _DEFAULT_QUANTILES.items()
+        }
+    )
+    ok_rate: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {
+            "wclass": self.wclass,
+            "arrival_rate_per_s": round(self.arrival_rate_per_s, 6),
+            "mean_lifetime_s": round(self.mean_lifetime_s, 3),
+            "journeys_per_s": round(self.journeys_per_s, 4),
+            "shapes": [list(s) for s in self.shapes],
+            "prompt_tokens_mean": round(self.prompt_tokens_mean, 1),
+            "output_tokens_mean": round(self.output_tokens_mean, 1),
+            "tokens_per_sec_per_chip": {
+                g: round(v, 3)
+                for g, v in sorted(self.tokens_per_sec_per_chip.items())
+            },
+            "interference": {
+                k: round(v, 4) for k, v in sorted(self.interference.items())
+            },
+            "latency_ms": {
+                m: {q: round(v, 3) for q, v in sorted(qs.items())}
+                for m, qs in sorted(self.latency_ms.items())
+            },
+            "ok_rate": round(self.ok_rate, 4),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClassModel":
+        m = cls(wclass=d.get("wclass", DEFAULT_CLASS))
+        for k in ("arrival_rate_per_s", "mean_lifetime_s", "journeys_per_s",
+                  "prompt_tokens_mean", "output_tokens_mean", "ok_rate"):
+            if d.get(k) is not None:
+                setattr(m, k, float(d[k]))
+        if d.get("shapes"):
+            m.shapes = [
+                (str(kind), int(val), float(w)) for kind, val, w in d["shapes"]
+            ]
+        if d.get("tokens_per_sec_per_chip"):
+            m.tokens_per_sec_per_chip = {
+                str(g): float(v)
+                for g, v in d["tokens_per_sec_per_chip"].items()
+            }
+        if d.get("interference"):
+            m.interference = {
+                str(k): float(v) for k, v in d["interference"].items()
+            }
+        if d.get("latency_ms"):
+            m.latency_ms = {
+                str(metric): {str(q): float(v) for q, v in qs.items()}
+                for metric, qs in d["latency_ms"].items()
+            }
+        return m
+
+
+@dataclass
+class WorkloadModel:
+    """Per-class models + provenance.  ``source`` is ``fitted`` when the
+    numbers came from a recording, ``synthetic`` otherwise — twin
+    reports carry it so a capacity answer can never silently rest on
+    made-up inputs."""
+
+    classes: dict = field(default_factory=dict)  # wclass → ClassModel
+    source: str = "synthetic"
+    recorded_span_s: float = 0.0
+    recorded_binds: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "source": self.source,
+            "recorded_span_s": round(self.recorded_span_s, 3),
+            "recorded_binds": self.recorded_binds,
+            "classes": {
+                cls: m.to_dict() for cls, m in sorted(self.classes.items())
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadModel":
+        return cls(
+            classes={
+                str(c): ClassModel.from_dict(m)
+                for c, m in (d.get("classes") or {}).items()
+            },
+            source=str(d.get("source", "synthetic")),
+            recorded_span_s=float(d.get("recorded_span_s", 0.0)),
+            recorded_binds=int(d.get("recorded_binds", 0)),
+        )
+
+
+def sample_latency(rng: random.Random, quantiles: dict) -> float:
+    """Inverse-transform sample (ms) through a piecewise-linear CDF
+    pinned at the fitted p50/p95/p99 — the cheapest generator whose
+    OWN p50/p95/p99 reproduce the fitted ones."""
+    p50 = float(quantiles.get("p50", 1.0))
+    p95 = max(p50, float(quantiles.get("p95", p50)))
+    p99 = max(p95, float(quantiles.get("p99", p95)))
+    u = rng.random()
+    if u < 0.5:
+        lo = p50 * 0.25  # fitted floor: fastest journeys ~ quarter-median
+        return lo + (p50 - lo) * (u / 0.5)
+    if u < 0.95:
+        return p50 + (p95 - p50) * ((u - 0.5) / 0.45)
+    if u < 0.99:
+        return p95 + (p99 - p95) * ((u - 0.95) / 0.04)
+    return p99 * (1.0 + (u - 0.99) * 5.0)  # bounded tail past p99
+
+
+def objectives_spec_from_events(events: list) -> Optional[dict]:
+    """Recover a ``SloPlane.load_config`` spec from the LAST journaled
+    ``slo``/``objectives`` record (the plane journals its full config on
+    every load), so a recorded scenario replays under exactly the
+    objectives the live plane enforced.  None when never journaled."""
+    spec = None
+    for rec in events:
+        if rec.get("type") != "slo" or rec.get("action") != "objectives":
+            continue
+        classes = {}
+        for cls, objs in (rec.get("classes") or {}).items():
+            entry = {}
+            for key, o in (objs or {}).items():
+                if o.get("metric") == "availability":
+                    entry[key] = o.get("target")
+                else:
+                    entry[key] = o.get("threshold_ms")
+            if entry:
+                classes[cls] = entry
+        if classes:
+            spec = {
+                "classes": classes,
+                "window_short_s": rec.get("window_short_s", 60),
+                "window_long_s": rec.get("window_long_s", 300),
+                "burn_threshold": rec.get("burn_threshold", 1.0),
+            }
+    return spec
+
+
+def _option_shape(option: dict):
+    """("whole", chips) | ("core", units) for one recorded bind option."""
+    whole_chips = 0
+    core_units = 0
+    for alloc in option.get("allocs") or []:
+        try:
+            _name, coords, whole, core, _hbm, _contig = alloc
+        except (TypeError, ValueError):
+            continue
+        if whole:
+            whole_chips += len(coords)
+        elif core:
+            core_units += int(core)
+    if whole_chips:
+        return ("whole", whole_chips)
+    if core_units:
+        return ("core", core_units)
+    return None
+
+
+def fit_workload_model(events: list,
+                       slo_state: Optional[dict] = None) -> WorkloadModel:
+    """Fit per-class models from a recorded journal (+ optionally the
+    live SLO plane's ``debug_state()`` for journey-latency quantiles).
+
+    Journal inputs: ``bind``/``forget`` arrivals, lifetimes and shape
+    mix (keyed by the bind's ``wclass``); the LAST ``profile`` record's
+    per-class tokens/s/chip EWMAs and interference matrix.  Raises
+    ValueError when the recording holds no binds — a model fitted from
+    nothing must fail loudly, not simulate silence."""
+    binds_by_class: dict[str, list[float]] = {}
+    shapes_by_class: dict[str, dict] = {}
+    bind_at: dict[str, tuple[str, float]] = {}  # pod → (wclass, t)
+    lifetimes: dict[str, list[float]] = {}
+    last_profile: Optional[dict] = None
+    t_min = t_max = None
+    for rec in events:
+        t = rec.get("t")
+        rtype = rec.get("type")
+        if rtype == "profile":
+            last_profile = rec
+            continue
+        if rtype not in ("bind", "forget") or t is None:
+            continue
+        t = float(t)
+        t_min = t if t_min is None else min(t_min, t)
+        t_max = t if t_max is None else max(t_max, t)
+        if rtype == "bind":
+            if rec.get("source") == "replay":
+                continue  # restart re-assertion, not an arrival
+            wclass = rec.get("wclass") or DEFAULT_CLASS
+            binds_by_class.setdefault(wclass, []).append(t)
+            if rec.get("pod"):
+                bind_at[rec["pod"]] = (wclass, t)
+            shape = _option_shape(rec.get("option") or {})
+            if shape is not None:
+                counts = shapes_by_class.setdefault(wclass, {})
+                counts[shape] = counts.get(shape, 0) + 1
+        else:
+            entry = bind_at.pop(rec.get("pod"), None)
+            if entry is not None:
+                wclass, t0 = entry
+                lifetimes.setdefault(wclass, []).append(max(0.0, t - t0))
+    total_binds = sum(len(v) for v in binds_by_class.values())
+    if not total_binds:
+        raise ValueError(
+            "cannot fit a workload model: the recording holds no bind "
+            "records"
+        )
+    span = max(1e-6, (t_max - t_min)) if t_min is not None else 1e-6
+
+    profiles = (last_profile or {}).get("profiles") or {}
+    interference = (last_profile or {}).get("interference") or {}
+    windows = (slo_state or {}).get("windows") or {}
+
+    model = WorkloadModel(
+        source="fitted", recorded_span_s=span, recorded_binds=total_binds,
+    )
+    for wclass, arrivals in sorted(binds_by_class.items()):
+        m = ClassModel(wclass=wclass)
+        m.arrival_rate_per_s = len(arrivals) / span
+        lt = lifetimes.get(wclass) or []
+        if lt:
+            m.mean_lifetime_s = max(1e-3, sum(lt) / len(lt))
+        else:
+            # nothing forgotten during the recording: pods outlive it
+            m.mean_lifetime_s = span
+        counts = shapes_by_class.get(wclass) or {}
+        n = sum(counts.values())
+        if n:
+            m.shapes = [
+                (kind, val, cnt / n)
+                for (kind, val), cnt in sorted(counts.items())
+            ]
+        prof = profiles.get(wclass) or {}
+        tput = prof.get("tput") or prof.get("tokens_per_sec_per_chip")
+        if isinstance(tput, dict) and tput:
+            m.tokens_per_sec_per_chip = {
+                str(g): float(v) for g, v in tput.items() if v
+            }
+        inter = interference.get(wclass)
+        if isinstance(inter, dict):
+            m.interference = {
+                str(k): float(v) for k, v in inter.items()
+            }
+        win = windows.get(wclass) or {}
+        win_short = float((slo_state or {}).get("window_short_s") or 60.0)
+        if win.get("samples"):
+            m.journeys_per_s = max(
+                1e-3, float(win["samples"]) / max(1.0, win_short)
+            )
+        for metric in ("ttft", "e2e", "queue", "tpot", "hop"):
+            q = win.get(metric + "_ms")
+            if isinstance(q, dict) and q.get("p50") is not None:
+                m.latency_ms[metric] = {
+                    "p50": float(q["p50"]),
+                    "p95": float(q.get("p95", q["p50"])),
+                    "p99": float(q.get("p99", q.get("p95", q["p50"]))),
+                }
+        if win.get("ok_frac") is not None:
+            m.ok_rate = float(win["ok_frac"])
+        model.classes[wclass] = m
+    return model
+
+
+def synthesize_model(seed: int = 20260807,
+                     classes=("serve", "batch")) -> WorkloadModel:
+    """A seeded synthetic model for growth what-ifs with no recording.
+    Everything derives from one RNG so the same seed reproduces the
+    same fleet-scale answer bit-for-bit (the fleetgen stance)."""
+    rng = random.Random(seed)
+    model = WorkloadModel(source="synthetic")
+    for i, wclass in enumerate(classes):
+        m = ClassModel(wclass=wclass)
+        m.arrival_rate_per_s = round(rng.uniform(0.05, 0.15), 3)
+        m.mean_lifetime_s = round(rng.uniform(40.0, 80.0), 1)
+        m.journeys_per_s = round(rng.uniform(5.0, 20.0), 2)
+        whole_w = round(rng.uniform(0.3, 0.7), 2)
+        m.shapes = [
+            ("whole", rng.choice((1, 2)), whole_w),
+            ("core", rng.choice((50, 100)), round(1.0 - whole_w, 2)),
+        ]
+        m.prompt_tokens_mean = float(rng.choice((256, 512, 1024)))
+        m.output_tokens_mean = float(rng.choice((64, 128, 256)))
+        m.tokens_per_sec_per_chip = {
+            g: round(v * rng.uniform(0.9, 1.1), 1)
+            for g, v in _DEFAULT_TPUT.items()
+        }
+        base = 1.0 + i * 0.5  # later classes arrive slower-served
+        m.latency_ms = {
+            metric: {q: round(v * base, 1) for q, v in qs.items()}
+            for metric, qs in _DEFAULT_QUANTILES.items()
+        }
+        m.ok_rate = 0.999
+        model.classes[wclass] = m
+    return model

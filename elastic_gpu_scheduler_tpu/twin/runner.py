@@ -1,0 +1,902 @@
+"""The twin scenario runner: time-warped fleet simulation.
+
+A ``TwinRunner`` replays a recorded journal workload — or a seeded
+synthetic growth curve — through the REAL decision code paths under a
+``VirtualClock``:
+
+- placement searches run through ``ChipSet.trade`` with the real raters
+  (binpack / spread / policy expressions), exactly the engine the live
+  scheduler binds through;
+- scaling decisions run through the real ``PolicyEngine.evaluate``
+  state machine (hysteresis, cooldowns, SLO-burn veto);
+- defrag rounds run through the real ``DefragPlanner.plan`` over shim
+  engines (planning on clones, moves applied by the runner);
+- SLO burn runs through a fresh ``SloPlane`` fed synthesized journeys
+  whose latency population reproduces the fitted quantiles.
+
+Isolation contract: the runner builds FRESH instances of everything —
+its own ``Journal``, its own ``SloPlane``, its own ``PolicyEngine`` and
+``DefragPlanner``, its own ChipSets.  It never reads or writes the
+process-global ``JOURNAL`` / ``SLO`` / ``PROFILER`` singletons, so a
+twin run on a live control plane leaves live scheduler state, journal
+sequence numbers, and metrics untouched (tests/test_twin.py holds this
+as a regression).
+
+The twin journal is a REAL journal: it replays through the existing
+``ReplayEngine`` invariant checks (chip conservation, dense seqs,
+double-bind/double-free), and its head/tail ``twin`` annotation records
+mark the stream as simulated.  Virtual timestamps + a single seeded RNG
+make two same-seed runs byte-identical.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.allocator import ChipSet
+from ..core.rater import Binpack, ICILocality, Random as RandomRater, Spread
+from ..core.request import TPURequest, TPUUnit
+from ..journal import Journal, option_record, read_journal
+from ..journal.replay import (
+    chipset_from_record,
+    option_from_record,
+    replay,
+    request_from_option,
+)
+from ..utils import consts
+from .clock import VirtualClock
+from .model import (
+    WorkloadModel,
+    fit_workload_model,
+    objectives_spec_from_events,
+    sample_latency,
+    synthesize_model,
+)
+
+# last completed run's report — the /debug/twin payload
+_LAST_LOCK = threading.Lock()
+_LAST_REPORT: Optional[dict] = None
+
+_BUILTIN_RATERS = {
+    "binpack": Binpack,
+    "spread": Spread,
+    "ici-locality": ICILocality,
+    "random": RandomRater,
+}
+
+# default objectives when a scenario carries none and the recording
+# never journaled a load — matches the check-slo fixture shape
+_DEFAULT_SLO_SPEC = {
+    "window_short_s": 60,
+    "window_long_s": 300,
+    "burn_threshold": 1.0,
+    "min_samples": 5,
+    "classes": {
+        "default": {"e2e_p95_ms": 2000.0, "availability": 0.99},
+    },
+}
+
+# synthetic fleet templates: (generation, host dims, hbm GiB/chip) —
+# the fleetgen host shape (4 chips per host, 2x2); tools/fleetgen.py's
+# ``twin_fleet`` builds richer slice-tiled mixes in this same wire form
+_SYNTH_TEMPLATES = (
+    ("v5e", (2, 2), 16),
+    ("v5e", (2, 2), 16),
+    ("v5p", (2, 2), 95),
+    ("v6e", (2, 2), 24),
+)
+
+
+def synthesize_fleet(nodes: int = 4, seed: int = 20260807) -> list:
+    """Seeded synthetic node specs in journal ``node_add`` wire form:
+    ``{"node", "generation", "dims", "wrap", "chips"}`` — the runner
+    feeds each through ``chipset_from_record`` so a synthetic fleet is
+    built by the exact decoder replay uses for recorded ones."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(nodes):
+        gen, dims, hbm = _SYNTH_TEMPLATES[rng.randrange(
+            len(_SYNTH_TEMPLATES)
+        )]
+        coords = []
+        for x in range(dims[0]):
+            for y in range(dims[1]):
+                coords.append([x, y])
+        out.append({
+            "node": f"twin-{gen}-{i}",
+            "generation": gen,
+            "dims": list(dims),
+            "wrap": [False] * len(dims),
+            "chips": [[c, consts.CORE_PER_CHIP, hbm] for c in coords],
+        })
+    return out
+
+
+def resolve_twin_rater(spec) -> object:
+    """Rater for a twin scenario: an already-built Rater object passes
+    through (autosearch candidates); a built-in name resolves to a fresh
+    instance; anything else is compiled as a policy EXPRESSION with a
+    binpack fallback — the twin never reads the live POLICIES registry,
+    so a what-if cannot depend on (or perturb) loaded policy state."""
+    if not isinstance(spec, str):
+        return spec  # duck-typed Rater
+    name = spec.strip()
+    if name in _BUILTIN_RATERS:
+        return _BUILTIN_RATERS[name]()
+    from ..policy.lang import compile_expr
+    from ..policy.rater import PolicyRater, SCORE_INPUTS
+
+    program = compile_expr(name, SCORE_INPUTS)
+    return PolicyRater(program, fallback=Binpack(), name="twin-expr")
+
+
+@dataclass
+class TwinScenario:
+    """One simulation's knobs.  ``mode`` is ``recorded`` (replay a
+    journal's bind/forget stream, re-placing with the scenario rater)
+    or ``synthetic`` (generate arrivals from the workload model, with
+    ``arrival_scale``/``growth`` warping the curve for what-ifs)."""
+
+    name: str = "twin"
+    mode: str = "synthetic"  # recorded | synthetic
+    seed: int = 20260807
+    duration_s: float = 1800.0  # simulated span (≥30 sim-minutes default)
+    step_s: float = 1.0
+    arrival_scale: float = 1.0  # journey-rate multiplier (what-if load)
+    growth: float = 1.0  # rate multiplier reached at duration end (ramp)
+    rater: str = "binpack"
+    replicas: int = 2  # serving replicas at t=0 (autoscaler's fleet)
+    chips_per_replica: int = 4
+    slo: Optional[dict] = None  # SloPlane.load_config spec override
+    policy: Optional[dict] = None  # ScalingPolicy kwargs override
+    autoscaler_interval_s: float = 5.0
+    defrag_mode: str = "auto"  # off disables twin defrag rounds
+    defrag_threshold: float = 0.5
+    defrag_interval_s: float = 30.0
+    nodes: int = 4  # synthetic fleet size when ``fleet`` is None
+    fleet: Optional[list] = None  # node_add-shaped specs (fleetgen)
+    out_dir: Optional[str] = None  # twin journal dir (tempdir when None)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "mode": self.mode, "seed": self.seed,
+            "duration_s": self.duration_s, "step_s": self.step_s,
+            "arrival_scale": self.arrival_scale, "growth": self.growth,
+            "rater": self.rater if isinstance(self.rater, str)
+            else getattr(self.rater, "name", "custom"),
+            "replicas": self.replicas,
+            "chips_per_replica": self.chips_per_replica,
+            "slo": self.slo, "policy": self.policy,
+            "autoscaler_interval_s": self.autoscaler_interval_s,
+            "defrag_mode": self.defrag_mode,
+            "defrag_threshold": self.defrag_threshold,
+            "defrag_interval_s": self.defrag_interval_s,
+            "nodes": self.nodes,
+            "out_dir": self.out_dir,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TwinScenario":
+        kwargs = {}
+        for f_ in ("name", "mode", "rater", "out_dir", "defrag_mode"):
+            if d.get(f_) is not None:
+                kwargs[f_] = d[f_]
+        for f_ in ("seed", "replicas", "chips_per_replica", "nodes"):
+            if d.get(f_) is not None:
+                kwargs[f_] = int(d[f_])
+        for f_ in ("duration_s", "step_s", "arrival_scale", "growth",
+                   "autoscaler_interval_s", "defrag_threshold",
+                   "defrag_interval_s"):
+            if d.get(f_) is not None:
+                kwargs[f_] = float(d[f_])
+        for f_ in ("slo", "policy"):
+            if isinstance(d.get(f_), dict):
+                kwargs[f_] = d[f_]
+        if isinstance(d.get("fleet"), list):
+            kwargs["fleet"] = d["fleet"]
+        sc = cls(**kwargs)
+        if sc.mode not in ("recorded", "synthetic"):
+            raise ValueError(f"twin mode {sc.mode!r} not in "
+                             "('recorded', 'synthetic')")
+        if sc.duration_s <= 0 or sc.step_s <= 0:
+            raise ValueError("twin duration_s/step_s must be positive")
+        return sc
+
+
+# -- defrag shims -------------------------------------------------------------
+# DefragPlanner plans over any object exposing the engine surface it
+# reads (lock / allocators / pod_maps) plus a clientset with get_pod.
+# The runner owns move EXECUTION (the planner's execute path calls the
+# live scheduler's migrate_pod; the twin applies moves to its own
+# ChipSets and journals the migrate records itself).
+
+
+class _NodeShim:
+    __slots__ = ("lock", "chips", "generation")
+
+    def __init__(self, chips: ChipSet, generation: str):
+        self.lock = threading.Lock()
+        self.chips = chips
+        self.generation = generation
+
+
+class _SchedShim:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.allocators: dict[str, _NodeShim] = {}
+        self.pod_maps: dict[str, tuple] = {}
+
+    def frag_snapshot(self) -> dict:
+        with self.lock:
+            allocs = dict(self.allocators)
+        out = {}
+        for name, na in allocs.items():
+            with na.lock:
+                idx, largest, _free = na.chips.fragmentation()
+            out[name] = (idx, largest)
+        return out
+
+
+class _ClientsetShim:
+    """get_pod over the runner's simulated pod table."""
+
+    def __init__(self):
+        self.pods: dict[str, object] = {}  # "ns/name" → Pod
+
+    def get_pod(self, namespace: str, name: str):
+        return self.pods[f"{namespace}/{name}"]
+
+
+@dataclass
+class _SimPod:
+    """One simulated tenant: its placement plus departure time."""
+
+    key: str
+    uid: str
+    wclass: str
+    node: str
+    option: object
+    chips_equiv: float
+    expires_at: Optional[float] = None  # None = recorded forget drives it
+
+
+class TwinRunner:
+    """One scenario, one run.  Single-threaded by design: the event loop
+    is the only writer, which is what lets two same-seed runs produce
+    byte-identical journals (dict insertion order, seq order and virtual
+    timestamps are all deterministic)."""
+
+    def __init__(self, scenario: TwinScenario, events: Optional[list] = None,
+                 slo_state: Optional[dict] = None,
+                 model: Optional[WorkloadModel] = None,
+                 rater=None):
+        from ..defrag import DefragPlanner
+        from ..fleet.autoscaler import PolicyEngine, ScalingPolicy
+        from ..slo import SloPlane
+
+        self.scenario = scenario
+        self.events = events or []
+        if scenario.mode == "recorded" and not self.events:
+            raise ValueError("recorded twin mode needs journal events")
+        self.clock = VirtualClock()
+        self.rng = random.Random(scenario.seed)
+        self.rater = rater if rater is not None else resolve_twin_rater(
+            scenario.rater
+        )
+
+        # workload model: explicit > fitted-from-recording > synthetic
+        if model is not None:
+            self.model = model
+        elif self.events:
+            self.model = fit_workload_model(self.events, slo_state)
+        else:
+            self.model = synthesize_model(scenario.seed)
+
+        # the twin's OWN journal, stamped with virtual time.  The ALL-CAPS
+        # attribute name keeps the journal-discipline lint honest: it
+        # recognizes `JOURNAL.record(...)` receivers as the choke point,
+        # and the twin's mutations must journal HERE, never globally.
+        self.out_dir = scenario.out_dir or tempfile.mkdtemp(prefix="twin-")
+        self.JOURNAL = Journal()
+        self.JOURNAL.wall_clock = self.clock
+        self.JOURNAL.configure(self.out_dir, fsync="off")
+
+        # fresh SLO plane on the virtual clock, sinking into OUR journal
+        self.plane = SloPlane(clock=self.clock)
+        self.plane.journal = self.JOURNAL
+        spec = (scenario.slo or objectives_spec_from_events(self.events)
+                or _DEFAULT_SLO_SPEC)
+        # a twin run folds ~duration/step journeys per class — keep the
+        # recorded min_samples so burn math matches the live plane's
+        self.plane.load_config(spec)
+
+        # real scaling state machine
+        self.engine = PolicyEngine(ScalingPolicy(**(scenario.policy or {})))
+        self.replicas = max(
+            self.engine.policy.min_replicas,
+            min(scenario.replicas, self.engine.policy.max_replicas),
+        )
+
+        # fleet + defrag shims
+        self.sched = _SchedShim()
+        self.clientset = _ClientsetShim()
+        self.planner = DefragPlanner(
+            engines=[self.sched],
+            clientset=self.clientset,
+            mode=scenario.defrag_mode if scenario.defrag_mode == "auto"
+            else "observe",
+            threshold=scenario.defrag_threshold,
+            min_interval_s=scenario.defrag_interval_s,
+            clock=self.clock,
+        )
+        self.defrag_enabled = scenario.defrag_mode != "off"
+
+        # sim state
+        self.pods: dict[str, _SimPod] = {}
+        self.backlog = 0.0  # queued requests (autoscaler signal source)
+        self.binds = self.unplaced = self.forgets = 0
+        self.contiguous = 0
+        self.scores: list[float] = []
+        self.migrations = 0
+        self.fleet_decisions: dict[str, int] = {}
+        self.journeys = 0
+        self.bind_walls: list[float] = []  # wall seconds per placement
+        self._arrival_acc: dict[str, float] = {}
+        self._pod_serial = 0
+        # per-class token accounting for the model-drift gate
+        self._served_tokens: dict[str, float] = {}
+        self._chip_seconds: dict[str, float] = {}
+        self._eff_tput_acc: dict[str, list] = {}  # [eff·dt sum, dt sum]
+
+    # -- fleet construction ---------------------------------------------------
+
+    def _node_specs(self) -> list:
+        if self.scenario.mode == "recorded":
+            specs: dict[str, dict] = {}
+            for rec in self.events:
+                if rec.get("type") in ("node_add", "node_resync"):
+                    specs[rec["node"]] = {
+                        "node": rec["node"],
+                        "generation": rec.get("generation") or "v5e",
+                        "dims": rec["dims"],
+                        "wrap": rec["wrap"],
+                        "chips": rec["chips"],
+                    }
+                elif rec.get("type") == "checkpoint" and not specs:
+                    for name, inv in sorted(
+                        (rec.get("nodes") or {}).items()
+                    ):
+                        specs[name] = {"node": name, "generation": "v5e",
+                                       **inv}
+            if not specs:
+                raise ValueError(
+                    "recorded twin mode: journal holds no node_add records"
+                )
+            return [specs[n] for n in sorted(specs)]
+        return self.scenario.fleet or synthesize_fleet(
+            self.scenario.nodes, self.scenario.seed
+        )
+
+    def _boot_fleet(self) -> None:
+        for spec in self._node_specs():
+            cs = chipset_from_record(spec)
+            self.sched.allocators[spec["node"]] = _NodeShim(
+                cs, spec["generation"]
+            )
+            self.JOURNAL.record(
+                "node_add", node=spec["node"],
+                generation=spec["generation"], dims=list(spec["dims"]),
+                wrap=list(spec["wrap"]),
+                chips=[list(c) for c in spec["chips"]],
+            )
+
+    # -- placement ------------------------------------------------------------
+
+    def _place(self, req: TPURequest, wclass: str,
+               prefer_node: Optional[str] = None):
+        """(node, Option) via the real placement search: try the
+        preferred node first (recorded mode re-places on the recorded
+        node, the what-if stance), else rate over every node and take
+        the best score — the scheduler's find-best loop in miniature."""
+        t0 = time.perf_counter()
+        best = None
+        names = ([prefer_node] if prefer_node else
+                 sorted(self.sched.allocators))
+        for name in names:
+            shim = self.sched.allocators.get(name)
+            if shim is None:
+                continue
+            opt = shim.chips.trade(req, self.rater)
+            if opt is not None and (best is None or opt.score > best[2]):
+                best = (name, opt, opt.score)
+        self.bind_walls.append(time.perf_counter() - t0)
+        if best is None:
+            return None
+        return best[0], best[1]
+
+    def _bind(self, key: str, uid: str, wclass: str, node: str, opt,
+              expires_at: Optional[float], source: str) -> None:
+        shim = self.sched.allocators[node]
+        shim.chips.transact(opt)
+        chips_equiv = sum(
+            len(a.coords) if a.whole
+            else max(a.core, 0) / consts.CORE_PER_CHIP
+            for a in opt.allocs if a.needs_tpu
+        )
+        self.pods[key] = _SimPod(
+            key=key, uid=uid, wclass=wclass, node=node, option=opt,
+            chips_equiv=chips_equiv, expires_at=expires_at,
+        )
+        self.sched.pod_maps[key] = (node, opt)
+        self.clientset.pods[key] = self._make_pod(key, uid)
+        self.binds += 1
+        self.scores.append(opt.score)
+        if all(a.contiguous for a in opt.allocs if a.needs_tpu):
+            self.contiguous += 1
+        self.JOURNAL.record(
+            "bind", pod=key, uid=uid, node=node,
+            option=option_record(opt), gang=None, source=source,
+            wclass=wclass,
+        )
+
+    @staticmethod
+    def _make_pod(key: str, uid: str):
+        from ..k8s.objects import make_pod
+
+        ns, _, name = key.partition("/")
+        return make_pod(name, namespace=ns, uid=uid, priority=0)
+
+    def _forget(self, key: str, source: str) -> None:
+        pod = self.pods.pop(key, None)
+        if pod is None:
+            return
+        shim = self.sched.allocators.get(pod.node)
+        if shim is not None and shim.chips.can_cancel(pod.option):
+            shim.chips.cancel(pod.option)
+        self.sched.pod_maps.pop(key, None)
+        self.clientset.pods.pop(key, None)
+        self.forgets += 1
+        self.JOURNAL.record("forget", pod=key, uid=pod.uid, node=pod.node,
+                            source=source)
+
+    # -- synthetic arrivals ---------------------------------------------------
+
+    def _growth_factor(self, t: float) -> float:
+        sc = self.scenario
+        frac = min(1.0, t / sc.duration_s) if sc.duration_s > 0 else 1.0
+        return sc.arrival_scale * (1.0 + (sc.growth - 1.0) * frac)
+
+    def _spawn_synthetic(self, t: float) -> None:
+        for wclass in sorted(self.model.classes):
+            cm = self.model.classes[wclass]
+            acc = self._arrival_acc.get(wclass, 0.0)
+            acc += (cm.arrival_rate_per_s * self._growth_factor(t)
+                    * self.scenario.step_s)
+            while acc >= 1.0:
+                acc -= 1.0
+                self._pod_serial += 1
+                key = f"twin/{wclass}-{self._pod_serial}"
+                uid = f"twin-uid-{self._pod_serial}"
+                shape = self._pick_shape(cm)
+                if shape[0] == "whole":
+                    unit = TPUUnit(core=0, hbm=0, chip_count=shape[1])
+                else:
+                    unit = TPUUnit(core=shape[1], hbm=0, chip_count=0)
+                req = TPURequest(
+                    pod_uid=uid, pod_key=key, units=(unit,),
+                    container_names=("main",),
+                )
+                placed = self._place(req, wclass)
+                if placed is None:
+                    self.unplaced += 1
+                    continue
+                life = self.rng.expovariate(1.0 / cm.mean_lifetime_s)
+                self._bind(key, uid, wclass, placed[0], placed[1],
+                           expires_at=t + max(self.scenario.step_s, life),
+                           source="twin")
+            self._arrival_acc[wclass] = acc
+
+    def _pick_shape(self, cm) -> tuple:
+        total = sum(w for _k, _v, w in cm.shapes) or 1.0
+        pick = self.rng.random() * total
+        for kind, val, w in cm.shapes:
+            pick -= w
+            if pick <= 0:
+                return (kind, val)
+        return (cm.shapes[-1][0], cm.shapes[-1][1])
+
+    def _expire_pods(self, t: float) -> None:
+        for key in [k for k, p in sorted(self.pods.items())
+                    if p.expires_at is not None and p.expires_at <= t]:
+            self._forget(key, source="twin")
+
+    # -- recorded replay ------------------------------------------------------
+
+    def _recorded_schedule(self) -> list:
+        """(rel_t, rec) for the workload records, clipped to the
+        scenario duration.  Relative to the recording's first timestamp
+        so virtual time starts at 0 like synthetic runs."""
+        rows = []
+        t0 = None
+        for rec in self.events:
+            if rec.get("type") not in ("bind", "forget", "migrate"):
+                continue
+            if rec.get("type") == "bind" and rec.get("source") == "replay":
+                continue  # restart re-assertion, not an arrival
+            t = rec.get("t")
+            if t is None:
+                continue
+            if t0 is None:
+                t0 = float(t)
+            rel = float(t) - t0
+            if rel > self.scenario.duration_s:
+                break
+            rows.append((rel, rec))
+        return rows
+
+    def _apply_recorded(self, rec: dict) -> None:
+        t = rec["type"]
+        if t == "bind":
+            key = rec.get("pod") or "?"
+            if key in self.pods:
+                return
+            try:
+                recorded = option_from_record(rec["option"])
+            except Exception:
+                return
+            wclass = rec.get("wclass") or self.plane.default_class
+            req = request_from_option(recorded, key, rec.get("uid", ""))
+            placed = self._place(req, wclass, prefer_node=rec.get("node"))
+            if placed is None:
+                # the scenario rater cannot place what the recording did
+                # on the same node state — count it loudly, then keep
+                # the stream consistent with the recorded option
+                self.unplaced += 1
+                shim = self.sched.allocators.get(rec.get("node"))
+                if shim is None or not shim.chips.can_transact(recorded):
+                    return
+                placed = (rec.get("node"), recorded)
+            self._bind(key, rec.get("uid", ""), wclass, placed[0],
+                       placed[1], expires_at=None, source="twin")
+        elif t == "forget":
+            self._forget(rec.get("pod") or "?", source="twin")
+        # recorded migrates are skipped: the twin runs its OWN defrag
+        # rounds through the real planner, which is the point
+
+    # -- journeys + SLO burn --------------------------------------------------
+
+    def _capacity_tokens_per_s(self, wclass: str, cm) -> tuple:
+        """(capacity tokens/s, effective tokens/s/chip) for one class:
+        replica chips × measured tokens/s/chip × the measured
+        interference factor, on the generation mix actually placed
+        (falls back to the fleet's generation mix when the class has no
+        placed pods).  The per-chip rate is also the drift reference —
+        the sim must SERVE at exactly the modeled per-chip rate, so any
+        divergence in the report's ``model_drift`` means the simulation
+        arithmetic broke, not that load was high."""
+        gens: dict[str, float] = {}
+        for p in self.pods.values():
+            if p.wclass != wclass:
+                continue
+            shim = self.sched.allocators.get(p.node)
+            gen = shim.generation if shim is not None else "v5e"
+            gens[gen] = gens.get(gen, 0.0) + p.chips_equiv
+        if not gens:
+            for shim in self.sched.allocators.values():
+                gens[shim.generation] = gens.get(shim.generation, 0.0) + 1.0
+        total_w = sum(gens.values()) or 1.0
+        tput = sum(
+            w * cm.tokens_per_sec_per_chip.get(
+                gen, sum(cm.tokens_per_sec_per_chip.values())
+                / max(1, len(cm.tokens_per_sec_per_chip)),
+            )
+            for gen, w in gens.items()
+        ) / total_w
+        inter = min(cm.interference.values()) if cm.interference else 1.0
+        eff = max(1e-6, tput * max(0.1, inter))
+        chips = self.replicas * self.scenario.chips_per_replica
+        return max(1e-6, chips * eff), eff
+
+    def _tick_journeys(self, t: float) -> dict:
+        """Synthesize this step's journeys per class and fold them into
+        the SLO plane; returns the autoscaler signals derived from the
+        same demand/capacity balance (so scaling sees the load that is
+        burning the budget, like live /v1/stats would)."""
+        sc = self.scenario
+        demand_req = served_req = 0.0
+        rho_worst = 0.0
+        for wclass in sorted(self.model.classes):
+            cm = self.model.classes[wclass]
+            rate = cm.journeys_per_s * self._growth_factor(t)
+            tokens_per_req = cm.prompt_tokens_mean + cm.output_tokens_mean
+            capacity, eff_tput = self._capacity_tokens_per_s(wclass, cm)
+            rho = rate * tokens_per_req / capacity
+            rho_worst = max(rho_worst, rho)
+            slowdown = max(1.0, rho)
+            demand_req += rate
+            served_req += min(rate, capacity / tokens_per_req)
+            self._served_tokens[wclass] = (
+                self._served_tokens.get(wclass, 0.0)
+                + min(rate * tokens_per_req, capacity) * sc.step_s
+            )
+            self._chip_seconds[wclass] = (
+                self._chip_seconds.get(wclass, 0.0)
+                + self.replicas * sc.chips_per_replica * sc.step_s
+                * (min(1.0, rho))
+            )
+            self._eff_tput_acc[wclass] = (
+                self._eff_tput_acc.get(wclass, [0.0, 0.0])
+            )
+            self._eff_tput_acc[wclass][0] += eff_tput * sc.step_s
+            self._eff_tput_acc[wclass][1] += sc.step_s
+            n = self._journey_count(rate * sc.step_s)
+            for _ in range(n):
+                ok = self.rng.random() < cm.ok_rate / max(1.0, rho ** 2)
+                kw = {}
+                for metric in ("ttft", "tpot", "e2e", "queue", "hop"):
+                    q = cm.latency_ms.get(metric)
+                    if q:
+                        kw[metric + "_ms"] = round(
+                            sample_latency(self.rng, q) * slowdown, 3
+                        )
+                self.plane.record_journey(
+                    wclass=wclass, ok=ok,
+                    tokens=int(cm.output_tokens_mean), **kw,
+                )
+                self.journeys += 1
+        self.backlog = max(
+            0.0, self.backlog + (demand_req - served_req) * sc.step_s
+        )
+        return {
+            "replicas": self.replicas,
+            "queued": int(self.backlog),
+            "queue_per_replica": round(
+                self.backlog / max(1, self.replicas), 3
+            ),
+            "occupancy": round(min(1.0, rho_worst), 4),
+            "page_util": round(min(1.0, rho_worst * 0.9), 4),
+            "host_gap_ms": 0.0,
+        }
+
+    def _journey_count(self, expected: float) -> int:
+        """Deterministic integer draw with the right mean (fractional
+        part resolved by the seeded RNG, not by dropping it)."""
+        base = int(expected)
+        if self.rng.random() < (expected - base):
+            base += 1
+        return base
+
+    # -- autoscaler + defrag ticks --------------------------------------------
+
+    def _autoscale(self, signals: dict, now: float) -> None:
+        slo = self.plane.scaling_input()
+        action, reason = self.engine.evaluate(
+            signals, self.replicas, now, total_replicas=self.replicas,
+            warming_replicas=0, slo=slo,
+        )
+        self.fleet_decisions[action] = self.fleet_decisions.get(
+            action, 0
+        ) + 1
+        target = self.replicas
+        if action == "up":
+            target += 1
+        elif action == "down":
+            target -= 1
+        self.JOURNAL.record(
+            "fleet", action=action, reason=reason, signals=signals,
+            replicas=self.replicas, replicas_total=self.replicas,
+            warming=0, slo=slo, policy=self.engine.policy.name,
+            executed=action != "hold", target=target,
+        )
+        self.replicas = target
+
+    def _defrag_round(self) -> None:
+        snap = self.sched.frag_snapshot()
+        if not any(idx > self.planner.threshold
+                   for idx, _ in snap.values()):
+            return
+        plan = self.planner.plan(self.sched)
+        for rnd in plan.rounds:
+            for mv in rnd:
+                to = self.sched.allocators.get(mv.to_node)
+                frm = self.sched.allocators.get(mv.from_node)
+                pod = self.pods.get(mv.pod_key)
+                if to is None or frm is None or pod is None:
+                    continue
+                if not to.chips.can_transact(mv.new):
+                    continue
+                to.chips.transact(mv.new)
+                if frm.chips.can_cancel(mv.old):
+                    frm.chips.cancel(mv.old)
+                pod.node, pod.option = mv.to_node, mv.new
+                self.sched.pod_maps[mv.pod_key] = (mv.to_node, mv.new)
+                self.migrations += 1
+                self.JOURNAL.record(
+                    "migrate", pod=mv.pod_key, uid=mv.uid,
+                    node=mv.to_node, source_node=mv.from_node,
+                    option=option_record(mv.new),
+                    option_old=option_record(mv.old),
+                    gang=mv.gang or None, source="twin_defrag",
+                    wclass=pod.wclass,
+                )
+
+    # -- drift ----------------------------------------------------------------
+
+    def _model_drift(self) -> dict:
+        """Per-class relative drift between the tokens/s/chip the sim
+        actually delivered and the fitted model's — check-twin's ≤20%
+        fidelity gate.  A sim that saturates (demand over capacity)
+        still serves AT the modeled per-chip rate, so drift here means
+        the simulation arithmetic diverged, not that load was high."""
+        out = {}
+        for wclass in sorted(self.model.classes):
+            cm = self.model.classes[wclass]
+            chip_s = self._chip_seconds.get(wclass, 0.0)
+            if chip_s <= 0:
+                continue
+            sim_tput = self._served_tokens.get(wclass, 0.0) / chip_s
+            acc = self._eff_tput_acc.get(wclass)
+            if acc and acc[1] > 0:
+                model_tput = acc[0] / acc[1]
+            else:
+                vals = (list(cm.tokens_per_sec_per_chip.values())
+                        or [1.0])
+                model_tput = sum(vals) / len(vals)
+            out[wclass] = {
+                "sim_tokens_per_s_per_chip": round(sim_tput, 3),
+                "model_tokens_per_s_per_chip": round(model_tput, 3),
+                "drift": round(
+                    abs(sim_tput - model_tput) / max(1e-6, model_tput), 4
+                ),
+            }
+        return out
+
+    # -- the run --------------------------------------------------------------
+
+    def run(self) -> dict:
+        sc = self.scenario
+        wall0 = time.perf_counter()
+        self.JOURNAL.record(
+            "twin", action="scenario", scenario=sc.name, seed=sc.seed,
+            mode=sc.mode, model_source=self.model.source,
+            duration_s=sc.duration_s, rater=getattr(
+                self.rater, "name", str(sc.rater)
+            ),
+        )
+        self._boot_fleet()
+        schedule = (self._recorded_schedule()
+                    if sc.mode == "recorded" else [])
+        cursor = 0
+        next_scale = 0.0
+        next_defrag = sc.defrag_interval_s
+        steps = int(math.ceil(sc.duration_s / sc.step_s))
+        for i in range(steps):
+            t = min((i + 1) * sc.step_s, sc.duration_s)
+            self.clock.advance_to(t)
+            if sc.mode == "recorded":
+                while cursor < len(schedule) and schedule[cursor][0] <= t:
+                    self._apply_recorded(schedule[cursor][1])
+                    cursor += 1
+            else:
+                self._spawn_synthetic(t)
+                self._expire_pods(t)
+            signals = self._tick_journeys(t)
+            self.plane.evaluate(now=t)
+            if t >= next_scale:
+                self._autoscale(signals, t)
+                next_scale = t + sc.autoscaler_interval_s
+            if self.defrag_enabled and t >= next_defrag:
+                self._defrag_round()
+                next_defrag = t + sc.defrag_interval_s
+        self.plane.evaluate(now=self.clock(), force=True)
+        report = self._finish(wall0)
+        with _LAST_LOCK:
+            global _LAST_REPORT
+            _LAST_REPORT = report
+        return report
+
+    def _finish(self, wall0: float) -> dict:
+        sc = self.scenario
+        frag = []
+        free = total = 0
+        for name in sorted(self.sched.allocators):
+            cs = self.sched.allocators[name].chips
+            frag.append(cs.fragmentation()[0])
+            free += cs.free_count()
+            total += cs.num_chips
+        slo_dbg = self.plane.debug_state()
+        posture = self.plane.posture()
+        burn: dict[str, dict] = {}
+        for cls, objs in sorted((slo_dbg.get("burn") or {}).items()):
+            for key, b in sorted((objs or {}).items()):
+                burn[f"{cls}:{key}"] = {
+                    k: b.get(k)
+                    for k in ("burn_short", "burn_long",
+                              "total_short", "bad_short")
+                }
+        scores = {
+            "binds": self.binds,
+            "placed": self.binds,
+            "unplaced": self.unplaced,
+            "forgets": self.forgets,
+            "migrations": self.migrations,
+            "mean_score": round(
+                sum(self.scores) / len(self.scores), 3
+            ) if self.scores else 0.0,
+            "contiguous_frac": round(
+                self.contiguous / self.binds, 4
+            ) if self.binds else 0.0,
+            "final_frag_mean": round(
+                sum(frag) / len(frag), 4
+            ) if frag else 0.0,
+            "mean_free_chip_frac": round(free / total, 4) if total else 0.0,
+        }
+        self.JOURNAL.record(
+            "twin", action="scores", scenario=sc.name, seed=sc.seed,
+            mode=sc.mode, scores=scores,
+            slo={"breaches": self.plane.breaches,
+                 "recoveries": self.plane.recoveries,
+                 "burning": posture["burning"]},
+        )
+        self.JOURNAL.flush()
+        self.JOURNAL.close()
+        twin_events = read_journal(self.out_dir)
+        res = replay(twin_events)  # conservation post-conditions included
+        violations = list(res.violations)
+        wall = max(1e-9, time.perf_counter() - wall0)
+        walls = sorted(self.bind_walls)
+        p99 = walls[min(len(walls) - 1,
+                        int(0.99 * len(walls)))] if walls else 0.0
+        return {
+            "scenario": sc.to_dict(),
+            "mode": sc.mode,
+            "seed": sc.seed,
+            "model": self.model.to_dict(),
+            "sim_duration_s": sc.duration_s,
+            "wall_s": round(wall, 3),
+            "speedup_vs_wall": round(sc.duration_s / wall, 1),
+            "bind_p99_ms": round(p99 * 1000.0, 3),
+            "journeys": self.journeys,
+            "replicas_final": self.replicas,
+            "fleet_decisions": dict(sorted(self.fleet_decisions.items())),
+            "packing": scores,
+            "slo": {
+                "posture": posture,
+                "breaches": self.plane.breaches,
+                "recoveries": self.plane.recoveries,
+                "burn": burn,
+            },
+            "model_drift": self._model_drift(),
+            "replay": {
+                "records": len(twin_events),
+                "twin_records": res.twin_records,
+                "violations": violations,
+            },
+            "journal_dir": self.out_dir,
+        }
+
+
+def run_scenario(scenario: TwinScenario, events: Optional[list] = None,
+                 slo_state: Optional[dict] = None,
+                 model: Optional[WorkloadModel] = None,
+                 rater=None) -> dict:
+    """Build a runner and run it — the one call sites use (CLI,
+    /twin/run, bench, check-twin, autosearch burn scoring)."""
+    return TwinRunner(
+        scenario, events=events, slo_state=slo_state, model=model,
+        rater=rater,
+    ).run()
+
+
+def debug_state() -> dict:
+    """The /debug/twin payload: the last completed run's report."""
+    with _LAST_LOCK:
+        if _LAST_REPORT is None:
+            return {"ran": False}
+        return {"ran": True, "report": _LAST_REPORT}

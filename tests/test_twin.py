@@ -1,0 +1,282 @@
+"""Digital twin (twin/): the virtual clock, workload-model fitting,
+same-seed determinism (byte-identical twin journals + identical burn and
+packing scores), live-state isolation of twin runs, the /twin HTTP
+surfaces, the CLI, and policy-autosearch gate honesty."""
+
+import hashlib
+import json
+import random
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.cli import build_stack
+from elastic_gpu_scheduler_tpu.journal import (
+    JOURNAL,
+    read_journal,
+    segment_paths,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.server.routes import ExtenderServer
+from elastic_gpu_scheduler_tpu.slo import SLO
+from elastic_gpu_scheduler_tpu.twin import (
+    INCUMBENT_SOURCE,
+    TwinScenario,
+    VirtualClock,
+    autosearch,
+    fit_workload_model,
+    genome_from_source,
+    render_source,
+    run_scenario,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """Twin runs must never need these, but the soak helpers use the
+    global journal — leave nothing configured behind."""
+    yield
+    JOURNAL.close()
+    SLO.reset()
+
+
+def tpu_pod(name, core=0, chips=0, wclass="serve"):
+    res = {consts.RESOURCE_TPU_CORE: core or chips * 100}
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations={consts.ANNOTATION_WORKLOAD_CLASS: wclass},
+    )
+
+
+def record_soak(dirpath, seed=7, ops=60):
+    """Seeded live soak on 4x4-mesh nodes; returns the journal events."""
+    JOURNAL.configure(str(dirpath), fsync="off")
+    cluster = FakeCluster()
+    names = []
+    for i in range(2):
+        names.append(f"n{i}")
+        cluster.add_node(
+            make_tpu_node(
+                f"n{i}", chips=16, hbm_gib=256, accelerator="v5e",
+                slice_topology="4x4",
+            )
+        )
+    registry, *_ = build_stack(
+        FakeClientset(cluster), cluster=None, priority="binpack"
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    rng = random.Random(seed)
+    live = []
+    for i in range(ops):
+        if live and rng.random() < 0.35:
+            sched.forget_pod(live.pop(rng.randrange(len(live))),
+                             source="soak")
+            continue
+        r = rng.random()
+        if r < 0.2:
+            pod = tpu_pod(f"s-{i}", chips=12, wclass="batch")
+        elif r < 0.55:
+            pod = tpu_pod(f"s-{i}", chips=4, wclass="batch")
+        else:
+            pod = tpu_pod(f"s-{i}", core=rng.choice((50, 100)))
+        cluster.create_pod(pod)
+        ok, _ = sched.assume(list(names), pod)
+        if not ok:
+            continue
+        sched.bind(rng.choice(ok), pod)
+        live.append(pod)
+    JOURNAL.flush()
+    JOURNAL.close()
+    return read_journal(str(dirpath))
+
+
+def journal_digest(dirpath):
+    h = hashlib.sha256()
+    for path in segment_paths(str(dirpath)):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+# -- virtual clock -----------------------------------------------------------
+
+
+def test_virtual_clock_basics():
+    clk = VirtualClock(100.0)
+    assert clk() == 100.0 and clk.now() == 100.0
+    clk.advance(2.5)
+    assert clk() == 102.5
+    clk.advance_to(200.0)
+    assert clk() == 200.0
+    clk.advance_to(150.0)  # refuses to run backwards: no-op
+    assert clk() == 200.0
+    with pytest.raises(ValueError):
+        clk.advance(-1.0)
+
+
+# -- workload model ----------------------------------------------------------
+
+
+def test_fit_workload_model_from_recording(tmp_path):
+    events = record_soak(tmp_path / "soak")
+    model = fit_workload_model(events)
+    assert set(model.classes) == {"serve", "batch"}
+    for cm in model.classes.values():
+        assert cm.arrival_rate_per_s > 0
+        assert cm.mean_lifetime_s > 0
+        assert cm.shapes
+    with pytest.raises(ValueError):
+        fit_workload_model([])
+
+
+# -- determinism (satellite: same seed => byte-identical) --------------------
+
+
+def test_same_seed_recorded_runs_byte_identical(tmp_path):
+    events = record_soak(tmp_path / "soak")
+    reports = []
+    for tag in ("a", "b"):
+        scenario = TwinScenario(
+            name="det", mode="recorded", seed=13, duration_s=600.0,
+            out_dir=str(tmp_path / f"twin-{tag}"),
+        )
+        reports.append(run_scenario(scenario, events=events))
+    assert not reports[0]["replay"]["violations"]
+    assert (journal_digest(tmp_path / "twin-a")
+            == journal_digest(tmp_path / "twin-b"))
+    assert reports[0]["slo"]["burn"] == reports[1]["slo"]["burn"]
+    assert reports[0]["slo"]["posture"] == reports[1]["slo"]["posture"]
+    assert reports[0]["packing"] == reports[1]["packing"]
+
+
+def test_seed_changes_synthetic_outcome(tmp_path):
+    digests = []
+    for seed in (1, 2):
+        scenario = TwinScenario(
+            name="seeded", mode="synthetic", seed=seed, duration_s=300.0,
+            out_dir=str(tmp_path / f"twin-{seed}"),
+        )
+        run_scenario(scenario)
+        digests.append(journal_digest(tmp_path / f"twin-{seed}"))
+    assert digests[0] != digests[1]
+
+
+# -- isolation (satellite: twin leaves live state untouched) -----------------
+
+
+def test_twin_run_leaves_live_state_untouched(tmp_path):
+    JOURNAL.configure(str(tmp_path / "live"), fsync="off")
+    cluster = FakeCluster()
+    cluster.add_node(make_tpu_node("live-0", chips=4, hbm_gib=64))
+    registry, predicate, prioritize, bind, controller, status, gang = (
+        build_stack(FakeClientset(cluster), cluster=None)
+    )
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    pod = tpu_pod("live-pod", core=100)
+    cluster.create_pod(pod)
+    ok, _ = sched.assume(["live-0"], pod)
+    sched.bind(ok[0], pod)
+    JOURNAL.flush()
+    seq_before = JOURNAL.last_seq()
+    status_before = status()
+    slo_before = SLO.debug_state()
+
+    scenario = TwinScenario(
+        name="isolated", mode="synthetic", seed=3, duration_s=300.0,
+        out_dir=str(tmp_path / "twin"),
+    )
+    report = run_scenario(scenario)
+    assert report["packing"]["binds"] > 0
+
+    assert JOURNAL.last_seq() == seq_before
+    assert status() == status_before
+    assert SLO.debug_state() == slo_before
+    # the live journal on disk gained nothing either
+    assert len(read_journal(str(tmp_path / "live"))) > 0
+    assert journal_digest(tmp_path / "twin") != ""
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+
+def test_twin_http_endpoints(tmp_path):
+    server = ExtenderServer.__new__(ExtenderServer)
+    code, payload, ctype = server._route_get("/debug/twin")
+    assert code == 200 and ctype == "application/json"
+
+    # recorded mode with no live journal configured: conflict, not crash
+    code, payload, _ = server._route_post_inner(
+        "/twin/run", json.dumps({"mode": "recorded"}).encode()
+    )
+    assert code == 409
+
+    code, payload, _ = server._route_post_inner("/twin/run", b"not json")
+    assert code == 400
+    code, payload, _ = server._route_post_inner(
+        "/twin/run", json.dumps({"mode": "bogus"}).encode()
+    )
+    assert code == 400
+
+    body = {"mode": "synthetic", "seed": 5, "duration_s": 300.0,
+            "out_dir": str(tmp_path / "twin")}
+    code, payload, _ = server._route_post_inner(
+        "/twin/run", json.dumps(body).encode()
+    )
+    assert code == 200
+    report = json.loads(payload)
+    assert report["replay"]["violations"] == []
+    assert report["speedup_vs_wall"] > 1
+
+    code, payload, _ = server._route_get("/debug/twin")
+    assert json.loads(payload)["ran"] is True
+    code, payload, _ = server._route_get("/debug/")
+    assert b"/debug/twin" in payload
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_run_synthetic_json(tmp_path, capsys):
+    from elastic_gpu_scheduler_tpu.twin.__main__ import main
+
+    rc = main([
+        "run", "--synthetic", "--duration", "300", "--seed", "9",
+        "--out", str(tmp_path / "twin"), "--json",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["sim_duration_s"] == 300.0
+    assert report["replay"]["violations"] == []
+
+
+# -- autosearch --------------------------------------------------------------
+
+
+def test_genome_roundtrip():
+    genome = genome_from_source(INCUMBENT_SOURCE)
+    rendered = render_source(genome)
+    assert render_source(genome_from_source(rendered)) == rendered
+
+
+def test_autosearch_gate_honesty(tmp_path):
+    events = record_soak(tmp_path / "soak")
+    report = autosearch(events, seed=11, rounds=1, population=4)
+    rejected = {r["source"] for r in report["rejected"]}
+    identity = render_source(genome_from_source(INCUMBENT_SOURCE))
+    for row in report["candidates"] + report["beats_incumbent"]:
+        assert row["gate"]["pass"] is True
+        assert row["source"] not in rejected
+    for row in report["beats_incumbent"]:
+        assert row["source"] != identity
+        assert row["wins"]
+    assert "nothing is applied automatically" in report["promotion"]

@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""CI gate for the digital twin (`make check-twin`).
+
+Records a seeded live soak (binds/forgets with workload classes, SLO
+objectives + request journeys, profile EWMAs), runs the twin over the
+recording, and HARD-FAILS on:
+
+1. **Replay violations** — the twin journal must replay through the
+   existing journal/replay.py invariant checks with ZERO violations
+   (conservation included): simulated decisions obey the same physics
+   as live ones.
+2. **Nondeterminism** — two same-seed twin runs over the same recording
+   must produce BYTE-IDENTICAL twin journals and identical SLO-burn
+   scores.  Virtual time means there is nothing left to be flaky.
+3. **Time-warp floor** — a >=30-sim-minute scenario must fold into
+   wall time at >=CHECK_TWIN_MIN_SPEEDUP x (default 100).
+4. **Model drift** — the fitted workload model's per-class tokens/s/chip
+   must stay within CHECK_TWIN_DRIFT (default 0.20) of the recorded
+   profile EWMAs, and the twin's SIMULATED effective throughput must
+   stay within the same bound of the model it was given.
+5. **Burn disagreement** — the twin's simulated SLO posture must agree
+   with the live-recorded posture: same burning verdict, per-objective
+   bad-request fraction within CHECK_TWIN_BURN_TOL (default 0.15).
+6. **Gate dishonesty** — no autosearch candidate whose replay gate
+   FAILED may be surfaced as promotable (ranked or beats-incumbent),
+   and the seeded fixture must yield >=1 gate-passed candidate that
+   strictly beats the incumbent binpack on a rater-neutral metric.
+
+Usage:
+    python tools/check_twin.py [--ops N]
+
+Environment:
+    CHECK_TWIN_SEED         soak + twin RNG seed (default 20260804)
+    CHECK_TWIN_MIN_SPEEDUP  time-warp floor (default 100)
+    CHECK_TWIN_DRIFT        model tokens/s drift bound (default 0.20)
+    CHECK_TWIN_BURN_TOL     burn bad-frac agreement bound (default 0.15)
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from elastic_gpu_scheduler_tpu.cli import build_stack  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal import (  # noqa: E402
+    JOURNAL,
+    read_journal,
+    segment_paths,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.objects import (  # noqa: E402
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.profile import PROFILER  # noqa: E402
+from elastic_gpu_scheduler_tpu.slo import SLO  # noqa: E402
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+
+SEED = int(os.environ.get("CHECK_TWIN_SEED", "20260804"))
+MIN_SPEEDUP = float(os.environ.get("CHECK_TWIN_MIN_SPEEDUP", "100"))
+DRIFT_BOUND = float(os.environ.get("CHECK_TWIN_DRIFT", "0.20"))
+BURN_TOL = float(os.environ.get("CHECK_TWIN_BURN_TOL", "0.15"))
+
+SLO_SPEC = {
+    "classes": {
+        "serve": {"e2e_p95_ms": 2000.0, "availability": 0.99},
+        "batch": {"e2e_p95_ms": 8000.0, "availability": 0.95},
+    },
+    "window_short_s": 60.0,
+    "window_long_s": 300.0,
+    "min_samples": 20,
+    "default_class": "serve",
+}
+
+
+def _pod(name: str, core: int = 0, chips: int = 0, wclass: str = "serve"):
+    res = {consts.RESOURCE_TPU_CORE: core or chips * 100}
+    return make_pod(
+        name,
+        containers=[
+            Container(
+                name="main",
+                resources=ResourceRequirements(limits=res),
+            )
+        ],
+        annotations={consts.ANNOTATION_WORKLOAD_CLASS: wclass},
+    )
+
+
+def record_soak(journal_dir: str, seed: int, ops: int):
+    """Seeded live soak on 4x4-mesh v5e nodes: the 12-chip/4-chip/
+    fractional mix that makes the incumbent's compact-box preference
+    CONTESTABLE (a 2x2-first placement can strand a later 12-chip pod
+    non-contiguous where a row-first one does not) — the workload the
+    autosearch yield gate needs.  Returns (events, live_slo_state,
+    live_posture)."""
+    JOURNAL.configure(journal_dir, fsync="off")
+    SLO.load_config(SLO_SPEC)
+    PROFILER.configure(sample=1.0)
+    PROFILER.reset()
+    cluster = FakeCluster()
+    names = []
+    for i in range(4):
+        name = f"n{i}"
+        names.append(name)
+        cluster.add_node(
+            make_tpu_node(
+                name, chips=16, hbm_gib=256, accelerator="v5e",
+                slice_topology="4x4",
+            )
+        )
+    clientset = FakeClientset(cluster)
+    registry, *_ = build_stack(clientset, cluster=None, priority="binpack")
+    sched = registry[consts.RESOURCE_TPU_CORE]
+    rng = random.Random(seed)
+    live: list = []
+    serial = 0
+    for _ in range(ops):
+        if live and rng.random() < 0.35:
+            victim = live.pop(rng.randrange(len(live)))
+            sched.forget_pod(victim, source="soak")
+            continue
+        serial += 1
+        r = rng.random()
+        if r < 0.2:
+            pod = _pod(f"s-{serial}", chips=12, wclass="batch")
+            chips = 12
+        elif r < 0.55:
+            pod = _pod(f"s-{serial}", chips=4, wclass="batch")
+            chips = 4
+        else:
+            pod = _pod(f"s-{serial}", core=rng.choice((50, 100)),
+                       wclass="serve")
+            chips = 1
+        cluster.create_pod(pod)
+        ok, _failed = sched.assume(list(names), pod)
+        if not ok:
+            continue
+        sched.bind(rng.choice(ok), pod)
+        live.append(pod)
+        wclass = pod.metadata.annotations[consts.ANNOTATION_WORKLOAD_CLASS]
+        # per-bind serving telemetry: profile EWMAs (~900 tokens/s/chip,
+        # the v5e default scale) and healthy request journeys well under
+        # the objectives — the live posture the twin must reproduce
+        for _step in range(3):
+            PROFILER.record_step(
+                tokens=9 * chips, wall_s=0.01, pod=pod.key,
+                wclass=wclass, generation="v5e", chips=chips,
+            )
+        for j in range(3):
+            SLO.record_journey(
+                wclass=wclass,
+                ok=rng.random() < 0.995,
+                ttft_ms=rng.uniform(20.0, 80.0),
+                tpot_ms=rng.uniform(5.0, 15.0),
+                e2e_ms=rng.uniform(200.0, 900.0),
+                queue_ms=rng.uniform(1.0, 10.0),
+                hop_ms=rng.uniform(0.5, 2.0),
+                tokens=64,
+                trace_id=f"soak-{serial}-{j}",
+            )
+    for pod in live:
+        sched.forget_pod(pod, source="drain")
+    PROFILER.maybe_journal(force=True)
+    SLO.evaluate(force=True)
+    live_state = SLO.debug_state()
+    live_posture = SLO.posture()
+    JOURNAL.flush()
+    JOURNAL.close()
+    events = read_journal(journal_dir)
+    return events, live_state, live_posture
+
+
+def _journal_digest(dirpath: str) -> str:
+    h = hashlib.sha256()
+    for path in segment_paths(dirpath):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _bad_fracs(burn: dict) -> dict:
+    """{"cls:key": bad_short/total_short} from either burn shape (the
+    live plane's nested dict or the twin report's flattened one)."""
+    out = {}
+    for k, v in burn.items():
+        if isinstance(v, dict) and "total_short" in v:
+            total = v.get("total_short") or 0
+            out[k] = (v.get("bad_short", 0) / total) if total else 0.0
+        elif isinstance(v, dict):
+            for key, rec in v.items():
+                total = rec.get("total_short") or 0
+                out[f"{k}:{key}"] = (
+                    (rec.get("bad_short", 0) / total) if total else 0.0
+                )
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ops", type=int, default=200)
+    args = parser.parse_args()
+
+    failures: list[str] = []
+    result: dict = {"check": "twin", "seed": SEED, "ops": args.ops}
+    soak_dir = tempfile.mkdtemp(prefix="check-twin-soak-")
+    twin_dirs = [
+        tempfile.mkdtemp(prefix="check-twin-run-a-"),
+        tempfile.mkdtemp(prefix="check-twin-run-b-"),
+    ]
+    try:
+        events, live_state, live_posture = record_soak(
+            soak_dir, SEED, args.ops
+        )
+        result["soak_records"] = len(events)
+
+        from elastic_gpu_scheduler_tpu.twin import (
+            TwinScenario,
+            autosearch,
+            fit_workload_model,
+            run_scenario,
+        )
+
+        # ---- phase 1+2+3: two same-seed recorded twin runs ------------
+        live_seq_before = JOURNAL.last_seq()
+        reports = []
+        for out_dir in twin_dirs:
+            scenario = TwinScenario(
+                name="check", mode="recorded", seed=SEED,
+                duration_s=1800.0, out_dir=out_dir,
+            )
+            reports.append(run_scenario(
+                scenario, events=events, slo_state=live_state,
+            ))
+        report = reports[0]
+        result["sim_duration_s"] = report["sim_duration_s"]
+        result["speedup_vs_wall"] = round(report["speedup_vs_wall"], 1)
+        result["replay_violations"] = len(report["replay"]["violations"])
+        result["twin_journal_records"] = report["replay"]["records"]
+        for i, rep in enumerate(reports):
+            if rep["replay"]["violations"]:
+                failures.append(
+                    f"run {i}: twin journal replay violations: "
+                    f"{rep['replay']['violations'][:3]}"
+                )
+        if report["sim_duration_s"] < 1800.0:
+            failures.append(
+                f"scenario simulated only {report['sim_duration_s']}s "
+                "(need >=1800)"
+            )
+        if report["speedup_vs_wall"] < MIN_SPEEDUP:
+            failures.append(
+                f"time-warp {report['speedup_vs_wall']:.1f}x below the "
+                f"{MIN_SPEEDUP:.0f}x floor"
+            )
+        if JOURNAL.last_seq() != live_seq_before:
+            failures.append(
+                "twin run advanced the LIVE journal sequence "
+                f"({live_seq_before} -> {JOURNAL.last_seq()})"
+            )
+
+        digests = [_journal_digest(d) for d in twin_dirs]
+        result["journal_digest"] = digests[0][:16]
+        if digests[0] != digests[1]:
+            failures.append(
+                "nondeterministic: same-seed twin journals differ "
+                f"({digests[0][:12]} vs {digests[1][:12]})"
+            )
+        if reports[0]["slo"]["burn"] != reports[1]["slo"]["burn"]:
+            failures.append(
+                "nondeterministic: same-seed SLO-burn scores differ"
+            )
+        if reports[0]["packing"] != reports[1]["packing"]:
+            failures.append(
+                "nondeterministic: same-seed packing scores differ"
+            )
+
+        # ---- phase 4: model drift -------------------------------------
+        model = fit_workload_model(events, slo_state=live_state)
+        last_profile = None
+        for rec in events:
+            if rec.get("type") == "profile":
+                last_profile = rec
+        recorded_tput = (last_profile or {}).get("profiles") or {}
+        drift_report = {}
+        for wclass, cm in sorted(model.classes.items()):
+            rec_tput = (recorded_tput.get(wclass) or {}).get("tput") or {}
+            for gen, rec_v in sorted(rec_tput.items()):
+                fit_v = cm.tokens_per_sec_per_chip.get(gen)
+                if not rec_v or fit_v is None:
+                    continue
+                drift = abs(fit_v - rec_v) / rec_v
+                drift_report[f"{wclass}:{gen}"] = round(drift, 4)
+                if drift > DRIFT_BOUND:
+                    failures.append(
+                        f"fitted tokens/s for {wclass}/{gen} drifts "
+                        f"{drift:.1%} from the recorded profile "
+                        f"(bound {DRIFT_BOUND:.0%})"
+                    )
+        for wclass, d in sorted((report.get("model_drift") or {}).items()):
+            drift = d.get("drift")
+            if drift is None:
+                continue
+            drift_report[f"sim:{wclass}"] = round(drift, 4)
+            if drift > DRIFT_BOUND:
+                failures.append(
+                    f"simulated throughput for {wclass} drifts "
+                    f"{drift:.1%} from the fitted model "
+                    f"(bound {DRIFT_BOUND:.0%})"
+                )
+        result["model_drift"] = drift_report
+
+        # ---- phase 5: burn agreement ----------------------------------
+        live_burning = bool(live_posture.get("burning"))
+        twin_burning = bool(report["slo"]["posture"].get("burning"))
+        result["live_burning"] = live_burning
+        result["twin_burning"] = twin_burning
+        if live_burning != twin_burning:
+            failures.append(
+                f"burn posture disagrees: live burning={live_burning}, "
+                f"twin burning={twin_burning}"
+            )
+        live_bad = _bad_fracs(live_state.get("burn") or {})
+        twin_bad = _bad_fracs(report["slo"].get("burn") or {})
+        burn_compare = {}
+        for key in sorted(set(live_bad) & set(twin_bad)):
+            delta = abs(live_bad[key] - twin_bad[key])
+            burn_compare[key] = {
+                "live": round(live_bad[key], 4),
+                "twin": round(twin_bad[key], 4),
+                "delta": round(delta, 4),
+            }
+            if delta > BURN_TOL:
+                failures.append(
+                    f"burn disagreement on {key}: live bad-frac "
+                    f"{live_bad[key]:.3f} vs twin {twin_bad[key]:.3f} "
+                    f"(tolerance {BURN_TOL})"
+                )
+        result["burn_compare"] = burn_compare
+
+        # ---- phase 6: autosearch honesty + yield ----------------------
+        search = autosearch(events, seed=SEED, rounds=3, population=10)
+        result["autosearch_evaluated"] = search["evaluated"]
+        result["autosearch_beats"] = len(search["beats_incumbent"])
+        rejected_sources = {
+            r["source"] for r in search["rejected"]
+        }
+        for bucket in ("candidates", "beats_incumbent"):
+            for row in search[bucket]:
+                gate = row.get("gate")
+                if gate is None or not gate.get("pass"):
+                    failures.append(
+                        f"autosearch surfaced a gate-rejected candidate "
+                        f"in {bucket}: {row['source'][:80]}"
+                    )
+                if row["source"] in rejected_sources:
+                    failures.append(
+                        f"autosearch ranked a rejected candidate: "
+                        f"{row['source'][:80]}"
+                    )
+        if not search["beats_incumbent"]:
+            failures.append(
+                "autosearch found no candidate beating the incumbent "
+                "on rater-neutral metrics in the seeded fixture"
+            )
+        else:
+            best = search["beats_incumbent"][0]
+            result["autosearch_best"] = {
+                "source": best["source"],
+                "wins": best["wins"],
+                "fitness": best["fitness"],
+            }
+    finally:
+        SLO.reset()
+        PROFILER.reset()
+        PROFILER.configure(sample=0.0)
+        JOURNAL.close()
+        shutil.rmtree(soak_dir, ignore_errors=True)
+        for d in twin_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    result["failures"] = failures
+    result["ok"] = not failures
+    print(json.dumps(result, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,36 @@ def make_fleet(cluster, nodes: int = 10000, seed: int = 20260804) -> list:
     return names
 
 
+def twin_fleet(nodes: int = 4, seed: int = 20260804) -> list:
+    """Seeded node specs for the digital twin, in journal ``node_add``
+    wire form (``{"node", "generation", "dims", "wrap", "chips"}``).
+
+    Unlike ``make_fleet`` this builds no FakeCluster — the twin's
+    simulated allocator domains are fed straight from these specs
+    (``TwinScenario(fleet=twin_fleet(...))``).  Domains are whole ICI
+    slices drawn from the same SLICE_TEMPLATES the cluster bench uses,
+    so twin packing sees the real mesh shapes (4x4 v5e/v6e, 4x4x4
+    v5p) rather than single-host 2x2 tiles."""
+    rng = random.Random(seed)
+    specs: list = []
+    for i in range(nodes):
+        gen, slice_topo, _host_topo, hbm, _hosts = rng.choices(
+            SLICE_TEMPLATES, weights=SLICE_WEIGHTS
+        )[0]
+        dims = tuple(int(d) for d in slice_topo.split("x"))
+        coords = [()]
+        for d in dims:
+            coords = [c + (v,) for c in coords for v in range(d)]
+        specs.append({
+            "node": f"twin-{gen}-{i}",
+            "generation": gen,
+            "dims": list(dims),
+            "wrap": [False] * len(dims),
+            "chips": [[list(c), 100, hbm // 4] for c in coords],
+        })
+    return specs
+
+
 def churn_trace(node_names: list, ops: int, seed: int = 1,
                 whole_pct: float = 0.6) -> list:
     """Seeded bind/forget op stream: ``("bind", pod_serial, core_units)``
