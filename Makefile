@@ -2,7 +2,7 @@
 TAG ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMAGE ?= tpu-elastic-scheduler:$(TAG)
 
-.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-slo check-twin check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
+.PHONY: test test-smoke test-heavy test-par bench check-plan-budget check-journal check-defrag check-serve-overlap check-profile check-fleet check-cluster-scale check-policy check-compile-cache check-analysis check-ha check-disagg check-slo check-twin check-federation check-native-san proto image image-workload run-fake tpu-validate tpu-validate-bg native
 
 # Tiered suites (see TESTING.md for measured wall times).
 # Smoke = scheduler plane + wire: exactly the test files that never import
@@ -163,6 +163,17 @@ check-slo:
 # candidate beating the incumbent binpack on rater-neutral metrics.
 check-twin:
 	JAX_PLATFORMS=cpu python tools/check_twin.py
+
+# Federation gate: seeded 3-shard soak through the front door — routed
+# pod churn (front-door p99 must stay within 2x the single-scheduler
+# bind p99), cross-shard gangs under injected fed.prepare faults (must
+# abort all-or-nothing with compensating rollbacks journaled), a shard
+# leader killed mid-commit (must resolve FORWARD from the decision log
+# on revive, zero double-booked chips), every per-shard journal
+# replaying clean with an empty live diff, and the cross-shard
+# conservation audit (federation/audit.py) green.
+check-federation:
+	JAX_PLATFORMS=cpu python tools/check_federation.py
 
 # Native-kernel sanitizer gate: rebuild placement.cc with
 # ASan+UBSan (-fno-sanitize-recover) and run a seeded differential

@@ -938,6 +938,120 @@ def ha_bench(nodes_n: int | None = None, seed: int | None = None) -> dict:
     return out
 
 
+def federation_bench(
+    nodes_n: int | None = None, seed: int | None = None
+) -> dict:
+    """Federation section (ROADMAP item 1's scale-out half): the cost of
+    the many-process control plane relative to the single leader it
+    shards.
+
+    Emits:
+      fed_route_p99_ms           front-door single-pod route (capacity-
+                                 ordered shard pick + assume/score/bind
+                                 on the winning shard's engine) — the
+                                 acceptance budget is 2x the
+                                 single-scheduler schedule_bind_p99_ms
+      fed_gang_2pc_ms            p99 cross-shard gang admission wall:
+                                 phase-1 reserve + durable journal seal
+                                 on every shard, decision, phase-2
+                                 commit records
+      fed_shard_kill_recovery_ms shard-leader kill (journal abort, torn
+                                 tail) to revived: repair + cold ledger
+                                 rebuild + slice re-warm + in-doubt
+                                 fed_gang resolution
+
+    Seeded + deterministic; tools/check_federation.py runs the same
+    machinery smaller with fault injection + conservation audits."""
+    import random as _random
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from tools.fleetgen import make_fleet
+    from elastic_gpu_scheduler_tpu.federation import (
+        FederationFrontDoor,
+        SchedulerShard,
+    )
+
+    nodes_n = nodes_n or int(os.environ.get("BENCH_FED_NODES", "200"))
+    seed = seed or int(os.environ.get("BENCH_FED_SEED", "20260804"))
+    routes_n = int(os.environ.get("BENCH_FED_ROUTES", "200"))
+    gangs_n = int(os.environ.get("BENCH_FED_GANGS", "40"))
+    rng = _random.Random(seed)
+    out: dict = {}
+    shards: dict = {}
+    tmp = _tempfile.mkdtemp(prefix="bench_fed_")
+    try:
+        fd = FederationFrontDoor()
+        for i, sid in enumerate(["eu/v6e/4x4", "us/v5e/4x4",
+                                 "us/v5p/4x4x4"]):
+            cluster = FakeCluster()
+            names = make_fleet(cluster, nodes=nodes_n, seed=seed + i)
+            sh = SchedulerShard(
+                sid, FakeClientset(cluster),
+                os.path.join(tmp, sid), node_names=names,
+            )
+            sh.cluster = cluster
+            sh.warm()
+            shards[sid] = sh
+            fd.add_shard(sh)
+        fd.refresh_summaries()
+        out["fed_shards"] = len(shards)
+        out["fed_nodes_per_shard"] = nodes_n
+
+        route_ms = []
+        for i in range(routes_n):
+            p = tpu_pod(f"fedb-{i}", core=rng.choice([50, 100]))
+            for sh in shards.values():
+                sh.cluster.create_pod(p)
+            t0 = time.perf_counter()
+            r = fd.route_pod(p)
+            if r["ok"]:
+                route_ms.append((time.perf_counter() - t0) * 1000.0)
+        out["fed_route_p99_ms"] = round(p99(route_ms), 3)
+        out["fed_routes"] = len(route_ms)
+
+        sids = sorted(shards)
+        gang_ms = []
+        for g in range(gangs_n):
+            pair = sorted(rng.sample(sids, 2))
+            members = []
+            ok = True
+            for j, sid in enumerate(pair):
+                sh = shards[sid]
+                gp = tpu_pod(f"fedg-{g}-m{j}", core=100,
+                             gang=f"fedg-{g}", gang_size=2)
+                sh.cluster.create_pod(gp)
+                fit, _e = sh.engine.assume(sh.node_names, gp)
+                if not fit:
+                    ok = False
+                    break
+                members.append((sid, rng.choice(fit), gp))
+            if not ok:
+                continue
+            t0 = time.perf_counter()
+            r = fd.admit_gang(f"default/fedg-{g}", members)
+            if r["ok"]:
+                gang_ms.append((time.perf_counter() - t0) * 1000.0)
+        out["fed_gang_2pc_ms"] = round(p99(gang_ms), 3)
+        out["fed_gangs_admitted"] = len(gang_ms)
+
+        victim = sids[0]
+        shards[victim].kill()
+        t0 = time.perf_counter()
+        shards[victim].revive(fd.decisions)
+        out["fed_shard_kill_recovery_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 2
+        )
+    finally:
+        for sh in shards.values():
+            try:
+                sh.JOURNAL.close()
+            except Exception:
+                pass
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def cluster_bench(
     nodes_n: int | None = None,
     seed: int | None = None,
@@ -3208,6 +3322,15 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, keep the artifact
             results["ha_bench_error"] = str(e)[:300]
 
+    # Federation: front-door routing, cross-shard 2PC gang admission and
+    # shard-leader kill/recovery walls (BENCH_FEDERATION=0 skips; per-
+    # shard node count BENCH_FED_NODES).
+    if os.environ.get("BENCH_FEDERATION", "1") != "0":
+        try:
+            results.update(federation_bench())
+        except Exception as e:  # noqa: BLE001 — report, keep the artifact
+            results["federation_bench_error"] = str(e)[:300]
+
     # the TPU sections are strictly additive: a probe/section CRASH must
     # not take down the scheduler headline metrics already in `results`
     # (v5p2048_gang1024_plan_ms et al. are computed above and emit either
@@ -3222,7 +3345,7 @@ def main():
     # in-process sections always run on the host CPU — stamp them too so
     # EVERY section in the artifact says where it was measured
     for prefix in ("journal_overhead", "defrag", "profile", "policy",
-                   "cluster", "ha"):
+                   "cluster", "ha", "fed"):
         if any(k.startswith(prefix) for k in results):
             results.setdefault(f"{prefix}_measured_on", "cpu")
     # relay-state provenance: one key an artifact reader can trust

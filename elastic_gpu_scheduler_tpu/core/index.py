@@ -132,6 +132,20 @@ class IndexEntry:
         }
 
 
+def topo_class(topo_key: tuple) -> str:
+    """Flatten an entry's ``(dims, wrap)`` topology key to the routable
+    class name the federation tier shards by (``4x4``, ``4x4x4``, a
+    trailing ``t`` for torus wrap).  THE one spelling: the federation
+    shard key is (region, generation, topo class) — the same triple
+    ``IndexEntry.bucket()`` groups on, minus the volatile band — so a
+    node's index bucket and its owning shard can never disagree."""
+    dims, wrap = topo_key
+    cls = "x".join(str(d) for d in dims)
+    if any(wrap):
+        cls += "t"
+    return cls
+
+
 def entry_from_chips(name: str, generation: str, cs) -> IndexEntry:
     """Derive a node's entry from its (locked) ChipSet — THE one
     derivation, shared by the live fold, ``verify()``, and the journal

@@ -126,6 +126,10 @@ class NodeAllocator:
         # and O(1) (CapacityIndex.mark_dirty is a GIL-atomic dict write);
         # None costs one truthiness check per mutation.
         self.on_change = None
+        # journal handle for resync records: the process-global JOURNAL
+        # unless the owning engine injects its own (federation shards —
+        # scheduler._create_allocator points this at the shard's stream)
+        self.JOURNAL = JOURNAL
 
     def _notify_change(self) -> None:
         cb = self.on_change
@@ -238,11 +242,11 @@ class NodeAllocator:
                 self.allocated.clear()
                 self._allocated_at.clear()
                 self._notify_change()
-                if JOURNAL.enabled:
+                if self.JOURNAL.enabled:
                     # reset=True: the rebuild WIPED chip usage (unlike the
                     # same-shape branch below, which preserves it) — replay
                     # must not re-charge live pods onto the fresh set
-                    JOURNAL.record(
+                    self.JOURNAL.record(
                         "node_resync", node=self.node_name, reset=True,
                         generation=self.generation,
                         **self.chips.inventory(),
@@ -265,8 +269,8 @@ class NodeAllocator:
                     changed = True
             if changed:
                 self._notify_change()
-            if changed and JOURNAL.enabled:
-                JOURNAL.record(
+            if changed and self.JOURNAL.enabled:
+                self.JOURNAL.record(
                     "node_resync", node=self.node_name,
                     generation=self.generation,
                     **self.chips.inventory(),

@@ -29,6 +29,14 @@ Sites (``FAULTS.maybe_fire(site)`` — one attribute check when off):
                        admission (the SLO plane's latency-injection
                        point: a ``delay`` plan here degrades TTFT/e2e
                        without failing anything — check-slo's fault)
+    fed.prepare        federation front door, before each shard's
+                       phase-1 gang reservation (an ``error`` here
+                       aborts the cross-shard transaction and drives
+                       the compensating rollback path)
+    fed.commit         federation front door, before each shard's
+                       phase-2 commit record (a fault here leaves the
+                       shard in-doubt — resolved forward from the
+                       decision log on revive)
 
 Kinds:
 

@@ -1,0 +1,47 @@
+"""Federated control plane: many scheduler processes, one fleet.
+
+Every plane before this package was exactly one process wide — PR 9
+bought a single engine 10k nodes and the HA work (journal shipping,
+warm takeover, fenced step-down) only ever protected ONE leader.  This
+package partitions the problem the way the capacity index already
+buckets it, keeping per-partition decisions exact (the Tesserae
+decomposition):
+
+``shard``      — one ``SchedulerShard`` per (region, generation,
+                 topology-class) key: its own ``TPUUnitScheduler``,
+                 its own ``Journal`` (per-shard stream — the unit the
+                 cross-shard conservation audit folds over), kill /
+                 revive hooks for chaos harnesses.
+``frontdoor``  — the thin federation tier: routes single pods off
+                 aggregate ``status_summary`` capacity pulled from
+                 every shard, admits CROSS-shard gangs via two-phase
+                 admission composed from the split-phase gang
+                 primitives (``gang_allocate`` / ``gang_unallocate``),
+                 journals each phase as a ``fed_gang`` record, and
+                 serves the federated ``GET /scheduler/status?summary=1``
+                 fold with per-shard staleness stamps.
+``ring``       — the data-plane shard tier: multiple ``FleetRouter``
+                 instances behind rendezvous (HRW) hashing on the
+                 ``utils/prefixdigest`` chain, so ``PrefixIndex``
+                 affinity and the SLO journey stream survive router
+                 scale-out with ~1/n re-steer on join/death.
+``audit``      — the cross-shard ``fed_gang`` agreement + conservation
+                 audit over a directory of per-shard journals (the
+                 journal CLI's multi-shard mode calls into this).
+
+Fault sites (``faultinject``): ``fed.prepare`` fires before each
+shard's phase-1 reservation, ``fed.commit`` before each commit record —
+the chaos gate (tools/check_federation.py) kills shard leaders at both.
+"""
+
+from .frontdoor import FederationFrontDoor
+from .ring import RouterRing
+from .shard import SchedulerShard, shard_key, shard_key_for_entry
+
+__all__ = [
+    "FederationFrontDoor",
+    "RouterRing",
+    "SchedulerShard",
+    "shard_key",
+    "shard_key_for_entry",
+]

@@ -1,0 +1,137 @@
+"""Cross-shard journal audit: fold per-shard replays, check fed_gang
+agreement.
+
+A single shard's replay can prove its OWN stream is conserved (binds
+balance forgets, prepare/commit/abort seals hold locally) but cannot
+see the other participants of a federated gang.  This module reads a
+directory of per-shard journal directories — the layout a federation
+writes (``<root>/<shard>/journal-000000.log``; shard ids with ``/`` in
+them flatten into nested subdirectories) — replays each stream
+independently, then audits the two-phase transactions ACROSS streams:
+
+  * every shard a transaction declares as a participant must have
+    journaled at least one ``fed_gang`` record for it (a silent
+    participant means its reservation was never sealed or its journal
+    was lost — either way the conservation story has a hole);
+  * all participants must reach the SAME terminal phase — one shard
+    committing while another aborts is the double-booking/lost-chips
+    split-brain the protocol exists to prevent;
+  * no transaction may end unresolved (terminal ``prepare``): that is
+    a reservation nobody decided, chips pinned until a recovery that
+    never ran.
+
+The journal CLI (``python -m elastic_gpu_scheduler_tpu.journal replay
+--dir <root>``) calls into this automatically when ``--dir`` holds
+shard subdirectories instead of segments.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..journal import read_journal, segment_paths
+from ..journal.replay import ReplayResult, replay
+
+__all__ = ["audit_federation", "cross_shard_violations", "shard_journal_dirs"]
+
+
+def shard_journal_dirs(root: str) -> dict[str, str]:
+    """Map shard id → journal directory for every subdirectory of
+    ``root`` (recursively) that holds journal segments.  Empty when
+    ``root`` itself is a plain single-journal directory."""
+    out: dict[str, str] = {}
+    if not os.path.isdir(root) or segment_paths(root):
+        return out
+    for dirpath, _dirnames, _filenames in sorted(os.walk(root)):
+        if dirpath != root and segment_paths(dirpath):
+            out[os.path.relpath(dirpath, root)] = dirpath
+    return out
+
+
+def cross_shard_violations(results: dict[str, ReplayResult]) -> list[str]:
+    """The fed_gang agreement audit over already-replayed shard
+    streams (keyed by shard id)."""
+    out: list[str] = []
+    # txn → shard id → this shard's view
+    txns: dict[str, dict[str, dict]] = {}
+    for sid, res in sorted(results.items()):
+        for txn, fg in res.fed_gangs.items():
+            txns.setdefault(txn, {})[sid] = fg
+    for txn, views in sorted(txns.items()):
+        declared: set[str] = set()
+        for fg in views.values():
+            declared.update(fg.get("shards") or [])
+        terminals = {}
+        for sid, fg in sorted(views.items()):
+            phases = fg.get("phases") or ["?"]
+            terminals[sid] = phases[-1]
+        kinds = set(terminals.values())
+        # a declared participant with NO record only matters when the
+        # transaction committed somewhere: commit requires EVERY shard
+        # to have sealed a prepare, so silence then means a reservation
+        # was never journaled (or the stream was truncated).  Under an
+        # abort, silence is the expected shape of a shard whose
+        # phase-1 faulted before it reserved anything.
+        if "commit" in kinds:
+            for sid in sorted(declared):
+                if sid in views:
+                    continue
+                if sid in results:
+                    out.append(
+                        f"fed_gang {txn}: committed, but declared "
+                        f"participant {sid} journaled no record for it "
+                        "— its prepare was never sealed (or its stream "
+                        "was truncated)"
+                    )
+                else:
+                    out.append(
+                        f"fed_gang {txn}: committed, but declared "
+                        f"participant {sid} has no journal in the "
+                        "audited set — cannot prove conservation"
+                    )
+        if "prepare" in kinds:
+            stuck = sorted(s for s, t in terminals.items() if t == "prepare")
+            out.append(
+                f"fed_gang {txn}: unresolved on shard(s) {stuck} — "
+                "prepared but never committed or aborted"
+            )
+            kinds.discard("prepare")
+        if len(kinds) > 1:
+            out.append(
+                f"fed_gang {txn}: participants disagree on the outcome "
+                f"({terminals}) — all-or-nothing violated across shards"
+            )
+    return out
+
+
+def audit_federation(
+    root: str, dirs: Optional[dict[str, str]] = None
+) -> dict:
+    """Replay every shard journal under ``root`` and run the
+    cross-shard agreement audit.  Returns per-shard summaries plus the
+    combined violation list (per-shard violations prefixed with the
+    shard id, then the cross-shard findings)."""
+    dirs = dirs if dirs is not None else shard_journal_dirs(root)
+    results: dict[str, ReplayResult] = {}
+    violations: list[str] = []
+    shards: dict[str, dict] = {}
+    for sid, path in sorted(dirs.items()):
+        res = replay(read_journal(path))
+        results[sid] = res
+        shards[sid] = res.summary()
+        violations.extend(f"[{sid}] {v}" for v in res.violations)
+    cross = cross_shard_violations(results)
+    violations.extend(cross)
+    return {
+        "federated": True,
+        "shards": shards,
+        "fed_gangs": sorted({
+            txn
+            for res in results.values()
+            for txn in res.fed_gangs
+        }),
+        "cross_shard_violations": cross,
+        "violations": violations,
+        "results": results,
+    }

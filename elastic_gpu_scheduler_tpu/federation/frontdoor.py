@@ -1,0 +1,470 @@
+"""The federation front door: route pods, admit cross-shard gangs.
+
+One thin tier in front of N ``SchedulerShard``s.  It holds NO chip
+state — every placement decision is made by a shard's own exact engine;
+the front door only (a) picks WHICH shard off aggregate
+``status_summary`` capacity (refreshed out-of-band, served with
+per-shard staleness stamps), and (b) coordinates cross-shard gangs as a
+two-phase transaction composed from the split-phase gang primitives
+the single-process coordinator already uses:
+
+  phase 1 (reserve)   per participating shard, in deterministic shard
+                      order: ``gang_allocate`` every local member under
+                      the shard's engine lock, then journal a
+                      ``fed_gang phase=prepare`` record INSIDE the same
+                      hold — the per-shard all-or-nothing seal.
+  decision            all shards prepared ⇒ the transaction IS
+                      committed (recorded in the coordinator's decision
+                      log before any commit record is written); any
+                      phase-1 failure ⇒ abort.
+  phase 2 (commit)    journal ``fed_gang phase=commit`` on every shard.
+                      A shard that dies here resolves FORWARD on
+                      revive: its journal shows the prepare, the
+                      decision log says commit.
+  abort               compensating rollback in REVERSE shard order —
+                      ``gang_unallocate`` every reserved member, then
+                      journal ``fed_gang phase=abort``.  Dead shards
+                      are skipped: their revive presumes abort (the
+                      coordinator never commits without every prepare).
+
+Fault sites: ``fed.prepare`` fires before each shard's reservation,
+``fed.commit`` before each commit record — tools/check_federation.py
+and the check-ha chaos phase kill shard leaders at both.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..faultinject import FAULTS
+from .shard import SchedulerShard
+
+log = logging.getLogger("tpu-federation")
+
+
+class FederationFrontDoor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.shards: dict[str, SchedulerShard] = {}
+        # txn id → "commit" | "abort": the coordinator's decision log.
+        # Written BEFORE any commit record, read by shard revive to
+        # resolve in-doubt prepares (``SchedulerShard.revive`` defaults
+        # to presumed-abort when a txn is missing here).
+        self.decisions: dict[str, str] = {}
+        self._txn_serial = 0
+        self._summaries: dict[str, tuple[dict, float]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.routed = 0
+        self.route_failures = 0
+        self.gangs_admitted = 0
+        self.gangs_aborted = 0
+        self.wall_clock = time.time  # injectable for tests
+        # chaos hook: called as (txn, shard_id) after each shard's
+        # phase-1 completes (reservation sealed + ledger annotated).
+        # The chaos gates kill a shard leader HERE — the deterministic
+        # "died with a journaled prepare" window the recovery paths and
+        # the cross-shard audit must survive.  None in production.
+        self.on_prepared = None
+
+    # -- membership ----------------------------------------------------------
+
+    def add_shard(self, shard: SchedulerShard) -> None:
+        with self._lock:
+            self.shards[shard.shard_id] = shard
+
+    def live_shards(self) -> list[SchedulerShard]:
+        with self._lock:
+            return [s for s in self.shards.values() if not s.dead]
+
+    # -- federated status (the routing signal + the status satellite) --------
+
+    def refresh_summaries(
+        self, top_k: int = 10, generations: bool = False
+    ) -> dict:
+        """Pull ``status_summary`` from every live shard and stamp it.
+        A dead shard keeps its LAST summary (with a growing staleness
+        stamp) — routing off slightly stale capacity self-corrects at
+        bind time; routing off a vanished summary cannot."""
+        out = {}
+        for sid, shard in sorted(self.shards.items()):
+            if shard.dead:
+                continue
+            try:
+                s = shard.status_summary(top_k=top_k, generations=generations)
+            except Exception as e:  # a flapping shard must not block the rest
+                log.warning("summary pull from shard %s failed: %s", sid, e)
+                continue
+            with self._lock:
+                self._summaries[sid] = (s, self.wall_clock())
+            out[sid] = s
+        return out
+
+    def federated_summary(self, top_k: int = 10) -> dict:
+        """Fold every shard's summary into one response: capacity and
+        generation sums, a re-merged top-K fragmented list, summed index
+        stats — plus a per-shard staleness stamp so a consumer can see
+        exactly how old each slice of the fold is."""
+        now = self.wall_clock()
+        with self._lock:
+            summaries = dict(self._summaries)
+            dead = {
+                sid for sid, s in self.shards.items() if s.dead
+            }
+        capacity = {
+            "core_total": 0, "core_avail": 0,
+            "hbm_total": 0, "hbm_avail": 0, "free_chips": 0,
+        }
+        generations: dict[str, dict] = {}
+        top: list[dict] = []
+        index = {"folds": 0, "entries": 0, "buckets": 0}
+        have_index = False
+        nodes = pods = 0
+        stamps = {}
+        for sid, (s, at) in sorted(summaries.items()):
+            stamps[sid] = {
+                "at": at,
+                "stale_s": max(0.0, now - at),
+                "dead": sid in dead,
+            }
+            nodes += s.get("nodes", 0)
+            pods += s.get("pods", 0)
+            for k in capacity:
+                capacity[k] += (s.get("capacity") or {}).get(k, 0)
+            for gen, g in (s.get("generations") or {}).items():
+                agg = generations.setdefault(
+                    gen, {"nodes": 0, "free_chips": 0, "free_core": 0}
+                )
+                for k in agg:
+                    agg[k] += g.get(k, 0)
+            for entry in s.get("top_fragmented") or []:
+                top.append({**entry, "shard": sid})
+            idx = s.get("index")
+            if idx:
+                have_index = True
+                index["folds"] += idx.get("folds", 0)
+                index["entries"] += idx.get("entries", 0)
+                index["buckets"] += idx.get("buckets", 0)
+        top.sort(
+            key=lambda e: (-e.get("fragmentation_index", 0.0),
+                           e.get("node", ""))
+        )
+        out = {
+            "federated": True,
+            "summary": True,
+            "shards": stamps,
+            "nodes": nodes,
+            "pods": pods,
+            "capacity": capacity,
+            "generations": generations,
+            "top_fragmented": top[:top_k],
+        }
+        if have_index:
+            out["index"] = index
+        return out
+
+    # -- single-pod routing --------------------------------------------------
+
+    def _shard_order(self, generation: Optional[str]) -> list[str]:
+        """Shards by descending free core from the stamped summaries
+        (capacity-aware routing); a generation hint filters to shards
+        whose summary shows free chips of that generation."""
+        with self._lock:
+            summaries = dict(self._summaries)
+        scored = []
+        for sid, (s, _at) in summaries.items():
+            shard = self.shards.get(sid)
+            if shard is None or shard.dead:
+                continue
+            if generation is not None:
+                g = (s.get("generations") or {}).get(generation)
+                if not g or g.get("free_chips", 0) <= 0:
+                    continue
+            scored.append(
+                (-(s.get("capacity") or {}).get("core_avail", 0), sid)
+            )
+        return [sid for _neg, sid in sorted(scored)]
+
+    def route_pod(
+        self,
+        pod,
+        candidates: Optional[list[str]] = None,
+        generation: Optional[str] = None,
+        max_candidates: int = 32,
+    ) -> dict:
+        """Pick a shard off aggregate capacity, then run the normal
+        assume → score → bind verbs against that shard's exact engine.
+        Capacity summaries are stamped, not fresh — a shard that looks
+        free but fills up mid-route simply fails filter and the next
+        shard in capacity order is tried (stale routing self-corrects
+        at bind time, never double-books: only engines commit)."""
+        if not self._summaries:
+            self.refresh_summaries()
+        order = self._shard_order(generation)
+        tried = []
+        for sid in order:
+            shard = self.shards[sid]
+            names = candidates or shard.node_names
+            if not names:
+                continue
+            names = names[:max_candidates] if max_candidates else names
+            tried.append(sid)
+            fit, errors = shard.engine.assume(names, pod)
+            if not fit:
+                continue
+            scores = shard.engine.score(fit, pod)
+            node = max(zip(scores, fit))[1]
+            try:
+                shard.engine.bind(node, pod)
+            except Exception as e:
+                log.info("route %s: bind on %s/%s failed: %s",
+                         pod.key, sid, node, e)
+                continue
+            self.routed += 1
+            return {"ok": True, "shard": sid, "node": node}
+        self.route_failures += 1
+        return {
+            "ok": False, "shard": None, "node": None,
+            "error": f"no shard admitted {pod.key} "
+                     f"(tried {tried or 'none — no capacity summaries'})",
+        }
+
+    # -- cross-shard gangs: two-phase admission ------------------------------
+
+    def admit_gang(
+        self,
+        gang_key: str,
+        members: list[tuple[str, str, object]],
+        size: Optional[int] = None,
+    ) -> dict:
+        """``members``: (shard_id, node_name, pod) per gang member.
+        All-or-nothing across shards: every shard reserves (phase 1) or
+        every reservation is compensated in reverse order."""
+        by_shard: dict[str, list[tuple[str, object]]] = {}
+        for sid, node, pod in members:
+            by_shard.setdefault(sid, []).append((node, pod))
+        shard_order = sorted(by_shard)
+        with self._lock:
+            self._txn_serial += 1
+            txn = f"{gang_key}#{self._txn_serial}"
+        size = size if size is not None else len(members)
+        prepared: list[tuple[SchedulerShard, str, list]] = []
+        try:
+            # phase 1: reserve on every shard, deterministic order
+            for sid in shard_order:
+                shard = self.shards.get(sid)
+                if shard is None or shard.dead:
+                    raise RuntimeError(f"shard {sid} is unavailable")
+                FAULTS.maybe_fire("fed.prepare")
+                local = by_shard[sid]
+                allocated: list = []
+                with shard.engine.lock:
+                    try:
+                        for node, pod in local:
+                            opt = shard.engine.gang_allocate(
+                                node, pod, source="fed_gang"
+                            )
+                            allocated.append((pod, node, opt))
+                        if shard.JOURNAL.enabled:
+                            # the per-shard seal, inside the same lock
+                            # hold as the members' bind records (the
+                            # gang_admit discipline)
+                            shard.JOURNAL.record(
+                                "fed_gang", phase="prepare", txn=txn,
+                                gang=gang_key, size=size,
+                                members=[p.key for _n, p in local],
+                                shards=shard_order, shard=sid,
+                            )
+                    except Exception:
+                        # partial LOCAL reservation: free inside this
+                        # hold so no other verb ever sees it
+                        for pod, node, opt in reversed(allocated):
+                            shard.engine.gang_unallocate(
+                                node, pod, opt, source="fed_gang_rollback"
+                            )
+                        raise
+                prepared.append((shard, sid, allocated))
+                # 2PC correctness: the prepare is only a prepare once it
+                # is DURABLE — a leader killed after acking phase 1 must
+                # find the sealed reservation in its journal on revive,
+                # or recovery has nothing to resolve while the ledger
+                # annotations below quietly re-charge the members
+                if shard.JOURNAL.enabled and not shard.JOURNAL.flush():
+                    raise RuntimeError(
+                        f"shard {sid}: prepare for {txn} never became "
+                        "durable"
+                    )
+                # ledger writes complete phase 1: a revived shard's cold
+                # rebuild re-charges exactly the members annotated here,
+                # so commit-recovery finds them live and abort-recovery
+                # has something to strip.  A failure aborts the whole
+                # transaction (the decision is only made after EVERY
+                # shard both reserved and annotated).
+                for pod, node, opt in allocated:
+                    shard.engine.gang_annotate(pod, opt, node)
+                if self.on_prepared is not None:
+                    self.on_prepared(txn, sid)
+        except Exception as e:
+            self.decisions[txn] = "abort"
+            self._compensate(txn, gang_key, prepared, str(e))
+            self.gangs_aborted += 1
+            return {
+                "ok": False, "txn": txn, "gang": gang_key,
+                "shards": shard_order, "error": str(e) or repr(e),
+            }
+
+        # decision point: every shard holds its reservation — the
+        # transaction is committed BEFORE any commit record is written,
+        # so a shard that dies mid-phase-2 resolves forward on revive
+        self.decisions[txn] = "commit"
+        unresolved = []
+        for shard, sid, allocated in prepared:
+            try:
+                FAULTS.maybe_fire("fed.commit")
+                if shard.dead:
+                    raise RuntimeError(f"shard {sid} died before commit")
+                with shard.engine.lock:
+                    if shard.JOURNAL.enabled:
+                        shard.JOURNAL.record(
+                            "fed_gang", phase="commit", txn=txn,
+                            gang=gang_key,
+                            members=[p.key for p, _n, _o in allocated],
+                            shards=shard_order, shard=sid,
+                        )
+            except Exception as e:
+                # the decision stands — this shard's journal shows an
+                # unresolved prepare until its revive reads the
+                # decision log and journals the commit
+                log.warning("fed_gang %s: commit record on shard %s "
+                            "deferred to recovery: %s", txn, sid, e)
+                unresolved.append(sid)
+        self.gangs_admitted += 1
+        out = {
+            "ok": True, "txn": txn, "gang": gang_key,
+            "shards": shard_order,
+        }
+        if unresolved:
+            out["unresolved"] = unresolved
+        return out
+
+    def _compensate(
+        self, txn: str, gang_key: str, prepared: list, reason: str
+    ) -> None:
+        """Reverse-order compensating rollback of every reserved shard.
+        Dead shards are skipped — their journals keep the unresolved
+        prepare and revive presumes abort from the decision log."""
+        for shard, sid, allocated in reversed(prepared):
+            if shard.dead:
+                continue
+            for pod, _node, _opt in allocated:
+                try:
+                    shard.engine.gang_strip_annotations(pod)
+                except Exception as e:  # best-effort; resync catches it
+                    log.warning("fed_gang %s: strip %s on %s failed: %s",
+                                txn, pod.key, sid, e)
+            with shard.engine.lock:
+                for pod, node, opt in reversed(allocated):
+                    shard.engine.gang_unallocate(
+                        node, pod, opt, source="fed_gang_rollback"
+                    )
+                if shard.JOURNAL.enabled:
+                    shard.JOURNAL.record(
+                        "fed_gang", phase="abort", txn=txn,
+                        gang=gang_key,
+                        members=[p.key for p, _n, _o in allocated],
+                        shards=sorted(self.shards), shard=sid,
+                        reason=(reason or "")[:200],
+                    )
+
+    # -- introspection -------------------------------------------------------
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            stamps = {
+                sid: {"at": at, "stale_s": max(0.0, self.wall_clock() - at)}
+                for sid, (_s, at) in sorted(self._summaries.items())
+            }
+        return {
+            "shards": {
+                sid: s.debug_state()
+                for sid, s in sorted(self.shards.items())
+            },
+            "summaries": stamps,
+            "decisions": dict(self.decisions),
+            "routed": self.routed,
+            "route_failures": self.route_failures,
+            "gangs_admitted": self.gangs_admitted,
+            "gangs_aborted": self.gangs_aborted,
+        }
+
+    # -- HTTP (the status-aggregation satellite) -----------------------------
+
+    def start(self) -> int:
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._server.server_address[1]
+        t = threading.Thread(
+            target=self._server.serve_forever, name="fed-frontdoor",
+            daemon=True,
+        )
+        t.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _make_handler(fd: FederationFrontDoor):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            raw = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_GET(self):  # noqa: N802 (stdlib handler name)
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            if u.path == "/healthz":
+                self._json(200, {"ok": True, "role": "fed-frontdoor"})
+                return
+            if u.path == "/scheduler/status":
+                top_k = int(q.get("top_k", ["10"])[0])
+                if q.get("summary", ["0"])[0] in ("1", "true"):
+                    fd.refresh_summaries(
+                        top_k=top_k,
+                        generations=q.get("generations", ["0"])[0]
+                        in ("1", "true"),
+                    )
+                    self._json(200, fd.federated_summary(top_k=top_k))
+                else:
+                    self._json(200, {
+                        "schedulers": [
+                            s.engine.status()
+                            for s in fd.live_shards()
+                        ],
+                    })
+                return
+            if u.path == "/debug/federation":
+                self._json(200, fd.debug_state())
+                return
+            self._json(404, {"error": f"no route {u.path}"})
+
+    return Handler

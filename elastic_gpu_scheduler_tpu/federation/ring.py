@@ -1,0 +1,151 @@
+"""Router-shard tier: N ``FleetRouter`` instances behind rendezvous
+hashing on the prefix-digest chain.
+
+The single fleet router holds two things that must survive scale-out:
+the ``PrefixIndex`` affinity map (prefix digest → replica holding the
+KV pages) and the SLO journey stream.  Sharding by client or by random
+pick would scatter a session's requests across routers and destroy
+both.  This ring steers every request by its FIRST page digest — the
+root of the prefix chain, identical for every continuation of the same
+prefix — so one prefix always lands on one router shard, whose local
+affinity map then works exactly as before.
+
+Rendezvous (highest-random-weight) hashing, not a ring of vnodes: the
+owner of key *k* is the shard maximizing ``blake2b(shard_name ‖ k)``.
+A shard joining or dying re-steers only the keys it wins or held
+(~1/n), and every survivor computes ownership independently — no
+coordination, no token ring to rebalance.  Journeys still assemble
+fleet-wide because every router shard records into the process-global
+SLO plane (``/debug/trace/<id>`` answers from any shard).
+
+The routers keep their own ``ReplicaSet``s (each polls the backends
+itself): router death then loses nothing but its affinity map, and the
+re-steered prefixes warm the new owner's map on first miss — the
+bounded hit-rate dip tests/test_fleet.py pins down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Optional
+
+from ..utils import prefixdigest
+
+__all__ = ["RouterRing", "rendezvous_owner"]
+
+
+def rendezvous_owner(names: list[str], key: bytes) -> Optional[str]:
+    """Highest-random-weight owner of ``key`` among ``names``."""
+    best = None
+    best_w = b""
+    for name in sorted(names):  # sorted: ties broken deterministically
+        w = hashlib.blake2b(
+            name.encode() + b"\x00" + key, digest_size=8
+        ).digest()
+        if best is None or w > best_w:
+            best, best_w = name, w
+    return best
+
+
+class RouterRing:
+    def __init__(self, page_size: int = 4, max_pages: int = 1):
+        self.page_size = int(page_size)
+        # only the chain ROOT steers (digest[0] is shared by every
+        # continuation of the prefix — deeper links would split them)
+        self.max_pages = max(1, int(max_pages))
+        self._lock = threading.Lock()
+        self._routers: dict[str, object] = {}  # name → FleetRouter
+        self.steered = 0
+        self.unkeyed = 0  # no full page: steered by whole-prompt hash
+
+    # -- membership (join / death re-steer happens implicitly: owners
+    # -- are recomputed per request over the CURRENT member set) -------------
+
+    def add_router(self, name: str, router) -> None:
+        with self._lock:
+            self._routers[name] = router
+
+    def remove_router(self, name: str):
+        with self._lock:
+            return self._routers.pop(name, None)
+
+    def routers(self) -> dict:
+        with self._lock:
+            return dict(self._routers)
+
+    # -- steering ------------------------------------------------------------
+
+    def steer_key(self, body: dict) -> bytes:
+        """The consistent-hash key for one request: the root link of
+        the prefix-digest chain (same derivation as the routers' own
+        affinity map — adapter-seeded, page-size aligned), falling back
+        to a whole-prompt hash when no full page exists (nothing is
+        cacheable, so ANY stable spread works)."""
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list):
+            return b""
+        adapter = str(body.get("adapter", ""))
+        seed = (
+            prefixdigest.prefix_seed(0)
+            if not adapter
+            else b"adapter:" + adapter.encode()
+        )
+        try:
+            digests = prefixdigest.page_digests(
+                prompt, self.page_size, max_pages=self.max_pages, seed=seed,
+            )
+        except (OverflowError, TypeError, ValueError):
+            digests = []
+        if digests:
+            return digests[0]
+        raw = b",".join(str(t).encode() for t in prompt)
+        return hashlib.blake2b(raw, digest_size=16).digest()
+
+    def route(self, body: dict) -> tuple[Optional[str], Optional[object]]:
+        """(shard name, FleetRouter) owning this request — None/None
+        when the ring is empty."""
+        with self._lock:
+            names = list(self._routers)
+        if not names:
+            return None, None
+        key = self.steer_key(body)
+        if not key:
+            self.unkeyed += 1
+            key = b"\x00"
+        owner = rendezvous_owner(names, key)
+        self.steered += 1
+        with self._lock:
+            return owner, self._routers.get(owner)
+
+    # -- introspection -------------------------------------------------------
+
+    def aggregate_affinity(self) -> dict:
+        """Fleet-wide affinity hit rate folded across router shards —
+        comparable to a single router's ``debug_state()['affinity']``."""
+        hits = requests = 0
+        per_shard = {}
+        for name, router in sorted(self.routers().items()):
+            try:
+                aff = router.debug_state().get("affinity") or {}
+            except Exception:
+                aff = {}
+            h, r = aff.get("hits", 0), aff.get("requests", 0)
+            hits += h
+            requests += r
+            per_shard[name] = {"hits": h, "requests": r}
+        return {
+            "hits": hits,
+            "requests": requests,
+            "hit_rate": (hits / requests) if requests else 0.0,
+            "per_shard": per_shard,
+        }
+
+    def debug_state(self) -> dict:
+        return {
+            "routers": sorted(self.routers()),
+            "page_size": self.page_size,
+            "steered": self.steered,
+            "unkeyed": self.unkeyed,
+            "affinity": self.aggregate_affinity(),
+        }

@@ -1,0 +1,240 @@
+"""One federation shard: a whole single-leader control plane, scoped to
+one (region, generation, topology-class) slice of the fleet.
+
+A shard is deliberately NOT a new kind of scheduler — it is the same
+``TPUUnitScheduler`` every pre-federation deployment runs, with two
+bindings swapped: its own ``Journal`` instance (via
+``SchedulerConfig.journal``) so every mutation lands in a per-shard
+segment directory, and its own clientset over the shard's node slice.
+PR 13's standby machinery composes unchanged: a follower pointed at a
+shard's ``/journal/stream`` ships THIS journal, and warm takeover swaps
+state into THIS engine — one standby chain per shard.
+
+``kill()`` / ``revive()`` are the chaos-harness surface: ``kill``
+aborts the journal writer mid-write (the kill -9 torn tail) and marks
+the shard dead; ``revive`` repairs + reopens the journal, cold-rebuilds
+a fresh engine from the annotation ledger, and resolves any in-doubt
+``fed_gang`` reservation the dead leader left behind — compensating
+rollback (presumed abort) unless the front door's decision log says the
+transaction committed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Union
+
+from ..journal import Journal, read_journal
+from ..journal.replay import replay
+from ..scheduler.scheduler import SchedulerConfig, TPUUnitScheduler
+
+log = logging.getLogger("tpu-federation")
+
+
+def shard_key(region: str, generation: str, topo_class: str) -> str:
+    """The shard id: the (region, generation, topology-class) triple the
+    capacity index already buckets by, flattened to one routable name."""
+    return f"{region}/{generation}/{topo_class}"
+
+
+def shard_key_for_entry(region: str, entry) -> str:
+    """Shard id for a live ``core.index.IndexEntry`` — generation and
+    topology class come from the entry itself (via the index's own
+    ``topo_class`` derivation), so placement buckets and shard
+    ownership stay in lockstep."""
+    from ..core.index import topo_class
+
+    return shard_key(region, entry.generation, topo_class(entry.topo_key))
+
+
+class SchedulerShard:
+    def __init__(
+        self,
+        shard_id: str,
+        clientset,
+        journal_dir: str,
+        node_names: Optional[list[str]] = None,
+        priority: str = "binpack",
+        fsync: str = "off",
+        max_segment_bytes: int = 64 << 20,
+        placement_index: bool = True,
+    ):
+        from ..policy import resolve_rater
+
+        self.shard_id = shard_id
+        self.clientset = clientset
+        self.journal_dir = journal_dir
+        # candidate set the front door filters over (the shard's node
+        # slice; allocators materialize lazily on first assume/bind)
+        self.node_names: list[str] = list(node_names or [])
+        self.rater = resolve_rater(priority)
+        self._fsync = fsync
+        self._max_segment_bytes = int(max_segment_bytes)
+        self._placement_index = bool(placement_index)
+        self.dead = False
+        self.kills = 0
+        self.JOURNAL = Journal()
+        self.JOURNAL.configure(
+            journal_dir, fsync=fsync, max_segment_bytes=max_segment_bytes
+        )
+        self.engine = self._build_engine()
+
+    def _build_engine(self) -> TPUUnitScheduler:
+        config = SchedulerConfig(
+            clientset=self.clientset,
+            rater=self.rater,
+            placement_index=self._placement_index,
+            journal=self.JOURNAL,
+        )
+        return TPUUnitScheduler(config, name=f"tpushare/{self.shard_id}")
+
+    def warm(self) -> int:
+        """Materialize an allocator for every node in the shard's slice
+        (they otherwise build lazily on first assume/bind).  The front
+        door routes off ``status_summary`` capacity, and a cold shard
+        summarizes to zero nodes — harnesses and servers warm at boot
+        so the first summary already shows the real slice.  Returns the
+        number of live allocators."""
+        for name in self.node_names:
+            self.engine._get_allocator(name)
+        with self.engine.lock:
+            return len(self.engine.allocators)
+
+    # -- summaries (what the front door routes off) --------------------------
+
+    def status_summary(self, top_k: int = 10, generations: bool = False) -> dict:
+        s = self.engine.status_summary(top_k=top_k, generations=generations)
+        s["shard"] = self.shard_id
+        return s
+
+    # -- chaos surface -------------------------------------------------------
+
+    def kill(self) -> None:
+        """Shard-leader death: the journal writer dies mid-write (torn
+        tail on disk, exactly what kill -9 leaves) and the shard stops
+        answering.  In-memory engine state is abandoned — a dead
+        leader's memory is gone; only its journal and the annotation
+        ledger survive."""
+        self.kills += 1
+        self.dead = True
+        self.JOURNAL.abort()
+
+    def revive(
+        self,
+        decisions: Union[dict, Callable[[str], Optional[str]], None] = None,
+    ) -> dict:
+        """Bring a killed shard back: repair + reopen the journal
+        (sequence numbering resumes after the truncated tear),
+        cold-rebuild a fresh engine from the annotation ledger, then
+        resolve every in-doubt ``fed_gang`` the dead leader left
+        prepared-but-undecided.  ``decisions`` maps txn id → "commit" /
+        "abort" (the front door's decision log, or a callable); unknown
+        transactions are presumed aborted — the coordinator only
+        commits after EVERY shard prepared, so an unresolved prepare
+        with no recorded decision cannot have committed anywhere."""
+        self.JOURNAL.configure(
+            self.journal_dir, fsync=self._fsync,
+            max_segment_bytes=self._max_segment_bytes,
+        )
+        # cold rebuild re-charges whatever the dead leader had annotated
+        # (journals node_add + bind(source=replay) into the reopened
+        # stream — the same records a restarting single leader writes),
+        # then the slice re-warms so summaries report full capacity
+        self.engine = self._build_engine()
+        self.warm()
+        self.dead = False
+        return self.resolve_in_doubt(decisions)
+
+    def resolve_in_doubt(
+        self,
+        decisions: Union[dict, Callable[[str], Optional[str]], None] = None,
+    ) -> dict:
+        """Terminate every ``fed_gang`` txn whose last local phase is
+        still ``prepare``: journal a ``commit`` (decision says the fleet
+        committed — the rebuilt members stay) or compensate — free any
+        rebuilt member via ``gang_unallocate``, strip its ledger entry,
+        and journal the ``abort``.  Idempotent: a resolved txn has a
+        terminal record and is skipped on the next call."""
+        if not self.JOURNAL.flush():
+            log.warning("shard %s: journal flush before in-doubt scan "
+                        "failed", self.shard_id)
+        res = replay(read_journal(self.journal_dir))
+        decide = (
+            decisions if callable(decisions)
+            else (decisions or {}).get
+        )
+        resolved = {"committed": [], "aborted": []}
+        for txn, fg in sorted(res.fed_gangs.items()):
+            phases = fg.get("phases") or []
+            if not phases or phases[-1] != "prepare":
+                continue
+            decision = decide(txn) or "abort"
+            members = list(fg.get("members") or [])
+            if decision == "commit":
+                with self.engine.lock:
+                    self.JOURNAL.record(
+                        "fed_gang", phase="commit", txn=txn,
+                        gang=fg.get("gang"), members=members,
+                        shards=fg.get("shards") or [],
+                        shard=self.shard_id, recovered=True,
+                    )
+                resolved["committed"].append(txn)
+                continue
+            # compensating rollback, reverse reservation order.  Two
+            # shapes per member: rebuilt from its ledger annotation
+            # (free the live charge — gang_unallocate journals the
+            # balancing forget) or journal-only (the leader died after
+            # sealing the prepare but before the annotation landed, so
+            # the rebuild found nothing — journal a bare forget so the
+            # STREAM balances; there is no memory to free).
+            for key in reversed(members):
+                entry = self.engine.pod_maps.get(key)
+                if entry is not None:
+                    node, opt = entry
+                    ns, _, name = key.partition("/")
+                    try:
+                        pod = self.clientset.get_pod(ns, name)
+                    except Exception:
+                        continue
+                    self.engine.gang_unallocate(
+                        node, pod, opt, source="fed_gang_recovery"
+                    )
+                    try:
+                        self.engine.gang_strip_annotations(pod)
+                    except Exception as e:  # best-effort; resync wins
+                        log.warning("shard %s: strip %s failed: %s",
+                                    self.shard_id, key, e)
+                elif key in res.pods:
+                    lp = res.pods[key]
+                    self.JOURNAL.record(
+                        "forget", pod=key, uid=lp.uid, node=lp.node,
+                        option=None, gang=lp.gang,
+                        source="fed_gang_recovery",
+                    )
+            with self.engine.lock:
+                self.JOURNAL.record(
+                    "fed_gang", phase="abort", txn=txn,
+                    gang=fg.get("gang"), members=members,
+                    shards=fg.get("shards") or [],
+                    shard=self.shard_id, recovered=True,
+                    reason="in-doubt recovery: presumed abort",
+                )
+            resolved["aborted"].append(txn)
+        # seal the terminal records: recovery isn't done until the
+        # commit/abort outcomes are on disk (an auditor reading the
+        # segments must never see the in-doubt state we just resolved)
+        if (resolved["committed"] or resolved["aborted"]) and \
+                not self.JOURNAL.flush():
+            log.warning("shard %s: journal flush after in-doubt "
+                        "resolution failed", self.shard_id)
+        return resolved
+
+    def debug_state(self) -> dict:
+        return {
+            "shard": self.shard_id,
+            "dead": self.dead,
+            "kills": self.kills,
+            "nodes": len(self.node_names),
+            "journal_dir": self.journal_dir,
+            "last_seq": self.JOURNAL.last_seq(),
+        }

@@ -274,6 +274,7 @@ class Autoscaler:
         shed_queue_margin: float = 0.0,
         slo_provider=None,
         clock=time.monotonic,
+        extra_replica_sets=None,
     ):
         """``slo_provider``: callable → the SLO plane's burn posture
         (``SLO.scaling_input`` is the production shape; None while no
@@ -294,8 +295,16 @@ class Autoscaler:
         the victim's live sessions away instead of waiting out their
         generation.  Every commanded migration journals a ``kv_migrate``
         annotation — the decision trail replay audits alongside
-        ``fleet`` records."""
+        ``fleet`` records.
+
+        ``extra_replica_sets``: additional ``ReplicaSet``s whose 'up'
+        stats fold into ``signals()`` alongside the primary set.  A
+        sharded data plane (federation ``RouterRing``) runs one router
+        per shard, each polling its own ``ReplicaSet`` — the scaler
+        must see fleet-wide queue/occupancy, not one shard's slice, or
+        a hot shard hides behind a cold one's averages."""
         self.replicas = replicas
+        self.extra_replica_sets = list(extra_replica_sets or [])
         self.executor = executor
         self.policy = policy or ScalingPolicy()
         self.engine = PolicyEngine(self.policy)
@@ -326,6 +335,8 @@ class Autoscaler:
         # them would scale on dead data — and its queued work reroutes
         # to the up set as it drains anyway
         reps = [r for r in self.replicas.all() if r.state == "up"]
+        for rs in self.extra_replica_sets:
+            reps.extend(r for r in rs.all() if r.state == "up")
         return fold_signals([r.stats for r in reps])
 
     def _victim(self) -> Optional[str]:

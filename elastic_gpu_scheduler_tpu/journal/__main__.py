@@ -10,6 +10,15 @@ placements all-or-nothing), optionally diffs against a live
 ``/scheduler/status`` snapshot (a URL, a file path, or ``-`` for
 stdin), and optionally re-scores the recorded workload under a
 different rater (``--rater binpack|spread|random|ici-locality``).
+
+``--dir`` may also point at a FEDERATION journal root — a directory of
+per-shard journal directories (no segments of its own) — in which case
+every shard stream replays independently and the cross-shard
+``fed_gang`` conservation audit runs on top (all-or-nothing agreement,
+no silent committed participants, no unresolved reservations).
+``--rater`` then scores each shard's recorded workload separately;
+``--status`` is single-stream only.
+
 Exit status: 0 clean, 1 invariant violations or live-state divergence,
 2 usage error.
 """
@@ -34,6 +43,57 @@ def _load_status(src: str) -> dict:
             return json.loads(resp.read())
     with open(src) as f:
         return json.load(f)
+
+
+def _replay_federated(args, shard_dirs: dict) -> int:
+    from ..federation.audit import audit_federation
+
+    if args.status:
+        print("error: --status diffs one live scheduler against one "
+              "stream; point --dir at a single shard's journal instead",
+              file=sys.stderr)
+        return 2
+    audit = audit_federation(args.dir, dirs=shard_dirs)
+    audit.pop("results")  # ReplayResult objects aren't JSON-serializable
+    out = {
+        "journal": {"dir": args.dir, "shards": len(shard_dirs)},
+        "federated": audit,
+    }
+    if args.rater:
+        from ..policy.registry import resolve_rater
+
+        try:
+            rater = resolve_rater(args.rater)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        out["what_if"] = {
+            sid: what_if(read_journal(path), rater)
+            for sid, path in sorted(shard_dirs.items())
+        }
+    failed = bool(audit["violations"])
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print(f"federation: {len(shard_dirs)} shard journal(s) under "
+              f"{args.dir}")
+        for sid, s in sorted(audit["shards"].items()):
+            print(f"shard:   {sid}: {s['records']} record(s), "
+                  f"{s['live_pods']} live pod(s), "
+                  f"{s.get('fed_gang_records', 0)} fed_gang record(s)")
+        if audit["fed_gangs"]:
+            print(f"fed_gang: {len(audit['fed_gangs'])} cross-shard "
+                  f"transaction(s)")
+        for v in audit["violations"]:
+            print(f"VIOLATION: {v}")
+        for sid, w in sorted(out.get("what_if", {}).items()):
+            print(
+                f"what-if [{sid}] {w['rater']}: {w['placed']}/{w['binds']} "
+                f"placed (mean score {w['mean_score']})"
+            )
+        if not failed:
+            print("ok: invariants hold across all shard journals")
+    return 1 if failed else 0
 
 
 def main(argv=None) -> int:
@@ -74,6 +134,15 @@ def main(argv=None) -> int:
     if args.cmd != "replay":
         p.print_help()
         return 2
+
+    # Federation root (directory of per-shard journal directories)?
+    # Replay every stream and audit fed_gang conservation ACROSS them —
+    # a single-stream replay cannot see the other 2PC participants.
+    from ..federation.audit import shard_journal_dirs
+
+    shard_dirs = shard_journal_dirs(args.dir)
+    if shard_dirs:
+        return _replay_federated(args, shard_dirs)
 
     events = read_journal(args.dir)
     res = replay(events)

@@ -172,6 +172,18 @@ class ReplayResult:
     # mistake a twin journal for a live flight recording.
     twin_records: int = 0
     last_twin: Optional[dict] = None
+    # federated cross-shard gang transactions (federation/): THIS shard's
+    # view of each two-phase admission — phases in stream order plus the
+    # local member set, keyed by txn id.  Each phase record is audited
+    # in place (prepare/commit ⇒ local members bound; abort ⇒ none), and
+    # conservation_violations() flags any txn whose last local phase is
+    # still "prepare" (an unresolved reservation: the shard died between
+    # phase 1 and the decision, and recovery never compensated it).  The
+    # CROSS-shard agreement audit — every participant reaching the same
+    # terminal phase — folds these views across shard journals
+    # (federation.audit / the journal CLI's --dir-of-dirs mode).
+    fed_gang_records: int = 0
+    fed_gangs: dict = field(default_factory=dict)  # txn → view dict
 
     def summary(self) -> dict:
         # fragmentation derived from the REPLAYED chip state — the same
@@ -206,6 +218,16 @@ class ReplayResult:
             "slo_records": self.slo_records,
             "slo_breaches": self.slo_breaches,
             "twin_records": self.twin_records,
+            "fed_gang_records": self.fed_gang_records,
+            "fed_gangs": {
+                txn: {
+                    "gang": v.get("gang"),
+                    "phases": list(v.get("phases", [])),
+                    "members": list(v.get("members", [])),
+                    "shards": list(v.get("shards", [])),
+                }
+                for txn, v in sorted(self.fed_gangs.items())
+            },
             "violations": list(self.violations),
             "warnings": list(self.warnings),
         }
@@ -522,6 +544,69 @@ class ReplayEngine:
                     f"{where}: gang {gang} rolled back but {len(bound)} "
                     f"member(s) still journaled as bound: {bound[:4]}"
                 )
+        elif t == "fed_gang":
+            # one shard's view of a federated two-phase gang admission
+            # (federation/frontdoor.py).  The LOCAL members' binds and
+            # compensating forgets are journaled individually by the
+            # split-phase primitives; each phase record seals what the
+            # stream must show at that point:
+            #   prepare — every local member bound (journaled under the
+            #   same engine-lock hold as the binds, so nothing can
+            #   interleave);
+            #   commit  — the prepared members still bound;
+            #   abort   — none bound (the compensating forgets are
+            #   journaled BEFORE the abort, reverse-commit order).
+            # Cross-shard agreement (all participants reach the same
+            # terminal phase) is the dir-of-dirs audit's job — one
+            # stream cannot see the other shards.
+            txn = rec.get("txn", "?")
+            phase = rec.get("phase", "?")
+            members = rec.get("members") or []
+            res.fed_gang_records += 1
+            fg = res.fed_gangs.setdefault(txn, {
+                "gang": rec.get("gang", "?"), "phases": [],
+                "members": [], "shards": rec.get("shards") or [],
+            })
+            fg["phases"].append(phase)
+            if members:
+                fg["members"] = list(members)
+            else:
+                members = fg["members"]
+            if phase == "prepare":
+                missing = [m for m in members if m not in res.pods]
+                if missing:
+                    res.violations.append(
+                        f"{where}: fed_gang {txn} prepared with "
+                        f"{len(missing)}/{len(members)} local member(s) "
+                        f"not bound: {missing[:4]} — phase-1 reservation "
+                        "not sealed atomically"
+                    )
+            elif phase == "commit":
+                if "prepare" not in fg["phases"][:-1]:
+                    res.violations.append(
+                        f"{where}: fed_gang {txn} committed without a "
+                        "local prepare — decision outran the reservation"
+                    )
+                missing = [m for m in members if m not in res.pods]
+                if missing:
+                    res.violations.append(
+                        f"{where}: fed_gang {txn} committed but "
+                        f"{len(missing)} local member(s) not bound: "
+                        f"{missing[:4]} — all-or-nothing violated"
+                    )
+            elif phase == "abort":
+                bound = [m for m in members if m in res.pods]
+                if bound:
+                    res.violations.append(
+                        f"{where}: fed_gang {txn} aborted but "
+                        f"{len(bound)} local member(s) still bound: "
+                        f"{bound[:4]} — compensating rollback incomplete"
+                    )
+            else:
+                res.violations.append(
+                    f"{where}: fed_gang {txn} has unknown phase "
+                    f"{phase!r}"
+                )
         elif t == "node_remove":
             # the live remove_node refuses while ledger pods still charge
             # the node, so a journal recording a removal with live pods on
@@ -772,6 +857,18 @@ class ReplayEngine:
                     f"core={used_core}/hbm={used_hbm} in use but live pods "
                     f"charge core={exp_core}/hbm={exp_hbm}"
                 )
+        # federated 2PC: a txn whose LAST local phase is "prepare" holds
+        # a reservation nobody decided — the shard died mid-transaction
+        # and recovery never compensated it (chips silently pinned)
+        for txn, fg in sorted(res.fed_gangs.items()):
+            phases = fg.get("phases") or []
+            if phases and phases[-1] == "prepare":
+                out.append(
+                    f"fed_gang {txn}: unresolved at end of stream — "
+                    "prepared but never committed or aborted "
+                    "(reservation leaked; recovery owed a compensating "
+                    "rollback)"
+                )
         return out
 
 
@@ -950,7 +1047,7 @@ def what_if(events: list[dict], rater: Rater) -> dict:
                 observe_profile(rec)
             continue
         if t in ("fleet", "resize", "policy", "policy_fault", "warmup",
-                 "gang_admit", "gang_rollback", "ha_takeover",
+                 "gang_admit", "gang_rollback", "fed_gang", "ha_takeover",
                  "kv_migrate", "slo", "twin"):
             # annotations (autoscaler evaluations / resize summaries /
             # policy-plane events / compile warm-ups / gang admit+rollback

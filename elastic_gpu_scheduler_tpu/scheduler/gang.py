@@ -80,7 +80,6 @@ from ..core.allocator import (
 from ..core.index import request_demand
 from ..core.request import TPURequest, request_from_pod
 from ..faultinject import FAULTS
-from ..journal import JOURNAL
 from ..k8s.objects import Pod
 from ..metrics import GANG_COMMIT, GANG_EVENTS, PLAN_CACHE, TimedLock
 from ..tracing import AUDIT, NOOP_SPAN, TRACER
@@ -1476,7 +1475,7 @@ class GangCoordinator:
                         if opt is None:
                             opt = sched.gang_allocate(node, pod)
                         allocated.append((pod, node, opt))
-                    if JOURNAL.enabled:
+                    if sched.JOURNAL.enabled:
                         # the all-or-nothing seal, INSIDE the same engine-
                         # lock hold as the members' bind records: no
                         # concurrent forget (it needs sched.lock) can
@@ -1484,7 +1483,7 @@ class GangCoordinator:
                         # so replay's membership check can never trip on a
                         # legal mid-commit deletion.  Phase-2/3 failures
                         # journal balancing forgets + a gang_rollback.
-                        JOURNAL.record(
+                        sched.JOURNAL.record(
                             "gang_admit",
                             gang=gkey,
                             size=g.size,
@@ -1651,11 +1650,11 @@ class GangCoordinator:
         except Exception as e:
             with self._lock:
                 self._plans.pop(gkey, None)  # stale either way
-            if JOURNAL.enabled:
+            if sched.JOURNAL.enabled:
                 # phase rollbacks freed every allocation before any bind
                 # record was journaled, so this is informational: a gang
                 # that reached commit and left NOTHING bound
-                JOURNAL.record(
+                sched.JOURNAL.record(
                     "gang_rollback",
                     gang=gkey,
                     size=g.size,

@@ -72,6 +72,12 @@ class SchedulerConfig:
     # journal shipping and is swapped in by scheduler/ha.warm_takeover
     # on election, so a cold rebuild here would only be thrown away.
     rebuild_on_start: bool = True
+    # Per-engine journal instance.  None = the process-global JOURNAL
+    # (every pre-federation caller).  A federation shard passes its own
+    # Journal so many engines in one process each write their own
+    # segment directory — the per-shard stream the cross-shard fed_gang
+    # audit folds over.
+    journal: Optional["object"] = None
 
 
 class ResourceScheduler:
@@ -115,6 +121,11 @@ class TPUUnitScheduler(ResourceScheduler):
         self.clientset = config.clientset
         self.rater = config.rater
         self.assume_workers = max(1, config.assume_workers)
+        # Engine-scoped journal handle: the process-global JOURNAL unless
+        # a federation shard injected its own.  Everything below (and the
+        # gang coordinator, via sched.JOURNAL) writes through this handle
+        # so per-shard engines journal to per-shard streams.
+        self.JOURNAL = config.journal if config.journal is not None else JOURNAL
         # Sharded locking (wait-time-instrumented via metrics.LOCK_WAIT):
         # this lock guards ONLY the registry maps (allocators / pod_maps /
         # released_pods) — chip state lives behind each NodeAllocator's own
@@ -239,6 +250,7 @@ class TPUUnitScheduler(ResourceScheduler):
             log.debug("get node %s: %s", node_name, e)
             return None
         na = NodeAllocator(node)
+        na.JOURNAL = self.JOURNAL  # resync records follow the engine's stream
         if na.chips.num_chips == 0:
             return None
         # replay pods already assumed onto this node
@@ -262,12 +274,12 @@ class TPUUnitScheduler(ResourceScheduler):
                 # dirty the entry like any later mutation
                 na.on_change = self.index.mark_dirty
                 self.index.note_node(node_name, na)
-            if JOURNAL.enabled:
+            if self.JOURNAL.enabled:
                 # capacity inventory first, so every later bind/forget on
                 # this node replays against a known chip set; generation
                 # rides along so offline what-if replay can key
                 # profile-aware scores by TPU type
-                JOURNAL.record(
+                self.JOURNAL.record(
                     "node_add", node=node_name, generation=na.generation,
                     **na.chips.inventory(),
                 )
@@ -1033,12 +1045,12 @@ class TPUUnitScheduler(ResourceScheduler):
         trace_id=None,
     ):
         self._profile_note("bind", pod, to_node, new_opt)
-        if not JOURNAL.enabled:
+        if not self.JOURNAL.enabled:
             return None
         if trace_id is None:
             ctx = TRACER.pod_context(pod.key)
             trace_id = ctx.trace_id if ctx is not None else None
-        return JOURNAL.record(
+        return self.JOURNAL.record(
             "migrate",
             pod=pod.key,
             uid=pod.metadata.uid,
@@ -1279,13 +1291,13 @@ class TPUUnitScheduler(ResourceScheduler):
         self._frag_cache_at = time.monotonic()
 
     def register_checkpoint_provider(self) -> None:
-        """Point the global journal's segment-head checkpoints at THIS
+        """Point the engine's journal's segment-head checkpoints at THIS
         engine.  Called at construction, and again after a journal
         reconfigure (``Journal.configure`` clears the provider — a new
         leader reopening its journal at warm takeover must re-register
         before its requested boot checkpoint can be written)."""
         ref = weakref.ref(self)
-        JOURNAL.checkpoint_provider = lambda: (
+        self.JOURNAL.checkpoint_provider = lambda: (
             lambda s: s._journal_checkpoint() if s is not None else None
         )(ref())
 
@@ -1293,7 +1305,7 @@ class TPUUnitScheduler(ResourceScheduler):
         """Full-state snapshot for the journal's segment-head checkpoint
         (runs on the journal writer thread: registry under self.lock,
         per-node inventory under each node's own lock)."""
-        if not JOURNAL.enabled:
+        if not self.JOURNAL.enabled:
             return None
         with self.lock:
             # exact as_of: every engine mutation journals INSIDE this
@@ -1301,7 +1313,7 @@ class TPUUnitScheduler(ResourceScheduler):
             # in the ledger copy below — no claimed-covered-but-absent
             # window (the journal's own fallback reads it pre-provider,
             # which is safe but coarser)
-            as_of = JOURNAL.last_seq()
+            as_of = self.JOURNAL.last_seq()
             allocators = dict(self.allocators)
             pods = [
                 {"pod": k, "node": n, "option": option_record(o)}
@@ -1333,7 +1345,7 @@ class TPUUnitScheduler(ResourceScheduler):
         raters.  Also the profile observatory's co-tenancy choke point:
         every committed bind/forget passes through here."""
         self._profile_note(type_, pod, node_name, opt)
-        if not JOURNAL.enabled:
+        if not self.JOURNAL.enabled:
             return None
         if trace_id is None:
             ctx = TRACER.pod_context(pod.key)
@@ -1341,7 +1353,7 @@ class TPUUnitScheduler(ResourceScheduler):
         # no fragmentation fields: the replayed chip state derives them
         # exactly at any seq (ReplayResult.summary), and attaching them
         # here would put the contiguous-box scan on the bind path
-        return JOURNAL.record(
+        return self.JOURNAL.record(
             type_,
             pod=pod.key,
             uid=pod.metadata.uid,
@@ -1536,8 +1548,8 @@ class TPUUnitScheduler(ResourceScheduler):
             CHIPS_ALLOCATED.remove(node_name)
             FRAG_INDEX.remove(node_name)
             FREE_SUBMESH.remove(node_name)
-            if JOURNAL.enabled:
-                JOURNAL.record(
+            if self.JOURNAL.enabled:
+                self.JOURNAL.record(
                     "node_remove", node=node_name, source=source
                 )
         log.info("removed vanished node %s from the allocator registry",

@@ -290,6 +290,11 @@ the Python analogues):</p>
  a scenario ({"mode": "synthetic"|"recorded", "duration_s": N, ...} —
  recorded mode replays this process's own journal through the twin);
  offline CLI: python -m elastic_gpu_scheduler_tpu.twin</li>
+<li><a href="/debug/federation">/debug/federation</a>
+ — federated control plane: shard inventory (dead/alive, journal seq),
+ cross-shard gang decision log, routing counters; the federation front
+ door also serves GET /scheduler/status?summary=1 folded across every
+ shard with per-shard staleness stamps</li>
 <li><a href="/debug/relay">/debug/relay</a>
  — TPU probe-relay health (the tpu_relay_up gauge's source: last probe
  state, latency, failure detail; --relay-probe-interval starts it)</li>
@@ -463,6 +468,7 @@ class ExtenderServer:
         elector=None,  # optional LeaderElector (/debug/leader)
         follower=None,  # optional journal.ship.JournalFollower (HA standby)
         assembler=None,  # optional slo.assembly.TraceAssembler
+        federation=None,  # optional federation.FederationFrontDoor
     ):
         self.predicate = predicate
         self.prioritize = prioritize
@@ -475,6 +481,7 @@ class ExtenderServer:
         self.elector = elector
         self.follower = follower
         self.assembler = assembler
+        self.federation = federation
         self.host = host
         self.port = port
         self.tls_cert = tls_cert
@@ -658,6 +665,23 @@ class ExtenderServer:
             return (
                 200,
                 json.dumps(SLO.debug_state(), indent=1).encode(),
+                "application/json",
+            )
+        if path == "/debug/federation":
+            # federated control plane: shard inventory, 2PC decision
+            # log, routing counters (the front door's own port serves
+            # the same payload; this mirror keeps one /debug/ index)
+            if self.federation is None:
+                return (
+                    200,
+                    json.dumps({"federated": False}).encode(),
+                    "application/json",
+                )
+            return (
+                200,
+                json.dumps(
+                    self.federation.debug_state(), indent=1
+                ).encode(),
                 "application/json",
             )
         if path == "/debug/twin":

@@ -31,7 +31,28 @@ from typing import Optional
 
 from ..tracing import TRACER
 
-__all__ = ["TraceAssembler"]
+__all__ = ["TraceAssembler", "merged_sources"]
+
+
+def merged_sources(*fns):
+    """Compose several source callables — one per router shard — into
+    the single list ``TraceAssembler`` pulls.  A sharded data plane
+    (federation ``RouterRing``) runs one ``ReplicaSet`` per router, so
+    the assembler must fold every shard's replica list or journeys that
+    crossed shards resolve with holes; duplicate (host, port) entries
+    (shards polling the same backends) pull once."""
+    def fold():
+        seen = set()
+        out = []
+        for fn in fns:
+            for name, addr in list(fn() or []):
+                key = tuple(addr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append((name, addr))
+        return out
+    return fold
 
 
 def _pull_trace(

@@ -1,0 +1,367 @@
+"""Federated control plane (federation/): shard keys, front-door
+routing off aggregate capacity, two-phase cross-shard gang admission
+(all-or-nothing + compensating rollback + in-doubt recovery), the
+federated status fold, rendezvous ownership, and the cross-shard
+journal conservation audit + journal-CLI multi-shard mode.
+
+Smoke tier: no jax — shards run the real scheduler plane over
+FakeCluster slices with per-shard Journal instances in tmp dirs."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elastic_gpu_scheduler_tpu.faultinject import FAULTS
+from elastic_gpu_scheduler_tpu.federation import (
+    FederationFrontDoor,
+    RouterRing,
+    SchedulerShard,
+    shard_key,
+)
+from elastic_gpu_scheduler_tpu.federation.audit import (
+    audit_federation,
+    cross_shard_violations,
+    shard_journal_dirs,
+)
+from elastic_gpu_scheduler_tpu.federation.ring import rendezvous_owner
+from elastic_gpu_scheduler_tpu.journal import read_journal
+from elastic_gpu_scheduler_tpu.journal.replay import (
+    ReplayResult,
+    diff_live,
+    replay,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster
+from elastic_gpu_scheduler_tpu.k8s.objects import (
+    Container,
+    ResourceRequirements,
+    make_pod,
+    make_tpu_node,
+)
+from elastic_gpu_scheduler_tpu.utils import consts
+
+
+def _pod(name, core=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {consts.RESOURCE_TPU_CORE: core} if core else {}
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+def _shard(tmp_path, sid, n_nodes=4, generation="v5e"):
+    cluster = FakeCluster()
+    names = []
+    for i in range(n_nodes):
+        name = f"{sid.replace('/', '-')}-n{i}"
+        cluster.add_node(make_tpu_node(
+            name, chips=4, hbm_gib=64, accelerator=generation,
+            slice_topology="4x4",
+        ))
+        names.append(name)
+    # nested dirs: shard ids keep their "/"s, so the cross-shard audit
+    # recovers the id from the relpath under the federation root
+    sh = SchedulerShard(
+        sid, FakeClientset(cluster),
+        str(tmp_path / sid), node_names=names,
+    )
+    sh.cluster = cluster
+    sh.warm()
+    return sh
+
+
+def _free_core(sh):
+    return sh.engine.status_summary()["capacity"]["core_avail"]
+
+
+@pytest.fixture
+def fed(tmp_path):
+    fd = FederationFrontDoor()
+    a = _shard(tmp_path, "us/v5e/4x4", generation="v5e")
+    b = _shard(tmp_path, "eu/v5p/4x4", generation="v5p")
+    fd.add_shard(a)
+    fd.add_shard(b)
+    fd.refresh_summaries()
+    yield fd, a, b
+    FAULTS.clear()
+    for sh in (a, b):
+        sh.JOURNAL.close()
+
+
+def test_shard_key_is_the_index_bucket_triple():
+    assert shard_key("us", "v5e", "4x4") == "us/v5e/4x4"
+
+
+def test_federated_summary_folds_capacity_with_staleness(fed):
+    fd, a, b = fed
+    s = fd.federated_summary()
+    assert s["federated"] is True
+    assert s["nodes"] == len(a.node_names) + len(b.node_names)
+    assert (
+        s["capacity"]["core_avail"] == _free_core(a) + _free_core(b)
+    )
+    # per-shard staleness stamps: every shard reports, fresh, alive
+    assert set(s["shards"]) == {a.shard_id, b.shard_id}
+    for stamp in s["shards"].values():
+        assert stamp["stale_s"] >= 0.0
+        assert stamp["dead"] is False
+    # generation fold keeps both slices distinct
+    assert "v5e" in s["generations"] and "v5p" in s["generations"]
+
+
+def test_route_pod_binds_on_one_shard_and_respects_generation(fed):
+    fd, a, b = fed
+    p = _pod("r1", core=100)
+    a.cluster.create_pod(p)
+    b.cluster.create_pod(p)
+    r = fd.route_pod(p, generation="v5p")
+    assert r["ok"] and r["shard"] == b.shard_id
+    assert _free_core(b) == 16 * 100 - 100
+    assert _free_core(a) == 16 * 100
+
+
+def test_cross_shard_gang_commits_all_or_nothing(fed, tmp_path):
+    fd, a, b = fed
+    members = []
+    for j, sh in enumerate((a, b)):
+        gp = _pod(f"g-m{j}", core=100, gang="g", gang_size=2)
+        sh.cluster.create_pod(gp)
+        members.append((sh.shard_id, sh.node_names[0], gp))
+    res = fd.admit_gang("default/g", members)
+    assert res["ok"]
+    assert fd.decisions[res["txn"]] == "commit"
+    # both shards journaled prepare→commit and replay clean
+    for sh in (a, b):
+        assert sh.JOURNAL.flush()
+        r = replay(read_journal(sh.journal_dir))
+        assert not r.violations
+        assert r.fed_gangs[res["txn"]]["phases"] == ["prepare", "commit"]
+        assert not diff_live(r, sh.engine.status())
+    audit = audit_federation(str(tmp_path))
+    assert not audit["violations"]
+
+
+def test_cross_shard_gang_aborts_all_or_nothing_on_phase1_fault(fed):
+    fd, a, b = fed
+    base = _free_core(a) + _free_core(b)
+    members = []
+    for j, sh in enumerate((a, b)):
+        gp = _pod(f"ab-m{j}", core=100, gang="ab", gang_size=2)
+        sh.cluster.create_pod(gp)
+        members.append((sh.shard_id, sh.node_names[0], gp))
+    # second shard's phase-1 faults AFTER the first reserved: the first
+    # must be compensated in reverse order, nothing stays charged
+    FAULTS.configure(
+        [{"site": "fed.prepare", "kind": "error", "nth": 2, "count": 1}],
+        seed=7,
+    )
+    res = fd.admit_gang("default/ab", members)
+    FAULTS.clear()
+    assert not res["ok"]
+    assert fd.decisions[res["txn"]] == "abort"
+    assert _free_core(a) + _free_core(b) == base
+    # the prepared shard's journal carries the compensating abort
+    first = min((a, b), key=lambda s: s.shard_id)
+    assert first.JOURNAL.flush()
+    r = replay(read_journal(first.journal_dir))
+    assert r.fed_gangs[res["txn"]]["phases"] == ["prepare", "abort"]
+    assert not r.violations
+
+
+def test_shard_kill_mid_phase1_recovers_by_presumed_abort(fed, tmp_path):
+    fd, a, b = fed
+    base = _free_core(a) + _free_core(b)
+    first = min((a, b), key=lambda s: s.shard_id)
+    members = []
+    for j, sh in enumerate((a, b)):
+        gp = _pod(f"k-m{j}", core=100, gang="k", gang_size=2)
+        sh.cluster.create_pod(gp)
+        members.append((sh.shard_id, sh.node_names[0], gp))
+    # the first shard seals its prepare, then dies; the second shard's
+    # prepare faults → abort decision, dead shard skipped by rollback
+    fd.on_prepared = (
+        lambda txn, sid: first.kill() if sid == first.shard_id else None
+    )
+    FAULTS.configure(
+        [{"site": "fed.prepare", "kind": "error", "nth": 2, "count": 1}],
+        seed=7,
+    )
+    res = fd.admit_gang("default/k", members)
+    FAULTS.clear()
+    fd.on_prepared = None
+    assert not res["ok"]
+    # revive: unknown-to-commit txn is presumed aborted from the
+    # decision log, the in-doubt reservation is compensated
+    rec = first.revive(fd.decisions)
+    assert rec["aborted"] == [res["txn"]]
+    assert _free_core(a) + _free_core(b) == base
+    audit = audit_federation(str(tmp_path))
+    assert not audit["violations"]
+
+
+def test_shard_kill_mid_commit_resolves_forward(fed, tmp_path):
+    fd, a, b = fed
+    base = _free_core(a) + _free_core(b)
+    first = min((a, b), key=lambda s: s.shard_id)
+    members = []
+    for j, sh in enumerate((a, b)):
+        gp = _pod(f"c-m{j}", core=100, gang="c", gang_size=2)
+        sh.cluster.create_pod(gp)
+        members.append((sh.shard_id, sh.node_names[0], gp))
+    FAULTS.configure(
+        [{"site": "fed.commit", "kind": "error", "nth": 1, "count": 1}],
+        seed=7,
+    )
+    res = fd.admit_gang("default/c", members)
+    FAULTS.clear()
+    assert res["ok"] and res["unresolved"] == [first.shard_id]
+    first.kill()
+    rec = first.revive(fd.decisions)
+    assert rec["committed"] == [res["txn"]]
+    # members stay charged after forward-commit recovery
+    assert _free_core(a) + _free_core(b) == base - 200
+    for sh in (a, b):
+        assert sh.JOURNAL.flush()
+    audit = audit_federation(str(tmp_path))
+    assert not audit["violations"]
+
+
+def test_frontdoor_http_serves_federated_summary_and_debug(fed):
+    fd, a, b = fed
+    port = fd.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        assert get("/healthz")["ok"] is True
+        s = get("/scheduler/status?summary=1")
+        assert s["federated"] is True
+        assert s["nodes"] == len(a.node_names) + len(b.node_names)
+        dbg = get("/debug/federation")
+        assert set(dbg["shards"]) == {a.shard_id, b.shard_id}
+    finally:
+        fd.stop()
+
+
+def test_rendezvous_owner_resteers_only_lost_keys():
+    keys = [f"key-{i}".encode() for i in range(200)]
+    three = {k: rendezvous_owner(["a", "b", "c"], k) for k in keys}
+    two = {k: rendezvous_owner(["a", "b"], k) for k in keys}
+    moved = [k for k in keys if three[k] != two[k]]
+    # exactly the keys c owned move; a/b-owned keys stay put
+    assert moved == [k for k in keys if three[k] == "c"]
+    assert 0 < len(moved) < len(keys)
+
+
+def test_router_ring_steers_continuations_to_one_owner():
+    ring = RouterRing(page_size=4)
+    ring.add_router("r0", object())
+    ring.add_router("r1", object())
+    prefix = [1, 2, 3, 4]
+    keys = {
+        ring.steer_key({"prompt": prefix + extra}).hex()
+        for extra in ([], [5], [5, 6], [7, 8, 9])
+    }
+    # every continuation shares the chain root → one steering key
+    assert len(keys) == 1
+    # different adapters place the same tokens in different keyspaces
+    assert ring.steer_key({"prompt": prefix}) != ring.steer_key(
+        {"prompt": prefix, "adapter": "lora-x"}
+    )
+
+
+def test_cross_shard_audit_flags_disagreement_and_unresolved():
+    def _res(fed_gangs):
+        r = ReplayResult()
+        r.fed_gangs = fed_gangs
+        return r
+
+    # terminal disagreement: one commits, one aborts
+    split = cross_shard_violations({
+        "a": _res({"t1": {"phases": ["prepare", "commit"],
+                          "shards": ["a", "b"]}}),
+        "b": _res({"t1": {"phases": ["prepare", "abort"],
+                          "shards": ["a", "b"]}}),
+    })
+    assert any("disagree" in v for v in split)
+    # unresolved prepare
+    stuck = cross_shard_violations({
+        "a": _res({"t2": {"phases": ["prepare"], "shards": ["a"]}}),
+    })
+    assert any("unresolved" in v for v in stuck)
+    # committed with a silent declared participant
+    silent = cross_shard_violations({
+        "a": _res({"t3": {"phases": ["prepare", "commit"],
+                          "shards": ["a", "b"]}}),
+        "b": _res({}),
+    })
+    assert any("no record" in v for v in silent)
+    # aborted with a silent participant is the EXPECTED shape of a
+    # shard whose phase 1 faulted before journaling — not a violation
+    quiet_abort = cross_shard_violations({
+        "a": _res({"t4": {"phases": ["prepare", "abort"],
+                          "shards": ["a", "b"]}}),
+        "b": _res({}),
+    })
+    assert quiet_abort == []
+
+
+def test_journal_cli_replays_directory_of_shard_journals(fed, tmp_path):
+    from elastic_gpu_scheduler_tpu.journal.__main__ import main as jmain
+
+    fd, a, b = fed
+    members = []
+    for j, sh in enumerate((a, b)):
+        gp = _pod(f"cli-m{j}", core=100, gang="cli", gang_size=2)
+        sh.cluster.create_pod(gp)
+        members.append((sh.shard_id, sh.node_names[0], gp))
+    assert fd.admit_gang("default/cli", members)["ok"]
+    for sh in (a, b):
+        assert sh.JOURNAL.flush()
+    # root holds two shard journal dirs → federated mode, clean exit
+    dirs = shard_journal_dirs(str(tmp_path))
+    assert len(dirs) == 2
+    assert jmain(["replay", "--dir", str(tmp_path)]) == 0
+    assert jmain(["replay", "--dir", str(tmp_path), "--json"]) == 0
+    # a single shard dir still takes the single-stream path
+    assert jmain(["replay", "--dir", a.journal_dir]) == 0
+    # --status is single-stream only in federated mode
+    assert jmain(
+        ["replay", "--dir", str(tmp_path), "--status", "x.json"]
+    ) == 2
+
+
+def test_shard_key_for_entry_matches_index_bucket(fed):
+    from elastic_gpu_scheduler_tpu.core.index import topo_class
+    from elastic_gpu_scheduler_tpu.federation import shard_key_for_entry
+
+    fd, a, b = fed
+    idx = a.engine.index
+    entry = next(iter(idx.entries.values()))
+    key = shard_key_for_entry("us", entry)
+    assert key == f"us/{entry.generation}/{topo_class(entry.topo_key)}"
+    assert key.startswith("us/v5e/4x4")
+
+
+def test_merged_sources_folds_router_shard_replica_lists():
+    from elastic_gpu_scheduler_tpu.slo.assembly import merged_sources
+
+    r0 = lambda: [("a", ("127.0.0.1", 1)), ("b", ("127.0.0.1", 2))]
+    r1 = lambda: [("b", ("127.0.0.1", 2)), ("c", ("127.0.0.1", 3))]
+    fold = merged_sources(r0, r1)
+    assert fold() == [
+        ("a", ("127.0.0.1", 1)),
+        ("b", ("127.0.0.1", 2)),
+        ("c", ("127.0.0.1", 3)),
+    ]

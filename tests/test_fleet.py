@@ -1147,3 +1147,163 @@ def test_autoscaler_shed_rebalances_on_hold(tmp_path):
     res = replay(events)
     assert res.kv_migrations == 3 and not res.violations
     assert res.last_kv_migration["reason"] == "scale_down"
+
+
+# -- federation router ring: sharded data plane -----------------------------
+
+
+def _ring_member(servers):
+    """One router shard over the shared backend set: its OWN ReplicaSet
+    (each shard polls the fleet itself — ring.py's topology)."""
+    rs = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    for s in servers:
+        rs.add(s.replica())
+    rs.refresh()
+    router = FleetRouter(rs, host="127.0.0.1", port=0, page_size=4)
+    port = router.start()
+    return rs, router, port
+
+
+def test_router_ring_affinity_survives_join_and_death():
+    """Rendezvous steering keeps the fleet-wide PrefixIndex hit-rate
+    within tolerance of a single router across a shard join and a shard
+    death: a prefix re-steers at most ~1/n, and each re-steer costs one
+    warm-up miss on the new owner."""
+    from elastic_gpu_scheduler_tpu.federation import RouterRing
+
+    servers = [FakeReplicaServer(f"rep-{i}") for i in range(3)]
+    prompts = [[i * 100 + j for j in range(8)] for i in range(12)]
+    rounds = 4
+
+    def drive(ring, members):
+        owners = {}
+        for _ in range(rounds):
+            for i, prompt in enumerate(prompts):
+                body = {"prompt": prompt}
+                name, _router = ring.route(body)
+                owners.setdefault(i, set()).add(name)
+                st, _ = post_completion(members[name][2], body)
+                assert st == 200
+        return owners
+
+    # single-router baseline: same workload volume, one affinity map
+    base_rs, base_router, base_port = _ring_member(servers)
+    try:
+        for _ in range(3 * rounds):
+            for prompt in prompts:
+                st, _ = post_completion(base_port, {"prompt": prompt})
+                assert st == 200
+        base_aff = base_router.debug_state()["affinity"]
+        base_rate = base_aff["hits"] / base_aff["requests"]
+    finally:
+        base_router.stop()
+        base_rs.stop()
+
+    ring = RouterRing(page_size=4)
+    members = {}
+    try:
+        for name in ("r0", "r1"):
+            members[name] = _ring_member(servers)
+            ring.add_router(name, members[name][1])
+
+        # stable membership: every prefix sticks to exactly one owner
+        owners = drive(ring, members)
+        assert all(len(v) == 1 for v in owners.values())
+        before = {i: next(iter(v)) for i, v in owners.items()}
+
+        # join: only the keys the new shard WINS re-steer (~1/n)
+        members["r2"] = _ring_member(servers)
+        ring.add_router("r2", members["r2"][1])
+        owners = drive(ring, members)
+        assert all(len(v) == 1 for v in owners.values())
+        after_join = {i: next(iter(v)) for i, v in owners.items()}
+        moved = [i for i in before if after_join[i] != before[i]]
+        assert all(after_join[i] == "r2" for i in moved)
+        assert len(moved) < len(prompts)
+
+        # death: the dead shard's keys spread over the survivors
+        ring.remove_router("r0")
+        owners = drive(ring, members)
+        assert all(v <= {"r1", "r2"} for v in owners.values())
+
+        # fleet-wide hit rate within tolerance of the single-router
+        # baseline (worst case: one extra warm-up miss per surviving
+        # owner a prefix visited)
+        ring_rate = ring.aggregate_affinity()["hit_rate"]
+        assert ring_rate >= base_rate - 0.2
+    finally:
+        for rs, router, _port in members.values():
+            router.stop()
+            rs.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_ring_journeys_assemble_across_shards():
+    """A journey routed through one router shard resolves via
+    /debug/trace/<id> on ANY shard: every shard records into the
+    process-global SLO plane, so the trace doesn't care which port
+    answers."""
+    from elastic_gpu_scheduler_tpu.federation import RouterRing
+
+    servers = [FakeReplicaServer("rep-0")]
+    ring = RouterRing(page_size=4)
+    members = {}
+    try:
+        for name in ("r0", "r1"):
+            members[name] = _ring_member(servers)
+            ring.add_router(name, members[name][1])
+        # a prompt owned by each shard
+        by_owner = {}
+        for i in range(64):
+            body = {"prompt": [i * 10 + j for j in range(8)]}
+            name, _router = ring.route(body)
+            by_owner.setdefault(name, body)
+            if len(by_owner) == 2:
+                break
+        assert len(by_owner) == 2
+        ports = {n: members[n][2] for n in members}
+        other = {"r0": "r1", "r1": "r0"}
+        for k, (name, body) in enumerate(sorted(by_owner.items())):
+            tid = f"{k + 1:02d}" * 16  # all-zero trace ids are invalid
+            tp = f"00-{tid}-{'cd' * 8}-01"
+            st, _ = post_completion(ports[name], body, traceparent=tp)
+            assert st == 200
+            # resolve from the OTHER shard's port
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ports[other[name]]}/debug/trace/{tid}",
+                timeout=5,
+            ) as r:
+                payload = json.loads(r.read())
+            assert payload["trace_id"] == tid
+            assert payload["span_count"] >= 1
+            assert any(
+                s.get("name") == "fleet.route" for s in payload["spans"]
+            )
+    finally:
+        for rs, router, _port in members.values():
+            router.stop()
+            rs.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_autoscaler_folds_signals_across_router_shards():
+    """extra_replica_sets: the scaler's signals() must see the WHOLE
+    sharded data plane, not one router's slice."""
+    rs1 = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    a = rs1.add(Replica("a", "127.0.0.1", 1))
+    a.state = "up"
+    a.stats = {"queued": 6, "active_slots": 2, "max_batch": 4}
+    rs2 = ReplicaSet(interval_s=60.0, relay_monitor=FakeRelayMonitor())
+    b = rs2.add(Replica("b", "127.0.0.1", 2))
+    b.state = "up"
+    b.stats = {"queued": 0, "active_slots": 0, "max_batch": 4}
+    auto = Autoscaler(rs1, executor=None, extra_replica_sets=[rs2])
+    sig = auto.signals()
+    assert sig["replicas"] == 2
+    assert sig["queued"] == 6
+    assert sig["queue_per_replica"] == 3.0
+    assert sig["occupancy"] == 0.25

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""CI gate for the federated control plane (`make check-federation`).
+
+Seeded multi-shard soak: a 3-shard federation (one SchedulerShard per
+(region, generation, topology-class) key, each with its own journal
+stream) routes a pod churn through the front door and admits
+cross-shard gangs via two-phase admission while a deterministic fault
+plan fires at the ``fed.prepare`` / ``fed.commit`` sites.  HARD-FAILS
+when:
+
+- any cross-shard gang admits partially (all-or-nothing broken): an
+  injected phase-1 fault must leave ZERO members charged anywhere and
+  a compensating ``fed_gang`` abort in every prepared shard's journal,
+- a shard leader killed mid-commit (prepare sealed + decision=commit,
+  death before its commit record) does not resolve FORWARD from the
+  decision log on revive, or leaves any chip double-booked,
+- any shard's journal replays with violations or a non-empty live
+  diff, or the cross-shard conservation audit (federation/audit.py)
+  reports disagreement / silent participants / unresolved prepares,
+- the federated ``status_summary`` fold ever drifts from the direct sum of
+  the shards' own summaries (aggregate capacity conservation), or
+- the front-door route p99 exceeds CHECK_FED_ROUTE_BUDGET_MS
+  (default 6.8 ms = 2x BENCH_r09's schedule_bind_p99_ms of 3.404 —
+  the federation tier may at most double the single-scheduler bind).
+
+Usage:
+    python tools/check_federation.py
+
+Environment:
+    CHECK_FED_SEED             soak RNG seed (default 20260804)
+    CHECK_FED_NODES            fleetgen nodes per shard (default 48)
+    CHECK_FED_OPS              routed pods (default 120)
+    CHECK_FED_GANGS            cross-shard gangs (default 12)
+    CHECK_FED_ROUTE_BUDGET_MS  front-door route p99 ceiling (default 6.8)
+
+Wired into the Makefile as `make check-federation`, next to check-twin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elastic_gpu_scheduler_tpu.faultinject import FAULTS  # noqa: E402
+from elastic_gpu_scheduler_tpu.federation import (  # noqa: E402
+    FederationFrontDoor,
+    SchedulerShard,
+)
+from elastic_gpu_scheduler_tpu.federation.audit import (  # noqa: E402
+    audit_federation,
+)
+from elastic_gpu_scheduler_tpu.journal import read_journal  # noqa: E402
+from elastic_gpu_scheduler_tpu.journal.replay import (  # noqa: E402
+    diff_live,
+    replay,
+)
+from elastic_gpu_scheduler_tpu.k8s.client import FakeClientset  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.fake import FakeCluster  # noqa: E402
+from elastic_gpu_scheduler_tpu.k8s.objects import (  # noqa: E402
+    Container,
+    ResourceRequirements,
+    make_pod,
+)
+from elastic_gpu_scheduler_tpu.utils import consts  # noqa: E402
+from tools.fleetgen import make_fleet  # noqa: E402
+
+SEED = int(os.environ.get("CHECK_FED_SEED", "20260804"))
+NODES = int(os.environ.get("CHECK_FED_NODES", "48"))
+OPS = int(os.environ.get("CHECK_FED_OPS", "120"))
+GANGS = int(os.environ.get("CHECK_FED_GANGS", "12"))
+ROUTE_BUDGET_MS = float(os.environ.get("CHECK_FED_ROUTE_BUDGET_MS", "6.8"))
+
+SHARD_IDS = ["eu/v6e/4x4", "us/v5e/4x4", "us/v5p/4x4x4"]
+
+
+def _pod(name, core=0, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann[consts.ANNOTATION_GANG_NAME] = gang
+        ann[consts.ANNOTATION_GANG_SIZE] = str(gang_size)
+    res = {consts.RESOURCE_TPU_CORE: core} if core else {}
+    return make_pod(
+        name,
+        containers=[
+            Container(name="main", resources=ResourceRequirements(limits=res))
+        ],
+        annotations=ann,
+    )
+
+
+def _build(tmp):
+    fd = FederationFrontDoor()
+    shards = {}
+    for i, sid in enumerate(SHARD_IDS):
+        cluster = FakeCluster()
+        names = make_fleet(cluster, nodes=NODES, seed=SEED + i)
+        sh = SchedulerShard(
+            sid, FakeClientset(cluster),
+            os.path.join(tmp, sid), node_names=names,
+        )
+        sh.cluster = cluster
+        sh.warm()
+        shards[sid] = sh
+        fd.add_shard(sh)
+    fd.refresh_summaries()
+    return fd, shards
+
+
+def _free_core(shards) -> int:
+    return sum(
+        sh.engine.status_summary()["capacity"]["core_avail"]
+        for sh in shards.values()
+    )
+
+
+def _fold_drift(fd, shards) -> int:
+    """Federated capacity fold vs the direct per-shard sum — zero or
+    the aggregation layer is inventing/losing chips."""
+    fd.refresh_summaries()
+    folded = fd.federated_summary()["capacity"]["core_avail"]
+    return folded - _free_core(shards)
+
+
+def _fit_node(sh, pod, rng) -> str:
+    """A node on this shard that can actually host the member (the
+    front door's gang planner would run the same assume filter)."""
+    fit, _errors = sh.engine.assume(sh.node_names, pod)
+    if not fit:
+        raise RuntimeError(f"shard {sh.shard_id}: no node fits {pod.key}")
+    return rng.choice(fit)
+
+
+def _p99(samples_ms: list) -> float:
+    if not samples_ms:
+        return 0.0
+    s = sorted(samples_ms)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def main() -> int:
+    failures: list = []
+    result: dict = {
+        "seed": SEED, "shards": len(SHARD_IDS),
+        "nodes_per_shard": NODES, "ops": OPS, "gangs": GANGS,
+    }
+    rng = random.Random(SEED)
+    tmp = tempfile.mkdtemp(prefix="check_fed_")
+    try:
+        fd, shards = _build(tmp)
+        sids = sorted(shards)
+        base_free = _free_core(shards)
+        result["free_core_baseline"] = base_free
+        charged = 0  # core we EXPECT to be held at any point
+
+        # -- phase 1: routed pod churn, front-door latency ---------------
+        route_ms = []
+        for i in range(OPS):
+            core = rng.choice([50, 100, 200])
+            p = _pod(f"soak-{i}", core=core)
+            for sh in shards.values():
+                sh.cluster.create_pod(p)
+            t0 = time.perf_counter()
+            r = fd.route_pod(p)
+            route_ms.append((time.perf_counter() - t0) * 1000.0)
+            if not r["ok"]:
+                failures.append(f"route {p.key} failed: {r['error']}")
+                break
+            charged += core
+            if i % 40 == 0:
+                drift = _fold_drift(fd, shards)
+                if drift:
+                    failures.append(
+                        f"op {i}: federated capacity fold drifts "
+                        f"{drift} core from sum of shards"
+                    )
+        result["fed_route_p99_ms"] = round(_p99(route_ms), 3)
+        result["fed_route_budget_ms"] = ROUTE_BUDGET_MS
+        if result["fed_route_p99_ms"] > ROUTE_BUDGET_MS:
+            failures.append(
+                f"front-door route p99 {result['fed_route_p99_ms']}ms "
+                f"over budget {ROUTE_BUDGET_MS}ms (2x single-scheduler "
+                "bind p99)"
+            )
+
+        # -- phase 2: cross-shard gangs, all-or-nothing under faults -----
+        # every 3rd admission runs with an injected phase-1 fault on a
+        # participating shard: the whole transaction must abort and
+        # free EXACTLY what it reserved
+        admitted = aborted = 0
+        for g in range(GANGS):
+            pair = rng.sample(sids, 2)
+            gname = f"fg-{g}"
+            members = []
+            for j, sid in enumerate(sorted(pair)):
+                sh = shards[sid]
+                gp = _pod(f"{gname}-m{j}", core=100,
+                          gang=gname, gang_size=2)
+                sh.cluster.create_pod(gp)
+                members.append((sid, _fit_node(sh, gp, rng), gp))
+            inject = (g % 3 == 2)
+            if inject:
+                FAULTS.configure(
+                    [{"site": "fed.prepare", "kind": "error",
+                      "nth": 2, "count": 1}],
+                    seed=SEED + g,
+                )
+            pre_free = _free_core(shards)
+            res = fd.admit_gang(f"default/{gname}", members)
+            if inject:
+                FAULTS.clear()
+                if res["ok"]:
+                    failures.append(
+                        f"gang {gname}: admitted through an injected "
+                        "phase-1 fault"
+                    )
+                elif _free_core(shards) != pre_free:
+                    failures.append(
+                        f"gang {gname}: aborted but "
+                        f"{pre_free - _free_core(shards)} core still "
+                        "held — all-or-nothing broken"
+                    )
+                else:
+                    aborted += 1
+            elif not res["ok"]:
+                failures.append(
+                    f"gang {gname}: clean admission failed: "
+                    f"{res.get('error')}"
+                )
+            else:
+                admitted += 1
+                charged += 200
+        result["gangs_admitted"] = admitted
+        result["gangs_aborted"] = aborted
+        drift = _fold_drift(fd, shards)
+        if drift:
+            failures.append(
+                f"post-gang federated fold drifts {drift} core"
+            )
+        if _free_core(shards) != base_free - charged:
+            failures.append(
+                f"capacity drift: free {_free_core(shards)} != baseline "
+                f"{base_free} - charged {charged}"
+            )
+
+        # -- phase 3: shard-leader kill mid-commit -----------------------
+        # prepare seals everywhere, decision=commit, the FIRST shard's
+        # commit record faults; kill that leader, revive it against the
+        # decision log — it must resolve FORWARD (members stay charged)
+        pair = sorted(rng.sample(sids, 2))
+        victim = pair[0]
+        members = []
+        for j, sid in enumerate(pair):
+            sh = shards[sid]
+            gp = _pod(f"kill-m{j}", core=100, gang="kill", gang_size=2)
+            sh.cluster.create_pod(gp)
+            members.append((sid, _fit_node(sh, gp, rng), gp))
+        FAULTS.configure(
+            [{"site": "fed.commit", "kind": "error", "nth": 1,
+              "count": 1}],
+            seed=SEED,
+        )
+        res = fd.admit_gang("default/kill", members)
+        FAULTS.clear()
+        if not (res["ok"] and res.get("unresolved") == [victim]):
+            failures.append(
+                f"mid-commit fault: expected commit with {victim} "
+                f"unresolved, got {res}"
+            )
+        else:
+            charged += 200
+            shards[victim].kill()
+            rec = shards[victim].revive(fd.decisions)
+            if rec["committed"] != [res["txn"]]:
+                failures.append(
+                    f"revive resolved {rec}, expected forward-commit "
+                    f"of {res['txn']}"
+                )
+            if _free_core(shards) != base_free - charged:
+                failures.append(
+                    f"post-revive drift: free {_free_core(shards)} != "
+                    f"baseline {base_free} - charged {charged} "
+                    "(double-book or lost charge)"
+                )
+        result["free_core_final"] = _free_core(shards)
+        result["charged_core"] = charged
+
+        # -- phase 4: every journal replays clean, cross-shard audit -----
+        for sid in sids:
+            sh = shards[sid]
+            if not sh.JOURNAL.flush():
+                failures.append(f"{sid}: journal flush failed")
+                continue
+            r = replay(read_journal(sh.journal_dir))
+            if r.violations:
+                failures.append(
+                    f"{sid}: replay violations: {r.violations[:3]}"
+                )
+            d = diff_live(r, sh.engine.status())
+            if d:
+                failures.append(f"{sid}: live diff non-empty: {d[:3]}")
+        audit = audit_federation(tmp)
+        result["fed_gang_txns"] = len(audit["fed_gangs"])
+        if audit["violations"]:
+            failures.append(
+                f"cross-shard audit: {audit['violations'][:3]}"
+            )
+    finally:
+        FAULTS.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    result["failures"] = failures
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

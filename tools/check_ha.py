@@ -18,7 +18,11 @@ a standby performs a WARM takeover.  HARD-FAILS when:
 - warm takeover is not at least CHECK_HA_MIN_SPEEDUP× faster than the
   cold rebuild it replaces, or
 - leader-election chaos (injected renew faults) fails to fail-stop and
-  re-acquire, or the router's probe-fault breaker never re-closes.
+  re-acquire, or the router's probe-fault breaker never re-closes, or
+- a federation shard leader killed mid-phase-1 of a cross-shard gang
+  (prepare sealed, then death + a second-shard prepare fault) leaves
+  any chip double-booked, any surviving shard's journal without its
+  compensating rollback, or the cross-shard conservation audit dirty.
 
 Usage:
     python tools/check_ha.py
@@ -150,6 +154,125 @@ def _router_chaos(failures: list, scheduler_base_port: int) -> None:
             f"router chaos: breaker never re-closed (state={r.state})"
         )
     FAULTS.clear()
+
+
+def _federation_chaos(failures: list, result: dict) -> None:
+    """Phase 5: shard-leader death mid-phase-1 of a cross-shard gang.
+    The victim seals (journals + flushes) its prepare, dies, and the
+    second participant's phase-1 faults — the front door must decide
+    abort, compensate every SURVIVING shard (reverse-order
+    gang_unallocate, journaled fed_gang abort), and the revived victim
+    must presume abort from the decision log.  Zero double-booked
+    chips: aggregate free core returns exactly to the pre-gang
+    baseline, and the cross-shard journal audit is clean."""
+    from elastic_gpu_scheduler_tpu.federation import (
+        FederationFrontDoor,
+        SchedulerShard,
+    )
+    from elastic_gpu_scheduler_tpu.federation.audit import audit_federation
+
+    tmp = tempfile.mkdtemp(prefix="check_ha_fed_")
+    try:
+        fd = FederationFrontDoor()
+        shards = {}
+        for i, sid in enumerate(["us/v5e/4x4", "us/v5p/4x4x4",
+                                 "eu/v6e/4x4"]):
+            cluster = FakeCluster()
+            names = make_fleet(cluster, nodes=24, seed=SEED + i)
+            sh = SchedulerShard(
+                sid, FakeClientset(cluster),
+                os.path.join(tmp, sid), node_names=names,
+            )
+            sh.cluster = cluster
+            sh.warm()
+            shards[sid] = sh
+            fd.add_shard(sh)
+        fd.refresh_summaries()
+
+        def free_core():
+            return sum(
+                sh.engine.status_summary()["capacity"]["core_avail"]
+                for sh in shards.values()
+            )
+
+        sids = sorted(shards)
+        base_free = free_core()
+        victim = sids[0]  # first in shard order → prepares first
+        # the kill lands AFTER the victim's prepare is sealed on disk
+        # (journal flushed) — the in-doubt reservation revive must
+        # resolve; the nth=2 fault then fails the SECOND prepare
+        fd.on_prepared = (
+            lambda txn, sid: shards[sid].kill() if sid == victim else None
+        )
+        FAULTS.configure(
+            [{"site": "fed.prepare", "kind": "error", "nth": 2,
+              "count": 1}],
+            seed=SEED,
+        )
+        members = []
+        for j, sid in enumerate(sids[:2]):
+            sh = shards[sid]
+            gp = _pod(f"fed-kill-{j}", core=100, gang="fedkill",
+                      gang_size=2)
+            sh.cluster.create_pod(gp)
+            members.append((sid, sh.node_names[j], gp))
+        res = fd.admit_gang("default/fedkill", members)
+        FAULTS.clear()
+        fd.on_prepared = None
+        if res["ok"]:
+            failures.append(
+                "phase 5: gang admitted despite shard death mid-phase-1"
+            )
+            return
+        txn = res["txn"]
+        if fd.decisions.get(txn) != "abort":
+            failures.append(
+                f"phase 5: decision log says {fd.decisions.get(txn)!r} "
+                "for a failed phase-1, expected 'abort'"
+            )
+        # surviving shards must already be compensated and conserved
+        for sid in sids:
+            if sid == victim:
+                continue
+            sh = shards[sid]
+            if not sh.JOURNAL.flush():
+                failures.append(f"phase 5: {sid} journal flush failed")
+                continue
+            r = replay(read_journal(sh.journal_dir))
+            if r.violations:
+                failures.append(
+                    f"phase 5: survivor {sid} replay violations: "
+                    f"{r.violations[:3]}"
+                )
+            d = diff_live(r, sh.engine.status())
+            if d:
+                failures.append(
+                    f"phase 5: survivor {sid} live diff non-empty: "
+                    f"{d[:3]}"
+                )
+        # revive the victim: presumed abort from the decision log
+        rec = shards[victim].revive(fd.decisions)
+        if rec["aborted"] != [txn]:
+            failures.append(
+                f"phase 5: revive resolved {rec}, expected abort of {txn}"
+            )
+        audit = audit_federation(tmp)
+        if audit["violations"]:
+            failures.append(
+                f"phase 5: cross-shard audit violations: "
+                f"{audit['violations'][:3]}"
+            )
+        after = free_core()
+        result["federation_free_core_baseline"] = base_free
+        result["federation_free_core_after"] = after
+        if after != base_free:
+            failures.append(
+                f"phase 5: {base_free - after} core double-booked/lost "
+                "after shard-kill rollback"
+            )
+    finally:
+        FAULTS.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> int:
@@ -431,6 +554,9 @@ def main() -> int:
                 f"post-takeover live diff non-empty: {d[:3]}"
             )
         JOURNAL.close()
+
+        # -- phase 5: federation shard-leader death mid-phase-1 ----------
+        _federation_chaos(failures, result)
     finally:
         FAULTS.clear()
         JOURNAL.close()
